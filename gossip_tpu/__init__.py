@@ -1,0 +1,33 @@
+"""gossip_tpu — a TPU-native gossip / epidemic-broadcast simulation framework.
+
+Re-imagines the capabilities of the reference Go program
+(``0xSherlokMo/gossip-protocol``, a Maelstrom "Gossip Glomers" broadcast node,
+``/root/reference/main.go``) as a batched, round-synchronous simulator built
+on JAX / XLA / shard_map for TPU device meshes.
+
+The reference is *event-driven*: one OS process per cluster node, a goroutine
+per message, blocking RPC fan-out with retries (main.go:65-89).  The TPU-native
+design inverts this: the whole cluster is a handful of ``[N]``-shaped arrays,
+one gossip round is one jitted function (sample targets -> scatter/gather ->
+threshold -> update), and a simulation is ``lax.scan`` / ``lax.while_loop``
+over rounds.  The node dimension is sharded over the device mesh with
+``shard_map``; coverage counters ride ``psum`` over ICI.
+
+Layout:
+  - :mod:`gossip_tpu.topology`  — graph families as static padded neighbor tables
+  - :mod:`gossip_tpu.ops`      — sampling + propagation kernels (the hot path)
+  - :mod:`gossip_tpu.models`   — protocol semantics (SI push/pull, anti-entropy,
+    SWIM failure detection, multi-rumor)
+  - :mod:`gossip_tpu.parallel` — mesh + shard_map node-dim sharding
+  - :mod:`gossip_tpu.runtime`  — simulators (round-batched JAX backend and the
+    Go-semantics event-driven parity backend), Maelstrom protocol runtime
+  - :mod:`gossip_tpu.utils`    — metrics, checkpointing, tracing
+"""
+
+__version__ = "0.1.0"
+
+from gossip_tpu.config import (  # noqa: F401
+    ProtocolConfig,
+    RunConfig,
+    TopologyConfig,
+)

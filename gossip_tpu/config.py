@@ -1,0 +1,134 @@
+"""Configuration dataclasses.
+
+The reference has *no* config system at all — no flags, no env vars; its only
+runtime configuration is the ``topology`` message (reference main.go:132-149).
+The new framework makes every implicit constant explicit and sweepable:
+cluster size N, fanout, protocol mode, topology family, mesh shape, backend.
+
+All configs are frozen (hashable) so they can be closed over by jitted
+functions or used as static arguments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+# Protocol modes.
+PUSH = "push"            # infected nodes push the rumor to sampled peers
+PULL = "pull"            # all nodes pull from sampled peers
+PUSH_PULL = "pushpull"   # both directions in one round
+FLOOD = "flood"          # push to ALL neighbors every round (Go-parity mode:
+                         # the reference relays to its full neighbor list,
+                         # main.go:72-75; coverage(t) == BFS ball of radius t)
+ANTI_ENTROPY = "antientropy"  # periodic full-digest pull exchange
+SWIM = "swim"            # SWIM-style suspect/confirm failure detection
+
+MODES = (PUSH, PULL, PUSH_PULL, FLOOD, ANTI_ENTROPY, SWIM)
+
+# Topology families.
+COMPLETE = "complete"    # implicit: uniform random peer, no neighbor table
+RING = "ring"
+GRID = "grid"
+ERDOS_RENYI = "erdos_renyi"
+WATTS_STROGATZ = "watts_strogatz"
+POWER_LAW = "power_law"  # Barabasi-Albert preferential attachment
+
+FAMILIES = (COMPLETE, RING, GRID, ERDOS_RENYI, WATTS_STROGATZ, POWER_LAW)
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyConfig:
+    """Which graph the rumor spreads on.
+
+    The reference receives its topology at runtime as a ``node -> [neighbors]``
+    JSON map (main.go:132-149).  Here topologies are generated up front as
+    static padded neighbor tables (``int32[N, D]`` with out-of-range sentinel
+    padding) so shapes stay static for XLA; the ``complete`` family is
+    *implicit* (uniform sampling, no table) so it scales to 10M+ nodes with
+    zero adjacency memory.
+    """
+
+    family: str = COMPLETE
+    n: int = 1024
+    # family-specific parameters:
+    k: int = 4            # ring/WS: neighbors per side*2; BA: edges per new node
+    p: float = 0.01       # ER edge probability / WS rewire probability
+    degree_cap: Optional[int] = None  # cap padded table width (power-law tails)
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.family not in FAMILIES:
+            raise ValueError(f"unknown topology family {self.family!r}")
+        if self.n < 2:
+            raise ValueError("need at least 2 nodes")
+
+
+@dataclasses.dataclass(frozen=True)
+class ProtocolConfig:
+    """Gossip protocol semantics for one simulation.
+
+    ``fanout`` generalizes the reference's fixed "all neighbors" fan-out
+    (main.go:72): sampled-peer protocols contact ``fanout`` random peers per
+    round; ``flood`` ignores it and contacts every neighbor, which is the
+    faithful Go-parity behavior.
+    """
+
+    mode: str = PUSH
+    fanout: int = 1
+    rumors: int = 1          # R: number of concurrent rumors (multi-rumor broadcast)
+    exclude_self: bool = True
+    # anti-entropy: run a full-digest pull exchange every `period` rounds.
+    period: int = 1
+    # SWIM parameters (see models/swim.py):
+    swim_proxies: int = 3        # indirect-probe proxies (the "k" of SWIM)
+    swim_suspect_rounds: int = 4 # rounds a suspect waits before confirm-dead
+    swim_subjects: int = 8       # number of tracked (possibly-failing) subjects
+
+    def __post_init__(self):
+        if self.mode not in MODES:
+            raise ValueError(f"unknown protocol mode {self.mode!r}")
+        if self.fanout < 1:
+            raise ValueError("fanout must be >= 1")
+        if self.rumors < 1:
+            raise ValueError("rumors must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultConfig:
+    """In-kernel fault injection.
+
+    The reference never injects faults itself — Maelstrom partitions the
+    network externally, and the node survives via an unbounded retry loop
+    (main.go:77-87).  In the batched simulator faults are masks applied inside
+    the round kernel: a dead node neither sends nor receives; a dropped edge
+    loses this round's message (retried implicitly next round, which mirrors
+    at-least-once delivery + idempotent receipt, main.go:80-87 + 113).
+    """
+
+    node_death_rate: float = 0.0   # fraction of nodes dead (static mask)
+    drop_prob: float = 0.0         # per-message drop probability per round
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    """Simulation driver parameters."""
+
+    target_coverage: float = 0.99
+    max_rounds: int = 256
+    seed: int = 0
+    origin: int = 0          # node where rumor 0 starts (rumor r starts at origin+r)
+
+    def __post_init__(self):
+        if not 0.0 < self.target_coverage <= 1.0:
+            raise ValueError("target_coverage must be in (0, 1]")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device mesh for node-dimension sharding (the SP/CP analog: the scaled
+    long dimension here is *nodes*, not tokens — see SURVEY.md §5)."""
+
+    n_devices: int = 1
+    axis_name: str = "nodes"

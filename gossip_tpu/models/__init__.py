@@ -1,0 +1,2 @@
+from gossip_tpu.models.state import SimState, init_state, alive_mask  # noqa: F401
+from gossip_tpu.models.si import make_si_round, coverage  # noqa: F401

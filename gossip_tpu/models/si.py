@@ -1,0 +1,142 @@
+"""SI-family gossip rounds: push / pull / push-pull / flood / anti-entropy.
+
+One round is a pure function ``SimState -> SimState`` built once per
+(protocol, topology, fault) config and jitted by the caller.  Semantics map
+from the reference like so:
+
+  reference (event-driven, main.go)       batched round (here)
+  --------------------------------------  --------------------------------
+  relay to all neighbors   (72-75)        ``flood`` mode (gather over row)
+  dedup set receipt        (113, 66)      OR-merge into ``seen`` (idempotent)
+  at-least-once retry      (80-87)        a lost push is simply re-sent in a
+                                          later round because the sender stays
+                                          active while infected
+  ack-before-process       (109)          N/A — no blocking anywhere
+  sender exclusion         (73-75)        omitted: changes message counts by
+                                          O(1/degree), never the infected set
+
+Fault injection (the analog of Maelstrom's external partitions, SURVEY.md §5):
+``FaultConfig.node_death_rate`` statically kills nodes (they neither send,
+respond, nor receive); ``drop_prob`` drops each (sender, target) edge use
+per round, modeling lossy links healed by the next round's resend.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from gossip_tpu import config as C
+from gossip_tpu.config import FaultConfig, ProtocolConfig
+from gossip_tpu.models.state import SimState, alive_mask
+from gossip_tpu.ops.propagate import flood_gather, pull_merge, push_delta
+from gossip_tpu.ops.sampling import sample_peers
+from gossip_tpu.topology.generators import Topology
+
+# Sub-key tags so push and pull draws in the same round are independent.
+# Drop keys are folded into the *round* key (not the push/pull key) because
+# fold_in(pkey, small_tag) would collide with node small_tag's per-node
+# sampling key (node keys are fold_in(pkey, node_id)).
+_PUSH_TAG, _PULL_TAG, _PUSH_DROP_TAG, _PULL_DROP_TAG, _FLOOD_DROP_TAG = (
+    1, 2, 3, 4, 5)
+
+
+def _apply_drop(key: jax.Array, targets: jax.Array, drop_prob: float,
+                sentinel: int) -> jax.Array:
+    """Lossy links: turn dropped targets into the sentinel (scatter-dropped)."""
+    if drop_prob <= 0.0:
+        return targets
+    dropped = jax.random.bernoulli(key, drop_prob, targets.shape)
+    return jnp.where(dropped, jnp.int32(sentinel), targets)
+
+
+def make_si_round(proto: ProtocolConfig, topo: Topology,
+                  fault: Optional[FaultConfig] = None,
+                  origin: int = 0) -> Callable[[SimState], SimState]:
+    """Build the single-device round step.  The sharded equivalent lives in
+    :mod:`gossip_tpu.parallel.sharded` and must stay semantically identical
+    (tested in tests/test_sharding.py)."""
+    n, k = topo.n, proto.fanout
+    mode = proto.mode
+    if mode == C.SWIM:
+        raise ValueError("SWIM rounds are built by models/swim.py")
+    if mode == C.FLOOD and topo.implicit:
+        raise ValueError("flood mode needs an explicit neighbor table")
+    alive = alive_mask(fault, n, origin)
+    drop_prob = 0.0 if fault is None else fault.drop_prob
+    ids = jnp.arange(n, dtype=jnp.int32)
+
+    def step(state: SimState) -> SimState:
+        rkey = jax.random.fold_in(state.base_key, state.round)
+        seen = state.seen
+        # What peers can observe of node i: dead nodes go dark.
+        visible = seen if alive is None else seen & alive[:, None]
+        delta = jnp.zeros_like(seen)
+        msgs = state.msgs
+
+        if mode in (C.PUSH, C.PUSH_PULL):
+            pkey = jax.random.fold_in(rkey, _PUSH_TAG)
+            targets = sample_peers(pkey, ids, topo, k, proto.exclude_self)
+            targets = _apply_drop(jax.random.fold_in(rkey, _PUSH_DROP_TAG),
+                                  targets, drop_prob, n)
+            sender_active = jnp.any(visible, axis=1)          # [N]
+            valid = (targets < n) & sender_active[:, None]    # [N, k]
+            delta = delta | push_delta(n, jnp.where(valid, targets, n),
+                                       visible)
+            msgs = msgs + jnp.sum(valid).astype(jnp.float32)
+
+        if mode in (C.PULL, C.PUSH_PULL) or mode == C.ANTI_ENTROPY:
+            qkey = jax.random.fold_in(rkey, _PULL_TAG)
+            partners = sample_peers(qkey, ids, topo, k, proto.exclude_self)
+            partners = _apply_drop(jax.random.fold_in(rkey, _PULL_DROP_TAG),
+                                   partners, drop_prob, n)
+            pulled = pull_merge(visible, partners, n)
+            # dead nodes neither request nor receive (alive-mask contract)
+            if alive is not None:
+                partners = jnp.where(alive[:, None], partners, n)
+            n_req = jnp.sum(partners < n).astype(jnp.float32)
+            if mode == C.ANTI_ENTROPY and proto.period > 1:
+                # Periodic full-digest exchange (classic anti-entropy cadence);
+                # off-rounds are quiescent.
+                on = (state.round % proto.period) == 0
+                pulled = jnp.where(on, pulled, False)
+                n_req = jnp.where(on, n_req, 0.0)
+            delta = delta | pulled
+            msgs = msgs + 2.0 * n_req  # request + digest response
+
+        if mode == C.FLOOD:
+            nbrs = topo.nbrs
+            if drop_prob > 0.0:
+                # lossy links drop individual edge uses this round; the edge
+                # is retried next round (at-least-once, main.go:80-87)
+                fkey = jax.random.fold_in(rkey, _FLOOD_DROP_TAG)
+                dropped = jax.random.bernoulli(fkey, drop_prob, nbrs.shape)
+                nbrs = jnp.where(dropped, jnp.int32(n), nbrs)
+            delta = flood_gather(visible, nbrs, n)
+            sender_active = jnp.any(visible, axis=1)
+            msgs = msgs + jnp.sum(
+                jnp.where(sender_active, topo.deg, 0)).astype(jnp.float32)
+
+        if alive is not None:
+            delta = delta & alive[:, None]  # dead nodes receive nothing
+        return SimState(seen=seen | delta, round=state.round + 1,
+                        base_key=state.base_key, msgs=msgs)
+
+    return step
+
+
+def coverage(seen: jax.Array,
+             alive: Optional[jax.Array] = None) -> jax.Array:
+    """Min-over-rumors fraction of (alive) nodes that have each rumor.
+
+    The Maelstrom checker's invariant is "every broadcast eventually appears
+    in every node's read" (SURVEY.md §4); with dead nodes the reachable
+    population is the alive set.
+    """
+    if alive is None:
+        return jnp.min(jnp.mean(seen.astype(jnp.float32), axis=0))
+    w = alive.astype(jnp.float32)
+    per_rumor = (seen.astype(jnp.float32) * w[:, None]).sum(0) / w.sum()
+    return jnp.min(per_rumor)

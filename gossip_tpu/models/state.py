@@ -1,0 +1,63 @@
+"""Simulation state: the whole cluster as a few arrays.
+
+The reference keeps, per process: an append-only message log + dedup set
+behind an RWMutex (main.go:22-58) and a topology map (main.go:60-63).  The
+batched equivalent of the *dedup set across the whole cluster* is one array:
+
+    seen: bool[N, R]    seen[i, r]  <=>  node i has received rumor r
+
+The append-only ordered log exists to serve ``read`` (main.go:123-130); order
+is arrival order with no guarantee (SURVEY.md §2.2.9), so the set view is the
+semantically load-bearing part — the Maelstrom checker itself treats messages
+as a set (SURVEY.md §2.2.5).  The Maelstrom-compat runtime
+(:mod:`gossip_tpu.runtime.maelstrom_node`) keeps a real ordered log, since it
+must answer real ``read`` RPCs.
+
+There are deliberately **no locks anywhere**: one round = one XLA program, so
+the reference's dedup TOCTOU race and unsynchronized topology write
+(SURVEY.md §2.2.5-6) are structurally impossible here.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
+
+
+class SimState(NamedTuple):
+    """Carried through ``lax.scan`` / ``lax.while_loop`` rounds."""
+
+    seen: jax.Array      # bool[N, R]
+    round: jax.Array     # int32 scalar — round counter (the synchronous clock)
+    base_key: jax.Array  # PRNG key; round keys are fold_in(base_key, round)
+    msgs: jax.Array      # float32 scalar — cumulative messages sent
+
+
+def init_state(run: RunConfig, proto: ProtocolConfig, n: int) -> SimState:
+    """Rumor r starts at node (origin + r) % n — the ``broadcast`` injection
+    point (each Maelstrom client broadcast lands at one node, main.go:102)."""
+    r = proto.rumors
+    origins = (run.origin + jnp.arange(r)) % n
+    seen = jnp.zeros((n, r), jnp.bool_).at[origins, jnp.arange(r)].set(True)
+    return SimState(
+        seen=seen,
+        round=jnp.int32(0),
+        base_key=jax.random.key(run.seed),
+        msgs=jnp.float32(0.0),
+    )
+
+
+def alive_mask(fault: Optional[FaultConfig], n: int,
+               origin: int = 0) -> Optional[jax.Array]:
+    """Static dead-node mask (None when no faults — keeps the fault-free hot
+    path free of masking work).  The rumor origin is pinned alive so the
+    simulation is non-degenerate."""
+    if fault is None or fault.node_death_rate <= 0.0:
+        return None
+    key = jax.random.key(fault.seed ^ 0x5157)
+    alive = ~jax.random.bernoulli(key, fault.node_death_rate, (n,))
+    return alive.at[origin].set(True)

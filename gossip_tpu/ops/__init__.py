@@ -1,0 +1,12 @@
+from gossip_tpu.ops.sampling import (  # noqa: F401
+    node_keys,
+    sample_peers,
+    sample_peers_complete,
+    sample_peers_table,
+)
+from gossip_tpu.ops.propagate import (  # noqa: F401
+    flood_gather,
+    pull_merge,
+    push_counts,
+    push_delta,
+)

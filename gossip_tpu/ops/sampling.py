@@ -1,0 +1,86 @@
+"""Random peer sampling — the batched analog of "pick a neighbor to gossip to".
+
+The reference contacts *all* neighbors sequentially (main.go:72-75).  Sampled
+protocols (push/pull/push-pull with fanout k) instead draw k random peers per
+node per round.  Everything here is shaped ``[N_local, k]`` with **static**
+shapes: we sample for every node every round and mask by activity afterwards —
+wasted lanes are far cheaper on TPU than ragged shapes (SURVEY.md §7 "Static
+shapes for sparse fanout").
+
+Reproducibility / mesh independence: peer choice for global node ``i`` in
+round ``t`` depends only on ``(base_key, t, i)`` — per-node keys are derived
+with ``fold_in(round_key, global_id)`` — so results are bitwise identical
+regardless of how the node axis is sharded (SURVEY.md §7 "Cross-shard
+randomness").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from gossip_tpu.topology.generators import Topology
+
+
+def node_keys(round_key: jax.Array, global_ids: jax.Array) -> jax.Array:
+    """Per-node PRNG keys: fold the global node id into the round key."""
+    return jax.vmap(jax.random.fold_in, in_axes=(None, 0))(round_key, global_ids)
+
+
+def sample_peers_complete(round_key: jax.Array, global_ids: jax.Array,
+                          n_total: int, k: int,
+                          exclude_self: bool = True) -> jax.Array:
+    """Uniform peers on the implicit complete graph -> int32[len(ids), k].
+
+    Self-exclusion uses the shift trick (draw from n-1, bump >= self) so no
+    rejection loop is needed.
+    """
+    keys = node_keys(round_key, global_ids)
+    if exclude_self and n_total > 1:
+        def one(key, i):
+            r = jax.random.randint(key, (k,), 0, n_total - 1, dtype=jnp.int32)
+            return r + (r >= i).astype(jnp.int32)
+    else:
+        def one(key, i):
+            del i
+            return jax.random.randint(key, (k,), 0, n_total, dtype=jnp.int32)
+    return jax.vmap(one)(keys, global_ids.astype(jnp.int32))
+
+
+def sample_peers_table(round_key: jax.Array, global_ids: jax.Array,
+                       nbrs: jax.Array, deg: jax.Array, k: int,
+                       sentinel: int) -> jax.Array:
+    """k uniform neighbors per node from a padded table -> int32[N_local, k].
+
+    ``nbrs``/``deg`` are the *local rows* for ``global_ids``.  Nodes with
+    degree 0 emit the sentinel (dropped by scatters, masked by gathers).
+    """
+    keys = node_keys(round_key, global_ids)
+
+    def one(key, row, d):
+        idx = jax.random.randint(key, (k,), 0, jnp.maximum(d, 1),
+                                 dtype=jnp.int32)
+        t = row[idx]
+        return jnp.where(d > 0, t, jnp.int32(sentinel))
+
+    return jax.vmap(one)(keys, nbrs, deg)
+
+
+def sample_peers(round_key: jax.Array, global_ids: jax.Array, topo: Topology,
+                 k: int, exclude_self: bool = True,
+                 local_nbrs: Optional[jax.Array] = None,
+                 local_deg: Optional[jax.Array] = None) -> jax.Array:
+    """Dispatch on implicit-vs-table topology (static choice, no tracing cost).
+
+    Under shard_map callers pass their local table slice via ``local_nbrs`` /
+    ``local_deg``; single-device callers let it default to the full table.
+    """
+    if topo.implicit:
+        return sample_peers_complete(round_key, global_ids, topo.n, k,
+                                     exclude_self)
+    nbrs = topo.nbrs if local_nbrs is None else local_nbrs
+    deg = topo.deg if local_deg is None else local_deg
+    return sample_peers_table(round_key, global_ids, nbrs, deg, k,
+                              sentinel=topo.n)

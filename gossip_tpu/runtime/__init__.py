@@ -1,0 +1,6 @@
+from gossip_tpu.runtime.simulator import (  # noqa: F401
+    CurveResult,
+    UntilResult,
+    simulate_curve,
+    simulate_until,
+)

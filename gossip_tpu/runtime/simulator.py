@@ -1,0 +1,119 @@
+"""Round-batched simulation drivers (the ``jax-tpu`` backend).
+
+Two drivers over the same round step:
+
+  * :func:`simulate_curve` — ``lax.scan`` over a fixed number of rounds,
+    recording the coverage curve + cumulative message counts.  This is the
+    observability product the reference never had (SURVEY.md §5: Maelstrom
+    computed everything externally).
+  * :func:`simulate_until` — ``lax.while_loop`` until coverage >= target,
+    for racing the wall-clock (the bench path).  No per-round host sync:
+    the whole loop is one XLA program.
+
+The Go-semantics event-driven backend (``go-native``) lives in
+:mod:`gossip_tpu.runtime.gonative`; both implement "run this protocol config
+to convergence", which is the Backend seam from BASELINE.json's north star.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
+from gossip_tpu.models.si import coverage, make_si_round
+from gossip_tpu.models.state import SimState, alive_mask, init_state
+from gossip_tpu.topology.generators import Topology
+
+
+@dataclasses.dataclass
+class CurveResult:
+    coverage: np.ndarray        # float32[T] min-over-rumors coverage after round t
+    msgs: np.ndarray            # float32[T] cumulative messages after round t
+    rounds_to_target: int       # first round index with coverage >= target (+1),
+                                # or -1 if never reached
+    final_coverage: float
+    state: SimState
+
+
+@dataclasses.dataclass
+class UntilResult:
+    rounds: int
+    coverage: float
+    msgs: float
+    state: SimState
+
+
+def _build(proto: ProtocolConfig, topo: Topology, run: RunConfig,
+           fault: Optional[FaultConfig]):
+    step = make_si_round(proto, topo, fault, run.origin)
+    alive = alive_mask(fault, topo.n, run.origin)
+    init = init_state(run, proto, topo.n)
+    return step, alive, init
+
+
+def simulate_curve(proto: ProtocolConfig, topo: Topology, run: RunConfig,
+                   fault: Optional[FaultConfig] = None) -> CurveResult:
+    step, alive, init = _build(proto, topo, run, fault)
+
+    @jax.jit
+    def scan(init_state_):
+        def body(state, _):
+            state = step(state)
+            return state, (coverage(state.seen, alive), state.msgs)
+        return jax.lax.scan(body, init_state_, None, length=run.max_rounds)
+
+    final, (covs, msgs) = scan(init)
+    covs = np.asarray(covs)
+    msgs = np.asarray(msgs)
+    hit = np.nonzero(covs >= run.target_coverage)[0]
+    return CurveResult(
+        coverage=covs,
+        msgs=msgs,
+        rounds_to_target=int(hit[0]) + 1 if len(hit) else -1,
+        final_coverage=float(covs[-1]),
+        state=final,
+    )
+
+
+def simulate_until(proto: ProtocolConfig, topo: Topology, run: RunConfig,
+                   fault: Optional[FaultConfig] = None) -> UntilResult:
+    step, alive, init = _build(proto, topo, run, fault)
+    target = jnp.float32(run.target_coverage)
+
+    @jax.jit
+    def loop(init_state_):
+        def cond(state):
+            return ((coverage(state.seen, alive) < target)
+                    & (state.round < run.max_rounds))
+        return jax.lax.while_loop(cond, step, init_state_)
+
+    final = loop(init)
+    return UntilResult(
+        rounds=int(final.round),
+        coverage=float(coverage(final.seen, alive)),
+        msgs=float(final.msgs),
+        state=final,
+    )
+
+
+def compiled_until(proto: ProtocolConfig, topo: Topology, run: RunConfig,
+                   fault: Optional[FaultConfig] = None):
+    """Lowered/compiled while-loop runner + fresh init state, for benchmarks
+    that must separate compile time from run time."""
+    step, alive, init = _build(proto, topo, run, fault)
+    target = jnp.float32(run.target_coverage)
+
+    @partial(jax.jit, donate_argnums=0)
+    def loop(state):
+        def cond(s):
+            return ((coverage(s.seen, alive) < target)
+                    & (s.round < run.max_rounds))
+        return jax.lax.while_loop(cond, step, state)
+
+    return loop, init

@@ -1,0 +1,11 @@
+from gossip_tpu.topology.generators import (  # noqa: F401
+    Topology,
+    build,
+    complete,
+    complete_table,
+    erdos_renyi,
+    grid2d,
+    power_law,
+    ring,
+    watts_strogatz,
+)

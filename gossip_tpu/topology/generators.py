@@ -1,0 +1,248 @@
+"""Topology library: graph families as static padded neighbor tables.
+
+The reference's topology is a runtime ``map[string][]string`` of node-id ->
+neighbor list (reference main.go:60-63, filled at main.go:142, read in the
+gossip hot loop at main.go:72).  For XLA, ragged per-node neighbor lists
+become a **fixed-width padded table** ``nbrs: int32[N, D]`` (D = max degree,
+optionally capped) with out-of-range sentinel ``N`` in unused slots, plus a
+``deg: int32[N]`` vector.  Static shapes mean one compiled program per
+(N, D) — no recompiles as the rumor spreads.
+
+The ``complete`` family is *implicit*: at 10M nodes a table would be absurd,
+and uniform peer sampling needs no adjacency at all, so ``nbrs is None`` and
+samplers draw targets directly from ``[0, N)``.
+
+All generators are host-side numpy (cheap, one-time) and deterministic in
+their seed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import numpy as np
+
+from gossip_tpu import config as cfg_mod
+from gossip_tpu.config import TopologyConfig
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """A static graph, ready to be passed into jitted round kernels.
+
+    ``nbrs[i, j]`` is the j-th neighbor of node i for ``j < deg[i]`` and the
+    sentinel value ``n`` (out of range — scatter ``mode='drop'`` ignores it,
+    gathers mask it) for ``j >= deg[i]``.  ``nbrs is None`` for the implicit
+    complete graph.
+    """
+
+    nbrs: Optional[jax.Array]  # int32[N, D] or None (implicit complete graph)
+    deg: Optional[jax.Array]   # int32[N] or None
+    n: int = dataclasses.field(metadata=dict(static=True), default=0)
+    family: str = dataclasses.field(metadata=dict(static=True), default="complete")
+
+    @property
+    def implicit(self) -> bool:
+        return self.nbrs is None
+
+    @property
+    def width(self) -> int:
+        return 0 if self.nbrs is None else int(self.nbrs.shape[1])
+
+
+def _pack(n: int, src: np.ndarray, dst: np.ndarray,
+          degree_cap: Optional[int], family: str,
+          rng: np.random.Generator) -> Topology:
+    """Pack an edge list (directed pairs; callers pass both directions for
+    undirected graphs) into a padded neighbor table."""
+    order = np.argsort(src, kind="stable")
+    src, dst = src[order], dst[order]
+    deg = np.bincount(src, minlength=n).astype(np.int32)
+    d_max = int(deg.max()) if len(src) else 0
+    if degree_cap is not None and d_max > degree_cap:
+        # Per-node random subsample of neighbors down to the cap: keeps the
+        # table narrow under heavy-tailed degree distributions (power-law).
+        keep = np.ones(len(src), dtype=bool)
+        starts = np.concatenate([[0], np.cumsum(deg)])
+        for i in np.nonzero(deg > degree_cap)[0]:
+            lo, hi = starts[i], starts[i + 1]
+            drop = rng.choice(hi - lo, size=(hi - lo) - degree_cap, replace=False)
+            keep[lo + drop] = False
+        src, dst = src[keep], dst[keep]
+        deg = np.bincount(src, minlength=n).astype(np.int32)
+        d_max = degree_cap
+    d_max = max(d_max, 1)
+    nbrs = np.full((n, d_max), n, dtype=np.int32)  # sentinel = n
+    # Column index of each edge within its source row.
+    starts = np.concatenate([[0], np.cumsum(deg)])[:-1]
+    col = np.arange(len(src)) - np.repeat(starts, deg)
+    nbrs[src, col] = dst
+    import jax.numpy as jnp
+    return Topology(nbrs=jnp.asarray(nbrs), deg=jnp.asarray(deg), n=n,
+                    family=family)
+
+
+def complete(n: int) -> Topology:
+    """Implicit complete graph: every node can sample every other node.
+
+    This is the 10M-node scale path — no adjacency memory at all."""
+    return Topology(nbrs=None, deg=None, n=n, family=cfg_mod.COMPLETE)
+
+
+def complete_table(n: int) -> Topology:
+    """Materialized complete graph (small n only — parity fixtures)."""
+    src = np.repeat(np.arange(n), n - 1)
+    dst = np.concatenate([np.delete(np.arange(n), i) for i in range(n)])
+    return _pack(n, src.astype(np.int64), dst.astype(np.int64), None,
+                 cfg_mod.COMPLETE, np.random.default_rng(0))
+
+
+def ring(n: int, k: int = 2) -> Topology:
+    """Ring lattice: each node linked to the k nearest neighbors (k/2 per
+    side).  k must be even."""
+    if k % 2 or k < 2:
+        raise ValueError("ring k must be even and >= 2")
+    offs = np.concatenate([np.arange(1, k // 2 + 1), -np.arange(1, k // 2 + 1)])
+    src = np.repeat(np.arange(n), k)
+    dst = (src + np.tile(offs, n)) % n
+    return _pack(n, src, dst, None, cfg_mod.RING, np.random.default_rng(0))
+
+
+def grid2d(rows: int, cols: int) -> Topology:
+    """2-D grid, 4-connected, non-wrapping (the classic Maelstrom topology
+    shape that the harness hands to the reference node)."""
+    n = rows * cols
+    i = np.arange(n)
+    r, c = i // cols, i % cols
+    pairs = []
+    for dr, dc in ((0, 1), (1, 0)):
+        ok = (r + dr < rows) & (c + dc < cols)
+        a = i[ok]
+        b = (r[ok] + dr) * cols + (c[ok] + dc)
+        pairs.append((a, b))
+        pairs.append((b, a))
+    src = np.concatenate([p[0] for p in pairs])
+    dst = np.concatenate([p[1] for p in pairs])
+    return _pack(n, src, dst, None, cfg_mod.GRID, np.random.default_rng(0))
+
+
+def erdos_renyi(n: int, p: float, seed: int = 0,
+                degree_cap: Optional[int] = None) -> Topology:
+    """G(n, p) via sparse edge sampling: draw Binomial(n*(n-1)/2, p) edge
+    slots, then sample that many distinct unordered pairs.  O(E), not O(N^2)."""
+    rng = np.random.default_rng(seed)
+    m_total = n * (n - 1) // 2
+    m = rng.binomial(m_total, p)
+    if m > m_total // 8:
+        # Dense regime: rejection sampling degenerates (coupon collector);
+        # take a permutation prefix instead.  Only feasible when m_total
+        # itself is materializable — which is the only regime where a dense
+        # G(n,p) is materializable anyway.
+        codes = rng.permutation(m_total)[:m]
+    else:
+        # Sparse regime: sample unordered-pair codes without replacement via
+        # collision-resample with geometrically growing batches.
+        codes = np.unique(rng.integers(0, m_total, size=int(m * 1.05) + 16))
+        batch = max(m // 8, 64)
+        while len(codes) < m:
+            extra = rng.integers(0, m_total, size=batch)
+            codes = np.unique(np.concatenate([codes, extra]))
+            batch *= 2
+        codes = rng.permutation(codes)[:m]
+    # Decode unordered-pair index -> (a, b), a < b (triangular decoding).
+    b = np.ceil((np.sqrt(8.0 * codes + 9) - 1) / 2).astype(np.int64)
+    a = (codes - b * (b - 1) // 2).astype(np.int64)
+    src = np.concatenate([a, b])
+    dst = np.concatenate([b, a])
+    return _pack(n, src, dst, degree_cap, cfg_mod.ERDOS_RENYI, rng)
+
+
+def watts_strogatz(n: int, k: int = 4, beta: float = 0.1,
+                   seed: int = 0) -> Topology:
+    """Watts–Strogatz small world: ring lattice with each edge rewired to a
+    uniform random endpoint with probability beta."""
+    if k % 2 or k < 2:
+        raise ValueError("watts_strogatz k must be even and >= 2")
+    rng = np.random.default_rng(seed)
+    src = np.repeat(np.arange(n), k // 2)
+    dst = (src + np.tile(np.arange(1, k // 2 + 1), n)) % n
+    rewire = rng.random(len(src)) < beta
+    new_dst = rng.integers(0, n, size=len(src))
+    # avoid self-loops on rewire
+    new_dst = np.where(new_dst == src, (new_dst + 1) % n, new_dst)
+    dst = np.where(rewire, new_dst, dst)
+    s = np.concatenate([src, dst])
+    d = np.concatenate([dst, src])
+    # rewiring can collide with an existing or another rewired edge; collapse
+    # duplicates so the padded table never repeats a neighbor
+    codes = np.unique(s.astype(np.int64) * n + d)
+    s, d = codes // n, codes % n
+    return _pack(n, s, d, None, cfg_mod.WATTS_STROGATZ, rng)
+
+
+def power_law(n: int, m: int = 2, seed: int = 0,
+              degree_cap: Optional[int] = None) -> Topology:
+    """Barabási–Albert preferential attachment via the repeated-nodes trick:
+    each new node attaches to m targets drawn uniformly from the flat list of
+    all previous edge endpoints (which is exactly degree-proportional).
+    Vectorized enough to build 1M-node graphs in seconds."""
+    rng = np.random.default_rng(seed)
+    if m < 1 or n <= m:
+        raise ValueError("power_law needs n > m >= 1")
+    # endpoint pool; seed with a small clique among the first m+1 nodes
+    srcs = [np.repeat(np.arange(m + 1), m)]
+    dsts = [np.concatenate([np.delete(np.arange(m + 1), i)[:m]
+                            for i in range(m + 1)])]
+    pool = np.concatenate(srcs + dsts)
+    pool_list = [pool]
+    pool_size = len(pool)
+    # process new nodes in growing chunks; inside a chunk, attach against the
+    # frozen pool (slight approximation of strict sequential BA, standard for
+    # scalable generation)
+    new = np.arange(m + 1, n)
+    chunk = max(1024, (n - m - 1) // 64)
+    for lo in range(0, len(new), chunk):
+        nodes = new[lo:lo + chunk]
+        flat_pool = np.concatenate(pool_list) if len(pool_list) > 1 else pool_list[0]
+        pool_list = [flat_pool]
+        # picks come from the frozen pool, whose ids all predate this chunk's
+        # nodes, so self-picks are impossible; duplicate directed edges are
+        # collapsed by the unique() pass below.
+        picks = flat_pool[rng.integers(0, pool_size, size=(len(nodes), m))]
+        s = np.repeat(nodes, m)
+        d = picks.reshape(-1)
+        srcs.append(s)
+        dsts.append(d)
+        addition = np.concatenate([s, d])
+        pool_list.append(addition)
+        pool_size += len(addition)
+    src = np.concatenate(srcs + dsts)
+    dst = np.concatenate(dsts + srcs)
+    # collapse duplicate directed edges so the padded table has no repeats
+    codes = src.astype(np.int64) * n + dst
+    codes = np.unique(codes)
+    src, dst = codes // n, codes % n
+    self_loop = src != dst
+    return _pack(n, src[self_loop], dst[self_loop], degree_cap,
+                 cfg_mod.POWER_LAW, rng)
+
+
+def build(tc: TopologyConfig) -> Topology:
+    """Build a topology from config (the CLI/sweep entry point)."""
+    if tc.family == cfg_mod.COMPLETE:
+        return complete(tc.n)
+    if tc.family == cfg_mod.RING:
+        return ring(tc.n, tc.k)
+    if tc.family == cfg_mod.GRID:
+        side = int(np.sqrt(tc.n))
+        return grid2d(side, (tc.n + side - 1) // side)
+    if tc.family == cfg_mod.ERDOS_RENYI:
+        return erdos_renyi(tc.n, tc.p, tc.seed, tc.degree_cap)
+    if tc.family == cfg_mod.WATTS_STROGATZ:
+        return watts_strogatz(tc.n, tc.k, tc.p, tc.seed)
+    if tc.family == cfg_mod.POWER_LAW:
+        return power_law(tc.n, tc.k, tc.seed, tc.degree_cap)
+    raise ValueError(tc.family)

@@ -1,0 +1,27 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Multi-device behavior is tested without TPU hardware the same way the
+reference tested multi-node without a cluster — the reference ran N OS
+processes on one machine under Maelstrom (SURVEY.md §4); we run 8 virtual XLA
+host devices in one process.
+
+Note: this environment preloads jax modules via sitecustomize, so plain env
+vars are captured before conftest runs — we must go through
+``jax.config.update`` for the platform choice.  XLA_FLAGS is still read at
+backend init, which has not happened yet at conftest import time.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Force CPU even if the surrounding environment points JAX at a TPU tunnel
+# (JAX_PLATFORMS=axon): unit tests must be fast and hermetic.  Override with
+# GOSSIP_TPU_TEST_PLATFORM=tpu to exercise the suite on real hardware.
+jax.config.update("jax_platforms",
+                  os.environ.get("GOSSIP_TPU_TEST_PLATFORM", "cpu"))

@@ -1,0 +1,167 @@
+"""SI round kernels: monotonicity, convergence, parity between modes.
+
+These are the per-kernel unit/property tests the reference never had
+(SURVEY.md §4: zero test files in the repo; testing was entirely external
+black-box Maelstrom runs).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossip_tpu import topology as T
+from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
+from gossip_tpu.models.si import coverage, make_si_round
+from gossip_tpu.models.state import init_state
+from gossip_tpu.runtime.simulator import simulate_curve, simulate_until
+
+
+def run_rounds(proto, topo, rounds, seed=0, fault=None):
+    step = jax.jit(make_si_round(proto, topo, fault))
+    state = init_state(RunConfig(seed=seed), proto, topo.n)
+    states = [state]
+    for _ in range(rounds):
+        state = step(state)
+        states.append(state)
+    return states
+
+
+@pytest.mark.parametrize("mode", ["push", "pull", "pushpull"])
+def test_monotone_coverage(mode):
+    topo = T.complete(256)
+    proto = ProtocolConfig(mode=mode, fanout=1)
+    states = run_rounds(proto, topo, 25)
+    covs = [float(coverage(s.seen)) for s in states]
+    assert covs[0] == pytest.approx(1 / 256)
+    assert all(b >= a for a, b in zip(covs, covs[1:])), covs
+    # ~log2(N)+ln(N) ≈ 14 expected rounds at N=256; 25 is comfortably past
+    assert covs[-1] == 1.0
+
+
+@pytest.mark.parametrize("mode", ["push", "pull", "pushpull"])
+def test_converges_on_sparse_graph(mode):
+    topo = T.erdos_renyi(512, 0.03, seed=7)
+    proto = ProtocolConfig(mode=mode, fanout=2)
+    res = simulate_until(proto, topo, RunConfig(max_rounds=128, seed=1))
+    assert res.coverage >= 0.99
+    assert 0 < res.rounds < 128
+
+
+def test_pushpull_beats_push():
+    """Push-pull converges in fewer rounds than push alone (classic result)."""
+    topo = T.complete(4096)
+    run = RunConfig(max_rounds=200, seed=3)
+    r_push = simulate_until(ProtocolConfig(mode="push", fanout=1), topo, run)
+    r_pp = simulate_until(ProtocolConfig(mode="pushpull", fanout=1), topo, run)
+    assert r_pp.rounds < r_push.rounds
+
+
+def test_seen_never_lost():
+    """Once seen, always seen (the dedup set only grows, main.go:35-44)."""
+    topo = T.ring(128, k=4)
+    proto = ProtocolConfig(mode="pushpull", fanout=1)
+    states = run_rounds(proto, topo, 20, seed=2)
+    prev = np.asarray(states[0].seen)
+    for s in states[1:]:
+        cur = np.asarray(s.seen)
+        assert (cur | prev).sum() == cur.sum()  # prev ⊆ cur
+        prev = cur
+
+
+def test_flood_is_bfs():
+    """Flood coverage after t rounds == BFS ball of radius t (Go-parity
+    claim from ops/propagate.py docstring) — checked exactly."""
+    topo = T.watts_strogatz(200, k=4, beta=0.3, seed=5)
+    proto = ProtocolConfig(mode="flood")
+    states = run_rounds(proto, topo, 10, seed=0)
+
+    # host-side BFS
+    nbrs, deg = np.asarray(topo.nbrs), np.asarray(topo.deg)
+    dist = np.full(200, -1)
+    dist[0] = 0
+    frontier = [0]
+    d = 0
+    while frontier:
+        nxt = []
+        for u in frontier:
+            for v in nbrs[u, : deg[u]]:
+                if dist[v] < 0:
+                    dist[v] = d + 1
+                    nxt.append(int(v))
+        frontier, d = nxt, d + 1
+
+    for t, s in enumerate(states):
+        expect = (dist >= 0) & (dist <= t)
+        got = np.asarray(s.seen)[:, 0]
+        np.testing.assert_array_equal(got, expect), f"round {t}"
+
+
+def test_multirumor():
+    topo = T.complete(512)
+    proto = ProtocolConfig(mode="pushpull", fanout=1, rumors=8)
+    res = simulate_until(proto, topo, RunConfig(max_rounds=64, seed=4))
+    assert res.coverage >= 0.99
+    seen = np.asarray(res.state.seen)
+    assert seen.shape == (512, 8)
+
+
+def test_messages_counted():
+    topo = T.complete(128)
+    res = simulate_curve(ProtocolConfig(mode="push", fanout=2), topo,
+                         RunConfig(max_rounds=10, seed=0))
+    msgs = res.msgs
+    assert (np.diff(msgs) >= 0).all()
+    # round 1: exactly one infected node pushes fanout=2 messages
+    assert msgs[0] == 2.0
+    # pull costs 2 messages per exchange, all nodes pull every round
+    res_pull = simulate_curve(ProtocolConfig(mode="pull", fanout=1), topo,
+                              RunConfig(max_rounds=3, seed=0))
+    assert res_pull.msgs[0] == 2.0 * 128
+
+
+def test_dead_nodes_never_infected():
+    topo = T.complete(256)
+    fault = FaultConfig(node_death_rate=0.3, seed=9)
+    proto = ProtocolConfig(mode="pushpull", fanout=2)
+    res = simulate_until(proto, topo, RunConfig(max_rounds=64, seed=5), fault)
+    from gossip_tpu.models.state import alive_mask
+    alive = np.asarray(alive_mask(fault, 256, 0))
+    seen = np.asarray(res.state.seen)[:, 0]
+    assert res.coverage >= 0.99          # alive population still converges
+    assert not seen[~alive].any()        # the dead stay dark
+
+
+def test_drop_prob_slows_but_converges():
+    """Lossy links: at-least-once semantics — resent next round, still
+    converges (reference retry loop main.go:80-87 without its liveness hole,
+    SURVEY.md §2.2.7)."""
+    topo = T.complete(512)
+    run = RunConfig(max_rounds=256, seed=6)
+    clean = simulate_until(ProtocolConfig(mode="push", fanout=1), topo, run)
+    lossy = simulate_until(ProtocolConfig(mode="push", fanout=1), topo, run,
+                           FaultConfig(drop_prob=0.5, seed=1))
+    assert lossy.coverage >= 0.99
+    assert lossy.rounds > clean.rounds
+
+
+def test_anti_entropy_period():
+    topo = T.ring(64, k=4)
+    proto = ProtocolConfig(mode="antientropy", fanout=1, period=4)
+    res = simulate_curve(proto, topo, RunConfig(max_rounds=24))
+    covs = res.coverage
+    # progress happens only on period boundaries: rounds 1..3 after an
+    # exchange round are flat
+    for t in range(1, len(covs) - 1):
+        if (t % 4) != 0:
+            assert covs[t] == covs[t - 1]
+
+
+def test_determinism():
+    topo = T.erdos_renyi(256, 0.05, seed=11)
+    proto = ProtocolConfig(mode="pushpull", fanout=1)
+    a = simulate_curve(proto, topo, RunConfig(max_rounds=16, seed=42))
+    b = simulate_curve(proto, topo, RunConfig(max_rounds=16, seed=42))
+    np.testing.assert_array_equal(a.coverage, b.coverage)
+    c = simulate_curve(proto, topo, RunConfig(max_rounds=16, seed=43))
+    assert not np.array_equal(a.coverage, c.coverage)

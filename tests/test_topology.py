@@ -1,0 +1,122 @@
+"""Topology generators: structural invariants of the padded tables."""
+
+import numpy as np
+import pytest
+
+from gossip_tpu import topology as T
+
+
+def check_table(topo, n):
+    nbrs = np.asarray(topo.nbrs)
+    deg = np.asarray(topo.deg)
+    assert nbrs.shape[0] == n and deg.shape == (n,)
+    cols = np.arange(nbrs.shape[1])
+    # entries below deg are real node ids; at/above deg are the sentinel n
+    valid = cols[None, :] < deg[:, None]
+    assert ((nbrs < n) == valid).all()
+    # no self loops
+    assert (nbrs != np.arange(n)[:, None]).all()
+
+
+def as_edge_set(topo):
+    nbrs = np.asarray(topo.nbrs)
+    deg = np.asarray(topo.deg)
+    n = topo.n
+    edges = set()
+    for i in range(n):
+        for j in nbrs[i, : deg[i]]:
+            edges.add((i, int(j)))
+    return edges
+
+
+def test_ring():
+    topo = T.ring(10, k=4)
+    check_table(topo, 10)
+    edges = as_edge_set(topo)
+    assert (0, 1) in edges and (0, 9) in edges and (0, 2) in edges
+    assert (0, 3) not in edges
+    # symmetric
+    assert all((b, a) in edges for a, b in edges)
+
+
+def test_complete_table():
+    topo = T.complete_table(6)
+    check_table(topo, 6)
+    assert len(as_edge_set(topo)) == 6 * 5
+
+
+def test_complete_implicit():
+    topo = T.complete(10_000_000)
+    assert topo.implicit and topo.n == 10_000_000 and topo.nbrs is None
+
+
+def test_grid():
+    topo = T.grid2d(3, 4)
+    check_table(topo, 12)
+    edges = as_edge_set(topo)
+    assert (0, 1) in edges and (0, 4) in edges
+    assert (3, 4) not in edges  # no wraparound across row boundary
+    deg = np.asarray(topo.deg)
+    assert deg[0] == 2 and deg[5] == 4  # corner vs interior
+
+
+def test_erdos_renyi_stats():
+    n, p = 2000, 0.01
+    topo = T.erdos_renyi(n, p, seed=1)
+    check_table(topo, n)
+    edges = as_edge_set(topo)
+    assert all((b, a) in edges for a, b in edges)
+    mean_deg = np.asarray(topo.deg).mean()
+    expect = (n - 1) * p
+    assert abs(mean_deg - expect) / expect < 0.15
+
+
+def test_watts_strogatz():
+    n = 500
+    topo = T.watts_strogatz(n, k=6, beta=0.2, seed=2)
+    check_table(topo, n)
+    edges = as_edge_set(topo)
+    assert all((b, a) in edges for a, b in edges)
+    # degree conserved on average (rewiring moves, never removes, edges)
+    assert abs(np.asarray(topo.deg).mean() - 6.0) < 0.5
+
+
+def test_power_law():
+    n = 2000
+    topo = T.power_law(n, m=3, seed=3)
+    check_table(topo, n)
+    edges = as_edge_set(topo)
+    assert all((b, a) in edges for a, b in edges)
+    deg = np.asarray(topo.deg)
+    # heavy tail: max degree far above the median
+    assert deg.max() > 5 * np.median(deg)
+    assert (deg > 0).all()
+
+
+def test_degree_cap():
+    topo = T.power_law(1000, m=3, seed=4, degree_cap=10)
+    check_table(topo, 1000)
+    assert np.asarray(topo.deg).max() <= 10
+    assert topo.nbrs.shape[1] <= 10
+
+
+def test_build_dispatch():
+    from gossip_tpu.config import TopologyConfig
+    for family, kw in [
+        ("complete", {}),
+        ("ring", dict(k=4)),
+        ("erdos_renyi", dict(p=0.05)),
+        ("watts_strogatz", dict(k=4, p=0.1)),
+        ("power_law", dict(k=2)),
+        ("grid", {}),
+    ]:
+        topo = T.build(TopologyConfig(family=family, n=100, **kw))
+        assert topo.n >= 100 if family == "grid" else topo.n == 100
+
+
+def test_bad_configs():
+    with pytest.raises(ValueError):
+        T.ring(10, k=3)
+    from gossip_tpu.config import TopologyConfig
+    with pytest.raises(ValueError):
+        TopologyConfig(family="nope", n=10)
