@@ -1,0 +1,67 @@
+"""Headline benchmark: simulated node-rounds/sec/chip (BASELINE.md metric).
+
+Runs the flagship config — multi-rumor push-pull SI epidemic broadcast on the
+implicit complete graph (the 10M-node scale path: zero adjacency memory,
+SURVEY.md §7) — to 99% coverage as ONE compiled ``lax.while_loop`` (no host
+sync per round), and reports throughput as
+
+    node_rounds_per_sec_per_chip = N * rounds / wall_seconds / n_chips
+
+``vs_baseline`` is measured against the derived north-star rate from
+BASELINE.json (the reference publishes no numbers — BASELINE.md): 10M nodes
+to 99% coverage in <1 s on 8 chips at ~24 rounds -> 30e6 node-rounds/s/chip.
+
+Prints exactly one JSON line.
+"""
+
+import json
+import sys
+import time
+
+import jax
+
+from gossip_tpu import config as C
+from gossip_tpu.config import ProtocolConfig, RunConfig
+from gossip_tpu.runtime.simulator import compiled_until
+from gossip_tpu.topology import generators as G
+
+# North-star-derived baseline rate (BASELINE.json: 10M nodes, 99% coverage,
+# <1 s wall-clock, v4-8): 10e6 nodes * 24 rounds / 1 s / 8 chips.
+BASELINE_NODE_ROUNDS_PER_SEC_PER_CHIP = 30.0e6
+
+
+def main():
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    # Full 10M-node config on TPU; scaled down on CPU so CI stays fast.
+    n = 10_000_000 if on_tpu else 500_000
+    proto = ProtocolConfig(mode=C.PUSH_PULL, fanout=1, rumors=1)
+    run = RunConfig(target_coverage=0.99, max_rounds=128, seed=0)
+    topo = G.complete(n)
+
+    loop, init = compiled_until(proto, topo, run)
+    # Warm-up executes + compiles; `loop` donates its argument, so rebuild
+    # the init state for the timed run.
+    warm = loop(init)
+    jax.block_until_ready(warm.seen)
+    rounds = int(warm.round)
+
+    _, init2 = compiled_until(proto, topo, run)
+    t0 = time.perf_counter()
+    final = loop(init2)
+    jax.block_until_ready(final.seen)
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count() if on_tpu else 1
+    rate = n * rounds / dt / n_chips
+    print(json.dumps({
+        "metric": "node_rounds_per_sec_per_chip",
+        "value": round(rate, 1),
+        "unit": f"node-rounds/s/chip (N={n}, push-pull SI to 99% in "
+                f"{rounds} rounds, {dt*1e3:.1f} ms, backend={backend})",
+        "vs_baseline": round(rate / BASELINE_NODE_ROUNDS_PER_SEC_PER_CHIP, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,24 +32,15 @@ from gossip_tpu import config as C
 from gossip_tpu.config import FaultConfig, ProtocolConfig
 from gossip_tpu.models.state import SimState, alive_mask
 from gossip_tpu.ops.propagate import flood_gather, pull_merge, push_delta
-from gossip_tpu.ops.sampling import sample_peers
+from gossip_tpu.ops.sampling import apply_drop, drop_mask, sample_peers
 from gossip_tpu.topology.generators import Topology
 
 # Sub-key tags so push and pull draws in the same round are independent.
 # Drop keys are folded into the *round* key (not the push/pull key) because
 # fold_in(pkey, small_tag) would collide with node small_tag's per-node
 # sampling key (node keys are fold_in(pkey, node_id)).
-_PUSH_TAG, _PULL_TAG, _PUSH_DROP_TAG, _PULL_DROP_TAG, _FLOOD_DROP_TAG = (
+PUSH_TAG, PULL_TAG, PUSH_DROP_TAG, PULL_DROP_TAG, FLOOD_DROP_TAG = (
     1, 2, 3, 4, 5)
-
-
-def _apply_drop(key: jax.Array, targets: jax.Array, drop_prob: float,
-                sentinel: int) -> jax.Array:
-    """Lossy links: turn dropped targets into the sentinel (scatter-dropped)."""
-    if drop_prob <= 0.0:
-        return targets
-    dropped = jax.random.bernoulli(key, drop_prob, targets.shape)
-    return jnp.where(dropped, jnp.int32(sentinel), targets)
 
 
 def make_si_round(proto: ProtocolConfig, topo: Topology,
@@ -77,10 +68,10 @@ def make_si_round(proto: ProtocolConfig, topo: Topology,
         msgs = state.msgs
 
         if mode in (C.PUSH, C.PUSH_PULL):
-            pkey = jax.random.fold_in(rkey, _PUSH_TAG)
+            pkey = jax.random.fold_in(rkey, PUSH_TAG)
             targets = sample_peers(pkey, ids, topo, k, proto.exclude_self)
-            targets = _apply_drop(jax.random.fold_in(rkey, _PUSH_DROP_TAG),
-                                  targets, drop_prob, n)
+            targets = apply_drop(rkey, PUSH_DROP_TAG, ids,
+                                 targets, drop_prob, n)
             sender_active = jnp.any(visible, axis=1)          # [N]
             valid = (targets < n) & sender_active[:, None]    # [N, k]
             delta = delta | push_delta(n, jnp.where(valid, targets, n),
@@ -88,10 +79,10 @@ def make_si_round(proto: ProtocolConfig, topo: Topology,
             msgs = msgs + jnp.sum(valid).astype(jnp.float32)
 
         if mode in (C.PULL, C.PUSH_PULL) or mode == C.ANTI_ENTROPY:
-            qkey = jax.random.fold_in(rkey, _PULL_TAG)
+            qkey = jax.random.fold_in(rkey, PULL_TAG)
             partners = sample_peers(qkey, ids, topo, k, proto.exclude_self)
-            partners = _apply_drop(jax.random.fold_in(rkey, _PULL_DROP_TAG),
-                                   partners, drop_prob, n)
+            partners = apply_drop(rkey, PULL_DROP_TAG, ids,
+                                  partners, drop_prob, n)
             pulled = pull_merge(visible, partners, n)
             # dead nodes neither request nor receive (alive-mask contract)
             if alive is not None:
@@ -111,8 +102,8 @@ def make_si_round(proto: ProtocolConfig, topo: Topology,
             if drop_prob > 0.0:
                 # lossy links drop individual edge uses this round; the edge
                 # is retried next round (at-least-once, main.go:80-87)
-                fkey = jax.random.fold_in(rkey, _FLOOD_DROP_TAG)
-                dropped = jax.random.bernoulli(fkey, drop_prob, nbrs.shape)
+                dropped = drop_mask(rkey, FLOOD_DROP_TAG, ids,
+                                    nbrs.shape[1], drop_prob)
                 nbrs = jnp.where(dropped, jnp.int32(n), nbrs)
             delta = flood_gather(visible, nbrs, n)
             sender_active = jnp.any(visible, axis=1)

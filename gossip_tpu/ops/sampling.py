@@ -29,6 +29,29 @@ def node_keys(round_key: jax.Array, global_ids: jax.Array) -> jax.Array:
     return jax.vmap(jax.random.fold_in, in_axes=(None, 0))(round_key, global_ids)
 
 
+def drop_mask(round_key: jax.Array, tag: int, global_ids: jax.Array,
+              width: int, drop_prob: float) -> jax.Array:
+    """Per-edge-use drop mask ``bool[len(ids), width]`` keyed by *global* node
+    id, so lossy-link draws are bitwise independent of how the node axis is
+    sharded (same contract as peer sampling above)."""
+    keys = node_keys(jax.random.fold_in(round_key, tag), global_ids)
+    return jax.vmap(
+        lambda k: jax.random.bernoulli(k, drop_prob, (width,)))(keys)
+
+
+def apply_drop(round_key: jax.Array, tag: int, global_ids: jax.Array,
+               targets: jax.Array, drop_prob: float,
+               sentinel: int) -> jax.Array:
+    """Lossy links: turn dropped targets into the sentinel (scatter-dropped,
+    gather-masked).  A dropped push/pull is simply retried in a later round —
+    the batched analog of at-least-once delivery (reference main.go:80-87)."""
+    if drop_prob <= 0.0:
+        return targets
+    dropped = drop_mask(round_key, tag, global_ids, targets.shape[1],
+                        drop_prob)
+    return jnp.where(dropped, jnp.int32(sentinel), targets)
+
+
 def sample_peers_complete(round_key: jax.Array, global_ids: jax.Array,
                           n_total: int, k: int,
                           exclude_self: bool = True) -> jax.Array:

@@ -1,0 +1,10 @@
+"""Node-dimension sharding over a device mesh (pjit / shard_map layer)."""
+
+from gossip_tpu.parallel.sharded import (  # noqa: F401
+    init_sharded_state,
+    make_mesh,
+    make_sharded_si_round,
+    pad_to_mesh,
+    sharded_alive,
+    simulate_until_sharded,
+)

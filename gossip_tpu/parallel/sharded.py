@@ -1,0 +1,227 @@
+"""Node-dimension sharding: the round step over a `jax.sharding.Mesh`.
+
+This is the TPU-pod scale path (SURVEY.md §7 layer 4, §2.3): the reference
+distributes by running one OS process per cluster node under Maelstrom
+(reference main.go — node identity via ``node.ID()``, topology keyed by node
+id); here the node dimension is an array axis sharded across devices with
+``jax.shard_map``, and the reference's stdin/stdout JSON "network" (SURVEY.md
+§2.4) becomes XLA collectives over ICI:
+
+  * **push**   — each shard scatter-adds its outgoing rumors into an
+    ``int32[N, R]`` count table, reduced to the owning shard with
+    ``psum_scatter`` (addition *is* an XLA collective reduction; boolean OR is
+    not — ``counts > 0`` recovers the OR, see ops/propagate.push_counts).
+  * **pull / flood** — the visible digest table is ``all_gather``-ed
+    (``bool[N, R]``: 1 byte/node/rumor, 10 MB at 10M nodes — cheap on ICI)
+    and each shard gathers its sampled rows locally.
+  * **coverage / message counters** — ``psum``.
+
+Bitwise parity with the single-device kernel (tests/test_sharding.py) holds
+because every random draw is keyed by (base_key, round, *global* node id) —
+see ops/sampling — so mesh shape never changes the trajectory.
+
+Nodes are padded to a multiple of the mesh size; padding rows are permanently
+dead (never sample, never receive, excluded from coverage).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gossip_tpu import config as C
+from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
+from gossip_tpu.models import si as si_mod
+from gossip_tpu.models.si import coverage
+from gossip_tpu.models.state import SimState, alive_mask, init_state
+from gossip_tpu.ops.propagate import flood_gather, pull_merge, push_counts
+from gossip_tpu.ops.sampling import apply_drop, drop_mask, sample_peers
+from gossip_tpu.topology.generators import Topology
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_name: str = "nodes") -> Mesh:
+    """1-D device mesh over the node axis (the SP/CP analog — SURVEY.md §5:
+    the scaled long dimension is nodes, not tokens)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(devs, (axis_name,))
+
+
+def pad_to_mesh(n: int, mesh: Mesh, axis_name: str) -> int:
+    p = mesh.shape[axis_name]
+    return math.ceil(n / p) * p
+
+
+def _pad_rows(x: jax.Array, n_pad: int, fill) -> jax.Array:
+    n = x.shape[0]
+    if n == n_pad:
+        return x
+    pad_shape = (n_pad - n,) + x.shape[1:]
+    return jnp.concatenate([x, jnp.full(pad_shape, fill, x.dtype)], axis=0)
+
+
+def sharded_alive(fault: Optional[FaultConfig], n: int, n_pad: int,
+                  origin: int) -> jax.Array:
+    """Combined liveness mask over padded rows: real & not-dead.
+
+    Unlike the single-device kernel (which skips masking entirely when there
+    are no faults), the sharded kernel always carries this mask because the
+    padding rows must stay dark."""
+    alive = alive_mask(fault, n, origin)
+    if alive is None:
+        alive = jnp.ones((n,), jnp.bool_)
+    return _pad_rows(alive, n_pad, False)
+
+
+def make_sharded_si_round(
+        proto: ProtocolConfig, topo: Topology, mesh: Mesh,
+        fault: Optional[FaultConfig] = None, origin: int = 0,
+        axis_name: str = "nodes") -> Callable[[SimState], SimState]:
+    """Build the sharded round step.  Semantically identical to
+    models/si.make_si_round; the returned function expects ``state.seen`` of
+    shape ``[n_pad, R]`` (see :func:`init_sharded_state`) and may be called
+    under an outer ``jax.jit`` / ``lax.while_loop``."""
+    n, k = topo.n, proto.fanout
+    mode = proto.mode
+    if mode == C.SWIM:
+        raise ValueError("SWIM rounds are built by models/swim.py")
+    if mode == C.FLOOD and topo.implicit:
+        raise ValueError("flood mode needs an explicit neighbor table")
+    n_pad = pad_to_mesh(n, mesh, axis_name)
+    nl = n_pad // mesh.shape[axis_name]
+    drop_prob = 0.0 if fault is None else fault.drop_prob
+    alive_pad = sharded_alive(fault, n, n_pad, origin)
+
+    have_table = not topo.implicit
+    if have_table:
+        nbrs_pad = _pad_rows(topo.nbrs, n_pad, n)   # sentinel = n
+        deg_pad = _pad_rows(topo.deg, n_pad, 0)
+
+    def local_round(seen_l, round_, base_key, msgs, alive_l, *table):
+        """One round on this shard's rows.  Axis-collective ops: psum_scatter
+        (push counts), all_gather (pull/flood digests), psum (counters)."""
+        shard = jax.lax.axis_index(axis_name)
+        gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
+        rkey = jax.random.fold_in(base_key, round_)
+        visible = seen_l & alive_l[:, None]
+        delta = jnp.zeros_like(seen_l)
+        msgs_local = jnp.float32(0.0)
+        if have_table:
+            nbrs_l, deg_l = table
+        else:
+            nbrs_l = deg_l = None
+
+        if mode in (C.PUSH, C.PUSH_PULL):
+            pkey = jax.random.fold_in(rkey, si_mod.PUSH_TAG)
+            targets = sample_peers(pkey, gids, topo, k, proto.exclude_self,
+                                   local_nbrs=nbrs_l, local_deg=deg_l)
+            targets = apply_drop(rkey, si_mod.PUSH_DROP_TAG, gids,
+                                 targets, drop_prob, n)
+            sender_active = jnp.any(visible, axis=1)
+            valid = (targets < n) & sender_active[:, None]
+            # invalid -> n_pad so scatter mode='drop' really drops them
+            # (sentinel n would land on a padding row when n < n_pad)
+            counts = push_counts(n_pad, jnp.where(valid, targets, n_pad),
+                                 visible)
+            counts_l = jax.lax.psum_scatter(counts, axis_name,
+                                            scatter_dimension=0, tiled=True)
+            delta = delta | (counts_l > 0)
+            msgs_local = msgs_local + jnp.sum(valid).astype(jnp.float32)
+
+        if mode in (C.PULL, C.PUSH_PULL, C.ANTI_ENTROPY):
+            seen_all = jax.lax.all_gather(visible, axis_name, tiled=True)
+            qkey = jax.random.fold_in(rkey, si_mod.PULL_TAG)
+            partners = sample_peers(qkey, gids, topo, k, proto.exclude_self,
+                                    local_nbrs=nbrs_l, local_deg=deg_l)
+            partners = apply_drop(rkey, si_mod.PULL_DROP_TAG, gids,
+                                  partners, drop_prob, n)
+            pulled = pull_merge(seen_all, partners, n)
+            partners = jnp.where(alive_l[:, None], partners, n)
+            n_req = jnp.sum(partners < n).astype(jnp.float32)
+            if mode == C.ANTI_ENTROPY and proto.period > 1:
+                on = (round_ % proto.period) == 0
+                pulled = jnp.where(on, pulled, False)
+                n_req = jnp.where(on, n_req, 0.0)
+            delta = delta | pulled
+            msgs_local = msgs_local + 2.0 * n_req
+
+        if mode == C.FLOOD:
+            seen_all = jax.lax.all_gather(visible, axis_name, tiled=True)
+            nbrs_use = nbrs_l
+            if drop_prob > 0.0:
+                dropped = drop_mask(rkey, si_mod.FLOOD_DROP_TAG, gids,
+                                    nbrs_use.shape[1], drop_prob)
+                nbrs_use = jnp.where(dropped, jnp.int32(n), nbrs_use)
+            delta = flood_gather(seen_all, nbrs_use, n)
+            sender_active = jnp.any(visible, axis=1)
+            msgs_local = msgs_local + jnp.sum(
+                jnp.where(sender_active, deg_l, 0)).astype(jnp.float32)
+
+        delta = delta & alive_l[:, None]
+        msgs_new = msgs + jax.lax.psum(msgs_local, axis_name)
+        return seen_l | delta, msgs_new
+
+    sh = P(axis_name)          # rows sharded
+    sh2 = P(axis_name, None)   # rows sharded, rumor dim replicated
+    rep = P()
+    in_specs = [sh2, rep, rep, rep, sh]
+    args = [alive_pad]
+    if have_table:
+        in_specs += [sh2, sh]
+        args += [nbrs_pad, deg_pad]
+
+    mapped = jax.shard_map(local_round, mesh=mesh,
+                           in_specs=tuple(in_specs),
+                           out_specs=(sh2, rep))
+
+    def step(state: SimState) -> SimState:
+        seen, msgs = mapped(state.seen, state.round, state.base_key,
+                            state.msgs, *args)
+        return SimState(seen=seen, round=state.round + 1,
+                        base_key=state.base_key, msgs=msgs)
+
+    return step
+
+
+def init_sharded_state(run: RunConfig, proto: ProtocolConfig, topo: Topology,
+                       mesh: Mesh, axis_name: str = "nodes") -> SimState:
+    """Initial state with ``seen`` padded to the mesh and placed sharded."""
+    n_pad = pad_to_mesh(topo.n, mesh, axis_name)
+    st = init_state(run, proto, topo.n)
+    seen = _pad_rows(st.seen, n_pad, False)
+    seen = jax.device_put(seen, NamedSharding(mesh, P(axis_name, None)))
+    return SimState(seen=seen, round=st.round, base_key=st.base_key,
+                    msgs=st.msgs)
+
+
+def simulate_until_sharded(proto: ProtocolConfig, topo: Topology,
+                           run: RunConfig, mesh: Mesh,
+                           fault: Optional[FaultConfig] = None,
+                           axis_name: str = "nodes"):
+    """``lax.while_loop`` to target coverage, whole loop one XLA program, state
+    resident sharded across the mesh.  Returns (rounds, coverage, msgs, state).
+    """
+    step = make_sharded_si_round(proto, topo, mesh, fault, run.origin,
+                                 axis_name)
+    n_pad = pad_to_mesh(topo.n, mesh, axis_name)
+    alive_pad = sharded_alive(fault, topo.n, n_pad, run.origin)
+    init = init_sharded_state(run, proto, topo, mesh, axis_name)
+    target = jnp.float32(run.target_coverage)
+
+    @jax.jit
+    def loop(state):
+        def cond(s):
+            return ((coverage(s.seen, alive_pad) < target)
+                    & (s.round < run.max_rounds))
+        return jax.lax.while_loop(cond, step, state)
+
+    final = loop(init)
+    return (int(final.round), float(coverage(final.seen, alive_pad)),
+            float(final.msgs), final)

@@ -1,0 +1,111 @@
+"""Sharded round == single-device round, bitwise, on an 8-device CPU mesh.
+
+This is the multi-device story the reference tested with N OS processes under
+Maelstrom on one machine (SURVEY.md §4); we assert the much stronger property
+that mesh sharding never changes the trajectory at all — every random draw is
+keyed by global node id (ops/sampling), so coverage curves are bitwise equal.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossip_tpu import config as C
+from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
+from gossip_tpu.models.si import coverage, make_si_round
+from gossip_tpu.models.state import init_state
+from gossip_tpu.parallel.sharded import (
+    init_sharded_state, make_mesh, make_sharded_si_round, pad_to_mesh,
+    simulate_until_sharded)
+from gossip_tpu.topology import generators as G
+
+
+def run_single(proto, topo, run, fault, rounds):
+    step = jax.jit(make_si_round(proto, topo, fault, run.origin))
+    st = init_state(run, proto, topo.n)
+    for _ in range(rounds):
+        st = step(st)
+    return st
+
+
+def run_sharded(proto, topo, run, fault, rounds, mesh):
+    step = jax.jit(make_sharded_si_round(proto, topo, mesh, fault, run.origin))
+    st = init_sharded_state(run, proto, topo, mesh)
+    for _ in range(rounds):
+        st = step(st)
+    return st
+
+
+CASES = [
+    ("push-complete", ProtocolConfig(mode=C.PUSH, fanout=2, rumors=3),
+     lambda: G.complete(96), None),
+    ("pull-complete", ProtocolConfig(mode=C.PULL, fanout=1, rumors=2),
+     lambda: G.complete(64), None),
+    ("pushpull-er", ProtocolConfig(mode=C.PUSH_PULL, fanout=2),
+     lambda: G.erdos_renyi(120, 0.08, seed=3), None),
+    ("flood-ring", ProtocolConfig(mode=C.FLOOD),
+     lambda: G.ring(96, 4), None),
+    ("antientropy-ws", ProtocolConfig(mode=C.ANTI_ENTROPY, fanout=1, period=2),
+     lambda: G.watts_strogatz(96, 4, 0.2, seed=1), None),
+    ("push-drop-death", ProtocolConfig(mode=C.PUSH_PULL, fanout=2),
+     lambda: G.erdos_renyi(96, 0.1, seed=5),
+     FaultConfig(node_death_rate=0.1, drop_prob=0.2, seed=7)),
+    ("flood-drop", ProtocolConfig(mode=C.FLOOD),
+     lambda: G.ring(96, 4),
+     FaultConfig(drop_prob=0.3, seed=2)),
+    ("antientropy-fault", ProtocolConfig(mode=C.ANTI_ENTROPY, fanout=1,
+                                         period=2),
+     lambda: G.watts_strogatz(96, 4, 0.2, seed=1),
+     FaultConfig(node_death_rate=0.15, drop_prob=0.1, seed=4)),
+]
+
+
+@pytest.mark.parametrize("name,proto,topo_fn,fault",
+                         CASES, ids=[c[0] for c in CASES])
+def test_sharded_bitwise_equals_single(name, proto, topo_fn, fault):
+    topo = topo_fn()
+    run = RunConfig(seed=11)
+    mesh = make_mesh(8)
+    rounds = 6
+    single = run_single(proto, topo, run, fault, rounds)
+    sharded = run_sharded(proto, topo, run, fault, rounds, mesh)
+    n = topo.n
+    np.testing.assert_array_equal(
+        np.asarray(sharded.seen)[:n], np.asarray(single.seen))
+    assert float(sharded.msgs) == pytest.approx(float(single.msgs))
+
+
+def test_padding_rows_stay_dark():
+    # n=100 on 8 devices -> n_pad=104; rows 100..103 must never light up.
+    topo = G.complete(100)
+    proto = ProtocolConfig(mode=C.PUSH_PULL, fanout=3)
+    mesh = make_mesh(8)
+    st = run_sharded(proto, topo, RunConfig(seed=0), None, 8, mesh)
+    assert pad_to_mesh(100, mesh, "nodes") == 104
+    seen = np.asarray(st.seen)
+    assert seen.shape[0] == 104
+    assert not seen[100:].any()
+    assert seen[:100].all()  # push-pull fanout 3, 8 rounds: converged
+
+
+def test_simulate_until_sharded_converges():
+    topo = G.erdos_renyi(500, 0.02, seed=2)
+    proto = ProtocolConfig(mode=C.PUSH_PULL, fanout=2)
+    mesh = make_mesh(8)
+    rounds, cov, msgs, final = simulate_until_sharded(
+        proto, topo, RunConfig(target_coverage=0.99, max_rounds=64), mesh)
+    assert cov >= 0.99
+    assert 0 < rounds < 64
+    assert msgs > 0
+
+
+def test_mesh_size_invariance():
+    # 2-device and 8-device meshes give the same trajectory.
+    topo = G.erdos_renyi(96, 0.1, seed=9)
+    proto = ProtocolConfig(mode=C.PUSH_PULL, fanout=1)
+    run = RunConfig(seed=3)
+    a = run_sharded(proto, topo, run, None, 5, make_mesh(2))
+    b = run_sharded(proto, topo, run, None, 5, make_mesh(8))
+    np.testing.assert_array_equal(
+        np.asarray(a.seen)[:topo.n], np.asarray(b.seen)[:topo.n])
