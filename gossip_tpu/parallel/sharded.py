@@ -27,7 +27,6 @@ dead (never sample, never receive, excluded from coverage).
 from __future__ import annotations
 
 import math
-from functools import partial
 from typing import Callable, Optional
 
 import jax
@@ -50,6 +49,9 @@ def make_mesh(n_devices: Optional[int] = None,
     the scaled long dimension is nodes, not tokens)."""
     devs = jax.devices()
     if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devs)} available")
         devs = devs[:n_devices]
     return Mesh(devs, (axis_name,))
 

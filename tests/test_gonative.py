@@ -1,0 +1,183 @@
+"""go-native backend semantics + parity with the batched flood kernel.
+
+The north-star parity requirement (BASELINE.json): convergence curves of the
+TPU backend match the Go reference at N=1024.  Parity is defined on the
+hop-depth clock (SURVEY.md §7 "Event-driven vs. round-synchronous parity"):
+flood-kernel coverage after round t == event-sim coverage within t hops ==
+the BFS ball of radius t around the origin.
+"""
+
+import numpy as np
+import pytest
+
+from gossip_tpu import config as C
+from gossip_tpu.config import ProtocolConfig, RunConfig
+from gossip_tpu.runtime.gonative import (
+    GoNativeSim, NetConfig, topology_from_table)
+from gossip_tpu.runtime.simulator import simulate_curve
+from gossip_tpu.topology import generators as G
+
+
+def make_sim(topo, **kw):
+    return GoNativeSim(topology_from_table(topo), **kw)
+
+
+def bfs_coverage(topo, origin, rounds):
+    """Independent BFS ball sizes from the raw adjacency (numpy, no jax)."""
+    nbrs, deg = np.asarray(topo.nbrs), np.asarray(topo.deg)
+    dist = np.full(topo.n, -1)
+    dist[origin] = 0
+    frontier = [origin]
+    d = 0
+    while frontier:
+        d += 1
+        nxt = []
+        for u in frontier:
+            for v in nbrs[u, :deg[u]]:
+                if dist[v] < 0:
+                    dist[v] = d
+                    nxt.append(int(v))
+        frontier = nxt
+    return np.array([(0 <= dist) & (dist <= h) for h in range(rounds + 1)
+                     ]).mean(axis=1)
+
+
+@pytest.mark.parametrize("topo_fn,rounds", [
+    (lambda: G.ring(1024, 4), 300),
+    (lambda: G.grid2d(32, 32), 70),
+    (lambda: G.erdos_renyi(1024, 0.008, seed=1), 40),
+], ids=["ring1024", "grid32x32", "er1024"])
+def test_flood_kernel_is_exact_bfs_and_bounds_event_sim(topo_fn, rounds):
+    """The parity-clock contract (gonative module doc): flood kernel == BFS
+    ball per round; event-sim hop coverage is bounded above by it and both
+    converge to the same fixed point (the Maelstrom invariant)."""
+    topo = topo_fn()
+    res = simulate_curve(ProtocolConfig(mode=C.FLOOD), topo,
+                         RunConfig(max_rounds=rounds, target_coverage=1.0))
+    kernel_cov = np.asarray(res.coverage)
+    bfs = bfs_coverage(topo, 0, rounds)
+    np.testing.assert_allclose(kernel_cov, bfs[1:], atol=1e-6)
+
+    sim = make_sim(topo)
+    sim.broadcast(origin=0, message=42)
+    sim.run()
+    hop_cov = np.array(sim.coverage_by_hop(42, rounds))
+    assert (hop_cov[1:] <= kernel_cov + 1e-9).all()
+    # Same fixed point: both backends cover exactly the origin's reachable
+    # component (races inflate the event sim's hop counts, never its eventual
+    # coverage) — the Maelstrom checker's set invariant, SURVEY.md §4.
+    kernel_set = set(np.nonzero(np.asarray(res.state.seen)[:, 0])[0])
+    sim_set = {i for i in range(topo.n) if 42 in sim.nodes[i].seen}
+    assert kernel_set == sim_set
+    assert len(sim_set) >= 0.99 * topo.n
+
+
+def test_exact_hop_parity_on_race_free_graph():
+    """On a k=2 ring every relayer has exactly one non-sender neighbor, so
+    no relay race exists and hop-of-arrival == BFS distance == kernel round,
+    exactly (the equality case of the parity contract)."""
+    topo = G.ring(256, 2)
+    rounds = 130
+    res = simulate_curve(ProtocolConfig(mode=C.FLOOD), topo,
+                         RunConfig(max_rounds=rounds, target_coverage=1.0))
+    sim = make_sim(topo)
+    sim.broadcast(origin=0, message=1)
+    sim.run()
+    hop_cov = sim.coverage_by_hop(1, rounds)
+    kernel_cov = np.asarray(res.coverage)
+    for t in range(1, rounds + 1):
+        assert kernel_cov[t - 1] == pytest.approx(hop_cov[t]), f"round {t}"
+
+
+def test_all_messages_reach_all_nodes():
+    topo = G.erdos_renyi(256, 0.03, seed=7)
+    sim = make_sim(topo)
+    for i, m in enumerate([5, 9, 13]):
+        sim.broadcast(origin=i * 10, message=m, t=0.01 * i)
+    sim.run()
+    for nid in range(topo.n):
+        assert sorted(sim.read(nid)) == [5, 9, 13]
+
+
+def test_dedup_and_sender_exclusion_two_nodes():
+    # A -- B only.  One injection at A: A->B is the only relay; B excludes
+    # its sender so it never echoes back (main.go:73-75); duplicate client
+    # injection is absorbed by the dedup set (main.go:113).
+    sim = GoNativeSim({0: [1], 1: [0]})
+    sim.broadcast(0, 99)
+    sim.run()
+    first = sim.msgs_sent
+    # client inject + ack (2) + A->B relay + ack (2) = 4; no echo
+    assert first == 4
+    assert sim.read(0) == [99] and sim.read(1) == [99]
+    sim.broadcast(0, 99, t=1.0)   # duplicate: ack only, no re-relay
+    sim.run()
+    assert sim.msgs_sent == first + 2
+    assert sim.read(0) == [99]
+
+
+def test_read_preserves_arrival_order():
+    sim = GoNativeSim({0: [1], 1: [0]})
+    sim.broadcast(0, 7, t=0.0)
+    sim.broadcast(0, 3, t=0.5)
+    sim.broadcast(0, 11, t=1.0)
+    sim.run()
+    assert sim.read(0) == [7, 3, 11]
+    assert sim.read(1) == [7, 3, 11]
+
+
+def test_transient_partition_heals_via_retry():
+    # line 0-1-2; cut (1,2) for 3 s.  Faithful mode: node 1's retries keep
+    # resending (the send precedes the ctx check), so node 2 gets the message
+    # after the heal — at-least-once delivery (main.go:80-87).
+    sim = GoNativeSim({0: [1], 1: [0, 2], 2: [1]}, horizon=30.0)
+    sim.partition(1, 2, 0.0, 3.0)
+    sim.broadcast(0, 1)
+    sim.run()
+    assert sim.read(2) == [1]
+    t2 = [t for (t, nid, m, _) in sim.deliveries if nid == 2][0]
+    assert t2 >= 3.0   # only after the heal
+
+
+def test_liveness_hole_blocks_later_neighbors():
+    # Defect §2.2.7: node 1 fans out to [0, 2, 3] sequentially (0 is the
+    # sender -> excluded; order is [2, 3]).  With (1,2) cut forever, the
+    # faithful node spins on neighbor 2 and NEVER contacts neighbor 3.
+    topo = {0: [1], 1: [0, 2, 3], 2: [1], 3: [1]}
+    sim = GoNativeSim(topo, horizon=30.0)
+    sim.partition(1, 2, 0.0, 1e9)
+    sim.broadcast(0, 1)
+    sim.run()
+    assert sim.read(2) == []
+    assert sim.read(3) == []   # starved by the stuck retry loop
+    # The fixed node (fresh ctx per attempt) still can't reach 2, but moves
+    # on?  No — the reference loop only advances on success; the *fix* is the
+    # fresh context, which lets a healed link succeed.  With a permanent cut
+    # neither variant reaches 3 via node 1; redundancy must come from the
+    # graph.  A cycle provides it:
+    ring = GoNativeSim({0: [1, 3], 1: [0, 2], 2: [1, 3], 3: [2, 0]},
+                       horizon=30.0)
+    ring.partition(1, 2, 0.0, 1e9)
+    ring.broadcast(0, 1)
+    ring.run()
+    assert ring.read(2) == [1]   # arrived the other way around
+
+
+def test_fixed_ctx_resumes_fanout_after_heal():
+    # Fixed mode: after the (1,2) link heals, the retry succeeds with its
+    # fresh context and the fan-out PROCEEDS to neighbor 3.
+    topo = {0: [1], 1: [0, 2, 3], 2: [1], 3: [1]}
+    sim = GoNativeSim(topo, net=NetConfig(faithful_ctx_bug=False),
+                      horizon=60.0)
+    sim.partition(1, 2, 0.0, 5.0)
+    sim.broadcast(0, 1)
+    sim.run()
+    assert sim.read(2) == [1]
+    assert sim.read(3) == [1]
+    # faithful mode starves node 3 under the same transient cut
+    sim2 = GoNativeSim(topo, horizon=60.0)
+    sim2.partition(1, 2, 0.0, 5.0)
+    sim2.broadcast(0, 1)
+    sim2.run()
+    assert sim2.read(2) == [1]   # resends still deliver after heal
+    assert sim2.read(3) == []    # but the loop never exits -> 3 starved
