@@ -51,13 +51,23 @@ def init_state(run: RunConfig, proto: ProtocolConfig, n: int) -> SimState:
     )
 
 
+def static_death_draw(fault: Optional[FaultConfig],
+                      n: int) -> Optional[jax.Array]:
+    """The one canonical static-death draw: the same FaultConfig kills the
+    same node set in every kernel family (SI here, SWIM in models/swim.py),
+    so cross-protocol experiments on one cluster line up."""
+    if fault is None or fault.node_death_rate <= 0.0:
+        return None
+    key = jax.random.key(fault.seed ^ 0x5157)
+    return ~jax.random.bernoulli(key, fault.node_death_rate, (n,))
+
+
 def alive_mask(fault: Optional[FaultConfig], n: int,
                origin: int = 0) -> Optional[jax.Array]:
     """Static dead-node mask (None when no faults — keeps the fault-free hot
     path free of masking work).  The rumor origin is pinned alive so the
     simulation is non-degenerate."""
-    if fault is None or fault.node_death_rate <= 0.0:
+    alive = static_death_draw(fault, n)
+    if alive is None:
         return None
-    key = jax.random.key(fault.seed ^ 0x5157)
-    alive = ~jax.random.bernoulli(key, fault.node_death_rate, (n,))
     return alive.at[origin].set(True)

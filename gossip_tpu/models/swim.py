@@ -1,0 +1,252 @@
+"""SWIM-style failure detection (suspect / confirm) in batched array form.
+
+The reference has no membership or failure detection at all — only a blind
+unbounded retry loop per neighbor RPC (reference main.go:77-87, SURVEY.md §5
+"Failure detection: retry only").  This module supplies the real thing, per
+the BASELINE.json config "SWIM-style suspect/confirm failure detection, 1M
+nodes": each node runs the SWIM probe cycle against a tracked set of S
+subjects (nodes 0..S-1), with indirect probes through K proxies, suspicion
+timers, confirm-after-timeout, and incarnation-based refutation, all as pure
+array updates — no per-node state machines, no control flow that XLA can't
+tile (SURVEY.md §7 "SWIM semantics in array form").
+
+**The wire encoding** is what makes SWIM XLA-native.  A view of a subject is
+(status, incarnation) with SWIM's override rules: Alive@i beats Suspect@j iff
+i > j; Suspect@i beats Alive@j iff i >= j; Dead beats everything.  That is a
+total order, so encode each view as ONE monotone int32
+
+    wire = incarnation * 2 + (1 if SUSPECT else 0)      # ALIVE/SUSPECT
+    wire = DEAD_WIRE (1 << 30)                          # DEAD (absorbing)
+
+and every SWIM merge — gossip dissemination, local suspicion, confirmation —
+becomes ``max``.  Dissemination is then a scatter-max (single device) or a
+per-shard scatter-max + ``lax.pmax`` over the mesh (sharded): the exact same
+shape as the SI push kernel, riding ICI.
+
+Round structure (one jitted step):
+  1. every alive node probes one uniform subject; on direct-probe failure it
+     ping-reqs K random proxies (SWIM's indirect probe);
+  2. total failure -> set the SUSPECT bit at the viewed incarnation;
+  3. nodes push their view rows to ``fanout`` random peers; receivers merge
+     by max (piggyback dissemination);
+  4. an alive subject that sees itself suspected refutes: self-view becomes
+     ALIVE at incarnation+1 (a larger wire, so it propagates over the stale
+     suspicion);
+  5. a view held at SUSPECT with the same wire for ``swim_suspect_rounds``
+     consecutive rounds is confirmed DEAD (absorbing — as in SWIM, a
+     confirmed-dead subject cannot refute).
+
+Ground truth: all nodes are alive before ``fail_round``; at ``fail_round``
+the nodes in ``dead_nodes`` (plus any FaultConfig static deaths) fail
+permanently.  Dead nodes neither probe, nor disseminate, nor update their
+views.  ``drop_prob`` models lossy links on probe paths (the source of false
+suspicions that refutation must outrun).
+
+Probes go node-to-subject directly (SWIM's membership overlay is the
+complete graph); the topology argument, when given, restricts only the
+*dissemination* targets — on a power-law graph that is the BASELINE.json
+1M-node config.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from gossip_tpu.config import FaultConfig, ProtocolConfig
+from gossip_tpu.ops.sampling import drop_mask, node_keys, sample_peers
+from gossip_tpu.topology.generators import Topology
+
+ALIVE, SUSPECT, DEAD = 0, 1, 2
+DEAD_WIRE = jnp.int32(1 << 30)
+
+# fold_in tags (disjoint from models/si.py's 1..5 by convention)
+_SUBJ_TAG, _PROXY_TAG, _DISS_TAG = 10, 11, 12
+_DIRECT_DROP_TAG, _TO_PROXY_DROP_TAG, _PROXY_SUBJ_DROP_TAG = 13, 14, 15
+
+
+class SwimState(NamedTuple):
+    """Carried through rounds.  ``wire[i, s]`` is node i's view of subject s
+    in the monotone encoding above; ``timer[i, s]`` counts consecutive rounds
+    the exact suspect wire has been held."""
+
+    wire: jax.Array     # int32[N, S]
+    timer: jax.Array    # int32[N, S]
+    round: jax.Array    # int32 scalar
+    base_key: jax.Array
+    msgs: jax.Array     # float32 scalar
+
+
+def suggested_suspect_rounds(n: int, fanout: int = 2) -> int:
+    """Suspicion timeout long enough for refutation to make the round trip.
+
+    SWIM's accuracy guarantee is probabilistic in exactly this timeout (SWIM
+    paper §4): a false suspicion must travel to the subject and the bumped
+    incarnation back to the suspector before the timer expires.  Both legs
+    are epidemic pushes, ~log_{1+fanout}(n) rounds each — stretched further
+    by whatever link loss caused the false suspicion in the first place —
+    so 2x that plus generous slack.  Shorter timeouts trade detection
+    latency for a real false-positive rate.
+    """
+    import math
+    leg = math.log(max(n, 2)) / math.log(1 + max(fanout, 1))
+    return max(6, int(math.ceil(2 * leg)) + 6)
+
+
+def decode_status(wire: jax.Array) -> jax.Array:
+    """wire -> {ALIVE, SUSPECT, DEAD}."""
+    return jnp.where(wire >= DEAD_WIRE, DEAD,
+                     jnp.where(wire % 2 == 1, SUSPECT, ALIVE))
+
+
+def init_swim_state(n: int, n_subjects: int, seed: int = 0) -> SwimState:
+    return SwimState(
+        wire=jnp.zeros((n, n_subjects), jnp.int32),   # everyone ALIVE@0
+        timer=jnp.zeros((n, n_subjects), jnp.int32),
+        round=jnp.int32(0),
+        base_key=jax.random.key(seed),
+        msgs=jnp.float32(0.0),
+    )
+
+
+def base_alive(n: int, dead_nodes: Tuple[int, ...],
+               fault: Optional[FaultConfig]) -> jax.Array:
+    """Static post-``fail_round`` liveness (True = stays alive).  Uses the
+    canonical draw from models/state so one FaultConfig kills the same node
+    set in SI and SWIM kernels alike."""
+    from gossip_tpu.models.state import static_death_draw
+    alive = jnp.ones((n,), jnp.bool_)
+    if dead_nodes:
+        alive = alive.at[jnp.asarray(dead_nodes)].set(False)
+    drawn = static_death_draw(fault, n)
+    if drawn is not None:
+        alive = alive & drawn
+    return alive
+
+
+def probe_draws(rkey, gids, s_count: int, n: int, proxies: int,
+                drop_prob: float):
+    """Steps 1-2 random draws: each node's probed subject, direct-probe drop,
+    proxy ids, and the two per-proxy hop drops.  All keyed by *global* node
+    id so the sharded kernel reproduces them bitwise (ops/sampling
+    contract).  Returns (subj[Nl], d_drop[Nl], proxy_ids[Nl,K],
+    to_p[Nl,K], p_to_s[Nl,K])."""
+    keys = node_keys(jax.random.fold_in(rkey, _SUBJ_TAG), gids)
+    subj = jax.vmap(
+        lambda k: jax.random.randint(k, (), 0, s_count, dtype=jnp.int32)
+    )(keys)
+    pkeys = node_keys(jax.random.fold_in(rkey, _PROXY_TAG), gids)
+    proxy_ids = jax.vmap(
+        lambda k: jax.random.randint(k, (proxies,), 0, n, dtype=jnp.int32)
+    )(pkeys)
+    m = len(gids)
+    if drop_prob > 0.0:
+        d_drop = drop_mask(rkey, _DIRECT_DROP_TAG, gids, 1, drop_prob)[:, 0]
+        to_p = drop_mask(rkey, _TO_PROXY_DROP_TAG, gids, proxies, drop_prob)
+        p_to_s = drop_mask(rkey, _PROXY_SUBJ_DROP_TAG, gids, proxies,
+                           drop_prob)
+    else:
+        d_drop = jnp.zeros((m,), jnp.bool_)
+        to_p = p_to_s = jnp.zeros((m, proxies), jnp.bool_)
+    return subj, d_drop, proxy_ids, to_p, p_to_s
+
+
+def make_swim_round(proto: ProtocolConfig, n: int,
+                    dead_nodes: Tuple[int, ...] = (),
+                    fail_round: int = 0,
+                    fault: Optional[FaultConfig] = None,
+                    topo: Optional[Topology] = None,
+                    ) -> Callable[[SwimState], SwimState]:
+    """Single-device SWIM round step (sharded twin:
+    :func:`gossip_tpu.parallel.sharded_swim.make_sharded_swim_round`, kept
+    semantically identical — tests/test_swim.py asserts bitwise parity)."""
+    s_count = proto.swim_subjects
+    proxies = proto.swim_proxies
+    t_confirm = proto.swim_suspect_rounds
+    fanout = proto.fanout
+    drop_prob = 0.0 if fault is None else fault.drop_prob
+    alive_base = base_alive(n, dead_nodes, fault)
+    if topo is None:
+        topo = Topology(nbrs=None, deg=None, n=n, family="complete")
+    ids = jnp.arange(n, dtype=jnp.int32)
+
+    def step(state: SwimState) -> SwimState:
+        rkey = jax.random.fold_in(state.base_key, state.round)
+        alive_now = jnp.where(state.round >= fail_round, alive_base, True)
+        subj_alive = alive_now[:s_count]
+        wire0 = state.wire
+
+        # 1-2: probe + suspect -------------------------------------------
+        subj, d_drop, proxy_ids, to_p, p_to_s = probe_draws(
+            rkey, ids, s_count, n, proxies, drop_prob)
+        direct_ok = subj_alive[subj] & ~d_drop
+        proxy_ok = (alive_now[proxy_ids] & ~to_p & ~p_to_s
+                    & subj_alive[subj][:, None])
+        indirect_ok = jnp.any(proxy_ok, axis=1)
+        fail = alive_now & ~direct_ok & ~indirect_ok          # [N]
+        onehot = jax.nn.one_hot(subj, s_count, dtype=jnp.bool_)
+        suspectable = (wire0 < DEAD_WIRE) & onehot & fail[:, None]
+        wire1 = jnp.where(suspectable, wire0 | 1, wire0)
+
+        # probe message accounting: direct ping (+ack on success); on direct
+        # failure, 4 messages per proxy path attempted (SWIM ping-req chain)
+        msgs_probe = (jnp.sum(alive_now & direct_ok) * 2.0
+                      + jnp.sum(alive_now & ~direct_ok)
+                      * (1.0 + 4.0 * proxies))
+
+        # 3: dissemination (scatter-max of wire rows) --------------------
+        dkey = jax.random.fold_in(rkey, _DISS_TAG)
+        targets = sample_peers(dkey, ids, topo, fanout, exclude_self=True)
+        targets = jnp.where(alive_now[:, None], targets, n)   # dead: silent
+        flat_t = targets.reshape(-1)
+        flat_w = jnp.broadcast_to(wire1[:, None, :],
+                                  (n, fanout, s_count)).reshape(-1, s_count)
+        recv = jnp.zeros_like(wire1).at[flat_t].max(flat_w, mode="drop")
+        wire2 = jnp.maximum(wire1, recv)
+        msgs_diss = jnp.sum(targets < n).astype(jnp.float32)
+
+        # 4: refutation (alive subjects bump incarnation over suspicion) --
+        self_view = wire2[ids[:s_count], jnp.arange(s_count)]  # [S]
+        refuted = jnp.where(
+            subj_alive & (self_view % 2 == 1) & (self_view < DEAD_WIRE),
+            (self_view // 2 + 1) * 2, self_view)
+        wire3 = wire2.at[ids[:s_count], jnp.arange(s_count)].set(refuted)
+
+        # 5: suspicion timers + confirm ----------------------------------
+        is_susp = (wire3 % 2 == 1) & (wire3 < DEAD_WIRE)
+        held = is_susp & (wire3 == state.wire)
+        timer = jnp.where(held, state.timer + 1,
+                          jnp.where(is_susp, 1, 0))
+        confirm = timer >= t_confirm
+        wire4 = jnp.where(confirm, DEAD_WIRE, wire3)
+        timer = jnp.where(confirm, 0, timer)
+
+        # dead nodes are frozen observers (no probe/diss/merge above was
+        # theirs; freeze their rows too)
+        wire_f = jnp.where(alive_now[:, None], wire4, wire0)
+        timer_f = jnp.where(alive_now[:, None], timer, state.timer)
+        return SwimState(wire=wire_f, timer=timer_f,
+                         round=state.round + 1, base_key=state.base_key,
+                         msgs=state.msgs + msgs_probe + msgs_diss)
+
+    return step
+
+
+def detection_fraction(state: SwimState, dead_subjects, alive_now=None
+                       ) -> jax.Array:
+    """Fraction of (alive-observer, dead-subject) pairs confirmed DEAD —
+    the SWIM convergence metric (completeness)."""
+    status = decode_status(state.wire)                    # [N, S]
+    if any(s >= status.shape[1] for s in dead_subjects):
+        raise ValueError(
+            f"dead_subjects {dead_subjects} out of range: only nodes "
+            f"0..{status.shape[1] - 1} are tracked subjects")
+    dead = jnp.zeros(status.shape[1], jnp.bool_
+                     ).at[jnp.asarray(dead_subjects)].set(True)
+    obs = (status == DEAD) & dead[None, :]
+    if alive_now is None:
+        return obs.sum() / (status.shape[0] * max(1, len(dead_subjects)))
+    w = alive_now.astype(jnp.float32)[:, None] * dead[None, :]
+    return (obs * w).sum() / jnp.maximum(w.sum(), 1.0)

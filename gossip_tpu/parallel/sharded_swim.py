@@ -1,0 +1,146 @@
+"""SWIM failure detection sharded over the node mesh.
+
+Twin of :func:`gossip_tpu.models.swim.make_swim_round` (kept semantically
+identical — tests/test_swim.py asserts bitwise parity on an 8-device CPU
+mesh).  The only structural difference is dissemination: the scatter-max of
+wire rows becomes a per-shard scatter-max into an ``int32[n_pad, S]``
+contribution table reduced with ``lax.pmax`` over the mesh axis — boolean OR
+is not an XLA collective reduction but ``max`` is, and the monotone wire
+encoding (models/swim.py module doc) makes max exactly the SWIM merge.
+
+At the BASELINE.json SWIM scale (1M nodes, S=8 subjects) the pmax moves
+``1M x 8 x 4 B = 32 MB`` per round over ICI — comfortably under the <1 s
+budget; the probe arrays are O(N x K) locals.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gossip_tpu.config import FaultConfig, ProtocolConfig
+from gossip_tpu.models import swim as SW
+from gossip_tpu.models.swim import DEAD_WIRE, SwimState, base_alive
+from gossip_tpu.ops.sampling import sample_peers
+from gossip_tpu.parallel.sharded import _pad_rows, pad_to_mesh
+from gossip_tpu.topology.generators import Topology
+
+
+def make_sharded_swim_round(
+        proto: ProtocolConfig, n: int, mesh: Mesh,
+        dead_nodes: Tuple[int, ...] = (), fail_round: int = 0,
+        fault: Optional[FaultConfig] = None,
+        topo: Optional[Topology] = None,
+        axis_name: str = "nodes") -> Callable[[SwimState], SwimState]:
+    s_count = proto.swim_subjects
+    proxies = proto.swim_proxies
+    t_confirm = proto.swim_suspect_rounds
+    fanout = proto.fanout
+    drop_prob = 0.0 if fault is None else fault.drop_prob
+    n_pad = pad_to_mesh(n, mesh, axis_name)
+    nl = n_pad // mesh.shape[axis_name]
+    valid = jnp.arange(n_pad) < n                     # padding rows: never alive
+    alive_base_pad = _pad_rows(base_alive(n, dead_nodes, fault), n_pad, False)
+    if topo is None:
+        topo = Topology(nbrs=None, deg=None, n=n, family="complete")
+    have_table = not topo.implicit
+    if have_table:
+        nbrs_pad = _pad_rows(topo.nbrs, n_pad, n)
+        deg_pad = _pad_rows(topo.deg, n_pad, 0)
+
+    def local_round(wire_l, timer_l, round_, base_key, msgs, alive_base_full,
+                    *table):
+        shard = jax.lax.axis_index(axis_name)
+        gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
+        rkey = jax.random.fold_in(base_key, round_)
+        alive_full = jnp.where(round_ >= fail_round, alive_base_full,
+                               True) & valid
+        alive_l = alive_full[gids]
+        subj_alive = alive_full[:s_count]
+        wire0 = wire_l
+        nbrs_l, deg_l = table if have_table else (None, None)
+
+        # 1-2: probe + suspect (draws keyed by global id — bitwise == twin)
+        subj, d_drop, proxy_ids, to_p, p_to_s = SW.probe_draws(
+            rkey, gids, s_count, n, proxies, drop_prob)
+        direct_ok = subj_alive[subj] & ~d_drop
+        proxy_ok = (alive_full[proxy_ids] & ~to_p & ~p_to_s
+                    & subj_alive[subj][:, None])
+        indirect_ok = jnp.any(proxy_ok, axis=1)
+        fail = alive_l & ~direct_ok & ~indirect_ok
+        onehot = jax.nn.one_hot(subj, s_count, dtype=jnp.bool_)
+        suspectable = (wire0 < DEAD_WIRE) & onehot & fail[:, None]
+        wire1 = jnp.where(suspectable, wire0 | 1, wire0)
+        msgs_local = (jnp.sum(alive_l & direct_ok) * 2.0
+                      + jnp.sum(alive_l & ~direct_ok)
+                      * (1.0 + 4.0 * proxies))
+
+        # 3: dissemination — local scatter-max, pmax over the mesh ---------
+        dkey = jax.random.fold_in(rkey, SW._DISS_TAG)
+        targets = sample_peers(dkey, gids, topo, fanout, exclude_self=True,
+                               local_nbrs=nbrs_l, local_deg=deg_l)
+        msgs_local = msgs_local + jnp.sum(
+            (targets < n) & alive_l[:, None]).astype(jnp.float32)
+        # silent senders (dead/padding) -> n_pad so the scatter drops them
+        # (sentinel n would land on a padding row when n < n_pad)
+        targets = jnp.where(alive_l[:, None], targets, n_pad)
+        flat_t = targets.reshape(-1)
+        flat_w = jnp.broadcast_to(wire1[:, None, :],
+                                  (nl, fanout, s_count)).reshape(-1, s_count)
+        contrib = jnp.zeros((n_pad, s_count), jnp.int32
+                            ).at[flat_t].max(flat_w, mode="drop")
+        recv_full = jax.lax.pmax(contrib, axis_name)
+        recv_l = jax.lax.dynamic_slice_in_dim(recv_full, shard * nl, nl, 0)
+        wire2 = jnp.maximum(wire1, recv_l)
+
+        # 4: refutation (only rows whose gid is an alive subject) ----------
+        sel = ((gids[:, None] == jnp.arange(s_count)[None, :])
+               & alive_full[gids][:, None])
+        odd = (wire2 % 2 == 1) & (wire2 < DEAD_WIRE)
+        wire3 = jnp.where(sel & odd, (wire2 // 2 + 1) * 2, wire2)
+
+        # 5: timers + confirm ---------------------------------------------
+        is_susp = (wire3 % 2 == 1) & (wire3 < DEAD_WIRE)
+        held = is_susp & (wire3 == wire_l)
+        timer = jnp.where(held, timer_l + 1, jnp.where(is_susp, 1, 0))
+        confirm = timer >= t_confirm
+        wire4 = jnp.where(confirm, DEAD_WIRE, wire3)
+        timer = jnp.where(confirm, 0, timer)
+
+        wire_f = jnp.where(alive_l[:, None], wire4, wire0)
+        timer_f = jnp.where(alive_l[:, None], timer, timer_l)
+        msgs_new = msgs + jax.lax.psum(msgs_local, axis_name)
+        return wire_f, timer_f, msgs_new
+
+    sh2 = P(axis_name, None)
+    rep = P()
+    in_specs = [sh2, sh2, rep, rep, rep, rep]
+    args = [alive_base_pad]
+    if have_table:
+        in_specs += [sh2, P(axis_name)]
+        args += [nbrs_pad, deg_pad]
+
+    mapped = jax.shard_map(local_round, mesh=mesh, in_specs=tuple(in_specs),
+                           out_specs=(sh2, sh2, rep))
+
+    def step(state: SwimState) -> SwimState:
+        wire, timer, msgs = mapped(state.wire, state.timer, state.round,
+                                   state.base_key, state.msgs, *args)
+        return SwimState(wire=wire, timer=timer, round=state.round + 1,
+                         base_key=state.base_key, msgs=msgs)
+
+    return step
+
+
+def init_sharded_swim_state(n: int, proto: ProtocolConfig, mesh: Mesh,
+                            seed: int = 0,
+                            axis_name: str = "nodes") -> SwimState:
+    n_pad = pad_to_mesh(n, mesh, axis_name)
+    st = SW.init_swim_state(n_pad, proto.swim_subjects, seed)
+    sharding = NamedSharding(mesh, P(axis_name, None))
+    return SwimState(wire=jax.device_put(st.wire, sharding),
+                     timer=jax.device_put(st.timer, sharding),
+                     round=st.round, base_key=st.base_key, msgs=st.msgs)

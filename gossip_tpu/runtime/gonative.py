@@ -121,8 +121,10 @@ class GoNativeSim:
         return True
 
     def _push_event(self, t: float, ev: tuple) -> None:
-        if t <= self.horizon:
-            heapq.heappush(self._q, (t, next(self._seq), ev))
+        # Never drop: events beyond the current horizon stay queued so a
+        # later run(until=...) can still process them (at-least-once holds
+        # across resumed runs); run() bounds the clock, not the queue.
+        heapq.heappush(self._q, (t, next(self._seq), ev))
 
     # -- protocol --------------------------------------------------------
 
