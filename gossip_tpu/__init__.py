@@ -27,6 +27,8 @@ Layout:
 __version__ = "0.1.0"
 
 from gossip_tpu.config import (  # noqa: F401
+    FaultConfig,
+    MeshConfig,
     ProtocolConfig,
     RunConfig,
     TopologyConfig,

@@ -1,6 +1,24 @@
-from gossip_tpu.runtime.simulator import (  # noqa: F401
-    CurveResult,
-    UntilResult,
-    simulate_curve,
-    simulate_until,
-)
+"""Runtimes: the round-batched JAX backend, the go-native event-driven
+parity backend, and the Maelstrom protocol node.
+
+The simulator API pulls in jax (~seconds of import time); load it lazily so
+jax-free entry points — the Maelstrom protocol node (spawned as one OS
+process per cluster node, reference-style), the go-native event simulator,
+``--help`` — start instantly (PEP 562).
+"""
+
+_LAZY = ("CurveResult", "UntilResult", "simulate_curve", "simulate_until",
+         "compiled_until")
+
+__all__ = list(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        from gossip_tpu.runtime import simulator
+        return getattr(simulator, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

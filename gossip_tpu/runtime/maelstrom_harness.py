@@ -1,0 +1,238 @@
+"""Mini-Maelstrom: spawn N protocol-node processes and route their traffic.
+
+The reference was tested exclusively by the external Maelstrom harness — N
+OS processes on one machine, all networking simulated by a router over
+stdin/stdout pipes, with injected latency and partitions (SURVEY.md §4,
+"the same trick the TPU framework should replay as a parity fixture").
+This module IS that fixture: a small asyncio router speaking the Maelstrom
+envelope protocol as client ``c1``, driving
+:mod:`gossip_tpu.runtime.maelstrom_node` processes (or any binary speaking
+the protocol) for black-box conformance tests.
+
+No jax imports — pure stdlib.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+class MaelstromHarness:
+    """Router + client for N Maelstrom protocol nodes.
+
+    Usage::
+
+        h = MaelstromHarness(5, latency=0.005)
+        await h.start()
+        await h.set_topology({"n0": ["n1"], ...})
+        await h.broadcast("n0", 42)
+        await h.quiesce()
+        assert 42 in await h.read("n3")
+        await h.stop()
+    """
+
+    CLIENT = "c1"
+
+    def __init__(self, n: int, latency: float = 0.002,
+                 argv: Optional[List[str]] = None):
+        self.n = n
+        self.latency = latency
+        self.argv = argv or [sys.executable, "-u", "-m",
+                             "gossip_tpu.runtime.maelstrom_node"]
+        self.ids = [f"n{i}" for i in range(n)]
+        self.procs: Dict[str, asyncio.subprocess.Process] = {}
+        self._pump_tasks: List[asyncio.Task] = []
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._next_msg_id = 1000
+        self._partitions: List[Tuple[str, str, float, float]] = []
+        self._loop_t0 = 0.0
+        self.routed = 0              # inter-node messages routed
+        self._last_activity = 0.0
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def start(self) -> None:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        # The protocol nodes are jax-free; drop the axon-TPU trigger so the
+        # environment's sitecustomize doesn't spend ~2 s per process
+        # registering a TPU backend N times on one host.
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        loop = asyncio.get_running_loop()
+        self._loop_t0 = loop.time()
+        for nid in self.ids:
+            proc = await asyncio.create_subprocess_exec(
+                *self.argv,
+                stdin=asyncio.subprocess.PIPE,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.PIPE,
+                limit=16 * 1024 * 1024,   # read_ok lines grow with the log
+                env=env)
+            self.procs[nid] = proc
+            self._pump_tasks.append(asyncio.ensure_future(
+                self._pump(nid, proc)))
+            self._pump_tasks.append(asyncio.ensure_future(
+                self._drain_stderr(nid, proc)))
+        await asyncio.gather(*[
+            self._client_rpc(nid, {"type": "init", "node_id": nid,
+                                   "node_ids": list(self.ids)})
+            for nid in self.ids])
+
+    async def stop(self) -> None:
+        for proc in self.procs.values():
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass
+        await asyncio.gather(*[p.wait() for p in self.procs.values()],
+                             return_exceptions=True)
+        # pumps return on EOF once the processes are gone; awaiting them
+        # (rather than cancelling mid-read) lets the pipe transports close
+        # inside the running loop, not in __del__ after it's gone
+        await asyncio.gather(*self._pump_tasks, return_exceptions=True)
+        for proc in self.procs.values():
+            if proc.stdin:
+                proc.stdin.close()
+
+    # -- network simulation ----------------------------------------------
+
+    def _now(self) -> float:
+        return asyncio.get_running_loop().time() - self._loop_t0
+
+    def partition(self, a: str, b: str, duration: float,
+                  start: Optional[float] = None) -> None:
+        """Block the (a, b) link both ways for ``duration`` from now (or
+        from ``start``, in harness time)."""
+        t0 = self._now() if start is None else start
+        self._partitions.append((a, b, t0, t0 + duration))
+
+    def _link_open(self, a: str, b: str) -> bool:
+        t = self._now()
+        for (x, y, t0, t1) in self._partitions:
+            if {a, b} == {x, y} and t0 <= t < t1:
+                return False
+        return True
+
+    def _write_to(self, nid: str, envelope: dict) -> None:
+        proc = self.procs.get(nid)
+        if proc is None or proc.stdin is None or proc.stdin.is_closing():
+            return
+        proc.stdin.write((json.dumps(envelope) + "\n").encode())
+
+    async def _deliver_later(self, nid: str, envelope: dict) -> None:
+        if self.latency > 0:
+            await asyncio.sleep(self.latency)
+        self._write_to(nid, envelope)
+
+    async def _pump(self, nid: str, proc) -> None:
+        """Route node ``nid``'s stdout: replies to the client resolve RPC
+        futures; node-to-node traffic is delivered with latency unless the
+        link is partitioned (messages in a cut are dropped, Maelstrom
+        style — the nodes' retries provide at-least-once)."""
+        try:
+            while True:
+                raw = await proc.stdout.readline()
+                if not raw:
+                    return
+                try:
+                    msg = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue
+                dest = msg.get("dest")
+                self._last_activity = self._now()
+                if dest == self.CLIENT:
+                    irt = msg.get("body", {}).get("in_reply_to")
+                    fut = self._pending.pop(irt, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(msg)
+                    continue
+                if dest in self.procs and self._link_open(msg.get("src"),
+                                                          dest):
+                    self.routed += 1
+                    asyncio.ensure_future(self._deliver_later(dest, msg))
+        except Exception as e:   # a dead pump black-holes the node: say so
+            print(f"[harness] pump for {nid} died: {e!r}", file=sys.stderr)
+            raise
+
+    async def _drain_stderr(self, nid: str, proc) -> None:
+        while True:
+            raw = await proc.stderr.readline()
+            if not raw:
+                return
+            print(f"[{nid} stderr] {raw.decode().rstrip()}", file=sys.stderr)
+
+    # -- client ops (what the Maelstrom workload generator sends) ---------
+
+    async def _client_rpc(self, dest: str, body: dict,
+                          timeout: float = 15.0) -> dict:
+        body = dict(body)
+        self._next_msg_id += 1
+        mid = body["msg_id"] = self._next_msg_id
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[mid] = fut
+        try:
+            self._write_to(dest,
+                           {"src": self.CLIENT, "dest": dest, "body": body})
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(mid, None)
+
+    async def set_topology(self, topo: Dict[str, List[str]]) -> None:
+        replies = await asyncio.gather(*[
+            self._client_rpc(nid, {"type": "topology", "topology": topo})
+            for nid in self.ids])
+        assert all(r["body"]["type"] == "topology_ok" for r in replies)
+
+    async def broadcast(self, node: str, value: int) -> dict:
+        return await self._client_rpc(node,
+                                      {"type": "broadcast", "message": value})
+
+    async def read(self, node: str) -> List[int]:
+        r = await self._client_rpc(node, {"type": "read"})
+        assert r["body"]["type"] == "read_ok"
+        return r["body"]["messages"]
+
+    async def send_raw(self, dest: str, body: dict, timeout: float = 15.0
+                       ) -> dict:
+        """Arbitrary client RPC (conformance probes, e.g. unknown types)."""
+        return await self._client_rpc(dest, body, timeout)
+
+    async def quiesce(self, idle: float = 0.3, timeout: float = 30.0) -> None:
+        """Wait until no message has moved for ``idle`` seconds."""
+        deadline = self._now() + timeout
+        while self._now() < deadline:
+            if self._now() - self._last_activity >= idle:
+                return
+            await asyncio.sleep(idle / 4)
+        raise TimeoutError("cluster did not quiesce")
+
+
+def line_topology(ids: List[str]) -> Dict[str, List[str]]:
+    topo = {}
+    for i, nid in enumerate(ids):
+        nbrs = []
+        if i > 0:
+            nbrs.append(ids[i - 1])
+        if i < len(ids) - 1:
+            nbrs.append(ids[i + 1])
+        topo[nid] = nbrs
+    return topo
+
+
+def grid_topology(ids: List[str], cols: int) -> Dict[str, List[str]]:
+    topo = {nid: [] for nid in ids}
+    rows = (len(ids) + cols - 1) // cols
+    for i, nid in enumerate(ids):
+        r, c = divmod(i, cols)
+        for (rr, cc) in ((r - 1, c), (r + 1, c), (r, c - 1), (r, c + 1)):
+            j = rr * cols + cc
+            if 0 <= rr < rows and 0 <= cc < cols and j < len(ids):
+                topo[nid].append(ids[j])
+    return topo

@@ -1,0 +1,209 @@
+"""Maelstrom-protocol broadcast node: one OS process per cluster node.
+
+A drop-in functional replacement for the reference Go binary under the
+Maelstrom / Jepsen harness (fly.io "Gossip Glomers" broadcast workload):
+newline-delimited JSON envelopes ``{"src","dest","body":{...}}`` on
+stdin/stdout, stderr for logs (SURVEY.md §2.5's L0 contract, inferred from
+the reference's call sites of the maelstrom demo/go library, reference
+go.mod:5).
+
+Implemented surface, matching the reference handler set (main.go:99-158):
+
+  * built-in ``init`` handshake — record ``node_id``/``node_ids``, reply
+    ``init_ok`` (the Go library does this invisibly; SURVEY.md §2.5);
+  * ``broadcast`` — ack FIRST with ``broadcast_ok`` (main.go:109), dedup
+    (main.go:113), append (main.go:117), then gossip to all topology
+    neighbors except the sender (main.go:72-75) with per-neighbor retry;
+  * ``read`` — ordered message log as ``read_ok`` (main.go:123-130);
+  * ``topology`` — store the neighbor map, reply ``topology_ok``
+    (main.go:132-149);
+  * ``broadcast_ok`` — no-op sink for acks with no outstanding RPC
+    (main.go:151-153); acks that match a pending RPC wake its waiter;
+  * unknown types — Maelstrom error reply, code 10 (not-supported).
+
+Deliberate fix vs the reference (flagged per SURVEY.md §2.2): the retry loop
+creates a FRESH 2 s context per attempt, so a healed partition lets the
+fan-out proceed (the reference reuses one expired context forever —
+main.go:77-87, the §2.2.7 liveness hole; that faithful behavior is modeled
+by :mod:`gossip_tpu.runtime.gonative` where parity needs it).  Dedup and the
+topology write are also race-free here by construction: each message is
+handled on the single asyncio loop (the reference's §2.2.5-6 races came from
+per-message goroutines).
+
+This module imports neither jax nor numpy — it must start fast, N processes
+at a time, under a harness.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+from typing import Any, Callable, Dict, List, Optional
+
+ERR_NOT_SUPPORTED = 10
+
+
+class MaelstromNode:
+    """Minimal async Maelstrom node runtime (the L0 layer, SURVEY.md §1).
+
+    Handlers run as their own asyncio task per message — the cooperative
+    analog of the Go library's goroutine-per-message dispatch, so a handler
+    blocked in :meth:`rpc` never stalls the read loop."""
+
+    def __init__(self):
+        self.node_id: Optional[str] = None
+        self.node_ids: List[str] = []
+        self.handlers: Dict[str, Callable] = {}
+        self._next_msg_id = 0
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._write_lock: Optional[asyncio.Lock] = None
+
+    def handle(self, typ: str, fn: Callable) -> None:
+        self.handlers[typ] = fn
+
+    def _msg_id(self) -> int:
+        self._next_msg_id += 1
+        return self._next_msg_id
+
+    async def _write(self, dest: str, body: Dict[str, Any]) -> None:
+        line = json.dumps({"src": self.node_id, "dest": dest, "body": body})
+        async with self._write_lock:
+            sys.stdout.write(line + "\n")
+            sys.stdout.flush()
+
+    async def send(self, dest: str, body: Dict[str, Any]) -> None:
+        await self._write(dest, body)
+
+    async def reply(self, req: Dict[str, Any], body: Dict[str, Any]) -> None:
+        body = dict(body)
+        body["in_reply_to"] = req["body"].get("msg_id")
+        await self._write(req["src"], body)
+
+    async def rpc(self, dest: str, body: Dict[str, Any],
+                  timeout: float = 2.0) -> Dict[str, Any]:
+        """SyncRPC analog (main.go:81): fresh msg_id, block until the
+        matching ``in_reply_to`` arrives or the timeout expires."""
+        body = dict(body)
+        mid = self._msg_id()
+        body["msg_id"] = mid
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[mid] = fut
+        try:
+            await self._write(dest, body)
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(mid, None)
+
+    async def _dispatch(self, msg: Dict[str, Any]) -> None:
+        body = msg.get("body", {})
+        typ = body.get("type")
+        irt = body.get("in_reply_to")
+        if irt is not None and irt in self._pending:
+            fut = self._pending.pop(irt)
+            if not fut.done():
+                fut.set_result(msg)
+            return
+        if typ == "init":
+            self.node_id = body["node_id"]
+            self.node_ids = list(body.get("node_ids", []))
+            await self.reply(msg, {"type": "init_ok"})
+            return
+        fn = self.handlers.get(typ)
+        if fn is None:
+            await self.reply(msg, {"type": "error", "code": ERR_NOT_SUPPORTED,
+                                   "text": f"unhandled type {typ!r}"})
+            return
+        try:
+            await fn(msg)
+        except Exception as e:  # crash log on stderr, Maelstrom-style
+            print(f"handler {typ} failed: {e!r}", file=sys.stderr)
+
+    async def run(self) -> None:
+        self._write_lock = asyncio.Lock()
+        loop = asyncio.get_running_loop()
+        while True:
+            line = await loop.run_in_executor(None, sys.stdin.readline)
+            if not line:
+                return                      # EOF: harness closed us
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except json.JSONDecodeError as e:
+                print(f"bad json: {e}", file=sys.stderr)
+                continue
+            asyncio.ensure_future(self._dispatch(msg))
+
+
+class BroadcastServer:
+    """The L1-L3 layers: message store + gossip engine + handlers."""
+
+    def __init__(self, node: MaelstromNode, rpc_timeout: float = 2.0,
+                 backoff_base: float = 0.1, max_retries: int = 64):
+        self.node = node
+        self.rpc_timeout = rpc_timeout
+        self.backoff_base = backoff_base
+        self.max_retries = max_retries    # int-overflow guard (ref has none)
+        self.messages: List[int] = []     # ordered log (main.go:23)
+        self.seen: set = set()            # dedup set (main.go:24)
+        self.topology: Dict[str, List[str]] = {}
+        node.handle("broadcast", self.on_broadcast)
+        node.handle("read", self.on_read)
+        node.handle("topology", self.on_topology)
+        node.handle("broadcast_ok", self.on_broadcast_ok)
+
+    async def on_broadcast(self, msg) -> None:
+        body = msg["body"]
+        m = body["message"]
+        sender = msg["src"]                        # main.go:107
+        await self.node.reply(msg, {"type": "broadcast_ok"})  # ack FIRST
+        if m in self.seen:                         # dedup (main.go:113)
+            return
+        self.seen.add(m)
+        self.messages.append(m)                    # append (main.go:117)
+        await self.gossip(m, exclude=sender)       # fan-out (main.go:118)
+
+    async def gossip(self, m: int, exclude: str) -> None:
+        """Sequential fan-out with retry (main.go:65-89), fixed-context
+        variant: fresh 2 s deadline per attempt (see module doc)."""
+        neighbors = self.topology.get(self.node.node_id, [])
+        for nb in neighbors:
+            if nb == exclude:                      # sender exclusion
+                continue
+            for attempt in range(self.max_retries):
+                try:
+                    await self.node.rpc(nb, {"type": "broadcast",
+                                             "message": m},
+                                        timeout=self.rpc_timeout)
+                    break
+                except asyncio.TimeoutError:
+                    await asyncio.sleep(
+                        self.backoff_base * (2 ** min(attempt, 12)))
+
+    async def on_read(self, msg) -> None:
+        await self.node.reply(msg, {"type": "read_ok",
+                                    "messages": list(self.messages)})
+
+    async def on_topology(self, msg) -> None:
+        self.topology = {k: list(v)
+                         for k, v in msg["body"]["topology"].items()}
+        await self.node.reply(msg, {"type": "topology_ok"})
+
+    async def on_broadcast_ok(self, msg) -> None:
+        pass                                       # sink (main.go:151-153)
+
+
+async def amain() -> None:
+    node = MaelstromNode()
+    BroadcastServer(node)
+    await node.run()
+
+
+def main() -> None:
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

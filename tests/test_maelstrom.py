@@ -1,0 +1,99 @@
+"""Black-box Maelstrom-protocol conformance (SURVEY.md §2.5 contract).
+
+Drives real ``maelstrom_node`` OS processes over stdin/stdout pipes through
+the mini-Maelstrom router — the reference's exact test setup (SURVEY.md §4):
+multi-node without a cluster, one process per node, simulated network.
+The workload is the Gossip Glomers broadcast checker's invariant: every
+broadcast message eventually appears in every node's read.
+"""
+
+import asyncio
+
+import pytest
+
+from gossip_tpu.runtime.maelstrom_harness import (
+    MaelstromHarness, grid_topology, line_topology)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_single_node_conformance():
+    async def main():
+        h = MaelstromHarness(1)
+        await h.start()          # init/init_ok exercised inside
+        try:
+            await h.set_topology({"n0": []})
+            r = await h.broadcast("n0", 7)
+            assert r["body"]["type"] == "broadcast_ok"
+            # reply correlation: in_reply_to must echo the msg_id we sent
+            # (the harness allocates ids sequentially from _next_msg_id)
+            assert r["body"]["in_reply_to"] == h._next_msg_id
+            assert await h.read("n0") == [7]
+            # duplicate broadcast: acked, not re-appended (dedup,
+            # reference main.go:113)
+            await h.broadcast("n0", 7)
+            assert await h.read("n0") == [7]
+            # unknown type -> Maelstrom error reply, code 10
+            err = await h.send_raw("n0", {"type": "frobnicate"})
+            assert err["body"]["type"] == "error"
+            assert err["body"]["code"] == 10
+        finally:
+            await h.stop()
+    run(main())
+
+
+def test_line_topology_full_propagation():
+    async def main():
+        h = MaelstromHarness(5)
+        await h.start()
+        try:
+            await h.set_topology(line_topology(h.ids))
+            for v in (1, 2, 3):
+                await h.broadcast("n0", v)
+            await h.broadcast("n4", 99)      # from the far end too
+            await h.quiesce()
+            for nid in h.ids:
+                assert sorted(await h.read(nid)) == [1, 2, 3, 99], nid
+        finally:
+            await h.stop()
+    run(main())
+
+
+def test_grid_topology_propagation():
+    async def main():
+        h = MaelstromHarness(9)
+        await h.start()
+        try:
+            await h.set_topology(grid_topology(h.ids, cols=3))
+            for i, v in enumerate((10, 20, 30)):
+                await h.broadcast(h.ids[i * 4 % 9], v)
+            await h.quiesce()
+            for nid in h.ids:
+                assert sorted(await h.read(nid)) == [10, 20, 30], nid
+        finally:
+            await h.stop()
+    run(main())
+
+
+def test_partition_tolerance_retry_heals():
+    # The partition-tolerance variant of the workload (SURVEY.md §4): cut
+    # the only link to n2, broadcast, heal, and the node's retry loop must
+    # deliver (at-least-once; fixed-context variant, maelstrom_node doc).
+    async def main():
+        h = MaelstromHarness(3, latency=0.002)
+        await h.start()
+        try:
+            await h.set_topology(line_topology(h.ids))
+            h.partition("n1", "n2", duration=1.5)
+            await h.broadcast("n0", 5)
+            await asyncio.sleep(0.3)
+            assert await h.read("n1") == [5]     # reached the near side
+            assert await h.read("n2") == []      # cut off
+            await asyncio.sleep(2.0)             # heal + retry window
+            await h.quiesce()
+            assert await h.read("n2") == [5]     # retry delivered
+        finally:
+            await h.stop()
+    run(main())
