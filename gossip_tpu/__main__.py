@@ -1,0 +1,5 @@
+import sys
+
+from gossip_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,240 @@
+"""The ``Backend`` seam: one interface, two engines.
+
+BASELINE.json's north star requires the simulator to sit behind a backend
+interface — ``go-native`` (the event-driven engine reproducing the reference
+semantics, :mod:`gossip_tpu.runtime.gonative`) vs ``jax-tpu`` (the batched
+round-synchronous engine) — "so the existing CLI selects the simulator at
+runtime".  The CLI (:mod:`gossip_tpu.cli`) and the gRPC sidecar
+(:mod:`gossip_tpu.rpc.sidecar`) both speak only this seam.
+
+The two engines report on their native clocks (SURVEY.md §7, the parity
+mapping documented in runtime/gonative.py): ``jax-tpu`` rounds are
+synchronous gossip rounds; ``go-native`` "rounds" are hop depths, plus
+wall-clock convergence in ``meta``.  Coverage values and curves are directly
+comparable (the parity artifact).  Message counts are NOT: go-native counts
+every wire message including the per-delivery ``broadcast_ok`` ack
+(reference semantics, main.go:109), while the batched kernels count
+transmissions only — roughly a 2x accounting gap on flood, recorded per
+backend in ``meta["msgs_counts"]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+from gossip_tpu.config import (FaultConfig, MeshConfig, ProtocolConfig,
+                               RunConfig, TopologyConfig)
+
+BACKENDS = ("jax-tpu", "go-native")
+
+# go-native materializes every edge as python objects; past this it is no
+# longer the quick parity fixture it exists to be.
+_GONATIVE_MAX_NODES = 20_000
+
+
+@dataclasses.dataclass
+class RunReport:
+    """One simulation's outcome, backend-agnostic (JSON-serializable)."""
+
+    backend: str
+    mode: str
+    n: int
+    rounds: int              # rounds (jax-tpu) / hop depth (go-native)
+    coverage: float
+    msgs: float
+    wall_s: float
+    curve: Optional[List[float]] = None
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _build_topology(tc: TopologyConfig, for_gonative: bool):
+    from gossip_tpu.topology import generators as G
+    if for_gonative and tc.family == "complete":
+        # the event sim needs explicit neighbor lists
+        if tc.n > 2048:
+            raise ValueError(
+                "go-native on a complete graph materializes n^2 edges; "
+                f"n={tc.n} is past sanity (use a sparse family or jax-tpu)")
+        return G.complete_table(tc.n)
+    return G.build(tc)
+
+
+def run_gonative(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
+                 fault: Optional[FaultConfig] = None,
+                 want_curve: bool = False) -> RunReport:
+    """Event-driven reference-semantics run (flood relay — the only protocol
+    the reference implements; SURVEY.md §2).  Faults map to partitions is
+    not supported here: the event sim exposes explicit partition windows via
+    its own API for targeted tests."""
+    from gossip_tpu.runtime.gonative import GoNativeSim, topology_from_table
+    if tc.n > _GONATIVE_MAX_NODES:
+        raise ValueError(
+            f"go-native backend capped at {_GONATIVE_MAX_NODES} nodes "
+            f"(parity fixture, not the scale path); got n={tc.n}")
+    if proto.mode != "flood":
+        raise ValueError(
+            "go-native reproduces the reference's relay-to-all-neighbors "
+            f"semantics (flood); mode {proto.mode!r} has no Go equivalent")
+    if fault is not None:
+        raise ValueError(
+            "go-native takes no FaultConfig: faults there are explicit "
+            "partition windows on the GoNativeSim API (Maelstrom-style), "
+            "not per-round masks")
+    topo = _build_topology(tc, for_gonative=True)
+    t0 = time.perf_counter()
+    sim = GoNativeSim(topology_from_table(topo))
+    for r in range(proto.rumors):
+        sim.broadcast(origin=(run.origin + r) % tc.n, message=r)
+    sim.run()
+    wall = time.perf_counter() - t0
+    max_h = run.max_rounds
+    curves = [sim.coverage_by_hop(r, max_h) for r in range(proto.rumors)]
+    curve = [min(c[h] for c in curves) for h in range(max_h + 1)]
+    hops = next((h for h in range(max_h + 1)
+                 if curve[h] >= run.target_coverage), -1)
+    final_cov = min(
+        sum(1 for i in range(tc.n) if r in sim.nodes[i].seen) / tc.n
+        for r in range(proto.rumors))
+    return RunReport(
+        backend="go-native", mode="flood", n=tc.n,
+        rounds=hops, coverage=final_cov, msgs=float(sim.msgs_sent),
+        wall_s=round(wall, 4),
+        curve=curve[1:] if want_curve else None,
+        meta={"clock": "hop-depth", "sim_time_s": sim.now,
+              "deliveries": len(sim.deliveries),
+              "msgs_counts": "requests+acks"})
+
+
+def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
+            fault: Optional[FaultConfig] = None,
+            mesh_cfg: Optional[MeshConfig] = None,
+            want_curve: bool = False) -> RunReport:
+    """Batched round-synchronous run; shards over a device mesh when
+    ``mesh_cfg.n_devices > 1``."""
+    from gossip_tpu.topology import generators as G
+    topo = G.build(tc)
+    n_dev = 1 if mesh_cfg is None else mesh_cfg.n_devices
+
+    if proto.mode == "swim":
+        from gossip_tpu.models.swim import suggested_suspect_rounds
+        from gossip_tpu.runtime.simulator import simulate_swim_curve
+        mesh = None
+        if n_dev > 1:
+            from gossip_tpu.parallel.sharded import make_mesh
+            mesh = make_mesh(n_dev)
+        dead = (1 % proto.swim_subjects,)
+        rounds = run.max_rounds
+        t0 = time.perf_counter()
+        fracs, final = simulate_swim_curve(
+            proto, tc.n, rounds, dead_nodes=dead, fail_round=2, fault=fault,
+            topo=None if tc.family == "complete" else topo, seed=run.seed,
+            mesh=mesh)
+        wall = time.perf_counter() - t0
+        hit = [i for i, f in enumerate(fracs) if f >= run.target_coverage]
+        return RunReport(
+            backend="jax-tpu", mode="swim", n=tc.n,
+            rounds=(hit[0] + 1) if hit else -1,
+            coverage=float(fracs[-1]), msgs=float(final.msgs),
+            wall_s=round(wall, 4),
+            curve=[float(f) for f in fracs] if want_curve else None,
+            meta={"clock": "rounds", "metric": "detection_fraction",
+                  "dead_subjects": list(dead),
+                  "suggested_suspect_rounds":
+                      suggested_suspect_rounds(tc.n, proto.fanout),
+                  "devices": n_dev})
+
+    if n_dev > 1:
+        from gossip_tpu.parallel.sharded import (
+            make_mesh, simulate_curve_sharded, simulate_until_sharded)
+        mesh = make_mesh(n_dev)
+        t0 = time.perf_counter()
+        if want_curve:
+            covs, msgs, _ = simulate_curve_sharded(proto, topo, run, mesh,
+                                                   fault)
+            wall = time.perf_counter() - t0
+            hit = [i for i, c in enumerate(covs)
+                   if c >= run.target_coverage]
+            return RunReport(
+                backend="jax-tpu", mode=proto.mode, n=tc.n,
+                rounds=(hit[0] + 1) if hit else -1,
+                coverage=float(covs[-1]), msgs=float(msgs[-1]),
+                wall_s=round(wall, 4), curve=[float(c) for c in covs],
+                meta={"clock": "rounds", "devices": n_dev,
+                      "msgs_counts": "transmissions"})
+        rounds, cov, msgs, _ = simulate_until_sharded(proto, topo, run, mesh,
+                                                      fault)
+        wall = time.perf_counter() - t0
+        return RunReport(backend="jax-tpu", mode=proto.mode, n=tc.n,
+                         rounds=rounds, coverage=cov, msgs=msgs,
+                         wall_s=round(wall, 4),
+                         meta={"clock": "rounds", "devices": n_dev,
+                               "msgs_counts": "transmissions"})
+
+    from gossip_tpu.runtime.simulator import simulate_curve, simulate_until
+    t0 = time.perf_counter()
+    if want_curve:
+        res = simulate_curve(proto, topo, run, fault)
+        wall = time.perf_counter() - t0
+        return RunReport(
+            backend="jax-tpu", mode=proto.mode, n=tc.n,
+            rounds=res.rounds_to_target, coverage=res.final_coverage,
+            msgs=float(res.msgs[-1]), wall_s=round(wall, 4),
+            curve=[float(c) for c in res.coverage],
+            meta={"clock": "rounds", "devices": 1,
+                  "msgs_counts": "transmissions"})
+    res = simulate_until(proto, topo, run, fault)
+    wall = time.perf_counter() - t0
+    return RunReport(backend="jax-tpu", mode=proto.mode, n=tc.n,
+                     rounds=res.rounds, coverage=res.coverage, msgs=res.msgs,
+                     wall_s=round(wall, 4),
+                     meta={"clock": "rounds", "devices": 1,
+                           "msgs_counts": "transmissions"})
+
+
+def run_simulation(backend: str, proto: ProtocolConfig, tc: TopologyConfig,
+                   run: RunConfig, fault: Optional[FaultConfig] = None,
+                   mesh_cfg: Optional[MeshConfig] = None,
+                   want_curve: bool = False) -> RunReport:
+    """The one entry point both the CLI and the sidecar call."""
+    if backend == "go-native":
+        return run_gonative(proto, tc, run, fault, want_curve)
+    if backend == "jax-tpu":
+        return run_jax(proto, tc, run, fault, mesh_cfg, want_curve)
+    raise ValueError(f"unknown backend {backend!r}; choose from {BACKENDS}")
+
+
+# -- (de)serialization for the RPC/CLI boundary --------------------------
+
+_CFG_TYPES = {"proto": ProtocolConfig, "topology": TopologyConfig,
+              "run": RunConfig, "fault": FaultConfig, "mesh": MeshConfig}
+
+
+def request_to_args(req: Dict[str, Any]) -> Dict[str, Any]:
+    """JSON request dict -> kwargs for :func:`run_simulation`.  Unknown
+    fields are rejected (typos should not silently become defaults)."""
+    out: Dict[str, Any] = {"backend": req.get("backend", "jax-tpu"),
+                           "want_curve": bool(req.get("curve", False))}
+    for key, cls in _CFG_TYPES.items():
+        val = req.get(key)
+        if val is None:
+            cfg = None
+        else:
+            known = {f.name for f in dataclasses.fields(cls)}
+            bad = set(val) - known
+            if bad:
+                raise ValueError(f"unknown {key} fields: {sorted(bad)}")
+            cfg = cls(**val)
+        out[{"proto": "proto", "topology": "tc", "run": "run",
+             "fault": "fault", "mesh": "mesh_cfg"}[key]] = cfg
+    if out["proto"] is None:
+        out["proto"] = ProtocolConfig()
+    if out["tc"] is None:
+        out["tc"] = TopologyConfig()
+    if out["run"] is None:
+        out["run"] = RunConfig()
+    return out

@@ -1,0 +1,208 @@
+"""Command-line interface: pick a backend, run, sweep, serve.
+
+The reference has no flags, no env vars, no config of any kind — its only
+runtime configuration is the ``topology`` message (reference main.go:132-149,
+SURVEY.md §5).  This CLI makes every implicit constant explicit and
+sweepable, and selects the engine at runtime through the Backend seam
+(BASELINE.json north star):
+
+    python -m gossip_tpu run --backend jax-tpu --mode pushpull --n 100000
+    python -m gossip_tpu run --backend go-native --mode flood --n 1024 \
+        --family ring --curve
+    python -m gossip_tpu sweep --scale 0.01          # the 5 BASELINE configs
+    python -m gossip_tpu serve --port 50051          # gRPC sidecar
+    python -m gossip_tpu maelstrom                   # protocol node on stdio
+
+Output is JSON lines (one report per line) so harnesses can consume it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from gossip_tpu.config import (FaultConfig, MeshConfig, ProtocolConfig,
+                               RunConfig, TopologyConfig)
+
+
+def _add_run_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--backend", default="jax-tpu",
+                   choices=("jax-tpu", "go-native"))
+    p.add_argument("--mode", default="push",
+                   choices=("push", "pull", "pushpull", "flood",
+                            "antientropy", "swim"))
+    p.add_argument("--n", type=int, default=1024)
+    p.add_argument("--fanout", type=int, default=1)
+    p.add_argument("--rumors", type=int, default=1)
+    p.add_argument("--period", type=int, default=1,
+                   help="anti-entropy exchange period (rounds)")
+    p.add_argument("--family", default="complete",
+                   choices=("complete", "ring", "grid", "erdos_renyi",
+                            "watts_strogatz", "power_law"))
+    p.add_argument("--k", type=int, default=4,
+                   help="ring/WS neighbors; BA attachment edges")
+    p.add_argument("--p", type=float, default=0.01,
+                   help="ER edge prob / WS rewire prob")
+    p.add_argument("--degree-cap", type=int, default=None)
+    p.add_argument("--target", type=float, default=0.99)
+    p.add_argument("--max-rounds", type=int, default=256)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--origin", type=int, default=0)
+    p.add_argument("--drop", type=float, default=0.0,
+                   help="per-message drop probability per round")
+    p.add_argument("--death", type=float, default=0.0,
+                   help="fraction of nodes statically dead")
+    p.add_argument("--devices", type=int, default=1,
+                   help="mesh size for node-dim sharding (jax-tpu)")
+    p.add_argument("--curve", action="store_true",
+                   help="include the per-round coverage curve")
+    p.add_argument("--swim-subjects", type=int, default=8)
+    p.add_argument("--swim-proxies", type=int, default=3)
+    p.add_argument("--swim-suspect-rounds", type=int, default=0,
+                   help="0 = use suggested_suspect_rounds(n)")
+
+
+def _args_to_configs(a):
+    t = a.swim_suspect_rounds
+    if not t and a.mode == "swim":    # import only when needed: pulls in jax
+        from gossip_tpu.models.swim import suggested_suspect_rounds
+        t = suggested_suspect_rounds(a.n, a.fanout)
+    t = t or 4
+    proto = ProtocolConfig(mode=a.mode, fanout=a.fanout, rumors=a.rumors,
+                           period=a.period, swim_subjects=a.swim_subjects,
+                           swim_proxies=a.swim_proxies,
+                           swim_suspect_rounds=t)
+    tc = TopologyConfig(family=a.family, n=a.n, k=a.k, p=a.p,
+                        degree_cap=a.degree_cap, seed=a.seed)
+    run = RunConfig(target_coverage=a.target, max_rounds=a.max_rounds,
+                    seed=a.seed, origin=a.origin)
+    fault = None
+    if a.drop > 0 or a.death > 0:
+        fault = FaultConfig(node_death_rate=a.death, drop_prob=a.drop,
+                            seed=a.seed)
+    mesh = MeshConfig(n_devices=a.devices) if a.devices > 1 else None
+    return proto, tc, run, fault, mesh
+
+
+def cmd_run(a) -> int:
+    from gossip_tpu.backend import run_simulation
+    proto, tc, run, fault, mesh = _args_to_configs(a)
+    report = run_simulation(a.backend, proto, tc, run, fault, mesh,
+                            want_curve=a.curve)
+    print(json.dumps(report.to_dict()))
+    return 0
+
+
+# The five BASELINE.json benchmark configs, scalable for CPU smoke runs.
+def baseline_configs(scale: float, devices: int):
+    def sn(n):                       # scaled node count
+        return max(64, int(n * scale))
+    n2 = sn(10_000)
+    n3 = sn(100_000)
+    n4 = sn(1_000_000)
+    n5 = sn(10_000_000)
+    return [
+        dict(name="push-complete-64-goref", backend="jax-tpu",
+             proto=ProtocolConfig(mode="push", fanout=1),
+             tc=TopologyConfig(family="complete", n=64),
+             run=RunConfig(max_rounds=64), compare_gonative=True),
+        dict(name="pushpull-er-10k", backend="jax-tpu",
+             proto=ProtocolConfig(mode="pushpull", fanout=1),
+             tc=TopologyConfig(family="erdos_renyi", n=n2,
+                               p=min(1.0, 0.01 * 10_000 / n2)),
+             run=RunConfig(max_rounds=64)),
+        dict(name="antientropy-ws-100k", backend="jax-tpu",
+             proto=ProtocolConfig(mode="antientropy", fanout=1, period=2),
+             tc=TopologyConfig(family="watts_strogatz", n=n3, k=6, p=0.1),
+             run=RunConfig(max_rounds=256)),
+        dict(name="swim-powerlaw-1m", backend="jax-tpu",
+             proto=ProtocolConfig(mode="swim", fanout=2, swim_proxies=3,
+                                  swim_subjects=8, swim_suspect_rounds=24),
+             tc=TopologyConfig(family="power_law", n=n4, k=3,
+                               degree_cap=256),
+             run=RunConfig(max_rounds=80)),
+        dict(name="multirumor-10m-sharded", backend="jax-tpu",
+             proto=ProtocolConfig(mode="pushpull", fanout=1, rumors=8),
+             tc=TopologyConfig(family="complete", n=n5),
+             run=RunConfig(max_rounds=64),
+             mesh=MeshConfig(n_devices=devices)),
+    ]
+
+
+def cmd_sweep(a) -> int:
+    from gossip_tpu.backend import run_simulation
+    import jax
+    devices = a.devices or len(jax.devices())
+    configs = baseline_configs(a.scale, devices)
+    if a.only:
+        configs = [c for c in configs if c["name"] in a.only]
+    for cfg in configs:
+        report = run_simulation(cfg["backend"], cfg["proto"], cfg["tc"],
+                                cfg["run"], None, cfg.get("mesh"),
+                                want_curve=a.curve)
+        out = report.to_dict()
+        out["config"] = cfg["name"]
+        if cfg.get("compare_gonative"):
+            ref = run_simulation("go-native",
+                                 ProtocolConfig(mode="flood"), cfg["tc"],
+                                 cfg["run"], want_curve=a.curve)
+            out["gonative_ref"] = ref.to_dict()
+        print(json.dumps(out), flush=True)
+    return 0
+
+
+def cmd_serve(a) -> int:
+    from gossip_tpu.rpc.sidecar import serve
+    server, port = serve(a.port, a.workers)
+    print(json.dumps({"serving": True, "port": port}), flush=True)
+    server.wait_for_termination()
+    return 0
+
+
+def cmd_maelstrom(a) -> int:
+    from gossip_tpu.runtime.maelstrom_node import main as node_main
+    node_main()
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="gossip_tpu",
+        description="TPU-native gossip simulation framework")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("run", help="run one simulation")
+    _add_run_flags(p)
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("sweep", help="run the 5 BASELINE benchmark configs")
+    p.add_argument("--scale", type=float, default=1.0,
+                   help="node-count scale factor (CPU smoke: 0.01)")
+    p.add_argument("--devices", type=int, default=0,
+                   help="mesh size for the sharded config (0 = all)")
+    p.add_argument("--only", nargs="*", default=None,
+                   help="subset of config names")
+    p.add_argument("--curve", action="store_true")
+    p.set_defaults(fn=cmd_sweep)
+
+    p = sub.add_parser("serve", help="start the gRPC sidecar")
+    p.add_argument("--port", type=int, default=50051)
+    p.add_argument("--workers", type=int, default=4)
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("maelstrom",
+                       help="run the Maelstrom protocol node on stdio")
+    p.set_defaults(fn=cmd_maelstrom)
+
+    a = ap.parse_args(argv)
+    try:
+        return a.fn(a)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -203,6 +203,31 @@ def init_sharded_state(run: RunConfig, proto: ProtocolConfig, topo: Topology,
                     msgs=st.msgs)
 
 
+def simulate_curve_sharded(proto: ProtocolConfig, topo: Topology,
+                           run: RunConfig, mesh: Mesh,
+                           fault: Optional[FaultConfig] = None,
+                           axis_name: str = "nodes"):
+    """``lax.scan`` over rounds recording (coverage, msgs) per round, state
+    resident sharded.  Sharded twin of runtime/simulator.simulate_curve.
+    Returns (coverage[T], msgs[T], final_state) as host arrays/state."""
+    import numpy as np
+    step = make_sharded_si_round(proto, topo, mesh, fault, run.origin,
+                                 axis_name)
+    n_pad = pad_to_mesh(topo.n, mesh, axis_name)
+    alive_pad = sharded_alive(fault, topo.n, n_pad, run.origin)
+    init = init_sharded_state(run, proto, topo, mesh, axis_name)
+
+    @jax.jit
+    def scan(state):
+        def body(s, _):
+            s = step(s)
+            return s, (coverage(s.seen, alive_pad), s.msgs)
+        return jax.lax.scan(body, state, None, length=run.max_rounds)
+
+    final, (covs, msgs) = scan(init)
+    return np.asarray(covs), np.asarray(msgs), final
+
+
 def simulate_until_sharded(proto: ProtocolConfig, topo: Topology,
                            run: RunConfig, mesh: Mesh,
                            fault: Optional[FaultConfig] = None,

@@ -1,0 +1,2 @@
+"""gRPC sidecar: the host-side shim between CLIs/harnesses and the JAX
+simulator (SURVEY.md §2.4 / BASELINE.json north star)."""

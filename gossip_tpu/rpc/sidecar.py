@@ -1,0 +1,103 @@
+"""gRPC sidecar: drive the simulator as a service, coarse-grained.
+
+The north star (BASELINE.json) wants the Go-style CLI to select the JAX
+simulator "at runtime via a gRPC shim to a Python/JAX sidecar".  This is
+that shim.  Two design rules from SURVEY.md §7 ("The gRPC boundary"):
+
+  * **Coarse calls only** — one RPC = one whole simulation run (or sweep),
+    never per-round; the <1 s 10M-node budget cannot absorb per-round RPCs.
+  * **No codegen** — the environment ships the grpc runtime but not
+    grpc_tools, so the service uses gRPC *generic method handlers* with
+    JSON payloads over raw bytes: real gRPC/HTTP-2 framing, zero .proto
+    compilation, and any language's grpc client can call it with a
+    bytes-in/bytes-out stub on ``/gossip.Simulator/<Method>``.
+
+Wire format: requests and responses are UTF-8 JSON.  ``Run`` takes
+``{"backend": ..., "proto": {...}, "topology": {...}, "run": {...},
+"fault": {...}|null, "mesh": {...}|null, "curve": bool}`` (field names =
+the config dataclasses, validated strictly) and returns a RunReport dict.
+``Health`` returns backend/device facts.
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures
+from typing import Optional, Tuple
+
+import grpc
+
+SERVICE = "gossip.Simulator"
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+def _run(request: bytes, context) -> bytes:
+    from gossip_tpu.backend import request_to_args, run_simulation
+    try:
+        req = json.loads(request)
+        args = request_to_args(req)
+        report = run_simulation(**args)
+    except (ValueError, TypeError, json.JSONDecodeError) as e:
+        context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+    return json.dumps(report.to_dict()).encode()
+
+
+def _health(request: bytes, context) -> bytes:
+    import jax
+    return json.dumps({
+        "ok": True,
+        "backend": jax.default_backend(),
+        "devices": len(jax.devices()),
+        "service": SERVICE,
+    }).encode()
+
+
+def serve(port: int = 50051, max_workers: int = 4,
+          host: str = "127.0.0.1") -> Tuple[grpc.Server, int]:
+    """Start the sidecar; returns (server, bound_port).  port=0 picks a
+    free port (tests)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    handlers = {
+        "Run": grpc.unary_unary_rpc_method_handler(
+            _run, request_deserializer=_identity,
+            response_serializer=_identity),
+        "Health": grpc.unary_unary_rpc_method_handler(
+            _health, request_deserializer=_identity,
+            response_serializer=_identity),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0 and port != 0:      # grpc's bind-failure sentinel
+        raise OSError(f"could not bind {host}:{port} (port in use?)")
+    server.start()
+    return server, bound
+
+
+class SidecarClient:
+    """Typed client over the JSON-bytes wire (usable from any grpc client
+    in any language the same way)."""
+
+    def __init__(self, address: str):
+        self._channel = grpc.insecure_channel(address)
+        self._run = self._channel.unary_unary(
+            f"/{SERVICE}/Run", request_serializer=_identity,
+            response_deserializer=_identity)
+        self._health = self._channel.unary_unary(
+            f"/{SERVICE}/Health", request_serializer=_identity,
+            response_deserializer=_identity)
+
+    def run(self, timeout: Optional[float] = 600.0, **request) -> dict:
+        """One simulation.  kwargs mirror the JSON request fields:
+        backend, proto, topology, run, fault, mesh, curve."""
+        return json.loads(self._run(json.dumps(request).encode(),
+                                    timeout=timeout))
+
+    def health(self, timeout: float = 10.0) -> dict:
+        return json.loads(self._health(b"{}", timeout=timeout))
+
+    def close(self) -> None:
+        self._channel.close()

@@ -102,6 +102,43 @@ def simulate_until(proto: ProtocolConfig, topo: Topology, run: RunConfig,
     )
 
 
+def simulate_swim_curve(proto: ProtocolConfig, n: int, rounds: int,
+                        dead_nodes=(), fail_round: int = 0,
+                        fault: Optional[FaultConfig] = None,
+                        topo: Optional[Topology] = None,
+                        seed: int = 0, mesh=None):
+    """SWIM detection-fraction curve over ``rounds`` (lax.scan, one XLA
+    program).  With ``mesh`` the sharded twin runs instead.  Returns
+    (detection[T] as numpy, final SwimState)."""
+    from gossip_tpu.models import swim as SW
+    if mesh is None:
+        step = SW.make_swim_round(proto, n, tuple(dead_nodes), fail_round,
+                                  fault, topo)
+        init = SW.init_swim_state(n, proto.swim_subjects, seed)
+    else:
+        from gossip_tpu.parallel.sharded_swim import (
+            init_sharded_swim_state, make_sharded_swim_round)
+        step = make_sharded_swim_round(proto, n, mesh, tuple(dead_nodes),
+                                       fail_round, fault, topo)
+        init = init_sharded_swim_state(n, proto, mesh, seed)
+    dead = tuple(dead_nodes)
+
+    @jax.jit
+    def scan(state):
+        def body(s, _):
+            s = step(s)
+            # observers: rows [0, n) — drops the mesh padding rows (a no-op
+            # slice in the unsharded case); detection over the dead subjects
+            frac = SW.detection_fraction(
+                SW.SwimState(s.wire[:n], s.timer[:n], s.round,
+                             s.base_key, s.msgs), dead) if dead else 0.0
+            return s, frac
+        return jax.lax.scan(body, state, None, length=rounds)
+
+    final, fracs = scan(init)
+    return np.asarray(fracs), final
+
+
 def compiled_until(proto: ProtocolConfig, topo: Topology, run: RunConfig,
                    fault: Optional[FaultConfig] = None):
     """Lowered/compiled while-loop runner + fresh init state, for benchmarks

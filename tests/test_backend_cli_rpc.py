@@ -1,0 +1,153 @@
+"""Backend seam, CLI, and gRPC sidecar tests (SURVEY.md §7 layers 5-6)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from gossip_tpu.backend import (RunReport, request_to_args, run_simulation)
+from gossip_tpu.config import (MeshConfig, ProtocolConfig, RunConfig,
+                               TopologyConfig)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_backend_parity_race_free_ring():
+    # On the k=2 ring the event sim's hop clock equals the kernel's round
+    # clock exactly (gonative parity contract), so the two backends must
+    # report identical rounds-to-target through the seam.
+    tc = TopologyConfig(family="ring", n=256, k=2)
+    run = RunConfig(target_coverage=1.0, max_rounds=200)
+    jax_r = run_simulation("jax-tpu", ProtocolConfig(mode="flood"), tc, run)
+    go_r = run_simulation("go-native", ProtocolConfig(mode="flood"), tc, run)
+    assert jax_r.coverage == go_r.coverage == 1.0
+    assert jax_r.rounds == go_r.rounds == 128
+    assert go_r.meta["clock"] == "hop-depth"
+
+
+def test_backend_swim_report():
+    proto = ProtocolConfig(mode="swim", fanout=2, swim_subjects=4,
+                           swim_proxies=2, swim_suspect_rounds=4)
+    r = run_simulation("jax-tpu", proto,
+                       TopologyConfig(family="complete", n=128),
+                       RunConfig(max_rounds=40))
+    assert r.mode == "swim"
+    assert r.coverage > 0.97          # detection fraction
+    assert 0 < r.rounds < 40
+
+
+def test_backend_sharded_path():
+    r = run_simulation("jax-tpu", ProtocolConfig(mode="pushpull"),
+                       TopologyConfig(family="complete", n=512),
+                       RunConfig(max_rounds=64),
+                       mesh_cfg=MeshConfig(n_devices=8), want_curve=True)
+    assert r.meta["devices"] == 8
+    assert r.coverage >= 0.99
+    assert len(r.curve) == 64
+
+
+def test_backend_rejections():
+    with pytest.raises(ValueError, match="unknown backend"):
+        run_simulation("torch", ProtocolConfig(), TopologyConfig(),
+                       RunConfig())
+    with pytest.raises(ValueError, match="no Go equivalent"):
+        run_simulation("go-native", ProtocolConfig(mode="pushpull"),
+                       TopologyConfig(family="ring", n=64), RunConfig())
+    with pytest.raises(ValueError, match="capped"):
+        run_simulation("go-native", ProtocolConfig(mode="flood"),
+                       TopologyConfig(family="ring", n=50_000), RunConfig())
+    from gossip_tpu.config import FaultConfig
+    with pytest.raises(ValueError, match="no FaultConfig"):
+        run_simulation("go-native", ProtocolConfig(mode="flood"),
+                       TopologyConfig(family="ring", n=64), RunConfig(),
+                       fault=FaultConfig(drop_prob=0.1))
+
+
+def test_request_to_args_strict():
+    args = request_to_args({"backend": "jax-tpu",
+                            "proto": {"mode": "push", "fanout": 2},
+                            "topology": {"family": "ring", "n": 64, "k": 2}})
+    assert args["proto"].fanout == 2
+    assert args["tc"].family == "ring"
+    with pytest.raises(ValueError, match="unknown proto fields"):
+        request_to_args({"proto": {"fanoot": 2}})
+
+
+def test_rpc_sidecar_round_trip():
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from gossip_tpu.rpc.sidecar import SidecarClient, serve
+    server, port = serve(port=0, max_workers=2)
+    try:
+        client = SidecarClient(f"127.0.0.1:{port}")
+        h = client.health()
+        assert h["ok"] and h["devices"] >= 1
+        rep = client.run(
+            backend="jax-tpu",
+            proto={"mode": "pushpull", "fanout": 1},
+            topology={"family": "erdos_renyi", "n": 500, "p": 0.02},
+            run={"max_rounds": 64}, curve=True)
+        assert rep["coverage"] >= 0.99
+        assert rep["backend"] == "jax-tpu"
+        assert len(rep["curve"]) == 64
+        # same request direct == same result (the shim adds nothing)
+        direct = run_simulation(
+            "jax-tpu", ProtocolConfig(mode="pushpull", fanout=1),
+            TopologyConfig(family="erdos_renyi", n=500, p=0.02),
+            RunConfig(max_rounds=64), want_curve=True)
+        assert rep["rounds"] == direct.rounds
+        assert rep["msgs"] == direct.msgs
+        # bad requests become INVALID_ARGUMENT, not server crashes
+        import grpc as g
+        with pytest.raises(g.RpcError) as ei:
+            client.run(backend="torch")
+        assert ei.value.code() == g.StatusCode.INVALID_ARGUMENT
+        client.close()
+    finally:
+        server.stop(grace=None)
+
+
+CLI_ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+           "PYTHONPATH": _REPO}
+
+
+def _cli(*argv):
+    return subprocess.run([sys.executable, "-m", "gossip_tpu", *argv],
+                          capture_output=True, text=True, cwd=_REPO,
+                          env=CLI_ENV, timeout=240)
+
+
+def test_cli_run_json():
+    p = _cli("run", "--backend", "go-native", "--mode", "flood",
+             "--family", "ring", "--n", "128", "--k", "2",
+             "--target", "1.0", "--max-rounds", "100")
+    assert p.returncode == 0, p.stderr
+    rep = json.loads(p.stdout)
+    assert rep["rounds"] == 64 and rep["coverage"] == 1.0
+
+
+def test_cli_run_jax_and_error_paths():
+    p = _cli("run", "--mode", "pushpull", "--n", "300",
+             "--family", "erdos_renyi", "--p", "0.03", "--curve")
+    assert p.returncode == 0, p.stderr
+    rep = json.loads(p.stdout)
+    assert rep["coverage"] >= 0.99 and rep["curve"]
+    p = _cli("run", "--backend", "go-native", "--mode", "pushpull",
+             "--family", "ring", "--n", "64")
+    assert p.returncode == 2
+    assert "no Go equivalent" in p.stderr
+
+
+def test_cli_sweep_smoke():
+    p = _cli("sweep", "--scale", "0.002", "--devices", "4",
+             "--only", "push-complete-64-goref", "pushpull-er-10k",
+             "multirumor-10m-sharded")
+    assert p.returncode == 0, p.stderr
+    lines = [json.loads(line) for line in p.stdout.splitlines()]
+    assert len(lines) == 3
+    byname = {line["config"]: line for line in lines}
+    assert byname["push-complete-64-goref"]["gonative_ref"]["coverage"] == 1.0
+    assert byname["multirumor-10m-sharded"]["meta"]["devices"] == 4
+    assert all(line["coverage"] >= 0.99 for line in lines)
