@@ -217,8 +217,15 @@ _CFG_TYPES = {"proto": ProtocolConfig, "topology": TopologyConfig,
 def request_to_args(req: Dict[str, Any]) -> Dict[str, Any]:
     """JSON request dict -> kwargs for :func:`run_simulation`.  Unknown
     fields are rejected (typos should not silently become defaults)."""
+    known_top = set(_CFG_TYPES) | {"backend", "curve"}
+    bad_top = set(req) - known_top
+    if bad_top:
+        raise ValueError(f"unknown request fields: {sorted(bad_top)}")
+    curve = req.get("curve", False)
+    if not isinstance(curve, bool):
+        raise ValueError(f"curve must be a bool, got {curve!r}")
     out: Dict[str, Any] = {"backend": req.get("backend", "jax-tpu"),
-                           "want_curve": bool(req.get("curve", False))}
+                           "want_curve": curve}
     for key, cls in _CFG_TYPES.items():
         val = req.get(key)
         if val is None:

@@ -1,0 +1,168 @@
+"""Bit-packed pull / anti-entropy rounds: the gather-only TPU fast path.
+
+The measured cost model on the target TPU (methodology: 20-iteration
+``fori_loop`` microbenches at N=10M, see bench.py notes):
+
+  * XLA scatter  ~ 10.6 ns/element  (the push half of push-pull)
+  * XLA gather   ~  8.0 ns/element  (bool), ~7.0 ns/element (uint32 word)
+  * everything else in a round fuses to ~5 ms at N=10M
+
+so a *pull-only* round costs one gather and nothing else, and pull's
+endgame is quadratic (the uninfected fraction squares each round: an
+uninfected node stays uninfected only if its sampled partner was also
+uninfected), giving ~log2(N) + O(log log N) rounds to 99%.  Measured at
+N=10M: pull 27 rounds / 2.30 s vs push-pull 17 rounds / 3.54 s — pull wins
+on wall-clock by 1.5x despite more rounds.  Packing (ops/bitpack.py) then
+moves 32 rumors per gathered word.
+
+Semantics are EXACTLY models/si.make_si_round's PULL / ANTI_ENTROPY modes —
+same RNG tags, same per-global-node-id keying, same message accounting —
+verified bitwise in tests/test_packed.py.  Push modes are deliberately
+absent: scatter-OR is not an XLA primitive and the scatter is the expensive
+half; use models/si.py when push semantics are required.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from gossip_tpu import config as C
+from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
+from gossip_tpu.models import si as si_mod
+from gossip_tpu.models.state import SimState, alive_mask, init_state
+from gossip_tpu.ops.bitpack import coverage_packed, n_words, pack
+from gossip_tpu.ops.sampling import apply_drop, sample_peers
+from gossip_tpu.topology.generators import Topology
+
+
+def init_packed_state(run: RunConfig, proto: ProtocolConfig,
+                      n: int) -> SimState:
+    """SimState whose ``seen`` is uint32[N, ceil(R/32)] (packed)."""
+    st = init_state(run, proto, n)
+    return st._replace(seen=pack(st.seen))
+
+
+def pull_merge_packed(packed_all: jax.Array, partners: jax.Array,
+                      sentinel: int) -> jax.Array:
+    """OR of k sampled peers' packed digest words -> uint32[N_local, W].
+
+    The packed twin of ops/propagate.pull_merge: one uint32 gather moves 32
+    rumor bits."""
+    valid = partners < sentinel
+    safe = jnp.minimum(partners, sentinel - 1)
+    got = packed_all[safe]                        # [Nl, k, W] uint32
+    got = jnp.where(valid[:, :, None], got, jnp.uint32(0))
+    out = got[:, 0, :]
+    for j in range(1, got.shape[1]):
+        out = out | got[:, j, :]
+    return out
+
+
+def make_packed_round(proto: ProtocolConfig, topo: Topology,
+                      fault: Optional[FaultConfig] = None,
+                      origin: int = 0,
+                      sampler: str = "threefry",
+                      sampler_seed: int = 0
+                      ) -> Callable[[SimState], SimState]:
+    """Packed PULL / ANTI_ENTROPY round step.
+
+    ``sampler="threefry"`` (default) is RNG-identical to
+    models/si.make_si_round — same tags, bitwise-equal trajectories.
+    ``sampler="pallas"`` draws partners with the TPU hardware PRNG
+    (ops/pallas_sampling — different stream, implicit complete graph only,
+    the opt-in bench fast path)."""
+    n, k = topo.n, proto.fanout
+    mode = proto.mode
+    if mode not in (C.PULL, C.ANTI_ENTROPY):
+        raise ValueError(
+            f"packed rounds support pull/antientropy only, got {mode!r} "
+            "(push needs scatter-OR, which XLA does not have — see module "
+            "doc)")
+    if sampler not in ("threefry", "pallas"):
+        raise ValueError(f"unknown sampler {sampler!r}")
+    if sampler == "pallas" and not topo.implicit:
+        raise ValueError("the pallas sampler draws on the implicit "
+                         "complete graph only")
+    alive = alive_mask(fault, n, origin)
+    drop_prob = 0.0 if fault is None else fault.drop_prob
+    ids = jnp.arange(n, dtype=jnp.int32)
+
+    def step(state: SimState) -> SimState:
+        rkey = jax.random.fold_in(state.base_key, state.round)
+        packed = state.seen
+        visible = packed if alive is None else jnp.where(
+            alive[:, None], packed, jnp.uint32(0))
+        if sampler == "pallas":
+            from gossip_tpu.ops.pallas_sampling import sample_peers_fast
+            partners = sample_peers_fast(sampler_seed, state.round, n, n, k,
+                                         proto.exclude_self)
+        else:
+            qkey = jax.random.fold_in(rkey, si_mod.PULL_TAG)
+            partners = sample_peers(qkey, ids, topo, k, proto.exclude_self)
+        partners = apply_drop(rkey, si_mod.PULL_DROP_TAG, ids,
+                              partners, drop_prob, n)
+        pulled = pull_merge_packed(visible, partners, n)
+        if alive is not None:
+            partners = jnp.where(alive[:, None], partners, n)
+        n_req = jnp.sum(partners < n).astype(jnp.float32)
+        if mode == C.ANTI_ENTROPY and proto.period > 1:
+            on = (state.round % proto.period) == 0
+            pulled = jnp.where(on, pulled, jnp.uint32(0))
+            n_req = jnp.where(on, n_req, 0.0)
+        if alive is not None:
+            pulled = jnp.where(alive[:, None], pulled, jnp.uint32(0))
+        return SimState(seen=packed | pulled, round=state.round + 1,
+                        base_key=state.base_key,
+                        msgs=state.msgs + 2.0 * n_req)
+
+    return step
+
+
+def simulate_until_packed(proto: ProtocolConfig, topo: Topology,
+                          run: RunConfig,
+                          fault: Optional[FaultConfig] = None):
+    """while_loop to target coverage on packed state — the bench fast path.
+    Returns (rounds, coverage, msgs, final_state)."""
+    step = make_packed_round(proto, topo, fault, run.origin)
+    alive = alive_mask(fault, topo.n, run.origin)
+    init = init_packed_state(run, proto, topo.n)
+    target = jnp.float32(run.target_coverage)
+    r = proto.rumors
+
+    @jax.jit
+    def loop(state):
+        def cond(s):
+            return ((coverage_packed(s.seen, r, alive) < target)
+                    & (s.round < run.max_rounds))
+        return jax.lax.while_loop(cond, step, state)
+
+    final = loop(init)
+    return (int(final.round),
+            float(coverage_packed(final.seen, r, alive)),
+            float(final.msgs), final)
+
+
+def compiled_until_packed(proto: ProtocolConfig, topo: Topology,
+                          run: RunConfig,
+                          fault: Optional[FaultConfig] = None,
+                          sampler: str = "threefry"):
+    """Compiled packed while-loop + fresh init (bench: compile/run split)."""
+    from functools import partial
+    step = make_packed_round(proto, topo, fault, run.origin, sampler,
+                             run.seed)
+    alive = alive_mask(fault, topo.n, run.origin)
+    init = init_packed_state(run, proto, topo.n)
+    target = jnp.float32(run.target_coverage)
+    r = proto.rumors
+
+    @partial(jax.jit, donate_argnums=0)
+    def loop(state):
+        def cond(s):
+            return ((coverage_packed(s.seen, r, alive) < target)
+                    & (s.round < run.max_rounds))
+        return jax.lax.while_loop(cond, step, state)
+
+    return loop, init

@@ -122,6 +122,11 @@ def simulate_swim_curve(proto: ProtocolConfig, n: int, rounds: int,
                                        fail_round, fault, topo)
         init = init_sharded_swim_state(n, proto, mesh, seed)
     dead = tuple(dead_nodes)
+    # Observer population: nodes that stay alive after fail_round.  Without
+    # this mask, fault-dead observers sit in the denominator and the
+    # detection fraction plateaus at the alive fraction, never reaching the
+    # target (detection_fraction's metric is over alive observers).
+    alive_obs = SW.base_alive(n, tuple(dead_nodes), fault)
 
     @jax.jit
     def scan(state):
@@ -131,7 +136,8 @@ def simulate_swim_curve(proto: ProtocolConfig, n: int, rounds: int,
             # slice in the unsharded case); detection over the dead subjects
             frac = SW.detection_fraction(
                 SW.SwimState(s.wire[:n], s.timer[:n], s.round,
-                             s.base_key, s.msgs), dead) if dead else 0.0
+                             s.base_key, s.msgs), dead,
+                alive_obs) if dead else 0.0
             return s, frac
         return jax.lax.scan(body, state, None, length=rounds)
 

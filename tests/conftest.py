@@ -22,6 +22,7 @@ import jax  # noqa: E402
 
 # Force CPU even if the surrounding environment points JAX at a TPU tunnel
 # (JAX_PLATFORMS=axon): unit tests must be fast and hermetic.  Override with
-# GOSSIP_TPU_TEST_PLATFORM=tpu to exercise the suite on real hardware.
+# GOSSIP_TPU_TEST_PLATFORM=axon to exercise the suite on real hardware (the
+# tunnel registers its platform under the name "axon", not "tpu").
 jax.config.update("jax_platforms",
                   os.environ.get("GOSSIP_TPU_TEST_PLATFORM", "cpu"))

@@ -1,0 +1,117 @@
+"""Bit-packed state: pack/unpack round trips and packed==unpacked parity.
+
+The packed pull path is the bench fast path (bench.py), so its contract is
+the strongest we have: bitwise-identical trajectories to the unpacked pull
+kernel under the same seeds — single-device AND sharded — plus exact
+message accounting and coverage agreement."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossip_tpu import config as C
+from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
+from gossip_tpu.models.si import coverage, make_si_round
+from gossip_tpu.models.si_packed import (
+    init_packed_state, make_packed_round, simulate_until_packed)
+from gossip_tpu.models.state import alive_mask, init_state
+from gossip_tpu.ops.bitpack import coverage_packed, pack, unpack
+from gossip_tpu.parallel.sharded import make_mesh
+from gossip_tpu.parallel.sharded_packed import (
+    init_sharded_packed_state, make_sharded_packed_round,
+    simulate_until_packed_sharded)
+from gossip_tpu.topology import generators as G
+
+
+@pytest.mark.parametrize("r", [1, 3, 32, 33, 100])
+def test_pack_unpack_roundtrip(r):
+    key = jax.random.key(r)
+    seen = jax.random.bernoulli(key, 0.3, (57, r))
+    np.testing.assert_array_equal(np.asarray(unpack(pack(seen), r)),
+                                  np.asarray(seen))
+
+
+@pytest.mark.parametrize("r", [1, 31, 64])
+def test_coverage_packed_matches_unpacked(r):
+    key = jax.random.key(r + 7)
+    seen = jax.random.bernoulli(key, 0.4, (200, r))
+    alive = jax.random.bernoulli(jax.random.key(1), 0.9, (200,))
+    for a in (None, alive):
+        cp = float(coverage_packed(pack(seen), r, a))
+        cu = float(coverage(seen, a))
+        assert cp == pytest.approx(cu, abs=1e-6)
+
+
+CASES = [
+    ("pull-complete", ProtocolConfig(mode=C.PULL, fanout=2, rumors=40),
+     lambda: G.complete(96), None),
+    ("pull-er-fault", ProtocolConfig(mode=C.PULL, fanout=1, rumors=5),
+     lambda: G.erdos_renyi(96, 0.1, seed=3),
+     FaultConfig(node_death_rate=0.1, drop_prob=0.2, seed=7)),
+    ("antientropy", ProtocolConfig(mode=C.ANTI_ENTROPY, fanout=1, rumors=2,
+                                   period=3),
+     lambda: G.watts_strogatz(96, 4, 0.2, seed=1), None),
+]
+
+
+@pytest.mark.parametrize("name,proto,topo_fn,fault", CASES,
+                         ids=[c[0] for c in CASES])
+def test_packed_bitwise_equals_unpacked(name, proto, topo_fn, fault):
+    topo = topo_fn()
+    run = RunConfig(seed=11)
+    rounds = 6
+    ustep = jax.jit(make_si_round(proto, topo, fault, run.origin))
+    ust = init_state(run, proto, topo.n)
+    pstep = jax.jit(make_packed_round(proto, topo, fault, run.origin))
+    pst = init_packed_state(run, proto, topo.n)
+    for _ in range(rounds):
+        ust = ustep(ust)
+        pst = pstep(pst)
+    np.testing.assert_array_equal(
+        np.asarray(unpack(pst.seen, proto.rumors)), np.asarray(ust.seen))
+    assert float(pst.msgs) == pytest.approx(float(ust.msgs))
+
+
+@pytest.mark.parametrize("name,proto,topo_fn,fault", CASES,
+                         ids=[c[0] for c in CASES])
+def test_sharded_packed_bitwise_parity(name, proto, topo_fn, fault):
+    topo = topo_fn()
+    run = RunConfig(seed=11)
+    mesh = make_mesh(8)
+    rounds = 6
+    pstep = jax.jit(make_packed_round(proto, topo, fault, run.origin))
+    pst = init_packed_state(run, proto, topo.n)
+    sstep = jax.jit(make_sharded_packed_round(proto, topo, mesh, fault,
+                                              run.origin))
+    sst = init_sharded_packed_state(run, proto, topo, mesh)
+    for _ in range(rounds):
+        pst = pstep(pst)
+        sst = sstep(sst)
+    np.testing.assert_array_equal(np.asarray(sst.seen)[:topo.n],
+                                  np.asarray(pst.seen))
+    assert float(sst.msgs) == pytest.approx(float(pst.msgs))
+
+
+def test_simulate_until_packed_converges():
+    proto = ProtocolConfig(mode=C.PULL, fanout=1, rumors=33)
+    rounds, cov, msgs, final = simulate_until_packed(
+        proto, G.complete(2000), RunConfig(max_rounds=64))
+    assert cov >= 0.99
+    assert 0 < rounds < 40
+    assert msgs > 0
+    # sharded twin reaches the same rounds count
+    mesh = make_mesh(8)
+    r2, cov2, msgs2, _ = simulate_until_packed_sharded(
+        proto, G.complete(2000), RunConfig(max_rounds=64), mesh)
+    assert r2 == rounds
+    assert cov2 == pytest.approx(cov)   # reduction order differs slightly
+    assert msgs2 == pytest.approx(msgs)
+
+
+def test_packed_rejects_push_modes():
+    with pytest.raises(ValueError, match="pull/antientropy"):
+        make_packed_round(ProtocolConfig(mode=C.PUSH), G.complete(64))
+    with pytest.raises(ValueError, match="pull/antientropy"):
+        make_sharded_packed_round(ProtocolConfig(mode=C.PUSH_PULL),
+                                  G.complete(64), make_mesh(2))
