@@ -71,7 +71,8 @@ def run_gonative(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
     the reference implements; SURVEY.md §2).  Faults map to partitions is
     not supported here: the event sim exposes explicit partition windows via
     its own API for targeted tests."""
-    from gossip_tpu.runtime.gonative import GoNativeSim, topology_from_table
+    from gossip_tpu.runtime.gonative import topology_from_table
+    from gossip_tpu.runtime.native_sim import make_event_sim
     if tc.n > _GONATIVE_MAX_NODES:
         raise ValueError(
             f"go-native backend capped at {_GONATIVE_MAX_NODES} nodes "
@@ -87,7 +88,9 @@ def run_gonative(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
             "not per-round masks")
     topo = _build_topology(tc, for_gonative=True)
     t0 = time.perf_counter()
-    sim = GoNativeSim(topology_from_table(topo))
+    # C++ event core when a compiler is present (equivalence proven in
+    # tests/test_native.py), pure Python otherwise
+    sim = make_event_sim(topology_from_table(topo))
     for r in range(proto.rumors):
         sim.broadcast(origin=(run.origin + r) % tc.n, message=r)
     sim.run()
@@ -97,17 +100,24 @@ def run_gonative(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
     curve = [min(c[h] for c in curves) for h in range(max_h + 1)]
     hops = next((h for h in range(max_h + 1)
                  if curve[h] >= run.target_coverage), -1)
-    final_cov = min(
-        sum(1 for i in range(tc.n) if r in sim.nodes[i].seen) / tc.n
-        for r in range(proto.rumors))
+    # one log read per node, all rumors tested against that one set (the
+    # native engine's .seen property marshals the whole log per access)
+    holders = [0] * proto.rumors
+    for i in range(tc.n):
+        seen_i = set(sim.read(i))
+        for r in range(proto.rumors):
+            if r in seen_i:
+                holders[r] += 1
+    final_cov = min(h / tc.n for h in holders)
     return RunReport(
         backend="go-native", mode="flood", n=tc.n,
         rounds=hops, coverage=final_cov, msgs=float(sim.msgs_sent),
         wall_s=round(wall, 4),
         curve=curve[1:] if want_curve else None,
         meta={"clock": "hop-depth", "sim_time_s": sim.now,
-              "deliveries": len(sim.deliveries),
-              "msgs_counts": "requests+acks"})
+              "deliveries": sim.delivery_count(),
+              "msgs_counts": "requests+acks",
+              "engine": type(sim).__name__})
 
 
 def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,

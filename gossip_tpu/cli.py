@@ -58,6 +58,8 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                    help="mesh size for node-dim sharding (jax-tpu)")
     p.add_argument("--curve", action="store_true",
                    help="include the per-round coverage curve")
+    p.add_argument("--save-curve", default=None, metavar="PATH",
+                   help="write the coverage curve as JSONL (implies --curve)")
     p.add_argument("--swim-subjects", type=int, default=8)
     p.add_argument("--swim-proxies", type=int, default=3)
     p.add_argument("--swim-suspect-rounds", type=int, default=0,
@@ -89,9 +91,18 @@ def _args_to_configs(a):
 def cmd_run(a) -> int:
     from gossip_tpu.backend import run_simulation
     proto, tc, run, fault, mesh = _args_to_configs(a)
+    want_curve = a.curve or bool(a.save_curve)
     report = run_simulation(a.backend, proto, tc, run, fault, mesh,
-                            want_curve=a.curve)
-    print(json.dumps(report.to_dict()))
+                            want_curve=want_curve)
+    out = report.to_dict()
+    if a.save_curve:
+        from gossip_tpu.utils.metrics import dump_curve_jsonl
+        meta = dict(out)
+        curve = meta.pop("curve")
+        dump_curve_jsonl(a.save_curve, curve, meta=meta)
+        if not a.curve:          # curve went to the file, not the report
+            out["curve"] = None
+    print(json.dumps(out))
     return 0
 
 

@@ -33,7 +33,7 @@ from gossip_tpu import config as C
 from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
 from gossip_tpu.models import si as si_mod
 from gossip_tpu.models.state import SimState, alive_mask, init_state
-from gossip_tpu.ops.bitpack import coverage_packed, n_words, pack
+from gossip_tpu.ops.bitpack import coverage_packed, pack
 from gossip_tpu.ops.sampling import apply_drop, sample_peers
 from gossip_tpu.topology.generators import Topology
 

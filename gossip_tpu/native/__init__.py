@@ -1,0 +1,87 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Build on demand with the in-tree Makefile (g++ only — no pybind11 in this
+environment; the Python<->C boundary is a flat C API).  ``load_eventsim()``
+returns the shared library handle or None when no compiler is available —
+callers fall back to the pure-Python implementation.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libeventsim.so")
+_SRC = os.path.join(_DIR, "eventsim.cpp")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def load_eventsim() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the event-sim core; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        fresh = (os.path.exists(_SO)
+                 and os.path.getmtime(_SO) >= os.path.getmtime(_SRC))
+        if not fresh and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            # stale/truncated/wrong-arch .so (e.g. an interrupted build
+            # left a fresh mtime): rebuild once, else fall back to Python
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(_SO)
+            except OSError:
+                return None
+        c = ctypes
+        lib.gsim_create.restype = c.c_void_p
+        lib.gsim_create.argtypes = [c.c_int32]
+        lib.gsim_destroy.argtypes = [c.c_void_p]
+        lib.gsim_config.argtypes = [c.c_void_p, c.c_double, c.c_double,
+                                    c.c_double, c.c_int32, c.c_int32,
+                                    c.c_double]
+        lib.gsim_set_neighbors.argtypes = [c.c_void_p, c.c_int32,
+                                           c.POINTER(c.c_int32), c.c_int32]
+        lib.gsim_partition.argtypes = [c.c_void_p, c.c_int32, c.c_int32,
+                                       c.c_double, c.c_double]
+        lib.gsim_broadcast.argtypes = [c.c_void_p, c.c_int32, c.c_int64,
+                                       c.c_double]
+        lib.gsim_run.argtypes = [c.c_void_p, c.c_double]
+        lib.gsim_msgs_sent.restype = c.c_int64
+        lib.gsim_msgs_sent.argtypes = [c.c_void_p]
+        lib.gsim_now.restype = c.c_double
+        lib.gsim_now.argtypes = [c.c_void_p]
+        lib.gsim_read_len.restype = c.c_int32
+        lib.gsim_read_len.argtypes = [c.c_void_p, c.c_int32]
+        lib.gsim_read.argtypes = [c.c_void_p, c.c_int32,
+                                  c.POINTER(c.c_int64)]
+        lib.gsim_min_hop.restype = c.c_int32
+        lib.gsim_min_hop.argtypes = [c.c_void_p, c.c_int32, c.c_int64]
+        lib.gsim_delivery_count.restype = c.c_int32
+        lib.gsim_delivery_count.argtypes = [c.c_void_p]
+        lib.gsim_deliveries.argtypes = [c.c_void_p, c.POINTER(c.c_double),
+                                        c.POINTER(c.c_int32),
+                                        c.POINTER(c.c_int64),
+                                        c.POINTER(c.c_int32)]
+        _lib = lib
+        return _lib

@@ -216,6 +216,11 @@ class GoNativeSim:
         """The `read` handler: ordered log snapshot (main.go:123-130)."""
         return list(self.nodes[node].log)
 
+    def delivery_count(self) -> int:
+        """First-receipt count (cheap on both engines — the native core's
+        ``deliveries`` property marshals full arrays)."""
+        return len(self.deliveries)
+
     def hop_depths(self, message: int) -> Dict[int, int]:
         """Min hop over all arrivals per node (>= BFS distance; == on
         race-free graphs — see the parity-clock note in the module doc)."""

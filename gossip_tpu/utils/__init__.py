@@ -1,0 +1,2 @@
+"""Metrics, checkpointing, and tracing (SURVEY.md §5 auxiliary subsystems —
+all absent from the reference, all first-class here)."""

@@ -1,0 +1,87 @@
+"""Metrics and observability: what the reference never had.
+
+The reference's only instrumentation is ``log.Fatal`` on exit; every
+latency/msgs-per-op number came from the external Maelstrom checker
+(SURVEY.md §5).  This module makes the framework's own metrics first-class
+(BASELINE.md tracked metrics):
+
+  * rounds-to-target-coverage,
+  * simulated node-rounds/sec/chip,
+  * messages-per-round / messages-per-op,
+  * convergence-curve artifacts (JSONL dumps, curve-gap comparison — the
+    parity deliverable between backends).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional, Sequence
+
+
+@dataclasses.dataclass
+class ConvergenceMetrics:
+    """Summary of one run's coverage curve."""
+
+    rounds_to_target: int          # -1 if never reached
+    final_coverage: float
+    auc: float                     # mean coverage over rounds (higher=faster)
+    msgs_total: float
+    msgs_per_node_per_round: float
+    node_rounds_per_sec: Optional[float] = None   # None without timing
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def summarize_curve(coverage: Sequence[float], msgs: Sequence[float],
+                    n: int, target: float = 0.99,
+                    wall_s: Optional[float] = None,
+                    n_chips: int = 1) -> ConvergenceMetrics:
+    cov = list(map(float, coverage))
+    rounds = len(cov)
+    hit = next((i + 1 for i, c in enumerate(cov) if c >= target), -1)
+    msgs_total = float(msgs[-1]) if len(msgs) else 0.0
+    rate = None
+    if wall_s and wall_s > 0:
+        rate = n * rounds / wall_s / n_chips
+    return ConvergenceMetrics(
+        rounds_to_target=hit,
+        final_coverage=cov[-1] if cov else 0.0,
+        auc=sum(cov) / rounds if rounds else 0.0,
+        msgs_total=msgs_total,
+        msgs_per_node_per_round=(msgs_total / (n * rounds)) if rounds else 0.0,
+        node_rounds_per_sec=rate,
+    )
+
+
+def curve_gap(a: Sequence[float], b: Sequence[float]) -> float:
+    """Max absolute coverage gap between two curves (padded with their
+    final values) — the backend-parity artifact: the jax-tpu flood curve vs
+    the go-native hop curve should gap to ~0 on race-free graphs
+    (runtime/gonative.py parity contract)."""
+    la, lb = list(map(float, a)), list(map(float, b))
+    m = max(len(la), len(lb))
+    la += [la[-1]] * (m - len(la)) if la else [0.0] * m
+    lb += [lb[-1]] * (m - len(lb)) if lb else [0.0] * m
+    return max(abs(x - y) for x, y in zip(la, lb))
+
+
+def dump_curve_jsonl(path: str, coverage: Sequence[float],
+                     msgs: Optional[Sequence[float]] = None,
+                     meta: Optional[dict] = None) -> None:
+    """One JSON object per round: {round, coverage, msgs?} with an optional
+    leading meta line ({"meta": ...}) — trivially greppable/plottable."""
+    with open(path, "w") as f:
+        if meta is not None:
+            f.write(json.dumps({"meta": meta}) + "\n")
+        for i, c in enumerate(coverage):
+            row = {"round": i + 1, "coverage": float(c)}
+            if msgs is not None:
+                row["msgs"] = float(msgs[i])
+            f.write(json.dumps(row) + "\n")
+
+
+def load_curve_jsonl(path: str) -> List[dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]

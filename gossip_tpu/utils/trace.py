@@ -1,0 +1,55 @@
+"""Profiling hooks: jax.profiler wrappers for round-level tracing.
+
+The reference has no tracing at all (SURVEY.md §5).  These helpers wrap
+``jax.profiler`` so any driver can capture an XLA trace viewable in
+TensorBoard / Perfetto (`trace(...)`) or annotate host-side phases
+(`annotate(...)`) without importing profiler plumbing everywhere.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace of the enclosed block into ``logdir``
+    (TensorBoard's profile plugin / Perfetto read it)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region inside an active trace (host + device timeline)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class RoundTimer:
+    """Wall-clock per-round timing for python-driven loops (the scan/while
+    drivers time whole programs instead — this is for stepwise drivers like
+    utils/checkpoint.run_with_checkpoints)."""
+
+    def __init__(self):
+        self.times: list = []
+        self._t0: Optional[float] = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.times.append(time.perf_counter() - self._t0)
+        return False
+
+    @property
+    def mean_ms(self) -> float:
+        return 1e3 * sum(self.times) / max(1, len(self.times))

@@ -1,0 +1,108 @@
+"""Checkpoint/resume, metrics, and tracing (SURVEY.md §5 subsystems)."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from gossip_tpu.config import ProtocolConfig, RunConfig
+from gossip_tpu.models.si import make_si_round
+from gossip_tpu.models.state import init_state
+from gossip_tpu.models.swim import init_swim_state, make_swim_round
+from gossip_tpu.topology import generators as G
+from gossip_tpu.utils.checkpoint import (load_state, run_with_checkpoints,
+                                         save_state)
+from gossip_tpu.utils.metrics import (curve_gap, dump_curve_jsonl,
+                                      load_curve_jsonl, summarize_curve)
+from gossip_tpu.utils.trace import RoundTimer, annotate, trace
+
+
+def test_checkpoint_resume_is_bitwise_identical(tmp_path):
+    # resume == straight run, bitwise — the PRNG key survives the npz trip
+    proto = ProtocolConfig(mode="pushpull", fanout=1, rumors=3)
+    topo = G.erdos_renyi(128, 0.08, seed=2)
+    step = jax.jit(make_si_round(proto, topo))
+    st = init_state(RunConfig(seed=9), proto, topo.n)
+    for _ in range(4):
+        st = step(st)
+    p = str(tmp_path / "ck.npz")
+    save_state(p, st)
+    resumed = load_state(p)
+    a, b = st, resumed
+    for _ in range(4):
+        a = step(a)
+        b = step(b)
+    np.testing.assert_array_equal(np.asarray(a.seen), np.asarray(b.seen))
+    assert float(a.msgs) == float(b.msgs)
+    assert int(a.round) == int(b.round)
+
+
+def test_checkpoint_swim_state(tmp_path):
+    proto = ProtocolConfig(mode="swim", fanout=2, swim_subjects=4,
+                           swim_proxies=2, swim_suspect_rounds=4)
+    step = jax.jit(make_swim_round(proto, 64, dead_nodes=(1,), fail_round=2))
+    st = init_swim_state(64, 4, seed=3)
+    for _ in range(6):
+        st = step(st)
+    p = str(tmp_path / "swim.npz")
+    save_state(p, st)
+    r = load_state(p)
+    np.testing.assert_array_equal(np.asarray(st.wire), np.asarray(r.wire))
+    a, b = step(st), step(r)
+    np.testing.assert_array_equal(np.asarray(a.wire), np.asarray(b.wire))
+
+
+def test_run_with_checkpoints_writes_and_resumes(tmp_path):
+    proto = ProtocolConfig(mode="pull", fanout=1)
+    topo = G.complete(128)
+    step = jax.jit(make_si_round(proto, topo))
+    st0 = init_state(RunConfig(seed=1), proto, topo.n)
+    p = str(tmp_path / "run.npz")
+    final = run_with_checkpoints(step, st0, rounds=7, path=p, every=3)
+    assert os.path.exists(p)
+    assert int(load_state(p).round) == int(final.round) == 7
+    # continue from disk for 3 more == straight 10
+    more = run_with_checkpoints(step, load_state(p), rounds=3, path=p)
+    straight = st0
+    for _ in range(10):
+        straight = step(straight)
+    np.testing.assert_array_equal(np.asarray(more.seen),
+                                  np.asarray(straight.seen))
+
+
+def test_summarize_curve_and_gap():
+    cov = [0.1, 0.5, 0.995, 1.0]
+    msgs = [10, 30, 60, 80]
+    m = summarize_curve(cov, msgs, n=100, target=0.99, wall_s=2.0)
+    assert m.rounds_to_target == 3
+    assert m.final_coverage == 1.0
+    assert m.msgs_total == 80
+    assert m.msgs_per_node_per_round == pytest.approx(80 / 400)
+    assert m.node_rounds_per_sec == pytest.approx(100 * 4 / 2.0)
+    assert curve_gap(cov, cov) == 0.0
+    assert curve_gap([0.5, 1.0], [0.4, 1.0, 1.0]) == pytest.approx(0.1)
+    assert m.to_dict()["auc"] == pytest.approx(sum(cov) / 4)
+
+
+def test_curve_jsonl_round_trip(tmp_path):
+    p = str(tmp_path / "curve.jsonl")
+    dump_curve_jsonl(p, [0.5, 1.0], [3, 7], meta={"mode": "pull"})
+    rows = load_curve_jsonl(p)
+    assert rows[0] == {"meta": {"mode": "pull"}}
+    assert rows[1] == {"round": 1, "coverage": 0.5, "msgs": 3.0}
+    assert rows[2]["coverage"] == 1.0
+
+
+def test_trace_smoke(tmp_path):
+    with trace(str(tmp_path / "prof")):
+        with annotate("round"):
+            jax.block_until_ready(jax.numpy.arange(8) * 2)
+    # trace files land under the logdir
+    assert any(os.scandir(str(tmp_path / "prof")))
+    t = RoundTimer()
+    for _ in range(2):
+        with t:
+            pass
+    assert len(t.times) == 2 and t.mean_ms >= 0
