@@ -60,6 +60,9 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                    help="include the per-round coverage curve")
     p.add_argument("--save-curve", default=None, metavar="PATH",
                    help="write the coverage curve as JSONL (implies --curve)")
+    p.add_argument("--ensemble", type=int, default=0, metavar="S",
+                   help="run S seeds as one vmapped batch and report "
+                        "ensemble statistics (jax-tpu, non-swim)")
     p.add_argument("--swim-subjects", type=int, default=8)
     p.add_argument("--swim-proxies", type=int, default=3)
     p.add_argument("--swim-suspect-rounds", type=int, default=0,
@@ -91,6 +94,33 @@ def _args_to_configs(a):
 def cmd_run(a) -> int:
     from gossip_tpu.backend import run_simulation
     proto, tc, run, fault, mesh = _args_to_configs(a)
+    if a.ensemble > 1:
+        if a.backend != "jax-tpu" or a.mode == "swim":
+            print("error: --ensemble needs the jax-tpu backend and a "
+                  "non-swim mode", file=sys.stderr)
+            return 2
+        from gossip_tpu.parallel.sweep import ensemble_curves
+        from gossip_tpu.topology import generators as G
+        ens = ensemble_curves(proto, G.build(tc), run,
+                              [run.seed + i for i in range(a.ensemble)],
+                              fault)
+        out = {"ensemble": ens.summary(), "mode": a.mode, "n": tc.n,
+               "backend": a.backend}
+        if a.save_curve:
+            # per-round ensemble band: mean / min / max over seeds
+            from gossip_tpu.utils.metrics import dump_curve_jsonl
+            import numpy as np
+            dump_curve_jsonl(a.save_curve, ens.curves.mean(axis=0),
+                             meta={**out, "band_min":
+                                   np.round(ens.curves.min(axis=0), 6
+                                            ).tolist(),
+                                   "band_max":
+                                   np.round(ens.curves.max(axis=0), 6
+                                            ).tolist()})
+        if a.curve:
+            out["curve_mean"] = [float(c) for c in ens.curves.mean(axis=0)]
+        print(json.dumps(out))
+        return 0
     want_curve = a.curve or bool(a.save_curve)
     report = run_simulation(a.backend, proto, tc, run, fault, mesh,
                             want_curve=want_curve)

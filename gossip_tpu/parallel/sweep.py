@@ -1,0 +1,91 @@
+"""Ensemble sweeps: the data-parallel axis (SURVEY.md §2.3 "DP").
+
+The reference runs one stochastic trajectory per process launch; asking
+"how many rounds does this protocol *typically* take?" means re-running the
+binary N times.  Here the trajectory ensemble is one ``vmap`` axis: S seeds
+run the same jitted round step as a single batched XLA program, so ensemble
+statistics (median/quantiles of rounds-to-target, curve bands) cost one
+compile and one device pass.  On a mesh this is the second axis of the
+north star ("multi-config sweep on a second mesh axis"); single-device it
+is plain vmap.
+
+Scope: seed ensembles share one (protocol, topology, fault) config — the
+round step is closed over statics, so sweeping *structural* config (mode,
+topology family) stays a python loop over compiles (see cli.cmd_sweep).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
+from gossip_tpu.models.si import coverage, make_si_round
+from gossip_tpu.models.state import SimState, alive_mask, init_state
+from gossip_tpu.topology.generators import Topology
+
+
+@dataclasses.dataclass
+class EnsembleResult:
+    curves: np.ndarray          # float32[S, T] coverage per seed per round
+    msgs: np.ndarray            # float32[S, T]
+    rounds_to_target: np.ndarray  # int[S], -1 where never reached
+    target: float
+
+    @property
+    def converged(self) -> np.ndarray:
+        return self.rounds_to_target >= 0
+
+    def summary(self) -> dict:
+        r = self.rounds_to_target[self.converged]
+        return {
+            "seeds": int(len(self.rounds_to_target)),
+            "converged": int(self.converged.sum()),
+            "rounds_mean": float(r.mean()) if len(r) else None,
+            "rounds_std": float(r.std()) if len(r) else None,
+            "rounds_p50": float(np.median(r)) if len(r) else None,
+            "rounds_p95": float(np.percentile(r, 95)) if len(r) else None,
+            "final_coverage_mean": float(self.curves[:, -1].mean()),
+            "msgs_mean": float(self.msgs[:, -1].mean()),
+            "target": self.target,
+        }
+
+
+def ensemble_curves(proto: ProtocolConfig, topo: Topology, run: RunConfig,
+                    seeds: Sequence[int],
+                    fault: Optional[FaultConfig] = None) -> EnsembleResult:
+    """Run |seeds| independent trajectories as ONE batched XLA program."""
+    step = make_si_round(proto, topo, fault, run.origin)
+    alive = alive_mask(fault, topo.n, run.origin)
+    base = init_state(run, proto, topo.n)
+    keys = jax.vmap(jax.random.key)(jnp.asarray(list(seeds), jnp.uint32))
+    s = len(seeds)
+    init = SimState(
+        seen=jnp.broadcast_to(base.seen, (s,) + base.seen.shape),
+        round=jnp.zeros((s,), jnp.int32),
+        base_key=keys,
+        msgs=jnp.zeros((s,), jnp.float32),
+    )
+
+    @jax.jit
+    def scan(states):
+        def body(st, _):
+            st = jax.vmap(step)(st)
+            covs = jax.vmap(lambda x: coverage(x.seen, alive))(st)
+            return st, (covs, st.msgs)
+        return jax.lax.scan(body, states, None, length=run.max_rounds)
+
+    _, (covs, msgs) = scan(init)
+    curves = np.asarray(covs).T          # [S, T]
+    msgs_t = np.asarray(msgs).T
+    hit = np.full(s, -1, np.int64)
+    reached = curves >= run.target_coverage
+    any_hit = reached.any(axis=1)
+    hit[any_hit] = reached[any_hit].argmax(axis=1) + 1
+    return EnsembleResult(curves=curves, msgs=msgs_t,
+                          rounds_to_target=hit,
+                          target=run.target_coverage)

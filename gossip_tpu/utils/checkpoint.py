@@ -62,8 +62,12 @@ def load_state(path: str) -> State:
         kwargs = {}
         for name in meta["fields"]:
             if name == meta["key_field"]:
+                # rewrap under the impl the checkpoint was SAVED with — the
+                # loading process may default to a different PRNG impl
+                # (e.g. rbg on TPU), which would silently change the
+                # resumed trajectory
                 kwargs[name] = jax.random.wrap_key_data(
-                    jax.numpy.asarray(z[name]))
+                    jax.numpy.asarray(z[name]), impl=meta["key_impl"])
             else:
                 kwargs[name] = jax.numpy.asarray(z[name])
     return cls(**kwargs)

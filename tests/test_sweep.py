@@ -1,0 +1,49 @@
+"""Ensemble sweep (the DP/vmap axis, SURVEY.md §2.3)."""
+
+import numpy as np
+import pytest
+
+from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
+from gossip_tpu.parallel.sweep import ensemble_curves
+from gossip_tpu.runtime.simulator import simulate_curve
+from gossip_tpu.topology import generators as G
+
+
+def test_ensemble_matches_individual_runs():
+    # the vmapped batch must reproduce each seed's solo trajectory exactly
+    proto = ProtocolConfig(mode="pushpull", fanout=1)
+    topo = G.erdos_renyi(200, 0.05, seed=1)
+    run = RunConfig(max_rounds=16)
+    seeds = [3, 11, 42]
+    ens = ensemble_curves(proto, topo, run, seeds)
+    for i, seed in enumerate(seeds):
+        solo = simulate_curve(proto, topo,
+                              RunConfig(max_rounds=16, seed=seed))
+        np.testing.assert_allclose(ens.curves[i], solo.coverage, atol=1e-6)
+        np.testing.assert_allclose(ens.msgs[i], solo.msgs)
+
+
+def test_ensemble_summary_statistics():
+    proto = ProtocolConfig(mode="push", fanout=2)
+    topo = G.complete(256)
+    run = RunConfig(max_rounds=32, target_coverage=0.99)
+    ens = ensemble_curves(proto, topo, run, list(range(8)))
+    s = ens.summary()
+    assert s["seeds"] == 8 and s["converged"] == 8
+    assert 3 <= s["rounds_p50"] <= 20
+    assert s["rounds_p95"] >= s["rounds_p50"]
+    assert ens.converged.all()
+    # seeds genuinely differ
+    assert len({int(r) for r in ens.rounds_to_target}) >= 1
+    assert (np.diff(ens.curves, axis=1) >= -1e-6).all()   # monotone
+
+
+def test_ensemble_with_faults_some_may_stall():
+    proto = ProtocolConfig(mode="push", fanout=1)
+    topo = G.ring(64, 2)
+    fault = FaultConfig(node_death_rate=0.2, seed=5)
+    run = RunConfig(max_rounds=8, target_coverage=1.0)
+    ens = ensemble_curves(proto, topo, run, [0, 1], fault)
+    # a ring with 20% dead nodes cannot reach full alive-coverage in 8
+    # rounds from one origin; -1 entries must be well-formed
+    assert set(ens.rounds_to_target) <= {-1} | set(range(1, 9))
