@@ -1,0 +1,155 @@
+"""Halo exchange: ``ppermute`` rounds for band-limited topologies.
+
+This is the framework's sequence-parallelism analog (SURVEY.md §5: the
+scaled long dimension is *nodes*, not tokens).  The general sharded kernels
+(parallel/sharded.py) ``all_gather`` the whole digest table every round —
+O(N) ICI traffic, unavoidable for topologies whose edges go anywhere
+(complete, ER, power-law).  But **band-limited** graphs (rings, 2-D grids
+in row-major order, unrewired Watts–Strogatz lattices — exactly the shapes
+Maelstrom hands the reference) have every edge within circular distance B
+of its source, so a contiguously-sharded node axis only ever reads rows
+within B of its block boundary.  One ``lax.ppermute`` to each mesh neighbor
+moves those 2B halo rows — O(B) traffic instead of O(N), the same
+neighbor-exchange pattern ring attention uses for sequence blocks.
+
+At the BASELINE scale: a k=6 ring at 10M nodes on 8 shards all-gathers
+10 MB/round in the general kernel; the halo kernel moves 2x3 rows = bytes.
+
+Constraints (checked, not assumed): an explicit neighbor table, band(topo)
+<= rows-per-shard (halo must come from the *immediate* mesh neighbors),
+and n divisible by the mesh size (contiguous blocks, no padding zone in
+the circular index math).  Results are bitwise identical to the
+single-device kernels — tests/test_halo.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gossip_tpu import config as C
+from gossip_tpu.config import FaultConfig, ProtocolConfig
+from gossip_tpu.models import si as si_mod
+from gossip_tpu.models.state import SimState, alive_mask
+from gossip_tpu.ops.sampling import apply_drop, drop_mask, sample_peers
+from gossip_tpu.topology.generators import Topology
+
+
+def band_of(topo: Topology) -> int:
+    """Max circular edge distance (host-side, one-time).  B such that every
+    edge (i, j) has min(|i-j|, n-|i-j|) <= B."""
+    if topo.implicit:
+        raise ValueError("band is undefined for the implicit complete graph")
+    nbrs = np.asarray(topo.nbrs)
+    deg = np.asarray(topo.deg)
+    n = topo.n
+    rows = np.repeat(np.arange(n), nbrs.shape[1])
+    flat = nbrs.reshape(-1)
+    valid = flat < n
+    mask_cols = (np.arange(nbrs.shape[1])[None, :] < deg[:, None]).reshape(-1)
+    use = valid & mask_cols
+    d = np.abs(flat[use] - rows[use])
+    return int(np.minimum(d, n - d).max()) if d.size else 0
+
+
+def _exchange_halos(visible_l: jax.Array, band: int,
+                    axis_name: str) -> jax.Array:
+    """[nl, R] -> [nl + 2B, R]: prepend the left neighbor's last B rows,
+    append the right neighbor's first B rows (both rings of the mesh)."""
+    p = jax.lax.axis_size(axis_name)
+    right = [(i, (i + 1) % p) for i in range(p)]   # data flows rightward
+    left = [(i, (i - 1) % p) for i in range(p)]
+    from_left = jax.lax.ppermute(visible_l[-band:], axis_name, right)
+    from_right = jax.lax.ppermute(visible_l[:band], axis_name, left)
+    return jnp.concatenate([from_left, visible_l, from_right], axis=0)
+
+
+def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
+                    fault: Optional[FaultConfig] = None, origin: int = 0,
+                    axis_name: str = "nodes"
+                    ) -> Callable[[SimState], SimState]:
+    """FLOOD or PULL round with O(band) cross-shard traffic.
+
+    Semantically identical to the general sharded kernels and to the
+    single-device kernels; only the communication pattern differs."""
+    n, k = topo.n, proto.fanout
+    mode = proto.mode
+    if mode not in (C.FLOOD, C.PULL):
+        raise ValueError(f"halo rounds support flood/pull, got {mode!r}")
+    if topo.implicit:
+        raise ValueError("halo exchange needs an explicit neighbor table")
+    p = mesh.shape[axis_name]
+    if n % p != 0:
+        raise ValueError(f"halo rounds need n % mesh size == 0 "
+                         f"(n={n}, mesh={p}); pad the topology instead")
+    nl = n // p
+    band = band_of(topo)
+    if band > nl:
+        raise ValueError(
+            f"band {band} exceeds rows/shard {nl}: edges span non-adjacent "
+            "shards — use the all_gather kernels (parallel/sharded.py)")
+    band = max(band, 1)            # ppermute of 0 rows is degenerate
+    drop_prob = 0.0 if fault is None else fault.drop_prob
+    alive = alive_mask(fault, n, origin)
+    alive_full = (jnp.ones((n,), jnp.bool_) if alive is None else alive)
+
+    def local_round(seen_l, round_, base_key, msgs, alive_l, nbrs_l, deg_l):
+        shard = jax.lax.axis_index(axis_name)
+        gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
+        rkey = jax.random.fold_in(base_key, round_)
+        visible = seen_l & alive_l[:, None]
+        ext = _exchange_halos(visible, band, axis_name)   # [nl+2B, R]
+        base = shard * nl - band
+        msgs_local = jnp.float32(0.0)
+
+        def to_ext(idx):
+            # global id -> extended-local row; circular, exact because every
+            # needed id is within B of this block (mod n)
+            return jnp.mod(idx - base, n)
+
+        if mode == C.FLOOD:
+            nbrs_use = nbrs_l
+            if drop_prob > 0.0:
+                dropped = drop_mask(rkey, si_mod.FLOOD_DROP_TAG, gids,
+                                    nbrs_use.shape[1], drop_prob)
+                nbrs_use = jnp.where(dropped, jnp.int32(n), nbrs_use)
+            valid = nbrs_use < n
+            got = ext[jnp.where(valid, to_ext(nbrs_use), 0)]
+            delta = jnp.any(got & valid[:, :, None], axis=1)
+            sender_active = jnp.any(visible, axis=1)
+            msgs_local = jnp.sum(
+                jnp.where(sender_active, deg_l, 0)).astype(jnp.float32)
+        else:  # PULL from the banded neighbor table
+            qkey = jax.random.fold_in(rkey, si_mod.PULL_TAG)
+            partners = sample_peers(qkey, gids, topo, k, proto.exclude_self,
+                                    local_nbrs=nbrs_l, local_deg=deg_l)
+            partners = apply_drop(rkey, si_mod.PULL_DROP_TAG, gids,
+                                  partners, drop_prob, n)
+            valid = partners < n
+            got = ext[jnp.where(valid, to_ext(partners), 0)]
+            delta = jnp.any(got & valid[:, :, None], axis=1)
+            req = jnp.where(alive_l[:, None], partners, n)
+            msgs_local = 2.0 * jnp.sum(req < n).astype(jnp.float32)
+
+        delta = delta & alive_l[:, None]
+        msgs_new = msgs + jax.lax.psum(msgs_local, axis_name)
+        return seen_l | delta, msgs_new
+
+    sh2 = P(axis_name, None)
+    rep = P()
+    mapped = jax.shard_map(
+        local_round, mesh=mesh,
+        in_specs=(sh2, rep, rep, rep, P(axis_name), sh2, P(axis_name)),
+        out_specs=(sh2, rep))
+
+    def step(state: SimState) -> SimState:
+        seen, msgs = mapped(state.seen, state.round, state.base_key,
+                            state.msgs, alive_full, topo.nbrs, topo.deg)
+        return SimState(seen=seen, round=state.round + 1,
+                        base_key=state.base_key, msgs=msgs)
+
+    return step

@@ -1,0 +1,90 @@
+"""Halo-exchange (ppermute) rounds == single-device kernels, bitwise.
+
+The O(band) communication pattern must never change results — only
+traffic.  Cases cover flood and pull on every band-limited family, with
+drops and deaths, plus the constraint errors."""
+
+import jax
+import numpy as np
+import pytest
+
+from gossip_tpu import config as C
+from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
+from gossip_tpu.models.si import make_si_round
+from gossip_tpu.models.state import init_state
+from gossip_tpu.parallel.halo import band_of, make_halo_round
+from gossip_tpu.parallel.sharded import (init_sharded_state, make_mesh)
+from gossip_tpu.topology import generators as G
+
+
+def test_band_of():
+    assert band_of(G.ring(64, 4)) == 2
+    assert band_of(G.ring(64, 6)) == 3
+    assert band_of(G.grid2d(8, 8)) == 8
+    ws = G.watts_strogatz(64, 4, beta=0.0, seed=0)   # unrewired lattice
+    assert band_of(ws) == 2
+    with pytest.raises(ValueError, match="undefined"):
+        band_of(G.complete(16))
+
+
+CASES = [
+    ("flood-ring", ProtocolConfig(mode=C.FLOOD), lambda: G.ring(128, 4),
+     None),
+    ("flood-grid", ProtocolConfig(mode=C.FLOOD), lambda: G.grid2d(8, 16),
+     None),
+    ("flood-drop-death", ProtocolConfig(mode=C.FLOOD),
+     lambda: G.ring(128, 6),
+     FaultConfig(node_death_rate=0.1, drop_prob=0.2, seed=3)),
+    ("pull-ws-lattice", ProtocolConfig(mode=C.PULL, fanout=2, rumors=3),
+     lambda: G.watts_strogatz(128, 6, beta=0.0, seed=1), None),
+    ("pull-drop", ProtocolConfig(mode=C.PULL, fanout=1),
+     lambda: G.ring(128, 4), FaultConfig(drop_prob=0.3, seed=5)),
+]
+
+
+@pytest.mark.parametrize("name,proto,topo_fn,fault", CASES,
+                         ids=[c[0] for c in CASES])
+def test_halo_bitwise_equals_single_device(name, proto, topo_fn, fault):
+    topo = topo_fn()
+    run = RunConfig(seed=7)
+    mesh = make_mesh(8)
+    sstep = jax.jit(make_si_round(proto, topo, fault, run.origin))
+    sst = init_state(run, proto, topo.n)
+    hstep = jax.jit(make_halo_round(proto, topo, mesh, fault, run.origin))
+    hst = init_sharded_state(run, proto, topo, mesh)   # n % 8 == 0: no pad
+    for _ in range(10):
+        sst = sstep(sst)
+        hst = hstep(hst)
+    np.testing.assert_array_equal(np.asarray(hst.seen), np.asarray(sst.seen))
+    assert float(hst.msgs) == pytest.approx(float(sst.msgs))
+
+
+def test_halo_wraparound_correct():
+    # rumor starting at node 0 must cross the 0/n seam through the mesh
+    # ring in both directions
+    topo = G.ring(64, 2)
+    proto = ProtocolConfig(mode=C.FLOOD)
+    mesh = make_mesh(8)
+    step = jax.jit(make_halo_round(proto, topo, mesh))
+    st = init_sharded_state(RunConfig(origin=0), proto, topo, mesh)
+    for _ in range(3):
+        st = step(st)
+    seen = np.asarray(st.seen)[:, 0]
+    expect = np.zeros(64, bool)
+    for d in range(-3, 4):
+        expect[d % 64] = True
+    np.testing.assert_array_equal(seen, expect)
+
+
+def test_halo_constraint_errors():
+    mesh = make_mesh(8)
+    with pytest.raises(ValueError, match="needs an explicit"):
+        make_halo_round(ProtocolConfig(mode=C.FLOOD), G.complete(64), mesh)
+    with pytest.raises(ValueError, match="flood/pull"):
+        make_halo_round(ProtocolConfig(mode=C.PUSH), G.ring(64, 2), mesh)
+    with pytest.raises(ValueError, match="mesh size"):
+        make_halo_round(ProtocolConfig(mode=C.FLOOD), G.ring(100, 2), mesh)
+    with pytest.raises(ValueError, match="band"):
+        # ER edges span the whole id space: band >> rows/shard
+        make_halo_round(ProtocolConfig(mode=C.FLOOD),
+                        G.erdos_renyi(128, 0.1, seed=1), mesh)
