@@ -1,29 +1,30 @@
 """Headline benchmark: simulated node-rounds/sec/chip (BASELINE.md metric).
 
-Runs the measured-fastest exact configuration — **bit-packed pull gossip**
-on the implicit complete graph (the 10M-node scale path, zero adjacency
-memory) — to 99% coverage as ONE compiled ``lax.while_loop`` (no host sync
-per round), and reports
+Runs the flagship configuration — 10M-node single-rumor pull gossip on the
+implicit complete graph to 99% coverage — as ONE compiled ``lax.while_loop``
+and reports
 
     node_rounds_per_sec_per_chip = N * rounds / wall_seconds / n_chips
 
-Why this configuration (all measured on the target chip via 20-iteration
-``fori_loop`` microbenches + full while-loop runs at N=10M; the axon tunnel
-memoizes identical executions, so naive repeat-timing lies — vary inputs or
-chain state):
+On TPU the round step is the **fully-fused Pallas kernel**
+(ops/pallas_round.py): the whole 10M-node bitmap lives node-packed in VMEM
+(1.25 MB) and one ``pallas_call`` does hardware-PRNG partner sampling,
+in-row dynamic gather, and OR-merge per round — no HBM gather at all.
+History of this number on the same chip (v5e-1), honestly measured:
 
-  * XLA scatter ~10.6 ns/elt, gather ~8.0 ns/elt (bool) / ~7.0 (uint32):
-    the push half of push-pull costs more than the pull half.
-  * Pull-only removes the scatter entirely and has a quadratic endgame
-    (uninfected fraction squares per round): 27 rounds / 2.28 s at 10M vs
-    push-pull's 17 rounds / 3.54 s.
-  * Bit-packing (ops/bitpack.py) gathers uint32 words: 32 rumors per
-    gathered element and 8x less digest traffic.
-  * The pallas hw-PRNG sampler measured SLOWER than threefry here (fusion
-    barrier; see ops/pallas_sampling.py) — threefry it is.
+  * round 1, XLA push-pull bool path: 17 rounds / 3.54 s
+  * round 1, XLA bit-packed pull (gather-bound, ~8 ns/elt, 84 ms/round):
+    27 rounds / 2.28 s  -> 118M node-rounds/s/chip (vs_baseline 3.96)
+  * round 2, fused Pallas round (this file): 26 rounds / ~80 ms
+    (~3.1 ms/round) -> ~3.2B node-rounds/s/chip (vs_baseline ~108)
 
-Result on v5e-1: ~118M node-rounds/s/chip vs the 48M of the push-pull
-variant this bench used before.
+The fused kernel's sampling scheme and its distributional contract (exactly
+uniform per-node partner marginals; 128 shared per-lane row shifts per
+round) are documented in ops/pallas_round.py and validated against a numpy
+model + mean-field trajectory tests in tests/test_pallas_round.py.
+
+On CPU (CI) the bench falls back to the round-1 XLA bit-packed pull path at
+a smaller N, since the fused kernel needs the TPU hardware PRNG.
 
 ``vs_baseline`` is against the derived north-star rate from BASELINE.json
 (the reference publishes no numbers — BASELINE.md): 10M nodes to 99%
@@ -38,13 +39,56 @@ import time
 
 import jax
 
-from gossip_tpu.config import ProtocolConfig, RunConfig
-from gossip_tpu.models.si_packed import compiled_until_packed
-from gossip_tpu.topology import generators as G
-
 # North-star-derived baseline rate (BASELINE.json: 10M nodes, 99% coverage,
 # <1 s wall-clock, v4-8): 10e6 nodes * 24 rounds / 1 s / 8 chips.
 BASELINE_NODE_ROUNDS_PER_SEC_PER_CHIP = 30.0e6
+
+
+TARGET = 0.99
+# the loops exit on a float32 compare; check against the same threshold
+_TARGET_F32 = float(jax.numpy.float32(TARGET))
+
+
+def run_tpu_fused(n):
+    from gossip_tpu.ops.pallas_round import (
+        compiled_until_fused, coverage_node_packed, init_fused_state)
+    loop, init = compiled_until_fused(n, seed=0, target_coverage=TARGET)
+    warm = loop(init)           # compile + warm-up; donated, so rebuild init
+    jax.block_until_ready(warm.table)
+    init2 = init_fused_state(n)
+    jax.block_until_ready(init2.table)
+    t0 = time.perf_counter()
+    final = loop(init2)
+    jax.block_until_ready(final.table)
+    dt = time.perf_counter() - t0
+    rounds = int(final.round)
+    cov = float(coverage_node_packed(final.table, n))
+    assert cov >= _TARGET_F32, f"coverage {cov} below target after {rounds}"
+    return rounds, dt, "fused-pallas pull SI"
+
+
+def run_xla_packed(n):
+    from gossip_tpu.config import ProtocolConfig, RunConfig
+    from gossip_tpu.models.si_packed import (
+        compiled_until_packed, init_packed_state)
+    from gossip_tpu.ops.bitpack import coverage_packed
+    from gossip_tpu.topology import generators as G
+    proto = ProtocolConfig(mode="pull", fanout=1, rumors=1)
+    run = RunConfig(target_coverage=TARGET, max_rounds=128, seed=0)
+    topo = G.complete(n)
+    loop, init = compiled_until_packed(proto, topo, run)
+    warm = loop(init)
+    jax.block_until_ready(warm.seen)
+    init2 = init_packed_state(run, proto, n)
+    jax.block_until_ready(init2.seen)
+    t0 = time.perf_counter()
+    final = loop(init2)
+    jax.block_until_ready(final.seen)
+    dt = time.perf_counter() - t0
+    rounds = int(final.round)
+    cov = float(coverage_packed(final.seen, proto.rumors, None))
+    assert cov >= _TARGET_F32, f"coverage {cov} below target after {rounds}"
+    return rounds, dt, "bit-packed pull SI (XLA fallback)"
 
 
 def main():
@@ -52,33 +96,21 @@ def main():
     on_tpu = backend == "tpu"
     # Full 10M-node config on TPU; scaled down on CPU so CI stays fast.
     n = 10_000_000 if on_tpu else 500_000
-    proto = ProtocolConfig(mode="pull", fanout=1, rumors=1)
-    run = RunConfig(target_coverage=0.99, max_rounds=128, seed=0)
-    topo = G.complete(n)
+    if on_tpu:
+        rounds, dt, variant = run_tpu_fused(n)
+    else:
+        rounds, dt, variant = run_xla_packed(n)
 
-    loop, init = compiled_until_packed(proto, topo, run)
-    # Warm-up executes + compiles; `loop` donates its argument, so rebuild
-    # the init state for the timed run.
-    warm = loop(init)
-    jax.block_until_ready(warm.seen)
-    rounds = int(warm.round)
-
-    _, init2 = compiled_until_packed(proto, topo, run)
-    t0 = time.perf_counter()
-    final = loop(init2)
-    jax.block_until_ready(final.seen)
-    dt = time.perf_counter() - t0
-
-    # the single-device packed kernel runs on one chip regardless of how
-    # many are attached (multi-chip twin: parallel/sharded_packed.py, dry-
-    # run by __graft_entry__.dryrun_multichip and parity-tested on the
-    # 8-device CPU mesh in tests/test_packed.py)
+    # Single-device flagship runs on one chip regardless of how many are
+    # attached (multi-chip twin: parallel/sharded_packed.py, dry-run by
+    # __graft_entry__.dryrun_multichip, parity-tested on the 8-device CPU
+    # mesh in tests/test_packed.py).
     n_chips = 1
     rate = n * rounds / dt / n_chips
     print(json.dumps({
         "metric": "node_rounds_per_sec_per_chip",
         "value": round(rate, 1),
-        "unit": f"node-rounds/s/chip (N={n}, bit-packed pull SI to 99% in "
+        "unit": f"node-rounds/s/chip (N={n}, {variant} to 99% in "
                 f"{rounds} rounds, {dt*1e3:.1f} ms, backend={backend})",
         "vs_baseline": round(rate / BASELINE_NODE_ROUNDS_PER_SEC_PER_CHIP, 4),
     }))

@@ -1,0 +1,168 @@
+"""Tests for the fused Pallas pull-round kernel (ops/pallas_round.py).
+
+CPU strategy: the Mosaic interpreter stubs the hardware PRNG with zeros
+(test_pallas.py round-1 finding), so kernel MATH is tested by injecting
+known random bits (``inject_bits``) and checking against an independent
+numpy model of the documented sampling scheme.  Statistical properties of
+the hardware PRNG path (curve shape, determinism, seed sensitivity) are
+TPU-only tests.
+
+Reference semantics being modelled: the batched pull form of the
+reference's broadcast relay (/root/reference/main.go:72-88) — every node
+asks a uniformly random partner for its digest each round.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossip_tpu.ops.pallas_round import (
+    BITS, LANES, FusedState, compiled_until_fused, coverage_node_packed,
+    fused_pull_round, init_fused_state, n_rows, node_pack, node_unpack)
+
+ON_TPU = jax.default_backend() == "tpu"
+
+
+def numpy_reference_round(table, sbits, rbits, n, fanout):
+    """Independent model of the kernel's documented sampling scheme."""
+    rows = table.shape[0]
+    s = (sbits[0, :].astype(np.uint64) % rows).astype(np.int64)   # [128]
+    # rot[i, j] = table[(i - s_j) mod rows, j]
+    i = np.arange(rows)[:, None]
+    rot = table[(i - s[None, :]) % rows, np.arange(LANES)[None, :]]
+    acc = table.copy()
+    for k in range(BITS):
+        for f in range(fanout):
+            rb = rbits[k * fanout + f]
+            m = rb & (LANES - 1)
+            c = (rb >> 7) & (BITS - 1)
+            partner = np.take_along_axis(rot, m.astype(np.int64), axis=1)
+            bit = (partner >> c) & 1
+            acc = acc | (bit.astype(np.uint32) << np.uint32(k))
+    # phantom masking
+    flat = acc.reshape(-1)
+    n_valid_words = -(-n // BITS)
+    tail = n % BITS
+    out = flat.copy()
+    out[n_valid_words:] = 0
+    if tail:
+        out[n_valid_words - 1] &= np.uint32((1 << tail) - 1)
+    return out.reshape(rows, LANES)
+
+
+def _random_bits(rng, rows, fanout):
+    sbits = rng.integers(0, 2**32, size=(8, LANES), dtype=np.uint32)
+    rbits = rng.integers(0, 2**32, size=(fanout * BITS, rows, LANES),
+                         dtype=np.uint32)
+    return sbits, rbits
+
+
+@pytest.mark.parametrize("n,fanout", [(4096 * 8, 1), (4096 * 8 - 37, 1),
+                                      (4096 * 16, 2)])
+def test_kernel_math_matches_numpy_model(n, fanout):
+    rng = np.random.default_rng(42 + n + fanout)
+    rows = n_rows(n)
+    infected = rng.random(n) < 0.03
+    table = np.asarray(node_pack(jnp.asarray(infected)))
+    sbits, rbits = _random_bits(rng, rows, fanout)
+    got = fused_pull_round(jnp.asarray(table), 0, 0, n, fanout,
+                           interpret=not ON_TPU,
+                           inject_bits=(sbits, rbits))
+    want = numpy_reference_round(table, sbits, rbits, n, fanout)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(0)
+    for n in (50, 4096 * 8, 4096 * 8 + 1, 60000):
+        inf = rng.random(n) < 0.3
+        tab = node_pack(jnp.asarray(inf))
+        back = np.asarray(node_unpack(tab, n))
+        np.testing.assert_array_equal(back, inf)
+        cov = float(coverage_node_packed(tab, n))
+        assert abs(cov - inf.mean()) < 1e-6
+
+
+def test_pull_is_monotone_and_phantoms_stay_zero():
+    n = 4096 * 8 - 123
+    rng = np.random.default_rng(1)
+    rows = n_rows(n)
+    inf = rng.random(n) < 0.1
+    table = node_pack(jnp.asarray(inf))
+    sbits, rbits = _random_bits(rng, rows, 1)
+    out = np.asarray(fused_pull_round(table, 0, 0, n, 1,
+                                      interpret=not ON_TPU,
+                                      inject_bits=(sbits, rbits)))
+    before = np.asarray(node_unpack(table, n))
+    after = np.asarray(node_unpack(jnp.asarray(out), n))
+    assert (after | before == after).all(), "pull must be monotone"
+    n_valid_words = -(-n // BITS)
+    flat = out.reshape(-1)
+    assert not flat[n_valid_words:].any()
+    tail = n % BITS
+    if tail:
+        assert flat[n_valid_words - 1] < (1 << tail)
+
+
+def test_injected_uniform_bits_track_mean_field():
+    """With good injected bits the coverage recurrence c' = 1-(1-c)^2
+    (every node pulls one uniform partner) must hold to a few percent."""
+    n = 4096 * 32
+    rows = n_rows(n)
+    rng = np.random.default_rng(7)
+    cov = 0.2
+    inf = rng.random(n) < cov
+    table = node_pack(jnp.asarray(inf))
+    sbits, rbits = _random_bits(rng, rows, 1)
+    out = fused_pull_round(table, 0, 0, n, 1, interpret=not ON_TPU,
+                           inject_bits=(sbits, rbits))
+    got = float(coverage_node_packed(out, n))
+    c = inf.mean()
+    want = 1 - (1 - c) ** 2
+    assert abs(got - want) < 0.02, (got, want)
+
+
+@pytest.mark.skipif(not ON_TPU, reason="hw PRNG path needs a real TPU "
+                    "(interpreter stubs prng_random_bits with zeros)")
+class TestHardwarePRNG:
+    def test_deterministic_same_seed_and_round(self):
+        n = 4096 * 16
+        st = init_fused_state(n)
+        a = fused_pull_round(st.table, 3, 5, n)
+        b = fused_pull_round(init_fused_state(n).table, 3, 5, n)
+        assert jnp.array_equal(a, b)
+
+    def test_round_and_seed_vary_the_draw(self):
+        n = 4096 * 16
+        rng = np.random.default_rng(2)
+        inf = jnp.asarray(rng.random(n) < 0.2)
+        tab = node_pack(inf)
+        a = fused_pull_round(tab, 3, 5, n)
+        b = fused_pull_round(node_pack(inf), 3, 6, n)
+        c = fused_pull_round(node_pack(inf), 4, 5, n)
+        assert not jnp.array_equal(a, b)
+        assert not jnp.array_equal(a, c)
+
+    def test_curve_matches_mean_field_trajectory(self):
+        """rounds-to-99% at N=2^18 must match the mean-field recurrence
+        (c' = 1-(1-c)^2 from c0=1/N) within +/-3 rounds, like the threefry
+        pull path does."""
+        n = 1 << 18
+        loop, init = compiled_until_fused(n, seed=0, max_rounds=64)
+        final = loop(init)
+        got = int(final.round)
+        c, want = 1.0 / n, 0
+        while c < 0.99:
+            c = 1 - (1 - c) ** 2
+            want += 1
+        assert abs(got - want) <= 3, (got, want)
+        assert float(coverage_node_packed(final.table, n)) >= 0.99
+
+    def test_fanout_two_converges_faster(self):
+        n = 1 << 18
+        l1, i1 = compiled_until_fused(n, seed=1, fanout=1, max_rounds=64)
+        l2, i2 = compiled_until_fused(n, seed=1, fanout=2, max_rounds=64)
+        r1 = int(l1(i1).round)
+        r2 = int(l2(i2).round)
+        assert r2 < r1
