@@ -1,0 +1,132 @@
+"""Tests for the sparse all_to_all exchange (parallel/sharded_sparse.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossip_tpu import config as C
+from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
+from gossip_tpu.ops.bitpack import coverage_packed, n_words
+from gossip_tpu.parallel.sharded import make_mesh
+from gossip_tpu.parallel.sharded_sparse import (
+    SPARSE_ROW_TAG, _round_draws, _slot_rows, init_sparse_state,
+    make_sparse_pull_round, simulate_until_sparse, sparse_meta,
+    sparse_pull_round_reference)
+
+P8 = 8
+
+
+def _mesh():
+    return make_mesh(P8)
+
+
+@pytest.mark.parametrize("mode,fanout,rumors,fault", [
+    (C.PULL, 1, 1, None),
+    (C.PULL, 2, 40, None),
+    (C.PULL, 1, 1, FaultConfig(node_death_rate=0.1, drop_prob=0.2, seed=3)),
+    (C.ANTI_ENTROPY, 1, 5, None),
+])
+def test_bitwise_parity_mesh_vs_reference(mode, fanout, rumors, fault):
+    """The mesh run and the single-device reference must agree BITWISE for
+    several rounds (collectives only move data)."""
+    n = 256
+    proto = ProtocolConfig(mode=mode, fanout=fanout, rumors=rumors, period=2)
+    run = RunConfig(seed=11)
+    mesh = _mesh()
+    step_m = make_sparse_pull_round(proto, n, mesh, fault, run.origin)
+    step_r = sparse_pull_round_reference(proto, n, P8, fault, run.origin)
+    st_m = init_sparse_state(run, proto, n, mesh)
+    st_r = init_sparse_state(run, proto, n)  # unsharded, same padding (p=1
+    # pads to n; mesh pads to n too since 256 % 8 == 0)
+    for _ in range(6):
+        st_m = step_m(st_m)
+        st_r = step_r(st_r)
+        np.testing.assert_array_equal(np.asarray(st_m.seen),
+                                      np.asarray(st_r.seen))
+        assert float(st_m.msgs) == float(st_r.msgs)
+
+
+def test_partner_marginal_is_uniform():
+    """Stratification must leave the per-slot partner marginal uniform over
+    all rows: chi-square over many rounds for one fixed slot."""
+    p, nl = 8, 32
+    n_pad = p * nl
+    key = jax.random.key(0)
+    slot = jnp.asarray([5], jnp.int32)      # fixed global slot, k=1
+
+    @jax.jit
+    @jax.vmap
+    def partner_gid(rnd):
+        rkey = jax.random.fold_in(key, rnd)
+        pi, o = _round_draws(rkey, p)
+        shard = pi[(5 + o) % p]
+        return shard * nl + _slot_rows(rkey, slot, nl)[0]
+
+    gids = np.asarray(partner_gid(jnp.arange(2000, dtype=jnp.uint32)))
+    counts = np.bincount(gids, minlength=n_pad)
+    expected = 2000 / n_pad
+    chi2 = ((counts - expected) ** 2 / expected).sum()
+    # dof = 255; 3-sigma upper bound ~ 255 + 3*sqrt(510) ~ 323
+    assert chi2 < 323, chi2
+
+
+def test_converges_and_traffic_accounting():
+    n = 1024
+    proto = ProtocolConfig(mode=C.PULL, fanout=2, rumors=40)
+    run = RunConfig(seed=0, target_coverage=0.99, max_rounds=64)
+    rounds, cov, msgs, final, meta = simulate_until_sparse(
+        proto, n, run, _mesh())
+    assert cov >= 0.99
+    assert 5 <= rounds <= 30
+    w = n_words(40)
+    nl = n // P8
+    assert meta.cap == (nl * 2) // P8
+    assert meta.request_bytes == P8 * meta.cap * 4
+    assert meta.response_bytes == P8 * meta.cap * 4 * w
+    assert meta.dense_bytes == n * 4 * w
+    # the whole point: sparse moves less than dense when k < shards*W/(W+1)
+    assert meta.sparse_bytes < meta.dense_bytes
+    # msgs: 2 per valid request, all nodes alive -> 2*k*n per active round
+    assert float(msgs) == pytest.approx(2.0 * 2 * n * rounds)
+
+
+def test_sparse_matches_dense_pull_statistically():
+    """Same protocol, different exchange: rounds-to-99% must agree within
+    +/-2 rounds of the dense packed pull path."""
+    from gossip_tpu.models.si_packed import simulate_until_packed
+    from gossip_tpu.topology import generators as G
+    n = 2048
+    proto = ProtocolConfig(mode=C.PULL, fanout=1, rumors=1)
+    run = RunConfig(seed=5, target_coverage=0.99, max_rounds=64)
+    r_sparse, cov_s, _, _, _ = simulate_until_sparse(proto, n, run, _mesh())
+    r_dense, cov_d, _, _ = simulate_until_packed(proto, G.complete(n), run)
+    assert cov_s >= 0.99 and cov_d >= 0.99
+    assert abs(r_sparse - r_dense) <= 2, (r_sparse, r_dense)
+
+
+def test_rejects_push_and_unbalanced():
+    mesh = _mesh()
+    with pytest.raises(ValueError, match="pull"):
+        make_sparse_pull_round(ProtocolConfig(mode=C.PUSH), 256, mesh)
+    with pytest.raises(ValueError, match="divide"):
+        # nl*k = 4 slots per shard, not divisible by 8 shards
+        make_sparse_pull_round(
+            ProtocolConfig(mode=C.PULL, fanout=1), 32, mesh)
+
+
+def test_dead_nodes_never_infected_or_requesting():
+    n = 256
+    fault = FaultConfig(node_death_rate=0.3, seed=9)
+    proto = ProtocolConfig(mode=C.PULL, fanout=1, rumors=1)
+    run = RunConfig(seed=2, max_rounds=40)
+    mesh = _mesh()
+    step = make_sparse_pull_round(proto, n, mesh, fault, run.origin)
+    st = init_sparse_state(run, proto, n, mesh)
+    from gossip_tpu.models.state import alive_mask
+    alive = np.asarray(alive_mask(fault, n, run.origin))
+    for _ in range(12):
+        st = step(st)
+    seen = np.asarray(st.seen)[:n, 0]
+    assert not (seen[~alive] != 0).any(), "dead nodes must stay dark"
+    assert (seen[alive] != 0).mean() > 0.9
