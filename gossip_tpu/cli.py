@@ -194,6 +194,38 @@ def cmd_sweep(a) -> int:
     return 0
 
 
+def cmd_grid(a) -> int:
+    """Batched config sweep: the cartesian product of --modes/--fanouts/
+    --drops/--periods/--seeds runs as ONE compiled XLA program (the
+    north-star "sweep fanout, mode, ... across a pod" sentence —
+    parallel/sweep.config_sweep_curves)."""
+    from gossip_tpu.parallel.sweep import SweepPoint, config_sweep_curves
+    from gossip_tpu.topology import generators as G
+    tc = TopologyConfig(family=a.family, n=a.n, k=a.k, p=a.p,
+                        degree_cap=a.degree_cap, seed=a.seed)
+    run = RunConfig(target_coverage=a.target, max_rounds=a.max_rounds,
+                    seed=a.seed)
+    fault = (FaultConfig(node_death_rate=a.death, seed=a.seed)
+             if a.death > 0 else None)
+    points = [
+        SweepPoint(mode=m, fanout=f, drop_prob=d,
+                   period=(p if m == "antientropy" else 1), seed=s)
+        for m in a.modes for f in a.fanouts for d in a.drops
+        for p in (a.periods if 'antientropy' in a.modes else [1])
+        for s in a.seeds]
+    # periods multiply only anti-entropy points; dedupe the rest
+    points = list(dict.fromkeys(points))
+    res = config_sweep_curves(points, G.build(tc), run, fault=fault,
+                              rumors=a.rumors)
+    for i, summary in enumerate(res.summaries()):
+        summary["n"] = a.n
+        summary["family"] = a.family
+        if a.curve:
+            summary["curve"] = [float(c) for c in res.curves[i]]
+        print(json.dumps(summary), flush=True)
+    return 0
+
+
 def cmd_serve(a) -> int:
     from gossip_tpu.rpc.sidecar import serve
     server, port = serve(a.port, a.workers)
@@ -227,6 +259,31 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="subset of config names")
     p.add_argument("--curve", action="store_true")
     p.set_defaults(fn=cmd_sweep)
+
+    p = sub.add_parser("grid", help="batched config sweep: cartesian "
+                       "product of modes/fanouts/drops/seeds in ONE "
+                       "compiled program")
+    p.add_argument("--modes", nargs="+", default=["push", "pull", "pushpull"],
+                   choices=("push", "pull", "pushpull", "antientropy"))
+    p.add_argument("--fanouts", nargs="+", type=int, default=[1, 2])
+    p.add_argument("--drops", nargs="+", type=float, default=[0.0])
+    p.add_argument("--periods", nargs="+", type=int, default=[2],
+                   help="anti-entropy cadences (ignored for other modes)")
+    p.add_argument("--seeds", nargs="+", type=int, default=[0])
+    p.add_argument("--n", type=int, default=4096)
+    p.add_argument("--rumors", type=int, default=1)
+    p.add_argument("--family", default="complete",
+                   choices=("complete", "ring", "grid", "erdos_renyi",
+                            "watts_strogatz", "power_law"))
+    p.add_argument("--k", type=int, default=4)
+    p.add_argument("--p", type=float, default=0.01)
+    p.add_argument("--degree-cap", type=int, default=None)
+    p.add_argument("--target", type=float, default=0.99)
+    p.add_argument("--max-rounds", type=int, default=64)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--death", type=float, default=0.0)
+    p.add_argument("--curve", action="store_true")
+    p.set_defaults(fn=cmd_grid)
 
     p = sub.add_parser("serve", help="start the gRPC sidecar")
     p.add_argument("--port", type=int, default=50051)

@@ -1,0 +1,105 @@
+"""Tests for the batched config sweep (parallel/sweep.config_sweep_curves)."""
+
+import numpy as np
+import pytest
+
+from gossip_tpu import config as C
+from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
+from gossip_tpu.parallel.sweep import SweepPoint, config_sweep_curves
+from gossip_tpu.runtime.simulator import simulate_curve
+from gossip_tpu.topology import generators as G
+
+
+def _grid_points():
+    """8 distinct configs: modes x fanouts x drop, plus seeds."""
+    return [
+        SweepPoint(mode=C.PUSH, fanout=1, seed=0),
+        SweepPoint(mode=C.PUSH, fanout=2, seed=1),
+        SweepPoint(mode=C.PULL, fanout=1, seed=2),
+        SweepPoint(mode=C.PULL, fanout=2, drop_prob=0.3, seed=3),
+        SweepPoint(mode=C.PUSH_PULL, fanout=1, seed=4),
+        SweepPoint(mode=C.PUSH_PULL, fanout=2, drop_prob=0.5, seed=5),
+        SweepPoint(mode=C.ANTI_ENTROPY, fanout=1, period=3, seed=6),
+        SweepPoint(mode=C.ANTI_ENTROPY, fanout=2, period=2, seed=7),
+    ]
+
+
+def test_eight_configs_one_program_all_converge():
+    topo = G.complete(2048)
+    run = RunConfig(seed=0, max_rounds=64, target_coverage=0.99)
+    res = config_sweep_curves(_grid_points(), topo, run)
+    assert res.curves.shape == (8, 64)
+    summaries = res.summaries()
+    assert len(summaries) == 8
+    for s in summaries:
+        assert s["converged"], s
+    # distinct configs, distinct outcomes: pushpull(f2) beats push(f1)
+    rt = res.rounds_to_target
+    assert rt[5] < rt[0]        # pushpull f2 (even lossy) < push f1
+    assert rt[6] > rt[2]        # periodic anti-entropy slower than pull
+
+
+def test_batch_composition_invariance():
+    """A point's trajectory must not depend on what else is in the batch
+    (same k_max): batch-of-8 slice == batch-of-1."""
+    topo = G.complete(512)
+    run = RunConfig(seed=0, max_rounds=24)
+    pts = _grid_points()
+    full = config_sweep_curves(pts, topo, run, k_max=2)
+    for i in (0, 3, 6):
+        solo = config_sweep_curves([pts[i]], topo, run, k_max=2)
+        np.testing.assert_array_equal(full.curves[i], solo.curves[0])
+        np.testing.assert_array_equal(full.msgs[i], solo.msgs[0])
+
+
+@pytest.mark.parametrize("mode,fanout,drop", [
+    (C.PUSH, 2, 0.0),
+    (C.PULL, 2, 0.25),
+    (C.PUSH_PULL, 2, 0.0),
+])
+def test_bitwise_parity_with_solo_round(mode, fanout, drop):
+    """A point whose fanout == k_max reproduces make_si_round's trajectory
+    bitwise (same RNG keys, same draw shapes)."""
+    n = 512
+    topo = G.complete(n)
+    run = RunConfig(seed=9, max_rounds=20, target_coverage=0.999)
+    pt = SweepPoint(mode=mode, fanout=fanout, drop_prob=drop, seed=9)
+    res = config_sweep_curves([pt], topo, run, k_max=fanout)
+    proto = ProtocolConfig(mode=mode, fanout=fanout)
+    fault = FaultConfig(drop_prob=drop, seed=9) if drop else None
+    solo = simulate_curve(proto, topo, run, fault)
+    np.testing.assert_array_equal(res.curves[0],
+                                  np.asarray(solo.coverage, np.float32))
+    np.testing.assert_allclose(res.msgs[0][-1], solo.msgs[-1], rtol=0)
+
+
+def test_explicit_table_topology():
+    topo = G.erdos_renyi(1024, p=0.02, seed=1)
+    run = RunConfig(seed=0, max_rounds=64)
+    pts = [SweepPoint(mode=C.PUSH_PULL, fanout=2, seed=s) for s in range(4)]
+    res = config_sweep_curves(pts, topo, run)
+    assert all(s["converged"] for s in res.summaries())
+
+
+def test_death_mask_shared_drop_per_config():
+    topo = G.complete(512)
+    run = RunConfig(seed=0, max_rounds=64)
+    fault = FaultConfig(node_death_rate=0.2, seed=4)
+    pts = [SweepPoint(mode=C.PUSH_PULL, fanout=1, drop_prob=d, seed=1)
+           for d in (0.0, 0.6)]
+    res = config_sweep_curves(pts, topo, run, fault=fault)
+    rt = res.rounds_to_target
+    assert rt[0] > 0 and rt[1] > 0 and rt[0] < rt[1]
+    with pytest.raises(ValueError, match="drop_prob"):
+        config_sweep_curves(pts, topo, run,
+                            fault=FaultConfig(drop_prob=0.1))
+
+
+def test_point_validation():
+    with pytest.raises(ValueError, match="flood"):
+        SweepPoint(mode=C.FLOOD)
+    with pytest.raises(ValueError, match="anti-entropy"):
+        SweepPoint(mode=C.PUSH, period=2)
+    with pytest.raises(ValueError, match="k_max"):
+        config_sweep_curves([SweepPoint(fanout=4)], G.complete(64),
+                            RunConfig(max_rounds=4), k_max=2)
