@@ -131,32 +131,61 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
     n_dev = 1 if mesh_cfg is None else mesh_cfg.n_devices
 
     if proto.mode == "swim":
-        from gossip_tpu.models.swim import suggested_suspect_rounds
+        from gossip_tpu.models.swim import (resolve_epoch_rounds,
+                                            suggested_suspect_rounds)
         from gossip_tpu.runtime.simulator import simulate_swim_curve
         mesh = None
         if n_dev > 1:
             from gossip_tpu.parallel.sharded import make_mesh
             mesh = make_mesh(n_dev)
-        dead = (1 % proto.swim_subjects,)
+        # Failure scenario from the FaultConfig (CLI --dead-nodes /
+        # --fail-round, RPC fault.dead_nodes); default: node 1 % S fails
+        # at round 2 (recorded in meta so the scenario is discoverable).
+        default_scenario = fault is None or not fault.dead_nodes
+        if default_scenario:
+            dead = (1 % proto.swim_subjects,)
+            fail_round = 2
+        else:
+            dead = fault.dead_nodes
+            fail_round = fault.fail_round
+        bad = [d for d in dead if d >= tc.n]
+        if bad:
+            raise ValueError(f"dead_nodes {bad} out of range for n={tc.n}")
+        if not proto.swim_rotate:
+            outside = [d for d in dead if d >= proto.swim_subjects]
+            if outside:
+                raise ValueError(
+                    f"dead_nodes {outside} are outside the fixed subject "
+                    f"window 0..{proto.swim_subjects - 1}; enable "
+                    "--swim-rotate for full-membership detection")
         rounds = run.max_rounds
         t0 = time.perf_counter()
         fracs, final = simulate_swim_curve(
-            proto, tc.n, rounds, dead_nodes=dead, fail_round=2, fault=fault,
+            proto, tc.n, rounds, dead_nodes=dead, fail_round=fail_round,
+            fault=fault,
             topo=None if tc.family == "complete" else topo, seed=run.seed,
             mesh=mesh)
         wall = time.perf_counter() - t0
         hit = [i for i, f in enumerate(fracs) if f >= run.target_coverage]
+        meta = {"clock": "rounds", "metric": "detection_fraction",
+                "dead_subjects": list(dead), "fail_round": fail_round,
+                "default_scenario": default_scenario,
+                "suggested_suspect_rounds":
+                    suggested_suspect_rounds(tc.n, proto.fanout),
+                "devices": n_dev}
+        if proto.swim_rotate:
+            meta["subject_window"] = "rotating"
+            meta["epoch_rounds"] = resolve_epoch_rounds(proto, tc.n)
+            # rotation: detection is scoped to the dead node's epoch; the
+            # headline number is the best in-window detection achieved
+            meta["peak_detection"] = float(max(fracs))
         return RunReport(
             backend="jax-tpu", mode="swim", n=tc.n,
             rounds=(hit[0] + 1) if hit else -1,
             coverage=float(fracs[-1]), msgs=float(final.msgs),
             wall_s=round(wall, 4),
             curve=[float(f) for f in fracs] if want_curve else None,
-            meta={"clock": "rounds", "metric": "detection_fraction",
-                  "dead_subjects": list(dead),
-                  "suggested_suspect_rounds":
-                      suggested_suspect_rounds(tc.n, proto.fanout),
-                  "devices": n_dev})
+            meta=meta)
 
     if n_dev > 1:
         from gossip_tpu.parallel.sharded import (

@@ -67,6 +67,16 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--swim-proxies", type=int, default=3)
     p.add_argument("--swim-suspect-rounds", type=int, default=0,
                    help="0 = use suggested_suspect_rounds(n)")
+    p.add_argument("--swim-rotate", action="store_true",
+                   help="rotate the subject window over all n nodes "
+                        "(full-membership failure detection)")
+    p.add_argument("--swim-epoch-rounds", type=int, default=0,
+                   help="rounds per rotating-window epoch (0 = auto)")
+    p.add_argument("--dead-nodes", nargs="*", type=int, default=None,
+                   metavar="ID",
+                   help="node ids that fail at --fail-round (swim scenario; "
+                        "default: node 1%%S fails at round 2)")
+    p.add_argument("--fail-round", type=int, default=0)
 
 
 def _args_to_configs(a):
@@ -78,15 +88,19 @@ def _args_to_configs(a):
     proto = ProtocolConfig(mode=a.mode, fanout=a.fanout, rumors=a.rumors,
                            period=a.period, swim_subjects=a.swim_subjects,
                            swim_proxies=a.swim_proxies,
-                           swim_suspect_rounds=t)
+                           swim_suspect_rounds=t,
+                           swim_rotate=a.swim_rotate,
+                           swim_epoch_rounds=a.swim_epoch_rounds)
     tc = TopologyConfig(family=a.family, n=a.n, k=a.k, p=a.p,
                         degree_cap=a.degree_cap, seed=a.seed)
     run = RunConfig(target_coverage=a.target, max_rounds=a.max_rounds,
                     seed=a.seed, origin=a.origin)
     fault = None
-    if a.drop > 0 or a.death > 0:
+    if a.drop > 0 or a.death > 0 or a.dead_nodes:
         fault = FaultConfig(node_death_rate=a.death, drop_prob=a.drop,
-                            seed=a.seed)
+                            seed=a.seed,
+                            dead_nodes=tuple(a.dead_nodes or ()),
+                            fail_round=a.fail_round)
     mesh = MeshConfig(n_devices=a.devices) if a.devices > 1 else None
     return proto, tc, run, fault, mesh
 

@@ -83,7 +83,13 @@ class ProtocolConfig:
     # SWIM parameters (see models/swim.py):
     swim_proxies: int = 3        # indirect-probe proxies (the "k" of SWIM)
     swim_suspect_rounds: int = 4 # rounds a suspect waits before confirm-dead
-    swim_subjects: int = 8       # number of tracked (possibly-failing) subjects
+    swim_subjects: int = 8       # tracked subjects (window width when rotating)
+    # Full-membership mode: the S-subject window rotates over ALL n nodes,
+    # advancing by S every `swim_epoch_rounds` rounds (0 = auto: long enough
+    # for detect + disseminate + confirm).  Every node is eventually watched
+    # without an [N, N] view table (models/swim.py module doc).
+    swim_rotate: bool = False
+    swim_epoch_rounds: int = 0
 
     def __post_init__(self):
         if self.mode not in MODES:
@@ -92,6 +98,10 @@ class ProtocolConfig:
             raise ValueError("fanout must be >= 1")
         if self.rumors < 1:
             raise ValueError("rumors must be >= 1")
+        if self.swim_subjects < 1:
+            raise ValueError("swim_subjects must be >= 1")
+        if self.swim_epoch_rounds < 0:
+            raise ValueError("swim_epoch_rounds must be >= 0 (0 = auto)")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -109,6 +119,21 @@ class FaultConfig:
     node_death_rate: float = 0.0   # fraction of nodes dead (static mask)
     drop_prob: float = 0.0         # per-message drop probability per round
     seed: int = 0
+    # Explicit failure scenario (SWIM kernels): exactly these node ids fail
+    # permanently at round `fail_round`.  Complements the random static mask
+    # above; empty = no scripted deaths.  Reachable from the CLI
+    # (--dead-nodes/--fail-round) and the RPC `fault` object.
+    dead_nodes: Tuple[int, ...] = ()
+    fail_round: int = 0
+
+    def __post_init__(self):
+        # JSON/RPC delivers lists; coerce so the config stays hashable.
+        if not isinstance(self.dead_nodes, tuple):
+            object.__setattr__(self, "dead_nodes", tuple(self.dead_nodes))
+        if any(d < 0 for d in self.dead_nodes):
+            raise ValueError("dead_nodes must be non-negative node ids")
+        if self.fail_round < 0:
+            raise ValueError("fail_round must be >= 0")
 
 
 @dataclasses.dataclass(frozen=True)

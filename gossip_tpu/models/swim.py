@@ -5,10 +5,24 @@ unbounded retry loop per neighbor RPC (reference main.go:77-87, SURVEY.md §5
 "Failure detection: retry only").  This module supplies the real thing, per
 the BASELINE.json config "SWIM-style suspect/confirm failure detection, 1M
 nodes": each node runs the SWIM probe cycle against a tracked set of S
-subjects (nodes 0..S-1), with indirect probes through K proxies, suspicion
-timers, confirm-after-timeout, and incarnation-based refutation, all as pure
+subjects, with indirect probes through K proxies, suspicion timers,
+confirm-after-timeout, and incarnation-based refutation, all as pure
 array updates — no per-node state machines, no control flow that XLA can't
 tile (SURVEY.md §7 "SWIM semantics in array form").
+
+**Membership coverage.**  Two subject-window modes:
+
+* fixed (default): the window is nodes ``0..S-1`` for the whole run — the
+  cheap array-form reduction for a known failure scenario;
+* rotating (``proto.swim_rotate``): the window advances by S every
+  *epoch* of ``swim_epoch_rounds`` rounds — epoch ``e`` watches global ids
+  ``(e*S + j) % n``.  Every node is eventually watched (full-membership
+  semantics) while per-observer view state stays ``[N, S]``, never
+  ``[N, N]``.  At each epoch boundary wire and timer reset: detection
+  state is scoped to the epoch, exactly like real SWIM's bounded
+  piggyback buffers scope dissemination.  The auto epoch length
+  (:func:`suggested_epoch_rounds`) leaves room for probe + epidemic
+  dissemination + suspicion timeout + confirm spread inside one epoch.
 
 **The wire encoding** is what makes SWIM XLA-native.  A view of a subject is
 (status, incarnation) with SWIM's override rules: Alive@i beats Suspect@j iff
@@ -95,6 +109,34 @@ def suggested_suspect_rounds(n: int, fanout: int = 2) -> int:
     return max(6, int(math.ceil(2 * leg)) + 6)
 
 
+def suggested_epoch_rounds(n: int, fanout: int, suspect_rounds: int) -> int:
+    """Rotating-window epoch length: probe seeding (~2 rounds; with n/S
+    probers per subject the dead subject is suspected almost immediately)
+    + one epidemic dissemination leg + the suspicion timeout + slack for
+    the DEAD confirmation itself to spread."""
+    import math
+    leg = math.log(max(n, 2)) / math.log(1 + max(fanout, 1))
+    return suspect_rounds + int(math.ceil(leg)) + 8
+
+
+def resolve_epoch_rounds(proto: ProtocolConfig, n: int) -> int:
+    """The epoch length a given config actually runs with (0 = auto)."""
+    return proto.swim_epoch_rounds or suggested_epoch_rounds(
+        n, proto.fanout, proto.swim_suspect_rounds)
+
+
+def subject_window(round_, s_count: int, n: int, rotate: bool,
+                   epoch_rounds: int) -> jax.Array:
+    """Global subject ids ``int32[S]`` watched during ``round_``.  Fixed
+    mode: always ``0..S-1``.  Rotating: epoch ``round_ // epoch_rounds``
+    shifts the window by S (mod n) — distinct ids whenever S <= n."""
+    slot = jnp.arange(s_count, dtype=jnp.int32)
+    if not rotate:
+        return slot
+    epoch = (jnp.asarray(round_, jnp.int32) // epoch_rounds).astype(jnp.int32)
+    return (epoch * s_count + slot) % n
+
+
 def decode_status(wire: jax.Array) -> jax.Array:
     """wire -> {ALIVE, SUSPECT, DEAD}."""
     return jnp.where(wire >= DEAD_WIRE, DEAD,
@@ -163,20 +205,35 @@ def make_swim_round(proto: ProtocolConfig, n: int,
     :func:`gossip_tpu.parallel.sharded_swim.make_sharded_swim_round`, kept
     semantically identical — tests/test_swim.py asserts bitwise parity)."""
     s_count = proto.swim_subjects
+    if s_count > n:
+        raise ValueError(
+            f"swim_subjects={s_count} exceeds cluster size n={n}; the "
+            "subject window cannot be wider than the membership")
     proxies = proto.swim_proxies
     t_confirm = proto.swim_suspect_rounds
     fanout = proto.fanout
+    rotate = proto.swim_rotate
+    epoch_rounds = resolve_epoch_rounds(proto, n)
     drop_prob = 0.0 if fault is None else fault.drop_prob
     alive_base = base_alive(n, dead_nodes, fault)
     if topo is None:
         topo = Topology(nbrs=None, deg=None, n=n, family="complete")
     ids = jnp.arange(n, dtype=jnp.int32)
+    slots = jnp.arange(s_count, dtype=jnp.int32)
 
     def step(state: SwimState) -> SwimState:
         rkey = jax.random.fold_in(state.base_key, state.round)
         alive_now = jnp.where(state.round >= fail_round, alive_base, True)
-        subj_alive = alive_now[:s_count]
-        wire0 = state.wire
+        subj_gids = subject_window(state.round, s_count, n, rotate,
+                                   epoch_rounds)
+        subj_alive = alive_now[subj_gids]
+        if rotate:   # epoch boundary: fresh view state for the new window
+            boundary = (state.round > 0) & (state.round % epoch_rounds == 0)
+            wire_prev = jnp.where(boundary, 0, state.wire)
+            timer_prev = jnp.where(boundary, 0, state.timer)
+        else:
+            wire_prev, timer_prev = state.wire, state.timer
+        wire0 = wire_prev
 
         # 1-2: probe + suspect -------------------------------------------
         subj, d_drop, proxy_ids, to_p, p_to_s = probe_draws(
@@ -208,25 +265,26 @@ def make_swim_round(proto: ProtocolConfig, n: int,
         msgs_diss = jnp.sum(targets < n).astype(jnp.float32)
 
         # 4: refutation (alive subjects bump incarnation over suspicion) --
-        self_view = wire2[ids[:s_count], jnp.arange(s_count)]  # [S]
+        self_view = wire2[subj_gids, slots]                    # [S]
         refuted = jnp.where(
             subj_alive & (self_view % 2 == 1) & (self_view < DEAD_WIRE),
             (self_view // 2 + 1) * 2, self_view)
-        wire3 = wire2.at[ids[:s_count], jnp.arange(s_count)].set(refuted)
+        wire3 = wire2.at[subj_gids, slots].set(refuted)
 
         # 5: suspicion timers + confirm ----------------------------------
         is_susp = (wire3 % 2 == 1) & (wire3 < DEAD_WIRE)
-        held = is_susp & (wire3 == state.wire)
-        timer = jnp.where(held, state.timer + 1,
+        held = is_susp & (wire3 == wire_prev)
+        timer = jnp.where(held, timer_prev + 1,
                           jnp.where(is_susp, 1, 0))
         confirm = timer >= t_confirm
         wire4 = jnp.where(confirm, DEAD_WIRE, wire3)
         timer = jnp.where(confirm, 0, timer)
 
         # dead nodes are frozen observers (no probe/diss/merge above was
-        # theirs; freeze their rows too)
-        wire_f = jnp.where(alive_now[:, None], wire4, wire0)
-        timer_f = jnp.where(alive_now[:, None], timer, state.timer)
+        # theirs; freeze their rows too — within the epoch; a rotating
+        # boundary resets every row, dead observers' stale views included)
+        wire_f = jnp.where(alive_now[:, None], wire4, wire_prev)
+        timer_f = jnp.where(alive_now[:, None], timer, timer_prev)
         return SwimState(wire=wire_f, timer=timer_f,
                          round=state.round + 1, base_key=state.base_key,
                          msgs=state.msgs + msgs_probe + msgs_diss)
@@ -234,19 +292,30 @@ def make_swim_round(proto: ProtocolConfig, n: int,
     return step
 
 
-def detection_fraction(state: SwimState, dead_subjects, alive_now=None
-                       ) -> jax.Array:
+def detection_fraction(state: SwimState, dead_subjects, alive_now=None,
+                       subj_gids=None) -> jax.Array:
     """Fraction of (alive-observer, dead-subject) pairs confirmed DEAD —
-    the SWIM convergence metric (completeness)."""
+    the SWIM convergence metric (completeness).
+
+    ``dead_subjects`` are GLOBAL node ids.  ``subj_gids`` maps window slots
+    to global ids (``subject_window``); default is the fixed window
+    ``0..S-1``, in which case out-of-window dead ids are an error.  With a
+    rotating window, dead ids outside the current window simply contribute
+    no pairs (fraction over in-window dead subjects only; 0.0 when none)."""
     status = decode_status(state.wire)                    # [N, S]
-    if any(s >= status.shape[1] for s in dead_subjects):
-        raise ValueError(
-            f"dead_subjects {dead_subjects} out of range: only nodes "
-            f"0..{status.shape[1] - 1} are tracked subjects")
-    dead = jnp.zeros(status.shape[1], jnp.bool_
-                     ).at[jnp.asarray(dead_subjects)].set(True)
+    s_count = status.shape[1]
+    if subj_gids is None:
+        if any(s >= s_count for s in dead_subjects):
+            raise ValueError(
+                f"dead_subjects {tuple(dead_subjects)} out of range: the "
+                f"fixed window tracks nodes 0..{s_count - 1} only "
+                "(set proto.swim_rotate for full-membership coverage)")
+        subj_gids = jnp.arange(s_count, dtype=jnp.int32)
+    dead_arr = jnp.asarray(tuple(dead_subjects), dtype=jnp.int32)
+    dead = jnp.any(subj_gids[:, None] == dead_arr[None, :], axis=1)  # [S]
     obs = (status == DEAD) & dead[None, :]
     if alive_now is None:
-        return obs.sum() / (status.shape[0] * max(1, len(dead_subjects)))
+        denom = status.shape[0] * jnp.maximum(dead.sum(), 1)
+        return obs.sum() / denom
     w = alive_now.astype(jnp.float32)[:, None] * dead[None, :]
     return (obs * w).sum() / jnp.maximum(w.sum(), 1.0)

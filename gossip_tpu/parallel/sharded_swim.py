@@ -36,9 +36,15 @@ def make_sharded_swim_round(
         topo: Optional[Topology] = None,
         axis_name: str = "nodes") -> Callable[[SwimState], SwimState]:
     s_count = proto.swim_subjects
+    if s_count > n:
+        raise ValueError(
+            f"swim_subjects={s_count} exceeds cluster size n={n}; the "
+            "subject window cannot be wider than the membership")
     proxies = proto.swim_proxies
     t_confirm = proto.swim_suspect_rounds
     fanout = proto.fanout
+    rotate = proto.swim_rotate
+    epoch_rounds = SW.resolve_epoch_rounds(proto, n)
     drop_prob = 0.0 if fault is None else fault.drop_prob
     n_pad = pad_to_mesh(n, mesh, axis_name)
     nl = n_pad // mesh.shape[axis_name]
@@ -59,7 +65,13 @@ def make_sharded_swim_round(
         alive_full = jnp.where(round_ >= fail_round, alive_base_full,
                                True) & valid
         alive_l = alive_full[gids]
-        subj_alive = alive_full[:s_count]
+        subj_gids = SW.subject_window(round_, s_count, n, rotate,
+                                      epoch_rounds)
+        subj_alive = alive_full[subj_gids]
+        if rotate:   # epoch boundary: fresh view state for the new window
+            boundary = (round_ > 0) & (round_ % epoch_rounds == 0)
+            wire_l = jnp.where(boundary, 0, wire_l)
+            timer_l = jnp.where(boundary, 0, timer_l)
         wire0 = wire_l
         nbrs_l, deg_l = table if have_table else (None, None)
 
@@ -97,8 +109,7 @@ def make_sharded_swim_round(
         wire2 = jnp.maximum(wire1, recv_l)
 
         # 4: refutation (only rows whose gid is an alive subject) ----------
-        sel = ((gids[:, None] == jnp.arange(s_count)[None, :])
-               & alive_full[gids][:, None])
+        sel = (gids[:, None] == subj_gids[None, :]) & alive_l[:, None]
         odd = (wire2 % 2 == 1) & (wire2 < DEAD_WIRE)
         wire3 = jnp.where(sel & odd, (wire2 // 2 + 1) * 2, wire2)
 

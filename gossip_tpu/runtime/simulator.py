@@ -122,6 +122,8 @@ def simulate_swim_curve(proto: ProtocolConfig, n: int, rounds: int,
                                        fail_round, fault, topo)
         init = init_sharded_swim_state(n, proto, mesh, seed)
     dead = tuple(dead_nodes)
+    rotate = proto.swim_rotate
+    epoch_rounds = SW.resolve_epoch_rounds(proto, n)
     # Observer population: nodes that stay alive after fail_round.  Without
     # this mask, fault-dead observers sit in the denominator and the
     # detection fraction plateaus at the alive fraction, never reaching the
@@ -134,10 +136,13 @@ def simulate_swim_curve(proto: ProtocolConfig, n: int, rounds: int,
             s = step(s)
             # observers: rows [0, n) — drops the mesh padding rows (a no-op
             # slice in the unsharded case); detection over the dead subjects
+            # in the window of the round just executed (s.round - 1)
+            window = SW.subject_window(s.round - 1, proto.swim_subjects, n,
+                                       rotate, epoch_rounds)
             frac = SW.detection_fraction(
                 SW.SwimState(s.wire[:n], s.timer[:n], s.round,
                              s.base_key, s.msgs), dead,
-                alive_obs) if dead else 0.0
+                alive_obs, subj_gids=window) if dead else 0.0
             return s, frac
         return jax.lax.scan(body, state, None, length=rounds)
 
