@@ -21,7 +21,7 @@ PUSH_PULL = "pushpull"   # both directions in one round
 FLOOD = "flood"          # push to ALL neighbors every round (Go-parity mode:
                          # the reference relays to its full neighbor list,
                          # main.go:72-75; coverage(t) == BFS ball of radius t)
-ANTI_ENTROPY = "antientropy"  # periodic full-digest pull exchange
+ANTI_ENTROPY = "antientropy"  # periodic bidirectional digest reconciliation
 SWIM = "swim"            # SWIM-style suspect/confirm failure detection
 
 MODES = (PUSH, PULL, PUSH_PULL, FLOOD, ANTI_ENTROPY, SWIM)

@@ -88,14 +88,24 @@ def make_si_round(proto: ProtocolConfig, topo: Topology,
             if alive is not None:
                 partners = jnp.where(alive[:, None], partners, n)
             n_req = jnp.sum(partners < n).astype(jnp.float32)
-            if mode == C.ANTI_ENTROPY and proto.period > 1:
-                # Periodic full-digest exchange (classic anti-entropy cadence);
-                # off-rounds are quiescent.
-                on = (state.round % proto.period) == 0
-                pulled = jnp.where(on, pulled, False)
-                n_req = jnp.where(on, n_req, 0.0)
-            delta = delta | pulled
-            msgs = msgs + 2.0 * n_req  # request + digest response
+            if mode == C.ANTI_ENTROPY:
+                # Classic anti-entropy (Demers et al. §1.2 "anti-entropy"):
+                # the periodic exchange reconciles BOTH directions — the
+                # initiator pulls the partner's digest AND pushes its own
+                # state back, so the pair converges to the union in one
+                # exchange.  3 messages per exchange: request + digest
+                # response + reverse delta.  Off-rounds are quiescent.
+                back = push_delta(n, partners, visible)
+                if proto.period > 1:
+                    on = (state.round % proto.period) == 0
+                    pulled = jnp.where(on, pulled, False)
+                    back = jnp.where(on, back, False)
+                    n_req = jnp.where(on, n_req, 0.0)
+                delta = delta | pulled | back
+                msgs = msgs + 3.0 * n_req
+            else:
+                delta = delta | pulled
+                msgs = msgs + 2.0 * n_req  # request + digest response
 
         if mode == C.FLOOD:
             nbrs = topo.nbrs

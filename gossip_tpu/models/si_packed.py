@@ -19,7 +19,9 @@ Semantics are EXACTLY models/si.make_si_round's PULL / ANTI_ENTROPY modes —
 same RNG tags, same per-global-node-id keying, same message accounting —
 verified bitwise in tests/test_packed.py.  Push modes are deliberately
 absent: scatter-OR is not an XLA primitive and the scatter is the expensive
-half; use models/si.py when push semantics are required.
+half; use models/si.py when push semantics are required.  The one
+exception is anti-entropy's reverse delta (the exchange is bidirectional),
+which unpacks to bools for the scatter on exchange rounds only.
 """
 
 from __future__ import annotations
@@ -108,15 +110,30 @@ def make_packed_round(proto: ProtocolConfig, topo: Topology,
         if alive is not None:
             partners = jnp.where(alive[:, None], partners, n)
         n_req = jnp.sum(partners < n).astype(jnp.float32)
-        if mode == C.ANTI_ENTROPY and proto.period > 1:
-            on = (state.round % proto.period) == 0
-            pulled = jnp.where(on, pulled, jnp.uint32(0))
-            n_req = jnp.where(on, n_req, 0.0)
+        if mode == C.ANTI_ENTROPY:
+            # Bidirectional reconciliation (twin of models/si.py): the
+            # initiator's digest also scatters back into the partner's row.
+            # XLA has no scatter-OR on words, so the push-back unpacks to
+            # bools for the scatter and repacks — paid only on exchange
+            # rounds; the pull direction stays a pure word gather.
+            from gossip_tpu.ops.bitpack import unpack
+            from gossip_tpu.ops.propagate import push_delta
+            back = pack(push_delta(n, partners, unpack(visible,
+                                                       proto.rumors)))
+            mfac = 3.0    # request + digest response + reverse delta
+            if proto.period > 1:
+                on = (state.round % proto.period) == 0
+                pulled = jnp.where(on, pulled, jnp.uint32(0))
+                back = jnp.where(on, back, jnp.uint32(0))
+                n_req = jnp.where(on, n_req, 0.0)
+            pulled = pulled | back
+        else:
+            mfac = 2.0    # request + digest response
         if alive is not None:
             pulled = jnp.where(alive[:, None], pulled, jnp.uint32(0))
         return SimState(seen=packed | pulled, round=state.round + 1,
                         base_key=state.base_key,
-                        msgs=state.msgs + 2.0 * n_req)
+                        msgs=state.msgs + mfac * n_req)
 
     return step
 

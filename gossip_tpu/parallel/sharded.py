@@ -147,12 +147,24 @@ def make_sharded_si_round(
             pulled = pull_merge(seen_all, partners, n)
             partners = jnp.where(alive_l[:, None], partners, n)
             n_req = jnp.sum(partners < n).astype(jnp.float32)
-            if mode == C.ANTI_ENTROPY and proto.period > 1:
-                on = (round_ % proto.period) == 0
-                pulled = jnp.where(on, pulled, False)
-                n_req = jnp.where(on, n_req, 0.0)
-            delta = delta | pulled
-            msgs_local = msgs_local + 2.0 * n_req
+            if mode == C.ANTI_ENTROPY:
+                # bidirectional reconciliation (twin of models/si.py): the
+                # initiator's state scatters back into the partner's row
+                bt = jnp.where(partners < n, partners, n_pad)
+                bcounts = push_counts(n_pad, bt, visible)
+                back = jax.lax.psum_scatter(bcounts, axis_name,
+                                            scatter_dimension=0,
+                                            tiled=True) > 0
+                if proto.period > 1:
+                    on = (round_ % proto.period) == 0
+                    pulled = jnp.where(on, pulled, False)
+                    back = jnp.where(on, back, False)
+                    n_req = jnp.where(on, n_req, 0.0)
+                delta = delta | pulled | back
+                msgs_local = msgs_local + 3.0 * n_req
+            else:
+                delta = delta | pulled
+                msgs_local = msgs_local + 2.0 * n_req
 
         if mode == C.FLOOD:
             seen_all = jax.lax.all_gather(visible, axis_name, tiled=True)

@@ -62,12 +62,31 @@ def make_sharded_packed_round(
         pulled = pull_merge_packed(packed_all, partners, n)
         partners = jnp.where(alive_l[:, None], partners, n)
         n_req = jnp.sum(partners < n).astype(jnp.float32)
-        if mode == C.ANTI_ENTROPY and proto.period > 1:
-            on = (round_ % proto.period) == 0
-            pulled = jnp.where(on, pulled, jnp.uint32(0))
-            n_req = jnp.where(on, n_req, 0.0)
+        if mode == C.ANTI_ENTROPY:
+            # Bidirectional reconciliation (twin of models/si_packed.py):
+            # the reverse delta scatters bool contributions and reduces
+            # them with psum_scatter (int counts, OR = count > 0), then
+            # repacks — exchange-round-only traffic, the pull direction
+            # keeps the packed-word all_gather.
+            from gossip_tpu.ops.bitpack import pack, unpack
+            from gossip_tpu.ops.propagate import push_counts
+            bt = jnp.where(partners < n, partners, n_pad)
+            bcounts = push_counts(n_pad, bt, unpack(visible, proto.rumors))
+            back_b = jax.lax.psum_scatter(bcounts, axis_name,
+                                          scatter_dimension=0,
+                                          tiled=True) > 0
+            back = pack(back_b)
+            mfac = 3.0
+            if proto.period > 1:
+                on = (round_ % proto.period) == 0
+                pulled = jnp.where(on, pulled, jnp.uint32(0))
+                back = jnp.where(on, back, jnp.uint32(0))
+                n_req = jnp.where(on, n_req, 0.0)
+            pulled = pulled | back
+        else:
+            mfac = 2.0
         pulled = jnp.where(alive_l[:, None], pulled, jnp.uint32(0))
-        msgs_new = msgs + jax.lax.psum(2.0 * n_req, axis_name)
+        msgs_new = msgs + jax.lax.psum(mfac * n_req, axis_name)
         return packed_l | pulled, msgs_new
 
     sh2 = P(axis_name, None)

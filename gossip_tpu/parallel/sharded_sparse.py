@@ -78,19 +78,22 @@ class SparseMeta(NamedTuple):
     request_bytes: int        # per device per round, sparse path
     response_bytes: int       # per device per round, sparse path
     dense_bytes: int          # per device per round, all_gather equivalent
+    reverse_bytes: int = 0    # anti-entropy reverse-delta payload (0 = pull)
 
     @property
     def sparse_bytes(self) -> int:
-        return self.request_bytes + self.response_bytes
+        return self.request_bytes + self.response_bytes + self.reverse_bytes
 
 
-def sparse_meta(n_pad: int, p: int, k: int, w: int) -> SparseMeta:
+def sparse_meta(n_pad: int, p: int, k: int, w: int,
+                bidirectional: bool = False) -> SparseMeta:
     nl = n_pad // p
     cap = (nl * k) // p
     return SparseMeta(p=p, cap=cap,
                       request_bytes=p * cap * 4,
                       response_bytes=p * cap * 4 * w,
-                      dense_bytes=n_pad * 4 * w)
+                      dense_bytes=n_pad * 4 * w,
+                      reverse_bytes=p * cap * 4 * w if bidirectional else 0)
 
 
 def _validate(n_pad: int, p: int, k: int) -> int:
@@ -208,12 +211,37 @@ def make_sparse_pull_round(
         pulled = _or_reduce_k(flat, nl, k)
 
         n_req = jnp.sum(valid).astype(jnp.float32)
+        back_l = None
+        if proto.mode == C.ANTI_ENTROPY:
+            # Bidirectional reconciliation: the requester's own digest rides
+            # ALONG with the request (one extra [p, cap, W] all_to_all) and
+            # the responder merges it locally — the partner pair converges
+            # to the union in one exchange, still O(messages) traffic
+            # (SparseMeta.reverse_bytes).
+            req_digest = visible[jnp.arange(nl * k, dtype=jnp.int32) // k]
+            req_digest = jnp.where(valid[:, None], req_digest, jnp.uint32(0))
+            D = req_digest.reshape(cap, p, w)                 # [cap, p, W]
+            send_d = jnp.take(jnp.transpose(D, (1, 0, 2)), cols_for_dst,
+                              axis=0)                         # [p, cap, W]
+            recv_d = jax.lax.all_to_all(send_d, axis_name, 0, 0,
+                                        tiled=False)
+            from gossip_tpu.ops.bitpack import pack, unpack
+            rows_in = jnp.where(ok, recv, nl).reshape(-1)     # sentinel nl
+            contrib = unpack(recv_d.reshape(-1, w), proto.rumors)
+            cnt = jnp.zeros((nl, proto.rumors), jnp.int32
+                            ).at[rows_in].add(contrib.astype(jnp.int32),
+                                              mode="drop")
+            back_l = pack(cnt > 0)
         if proto.mode == C.ANTI_ENTROPY and proto.period > 1:
             on = (round_ % proto.period) == 0
             pulled = jnp.where(on, pulled, jnp.uint32(0))
+            back_l = jnp.where(on, back_l, jnp.uint32(0))
             n_req = jnp.where(on, n_req, 0.0)
+        if back_l is not None:
+            pulled = pulled | back_l
+        mfac = 3.0 if proto.mode == C.ANTI_ENTROPY else 2.0
         pulled = jnp.where(alive_l[:, None], pulled, jnp.uint32(0))
-        msgs_new = msgs + jax.lax.psum(2.0 * n_req, axis_name)
+        msgs_new = msgs + jax.lax.psum(mfac * n_req, axis_name)
         return seen_l | pulled, msgs_new
 
     sh, sh2, rep = P(axis_name), P(axis_name, None), P()
@@ -262,14 +290,32 @@ def sparse_pull_round_reference(
         pulled = _or_reduce_k(got, n_pad, k)
 
         n_req = jnp.sum(valid).astype(jnp.float32)
+        back = None
+        if proto.mode == C.ANTI_ENTROPY:
+            # reverse delta: the requester's digest merges into the partner
+            # (single-device twin of the mesh kernel's piggybacked digest)
+            from gossip_tpu.ops.bitpack import pack, unpack
+            req_digest = visible[slot_gids // k]              # [n_pad*k, W]
+            req_digest = jnp.where(valid[:, None], req_digest,
+                                   jnp.uint32(0))
+            tgt = jnp.where(valid, gids, n_pad)
+            cnt = jnp.zeros((n_pad, proto.rumors), jnp.int32
+                            ).at[tgt].add(
+                unpack(req_digest, proto.rumors).astype(jnp.int32),
+                mode="drop")
+            back = pack(cnt > 0)
         if proto.mode == C.ANTI_ENTROPY and proto.period > 1:
             on = (round_ % proto.period) == 0
             pulled = jnp.where(on, pulled, jnp.uint32(0))
+            back = jnp.where(on, back, jnp.uint32(0))
             n_req = jnp.where(on, n_req, 0.0)
+        if back is not None:
+            pulled = pulled | back
+        mfac = 3.0 if proto.mode == C.ANTI_ENTROPY else 2.0
         pulled = jnp.where(alive_pad[:, None], pulled, jnp.uint32(0))
         return SimState(seen=seen | pulled, round=round_ + 1,
                         base_key=state.base_key,
-                        msgs=state.msgs + 2.0 * n_req)
+                        msgs=state.msgs + mfac * n_req)
 
     return step
 
@@ -318,7 +364,8 @@ def simulate_until_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
         return jax.lax.while_loop(cond, step, state)
 
     final = loop(init)
-    meta = sparse_meta(n_pad, p, proto.fanout, n_words(proto.rumors))
+    meta = sparse_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
+                       bidirectional=proto.mode == C.ANTI_ENTROPY)
     return (int(final.round),
             float(coverage_packed(final.seen, r, alive_pad)),
             float(final.msgs), final, meta)

@@ -117,7 +117,8 @@ def _rounds_to_target(curves: np.ndarray, target: float) -> np.ndarray:
 # into one compiled program.
 # ---------------------------------------------------------------------------
 
-# mode -> (do_push, do_pull); anti-entropy is pull gated by period.
+# mode -> (do_push, do_pull); anti-entropy is a period-gated bidirectional
+# exchange (pull + reverse delta, models/si.py semantics).
 _MODE_FLAGS = {C.PUSH: (True, False), C.PULL: (False, True),
                C.PUSH_PULL: (True, True), C.ANTI_ENTROPY: (False, True)}
 
@@ -212,7 +213,7 @@ def config_sweep_curves(points, topo: Topology, run: RunConfig,
     col = jnp.arange(k_max, dtype=jnp.int32)[None, :]
 
     def one_round(seen, round_, base_key, msgs,
-                  do_push, do_pull, fanout, dropp, period):
+                  do_push, do_pull, do_ae, fanout, dropp, period):
         rkey = jax.random.fold_in(base_key, round_)
         visible = seen & alive_b[:, None]
         delta = jnp.zeros_like(seen)
@@ -241,15 +242,19 @@ def config_sweep_curves(points, topo: Topology, run: RunConfig,
         pulled = pull_merge(visible, partners, n)
         partners = jnp.where(alive_b[:, None], partners, n)
         n_req = jnp.sum(partners < n).astype(jnp.float32)
+        # anti-entropy reverse delta: the initiator's state scatters back
+        # into the partner's row (bidirectional exchange, models/si.py)
+        bcounts = push_counts(n, partners, visible)
         on = do_pull & ((round_ % period) == 0)
-        delta = delta | (pulled & on)
-        msgs_round = msgs_round + jnp.where(on, 2.0 * n_req, 0.0)
+        delta = delta | (pulled & on) | ((bcounts > 0) & (on & do_ae))
+        mfac = jnp.where(do_ae, 3.0, 2.0)
+        msgs_round = msgs_round + jnp.where(on, mfac * n_req, 0.0)
 
         delta = delta & alive_b[:, None]
         return seen | delta, round_ + 1, msgs + msgs_round
 
     batched = jax.vmap(one_round,
-                       in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0))
+                       in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, 0))
 
     base = init_state(run, proto_like, n)
     init_seen = jnp.broadcast_to(base.seen, (cN,) + base.seen.shape)
@@ -257,6 +262,7 @@ def config_sweep_curves(points, topo: Topology, run: RunConfig,
         jnp.asarray([pt.seed for pt in points], jnp.uint32))
     do_push = jnp.asarray([_MODE_FLAGS[pt.mode][0] for pt in points])
     do_pull = jnp.asarray([_MODE_FLAGS[pt.mode][1] for pt in points])
+    do_ae = jnp.asarray([pt.mode == C.ANTI_ENTROPY for pt in points])
     fanouts = jnp.asarray([pt.fanout for pt in points], jnp.int32)
     drops = jnp.asarray([pt.drop_prob for pt in points], jnp.float32)
     periods = jnp.asarray([pt.period for pt in points], jnp.int32)
@@ -266,7 +272,8 @@ def config_sweep_curves(points, topo: Topology, run: RunConfig,
         def body(carry, _):
             seen, rounds, msgs = carry
             seen, rounds, msgs = batched(seen, rounds, keys, msgs, do_push,
-                                         do_pull, fanouts, drops, periods)
+                                         do_pull, do_ae, fanouts, drops,
+                                         periods)
             covs = jax.vmap(lambda x: coverage(x, alive))(seen)
             return (seen, rounds, msgs), (covs, msgs)
         return jax.lax.scan(body, (seen, rounds, msgs), None,
