@@ -78,7 +78,8 @@ class ProtocolConfig:
     fanout: int = 1
     rumors: int = 1          # R: number of concurrent rumors (multi-rumor broadcast)
     exclude_self: bool = True
-    # anti-entropy: run a full-digest pull exchange every `period` rounds.
+    # anti-entropy: run a bidirectional digest reconciliation every
+    # `period` rounds (both partners merge; off-rounds are quiescent).
     period: int = 1
     # SWIM parameters (see models/swim.py):
     swim_proxies: int = 3        # indirect-probe proxies (the "k" of SWIM)

@@ -94,13 +94,18 @@ def make_si_round(proto: ProtocolConfig, topo: Topology,
                 # initiator pulls the partner's digest AND pushes its own
                 # state back, so the pair converges to the union in one
                 # exchange.  3 messages per exchange: request + digest
-                # response + reverse delta.  Off-rounds are quiescent.
-                back = push_delta(n, partners, visible)
+                # response + reverse delta.  Off-rounds are quiescent, and
+                # lax.cond (not a mask) skips the reverse scatter's work on
+                # them.
                 if proto.period > 1:
                     on = (state.round % proto.period) == 0
+                    back = jax.lax.cond(
+                        on, lambda _: push_delta(n, partners, visible),
+                        lambda _: jnp.zeros_like(pulled), None)
                     pulled = jnp.where(on, pulled, False)
-                    back = jnp.where(on, back, False)
                     n_req = jnp.where(on, n_req, 0.0)
+                else:
+                    back = push_delta(n, partners, visible)
                 delta = delta | pulled | back
                 msgs = msgs + 3.0 * n_req
             else:

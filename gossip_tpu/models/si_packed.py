@@ -35,7 +35,8 @@ from gossip_tpu import config as C
 from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
 from gossip_tpu.models import si as si_mod
 from gossip_tpu.models.state import SimState, alive_mask, init_state
-from gossip_tpu.ops.bitpack import coverage_packed, pack
+from gossip_tpu.ops.bitpack import coverage_packed, pack, unpack
+from gossip_tpu.ops.propagate import push_delta
 from gossip_tpu.ops.sampling import apply_drop, sample_peers
 from gossip_tpu.topology.generators import Topology
 
@@ -114,18 +115,22 @@ def make_packed_round(proto: ProtocolConfig, topo: Topology,
             # Bidirectional reconciliation (twin of models/si.py): the
             # initiator's digest also scatters back into the partner's row.
             # XLA has no scatter-OR on words, so the push-back unpacks to
-            # bools for the scatter and repacks — paid only on exchange
-            # rounds; the pull direction stays a pure word gather.
-            from gossip_tpu.ops.bitpack import unpack
-            from gossip_tpu.ops.propagate import push_delta
-            back = pack(push_delta(n, partners, unpack(visible,
-                                                       proto.rumors)))
+            # bools for the scatter and repacks — lax.cond confines that
+            # cost to exchange rounds; the pull direction stays a pure
+            # word gather.
+            def reverse_delta(_):
+                return pack(push_delta(n, partners,
+                                       unpack(visible, proto.rumors)))
+
             mfac = 3.0    # request + digest response + reverse delta
             if proto.period > 1:
                 on = (state.round % proto.period) == 0
+                back = jax.lax.cond(on, reverse_delta,
+                                    lambda _: jnp.zeros_like(pulled), None)
                 pulled = jnp.where(on, pulled, jnp.uint32(0))
-                back = jnp.where(on, back, jnp.uint32(0))
                 n_req = jnp.where(on, n_req, 0.0)
+            else:
+                back = reverse_delta(None)
             pulled = pulled | back
         else:
             mfac = 2.0    # request + digest response

@@ -151,15 +151,25 @@ def make_sharded_si_round(
                 # bidirectional reconciliation (twin of models/si.py): the
                 # initiator's state scatters back into the partner's row
                 bt = jnp.where(partners < n, partners, n_pad)
-                bcounts = push_counts(n_pad, bt, visible)
-                back = jax.lax.psum_scatter(bcounts, axis_name,
-                                            scatter_dimension=0,
-                                            tiled=True) > 0
+
+                def reverse_delta(_):
+                    bcounts = push_counts(n_pad, bt, visible)
+                    return jax.lax.psum_scatter(bcounts, axis_name,
+                                                scatter_dimension=0,
+                                                tiled=True) > 0
+
                 if proto.period > 1:
+                    # lax.cond, not a mask: the psum_scatter must not move
+                    # bytes on quiescent rounds (the predicate is replicated,
+                    # so every shard takes the same branch)
                     on = (round_ % proto.period) == 0
+                    back = jax.lax.cond(
+                        on, reverse_delta,
+                        lambda _: jnp.zeros_like(pulled), None)
                     pulled = jnp.where(on, pulled, False)
-                    back = jnp.where(on, back, False)
                     n_req = jnp.where(on, n_req, 0.0)
+                else:
+                    back = reverse_delta(None)
                 delta = delta | pulled | back
                 msgs_local = msgs_local + 3.0 * n_req
             else:

@@ -21,7 +21,8 @@ from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
 from gossip_tpu.models import si as si_mod
 from gossip_tpu.models.si_packed import init_packed_state, pull_merge_packed
 from gossip_tpu.models.state import SimState
-from gossip_tpu.ops.bitpack import coverage_packed
+from gossip_tpu.ops.bitpack import coverage_packed, pack, unpack
+from gossip_tpu.ops.propagate import push_counts
 from gossip_tpu.ops.sampling import apply_drop, sample_peers
 from gossip_tpu.parallel.sharded import (_pad_rows, pad_to_mesh,
                                          sharded_alive)
@@ -66,22 +67,27 @@ def make_sharded_packed_round(
             # Bidirectional reconciliation (twin of models/si_packed.py):
             # the reverse delta scatters bool contributions and reduces
             # them with psum_scatter (int counts, OR = count > 0), then
-            # repacks — exchange-round-only traffic, the pull direction
-            # keeps the packed-word all_gather.
-            from gossip_tpu.ops.bitpack import pack, unpack
-            from gossip_tpu.ops.propagate import push_counts
+            # repacks — the pull direction keeps the packed-word
+            # all_gather.  On off-period rounds a lax.cond skips the
+            # collective entirely (replicated predicate, uniform branch).
             bt = jnp.where(partners < n, partners, n_pad)
-            bcounts = push_counts(n_pad, bt, unpack(visible, proto.rumors))
-            back_b = jax.lax.psum_scatter(bcounts, axis_name,
-                                          scatter_dimension=0,
-                                          tiled=True) > 0
-            back = pack(back_b)
+
+            def reverse_delta(_):
+                bcounts = push_counts(n_pad, bt,
+                                      unpack(visible, proto.rumors))
+                return pack(jax.lax.psum_scatter(bcounts, axis_name,
+                                                 scatter_dimension=0,
+                                                 tiled=True) > 0)
+
             mfac = 3.0
             if proto.period > 1:
                 on = (round_ % proto.period) == 0
+                back = jax.lax.cond(on, reverse_delta,
+                                    lambda _: jnp.zeros_like(pulled), None)
                 pulled = jnp.where(on, pulled, jnp.uint32(0))
-                back = jnp.where(on, back, jnp.uint32(0))
                 n_req = jnp.where(on, n_req, 0.0)
+            else:
+                back = reverse_delta(None)
             pulled = pulled | back
         else:
             mfac = 2.0

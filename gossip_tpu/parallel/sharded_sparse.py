@@ -60,7 +60,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from gossip_tpu import config as C
 from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
 from gossip_tpu.models.state import SimState
-from gossip_tpu.ops.bitpack import coverage_packed, n_words
+from gossip_tpu.ops.bitpack import coverage_packed, n_words, pack, unpack
 from gossip_tpu.parallel.sharded import (_pad_rows, pad_to_mesh,
                                          sharded_alive)
 
@@ -78,7 +78,10 @@ class SparseMeta(NamedTuple):
     request_bytes: int        # per device per round, sparse path
     response_bytes: int       # per device per round, sparse path
     dense_bytes: int          # per device per round, all_gather equivalent
-    reverse_bytes: int = 0    # anti-entropy reverse-delta payload (0 = pull)
+    # anti-entropy reverse-delta payload (0 = pull).  Moved on EXCHANGE
+    # rounds only — with period>1 a lax.cond skips the collective on
+    # quiescent rounds, so the per-round average is reverse_bytes/period.
+    reverse_bytes: int = 0
 
     @property
     def sparse_bytes(self) -> int:
@@ -211,33 +214,39 @@ def make_sparse_pull_round(
         pulled = _or_reduce_k(flat, nl, k)
 
         n_req = jnp.sum(valid).astype(jnp.float32)
-        back_l = None
         if proto.mode == C.ANTI_ENTROPY:
             # Bidirectional reconciliation: the requester's own digest rides
             # ALONG with the request (one extra [p, cap, W] all_to_all) and
             # the responder merges it locally — the partner pair converges
             # to the union in one exchange, still O(messages) traffic
-            # (SparseMeta.reverse_bytes).
-            req_digest = visible[jnp.arange(nl * k, dtype=jnp.int32) // k]
-            req_digest = jnp.where(valid[:, None], req_digest, jnp.uint32(0))
-            D = req_digest.reshape(cap, p, w)                 # [cap, p, W]
-            send_d = jnp.take(jnp.transpose(D, (1, 0, 2)), cols_for_dst,
-                              axis=0)                         # [p, cap, W]
-            recv_d = jax.lax.all_to_all(send_d, axis_name, 0, 0,
-                                        tiled=False)
-            from gossip_tpu.ops.bitpack import pack, unpack
-            rows_in = jnp.where(ok, recv, nl).reshape(-1)     # sentinel nl
-            contrib = unpack(recv_d.reshape(-1, w), proto.rumors)
-            cnt = jnp.zeros((nl, proto.rumors), jnp.int32
-                            ).at[rows_in].add(contrib.astype(jnp.int32),
-                                              mode="drop")
-            back_l = pack(cnt > 0)
-        if proto.mode == C.ANTI_ENTROPY and proto.period > 1:
-            on = (round_ % proto.period) == 0
-            pulled = jnp.where(on, pulled, jnp.uint32(0))
-            back_l = jnp.where(on, back_l, jnp.uint32(0))
-            n_req = jnp.where(on, n_req, 0.0)
-        if back_l is not None:
+            # (SparseMeta.reverse_bytes).  lax.cond skips the collective on
+            # off-period rounds (replicated predicate, uniform branch).
+            def reverse_delta(_):
+                req_digest = visible[
+                    jnp.arange(nl * k, dtype=jnp.int32) // k]
+                req_digest = jnp.where(valid[:, None], req_digest,
+                                       jnp.uint32(0))
+                D = req_digest.reshape(cap, p, w)             # [cap, p, W]
+                send_d = jnp.take(jnp.transpose(D, (1, 0, 2)), cols_for_dst,
+                                  axis=0)                     # [p, cap, W]
+                recv_d = jax.lax.all_to_all(send_d, axis_name, 0, 0,
+                                            tiled=False)
+                rows_in = jnp.where(ok, recv, nl).reshape(-1)  # sentinel nl
+                contrib = unpack(recv_d.reshape(-1, w), proto.rumors)
+                cnt = jnp.zeros((nl, proto.rumors), jnp.int32
+                                ).at[rows_in].add(contrib.astype(jnp.int32),
+                                                  mode="drop")
+                return pack(cnt > 0)
+
+            if proto.period > 1:
+                on = (round_ % proto.period) == 0
+                back_l = jax.lax.cond(on, reverse_delta,
+                                      lambda _: jnp.zeros_like(pulled),
+                                      None)
+                pulled = jnp.where(on, pulled, jnp.uint32(0))
+                n_req = jnp.where(on, n_req, 0.0)
+            else:
+                back_l = reverse_delta(None)
             pulled = pulled | back_l
         mfac = 3.0 if proto.mode == C.ANTI_ENTROPY else 2.0
         pulled = jnp.where(alive_l[:, None], pulled, jnp.uint32(0))
@@ -294,7 +303,6 @@ def sparse_pull_round_reference(
         if proto.mode == C.ANTI_ENTROPY:
             # reverse delta: the requester's digest merges into the partner
             # (single-device twin of the mesh kernel's piggybacked digest)
-            from gossip_tpu.ops.bitpack import pack, unpack
             req_digest = visible[slot_gids // k]              # [n_pad*k, W]
             req_digest = jnp.where(valid[:, None], req_digest,
                                    jnp.uint32(0))

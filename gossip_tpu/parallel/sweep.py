@@ -211,6 +211,7 @@ def config_sweep_curves(points, topo: Topology, run: RunConfig,
     deg = None if topo.implicit else topo.deg
     gids = jnp.arange(n, dtype=jnp.int32)
     col = jnp.arange(k_max, dtype=jnp.int32)[None, :]
+    have_ae = any(pt.mode == C.ANTI_ENTROPY for pt in points)
 
     def one_round(seen, round_, base_key, msgs,
                   do_push, do_pull, do_ae, fanout, dropp, period):
@@ -242,11 +243,14 @@ def config_sweep_curves(points, topo: Topology, run: RunConfig,
         pulled = pull_merge(visible, partners, n)
         partners = jnp.where(alive_b[:, None], partners, n)
         n_req = jnp.sum(partners < n).astype(jnp.float32)
-        # anti-entropy reverse delta: the initiator's state scatters back
-        # into the partner's row (bidirectional exchange, models/si.py)
-        bcounts = push_counts(n, partners, visible)
         on = do_pull & ((round_ % period) == 0)
-        delta = delta | (pulled & on) | ((bcounts > 0) & (on & do_ae))
+        delta = delta | (pulled & on)
+        if have_ae:
+            # anti-entropy reverse delta: the initiator's state scatters
+            # back into the partner's row (bidirectional exchange,
+            # models/si.py) — built only when the batch has an AE point
+            bcounts = push_counts(n, partners, visible)
+            delta = delta | ((bcounts > 0) & (on & do_ae))
         mfac = jnp.where(do_ae, 3.0, 2.0)
         msgs_round = msgs_round + jnp.where(on, mfac * n_req, 0.0)
 

@@ -174,13 +174,27 @@ class BroadcastServer:
                 continue
             for attempt in range(self.max_retries):
                 try:
-                    await self.node.rpc(nb, {"type": "broadcast",
-                                             "message": m},
-                                        timeout=self.rpc_timeout)
-                    break
+                    reply = await self.node.rpc(nb, {"type": "broadcast",
+                                                     "message": m},
+                                                timeout=self.rpc_timeout)
                 except asyncio.TimeoutError:
+                    pass                           # lost/late: retry
+                else:
+                    # An error reply is a failed delivery, not an ack: the
+                    # reference's SyncRPC returns error replies as Go errors
+                    # and stays in the retry loop (main.go:81-87).
+                    if reply.get("body", {}).get("type") != "error":
+                        break
+                if attempt + 1 < self.max_retries:   # no sleep before give-up
                     await asyncio.sleep(
                         self.backoff_base * (2 ** min(attempt, 12)))
+            else:
+                # at-least-once exhausted (the capped variant of the
+                # reference's unbounded loop) — surface it, don't lose it
+                # silently
+                print(f"gossip: giving up on {nb} after "
+                      f"{self.max_retries} attempts (message {m!r})",
+                      file=sys.stderr)
 
     async def on_read(self, msg) -> None:
         await self.node.reply(msg, {"type": "read_ok",

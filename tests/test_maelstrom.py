@@ -77,6 +77,50 @@ def test_grid_topology_propagation():
     run(main())
 
 
+class _StubNode:
+    """Scripted peer for gossip retry-policy unit tests."""
+
+    node_id = "n0"
+
+    def __init__(self, replies):
+        self.replies = list(replies)    # body types to return, last repeats
+        self.calls = 0
+
+    def handle(self, typ, fn):
+        pass
+
+    async def rpc(self, dest, body, timeout=2.0):
+        i = min(self.calls, len(self.replies) - 1)
+        self.calls += 1
+        return {"src": dest, "body": {"type": self.replies[i]}}
+
+
+def test_error_reply_is_retried_not_treated_as_ack():
+    # The reference's SyncRPC surfaces an error reply as a Go error and the
+    # retry loop keeps going (main.go:81-87); a matched reply of type
+    # "error" must NOT count as delivery.
+    from gossip_tpu.runtime.maelstrom_node import BroadcastServer
+    async def main():
+        node = _StubNode(["error", "error", "broadcast_ok"])
+        srv = BroadcastServer(node, backoff_base=0.0)
+        srv.topology = {"n0": ["n1"]}
+        await srv.gossip(5, exclude="nX")
+        assert node.calls == 3          # two error replies retried
+    run(main())
+
+
+def test_retry_exhaustion_warns_on_stderr(capsys):
+    from gossip_tpu.runtime.maelstrom_node import BroadcastServer
+    async def main():
+        node = _StubNode(["error"])
+        srv = BroadcastServer(node, backoff_base=0.0, max_retries=4)
+        srv.topology = {"n0": ["n1"]}
+        await srv.gossip(9, exclude="nX")
+        assert node.calls == 4
+    run(main())
+    assert "giving up on n1" in capsys.readouterr().err
+
+
 def test_partition_tolerance_retry_heals():
     # The partition-tolerance variant of the workload (SURVEY.md §4): cut
     # the only link to n2, broadcast, heal, and the node's retry loop must
