@@ -1,9 +1,10 @@
 """Native (C++) runtime components, loaded via ctypes.
 
-Build on demand with the in-tree Makefile (g++ only — no pybind11 in this
-environment; the Python<->C boundary is a flat C API).  ``load_eventsim()``
-returns the shared library handle or None when no compiler is available —
-callers fall back to the pure-Python implementation.
+Built on demand by :func:`load_eventsim` itself — a single ``g++ -O2
+-shared`` subprocess invocation (no pybind11 in this environment; the
+Python<->C boundary is a flat C API).  ``load_eventsim()`` returns the
+shared library handle or None when no compiler is available — callers fall
+back to the pure-Python implementation.
 """
 
 from __future__ import annotations
