@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import os
+import weakref
 from typing import Union
 
 import jax
@@ -73,16 +74,46 @@ def load_state(path: str) -> State:
     return cls(**kwargs)
 
 
+# One jitted fori_loop runner per step function, so repeated
+# run_with_checkpoints calls (resume loops) reuse the executable.  Weak
+# keys: a dropped step closure (and the topology arrays it captures) must
+# not be pinned in memory by this cache.
+_segment_runners: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _segment_runner(step):
+    runner = _segment_runners.get(step)
+    if runner is None:
+        @jax.jit
+        def runner(s, n_steps):
+            return jax.lax.fori_loop(0, n_steps, lambda _, st: step(st), s)
+        _segment_runners[step] = runner
+    return runner
+
+
 def run_with_checkpoints(step, state: State, rounds: int, path: str,
                          every: int = 50) -> State:
     """Drive ``step`` for ``rounds`` rounds, checkpointing every ``every``
     rounds (and at the end).  Resume by loading the file and calling again
-    with the remaining round budget — long sweeps survive preemption."""
-    for i in range(rounds):
-        state = step(state)
-        if (i + 1) % every == 0:
-            jax.block_until_ready(state.seen if hasattr(state, "seen")
-                                  else state.wire)
-            save_state(path, state)
-    save_state(path, state)
+    with the remaining round budget — long sweeps survive preemption.
+
+    Each inter-checkpoint segment runs as ONE compiled ``fori_loop`` (the
+    segment length is a traced argument, so the short tail segment reuses
+    the same executable, as does a resume call with the same ``step``):
+    the host syncs once per checkpoint, not once per round, preserving the
+    while-loop fusion the round kernels are built for (tests/test_utils.py
+    asserts both the bitwise trajectory and the one-trace property)."""
+    if every < 1:
+        raise ValueError(f"every must be >= 1, got {every}")
+    run_segment = _segment_runner(step)
+    done = 0
+    while done < rounds:
+        todo = min(every, rounds - done)
+        state = run_segment(state, todo)
+        done += todo
+        jax.block_until_ready(state.seen if hasattr(state, "seen")
+                              else state.wire)
+        save_state(path, state)
+    if rounds <= 0:
+        save_state(path, state)
     return state
