@@ -64,7 +64,7 @@ complete graph); the topology argument, when given, restricts only the
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple, Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -200,10 +200,20 @@ def make_swim_round(proto: ProtocolConfig, n: int,
                     fail_round: int = 0,
                     fault: Optional[FaultConfig] = None,
                     topo: Optional[Topology] = None,
-                    ) -> Callable[[SwimState], SwimState]:
+                    tabled: bool = False,
+                    ):
     """Single-device SWIM round step (sharded twin:
     :func:`gossip_tpu.parallel.sharded_swim.make_sharded_swim_round`, kept
-    semantically identical — tests/test_swim.py asserts bitwise parity)."""
+    semantically identical — tests/test_swim.py asserts bitwise parity).
+
+    Returns ``step: SwimState -> SwimState``, or with ``tabled=True`` the
+    pair ``(step, tables)`` where ``step(state, *tables)`` takes the
+    topology's neighbor arrays as ARGUMENTS instead of closure constants —
+    required at 1M+ nodes with explicit tables, where a closed-over table
+    would be serialized into the XLA compile request (hundreds of MB of
+    inline HLO constants) instead of shipped once as a runtime device
+    buffer.  The other O(N) buffers (node iota, liveness mask) are computed
+    INSIDE the trace from scalars for the same reason."""
     s_count = proto.swim_subjects
     if s_count > n:
         raise ValueError(
@@ -215,13 +225,17 @@ def make_swim_round(proto: ProtocolConfig, n: int,
     rotate = proto.swim_rotate
     epoch_rounds = resolve_epoch_rounds(proto, n)
     drop_prob = 0.0 if fault is None else fault.drop_prob
-    alive_base = base_alive(n, dead_nodes, fault)
     if topo is None:
         topo = Topology(nbrs=None, deg=None, n=n, family="complete")
-    ids = jnp.arange(n, dtype=jnp.int32)
     slots = jnp.arange(s_count, dtype=jnp.int32)
+    tables = () if topo.implicit else (topo.nbrs, topo.deg)
 
-    def step(state: SwimState) -> SwimState:
+    def step_tabled(state: SwimState, *tbl) -> SwimState:
+        nbrs, deg = tbl if tbl else (None, None)
+        # O(N) buffers built in-trace (iota + small scatters), so the
+        # compile request carries no big inline constants
+        ids = jnp.arange(n, dtype=jnp.int32)
+        alive_base = base_alive(n, dead_nodes, fault)
         rkey = jax.random.fold_in(state.base_key, state.round)
         alive_now = jnp.where(state.round >= fail_round, alive_base, True)
         subj_gids = subject_window(state.round, s_count, n, rotate,
@@ -255,7 +269,8 @@ def make_swim_round(proto: ProtocolConfig, n: int,
 
         # 3: dissemination (scatter-max of wire rows) --------------------
         dkey = jax.random.fold_in(rkey, _DISS_TAG)
-        targets = sample_peers(dkey, ids, topo, fanout, exclude_self=True)
+        targets = sample_peers(dkey, ids, topo, fanout, exclude_self=True,
+                               local_nbrs=nbrs, local_deg=deg)
         targets = jnp.where(alive_now[:, None], targets, n)   # dead: silent
         flat_t = targets.reshape(-1)
         flat_w = jnp.broadcast_to(wire1[:, None, :],
@@ -288,6 +303,12 @@ def make_swim_round(proto: ProtocolConfig, n: int,
         return SwimState(wire=wire_f, timer=timer_f,
                          round=state.round + 1, base_key=state.base_key,
                          msgs=state.msgs + msgs_probe + msgs_diss)
+
+    if tabled:
+        return step_tabled, tables
+
+    def step(state: SwimState) -> SwimState:
+        return step_tabled(state, *tables)
 
     return step
 

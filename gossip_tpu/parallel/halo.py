@@ -20,6 +20,13 @@ Constraints (checked, not assumed): an explicit neighbor table, band(topo)
 and n divisible by the mesh size (contiguous blocks, no padding zone in
 the circular index math).  Results are bitwise identical to the
 single-device kernels — tests/test_halo.py.
+
+CPU-mesh caveat (virtual devices only, not TPU): XLA's in-process CPU
+collectives rendezvous across host threads; dispatching hundreds of
+ppermute rounds without a host sync can starve one virtual device and
+abort the rendezvous.  Python-loop drivers on the CPU mesh should
+``block_until_ready`` periodically (a ``lax.while_loop``/``scan`` driver,
+the normal production shape, has no such issue).
 """
 
 from __future__ import annotations
@@ -56,15 +63,23 @@ def band_of(topo: Topology) -> int:
     return int(np.minimum(d, n - d).max()) if d.size else 0
 
 
+def _ring_perms(axis_name: str):
+    """(to_right, to_left) ppermute pairs on the mesh ring — the single
+    source of the neighbor convention for both the forward halo read and
+    the reverse push write-back."""
+    p = jax.lax.axis_size(axis_name)
+    to_right = [(i, (i + 1) % p) for i in range(p)]
+    to_left = [(i, (i - 1) % p) for i in range(p)]
+    return to_right, to_left
+
+
 def _exchange_halos(visible_l: jax.Array, band: int,
                     axis_name: str) -> jax.Array:
     """[nl, R] -> [nl + 2B, R]: prepend the left neighbor's last B rows,
     append the right neighbor's first B rows (both rings of the mesh)."""
-    p = jax.lax.axis_size(axis_name)
-    right = [(i, (i + 1) % p) for i in range(p)]   # data flows rightward
-    left = [(i, (i - 1) % p) for i in range(p)]
-    from_left = jax.lax.ppermute(visible_l[-band:], axis_name, right)
-    from_right = jax.lax.ppermute(visible_l[:band], axis_name, left)
+    to_right, to_left = _ring_perms(axis_name)
+    from_left = jax.lax.ppermute(visible_l[-band:], axis_name, to_right)
+    from_right = jax.lax.ppermute(visible_l[:band], axis_name, to_left)
     return jnp.concatenate([from_left, visible_l, from_right], axis=0)
 
 
@@ -72,14 +87,19 @@ def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
                     fault: Optional[FaultConfig] = None, origin: int = 0,
                     axis_name: str = "nodes"
                     ) -> Callable[[SimState], SimState]:
-    """FLOOD or PULL round with O(band) cross-shard traffic.
+    """FLOOD, PULL, PUSH, or PUSH_PULL round with O(band) cross-shard
+    traffic.
 
     Semantically identical to the general sharded kernels and to the
-    single-device kernels; only the communication pattern differs."""
+    single-device kernels; only the communication pattern differs.  Push
+    scatters into the extended halo buffer and the boundary contributions
+    flow BACK to the owning shard with a reverse ``ppermute`` — the push
+    twin of the forward halo read."""
     n, k = topo.n, proto.fanout
     mode = proto.mode
-    if mode not in (C.FLOOD, C.PULL):
-        raise ValueError(f"halo rounds support flood/pull, got {mode!r}")
+    if mode not in (C.FLOOD, C.PULL, C.PUSH, C.PUSH_PULL):
+        raise ValueError(
+            f"halo rounds support flood/pull/push/pushpull, got {mode!r}")
     if topo.implicit:
         raise ValueError("halo exchange needs an explicit neighbor table")
     p = mesh.shape[axis_name]
@@ -111,6 +131,7 @@ def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
             # needed id is within B of this block (mod n)
             return jnp.mod(idx - base, n)
 
+        delta = jnp.zeros_like(seen_l)
         if mode == C.FLOOD:
             nbrs_use = nbrs_l
             if drop_prob > 0.0:
@@ -123,7 +144,40 @@ def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
             sender_active = jnp.any(visible, axis=1)
             msgs_local = jnp.sum(
                 jnp.where(sender_active, deg_l, 0)).astype(jnp.float32)
-        else:  # PULL from the banded neighbor table
+
+        if mode in (C.PUSH, C.PUSH_PULL):
+            # banded push: scatter into the [nl + 2B] extended buffer, then
+            # hand the boundary contributions back to their owners with a
+            # reverse ppermute (O(band) bytes, the push twin of the halo
+            # read)
+            pkey = jax.random.fold_in(rkey, si_mod.PUSH_TAG)
+            targets = sample_peers(pkey, gids, topo, k, proto.exclude_self,
+                                   local_nbrs=nbrs_l, local_deg=deg_l)
+            targets = apply_drop(rkey, si_mod.PUSH_DROP_TAG, gids,
+                                 targets, drop_prob, n)
+            sender_active = jnp.any(visible, axis=1)
+            valid = (targets < n) & sender_active[:, None]
+            ext_rows = nl + 2 * band
+            tloc = jnp.where(valid, to_ext(targets), ext_rows)  # drop
+            flat_t = tloc.reshape(-1)
+            flat_p = jnp.broadcast_to(
+                visible[:, None, :],
+                (nl, k, visible.shape[1])).reshape(-1, visible.shape[1])
+            contrib = jnp.zeros((ext_rows, visible.shape[1]), jnp.bool_
+                                ).at[flat_t].max(flat_p, mode="drop")
+            to_right, to_left = _ring_perms(axis_name)
+            # contrib[:B] targets the LEFT neighbor's last B rows;
+            # contrib[-B:] targets the RIGHT neighbor's first B rows
+            recv_hi = jax.lax.ppermute(contrib[:band], axis_name, to_left)
+            recv_lo = jax.lax.ppermute(contrib[band + nl:], axis_name,
+                                       to_right)
+            pushed = (contrib[band:band + nl]
+                      | jnp.pad(recv_lo, ((0, nl - band), (0, 0)))
+                      | jnp.pad(recv_hi, ((nl - band, 0), (0, 0))))
+            delta = delta | pushed
+            msgs_local = msgs_local + jnp.sum(valid).astype(jnp.float32)
+
+        if mode in (C.PULL, C.PUSH_PULL):
             qkey = jax.random.fold_in(rkey, si_mod.PULL_TAG)
             partners = sample_peers(qkey, gids, topo, k, proto.exclude_self,
                                     local_nbrs=nbrs_l, local_deg=deg_l)
@@ -131,9 +185,10 @@ def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
                                   partners, drop_prob, n)
             valid = partners < n
             got = ext[jnp.where(valid, to_ext(partners), 0)]
-            delta = jnp.any(got & valid[:, :, None], axis=1)
+            delta = delta | jnp.any(got & valid[:, :, None], axis=1)
             req = jnp.where(alive_l[:, None], partners, n)
-            msgs_local = 2.0 * jnp.sum(req < n).astype(jnp.float32)
+            msgs_local = msgs_local + 2.0 * jnp.sum(
+                req < n).astype(jnp.float32)
 
         delta = delta & alive_l[:, None]
         msgs_new = msgs + jax.lax.psum(msgs_local, axis_name)

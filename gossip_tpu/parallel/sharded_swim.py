@@ -15,7 +15,7 @@ budget; the probe arrays are O(N x K) locals.
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -34,7 +34,14 @@ def make_sharded_swim_round(
         dead_nodes: Tuple[int, ...] = (), fail_round: int = 0,
         fault: Optional[FaultConfig] = None,
         topo: Optional[Topology] = None,
-        axis_name: str = "nodes") -> Callable[[SwimState], SwimState]:
+        axis_name: str = "nodes",
+        tabled: bool = False):
+    """Returns ``step: SwimState -> SwimState``; ``tabled=True`` returns
+    ``(step, tables)`` with the padded topology arrays as step ARGUMENTS
+    rather than closure constants — see models/swim.make_swim_round: at
+    1M+ nodes a closed-over table inflates the XLA compile request with
+    inline constants.  Liveness masks are built in-trace for the same
+    reason."""
     s_count = proto.swim_subjects
     if s_count > n:
         raise ValueError(
@@ -48,8 +55,6 @@ def make_sharded_swim_round(
     drop_prob = 0.0 if fault is None else fault.drop_prob
     n_pad = pad_to_mesh(n, mesh, axis_name)
     nl = n_pad // mesh.shape[axis_name]
-    valid = jnp.arange(n_pad) < n                     # padding rows: never alive
-    alive_base_pad = _pad_rows(base_alive(n, dead_nodes, fault), n_pad, False)
     if topo is None:
         topo = Topology(nbrs=None, deg=None, n=n, family="complete")
     have_table = not topo.implicit
@@ -57,11 +62,15 @@ def make_sharded_swim_round(
         nbrs_pad = _pad_rows(topo.nbrs, n_pad, n)
         deg_pad = _pad_rows(topo.deg, n_pad, 0)
 
-    def local_round(wire_l, timer_l, round_, base_key, msgs, alive_base_full,
-                    *table):
+    def local_round(wire_l, timer_l, round_, base_key, msgs, *table):
         shard = jax.lax.axis_index(axis_name)
         gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
         rkey = jax.random.fold_in(base_key, round_)
+        # O(N) liveness buffers built in-trace (replicated compute, no big
+        # inline constants in the compile request — models/swim doc)
+        valid = jnp.arange(n_pad) < n             # padding rows: never alive
+        alive_base_full = _pad_rows(base_alive(n, dead_nodes, fault),
+                                    n_pad, False)
         alive_full = jnp.where(round_ >= fail_round, alive_base_full,
                                True) & valid
         alive_l = alive_full[gids]
@@ -128,20 +137,25 @@ def make_sharded_swim_round(
 
     sh2 = P(axis_name, None)
     rep = P()
-    in_specs = [sh2, sh2, rep, rep, rep, rep]
-    args = [alive_base_pad]
+    in_specs = [sh2, sh2, rep, rep, rep]
     if have_table:
         in_specs += [sh2, P(axis_name)]
-        args += [nbrs_pad, deg_pad]
 
     mapped = jax.shard_map(local_round, mesh=mesh, in_specs=tuple(in_specs),
                            out_specs=(sh2, sh2, rep))
+    tables = (nbrs_pad, deg_pad) if have_table else ()
 
-    def step(state: SwimState) -> SwimState:
+    def step_tabled(state: SwimState, *tbl) -> SwimState:
         wire, timer, msgs = mapped(state.wire, state.timer, state.round,
-                                   state.base_key, state.msgs, *args)
+                                   state.base_key, state.msgs, *tbl)
         return SwimState(wire=wire, timer=timer, round=state.round + 1,
                          base_key=state.base_key, msgs=msgs)
+
+    if tabled:
+        return step_tabled, tables
+
+    def step(state: SwimState) -> SwimState:
+        return step_tabled(state, *tables)
 
     return step
 

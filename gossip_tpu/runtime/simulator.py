@@ -111,29 +111,35 @@ def simulate_swim_curve(proto: ProtocolConfig, n: int, rounds: int,
     program).  With ``mesh`` the sharded twin runs instead.  Returns
     (detection[T] as numpy, final SwimState)."""
     from gossip_tpu.models import swim as SW
+    # tabled=True: topology arrays enter the jitted scan as ARGUMENTS, not
+    # closure constants — a closed-over 1M-row neighbor table would be
+    # serialized inline into the compile request (models/swim doc).
     if mesh is None:
-        step = SW.make_swim_round(proto, n, tuple(dead_nodes), fail_round,
-                                  fault, topo)
+        step, tables = SW.make_swim_round(proto, n, tuple(dead_nodes),
+                                          fail_round, fault, topo,
+                                          tabled=True)
         init = SW.init_swim_state(n, proto.swim_subjects, seed)
     else:
         from gossip_tpu.parallel.sharded_swim import (
             init_sharded_swim_state, make_sharded_swim_round)
-        step = make_sharded_swim_round(proto, n, mesh, tuple(dead_nodes),
-                                       fail_round, fault, topo)
+        step, tables = make_sharded_swim_round(proto, n, mesh,
+                                               tuple(dead_nodes),
+                                               fail_round, fault, topo,
+                                               tabled=True)
         init = init_sharded_swim_state(n, proto, mesh, seed)
     dead = tuple(dead_nodes)
     rotate = proto.swim_rotate
     epoch_rounds = SW.resolve_epoch_rounds(proto, n)
-    # Observer population: nodes that stay alive after fail_round.  Without
-    # this mask, fault-dead observers sit in the denominator and the
-    # detection fraction plateaus at the alive fraction, never reaching the
-    # target (detection_fraction's metric is over alive observers).
-    alive_obs = SW.base_alive(n, tuple(dead_nodes), fault)
-
     @jax.jit
-    def scan(state):
+    def scan(state, *tbl):
+        # Observer population: nodes that stay alive after fail_round.
+        # Without this mask, fault-dead observers sit in the denominator
+        # and the detection fraction plateaus at the alive fraction, never
+        # reaching the target.  Built in-trace: no O(N) inline constant.
+        alive_obs = SW.base_alive(n, tuple(dead_nodes), fault)
+
         def body(s, _):
-            s = step(s)
+            s = step(s, *tbl)
             # observers: rows [0, n) — drops the mesh padding rows (a no-op
             # slice in the unsharded case); detection over the dead subjects
             # in the window of the round just executed (s.round - 1)
@@ -146,7 +152,7 @@ def simulate_swim_curve(proto: ProtocolConfig, n: int, rounds: int,
             return s, frac
         return jax.lax.scan(body, state, None, length=rounds)
 
-    final, fracs = scan(init)
+    final, fracs = scan(init, *tables)
     return np.asarray(fracs), final
 
 

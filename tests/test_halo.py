@@ -1,8 +1,8 @@
 """Halo-exchange (ppermute) rounds == single-device kernels, bitwise.
 
 The O(band) communication pattern must never change results — only
-traffic.  Cases cover flood and pull on every band-limited family, with
-drops and deaths, plus the constraint errors."""
+traffic.  Cases cover flood, pull, push, and push-pull on every
+band-limited family, with drops and deaths, plus the constraint errors."""
 
 import jax
 import numpy as np
@@ -39,6 +39,13 @@ CASES = [
      lambda: G.watts_strogatz(128, 6, beta=0.0, seed=1), None),
     ("pull-drop", ProtocolConfig(mode=C.PULL, fanout=1),
      lambda: G.ring(128, 4), FaultConfig(drop_prob=0.3, seed=5)),
+    ("push-ring", ProtocolConfig(mode=C.PUSH, fanout=2),
+     lambda: G.ring(128, 6), None),
+    ("push-drop-death", ProtocolConfig(mode=C.PUSH, fanout=1),
+     lambda: G.grid2d(8, 16),
+     FaultConfig(node_death_rate=0.1, drop_prob=0.2, seed=4)),
+    ("pushpull-ws", ProtocolConfig(mode=C.PUSH_PULL, fanout=1, rumors=2),
+     lambda: G.watts_strogatz(128, 6, beta=0.0, seed=2), None),
 ]
 
 
@@ -81,7 +88,8 @@ def test_halo_constraint_errors():
     with pytest.raises(ValueError, match="needs an explicit"):
         make_halo_round(ProtocolConfig(mode=C.FLOOD), G.complete(64), mesh)
     with pytest.raises(ValueError, match="flood/pull"):
-        make_halo_round(ProtocolConfig(mode=C.PUSH), G.ring(64, 2), mesh)
+        make_halo_round(ProtocolConfig(mode=C.ANTI_ENTROPY),
+                        G.ring(64, 2), mesh)
     with pytest.raises(ValueError, match="mesh size"):
         make_halo_round(ProtocolConfig(mode=C.FLOOD), G.ring(100, 2), mesh)
     with pytest.raises(ValueError, match="band"):
