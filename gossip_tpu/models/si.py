@@ -23,14 +23,14 @@ per round, modeling lossy links healed by the next round's resend.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 from gossip_tpu import config as C
 from gossip_tpu.config import FaultConfig, ProtocolConfig
-from gossip_tpu.models.state import SimState, alive_mask
+from gossip_tpu.models.state import SimState, alive_mask, bind_tables
 from gossip_tpu.ops.propagate import flood_gather, pull_merge, push_delta
 from gossip_tpu.ops.sampling import apply_drop, drop_mask, sample_peers
 from gossip_tpu.topology.generators import Topology
@@ -45,21 +45,30 @@ PUSH_TAG, PULL_TAG, PUSH_DROP_TAG, PULL_DROP_TAG, FLOOD_DROP_TAG = (
 
 def make_si_round(proto: ProtocolConfig, topo: Topology,
                   fault: Optional[FaultConfig] = None,
-                  origin: int = 0) -> Callable[[SimState], SimState]:
+                  origin: int = 0, tabled: bool = False):
     """Build the single-device round step.  The sharded equivalent lives in
     :mod:`gossip_tpu.parallel.sharded` and must stay semantically identical
-    (tested in tests/test_sharding.py)."""
+    (tested in tests/test_sharding.py).
+
+    Returns ``step: SimState -> SimState``, or with ``tabled=True`` the pair
+    ``(step, tables)`` where ``step(state, *tables)`` takes the topology's
+    neighbor arrays as ARGUMENTS rather than closure constants — at 1M+
+    nodes a closed-over table is serialized inline into the XLA compile
+    request (models/swim.py doc).  O(N) iota/liveness buffers are built
+    in-trace for the same reason."""
     n, k = topo.n, proto.fanout
     mode = proto.mode
     if mode == C.SWIM:
         raise ValueError("SWIM rounds are built by models/swim.py")
     if mode == C.FLOOD and topo.implicit:
         raise ValueError("flood mode needs an explicit neighbor table")
-    alive = alive_mask(fault, n, origin)
     drop_prob = 0.0 if fault is None else fault.drop_prob
-    ids = jnp.arange(n, dtype=jnp.int32)
+    tables = () if topo.implicit else (topo.nbrs, topo.deg)
 
-    def step(state: SimState) -> SimState:
+    def step_tabled(state: SimState, *tbl) -> SimState:
+        nbrs_t, deg_t = tbl if tbl else (None, None)
+        alive = alive_mask(fault, n, origin)      # in-trace, None-free path
+        ids = jnp.arange(n, dtype=jnp.int32)
         rkey = jax.random.fold_in(state.base_key, state.round)
         seen = state.seen
         # What peers can observe of node i: dead nodes go dark.
@@ -69,7 +78,8 @@ def make_si_round(proto: ProtocolConfig, topo: Topology,
 
         if mode in (C.PUSH, C.PUSH_PULL):
             pkey = jax.random.fold_in(rkey, PUSH_TAG)
-            targets = sample_peers(pkey, ids, topo, k, proto.exclude_self)
+            targets = sample_peers(pkey, ids, topo, k, proto.exclude_self,
+                                   local_nbrs=nbrs_t, local_deg=deg_t)
             targets = apply_drop(rkey, PUSH_DROP_TAG, ids,
                                  targets, drop_prob, n)
             sender_active = jnp.any(visible, axis=1)          # [N]
@@ -80,7 +90,8 @@ def make_si_round(proto: ProtocolConfig, topo: Topology,
 
         if mode in (C.PULL, C.PUSH_PULL) or mode == C.ANTI_ENTROPY:
             qkey = jax.random.fold_in(rkey, PULL_TAG)
-            partners = sample_peers(qkey, ids, topo, k, proto.exclude_self)
+            partners = sample_peers(qkey, ids, topo, k, proto.exclude_self,
+                                    local_nbrs=nbrs_t, local_deg=deg_t)
             partners = apply_drop(rkey, PULL_DROP_TAG, ids,
                                   partners, drop_prob, n)
             pulled = pull_merge(visible, partners, n)
@@ -113,7 +124,7 @@ def make_si_round(proto: ProtocolConfig, topo: Topology,
                 msgs = msgs + 2.0 * n_req  # request + digest response
 
         if mode == C.FLOOD:
-            nbrs = topo.nbrs
+            nbrs = nbrs_t
             if drop_prob > 0.0:
                 # lossy links drop individual edge uses this round; the edge
                 # is retried next round (at-least-once, main.go:80-87)
@@ -123,14 +134,14 @@ def make_si_round(proto: ProtocolConfig, topo: Topology,
             delta = flood_gather(visible, nbrs, n)
             sender_active = jnp.any(visible, axis=1)
             msgs = msgs + jnp.sum(
-                jnp.where(sender_active, topo.deg, 0)).astype(jnp.float32)
+                jnp.where(sender_active, deg_t, 0)).astype(jnp.float32)
 
         if alive is not None:
             delta = delta & alive[:, None]  # dead nodes receive nothing
         return SimState(seen=seen | delta, round=state.round + 1,
                         base_key=state.base_key, msgs=msgs)
 
-    return step
+    return bind_tables(step_tabled, tables, tabled)
 
 
 def coverage(seen: jax.Array,

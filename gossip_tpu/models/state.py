@@ -71,3 +71,20 @@ def alive_mask(fault: Optional[FaultConfig], n: int,
     if alive is None:
         return None
     return alive.at[origin].set(True)
+
+
+def bind_tables(step_tabled, tables: tuple, tabled: bool):
+    """Shared epilogue for the round-step factories.
+
+    ``tabled=True`` exposes ``(step_tabled, tables)`` so callers pass the
+    topology arrays through the jit boundary as ARGUMENTS — a closed-over
+    1M+-row table is serialized inline into the XLA compile request, which
+    remote-compile endpoints reject (models/swim.py doc).  ``tabled=False``
+    binds them as a convenience closure for small-n callers."""
+    if tabled:
+        return step_tabled, tables
+
+    def step(state):
+        return step_tabled(state, *tables)
+
+    return step

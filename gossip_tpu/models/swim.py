@@ -70,6 +70,7 @@ import jax
 import jax.numpy as jnp
 
 from gossip_tpu.config import FaultConfig, ProtocolConfig
+from gossip_tpu.models.state import bind_tables
 from gossip_tpu.ops.sampling import drop_mask, node_keys, sample_peers
 from gossip_tpu.topology.generators import Topology
 
@@ -304,13 +305,7 @@ def make_swim_round(proto: ProtocolConfig, n: int,
                          round=state.round + 1, base_key=state.base_key,
                          msgs=state.msgs + msgs_probe + msgs_diss)
 
-    if tabled:
-        return step_tabled, tables
-
-    def step(state: SwimState) -> SwimState:
-        return step_tabled(state, *tables)
-
-    return step
+    return bind_tables(step_tabled, tables, tabled)
 
 
 def detection_fraction(state: SwimState, dead_subjects, alive_now=None,

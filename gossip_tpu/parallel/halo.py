@@ -31,7 +31,7 @@ the normal production shape, has no such issue).
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -41,7 +41,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from gossip_tpu import config as C
 from gossip_tpu.config import FaultConfig, ProtocolConfig
 from gossip_tpu.models import si as si_mod
-from gossip_tpu.models.state import SimState, alive_mask
+from gossip_tpu.models.state import SimState, alive_mask, bind_tables
 from gossip_tpu.ops.sampling import apply_drop, drop_mask, sample_peers
 from gossip_tpu.topology.generators import Topology
 
@@ -85,8 +85,7 @@ def _exchange_halos(visible_l: jax.Array, band: int,
 
 def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
                     fault: Optional[FaultConfig] = None, origin: int = 0,
-                    axis_name: str = "nodes"
-                    ) -> Callable[[SimState], SimState]:
+                    axis_name: str = "nodes", tabled: bool = False):
     """FLOOD, PULL, PUSH, or PUSH_PULL round with O(band) cross-shard
     traffic.
 
@@ -94,7 +93,11 @@ def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
     single-device kernels; only the communication pattern differs.  Push
     scatters into the extended halo buffer and the boundary contributions
     flow BACK to the owning shard with a reverse ``ppermute`` — the push
-    twin of the forward halo read."""
+    twin of the forward halo read.
+
+    ``tabled=True`` returns ``(step, tables)`` with the neighbor arrays as
+    step ARGUMENTS (no O(N) jit closure constants — models/swim.py doc);
+    the liveness mask is built in-trace."""
     n, k = topo.n, proto.fanout
     mode = proto.mode
     if mode not in (C.FLOOD, C.PULL, C.PUSH, C.PUSH_PULL):
@@ -114,13 +117,15 @@ def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
             "shards — use the all_gather kernels (parallel/sharded.py)")
     band = max(band, 1)            # ppermute of 0 rows is degenerate
     drop_prob = 0.0 if fault is None else fault.drop_prob
-    alive = alive_mask(fault, n, origin)
-    alive_full = (jnp.ones((n,), jnp.bool_) if alive is None else alive)
 
-    def local_round(seen_l, round_, base_key, msgs, alive_l, nbrs_l, deg_l):
+    def local_round(seen_l, round_, base_key, msgs, nbrs_l, deg_l):
         shard = jax.lax.axis_index(axis_name)
         gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
         rkey = jax.random.fold_in(base_key, round_)
+        # liveness in-trace (replicated compute, no O(N) inline constant)
+        alive = alive_mask(fault, n, origin)
+        alive_full = (jnp.ones((n,), jnp.bool_) if alive is None else alive)
+        alive_l = alive_full[gids]
         visible = seen_l & alive_l[:, None]
         ext = _exchange_halos(visible, band, axis_name)   # [nl+2B, R]
         base = shard * nl - band
@@ -198,13 +203,13 @@ def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
     rep = P()
     mapped = jax.shard_map(
         local_round, mesh=mesh,
-        in_specs=(sh2, rep, rep, rep, P(axis_name), sh2, P(axis_name)),
+        in_specs=(sh2, rep, rep, rep, sh2, P(axis_name)),
         out_specs=(sh2, rep))
 
-    def step(state: SimState) -> SimState:
+    def step_tabled(state: SimState, *tbl) -> SimState:
         seen, msgs = mapped(state.seen, state.round, state.base_key,
-                            state.msgs, alive_full, topo.nbrs, topo.deg)
+                            state.msgs, *tbl)
         return SimState(seen=seen, round=state.round + 1,
                         base_key=state.base_key, msgs=msgs)
 
-    return step
+    return bind_tables(step_tabled, (topo.nbrs, topo.deg), tabled)

@@ -27,7 +27,7 @@ dead (never sample, never receive, excluded from coverage).
 from __future__ import annotations
 
 import math
-from typing import Callable, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -37,7 +37,8 @@ from gossip_tpu import config as C
 from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
 from gossip_tpu.models import si as si_mod
 from gossip_tpu.models.si import coverage
-from gossip_tpu.models.state import SimState, alive_mask, init_state
+from gossip_tpu.models.state import (SimState, alive_mask, bind_tables,
+                                     init_state)
 from gossip_tpu.ops.propagate import flood_gather, pull_merge, push_counts
 from gossip_tpu.ops.sampling import apply_drop, drop_mask, sample_peers
 from gossip_tpu.topology.generators import Topology
@@ -85,11 +86,16 @@ def sharded_alive(fault: Optional[FaultConfig], n: int, n_pad: int,
 def make_sharded_si_round(
         proto: ProtocolConfig, topo: Topology, mesh: Mesh,
         fault: Optional[FaultConfig] = None, origin: int = 0,
-        axis_name: str = "nodes") -> Callable[[SimState], SimState]:
+        axis_name: str = "nodes", tabled: bool = False):
     """Build the sharded round step.  Semantically identical to
     models/si.make_si_round; the returned function expects ``state.seen`` of
     shape ``[n_pad, R]`` (see :func:`init_sharded_state`) and may be called
-    under an outer ``jax.jit`` / ``lax.while_loop``."""
+    under an outer ``jax.jit`` / ``lax.while_loop``.
+
+    Returns ``step: SimState -> SimState``; ``tabled=True`` returns
+    ``(step, tables)`` with the padded topology arrays as step ARGUMENTS —
+    a closed-over 1M+-row table is serialized inline into the XLA compile
+    request (models/swim.py doc).  The liveness mask is built in-trace."""
     n, k = topo.n, proto.fanout
     mode = proto.mode
     if mode == C.SWIM:
@@ -99,19 +105,20 @@ def make_sharded_si_round(
     n_pad = pad_to_mesh(n, mesh, axis_name)
     nl = n_pad // mesh.shape[axis_name]
     drop_prob = 0.0 if fault is None else fault.drop_prob
-    alive_pad = sharded_alive(fault, n, n_pad, origin)
 
     have_table = not topo.implicit
     if have_table:
         nbrs_pad = _pad_rows(topo.nbrs, n_pad, n)   # sentinel = n
         deg_pad = _pad_rows(topo.deg, n_pad, 0)
 
-    def local_round(seen_l, round_, base_key, msgs, alive_l, *table):
+    def local_round(seen_l, round_, base_key, msgs, *table):
         """One round on this shard's rows.  Axis-collective ops: psum_scatter
         (push counts), all_gather (pull/flood digests), psum (counters)."""
         shard = jax.lax.axis_index(axis_name)
         gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
         rkey = jax.random.fold_in(base_key, round_)
+        # liveness in-trace (replicated compute, no O(N) inline constant)
+        alive_l = sharded_alive(fault, n, n_pad, origin)[gids]
         visible = seen_l & alive_l[:, None]
         delta = jnp.zeros_like(seen_l)
         msgs_local = jnp.float32(0.0)
@@ -195,23 +202,23 @@ def make_sharded_si_round(
     sh = P(axis_name)          # rows sharded
     sh2 = P(axis_name, None)   # rows sharded, rumor dim replicated
     rep = P()
-    in_specs = [sh2, rep, rep, rep, sh]
-    args = [alive_pad]
+    in_specs = [sh2, rep, rep, rep]
+    tables = ()
     if have_table:
         in_specs += [sh2, sh]
-        args += [nbrs_pad, deg_pad]
+        tables = (nbrs_pad, deg_pad)
 
     mapped = jax.shard_map(local_round, mesh=mesh,
                            in_specs=tuple(in_specs),
                            out_specs=(sh2, rep))
 
-    def step(state: SimState) -> SimState:
+    def step_tabled(state: SimState, *tbl) -> SimState:
         seen, msgs = mapped(state.seen, state.round, state.base_key,
-                            state.msgs, *args)
+                            state.msgs, *tbl)
         return SimState(seen=seen, round=state.round + 1,
                         base_key=state.base_key, msgs=msgs)
 
-    return step
+    return bind_tables(step_tabled, tables, tabled)
 
 
 def init_sharded_state(run: RunConfig, proto: ProtocolConfig, topo: Topology,
@@ -233,20 +240,20 @@ def simulate_curve_sharded(proto: ProtocolConfig, topo: Topology,
     resident sharded.  Sharded twin of runtime/simulator.simulate_curve.
     Returns (coverage[T], msgs[T], final_state) as host arrays/state."""
     import numpy as np
-    step = make_sharded_si_round(proto, topo, mesh, fault, run.origin,
-                                 axis_name)
+    step, tables = make_sharded_si_round(proto, topo, mesh, fault,
+                                         run.origin, axis_name, tabled=True)
     n_pad = pad_to_mesh(topo.n, mesh, axis_name)
-    alive_pad = sharded_alive(fault, topo.n, n_pad, run.origin)
     init = init_sharded_state(run, proto, topo, mesh, axis_name)
 
     @jax.jit
-    def scan(state):
+    def scan(state, *tbl):
+        alive_pad = sharded_alive(fault, topo.n, n_pad, run.origin)
         def body(s, _):
-            s = step(s)
+            s = step(s, *tbl)
             return s, (coverage(s.seen, alive_pad), s.msgs)
         return jax.lax.scan(body, state, None, length=run.max_rounds)
 
-    final, (covs, msgs) = scan(init)
+    final, (covs, msgs) = scan(init, *tables)
     return np.asarray(covs), np.asarray(msgs), final
 
 
@@ -257,20 +264,23 @@ def simulate_until_sharded(proto: ProtocolConfig, topo: Topology,
     """``lax.while_loop`` to target coverage, whole loop one XLA program, state
     resident sharded across the mesh.  Returns (rounds, coverage, msgs, state).
     """
-    step = make_sharded_si_round(proto, topo, mesh, fault, run.origin,
-                                 axis_name)
+    step, tables = make_sharded_si_round(proto, topo, mesh, fault,
+                                         run.origin, axis_name, tabled=True)
     n_pad = pad_to_mesh(topo.n, mesh, axis_name)
     alive_pad = sharded_alive(fault, topo.n, n_pad, run.origin)
     init = init_sharded_state(run, proto, topo, mesh, axis_name)
     target = jnp.float32(run.target_coverage)
 
     @jax.jit
-    def loop(state):
+    def loop(state, *tbl):
+        alive_t = sharded_alive(fault, topo.n, n_pad, run.origin)
         def cond(s):
-            return ((coverage(s.seen, alive_pad) < target)
+            return ((coverage(s.seen, alive_t) < target)
                     & (s.round < run.max_rounds))
-        return jax.lax.while_loop(cond, step, state)
+        def body(s):
+            return step(s, *tbl)
+        return jax.lax.while_loop(cond, body, state)
 
-    final = loop(init)
+    final = loop(init, *tables)
     return (int(final.round), float(coverage(final.seen, alive_pad)),
             float(final.msgs), final)

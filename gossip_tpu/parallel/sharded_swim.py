@@ -23,6 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gossip_tpu.config import FaultConfig, ProtocolConfig
 from gossip_tpu.models import swim as SW
+from gossip_tpu.models.state import bind_tables
 from gossip_tpu.models.swim import DEAD_WIRE, SwimState, base_alive
 from gossip_tpu.ops.sampling import sample_peers
 from gossip_tpu.parallel.sharded import _pad_rows, pad_to_mesh
@@ -151,13 +152,7 @@ def make_sharded_swim_round(
         return SwimState(wire=wire, timer=timer, round=state.round + 1,
                          base_key=state.base_key, msgs=msgs)
 
-    if tabled:
-        return step_tabled, tables
-
-    def step(state: SwimState) -> SwimState:
-        return step_tabled(state, *tables)
-
-    return step
+    return bind_tables(step_tabled, tables, tabled)
 
 
 def init_sharded_swim_state(n: int, proto: ProtocolConfig, mesh: Mesh,

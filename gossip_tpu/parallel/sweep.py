@@ -75,8 +75,9 @@ def ensemble_curves(proto: ProtocolConfig, topo: Topology, run: RunConfig,
                     seeds: Sequence[int],
                     fault: Optional[FaultConfig] = None) -> EnsembleResult:
     """Run |seeds| independent trajectories as ONE batched XLA program."""
-    step = make_si_round(proto, topo, fault, run.origin)
-    alive = alive_mask(fault, topo.n, run.origin)
+    # tables as jit ARGUMENTS + liveness in-trace: no O(N) closure
+    # constants in the compile request (models/swim.py doc)
+    step, tables = make_si_round(proto, topo, fault, run.origin, tabled=True)
     base = init_state(run, proto, topo.n)
     keys = jax.vmap(jax.random.key)(jnp.asarray(list(seeds), jnp.uint32))
     s = len(seeds)
@@ -88,14 +89,15 @@ def ensemble_curves(proto: ProtocolConfig, topo: Topology, run: RunConfig,
     )
 
     @jax.jit
-    def scan(states):
+    def scan(states, *tbl):
+        alive = alive_mask(fault, topo.n, run.origin)
         def body(st, _):
-            st = jax.vmap(step)(st)
+            st = jax.vmap(lambda x: step(x, *tbl))(st)
             covs = jax.vmap(lambda x: coverage(x.seen, alive))(st)
             return st, (covs, st.msgs)
         return jax.lax.scan(body, states, None, length=run.max_rounds)
 
-    _, (covs, msgs) = scan(init)
+    _, (covs, msgs) = scan(init, *tables)
     curves = np.asarray(covs).T          # [S, T]
     return EnsembleResult(curves=curves, msgs=np.asarray(msgs).T,
                           rounds_to_target=_rounds_to_target(
@@ -204,17 +206,17 @@ def config_sweep_curves(points, topo: Topology, run: RunConfig,
         raise ValueError("k_max smaller than a point's fanout")
     cN = len(points)
     proto_like = ProtocolConfig(mode=C.PUSH, fanout=k_max, rumors=rumors)
-    alive = alive_mask(fault, n, run.origin)
-    alive_b = jnp.ones((n,), jnp.bool_) if alive is None else alive
-
-    nbrs = None if topo.implicit else topo.nbrs
-    deg = None if topo.implicit else topo.deg
-    gids = jnp.arange(n, dtype=jnp.int32)
-    col = jnp.arange(k_max, dtype=jnp.int32)[None, :]
+    tables = () if topo.implicit else (topo.nbrs, topo.deg)
     have_ae = any(pt.mode == C.ANTI_ENTROPY for pt in points)
 
     def one_round(seen, round_, base_key, msgs,
-                  do_push, do_pull, do_ae, fanout, dropp, period):
+                  do_push, do_pull, do_ae, fanout, dropp, period, *tbl):
+        nbrs, deg = tbl if tbl else (None, None)
+        # O(N) buffers in-trace: no inline constants in the compile request
+        gids = jnp.arange(n, dtype=jnp.int32)
+        col = jnp.arange(k_max, dtype=jnp.int32)[None, :]
+        alive = alive_mask(fault, n, run.origin)
+        alive_b = jnp.ones((n,), jnp.bool_) if alive is None else alive
         rkey = jax.random.fold_in(base_key, round_)
         visible = seen & alive_b[:, None]
         delta = jnp.zeros_like(seen)
@@ -258,7 +260,7 @@ def config_sweep_curves(points, topo: Topology, run: RunConfig,
         return seen | delta, round_ + 1, msgs + msgs_round
 
     batched = jax.vmap(one_round,
-                       in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, 0))
+                       in_axes=(0,) * 10 + (None,) * len(tables))
 
     base = init_state(run, proto_like, n)
     init_seen = jnp.broadcast_to(base.seen, (cN,) + base.seen.shape)
@@ -272,19 +274,20 @@ def config_sweep_curves(points, topo: Topology, run: RunConfig,
     periods = jnp.asarray([pt.period for pt in points], jnp.int32)
 
     @jax.jit
-    def scan(seen, rounds, keys, msgs):
+    def scan(seen, rounds, keys, msgs, *tbl):
+        alive = alive_mask(fault, n, run.origin)
         def body(carry, _):
             seen, rounds, msgs = carry
             seen, rounds, msgs = batched(seen, rounds, keys, msgs, do_push,
                                          do_pull, do_ae, fanouts, drops,
-                                         periods)
+                                         periods, *tbl)
             covs = jax.vmap(lambda x: coverage(x, alive))(seen)
             return (seen, rounds, msgs), (covs, msgs)
         return jax.lax.scan(body, (seen, rounds, msgs), None,
                             length=run.max_rounds)
 
     _, (covs, msgs) = scan(init_seen, jnp.zeros((cN,), jnp.int32), keys,
-                           jnp.zeros((cN,), jnp.float32))
+                           jnp.zeros((cN,), jnp.float32), *tables)
     curves = np.asarray(covs).T
     return ConfigSweepResult(points=points, curves=curves,
                              msgs=np.asarray(msgs).T,

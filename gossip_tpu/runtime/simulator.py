@@ -51,24 +51,28 @@ class UntilResult:
 
 def _build(proto: ProtocolConfig, topo: Topology, run: RunConfig,
            fault: Optional[FaultConfig]):
-    step = make_si_round(proto, topo, fault, run.origin)
-    alive = alive_mask(fault, topo.n, run.origin)
+    """step + its table args + init.  Tables travel as jit ARGUMENTS and the
+    alive mask is rebuilt in-trace, so no O(N) buffer is inlined into the
+    XLA compile request (models/swim.py doc — the axon remote-compile
+    endpoint rejects oversized requests)."""
+    step, tables = make_si_round(proto, topo, fault, run.origin, tabled=True)
     init = init_state(run, proto, topo.n)
-    return step, alive, init
+    return step, tables, init
 
 
 def simulate_curve(proto: ProtocolConfig, topo: Topology, run: RunConfig,
                    fault: Optional[FaultConfig] = None) -> CurveResult:
-    step, alive, init = _build(proto, topo, run, fault)
+    step, tables, init = _build(proto, topo, run, fault)
 
     @jax.jit
-    def scan(init_state_):
+    def scan(init_state_, *tbl):
+        alive = alive_mask(fault, topo.n, run.origin)
         def body(state, _):
-            state = step(state)
+            state = step(state, *tbl)
             return state, (coverage(state.seen, alive), state.msgs)
         return jax.lax.scan(body, init_state_, None, length=run.max_rounds)
 
-    final, (covs, msgs) = scan(init)
+    final, (covs, msgs) = scan(init, *tables)
     covs = np.asarray(covs)
     msgs = np.asarray(msgs)
     hit = np.nonzero(covs >= run.target_coverage)[0]
@@ -83,17 +87,21 @@ def simulate_curve(proto: ProtocolConfig, topo: Topology, run: RunConfig,
 
 def simulate_until(proto: ProtocolConfig, topo: Topology, run: RunConfig,
                    fault: Optional[FaultConfig] = None) -> UntilResult:
-    step, alive, init = _build(proto, topo, run, fault)
+    step, tables, init = _build(proto, topo, run, fault)
     target = jnp.float32(run.target_coverage)
+    alive = alive_mask(fault, topo.n, run.origin)   # host-side final metric
 
     @jax.jit
-    def loop(init_state_):
+    def loop(init_state_, *tbl):
+        alive_t = alive_mask(fault, topo.n, run.origin)
         def cond(state):
-            return ((coverage(state.seen, alive) < target)
+            return ((coverage(state.seen, alive_t) < target)
                     & (state.round < run.max_rounds))
-        return jax.lax.while_loop(cond, step, init_state_)
+        def body(state):
+            return step(state, *tbl)
+        return jax.lax.while_loop(cond, body, init_state_)
 
-    final = loop(init)
+    final = loop(init, *tables)
     return UntilResult(
         rounds=int(final.round),
         coverage=float(coverage(final.seen, alive)),
@@ -159,15 +167,19 @@ def simulate_swim_curve(proto: ProtocolConfig, n: int, rounds: int,
 def compiled_until(proto: ProtocolConfig, topo: Topology, run: RunConfig,
                    fault: Optional[FaultConfig] = None):
     """Lowered/compiled while-loop runner + fresh init state, for benchmarks
-    that must separate compile time from run time."""
-    step, alive, init = _build(proto, topo, run, fault)
+    that must separate compile time from run time.  The returned loop takes
+    (state, *tables); pass the returned tables through."""
+    step, tables, init = _build(proto, topo, run, fault)
     target = jnp.float32(run.target_coverage)
 
     @partial(jax.jit, donate_argnums=0)
-    def loop(state):
+    def loop(state, *tbl):
+        alive = alive_mask(fault, topo.n, run.origin)
         def cond(s):
             return ((coverage(s.seen, alive) < target)
                     & (s.round < run.max_rounds))
-        return jax.lax.while_loop(cond, step, state)
+        def body(s):
+            return step(s, *tbl)
+        return jax.lax.while_loop(cond, body, state)
 
-    return loop, init
+    return loop, init, tables
