@@ -120,6 +120,14 @@ def run_gonative(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
               "engine": type(sim).__name__})
 
 
+def _curve_summary(covs, msgs, target):
+    """(rounds_to_target, final_cov, final_msgs, curve) from per-round
+    series — the one place the -1 sentinel / target comparison lives."""
+    hit = [i for i, c in enumerate(covs) if c >= target]
+    return ((hit[0] + 1) if hit else -1, float(covs[-1]), float(msgs[-1]),
+            [float(c) for c in covs])
+
+
 def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
             fault: Optional[FaultConfig] = None,
             mesh_cfg: Optional[MeshConfig] = None,
@@ -129,6 +137,18 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
     from gossip_tpu.topology import generators as G
     topo = G.build(tc)
     n_dev = 1 if mesh_cfg is None else mesh_cfg.n_devices
+    _exchange = "dense" if mesh_cfg is None else mesh_cfg.exchange
+    if _exchange != "dense":
+        # never silently substitute the dense path for a requested
+        # sparse/halo run — the traffic numbers would be mislabeled
+        if n_dev == 1:
+            raise ValueError(
+                f"exchange={_exchange!r} is a cross-shard pattern; it needs "
+                "n_devices > 1 (single-device runs have no exchange)")
+        if proto.mode == "swim":
+            raise ValueError(
+                f"exchange={_exchange!r} is not implemented for swim; "
+                "SWIM shards via the dense pmax kernel")
 
     if proto.mode == "swim":
         from gossip_tpu.models.swim import (resolve_epoch_rounds,
@@ -187,6 +207,61 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
             curve=[float(f) for f in fracs] if want_curve else None,
             meta=meta)
 
+    if n_dev > 1 and _exchange == "sparse":
+        from gossip_tpu.parallel.sharded import make_mesh
+        from gossip_tpu.parallel.sharded_sparse import (
+            simulate_curve_sparse, simulate_until_sparse)
+        if tc.family != "complete":
+            raise ValueError(
+                "exchange='sparse' runs on the implicit complete topology "
+                f"only (got family {tc.family!r}); use dense or halo")
+        mesh = make_mesh(n_dev)
+        t0 = time.perf_counter()
+        if want_curve:
+            covs, msgs, _, smeta = simulate_curve_sparse(
+                proto, tc.n, run, mesh, fault)
+            wall = time.perf_counter() - t0
+            rounds, cov, msgs_f, curve = _curve_summary(
+                covs, msgs, run.target_coverage)
+        else:
+            rounds, cov, msgs_f, _, smeta = simulate_until_sparse(
+                proto, tc.n, run, mesh, fault)
+            wall = time.perf_counter() - t0
+            curve = None
+        return RunReport(
+            backend="jax-tpu", mode=proto.mode, n=tc.n, rounds=rounds,
+            coverage=cov, msgs=msgs_f, wall_s=round(wall, 4), curve=curve,
+            meta={"clock": "rounds", "devices": n_dev,
+                  "msgs_counts": "transmissions", "exchange": "sparse",
+                  "ici_bytes_per_round": {
+                      "sparse": smeta.sparse_bytes,
+                      "dense_equivalent": smeta.dense_bytes,
+                      "reverse_exchange_only": smeta.reverse_bytes}})
+
+    if n_dev > 1 and _exchange == "halo":
+        from gossip_tpu.parallel.halo import (simulate_curve_halo,
+                                              simulate_until_halo)
+        from gossip_tpu.parallel.sharded import make_mesh
+        mesh = make_mesh(n_dev)
+        t0 = time.perf_counter()
+        if want_curve:
+            covs, msgs, _, band = simulate_curve_halo(proto, topo, run,
+                                                      mesh, fault)
+            wall = time.perf_counter() - t0
+            rounds, cov, msgs_f, curve = _curve_summary(
+                covs, msgs, run.target_coverage)
+        else:
+            rounds, cov, msgs_f, _, band = simulate_until_halo(
+                proto, topo, run, mesh, fault)
+            wall = time.perf_counter() - t0
+            curve = None
+        return RunReport(
+            backend="jax-tpu", mode=proto.mode, n=tc.n, rounds=rounds,
+            coverage=cov, msgs=msgs_f, wall_s=round(wall, 4), curve=curve,
+            meta={"clock": "rounds", "devices": n_dev,
+                  "msgs_counts": "transmissions", "exchange": "halo",
+                  "band": band})
+
     if n_dev > 1:
         from gossip_tpu.parallel.sharded import (
             make_mesh, simulate_curve_sharded, simulate_until_sharded)
@@ -196,13 +271,12 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
             covs, msgs, _ = simulate_curve_sharded(proto, topo, run, mesh,
                                                    fault)
             wall = time.perf_counter() - t0
-            hit = [i for i, c in enumerate(covs)
-                   if c >= run.target_coverage]
+            rounds, cov, msgs_f, curve = _curve_summary(
+                covs, msgs, run.target_coverage)
             return RunReport(
-                backend="jax-tpu", mode=proto.mode, n=tc.n,
-                rounds=(hit[0] + 1) if hit else -1,
-                coverage=float(covs[-1]), msgs=float(msgs[-1]),
-                wall_s=round(wall, 4), curve=[float(c) for c in covs],
+                backend="jax-tpu", mode=proto.mode, n=tc.n, rounds=rounds,
+                coverage=cov, msgs=msgs_f,
+                wall_s=round(wall, 4), curve=curve,
                 meta={"clock": "rounds", "devices": n_dev,
                       "msgs_counts": "transmissions"})
         rounds, cov, msgs, _ = simulate_until_sharded(proto, topo, run, mesh,

@@ -56,6 +56,12 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                    help="fraction of nodes statically dead")
     p.add_argument("--devices", type=int, default=1,
                    help="mesh size for node-dim sharding (jax-tpu)")
+    p.add_argument("--exchange", default="dense",
+                   choices=("dense", "sparse", "halo"),
+                   help="cross-shard pattern: dense all_gather (any), "
+                        "sparse all_to_all (complete topology, "
+                        "pull/antientropy, O(messages)), halo ppermute "
+                        "(band-limited topologies, O(band))")
     p.add_argument("--curve", action="store_true",
                    help="include the per-round coverage curve")
     p.add_argument("--save-curve", default=None, metavar="PATH",
@@ -101,7 +107,8 @@ def _args_to_configs(a):
                             seed=a.seed,
                             dead_nodes=tuple(a.dead_nodes or ()),
                             fail_round=a.fail_round)
-    mesh = MeshConfig(n_devices=a.devices) if a.devices > 1 else None
+    mesh = (MeshConfig(n_devices=a.devices, exchange=a.exchange)
+            if a.devices > 1 else None)
     return proto, tc, run, fault, mesh
 
 

@@ -151,10 +151,31 @@ class RunConfig:
             raise ValueError("target_coverage must be in (0, 1]")
 
 
+EXCHANGES = ("dense", "sparse", "halo")
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device mesh for node-dimension sharding (the SP/CP analog: the scaled
-    long dimension here is *nodes*, not tokens — see SURVEY.md §5)."""
+    long dimension here is *nodes*, not tokens — see SURVEY.md §5).
+
+    ``exchange`` picks the cross-shard communication pattern:
+
+    * ``dense``  — all_gather / psum_scatter of full digest tables (any
+      topology, any mode; O(N) ICI bytes per round);
+    * ``sparse`` — stratified all_to_all request/response (implicit
+      complete topology, pull/anti-entropy; O(messages) bytes —
+      parallel/sharded_sparse.py);
+    * ``halo``   — ppermute halo exchange (band-limited explicit
+      topologies, flood/pull/push/pushpull; O(band) bytes —
+      parallel/halo.py).
+    """
 
     n_devices: int = 1
     axis_name: str = "nodes"
+    exchange: str = "dense"
+
+    def __post_init__(self):
+        if self.exchange not in EXCHANGES:
+            raise ValueError(f"unknown exchange {self.exchange!r}; "
+                             f"choose from {EXCHANGES}")

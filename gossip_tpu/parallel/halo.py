@@ -39,7 +39,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gossip_tpu import config as C
-from gossip_tpu.config import FaultConfig, ProtocolConfig
+from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
 from gossip_tpu.models import si as si_mod
 from gossip_tpu.models.state import SimState, alive_mask, bind_tables
 from gossip_tpu.ops.sampling import apply_drop, drop_mask, sample_peers
@@ -213,3 +213,58 @@ def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
                         base_key=state.base_key, msgs=msgs)
 
     return bind_tables(step_tabled, (topo.nbrs, topo.deg), tabled)
+
+
+def simulate_until_halo(proto: ProtocolConfig, topo: Topology,
+                        run: RunConfig, mesh: Mesh,
+                        fault: Optional[FaultConfig] = None,
+                        axis_name: str = "nodes"):
+    """lax.while_loop to target coverage on the O(band) halo path.
+    Returns (rounds, coverage, msgs, final_state, band)."""
+    from gossip_tpu.models.si import coverage
+    from gossip_tpu.parallel.sharded import init_sharded_state
+    step, tables = make_halo_round(proto, topo, mesh, fault, run.origin,
+                                   axis_name, tabled=True)
+    init = init_sharded_state(run, proto, topo, mesh, axis_name)
+    target = jnp.float32(run.target_coverage)
+    n = topo.n
+
+    @jax.jit
+    def loop(state, *tbl):
+        alive = alive_mask(fault, n, run.origin)
+        def cond(s):
+            return ((coverage(s.seen, alive) < target)
+                    & (s.round < run.max_rounds))
+        def body(s):
+            return step(s, *tbl)
+        return jax.lax.while_loop(cond, body, state)
+
+    final = loop(init, *tables)
+    alive = alive_mask(fault, n, run.origin)
+    return (int(final.round), float(coverage(final.seen, alive)),
+            float(final.msgs), final, band_of(topo))
+
+
+def simulate_curve_halo(proto: ProtocolConfig, topo: Topology,
+                        run: RunConfig, mesh: Mesh,
+                        fault: Optional[FaultConfig] = None,
+                        axis_name: str = "nodes"):
+    """lax.scan over rounds recording (coverage, msgs) on the halo path.
+    Returns (coverage[T], msgs[T], final_state, band)."""
+    from gossip_tpu.models.si import coverage
+    from gossip_tpu.parallel.sharded import init_sharded_state
+    step, tables = make_halo_round(proto, topo, mesh, fault, run.origin,
+                                   axis_name, tabled=True)
+    init = init_sharded_state(run, proto, topo, mesh, axis_name)
+    n = topo.n
+
+    @jax.jit
+    def scan(state, *tbl):
+        alive = alive_mask(fault, n, run.origin)
+        def body(s, _):
+            s = step(s, *tbl)
+            return s, (coverage(s.seen, alive), s.msgs)
+        return jax.lax.scan(body, state, None, length=run.max_rounds)
+
+    final, (covs, msgs) = scan(init, *tables)
+    return np.asarray(covs), np.asarray(msgs), final, band_of(topo)

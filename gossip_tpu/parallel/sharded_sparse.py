@@ -350,6 +350,33 @@ def init_sparse_state(run: RunConfig, proto: ProtocolConfig, n: int,
                     msgs=st.msgs)
 
 
+def simulate_curve_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
+                          mesh: Mesh, fault: Optional[FaultConfig] = None,
+                          axis_name: str = "nodes"):
+    """lax.scan over rounds recording (coverage, msgs) on the sparse
+    exchange path.  Returns (coverage[T], msgs[T], final, SparseMeta)."""
+    import numpy as np
+    step = make_sparse_pull_round(proto, n, mesh, fault, run.origin,
+                                  axis_name)
+    p = mesh.shape[axis_name]
+    n_pad = pad_to_mesh(n, mesh, axis_name)
+    init = init_sparse_state(run, proto, n, mesh, axis_name)
+    r = proto.rumors
+
+    @jax.jit
+    def scan(state):
+        alive_pad = sharded_alive(fault, n, n_pad, run.origin)
+        def body(s, _):
+            s = step(s)
+            return s, (coverage_packed(s.seen, r, alive_pad), s.msgs)
+        return jax.lax.scan(body, state, None, length=run.max_rounds)
+
+    final, (covs, msgs) = scan(init)
+    meta = sparse_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
+                       bidirectional=proto.mode == C.ANTI_ENTROPY)
+    return np.asarray(covs), np.asarray(msgs), final, meta
+
+
 def simulate_until_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
                           mesh: Mesh, fault: Optional[FaultConfig] = None,
                           axis_name: str = "nodes"):

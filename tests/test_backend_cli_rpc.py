@@ -90,6 +90,48 @@ def test_backend_sharded_path():
     assert len(r.curve) == 64
 
 
+def test_backend_sparse_exchange():
+    # the O(messages) all_to_all path as a product surface (--exchange)
+    r = run_simulation("jax-tpu", ProtocolConfig(mode="pull", fanout=1),
+                       TopologyConfig(family="complete", n=2048),
+                       RunConfig(max_rounds=64),
+                       mesh_cfg=MeshConfig(n_devices=8, exchange="sparse"))
+    assert r.meta["exchange"] == "sparse"
+    assert r.coverage >= 0.99
+    b = r.meta["ici_bytes_per_round"]
+    assert b["sparse"] < b["dense_equivalent"]
+    with pytest.raises(ValueError, match="complete topology"):
+        run_simulation("jax-tpu", ProtocolConfig(mode="pull"),
+                       TopologyConfig(family="ring", n=512, k=4),
+                       RunConfig(),
+                       mesh_cfg=MeshConfig(n_devices=8, exchange="sparse"))
+
+
+def test_backend_halo_exchange():
+    # the O(band) ppermute path as a product surface, with curve
+    r = run_simulation("jax-tpu", ProtocolConfig(mode="pushpull", fanout=2),
+                       TopologyConfig(family="ring", n=512, k=6),
+                       RunConfig(max_rounds=128, target_coverage=0.9),
+                       mesh_cfg=MeshConfig(n_devices=8, exchange="halo"),
+                       want_curve=True)
+    assert r.meta["exchange"] == "halo"
+    assert r.meta["band"] == 3
+    assert r.coverage >= 0.9
+    assert len(r.curve) == 128
+    with pytest.raises(ValueError, match="unknown exchange"):
+        MeshConfig(n_devices=8, exchange="carrier-pigeon")
+    # a requested non-dense exchange is never silently substituted
+    with pytest.raises(ValueError, match="n_devices > 1"):
+        run_simulation("jax-tpu", ProtocolConfig(mode="pull"),
+                       TopologyConfig(family="complete", n=256), RunConfig(),
+                       mesh_cfg=MeshConfig(n_devices=1, exchange="sparse"))
+    with pytest.raises(ValueError, match="swim"):
+        run_simulation("jax-tpu", ProtocolConfig(mode="swim"),
+                       TopologyConfig(family="ring", n=256, k=4),
+                       RunConfig(),
+                       mesh_cfg=MeshConfig(n_devices=8, exchange="halo"))
+
+
 def test_backend_rejections():
     with pytest.raises(ValueError, match="unknown backend"):
         run_simulation("torch", ProtocolConfig(), TopologyConfig(),
