@@ -76,13 +76,13 @@ def run_xla_packed(n):
     proto = ProtocolConfig(mode="pull", fanout=1, rumors=1)
     run = RunConfig(target_coverage=TARGET, max_rounds=128, seed=0)
     topo = G.complete(n)
-    loop, init = compiled_until_packed(proto, topo, run)
-    warm = loop(init)
+    loop, init, tables = compiled_until_packed(proto, topo, run)
+    warm = loop(init, *tables)
     jax.block_until_ready(warm.seen)
     init2 = init_packed_state(run, proto, n)
     jax.block_until_ready(init2.seen)
     t0 = time.perf_counter()
-    final = loop(init2)
+    final = loop(init2, *tables)
     jax.block_until_ready(final.seen)
     dt = time.perf_counter() - t0
     rounds = int(final.round)

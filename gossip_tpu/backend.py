@@ -262,10 +262,29 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
                   "msgs_counts": "transmissions", "exchange": "halo",
                   "band": band})
 
+    # Pull and anti-entropy route through the bit-packed engines (32 rumor
+    # bits per gathered word) — bitwise-identical trajectories to the bool
+    # kernels (tests/test_packed.py), just less HBM/ICI traffic.  The curve
+    # drivers stay on the bool path (no packed scan driver yet).
+    packed_ok = proto.mode in ("pull", "antientropy") and not want_curve
+
     if n_dev > 1:
         from gossip_tpu.parallel.sharded import (
             make_mesh, simulate_curve_sharded, simulate_until_sharded)
         mesh = make_mesh(n_dev)
+        if packed_ok:
+            from gossip_tpu.parallel.sharded_packed import (
+                simulate_until_packed_sharded)
+            t0 = time.perf_counter()
+            rounds, cov, msgs, _ = simulate_until_packed_sharded(
+                proto, topo, run, mesh, fault)
+            wall = time.perf_counter() - t0
+            return RunReport(backend="jax-tpu", mode=proto.mode, n=tc.n,
+                             rounds=rounds, coverage=cov, msgs=msgs,
+                             wall_s=round(wall, 4),
+                             meta={"clock": "rounds", "devices": n_dev,
+                                   "msgs_counts": "transmissions",
+                                   "engine": "bit-packed"})
         t0 = time.perf_counter()
         if want_curve:
             covs, msgs, _ = simulate_curve_sharded(proto, topo, run, mesh,
@@ -287,6 +306,18 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
                          wall_s=round(wall, 4),
                          meta={"clock": "rounds", "devices": n_dev,
                                "msgs_counts": "transmissions"})
+
+    if packed_ok:
+        from gossip_tpu.models.si_packed import simulate_until_packed
+        t0 = time.perf_counter()
+        rounds, cov, msgs, _ = simulate_until_packed(proto, topo, run, fault)
+        wall = time.perf_counter() - t0
+        return RunReport(backend="jax-tpu", mode=proto.mode, n=tc.n,
+                         rounds=rounds, coverage=cov, msgs=msgs,
+                         wall_s=round(wall, 4),
+                         meta={"clock": "rounds", "devices": 1,
+                               "msgs_counts": "transmissions",
+                               "engine": "bit-packed"})
 
     from gossip_tpu.runtime.simulator import simulate_curve, simulate_until
     t0 = time.perf_counter()

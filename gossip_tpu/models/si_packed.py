@@ -26,7 +26,7 @@ which unpacks to bools for the scatter on exchange rounds only.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -34,7 +34,8 @@ import jax.numpy as jnp
 from gossip_tpu import config as C
 from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
 from gossip_tpu.models import si as si_mod
-from gossip_tpu.models.state import SimState, alive_mask, init_state
+from gossip_tpu.models.state import (SimState, alive_mask, bind_tables,
+                                     init_state)
 from gossip_tpu.ops.bitpack import coverage_packed, pack, unpack
 from gossip_tpu.ops.propagate import push_delta
 from gossip_tpu.ops.sampling import apply_drop, sample_peers
@@ -68,15 +69,19 @@ def make_packed_round(proto: ProtocolConfig, topo: Topology,
                       fault: Optional[FaultConfig] = None,
                       origin: int = 0,
                       sampler: str = "threefry",
-                      sampler_seed: int = 0
-                      ) -> Callable[[SimState], SimState]:
+                      sampler_seed: int = 0,
+                      tabled: bool = False):
     """Packed PULL / ANTI_ENTROPY round step.
 
     ``sampler="threefry"`` (default) is RNG-identical to
     models/si.make_si_round — same tags, bitwise-equal trajectories.
     ``sampler="pallas"`` draws partners with the TPU hardware PRNG
     (ops/pallas_sampling — different stream, implicit complete graph only,
-    the opt-in bench fast path)."""
+    the opt-in bench fast path).
+
+    ``tabled=True`` returns ``(step, tables)`` with the topology arrays as
+    step ARGUMENTS (no O(N) jit closure constants — models/swim.py doc);
+    the liveness mask is built in-trace."""
     n, k = topo.n, proto.fanout
     mode = proto.mode
     if mode not in (C.PULL, C.ANTI_ENTROPY):
@@ -89,11 +94,13 @@ def make_packed_round(proto: ProtocolConfig, topo: Topology,
     if sampler == "pallas" and not topo.implicit:
         raise ValueError("the pallas sampler draws on the implicit "
                          "complete graph only")
-    alive = alive_mask(fault, n, origin)
     drop_prob = 0.0 if fault is None else fault.drop_prob
-    ids = jnp.arange(n, dtype=jnp.int32)
+    tables = () if topo.implicit else (topo.nbrs, topo.deg)
 
-    def step(state: SimState) -> SimState:
+    def step_tabled(state: SimState, *tbl) -> SimState:
+        nbrs_t, deg_t = tbl if tbl else (None, None)
+        alive = alive_mask(fault, n, origin)      # in-trace
+        ids = jnp.arange(n, dtype=jnp.int32)
         rkey = jax.random.fold_in(state.base_key, state.round)
         packed = state.seen
         visible = packed if alive is None else jnp.where(
@@ -104,7 +111,8 @@ def make_packed_round(proto: ProtocolConfig, topo: Topology,
                                          proto.exclude_self)
         else:
             qkey = jax.random.fold_in(rkey, si_mod.PULL_TAG)
-            partners = sample_peers(qkey, ids, topo, k, proto.exclude_self)
+            partners = sample_peers(qkey, ids, topo, k, proto.exclude_self,
+                                    local_nbrs=nbrs_t, local_deg=deg_t)
         partners = apply_drop(rkey, si_mod.PULL_DROP_TAG, ids,
                               partners, drop_prob, n)
         pulled = pull_merge_packed(visible, partners, n)
@@ -140,7 +148,7 @@ def make_packed_round(proto: ProtocolConfig, topo: Topology,
                         base_key=state.base_key,
                         msgs=state.msgs + mfac * n_req)
 
-    return step
+    return bind_tables(step_tabled, tables, tabled)
 
 
 def simulate_until_packed(proto: ProtocolConfig, topo: Topology,
@@ -148,20 +156,24 @@ def simulate_until_packed(proto: ProtocolConfig, topo: Topology,
                           fault: Optional[FaultConfig] = None):
     """while_loop to target coverage on packed state — the bench fast path.
     Returns (rounds, coverage, msgs, final_state)."""
-    step = make_packed_round(proto, topo, fault, run.origin)
+    step, tables = make_packed_round(proto, topo, fault, run.origin,
+                                     tabled=True)
     alive = alive_mask(fault, topo.n, run.origin)
     init = init_packed_state(run, proto, topo.n)
     target = jnp.float32(run.target_coverage)
     r = proto.rumors
 
     @jax.jit
-    def loop(state):
+    def loop(state, *tbl):
+        alive_t = alive_mask(fault, topo.n, run.origin)
         def cond(s):
-            return ((coverage_packed(s.seen, r, alive) < target)
+            return ((coverage_packed(s.seen, r, alive_t) < target)
                     & (s.round < run.max_rounds))
-        return jax.lax.while_loop(cond, step, state)
+        def body(s):
+            return step(s, *tbl)
+        return jax.lax.while_loop(cond, body, state)
 
-    final = loop(init)
+    final = loop(init, *tables)
     return (int(final.round),
             float(coverage_packed(final.seen, r, alive)),
             float(final.msgs), final)
@@ -171,20 +183,23 @@ def compiled_until_packed(proto: ProtocolConfig, topo: Topology,
                           run: RunConfig,
                           fault: Optional[FaultConfig] = None,
                           sampler: str = "threefry"):
-    """Compiled packed while-loop + fresh init (bench: compile/run split)."""
+    """Compiled packed while-loop + fresh init (bench: compile/run split).
+    Returns (loop, init, tables); call ``loop(state, *tables)``."""
     from functools import partial
-    step = make_packed_round(proto, topo, fault, run.origin, sampler,
-                             run.seed)
-    alive = alive_mask(fault, topo.n, run.origin)
+    step, tables = make_packed_round(proto, topo, fault, run.origin,
+                                     sampler, run.seed, tabled=True)
     init = init_packed_state(run, proto, topo.n)
     target = jnp.float32(run.target_coverage)
     r = proto.rumors
 
     @partial(jax.jit, donate_argnums=0)
-    def loop(state):
+    def loop(state, *tbl):
+        alive = alive_mask(fault, topo.n, run.origin)
         def cond(s):
             return ((coverage_packed(s.seen, r, alive) < target)
                     & (s.round < run.max_rounds))
-        return jax.lax.while_loop(cond, step, state)
+        def body(s):
+            return step(s, *tbl)
+        return jax.lax.while_loop(cond, body, state)
 
-    return loop, init
+    return loop, init, tables

@@ -10,7 +10,7 @@ tests/test_packed.py.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -20,7 +20,7 @@ from gossip_tpu import config as C
 from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
 from gossip_tpu.models import si as si_mod
 from gossip_tpu.models.si_packed import init_packed_state, pull_merge_packed
-from gossip_tpu.models.state import SimState
+from gossip_tpu.models.state import SimState, bind_tables
 from gossip_tpu.ops.bitpack import coverage_packed, pack, unpack
 from gossip_tpu.ops.propagate import push_counts
 from gossip_tpu.ops.sampling import apply_drop, sample_peers
@@ -32,7 +32,10 @@ from gossip_tpu.topology.generators import Topology
 def make_sharded_packed_round(
         proto: ProtocolConfig, topo: Topology, mesh: Mesh,
         fault: Optional[FaultConfig] = None, origin: int = 0,
-        axis_name: str = "nodes") -> Callable[[SimState], SimState]:
+        axis_name: str = "nodes", tabled: bool = False):
+    """``tabled=True`` returns ``(step, tables)`` with the padded topology
+    arrays as step ARGUMENTS (no O(N) jit closure constants —
+    models/swim.py doc); the liveness mask is built in-trace."""
     n, k = topo.n, proto.fanout
     mode = proto.mode
     if mode not in (C.PULL, C.ANTI_ENTROPY):
@@ -40,17 +43,18 @@ def make_sharded_packed_round(
     n_pad = pad_to_mesh(n, mesh, axis_name)
     nl = n_pad // mesh.shape[axis_name]
     drop_prob = 0.0 if fault is None else fault.drop_prob
-    alive_pad = sharded_alive(fault, n, n_pad, origin)
 
     have_table = not topo.implicit
     if have_table:
         nbrs_pad = _pad_rows(topo.nbrs, n_pad, n)
         deg_pad = _pad_rows(topo.deg, n_pad, 0)
 
-    def local_round(packed_l, round_, base_key, msgs, alive_l, *table):
+    def local_round(packed_l, round_, base_key, msgs, *table):
         shard = jax.lax.axis_index(axis_name)
         gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
         rkey = jax.random.fold_in(base_key, round_)
+        # liveness in-trace (replicated compute, no O(N) inline constant)
+        alive_l = sharded_alive(fault, n, n_pad, origin)[gids]
         visible = jnp.where(alive_l[:, None], packed_l, jnp.uint32(0))
         packed_all = jax.lax.all_gather(visible, axis_name, tiled=True)
         nbrs_l, deg_l = table if have_table else (None, None)
@@ -97,22 +101,22 @@ def make_sharded_packed_round(
 
     sh2 = P(axis_name, None)
     rep = P()
-    in_specs = [sh2, rep, rep, rep, P(axis_name)]
-    args = [alive_pad]
+    in_specs = [sh2, rep, rep, rep]
+    tables = ()
     if have_table:
         in_specs += [sh2, P(axis_name)]
-        args += [nbrs_pad, deg_pad]
+        tables = (nbrs_pad, deg_pad)
 
     mapped = jax.shard_map(local_round, mesh=mesh, in_specs=tuple(in_specs),
                            out_specs=(sh2, rep))
 
-    def step(state: SimState) -> SimState:
+    def step_tabled(state: SimState, *tbl) -> SimState:
         seen, msgs = mapped(state.seen, state.round, state.base_key,
-                            state.msgs, *args)
+                            state.msgs, *tbl)
         return SimState(seen=seen, round=state.round + 1,
                         base_key=state.base_key, msgs=msgs)
 
-    return step
+    return bind_tables(step_tabled, tables, tabled)
 
 
 def init_sharded_packed_state(run: RunConfig, proto: ProtocolConfig,
@@ -129,8 +133,9 @@ def simulate_until_packed_sharded(proto: ProtocolConfig, topo: Topology,
                                   run: RunConfig, mesh: Mesh,
                                   fault: Optional[FaultConfig] = None,
                                   axis_name: str = "nodes"):
-    step = make_sharded_packed_round(proto, topo, mesh, fault, run.origin,
-                                     axis_name)
+    step, tables = make_sharded_packed_round(proto, topo, mesh, fault,
+                                             run.origin, axis_name,
+                                             tabled=True)
     n_pad = pad_to_mesh(topo.n, mesh, axis_name)
     alive_pad = sharded_alive(fault, topo.n, n_pad, run.origin)
     init = init_sharded_packed_state(run, proto, topo, mesh, axis_name)
@@ -138,13 +143,16 @@ def simulate_until_packed_sharded(proto: ProtocolConfig, topo: Topology,
     r = proto.rumors
 
     @jax.jit
-    def loop(state):
+    def loop(state, *tbl):
+        alive_t = sharded_alive(fault, topo.n, n_pad, run.origin)
         def cond(s):
-            return ((coverage_packed(s.seen, r, alive_pad) < target)
+            return ((coverage_packed(s.seen, r, alive_t) < target)
                     & (s.round < run.max_rounds))
-        return jax.lax.while_loop(cond, step, state)
+        def body(s):
+            return step(s, *tbl)
+        return jax.lax.while_loop(cond, body, state)
 
-    final = loop(init)
+    final = loop(init, *tables)
     return (int(final.round),
             float(coverage_packed(final.seen, r, alive_pad)),
             float(final.msgs), final)

@@ -90,6 +90,30 @@ def test_backend_sharded_path():
     assert len(r.curve) == 64
 
 
+def test_backend_packed_routing_matches_bool_path():
+    # pull/anti-entropy route through the bit-packed engine; trajectories
+    # are bitwise-identical to the bool path, so rounds-to-target and final
+    # coverage must agree exactly with the curve (bool) run.
+    proto = ProtocolConfig(mode="pull", fanout=1, rumors=3)
+    tc = TopologyConfig(family="erdos_renyi", n=1024, p=0.02)
+    run = RunConfig(max_rounds=64)
+    fast = run_simulation("jax-tpu", proto, tc, run)
+    assert fast.meta["engine"] == "bit-packed"
+    slow = run_simulation("jax-tpu", proto, tc, run, want_curve=True)
+    assert "engine" not in slow.meta          # curve keeps the bool path
+    # identical trajectory => same rounds-to-target (the while-loop run
+    # stops there; the curve run continues to max_rounds, so final
+    # coverage/msgs are not comparable between the two driver shapes)
+    assert fast.rounds == slow.rounds
+    assert fast.coverage >= run.target_coverage
+    # sharded twin routes too and agrees exactly
+    sh = run_simulation("jax-tpu", proto, tc, run,
+                        mesh_cfg=MeshConfig(n_devices=8))
+    assert sh.meta["engine"] == "bit-packed"
+    assert sh.rounds == fast.rounds
+    assert sh.msgs == pytest.approx(fast.msgs)
+
+
 def test_backend_sparse_exchange():
     # the O(messages) all_to_all path as a product surface (--exchange)
     r = run_simulation("jax-tpu", ProtocolConfig(mode="pull", fanout=1),
