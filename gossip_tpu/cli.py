@@ -219,8 +219,11 @@ def cmd_grid(a) -> int:
     """Batched config sweep: the cartesian product of --modes/--fanouts/
     --drops/--periods/--seeds runs as ONE compiled XLA program (the
     north-star "sweep fanout, mode, ... across a pod" sentence —
-    parallel/sweep.config_sweep_curves)."""
-    from gossip_tpu.parallel.sweep import SweepPoint, config_sweep_curves
+    parallel/sweep.config_sweep_curves).  --devices shards the config axis
+    over a mesh; --pod-mesh S N runs the full 2-D (configs x node-shards)
+    shard_map program."""
+    from gossip_tpu.parallel.sweep import (SweepPoint, config_sweep_curves,
+                                           config_sweep_curves_2d)
     from gossip_tpu.topology import generators as G
     tc = TopologyConfig(family=a.family, n=a.n, k=a.k, p=a.p,
                         degree_cap=a.degree_cap, seed=a.seed)
@@ -236,8 +239,28 @@ def cmd_grid(a) -> int:
         for s in a.seeds]
     # periods multiply only anti-entropy points; dedupe the rest
     points = list(dict.fromkeys(points))
-    res = config_sweep_curves(points, G.build(tc), run, fault=fault,
-                              rumors=a.rumors)
+    if a.pod_mesh:
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+        s, nd = a.pod_mesh
+        have = len(jax.devices())
+        if have < s * nd:
+            raise ValueError(f"--pod-mesh {s} {nd} needs {s * nd} devices; "
+                             f"only {have} available")
+        mesh2d = Mesh(np.asarray(jax.devices()[:s * nd]).reshape(s, nd),
+                      ("sweep", "nodes"))
+        res = config_sweep_curves_2d(points, G.build(tc), run, mesh2d,
+                                     fault=fault, rumors=a.rumors)
+    elif a.devices > 1:
+        from gossip_tpu.parallel.sharded import make_mesh
+        res = config_sweep_curves(points, G.build(tc), run, fault=fault,
+                                  rumors=a.rumors,
+                                  mesh=make_mesh(a.devices,
+                                                 axis_name="sweep"))
+    else:
+        res = config_sweep_curves(points, G.build(tc), run, fault=fault,
+                                  rumors=a.rumors)
     for i, summary in enumerate(res.summaries()):
         summary["n"] = a.n
         summary["family"] = a.family
@@ -304,6 +327,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--death", type=float, default=0.0)
     p.add_argument("--curve", action="store_true")
+    p.add_argument("--devices", type=int, default=1,
+                   help="shard the config axis over this many devices")
+    p.add_argument("--pod-mesh", nargs=2, type=int, default=None,
+                   metavar=("SWEEP", "NODES"),
+                   help="2-D mesh: configs sharded over SWEEP devices, "
+                        "each config's nodes over NODES devices")
     p.set_defaults(fn=cmd_grid)
 
     p = sub.add_parser("serve", help="start the gRPC sidecar")

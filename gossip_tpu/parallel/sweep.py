@@ -105,6 +105,140 @@ def ensemble_curves(proto: ProtocolConfig, topo: Topology, run: RunConfig,
                           target=run.target_coverage)
 
 
+def config_sweep_curves_2d(points, topo: Topology, run: RunConfig,
+                           mesh, fault: Optional[FaultConfig] = None,
+                           k_max: Optional[int] = None, rumors: int = 1,
+                           sweep_axis: str = "sweep",
+                           node_axis: str = "nodes") -> ConfigSweepResult:
+    """The north star's full 2-D pod sweep: distinct configs sharded over
+    ``sweep_axis`` AND every config's node dimension sharded over
+    ``node_axis`` — one ``shard_map`` over a 2-D mesh, one XLA program.
+
+    The config axis is embarrassingly parallel; the node axis uses the
+    dense collectives of parallel/sharded.py (``psum`` count reduction,
+    ``all_gather`` pull digests) *under vmap* — each device holds a
+    ``[C_local, nl, R]`` block and the collectives batch over its local
+    configs.  Same trajectory definition as :func:`config_sweep_curves`
+    (same RNG keying by global node id, same shared-``k_max`` draw widths),
+    so results are identical to the 1-D batch for any mesh shape.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from gossip_tpu.parallel.sharded import (_pad_rows, pad_to_mesh,
+                                             sharded_alive)
+    points = tuple(points)
+    if not points:
+        raise ValueError("need at least one SweepPoint")
+    if fault is not None and fault.drop_prob > 0.0:
+        raise ValueError("per-config loss goes through SweepPoint.drop_prob;"
+                         " FaultConfig.drop_prob would be ambiguous here")
+    cN = len(points)
+    p_sweep = mesh.shape[sweep_axis]
+    if cN % p_sweep != 0:
+        raise ValueError(f"{cN} configs do not divide over the "
+                         f"{sweep_axis} axis of size {p_sweep}")
+    n = topo.n
+    n_pad = pad_to_mesh(n, mesh, node_axis)
+    nl = n_pad // mesh.shape[node_axis]
+    k_max = k_max or max(pt.fanout for pt in points)
+    if any(pt.fanout > k_max for pt in points):
+        raise ValueError("k_max smaller than a point's fanout")
+    have_ae = any(pt.mode == C.ANTI_ENTROPY for pt in points)
+    have_table = not topo.implicit
+    if have_table:
+        nbrs_pad = _pad_rows(topo.nbrs, n_pad, n)
+        deg_pad = _pad_rows(topo.deg, n_pad, 0)
+
+    def one_cfg_round(seen_l, round_, base_key, msgs,
+                      do_push, do_pull, do_ae, fanout, dropp, period,
+                      nbrs_l, deg_l):
+        """One config's round on this node shard ([nl, R] rows)."""
+        shard = jax.lax.axis_index(node_axis)
+        gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
+        alive_l = sharded_alive(fault, n, n_pad, run.origin)[gids]
+        rkey = jax.random.fold_in(base_key, round_)
+        visible = seen_l & alive_l[:, None]
+
+        def count_reduce(counts):
+            # psum + own slice rather than psum_scatter: this runs under
+            # vmap over the local configs
+            full = jax.lax.psum(counts, node_axis)
+            return jax.lax.dynamic_slice_in_dim(full, shard * nl, nl, 0)
+
+        delta, msgs_round = _sweep_round_delta(
+            rkey, round_, gids, visible, alive_l, topo, k_max,
+            nbrs_l, deg_l, do_push, do_pull, do_ae, fanout, dropp, period,
+            have_ae, scatter_n=n_pad, count_reduce=count_reduce,
+            gather=lambda v: jax.lax.all_gather(v, node_axis, tiled=True))
+        seen_new = seen_l | delta
+        msgs_new = msgs + jax.lax.psum(msgs_round, node_axis)
+
+        # coverage on-device (min over rumors of alive-weighted fraction)
+        w = alive_l.astype(jnp.float32)
+        cnt = jax.lax.psum(jnp.sum(seen_new * w[:, None], axis=0),
+                           node_axis)                           # [R]
+        denom = jax.lax.psum(jnp.sum(w), node_axis)
+        cov = jnp.min(cnt / jnp.maximum(denom, 1.0))
+        return seen_new, msgs_new, cov
+
+    def local_block(seen_b, round_, keys_b, msgs_b,
+                    dpush_b, dpull_b, dae_b, fan_b, drop_b, per_b, *table):
+        nbrs_l, deg_l = table if have_table else (None, None)
+        return jax.vmap(
+            lambda s, key, m, a, b, c, f, d, p: one_cfg_round(
+                s, round_, key, m, a, b, c, f, d, p, nbrs_l, deg_l)
+        )(seen_b, keys_b, msgs_b, dpush_b, dpull_b, dae_b, fan_b, drop_b,
+          per_b)
+
+    sw = P(sweep_axis)
+    in_specs = [P(sweep_axis, node_axis, None), P(), sw, sw,
+                sw, sw, sw, sw, sw, sw]
+    if have_table:
+        in_specs += [P(node_axis, None), P(node_axis)]
+    mapped = jax.shard_map(local_block, mesh=mesh,
+                           in_specs=tuple(in_specs),
+                           out_specs=(P(sweep_axis, node_axis, None), sw,
+                                      sw))
+
+    proto_like = ProtocolConfig(mode=C.PUSH, fanout=k_max, rumors=rumors)
+    base = init_state(run, proto_like, n)
+    seen0 = _pad_rows(base.seen, n_pad, False)
+    init_seen = jnp.broadcast_to(seen0, (cN,) + seen0.shape)
+    keys = jax.vmap(jax.random.key)(
+        jnp.asarray([pt.seed for pt in points], jnp.uint32))
+    flags = [jnp.asarray([_MODE_FLAGS[pt.mode][0] for pt in points]),
+             jnp.asarray([_MODE_FLAGS[pt.mode][1] for pt in points]),
+             jnp.asarray([pt.mode == C.ANTI_ENTROPY for pt in points]),
+             jnp.asarray([pt.fanout for pt in points], jnp.int32),
+             jnp.asarray([pt.drop_prob for pt in points], jnp.float32),
+             jnp.asarray([pt.period for pt in points], jnp.int32)]
+    init_seen = jax.device_put(
+        init_seen, NamedSharding(mesh, P(sweep_axis, node_axis, None)))
+    row = NamedSharding(mesh, P(sweep_axis))
+    keys = jax.device_put(keys, row)
+    flags = [jax.device_put(f, row) for f in flags]
+    tables = (nbrs_pad, deg_pad) if have_table else ()
+
+    @jax.jit
+    def scan(seen, keys, msgs, *args):
+        flags_, tbl = args[:6], args[6:]
+        def body(carry, round_):
+            seen, msgs = carry
+            seen, msgs, covs = mapped(seen, round_, keys, msgs, *flags_,
+                                      *tbl)
+            return (seen, msgs), (covs, msgs)
+        return jax.lax.scan(body, (seen, msgs),
+                            jnp.arange(run.max_rounds, dtype=jnp.int32))
+
+    _, (covs, msgs) = scan(init_seen, keys,
+                           jnp.zeros((cN,), jnp.float32), *flags, *tables)
+    curves = np.asarray(covs).T
+    return ConfigSweepResult(points=points, curves=curves,
+                             msgs=np.asarray(msgs).T,
+                             rounds_to_target=_rounds_to_target(
+                                 curves, run.target_coverage),
+                             target=run.target_coverage)
+
+
 def _rounds_to_target(curves: np.ndarray, target: float) -> np.ndarray:
     """First 1-based round index reaching target per row; -1 if never."""
     hit = np.full(curves.shape[0], -1, np.int64)
@@ -178,10 +312,62 @@ def _drop_targets(rkey, tag, gids, targets, drop_prob, sentinel):
     return jnp.where(dropped, jnp.int32(sentinel), targets)
 
 
+def _sweep_round_delta(rkey, round_, gids, visible, alive_l, topo, k_max,
+                       nbrs, deg, do_push, do_pull, do_ae, fanout, dropp,
+                       period, have_ae, scatter_n, count_reduce, gather):
+    """The ONE per-config sweep round body — shared by the single-device
+    batch and the 2-D pod sweep, which differ only in how scatter counts
+    reduce (``count_reduce``), how the digest table is assembled
+    (``gather``), and the scatter sentinel (``scatter_n``).  Returns
+    (delta, msgs_this_round) for this row block."""
+    n = topo.n
+    col = jnp.arange(k_max, dtype=jnp.int32)[None, :]
+
+    # push half (computed for every config, masked by do_push)
+    pkey = jax.random.fold_in(rkey, si_mod.PUSH_TAG)
+    targets = sample_peers(pkey, gids, topo, k_max, True,
+                           local_nbrs=nbrs, local_deg=deg)
+    targets = jnp.where(col < fanout, targets, jnp.int32(n))
+    targets = _drop_targets(rkey, si_mod.PUSH_DROP_TAG, gids, targets,
+                            dropp, n)
+    sender_active = jnp.any(visible, axis=1)
+    valid = (targets < n) & sender_active[:, None]
+    counts = push_counts(scatter_n, jnp.where(valid, targets, scatter_n),
+                         visible)
+    delta = (count_reduce(counts) > 0) & do_push
+    msgs = jnp.where(do_push, jnp.sum(valid).astype(jnp.float32), 0.0)
+
+    # pull half (anti-entropy = bidirectional exchange gated by period)
+    seen_all = gather(visible)
+    qkey = jax.random.fold_in(rkey, si_mod.PULL_TAG)
+    partners = sample_peers(qkey, gids, topo, k_max, True,
+                            local_nbrs=nbrs, local_deg=deg)
+    partners = jnp.where(col < fanout, partners, jnp.int32(n))
+    partners = _drop_targets(rkey, si_mod.PULL_DROP_TAG, gids, partners,
+                             dropp, n)
+    pulled = pull_merge(seen_all, partners, n)
+    partners = jnp.where(alive_l[:, None], partners, n)
+    n_req = jnp.sum(partners < n).astype(jnp.float32)
+    on = do_pull & ((round_ % period) == 0)
+    delta = delta | (pulled & on)
+    if have_ae:
+        # anti-entropy reverse delta: the initiator's state scatters back
+        # into the partner's row (models/si.py) — built only when the
+        # batch has an AE point
+        bcounts = push_counts(scatter_n,
+                              jnp.where(partners < n, partners, scatter_n),
+                              visible)
+        delta = delta | ((count_reduce(bcounts) > 0) & (on & do_ae))
+    mfac = jnp.where(do_ae, 3.0, 2.0)
+    msgs = msgs + jnp.where(on, mfac * n_req, 0.0)
+    return delta & alive_l[:, None], msgs
+
+
 def config_sweep_curves(points, topo: Topology, run: RunConfig,
                         fault: Optional[FaultConfig] = None,
                         k_max: Optional[int] = None,
-                        rumors: int = 1) -> ConfigSweepResult:
+                        rumors: int = 1, mesh=None,
+                        axis_name: str = "sweep") -> ConfigSweepResult:
     """Run C distinct config points as ONE batched XLA program.
 
     ``fault`` contributes only the static death mask (shared structure);
@@ -193,6 +379,13 @@ def config_sweep_curves(points, topo: Topology, run: RunConfig,
     fanout equals k_max reproduces the solo make_si_round trajectory
     BITWISE (same keys, same draw shapes); batch composition never changes
     results (tested in tests/test_config_sweep.py).
+
+    ``mesh``: a 1-D device mesh shards the CONFIG axis — the north star's
+    "sweep fanout, mode, topology across a TPU pod" DP axis.  Configs are
+    independent, so the batch is embarrassingly parallel: the batched
+    arrays are placed with a ``P(axis_name)`` sharding and XLA partitions
+    the whole scan with zero cross-device traffic.  Results are the same
+    trajectories in the same order (sharding never changes values).
     """
     points = tuple(points)
     if not points:
@@ -200,6 +393,11 @@ def config_sweep_curves(points, topo: Topology, run: RunConfig,
     if fault is not None and fault.drop_prob > 0.0:
         raise ValueError("per-config loss goes through SweepPoint.drop_prob;"
                          " FaultConfig.drop_prob would be ambiguous here")
+    if mesh is not None and len(points) % mesh.shape[axis_name] != 0:
+        raise ValueError(
+            f"{len(points)} configs do not divide over the {axis_name} "
+            f"mesh axis of size {mesh.shape[axis_name]}; pad the batch "
+            "(duplicate a point) or change the mesh")
     n = topo.n
     k_max = k_max or max(pt.fanout for pt in points)
     if any(pt.fanout > k_max for pt in points):
@@ -214,49 +412,14 @@ def config_sweep_curves(points, topo: Topology, run: RunConfig,
         nbrs, deg = tbl if tbl else (None, None)
         # O(N) buffers in-trace: no inline constants in the compile request
         gids = jnp.arange(n, dtype=jnp.int32)
-        col = jnp.arange(k_max, dtype=jnp.int32)[None, :]
         alive = alive_mask(fault, n, run.origin)
         alive_b = jnp.ones((n,), jnp.bool_) if alive is None else alive
         rkey = jax.random.fold_in(base_key, round_)
         visible = seen & alive_b[:, None]
-        delta = jnp.zeros_like(seen)
-
-        # push half (computed for every config, masked by do_push)
-        pkey = jax.random.fold_in(rkey, si_mod.PUSH_TAG)
-        targets = sample_peers(pkey, gids, topo, k_max, True,
-                               local_nbrs=nbrs, local_deg=deg)
-        targets = jnp.where(col < fanout, targets, jnp.int32(n))
-        targets = _drop_targets(rkey, si_mod.PUSH_DROP_TAG, gids, targets,
-                                dropp, n)
-        sender_active = jnp.any(visible, axis=1)
-        valid = (targets < n) & sender_active[:, None]
-        counts = push_counts(n, jnp.where(valid, targets, n), visible)
-        delta = delta | ((counts > 0) & do_push)
-        msgs_round = jnp.where(do_push,
-                               jnp.sum(valid).astype(jnp.float32), 0.0)
-
-        # pull half (anti-entropy = pull gated by period)
-        qkey = jax.random.fold_in(rkey, si_mod.PULL_TAG)
-        partners = sample_peers(qkey, gids, topo, k_max, True,
-                                local_nbrs=nbrs, local_deg=deg)
-        partners = jnp.where(col < fanout, partners, jnp.int32(n))
-        partners = _drop_targets(rkey, si_mod.PULL_DROP_TAG, gids, partners,
-                                 dropp, n)
-        pulled = pull_merge(visible, partners, n)
-        partners = jnp.where(alive_b[:, None], partners, n)
-        n_req = jnp.sum(partners < n).astype(jnp.float32)
-        on = do_pull & ((round_ % period) == 0)
-        delta = delta | (pulled & on)
-        if have_ae:
-            # anti-entropy reverse delta: the initiator's state scatters
-            # back into the partner's row (bidirectional exchange,
-            # models/si.py) — built only when the batch has an AE point
-            bcounts = push_counts(n, partners, visible)
-            delta = delta | ((bcounts > 0) & (on & do_ae))
-        mfac = jnp.where(do_ae, 3.0, 2.0)
-        msgs_round = msgs_round + jnp.where(on, mfac * n_req, 0.0)
-
-        delta = delta & alive_b[:, None]
+        delta, msgs_round = _sweep_round_delta(
+            rkey, round_, gids, visible, alive_b, topo, k_max, nbrs, deg,
+            do_push, do_pull, do_ae, fanout, dropp, period, have_ae,
+            scatter_n=n, count_reduce=lambda c: c, gather=lambda v: v)
         return seen | delta, round_ + 1, msgs + msgs_round
 
     batched = jax.vmap(one_round,
@@ -272,6 +435,15 @@ def config_sweep_curves(points, topo: Topology, run: RunConfig,
     fanouts = jnp.asarray([pt.fanout for pt in points], jnp.int32)
     drops = jnp.asarray([pt.drop_prob for pt in points], jnp.float32)
     periods = jnp.asarray([pt.period for pt in points], jnp.int32)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        row = NamedSharding(mesh, P(axis_name))
+        init_seen = jax.device_put(
+            init_seen, NamedSharding(mesh, P(axis_name, None, None)))
+        keys = jax.device_put(keys, row)
+        do_push, do_pull, do_ae, fanouts, drops, periods = (
+            jax.device_put(x, row)
+            for x in (do_push, do_pull, do_ae, fanouts, drops, periods))
 
     @jax.jit
     def scan(seen, rounds, keys, msgs, *tbl):

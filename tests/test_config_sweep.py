@@ -1,11 +1,15 @@
 """Tests for the batched config sweep (parallel/sweep.config_sweep_curves)."""
 
+import jax
 import numpy as np
 import pytest
+from jax.sharding import Mesh
 
 from gossip_tpu import config as C
 from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
-from gossip_tpu.parallel.sweep import SweepPoint, config_sweep_curves
+from gossip_tpu.parallel.sharded import make_mesh
+from gossip_tpu.parallel.sweep import (SweepPoint, config_sweep_curves,
+                                       config_sweep_curves_2d)
 from gossip_tpu.runtime.simulator import simulate_curve
 from gossip_tpu.topology import generators as G
 
@@ -22,6 +26,39 @@ def _grid_points():
         SweepPoint(mode=C.ANTI_ENTROPY, fanout=1, period=3, seed=6),
         SweepPoint(mode=C.ANTI_ENTROPY, fanout=2, period=2, seed=7),
     ]
+
+
+def test_sweep_axis_sharding_is_value_invariant():
+    # the north-star DP axis: configs sharded over a 1-D device mesh give
+    # the exact trajectories of the unsharded batch
+    topo = G.complete(512)
+    run = RunConfig(seed=0, max_rounds=24)
+    pts = _grid_points()
+    solo = config_sweep_curves(pts, topo, run)
+    mesh = make_mesh(8, axis_name="sweep")
+    sh = config_sweep_curves(pts, topo, run, mesh=mesh)
+    np.testing.assert_array_equal(sh.curves, solo.curves)
+    np.testing.assert_array_equal(sh.msgs, solo.msgs)
+    with pytest.raises(ValueError, match="divide"):
+        config_sweep_curves(pts[:3], topo, run, mesh=mesh)
+
+
+@pytest.mark.parametrize("family", ["complete", "er"])
+def test_2d_pod_sweep_matches_1d_batch(family):
+    # full 2-D mesh: configs x node shards in ONE shard_map program —
+    # trajectories identical to the single-device batch
+    topo = (G.complete(512) if family == "complete"
+            else G.erdos_renyi(512, 0.05, seed=2))
+    run = RunConfig(seed=0, max_rounds=24, target_coverage=0.99)
+    pts = _grid_points()
+    solo = config_sweep_curves(pts, topo, run, rumors=2)
+    mesh2d = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                  ("sweep", "nodes"))
+    pod = config_sweep_curves_2d(pts, topo, run, mesh2d, rumors=2)
+    np.testing.assert_allclose(pod.curves, solo.curves, atol=1e-6)
+    np.testing.assert_array_equal(pod.msgs, solo.msgs)
+    np.testing.assert_array_equal(pod.rounds_to_target,
+                                  solo.rounds_to_target)
 
 
 def test_eight_configs_one_program_all_converge():
