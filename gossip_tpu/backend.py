@@ -128,6 +128,86 @@ def _curve_summary(covs, msgs, target):
             [float(c) for c in covs])
 
 
+def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
+               fault: Optional[FaultConfig], n_dev: int,
+               want_curve: bool) -> RunReport:
+    """engine='fused': the Pallas VMEM pull kernel as a product surface.
+
+    Validates eagerly and loudly — the fused kernel covers exactly the
+    flagship envelope (TPU, pull, implicit complete graph, single device,
+    fault-free, <= 32 rumors) and silently substituting a different engine
+    would mislabel the wall-clock numbers, same policy as the exchange
+    routing above.
+    """
+    import jax as _jax
+    import jax.numpy as jnp
+
+    from gossip_tpu.ops.pallas_round import (
+        BITS, check_fused_fits, compiled_until_fused,
+        compiled_until_fused_multirumor, coverage_node_packed,
+        coverage_words)
+
+    if proto.mode != "pull":
+        raise ValueError(f"engine='fused' implements pull rounds only "
+                         f"(got mode {proto.mode!r})")
+    if tc.family != "complete":
+        raise ValueError("engine='fused' runs on the implicit complete "
+                         f"topology only (got family {tc.family!r})")
+    if n_dev != 1:
+        raise ValueError("engine='fused' is the single-device VMEM kernel; "
+                         "use engine='auto' (with --exchange "
+                         "dense/sparse/halo) for sharded runs")
+    if fault is not None and (fault.node_death_rate or fault.drop_prob
+                              or fault.dead_nodes):
+        raise ValueError("engine='fused' has no fault-mask path; "
+                         "use engine='auto' for fault injection")
+    if proto.rumors > BITS:
+        raise ValueError(f"engine='fused' packs <= {BITS} rumors per word "
+                         f"(got rumors={proto.rumors})")
+    if want_curve:
+        raise ValueError("engine='fused' runs a compiled while_loop with no "
+                         "per-round curve capture; use engine='auto'")
+    table_bytes = check_fused_fits(tc.n, proto.rumors)
+    # platform last: config errors above surface identically on any backend
+    if _jax.default_backend() != "tpu":
+        raise ValueError(
+            "engine='fused' needs a TPU (the kernel samples partners with "
+            "the TPU hardware PRNG, which has no CPU equivalent); use "
+            "engine='auto' for the XLA bit-packed path")
+
+    n = tc.n
+    if proto.rumors == 1:
+        loop, init = compiled_until_fused(
+            n, seed=run.seed, fanout=proto.fanout,
+            target_coverage=run.target_coverage, max_rounds=run.max_rounds,
+            origin=run.origin)
+        cov_fn = lambda t: coverage_node_packed(t, n)  # noqa: E731
+    else:
+        loop, init = compiled_until_fused_multirumor(
+            n, proto.rumors, seed=run.seed, fanout=proto.fanout,
+            target_coverage=run.target_coverage, max_rounds=run.max_rounds,
+            origin=run.origin)
+        cov_fn = lambda t: coverage_words(t, n, proto.rumors)  # noqa: E731
+
+    t0 = time.perf_counter()
+    final = loop(init)
+    _jax.block_until_ready(final.table)
+    wall = time.perf_counter() - t0
+    cov = float(cov_fn(final.table))
+    rounds = int(final.round)
+    # float32 target compare, same threshold the loop's cond used
+    hit = cov >= float(jnp.float32(run.target_coverage))
+    return RunReport(
+        backend="jax-tpu", mode=proto.mode, n=n,
+        rounds=rounds if hit else -1, coverage=cov,
+        msgs=float(final.msgs), wall_s=round(wall, 4),
+        meta={"clock": "rounds", "devices": 1,
+              "msgs_counts": "transmissions", "engine": "fused-pallas",
+              "layout": ("node-packed bitmap" if proto.rumors == 1
+                         else "one 32-rumor word per node"),
+              "vmem_table_bytes": table_bytes})
+
+
 def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
             fault: Optional[FaultConfig] = None,
             mesh_cfg: Optional[MeshConfig] = None,
@@ -149,6 +229,9 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
             raise ValueError(
                 f"exchange={_exchange!r} is not implemented for swim; "
                 "SWIM shards via the dense pmax kernel")
+
+    if run.engine == "fused":
+        return _run_fused(proto, tc, run, fault, n_dev, want_curve)
 
     if proto.mode == "swim":
         from gossip_tpu.models.swim import (resolve_epoch_rounds,
@@ -345,6 +428,9 @@ def run_simulation(backend: str, proto: ProtocolConfig, tc: TopologyConfig,
                    mesh_cfg: Optional[MeshConfig] = None,
                    want_curve: bool = False) -> RunReport:
     """The one entry point both the CLI and the sidecar call."""
+    if backend == "go-native" and run.engine != "auto":
+        raise ValueError(f"engine={run.engine!r} is a jax-tpu kernel "
+                         "selection; go-native has one (event-driven) engine")
     if backend == "go-native":
         return run_gonative(proto, tc, run, fault, want_curve)
     if backend == "jax-tpu":

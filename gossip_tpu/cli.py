@@ -62,6 +62,11 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                         "sparse all_to_all (complete topology, "
                         "pull/antientropy, O(messages)), halo ppermute "
                         "(band-limited topologies, O(band))")
+    p.add_argument("--engine", default="auto", choices=("auto", "fused"),
+                   help="round kernel: auto = XLA (bit-packed fast path "
+                        "where eligible); fused = the Pallas VMEM kernel "
+                        "(TPU, pull, complete graph, single device, "
+                        "<= 32 rumors)")
     p.add_argument("--curve", action="store_true",
                    help="include the per-round coverage curve")
     p.add_argument("--save-curve", default=None, metavar="PATH",
@@ -100,7 +105,8 @@ def _args_to_configs(a):
     tc = TopologyConfig(family=a.family, n=a.n, k=a.k, p=a.p,
                         degree_cap=a.degree_cap, seed=a.seed)
     run = RunConfig(target_coverage=a.target, max_rounds=a.max_rounds,
-                    seed=a.seed, origin=a.origin)
+                    seed=a.seed, origin=a.origin,
+                    engine=getattr(a, "engine", "auto"))
     fault = None
     if a.drop > 0 or a.death > 0 or a.dead_nodes:
         fault = FaultConfig(node_death_rate=a.death, drop_prob=a.drop,
@@ -119,6 +125,12 @@ def cmd_run(a) -> int:
         if a.backend != "jax-tpu" or a.mode == "swim":
             print("error: --ensemble needs the jax-tpu backend and a "
                   "non-swim mode", file=sys.stderr)
+            return 2
+        if run.engine != "auto":
+            # never silently substitute the XLA kernels for a requested
+            # engine (same policy as backend._run_fused)
+            print("error: --ensemble runs the threefry XLA kernels; "
+                  "--engine fused is single-run only", file=sys.stderr)
             return 2
         from gossip_tpu.parallel.sweep import ensemble_curves
         from gossip_tpu.topology import generators as G

@@ -137,18 +137,39 @@ class FaultConfig:
             raise ValueError("fail_round must be >= 0")
 
 
+ENGINES = ("auto", "fused")
+
+
 @dataclasses.dataclass(frozen=True)
 class RunConfig:
-    """Simulation driver parameters."""
+    """Simulation driver parameters.
+
+    ``engine`` selects the single-device round implementation:
+
+    * ``auto``  — XLA kernels; pull/anti-entropy route through the
+      bit-packed fast path (models/si_packed.py), everything else through
+      the bool kernels (models/si.py).  Works on any backend, any mode.
+    * ``fused`` — the fully-fused Pallas VMEM kernel
+      (ops/pallas_round.py): hardware-PRNG partner sampling + in-row
+      gather + OR-merge in one ``pallas_call``, zero HBM gather.  TPU
+      only (the hardware PRNG has no CPU equivalent); pull mode on the
+      implicit complete topology, single device, fault-free, <= 32
+      rumors.  This is the bench.py flagship path surfaced as a product
+      engine.
+    """
 
     target_coverage: float = 0.99
     max_rounds: int = 256
     seed: int = 0
     origin: int = 0          # node where rumor 0 starts (rumor r starts at origin+r)
+    engine: str = "auto"
 
     def __post_init__(self):
         if not 0.0 < self.target_coverage <= 1.0:
             raise ValueError("target_coverage must be in (0, 1]")
+        if self.engine not in ENGINES:
+            raise ValueError(f"unknown engine {self.engine!r}; "
+                             f"choose from {ENGINES}")
 
 
 EXCHANGES = ("dense", "sparse", "halo")

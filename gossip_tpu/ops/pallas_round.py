@@ -104,6 +104,58 @@ def coverage_node_packed(table: jax.Array, n: int) -> jax.Array:
     return pop.astype(jnp.float32) / jnp.float32(n)
 
 
+# VMEM budget for the fused kernels: the live set is ~4 table-sized
+# buffers (aliased in/out table, rot, the rolled temp, acc), kept under
+# v5e's 128 MB with headroom for Mosaic's own temporaries.
+_VMEM_LIMIT_BYTES = 110 * 1024 * 1024
+TABLE_COPIES = 4
+
+
+def _rotate_rows(table: jax.Array, sbits: jax.Array, rows: int) -> jax.Array:
+    """Stage 1 of the partner draw (shared by both fused kernels):
+    ``rot[i, j] = table[(i - s_j) mod rows, j]`` with per-lane shifts
+    ``s_j = sbits[0, j] mod rows``, built from ceil(log2 rows) conditional
+    *static* rolls — a binary decomposition of the shift, selected per
+    lane.  (Modulo bias rows/2^32 < 1e-6: documented.)"""
+    s = (sbits[0:1, :] % jnp.uint32(rows)).astype(jnp.int32)   # [1, 128]
+    rot = table
+    shift = 1
+    while shift < rows:
+        rolled = pltpu.roll(rot, shift, 0)
+        take = (s & shift) != 0                                # [1, 128]
+        rot = jnp.where(take, rolled, rot)
+        shift <<= 1
+    return rot
+
+
+def _fused_call(kernel, rows: int, seed, round_, table, inject_bits,
+                interpret: bool, round_salt: int = 0):
+    """Shared pallas_call plumbing for the fused kernels: SMEM seed pair,
+    VMEM table aliased into the output, optional injected-bits operands."""
+    seeds = jnp.stack([jnp.asarray(seed, jnp.int32) * jnp.int32(_ROUND_MIX),
+                       jnp.asarray(round_, jnp.int32)
+                       ^ jnp.int32(round_salt)])
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM)]
+    operands = [seeds, table]
+    if inject_bits is not None:
+        sbits, rbits = inject_bits
+        in_specs += [pl.BlockSpec(memory_space=pltpu.VMEM),
+                     pl.BlockSpec(memory_space=pltpu.VMEM)]
+        operands += [jnp.asarray(sbits, jnp.uint32),
+                     jnp.asarray(rbits, jnp.uint32)]
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.uint32),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        input_output_aliases={1: 0},
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT_BYTES),
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(*operands)
+
+
 def _fused_round_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
                         n_valid_words: int, tail_mask: int, inject: bool):
     """One pull round, entirely in VMEM.  See module doc for the scheme.
@@ -121,20 +173,13 @@ def _fused_round_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
         pltpu.prng_seed(seed_ref[0], seed_ref[1])
     table = tin_ref[:]
 
-    # Stage 1: per-lane row shifts s_j ~ U[0, rows), binary-decomposed into
-    # conditional static rolls.  (Modulo bias rows/2^32 < 1e-6: documented.)
+    # Stage 1: one shared rotation per round (all bit planes and fanout
+    # draws reuse it; the MR kernel rotates per fanout draw instead).
     if inject:
         sbits = sbits_ref[:]
     else:
         sbits = pltpu.bitcast(pltpu.prng_random_bits((8, LANES)), jnp.uint32)
-    s = (sbits[0:1, :] % jnp.uint32(rows)).astype(jnp.int32)   # [1, 128]
-    rot = table
-    shift = 1
-    while shift < rows:
-        rolled = pltpu.roll(rot, shift, 0)
-        take = (s & shift) != 0                                # [1, 128]
-        rot = jnp.where(take, rolled, rot)
-        shift <<= 1
+    rot = _rotate_rows(table, sbits, rows)
 
     # Stages 2+3: per destination bit-plane k, draw (lane m, bit c) per
     # word, gather the partner word in-row, pull bit c into plane k.
@@ -178,31 +223,177 @@ def fused_pull_round(table: jax.Array, seed: jax.Array, round_: jax.Array,
     n_valid_words = -(-n // BITS)
     tail = n % BITS
     tail_mask = ((1 << tail) - 1) if tail else 0
-    inject = inject_bits is not None
     kernel = functools.partial(
         _fused_round_kernel, rows=rows, fanout=fanout,
-        n_valid_words=n_valid_words, tail_mask=tail_mask, inject=inject)
-    seeds = jnp.stack([jnp.asarray(seed, jnp.int32) * jnp.int32(_ROUND_MIX),
-                       jnp.asarray(round_, jnp.int32)])
-    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec(memory_space=pltpu.VMEM)]
-    operands = [seeds, table]
+        n_valid_words=n_valid_words, tail_mask=tail_mask,
+        inject=inject_bits is not None)
+    return _fused_call(kernel, rows, seed, round_, table, inject_bits,
+                       interpret)
+
+
+# ---------------------------------------------------------------------------
+# Multi-rumor variant: one VMEM element = one node's 32-rumor digest word.
+# ---------------------------------------------------------------------------
+#
+# The factored partner draw above works on ANY [rows, 128] uint32 table; for
+# up to 32 rumors the element at (row i, lane j) holds node ``i*128 + j``'s
+# rumor word (models/si_packed layout, one word per node).  A pull is then
+# ONE in-row gather of the partner's whole word OR-ed into the destination —
+# no bit-plane loop at all, because a real pull exchanges the full digest
+# (one partner per node per round, all rumors ride the same exchange,
+# exactly models/si.py's semantics).  At 10M nodes the table is 40 MB —
+# VMEM-resident on v5e.  Same distributional contract as the single-rumor
+# kernel: partner uniform over the padded node set, 128 shared per-lane row
+# shifts per (round, fanout) draw, self-pulls not excluded (1/N no-op).
+
+def mr_rows(n: int) -> int:
+    """Rows (multiple of 8) covering n nodes at one word per node."""
+    r = -(-n // LANES)
+    return max(8, -(-r // 8) * 8)
+
+
+def word_pack(seen: jax.Array) -> jax.Array:
+    """bool[N, R<=32] -> uint32[mr_rows(N), 128] one-word-per-node table."""
+    n, r = seen.shape
+    if r > BITS:
+        raise ValueError(f"multirumor fused kernel holds <= {BITS} rumors "
+                         f"per word; got {r}")
+    weights = (jnp.uint32(1) << jnp.arange(r, dtype=jnp.uint32))
+    words = jnp.sum(seen.astype(jnp.uint32) * weights[None, :], axis=1,
+                    dtype=jnp.uint32)
+    rows = mr_rows(n)
+    flat = jnp.zeros((rows * LANES,), jnp.uint32).at[:n].set(words)
+    return flat.reshape(rows, LANES)
+
+
+def word_unpack(table: jax.Array, n: int, rumors: int) -> jax.Array:
+    """uint32[rows, 128] -> bool[n, rumors]."""
+    flat = table.reshape(-1)[:n]
+    shifts = jnp.arange(rumors, dtype=jnp.uint32)
+    return ((flat[:, None] >> shifts[None, :]) & jnp.uint32(1)).astype(bool)
+
+
+def coverage_words(table: jax.Array, n: int, rumors: int) -> jax.Array:
+    """Min-over-rumors infected fraction (phantom words stay zero)."""
+    shifts = jnp.arange(rumors, dtype=jnp.uint32)
+    per_rumor = jnp.sum(
+        ((table.reshape(-1)[:, None] >> shifts[None, :]) & jnp.uint32(1)
+         ).astype(jnp.float32), axis=0)
+    return jnp.min(per_rumor) / jnp.float32(n)
+
+
+def _fused_mr_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
+                     n: int, inject: bool):
+    """One multi-rumor pull round, table fully VMEM-resident."""
     if inject:
-        sbits, rbits = inject_bits
-        in_specs += [pl.BlockSpec(memory_space=pltpu.VMEM),
-                     pl.BlockSpec(memory_space=pltpu.VMEM)]
-        operands += [jnp.asarray(sbits, jnp.uint32),
-                     jnp.asarray(rbits, jnp.uint32)]
-    return pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.uint32),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        input_output_aliases={1: 0},
-        compiler_params=None if interpret else pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
-        interpret=pltpu.InterpretParams() if interpret else False,
-    )(*operands)
+        sbits_ref, rbits_ref, tout_ref = rest
+    else:
+        (tout_ref,) = rest
+        pltpu.prng_seed(seed_ref[0], seed_ref[1])
+    table = tin_ref[:]
+
+    acc = table
+    for f in range(fanout):
+        # fresh per-lane row shifts per fanout draw (128 iid shifts)
+        if inject:
+            sbits = sbits_ref[f]
+        else:
+            sbits = pltpu.bitcast(pltpu.prng_random_bits((8, LANES)),
+                                  jnp.uint32)
+        rot = _rotate_rows(table, sbits, rows)
+        # per-element lane choice -> partner's whole rumor word
+        if inject:
+            rb = rbits_ref[f]
+        else:
+            rb = pltpu.bitcast(pltpu.prng_random_bits((rows, LANES)),
+                               jnp.uint32)
+        m = (rb & jnp.uint32(LANES - 1)).astype(jnp.int32)
+        acc = acc | jnp.take_along_axis(rot, m, axis=1)
+
+    # zero phantom words (node id >= n)
+    node_id = (jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0) * LANES
+               + jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1))
+    tout_ref[:] = jnp.where(node_id < n, acc, jnp.uint32(0))
+
+
+@functools.partial(jax.jit, static_argnames=("n", "fanout", "interpret"))
+def fused_multirumor_pull_round(table: jax.Array, seed: jax.Array,
+                                round_: jax.Array, n: int, fanout: int = 1,
+                                interpret: bool = False,
+                                inject_bits=None) -> jax.Array:
+    """One fused pull round on a one-word-per-node table.  Pure; jittable.
+
+    ``inject_bits`` (tests only): ``(sbits uint32[fanout, 8, 128], rbits
+    uint32[fanout, rows, 128])`` replacing the hardware PRNG so the kernel
+    math runs under the CPU interpreter."""
+    rows = table.shape[0]
+    kernel = functools.partial(_fused_mr_kernel, rows=rows, fanout=fanout,
+                               n=n, inject=inject_bits is not None)
+    # round_salt: distinct hw-PRNG stream from the single-rumor kernel
+    return _fused_call(kernel, rows, seed, round_, table, inject_bits,
+                       interpret, round_salt=0x5D0)
+
+
+def fused_table_bytes(n: int, rumors: int) -> int:
+    """Size of the fused kernel's VMEM table for this (n, rumors)."""
+    rows = n_rows(n) if rumors == 1 else mr_rows(n)
+    return rows * LANES * 4
+
+
+def check_fused_fits(n: int, rumors: int) -> int:
+    """Raise ValueError if the fused kernel's working set (TABLE_COPIES
+    live table-sized buffers) cannot fit the VMEM budget; return the
+    table size in bytes.  Callers get a friendly error instead of a
+    Mosaic VMEM-exhausted compile failure."""
+    tb = fused_table_bytes(n, rumors)
+    if TABLE_COPIES * tb > _VMEM_LIMIT_BYTES:
+        layout = ("node-packed bitmap" if rumors == 1
+                  else "one-word-per-node")
+        raise ValueError(
+            f"fused kernel working set (~{TABLE_COPIES} x "
+            f"{tb / (1 << 20):.0f} MiB {layout} table) exceeds the "
+            f"{_VMEM_LIMIT_BYTES >> 20} MiB VMEM budget at n={n}, "
+            f"rumors={rumors}; reduce n, use engine='auto' (HBM-resident "
+            "XLA kernels), or shard across devices")
+    return tb
+
+
+def init_multirumor_state(n: int, rumors: int, origin: int = 0):
+    """FusedState whose table is the one-word-per-node layout; rumor r
+    starts at node (origin + r) % n (models/state.init_state contract)."""
+    if rumors > BITS:
+        raise ValueError(f"multirumor fused kernel holds <= {BITS} rumors")
+    seen = jnp.zeros((n, rumors), jnp.bool_)
+    origins = (origin + jnp.arange(rumors)) % n
+    seen = seen.at[origins, jnp.arange(rumors)].set(True)
+    return FusedState(table=word_pack(seen), round=jnp.int32(0),
+                      msgs=jnp.float32(0.0))
+
+
+def compiled_until_fused_multirumor(n: int, rumors: int, seed: int,
+                                    fanout: int = 1,
+                                    target_coverage: float = 0.99,
+                                    max_rounds: int = 128, origin: int = 0,
+                                    interpret: bool = False):
+    """(loop, init): compiled while_loop to min-over-rumors target coverage
+    using the multi-rumor fused kernel (hw PRNG — distributionally equal to
+    but a different stream from the threefry path)."""
+    target = jnp.float32(target_coverage)
+
+    def step(st: FusedState) -> FusedState:
+        tab = fused_multirumor_pull_round(st.table, seed, st.round, n,
+                                          fanout, interpret)
+        return FusedState(table=tab, round=st.round + 1,
+                          msgs=st.msgs + 2.0 * fanout * n)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def loop(st: FusedState) -> FusedState:
+        def cond(s):
+            return ((coverage_words(s.table, n, rumors) < target)
+                    & (s.round < max_rounds))
+        return jax.lax.while_loop(cond, step, st)
+
+    return loop, init_multirumor_state(n, rumors, origin)
 
 
 class FusedState(NamedTuple):

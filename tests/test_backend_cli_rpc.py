@@ -173,6 +173,58 @@ def test_backend_rejections():
                        fault=FaultConfig(drop_prob=0.1))
 
 
+def test_engine_fused_routing_and_rejections():
+    import jax
+
+    with pytest.raises(ValueError, match="unknown engine"):
+        RunConfig(engine="warp")
+    fused = RunConfig(engine="fused", max_rounds=64)
+    # config errors surface identically on any backend (platform check last)
+    with pytest.raises(ValueError, match="pull rounds only"):
+        run_simulation("jax-tpu", ProtocolConfig(mode="push"),
+                       TopologyConfig(n=4096), fused)
+    with pytest.raises(ValueError, match="complete"):
+        run_simulation("jax-tpu", ProtocolConfig(mode="pull"),
+                       TopologyConfig(family="ring", n=4096, k=2), fused)
+    with pytest.raises(ValueError, match="single-device"):
+        run_simulation("jax-tpu", ProtocolConfig(mode="pull"),
+                       TopologyConfig(n=4096), fused,
+                       mesh_cfg=MeshConfig(n_devices=8))
+    from gossip_tpu.config import FaultConfig
+    with pytest.raises(ValueError, match="fault"):
+        run_simulation("jax-tpu", ProtocolConfig(mode="pull"),
+                       TopologyConfig(n=4096), fused,
+                       fault=FaultConfig(drop_prob=0.5))
+    with pytest.raises(ValueError, match="32 rumors"):
+        run_simulation("jax-tpu", ProtocolConfig(mode="pull", rumors=33),
+                       TopologyConfig(n=4096), fused)
+    with pytest.raises(ValueError, match="curve"):
+        run_simulation("jax-tpu", ProtocolConfig(mode="pull"),
+                       TopologyConfig(n=4096), fused, want_curve=True)
+    with pytest.raises(ValueError, match="VMEM budget"):
+        run_simulation("jax-tpu", ProtocolConfig(mode="pull", rumors=8),
+                       TopologyConfig(n=50_000_000), fused)
+    with pytest.raises(ValueError, match="event-driven"):
+        run_simulation("go-native", ProtocolConfig(mode="flood"),
+                       TopologyConfig(family="ring", n=64, k=2), fused)
+    # the RPC schema reaches the engine knob through the run object
+    args = request_to_args({"run": {"engine": "fused"}})
+    assert args["run"].engine == "fused"
+
+    if jax.default_backend() != "tpu":
+        with pytest.raises(ValueError, match="needs a TPU"):
+            run_simulation("jax-tpu", ProtocolConfig(mode="pull"),
+                           TopologyConfig(n=4096), fused)
+    else:
+        for rumors in (1, 8):
+            rep = run_simulation("jax-tpu",
+                                 ProtocolConfig(mode="pull", rumors=rumors),
+                                 TopologyConfig(n=1 << 16), fused)
+            assert rep.meta["engine"] == "fused-pallas"
+            assert rep.coverage >= 0.99 and rep.rounds > 0
+            assert rep.msgs == 2.0 * (1 << 16) * rep.rounds
+
+
 def test_request_to_args_strict():
     args = request_to_args({"backend": "jax-tpu",
                             "proto": {"mode": "push", "fanout": 2},
@@ -246,6 +298,10 @@ def test_cli_run_jax_and_error_paths():
              "--family", "ring", "--n", "64")
     assert p.returncode == 2
     assert "no Go equivalent" in p.stderr
+    p = _cli("run", "--mode", "pull", "--n", "256", "--engine", "fused",
+             "--ensemble", "4")
+    assert p.returncode == 2
+    assert "single-run only" in p.stderr
 
 
 def test_cli_sweep_smoke():

@@ -18,8 +18,11 @@ import numpy as np
 import pytest
 
 from gossip_tpu.ops.pallas_round import (
-    BITS, LANES, FusedState, compiled_until_fused, coverage_node_packed,
-    fused_pull_round, init_fused_state, n_rows, node_pack, node_unpack)
+    BITS, LANES, FusedState, compiled_until_fused,
+    compiled_until_fused_multirumor, coverage_node_packed, coverage_words,
+    fused_multirumor_pull_round, fused_pull_round, init_fused_state,
+    init_multirumor_state, mr_rows, n_rows, node_pack, node_unpack,
+    word_pack, word_unpack)
 
 ON_TPU = jax.default_backend() == "tpu"
 
@@ -121,6 +124,123 @@ def test_injected_uniform_bits_track_mean_field():
     c = inf.mean()
     want = 1 - (1 - c) ** 2
     assert abs(got - want) < 0.02, (got, want)
+
+
+# ---- multi-rumor (one-word-per-node) kernel -------------------------------
+
+def numpy_mr_round(table, sbits, rbits, n, fanout):
+    """Independent model of the multi-rumor kernel's sampling scheme."""
+    rows = table.shape[0]
+    acc = table.copy()
+    for f in range(fanout):
+        s = (sbits[f, 0, :].astype(np.uint64) % rows).astype(np.int64)
+        i = np.arange(rows)[:, None]
+        rot = table[(i - s[None, :]) % rows, np.arange(LANES)[None, :]]
+        m = rbits[f] & (LANES - 1)
+        acc = acc | np.take_along_axis(rot, m.astype(np.int64), axis=1)
+    flat = acc.reshape(-1)
+    flat[n:] = 0
+    return flat.reshape(rows, LANES)
+
+
+def _mr_bits(rng, rows, fanout):
+    sbits = rng.integers(0, 2**32, size=(fanout, 8, LANES), dtype=np.uint32)
+    rbits = rng.integers(0, 2**32, size=(fanout, rows, LANES),
+                         dtype=np.uint32)
+    return sbits, rbits
+
+
+@pytest.mark.parametrize("n,r,fanout", [(128 * 16, 8, 1),
+                                        (128 * 16 - 29, 32, 1),
+                                        (128 * 24, 3, 2)])
+def test_mr_kernel_math_matches_numpy_model(n, r, fanout):
+    rng = np.random.default_rng(5 + n + r)
+    rows = mr_rows(n)
+    seen = rng.random((n, r)) < 0.05
+    table = np.asarray(word_pack(jnp.asarray(seen)))
+    sbits, rbits = _mr_bits(rng, rows, fanout)
+    got = fused_multirumor_pull_round(jnp.asarray(table), 0, 0, n, fanout,
+                                      interpret=not ON_TPU,
+                                      inject_bits=(sbits, rbits))
+    want = numpy_mr_round(table, sbits, rbits, n, fanout)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_mr_pack_roundtrip_and_coverage():
+    rng = np.random.default_rng(3)
+    for n, r in ((200, 5), (128 * 16 + 1, 32), (5000, 1)):
+        seen = rng.random((n, r)) < 0.3
+        tab = word_pack(jnp.asarray(seen))
+        np.testing.assert_array_equal(np.asarray(word_unpack(tab, n, r)),
+                                      seen)
+        cov = float(coverage_words(tab, n, r))
+        assert abs(cov - seen.mean(axis=0).min()) < 1e-6
+    with pytest.raises(ValueError, match="rumors"):
+        word_pack(jnp.zeros((64, 33), bool))
+
+
+def test_mr_all_rumors_share_one_partner_per_draw():
+    """A pull moves the partner's WHOLE word: wherever rumor 0 was newly
+    received, every rumor the partner held must arrive with it."""
+    n, r = 128 * 16, 7
+    rng = np.random.default_rng(9)
+    rows = mr_rows(n)
+    # partner candidates hold either ALL rumors or none
+    holders = rng.random(n) < 0.1
+    seen = np.repeat(holders[:, None], r, axis=1)
+    table = word_pack(jnp.asarray(seen))
+    sbits, rbits = _mr_bits(rng, rows, 1)
+    out = np.asarray(fused_multirumor_pull_round(
+        table, 0, 0, n, 1, interpret=not ON_TPU,
+        inject_bits=(sbits, rbits)))
+    got = np.asarray(word_unpack(jnp.asarray(out), n, r))
+    # every node's row is all-True or all-False: digests moved atomically
+    assert (got.all(axis=1) | (~got.any(axis=1))).all()
+
+
+def test_mr_injected_bits_track_mean_field():
+    n, r = 128 * 64, 8
+    rows = mr_rows(n)
+    rng = np.random.default_rng(11)
+    seen = rng.random((n, r)) < 0.2
+    table = word_pack(jnp.asarray(seen))
+    sbits, rbits = _mr_bits(rng, rows, 1)
+    out = fused_multirumor_pull_round(table, 0, 0, n, 1,
+                                      interpret=not ON_TPU,
+                                      inject_bits=(sbits, rbits))
+    got = float(coverage_words(out, n, r))
+    c = 0.2
+    want = 1 - (1 - c) ** 2
+    assert abs(got - want) < 0.03, (got, want)
+
+
+@pytest.mark.skipif(not ON_TPU, reason="hw PRNG path needs a real TPU "
+                    "(interpreter stubs prng_random_bits with zeros)")
+class TestHardwarePRNGMultirumor:
+    def test_deterministic_and_stream_distinct(self):
+        n, r = 128 * 64, 8
+        st = init_multirumor_state(n, r)
+        a = fused_multirumor_pull_round(st.table, 3, 5, n)
+        b = fused_multirumor_pull_round(init_multirumor_state(n, r).table,
+                                        3, 5, n)
+        assert jnp.array_equal(a, b)
+        c = fused_multirumor_pull_round(init_multirumor_state(n, r).table,
+                                        3, 6, n)
+        assert not jnp.array_equal(a, c)
+
+    def test_mr_curve_matches_mean_field(self):
+        n, r = 1 << 18, 8
+        loop, init = compiled_until_fused_multirumor(n, r, seed=0,
+                                                     max_rounds=64)
+        final = loop(init)
+        got = int(final.round)
+        c, want = 1.0 / n, 0
+        while c < 0.99:
+            c = 1 - (1 - c) ** 2
+            want += 1
+        # min-over-rumors lags single-rumor coverage by a round or two
+        assert want - 1 <= got <= want + 4, (got, want)
+        assert float(coverage_words(final.table, n, r)) >= 0.99
 
 
 @pytest.mark.skipif(not ON_TPU, reason="hw PRNG path needs a real TPU "
