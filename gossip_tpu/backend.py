@@ -131,13 +131,17 @@ def _curve_summary(covs, msgs, target):
 def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
                fault: Optional[FaultConfig], n_dev: int,
                want_curve: bool) -> RunReport:
-    """engine='fused': the Pallas VMEM pull kernel as a product surface.
+    """engine='fused': the Pallas VMEM pull kernels as a product surface.
 
-    Validates eagerly and loudly — the fused kernel covers exactly the
-    flagship envelope (TPU, pull, implicit complete graph, single device,
-    fault-free, <= 32 rumors) and silently substituting a different engine
-    would mislabel the wall-clock numbers, same policy as the exchange
-    routing above.
+    Single device: the node-packed (rumors=1) or one-word-per-node
+    (rumors<=32) kernel.  Multi-device: rumor-plane sharding
+    (parallel/sharded_fused.py) — planes of 32 rumors across the mesh,
+    identical partner stream per device, zero per-round ICI.
+
+    Validates eagerly and loudly — the fused kernels cover exactly the
+    flagship envelope (TPU, pull, implicit complete graph, fault-free)
+    and silently substituting a different engine would mislabel the
+    wall-clock numbers, same policy as the exchange routing above.
     """
     import jax as _jax
     import jax.numpy as jnp
@@ -153,21 +157,21 @@ def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
     if tc.family != "complete":
         raise ValueError("engine='fused' runs on the implicit complete "
                          f"topology only (got family {tc.family!r})")
-    if n_dev != 1:
-        raise ValueError("engine='fused' is the single-device VMEM kernel; "
-                         "use engine='auto' (with --exchange "
-                         "dense/sparse/halo) for sharded runs")
     if fault is not None and (fault.node_death_rate or fault.drop_prob
                               or fault.dead_nodes):
         raise ValueError("engine='fused' has no fault-mask path; "
                          "use engine='auto' for fault injection")
-    if proto.rumors > BITS:
+    if n_dev == 1 and proto.rumors > BITS:
         raise ValueError(f"engine='fused' packs <= {BITS} rumors per word "
-                         f"(got rumors={proto.rumors})")
+                         f"on one device (got rumors={proto.rumors}); "
+                         "shard rumor planes with --devices")
     if want_curve:
         raise ValueError("engine='fused' runs a compiled while_loop with no "
                          "per-round curve capture; use engine='auto'")
-    table_bytes = check_fused_fits(tc.n, proto.rumors)
+    # multi-device shards rumor PLANES, so the per-device table is always
+    # the one-word-per-node layout regardless of total rumor count
+    table_bytes = check_fused_fits(tc.n,
+                                   proto.rumors if n_dev == 1 else BITS)
     # platform last: config errors above surface identically on any backend
     if _jax.default_backend() != "tpu":
         raise ValueError(
@@ -176,6 +180,28 @@ def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
             "engine='auto' for the XLA bit-packed path")
 
     n = tc.n
+    if n_dev > 1:
+        from gossip_tpu.parallel.sharded_fused import (
+            make_plane_mesh, plane_count, simulate_until_sharded_fused)
+        mesh = make_plane_mesh(n_dev)
+        w = plane_count(proto.rumors, n_dev)
+        t0 = time.perf_counter()
+        rounds, cov, msgs, final = simulate_until_sharded_fused(
+            n, proto.rumors, run, mesh, fanout=proto.fanout)
+        _jax.block_until_ready(final)
+        wall = time.perf_counter() - t0
+        hit = cov >= float(jnp.float32(run.target_coverage))
+        return RunReport(
+            backend="jax-tpu", mode=proto.mode, n=n,
+            rounds=rounds if hit else -1, coverage=cov, msgs=msgs,
+            wall_s=round(wall, 4),
+            meta={"clock": "rounds", "devices": n_dev,
+                  "msgs_counts": "transmissions",
+                  "engine": "fused-pallas-planes",
+                  "layout": f"{w} rumor planes x one 32-rumor word per node",
+                  "vmem_table_bytes_per_plane": table_bytes,
+                  "ici_bytes_per_round": 0.0})
+
     if proto.rumors == 1:
         loop, init = compiled_until_fused(
             n, seed=run.seed, fanout=proto.fanout,
@@ -231,6 +257,12 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
                 "SWIM shards via the dense pmax kernel")
 
     if run.engine == "fused":
+        if _exchange != "dense":
+            raise ValueError(
+                f"exchange={_exchange!r} requests a cross-shard digest "
+                "pattern; engine='fused' shards rumor planes with zero "
+                "per-round ICI and implements no exchange — use "
+                "engine='auto' for sparse/halo runs")
         return _run_fused(proto, tc, run, fault, n_dev, want_curve)
 
     if proto.mode == "swim":

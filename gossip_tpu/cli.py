@@ -65,8 +65,9 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--engine", default="auto", choices=("auto", "fused"),
                    help="round kernel: auto = XLA (bit-packed fast path "
                         "where eligible); fused = the Pallas VMEM kernel "
-                        "(TPU, pull, complete graph, single device, "
-                        "<= 32 rumors)")
+                        "(TPU, pull, complete graph; <= 32 rumors on one "
+                        "device, rumor planes sharded zero-ICI with "
+                        "--devices beyond that)")
     p.add_argument("--curve", action="store_true",
                    help="include the per-round coverage curve")
     p.add_argument("--save-curve", default=None, metavar="PATH",

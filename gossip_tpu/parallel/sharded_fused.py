@@ -1,0 +1,160 @@
+"""Rumor-plane sharding for the fused Pallas pull kernel: scale RUMORS,
+not traffic.
+
+The sharded SI kernels scale the NODE dimension and pay ICI for it every
+round (all_gather / all_to_all of digest state — parallel/sharded.py,
+sharded_sparse.py).  For massive multi-rumor broadcast the TPU-native
+layout is the transpose: shard the RUMOR dimension.  SI pull semantics
+(models/si.py, after the reference's whole-log exchange, main.go:126) give
+every node ONE partner per round, and the partner's *entire* digest rides
+that exchange — rumors never influence partner choice.  So the state
+``uint32[W, rows, 128]`` (W word-planes of the one-word-per-node layout,
+plane p holding rumors 32p..32p+31) can shard plane-wise across the mesh:
+every device runs the SAME fused VMEM kernel (ops/pallas_round.py) on its
+local planes, seeded identically, so the hardware PRNG reproduces the SAME
+partner draw on every device — one global partner per node per round,
+whole digest exchanged, and the merge needs **zero ICI traffic**.  The
+only cross-device communication in the whole simulation is the scalar
+coverage reduction in the loop condition.
+
+This is the engine for the 10M-node multi-rumor flagship: 32 rumors per
+chip-plane, R = 32*W rumors total, each plane a 40 MB VMEM-resident table
+at N=10M.  Node-dim sharding of the same workload would all_gather
+O(N*W) words per round; here the per-round ICI cost is a float.
+
+Rumor padding: planes are always full 32-bit words; rumor columns beyond
+``rumors`` (and whole planes beyond ``ceil(rumors/32)``, when W is padded
+up to the mesh size) are initialized ALL-ONES for real nodes, so their
+per-rumor coverage is 1.0 from round 0 and the min-over-rumors metric is
+untouched.  Phantom *nodes* stay zero (kernel contract).
+
+Testing: the kernel's inject path (tests-only explicit bit operands)
+makes the sharded round bitwise-checkable on the 8-device CPU mesh —
+every plane must equal the single-device multi-rumor kernel run with the
+same bits (tests/test_sharded_fused.py).  The hw-PRNG path additionally
+requires every device to draw the same stream, which holds by
+construction (same seed scalars, same kernel) on a real pod.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gossip_tpu.config import RunConfig
+from gossip_tpu.ops.pallas_round import (
+    BITS, coverage_words, fused_multirumor_pull_round, word_pack)
+
+AXIS = "planes"
+
+
+def make_plane_mesh(n_devices: int) -> Mesh:
+    """1-D mesh over the rumor-plane axis."""
+    devs = jax.devices()[:n_devices]
+    if len(devs) < n_devices:
+        raise ValueError(f"need {n_devices} devices, have {len(devs)}")
+    return Mesh(devs, (AXIS,))
+
+
+def plane_count(rumors: int, n_devices: int) -> int:
+    """Planes covering ``rumors``, padded up to a multiple of the mesh."""
+    w = -(-rumors // BITS)
+    return -(-w // n_devices) * n_devices
+
+
+def init_plane_state(n: int, rumors: int, mesh: Mesh,
+                     origin: int = 0) -> jax.Array:
+    """uint32[W, rows, 128] plane-sharded state; rumor r starts at node
+    (origin + r) % n (models/state.init_state contract); padding rumor
+    columns/planes are all-ones (coverage 1.0, inert under OR-merge)."""
+    if not 0 <= origin < n:
+        raise ValueError(f"origin {origin} out of range for n={n}")
+    w_total = plane_count(rumors, mesh.shape[AXIS])
+    planes = []
+    for p in range(w_total):
+        lo = p * BITS
+        real = max(0, min(rumors - lo, BITS))
+        seen = jnp.concatenate(
+            [jnp.zeros((n, real), jnp.bool_),
+             jnp.ones((n, BITS - real), jnp.bool_)], axis=1)
+        if real:
+            origins = (origin + lo + jnp.arange(real)) % n
+            seen = seen.at[origins, jnp.arange(real)].set(True)
+        planes.append(word_pack(seen))
+    stacked = jnp.stack(planes)
+    return jax.device_put(stacked, NamedSharding(mesh, P(AXIS, None, None)))
+
+
+def coverage_planes(planes: jax.Array, n: int) -> jax.Array:
+    """Min-over-rumors infected fraction across every plane and bit.
+    Padding rumors are all-ones (coverage 1.0) so they never win the min."""
+    per_plane = jax.vmap(lambda t: coverage_words(t, n, BITS))(planes)
+    return jnp.min(per_plane)
+
+
+def make_sharded_fused_round(n: int, mesh: Mesh, fanout: int = 1,
+                             interpret: bool = False, inject_bits=None):
+    """shard_map'd round: each device advances its local planes with the
+    identically-seeded fused kernel — same partner draw on every device,
+    zero ICI.  ``inject_bits`` (tests) is one (sbits, rbits) pair reused
+    for every plane, which IS the semantic: one shared partner stream."""
+    n_dev = mesh.shape[AXIS]
+
+    def local_round(planes_l, seed, round_):
+        w_local = planes_l.shape[0]
+        outs = [fused_multirumor_pull_round(
+                    planes_l[i], seed, round_, n, fanout, interpret,
+                    inject_bits=inject_bits)
+                for i in range(w_local)]
+        return jnp.stack(outs)
+
+    # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
+    # annotation, which the default shard_map VMA check rejects
+    mapped = jax.shard_map(
+        local_round, mesh=mesh,
+        in_specs=(P(AXIS, None, None), P(), P()),
+        out_specs=P(AXIS, None, None), check_vma=False)
+
+    def round_fn(planes, seed, round_):
+        if planes.shape[0] % n_dev:
+            raise ValueError(f"{planes.shape[0]} planes do not divide "
+                             f"over {n_dev} devices")
+        return mapped(planes, jnp.asarray(seed, jnp.int32),
+                      jnp.asarray(round_, jnp.int32))
+
+    return round_fn
+
+
+def simulate_until_sharded_fused(n: int, rumors: int, run: RunConfig,
+                                 mesh: Mesh, fanout: int = 1,
+                                 interpret: bool = False):
+    """(rounds, coverage, msgs, final_planes): compiled while_loop to
+    min-over-rumors target coverage on the plane-sharded state.
+
+    msgs counts transmissions (request + whole-digest response per
+    partner draw, all W words riding one exchange): 2*fanout*n/round."""
+    step = make_sharded_fused_round(n, mesh, fanout, interpret)
+    init = init_plane_state(n, rumors, mesh, run.origin)
+    target = jnp.float32(run.target_coverage)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def loop(planes):
+        def cond(c):
+            planes_c, round_c = c
+            return ((coverage_planes(planes_c, n) < target)
+                    & (round_c < run.max_rounds))
+
+        def body(c):
+            planes_c, round_c = c
+            return step(planes_c, run.seed, round_c), round_c + 1
+
+        return jax.lax.while_loop(cond, body, (planes, jnp.int32(0)))
+
+    final, rounds = loop(init)
+    rounds = int(rounds)
+    cov = float(coverage_planes(final, n))
+    msgs = 2.0 * fanout * n * rounds
+    return rounds, cov, msgs, final

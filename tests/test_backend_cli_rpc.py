@@ -186,16 +186,13 @@ def test_engine_fused_routing_and_rejections():
     with pytest.raises(ValueError, match="complete"):
         run_simulation("jax-tpu", ProtocolConfig(mode="pull"),
                        TopologyConfig(family="ring", n=4096, k=2), fused)
-    with pytest.raises(ValueError, match="single-device"):
-        run_simulation("jax-tpu", ProtocolConfig(mode="pull"),
-                       TopologyConfig(n=4096), fused,
-                       mesh_cfg=MeshConfig(n_devices=8))
     from gossip_tpu.config import FaultConfig
     with pytest.raises(ValueError, match="fault"):
         run_simulation("jax-tpu", ProtocolConfig(mode="pull"),
                        TopologyConfig(n=4096), fused,
                        fault=FaultConfig(drop_prob=0.5))
-    with pytest.raises(ValueError, match="32 rumors"):
+    # >32 rumors needs the plane-sharded multi-device path
+    with pytest.raises(ValueError, match="shard rumor planes"):
         run_simulation("jax-tpu", ProtocolConfig(mode="pull", rumors=33),
                        TopologyConfig(n=4096), fused)
     with pytest.raises(ValueError, match="curve"):
@@ -215,6 +212,11 @@ def test_engine_fused_routing_and_rejections():
         with pytest.raises(ValueError, match="needs a TPU"):
             run_simulation("jax-tpu", ProtocolConfig(mode="pull"),
                            TopologyConfig(n=4096), fused)
+        # multi-device (rumor-plane sharded) path gates on TPU the same way
+        with pytest.raises(ValueError, match="needs a TPU"):
+            run_simulation("jax-tpu", ProtocolConfig(mode="pull", rumors=256),
+                           TopologyConfig(n=4096), fused,
+                           mesh_cfg=MeshConfig(n_devices=8))
     else:
         for rumors in (1, 8):
             rep = run_simulation("jax-tpu",
@@ -223,6 +225,12 @@ def test_engine_fused_routing_and_rejections():
             assert rep.meta["engine"] == "fused-pallas"
             assert rep.coverage >= 0.99 and rep.rounds > 0
             assert rep.msgs == 2.0 * (1 << 16) * rep.rounds
+
+    # a requested sparse/halo exchange is never silently dropped
+    with pytest.raises(ValueError, match="no exchange"):
+        run_simulation("jax-tpu", ProtocolConfig(mode="pull", rumors=256),
+                       TopologyConfig(n=4096), fused,
+                       mesh_cfg=MeshConfig(n_devices=8, exchange="sparse"))
 
 
 def test_request_to_args_strict():
