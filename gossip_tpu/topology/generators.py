@@ -19,9 +19,11 @@ their seed.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from gossip_tpu import config as cfg_mod
@@ -53,6 +55,18 @@ class Topology:
         return 0 if self.nbrs is None else int(self.nbrs.shape[1])
 
 
+@functools.partial(jax.jit, static_argnums=(3, 4), donate_argnums=())
+def _scatter_table(src: jax.Array, dst: jax.Array, col: jax.Array,
+                   n: int, d_max: int) -> jax.Array:
+    """Build the padded table ON DEVICE from the edge list: one scatter of E
+    elements into a sentinel-filled [n, d_max] table.  Host->device traffic
+    is O(E) (the edges), never O(n * d_max) (the padding): at 1M-node
+    power-law with cap 256 that is ~70 MB of edges instead of a 1 GB padded
+    table — measured 45-100 s of pack+transfer before, ~3 s after."""
+    nbrs = jnp.full((n, d_max), jnp.int32(n), dtype=jnp.int32)
+    return nbrs.at[src, col].set(dst, unique_indices=True)
+
+
 def _pack(n: int, src: np.ndarray, dst: np.ndarray,
           degree_cap: Optional[int], family: str,
           rng: np.random.Generator) -> Topology:
@@ -62,27 +76,27 @@ def _pack(n: int, src: np.ndarray, dst: np.ndarray,
     src, dst = src[order], dst[order]
     deg = np.bincount(src, minlength=n).astype(np.int32)
     d_max = int(deg.max()) if len(src) else 0
-    if degree_cap is not None and d_max > degree_cap:
-        # Per-node random subsample of neighbors down to the cap: keeps the
-        # table narrow under heavy-tailed degree distributions (power-law).
-        keep = np.ones(len(src), dtype=bool)
-        starts = np.concatenate([[0], np.cumsum(deg)])
-        for i in np.nonzero(deg > degree_cap)[0]:
-            lo, hi = starts[i], starts[i + 1]
-            drop = rng.choice(hi - lo, size=(hi - lo) - degree_cap, replace=False)
-            keep[lo + drop] = False
-        src, dst = src[keep], dst[keep]
-        deg = np.bincount(src, minlength=n).astype(np.int32)
-        d_max = degree_cap
-    d_max = max(d_max, 1)
-    nbrs = np.full((n, d_max), n, dtype=np.int32)  # sentinel = n
-    # Column index of each edge within its source row.
     starts = np.concatenate([[0], np.cumsum(deg)])[:-1]
     col = np.arange(len(src)) - np.repeat(starts, deg)
-    nbrs[src, col] = dst
-    import jax.numpy as jnp
-    return Topology(nbrs=jnp.asarray(nbrs), deg=jnp.asarray(deg), n=n,
-                    family=family)
+    if degree_cap is not None and d_max > degree_cap:
+        # Per-node random subsample of neighbors down to the cap, fully
+        # vectorized: within over-cap rows, rank edges by an iid uniform
+        # priority (a random within-row permutation) and keep the first
+        # `cap`; under-cap rows keep their original column order exactly.
+        over = deg > degree_cap
+        pri = np.where(over[src], rng.random(len(src)), col.astype(np.float64))
+        order2 = np.lexsort((pri, src))
+        src, dst = src[order2], dst[order2]
+        rank = np.arange(len(src)) - np.repeat(starts, deg)
+        keep = rank < degree_cap
+        src, dst, col = src[keep], dst[keep], rank[keep]
+        deg = np.minimum(deg, degree_cap)
+        d_max = degree_cap
+    d_max = max(d_max, 1)
+    nbrs = _scatter_table(jnp.asarray(src, jnp.int32),
+                          jnp.asarray(dst, jnp.int32),
+                          jnp.asarray(col, jnp.int32), n, d_max)
+    return Topology(nbrs=nbrs, deg=jnp.asarray(deg), n=n, family=family)
 
 
 def complete(n: int) -> Topology:
