@@ -147,37 +147,16 @@ def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
     import jax.numpy as jnp
 
     from gossip_tpu.ops.pallas_round import (
-        BITS, check_fused_fits, compiled_until_fused,
-        compiled_until_fused_multirumor, coverage_node_packed,
-        coverage_words)
+        BITS, compiled_until_fused, compiled_until_fused_multirumor,
+        coverage_node_packed, coverage_words, fused_table_bytes)
 
-    if proto.mode != "pull":
-        raise ValueError(f"engine='fused' implements pull rounds only "
-                         f"(got mode {proto.mode!r})")
-    if tc.family != "complete":
-        raise ValueError("engine='fused' runs on the implicit complete "
-                         f"topology only (got family {tc.family!r})")
-    if fault is not None and (fault.node_death_rate or fault.drop_prob
-                              or fault.dead_nodes):
-        raise ValueError("engine='fused' has no fault-mask path; "
-                         "use engine='auto' for fault injection")
-    if n_dev == 1 and proto.rumors > BITS:
-        raise ValueError(f"engine='fused' packs <= {BITS} rumors per word "
-                         f"on one device (got rumors={proto.rumors}); "
-                         "shard rumor planes with --devices")
-    if want_curve:
-        raise ValueError("engine='fused' runs a compiled while_loop with no "
-                         "per-round curve capture; use engine='auto'")
+    reason = _fused_ineligible_reason(proto, tc, fault, n_dev, want_curve)
+    if reason is not None:
+        raise ValueError(reason)
     # multi-device shards rumor PLANES, so the per-device table is always
     # the one-word-per-node layout regardless of total rumor count
-    table_bytes = check_fused_fits(tc.n,
-                                   proto.rumors if n_dev == 1 else BITS)
-    # platform last: config errors above surface identically on any backend
-    if _jax.default_backend() != "tpu":
-        raise ValueError(
-            "engine='fused' needs a TPU (the kernel samples partners with "
-            "the TPU hardware PRNG, which has no CPU equivalent); use "
-            "engine='auto' for the XLA bit-packed path")
+    table_bytes = fused_table_bytes(tc.n,
+                                    proto.rumors if n_dev == 1 else BITS)
 
     n = tc.n
     if n_dev > 1:
@@ -234,6 +213,54 @@ def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
               "vmem_table_bytes": table_bytes})
 
 
+def _fused_ineligible_reason(proto: ProtocolConfig, tc: TopologyConfig,
+                             fault: Optional[FaultConfig], n_dev: int,
+                             want_curve: bool) -> Optional[str]:
+    """Why this run cannot use the fused Pallas engine, or None if it can.
+
+    The ONE list of preconditions: engine='fused' raises it verbatim,
+    engine='auto' checks it quietly — so the two can never drift apart.
+    Config reasons come before the platform probe so forced-fused config
+    errors surface identically on any backend."""
+    from gossip_tpu.ops.pallas_round import BITS, check_fused_fits
+    import jax as _jax
+    if proto.mode != "pull":
+        return (f"engine='fused' implements pull rounds only "
+                f"(got mode {proto.mode!r})")
+    if tc.family != "complete":
+        return ("engine='fused' runs on the implicit complete "
+                f"topology only (got family {tc.family!r})")
+    if fault is not None and (fault.node_death_rate or fault.drop_prob
+                              or fault.dead_nodes):
+        return ("engine='fused' has no fault-mask path; "
+                "use engine='auto' for fault injection")
+    if n_dev == 1 and proto.rumors > BITS:
+        return (f"engine='fused' packs <= {BITS} rumors per word "
+                f"on one device (got rumors={proto.rumors}); "
+                "shard rumor planes with --devices")
+    if want_curve:
+        return ("engine='fused' runs a compiled while_loop with no "
+                "per-round curve capture; use engine='auto'")
+    try:
+        check_fused_fits(tc.n, proto.rumors if n_dev == 1 else BITS,
+                         proto.fanout)
+    except ValueError as e:
+        return str(e)
+    if _jax.default_backend() != "tpu":
+        return ("engine='fused' needs a TPU (the kernel samples partners "
+                "with the TPU hardware PRNG, which has no CPU "
+                "equivalent); use engine='auto' for the XLA bit-packed "
+                "path")
+    return None
+
+
+def _fused_auto_ok(proto: ProtocolConfig, tc: TopologyConfig,
+                   fault: Optional[FaultConfig], want_curve: bool) -> bool:
+    """True when a single-device run is eligible for the fused Pallas
+    engine and it is safe to pick it silently under engine='auto'."""
+    return _fused_ineligible_reason(proto, tc, fault, 1, want_curve) is None
+
+
 def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
             fault: Optional[FaultConfig] = None,
             mesh_cfg: Optional[MeshConfig] = None,
@@ -264,6 +291,16 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
                 "per-round ICI and implements no exchange — use "
                 "engine='auto' for sparse/halo runs")
         return _run_fused(proto, tc, run, fault, n_dev, want_curve)
+
+    # engine='auto' picks the fused Pallas kernel when a single-device run
+    # is eligible — it is strictly faster than the XLA paths there.
+    # Multi-device auto keeps the node-dim sharded engines (fused shards
+    # rumor PLANES, a different scaling story the user opts into).
+    if (run.engine == "auto" and n_dev == 1
+            and _fused_auto_ok(proto, tc, fault, want_curve)):
+        rep = _run_fused(proto, tc, run, fault, 1, want_curve)
+        rep.meta["engine_auto"] = "fused"
+        return rep
 
     if proto.mode == "swim":
         from gossip_tpu.models.swim import (resolve_epoch_rounds,

@@ -62,12 +62,16 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                         "sparse all_to_all (complete topology, "
                         "pull/antientropy, O(messages)), halo ppermute "
                         "(band-limited topologies, O(band))")
-    p.add_argument("--engine", default="auto", choices=("auto", "fused"),
-                   help="round kernel: auto = XLA (bit-packed fast path "
-                        "where eligible); fused = the Pallas VMEM kernel "
-                        "(TPU, pull, complete graph; <= 32 rumors on one "
-                        "device, rumor planes sharded zero-ICI with "
-                        "--devices beyond that)")
+    p.add_argument("--engine", default="auto",
+                   choices=("auto", "fused", "xla"),
+                   help="round kernel: auto = best eligible (fused Pallas "
+                        "on TPU for single-device fault-free pull on the "
+                        "complete graph, bit-packed XLA otherwise); fused "
+                        "= force the Pallas kernel (TPU, pull, complete "
+                        "graph; <= 32 rumors on one device, rumor planes "
+                        "sharded zero-ICI with --devices beyond that); "
+                        "xla = force the XLA kernels (the threefry stream "
+                        "that matches the sharded paths bitwise)")
     p.add_argument("--curve", action="store_true",
                    help="include the per-round coverage curve")
     p.add_argument("--save-curve", default=None, metavar="PATH",
@@ -127,7 +131,7 @@ def cmd_run(a) -> int:
             print("error: --ensemble needs the jax-tpu backend and a "
                   "non-swim mode", file=sys.stderr)
             return 2
-        if run.engine != "auto":
+        if run.engine == "fused":
             # never silently substitute the XLA kernels for a requested
             # engine (same policy as backend._run_fused)
             print("error: --ensemble runs the threefry XLA kernels; "
@@ -198,8 +202,12 @@ def baseline_configs(scale: float, devices: int):
              tc=TopologyConfig(family="power_law", n=n4, k=3,
                                degree_cap=256),
              run=RunConfig(max_rounds=80)),
+        # BASELINE.json configs[4]: "10M-node multi-rumor broadcast,
+        # node-dim sharded".  Mode pull: on a multi-chip mesh the node
+        # dimension shards across devices; on one chip engine='auto'
+        # routes to the fused Pallas multi-rumor kernel.
         dict(name="multirumor-10m-sharded", backend="jax-tpu",
-             proto=ProtocolConfig(mode="pushpull", fanout=1, rumors=8),
+             proto=ProtocolConfig(mode="pull", fanout=1, rumors=8),
              tc=TopologyConfig(family="complete", n=n5),
              run=RunConfig(max_rounds=64),
              mesh=MeshConfig(n_devices=devices)),
@@ -253,16 +261,11 @@ def cmd_grid(a) -> int:
     # periods multiply only anti-entropy points; dedupe the rest
     points = list(dict.fromkeys(points))
     if a.pod_mesh:
-        import jax
-        import numpy as np
-        from jax.sharding import Mesh
+        # DCN-aware: configs (communication-free) ride the outer/slice
+        # axis, node shards (O(N) collectives) stay intra-slice on ICI.
+        from gossip_tpu.parallel.multislice import make_hybrid_mesh
         s, nd = a.pod_mesh
-        have = len(jax.devices())
-        if have < s * nd:
-            raise ValueError(f"--pod-mesh {s} {nd} needs {s * nd} devices; "
-                             f"only {have} available")
-        mesh2d = Mesh(np.asarray(jax.devices()[:s * nd]).reshape(s, nd),
-                      ("sweep", "nodes"))
+        mesh2d = make_hybrid_mesh(s, nd, axis_names=("sweep", "nodes"))
         res = config_sweep_curves_2d(points, G.build(tc), run, mesh2d,
                                      fault=fault, rumors=a.rumors)
     elif a.devices > 1:
@@ -359,6 +362,11 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     a = ap.parse_args(argv)
     try:
+        if a.cmd in ("run", "sweep", "grid", "serve"):
+            # multi-host pods: one jax.distributed.initialize() per host
+            # before any jax API (no-op without the coordinator env vars)
+            from gossip_tpu.parallel.multislice import maybe_init_distributed
+            maybe_init_distributed()
         return a.fn(a)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)

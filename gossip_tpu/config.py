@@ -137,25 +137,36 @@ class FaultConfig:
             raise ValueError("fail_round must be >= 0")
 
 
-ENGINES = ("auto", "fused")
+ENGINES = ("auto", "fused", "xla")
 
 
 @dataclasses.dataclass(frozen=True)
 class RunConfig:
     """Simulation driver parameters.
 
-    ``engine`` selects the single-device round implementation:
+    ``engine`` selects the round implementation:
 
-    * ``auto``  — XLA kernels; pull/anti-entropy route through the
-      bit-packed fast path (models/si_packed.py), everything else through
-      the bool kernels (models/si.py).  Works on any backend, any mode.
-    * ``fused`` — the fully-fused Pallas VMEM kernel
-      (ops/pallas_round.py): hardware-PRNG partner sampling + in-row
-      gather + OR-merge in one ``pallas_call``, zero HBM gather.  TPU
-      only (the hardware PRNG has no CPU equivalent); pull mode on the
-      implicit complete topology, single device, fault-free, <= 32
-      rumors.  This is the bench.py flagship path surfaced as a product
-      engine.
+    * ``auto``  — the best eligible engine.  On a TPU, single-device,
+      fault-free pull runs on the implicit complete topology (no curve
+      capture, <= 32 rumors) route to the fused Pallas kernel
+      automatically (meta records ``engine_auto``); other pull /
+      anti-entropy runs take the bit-packed XLA fast path
+      (models/si_packed.py); everything else the bool kernels
+      (models/si.py).  Works on any backend, any mode.
+    * ``xla``   — force the XLA kernels even where the fused engine is
+      eligible (pull/anti-entropy bit-packed, bool otherwise) — the
+      opt-out for cross-validating against the sharded paths, whose
+      threefry partner streams match the single-device XLA engine
+      bitwise but not the fused kernel's hardware-PRNG stream.
+    * ``fused`` — force the fused Pallas kernels (ops/pallas_round.py):
+      hardware-PRNG partner sampling + in-row gather + OR-merge in one
+      ``pallas_call`` (tables past the VMEM envelope use the staged
+      big-table path).  TPU only (the hardware PRNG has no CPU
+      equivalent); pull mode on the implicit complete topology,
+      fault-free.  Single device: <= 32 rumors packed in one word per
+      node.  Multi-device: rumor planes of 32 sharded across the mesh
+      (parallel/sharded_fused.py), zero per-round ICI.  Ineligible
+      configs raise rather than silently substituting another engine.
     """
 
     target_coverage: float = 0.99

@@ -316,6 +316,136 @@ def _fused_mr_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
     tout_ref[:] = jnp.where(node_id < n, acc, jnp.uint32(0))
 
 
+# --- Big-table multi-rumor path: XLA rotation + grid-blocked gather -----
+#
+# The value kernel holds ~4 table-sized VMEM windows; at N=10M (38.15 MiB
+# one-word-per-node table) that is an XLA-measured 152.7 MiB — OOM against
+# the 128 MiB chip.  Attempts to squeeze the whole round into one
+# whole-table kernel bottom out around 132-134 MiB (3 windows + register
+# spill slots), so the big path splits the round on its natural seam
+# instead:
+#
+#   * Stage 1 (XLA): the per-lane row rotation ``rot[i, j] =
+#     table[(i - s_j) mod rows, j]`` as ceil(log2 rows) static
+#     ``jnp.roll`` + lane-select stages.  Pure blocked data movement —
+#     XLA streams it through HBM with no table-sized VMEM resident, at
+#     HBM bandwidth (~17 stages x 2 x 38 MiB ≈ 1.3 GB ≈ 2 ms/round at
+#     10M nodes).
+#   * Stage 2 (Pallas, grid over row blocks): per-element lane choice +
+#     in-row partner-word gather (``tpu.dynamic_gather`` — the part XLA
+#     cannot do efficiently) + OR-merge + phantom masking, with
+#     block-sized double-buffered windows (3 x 512 KiB).
+#
+# Peak VMEM is block-sized, so this path has NO upper bound on n.  The
+# 128 per-lane shifts come from a threefry draw (tiny, XLA stage); the
+# per-block gather bits come from the hardware PRNG seeded per block —
+# the distributional contract (exactly uniform per-node partner
+# marginals, 128 shared per-lane row shifts per round) is identical to
+# the value kernel, and on injected bits the two are bitwise-equal
+# (tests/test_pallas_round.py).
+
+_MR_GATHER_BLOCK = 1024   # rows per grid step (512 KiB windows)
+
+
+def _mr_gather_kernel(seed_ref, tin_ref, rot_ref, *rest, n: int, block: int,
+                      inject: bool):
+    """Grid step: partner lane-gather from the pre-rotated table + OR."""
+    b = pl.program_id(0)
+    if inject:
+        rbits_ref, tout_ref = rest
+        rb = rbits_ref[0]
+    else:
+        (tout_ref,) = rest
+        # per-block stream: fold the block id into the round seed word
+        # (prng_set_seed_32 rejects a third traced operand)
+        pltpu.prng_seed(seed_ref[0],
+                        seed_ref[1] + b * jnp.int32(-1640531527))
+        rb = pltpu.bitcast(pltpu.prng_random_bits((block, LANES)),
+                           jnp.uint32)
+    m = (rb & jnp.uint32(LANES - 1)).astype(jnp.int32)
+    partner = jnp.take_along_axis(rot_ref[:], m, axis=1)
+    node_id = ((jax.lax.broadcasted_iota(jnp.int32, (block, LANES), 0)
+                + b * block) * LANES
+               + jax.lax.broadcasted_iota(jnp.int32, (block, LANES), 1))
+    tout_ref[:] = jnp.where(node_id < n, tin_ref[:] | partner,
+                            jnp.uint32(0))
+
+
+def _fused_mr_round_big(table: jax.Array, seed, round_, n: int,
+                        interpret: bool, inject_bits) -> jax.Array:
+    """One fanout-1 multi-rumor pull round via the staged big-table path."""
+    rows = table.shape[0]
+    block = min(_MR_GATHER_BLOCK, rows)
+
+    if inject_bits is not None:
+        sbits, rbits = inject_bits
+        sbits = jnp.asarray(sbits, jnp.uint32)[0]        # [8, 128]
+    else:
+        base = jax.random.PRNGKey(
+            jnp.uint32(jnp.asarray(seed, jnp.int32)) * jnp.uint32(_ROUND_MIX)
+            + jnp.uint32(0x5D0))
+        sbits = jax.random.bits(
+            jax.random.fold_in(base, jnp.asarray(round_, jnp.int32)),
+            (8, LANES), jnp.uint32)
+
+    # Stage 1 (XLA): per-lane row rotation, binary decomposition.
+    s = (sbits[0:1, :] % jnp.uint32(rows)).astype(jnp.int32)   # [1, 128]
+    rot = table
+    shift = 1
+    while shift < rows:
+        take = (s & shift) != 0
+        rot = jnp.where(take, jnp.roll(rot, shift, axis=0), rot)
+        shift <<= 1
+
+    # Stage 2 (Pallas grid): lane choice + in-row gather + OR + mask.
+    # Rows pad up to a block multiple (pad rows are phantom nodes — the
+    # kernel masks them to zero) so every grid step sees a full block.
+    rows_pad = -(-rows // block) * block
+    rbits = None if inject_bits is None else jnp.asarray(
+        inject_bits[1], jnp.uint32)
+    if rows_pad != rows:
+        zpad = jnp.zeros((rows_pad - rows, LANES), jnp.uint32)
+        table_p = jnp.concatenate([table, zpad], axis=0)
+        rot = jnp.concatenate([rot, zpad], axis=0)
+        if rbits is not None:
+            rbits = jnp.concatenate(
+                [rbits, jnp.zeros((rbits.shape[0], rows_pad - rows, LANES),
+                                  jnp.uint32)], axis=1)
+    else:
+        table_p = table
+    seeds = jnp.stack([jnp.asarray(seed, jnp.int32) * jnp.int32(_ROUND_MIX),
+                       jnp.asarray(round_, jnp.int32) ^ jnp.int32(0x5D0)])
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((block, LANES), lambda i: (i, 0)),
+                pl.BlockSpec((block, LANES), lambda i: (i, 0))]
+    operands = [seeds, table_p, rot]
+    if rbits is not None:
+        in_specs.append(pl.BlockSpec((1, block, LANES), lambda i: (0, i, 0)))
+        operands.append(rbits)
+    kernel = functools.partial(_mr_gather_kernel, n=n, block=block,
+                               inject=inject_bits is not None)
+    out = pl.pallas_call(
+        kernel,
+        grid=(rows_pad // block,),
+        out_shape=jax.ShapeDtypeStruct((rows_pad, LANES), jnp.uint32),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((block, LANES), lambda i: (i, 0)),
+        input_output_aliases={1: 0},
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(*operands)
+    return out[:rows] if rows_pad != rows else out
+
+
+def _mr_wants_big(table_bytes: int, fanout: int) -> bool:
+    """True when the value kernel cannot fit in VMEM (TABLE_COPIES live
+    table windows — the same bound check_fused_fits enforces, one
+    constant so routing and eligibility can never drift) and the staged
+    big-table path applies (fanout 1 only — extra fanout draws need a
+    live accumulator in the value kernel's layout)."""
+    return (TABLE_COPIES * table_bytes > _VMEM_LIMIT_BYTES
+            and fanout == 1)
+
+
 @functools.partial(jax.jit, static_argnames=("n", "fanout", "interpret"))
 def fused_multirumor_pull_round(table: jax.Array, seed: jax.Array,
                                 round_: jax.Array, n: int, fanout: int = 1,
@@ -323,10 +453,17 @@ def fused_multirumor_pull_round(table: jax.Array, seed: jax.Array,
                                 inject_bits=None) -> jax.Array:
     """One fused pull round on a one-word-per-node table.  Pure; jittable.
 
+    Tables whose 4-window working set exceeds the VMEM budget route to the
+    staged big-table path (XLA rotation + grid-blocked gather; fanout 1
+    only) — same math, block-sized VMEM, no upper bound on n.
+
     ``inject_bits`` (tests only): ``(sbits uint32[fanout, 8, 128], rbits
     uint32[fanout, rows, 128])`` replacing the hardware PRNG so the kernel
     math runs under the CPU interpreter."""
     rows = table.shape[0]
+    if _mr_wants_big(rows * LANES * 4, fanout):
+        return _fused_mr_round_big(table, seed, round_, n, interpret,
+                                   inject_bits)
     kernel = functools.partial(_fused_mr_kernel, rows=rows, fanout=fanout,
                                n=n, inject=inject_bits is not None)
     # round_salt: distinct hw-PRNG stream from the single-rumor kernel
@@ -340,22 +477,30 @@ def fused_table_bytes(n: int, rumors: int) -> int:
     return rows * LANES * 4
 
 
-def check_fused_fits(n: int, rumors: int) -> int:
-    """Raise ValueError if the fused kernel's working set (TABLE_COPIES
-    live table-sized buffers) cannot fit the VMEM budget; return the
-    table size in bytes.  Callers get a friendly error instead of a
-    Mosaic VMEM-exhausted compile failure."""
+def check_fused_fits(n: int, rumors: int, fanout: int = 1) -> int:
+    """Raise ValueError if no fused-kernel variant can fit this (n, rumors,
+    fanout) in VMEM; return the table size in bytes.  Callers get a
+    friendly error instead of an XLA VMEM-exhausted compile failure.
+
+    Multi-rumor tables whose 4-window value-kernel working set is over
+    budget still run via the staged big-table path when fanout == 1
+    (block-sized VMEM — no upper bound on n; the flagship 10M-node x
+    32-rumor case lands here)."""
     tb = fused_table_bytes(n, rumors)
-    if TABLE_COPIES * tb > _VMEM_LIMIT_BYTES:
-        layout = ("node-packed bitmap" if rumors == 1
-                  else "one-word-per-node")
-        raise ValueError(
-            f"fused kernel working set (~{TABLE_COPIES} x "
-            f"{tb / (1 << 20):.0f} MiB {layout} table) exceeds the "
-            f"{_VMEM_LIMIT_BYTES >> 20} MiB VMEM budget at n={n}, "
-            f"rumors={rumors}; reduce n, use engine='auto' (HBM-resident "
-            "XLA kernels), or shard across devices")
-    return tb
+    if TABLE_COPIES * tb <= _VMEM_LIMIT_BYTES:
+        return tb
+    if rumors > 1 and _mr_wants_big(tb, fanout):
+        return tb
+    layout = "node-packed bitmap" if rumors == 1 else "one-word-per-node"
+    hint = (" (fanout > 1 needs a live accumulator window and is limited "
+            "to tables that fit the value kernel)"
+            if rumors > 1 and fanout > 1 else "")
+    raise ValueError(
+        f"fused kernel working set (~{TABLE_COPIES} x "
+        f"{tb / (1 << 20):.0f} MiB {layout} table) exceeds the VMEM "
+        f"budget at n={n}, rumors={rumors}, fanout={fanout}{hint}; reduce "
+        "n, use engine='auto' (HBM-resident XLA kernels), or shard the "
+        "node dimension")
 
 
 def init_multirumor_state(n: int, rumors: int, origin: int = 0):

@@ -1,0 +1,130 @@
+"""Multi-slice (DCN) meshes: the multi-host scaling story.
+
+The reference scales across machines through the Maelstrom harness — one
+OS process per node, JSON over pipes, no awareness of network locality
+(reference main.go:72-88 contacts neighbors one at a time over whatever
+transport the harness provides).  The TPU-native equivalent is a
+**hybrid 2-D mesh**: a fast intra-slice axis (chips connected by ICI)
+and a slow cross-slice axis (hosts/slices connected by DCN).  The layout
+rule — the scaling-book recipe — is to put the communication-HEAVY
+dimension on ICI and the communication-FREE (or -light) dimension on
+DCN:
+
+* the **node axis** (O(N) digest collectives every round:
+  ``psum_scatter`` / ``all_gather`` / ``all_to_all`` in
+  parallel/sharded*.py) rides ICI, inside a slice;
+* the **sweep axis** (independent configs, parallel/sweep.py) or the
+  **rumor-plane axis** (zero-ICI by construction,
+  parallel/sharded_fused.py) rides DCN, across slices — those axes
+  exchange at most a scalar per round.
+
+``make_hybrid_mesh`` builds that mesh by grouping devices by their
+reported ``slice_index`` — each mesh row is one slice (devices within a
+row id-ordered, the platform's enumeration order), sub-pod meshes
+allowed — and falls back to a plain reshape on single-slice or CPU
+virtual devices.  The same program compiles either way, which is what
+lets the 8-device CPU mesh (tests, dryrun) validate the layout without
+a pod.
+
+Real multi-host execution additionally needs one ``jax.distributed.
+initialize()`` call per host before any jax API; ``maybe_init_distributed``
+wraps it behind the standard env vars so single-host runs stay untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+# (dcn_axis, ici_axis) default names match the 2-D pod sweep
+# (cli.cmd_grid / parallel/sweep.config_sweep_curves_2d).
+DEFAULT_AXES = ("sweep", "nodes")
+
+
+def device_slice_index(dev) -> int:
+    """The DCN slice a device belongs to (0 when the platform does not
+    report one — CPU, single-slice TPU)."""
+    idx = getattr(dev, "slice_index", None)
+    return 0 if idx is None else int(idx)
+
+
+def detect_slices(devices: Optional[Sequence] = None) -> int:
+    """Number of distinct DCN slices among ``devices``."""
+    devs = jax.devices() if devices is None else list(devices)
+    return len({device_slice_index(d) for d in devs})
+
+
+def make_hybrid_mesh(dcn_slices: int, per_slice: int,
+                     axis_names: Tuple[str, str] = DEFAULT_AXES) -> Mesh:
+    """A 2-D ``Mesh`` of shape (dcn_slices, per_slice) whose OUTER axis
+    crosses DCN slices and INNER axis stays inside a slice.
+
+    On hardware that reports multiple slices, each mesh row is one slice:
+    devices are grouped by ``slice_index`` and ``per_slice`` devices are
+    taken from each of the first ``dcn_slices`` groups — so sub-pod
+    meshes (fewer slices, fewer chips per slice) are valid, and the
+    inner axis never crosses DCN.  On single-slice or CPU virtual
+    devices it is a plain row-major reshape — the hybrid layout's
+    degenerate case, which is what lets the 8-device CPU mesh validate
+    the same shard_map programs without a pod.
+    """
+    grid = _hybrid_device_grid(jax.devices(), dcn_slices, per_slice)
+    return Mesh(grid, axis_names)
+
+
+def _hybrid_device_grid(devs: Sequence, dcn_slices: int,
+                        per_slice: int) -> np.ndarray:
+    """The (dcn_slices, per_slice) device grid behind make_hybrid_mesh —
+    split out so the slice-grouping logic is testable without real
+    multi-slice hardware."""
+    if dcn_slices < 1 or per_slice < 1:
+        raise ValueError("mesh axes must be >= 1")
+    want = dcn_slices * per_slice
+    if len(devs) < want:
+        raise ValueError(f"hybrid mesh {dcn_slices}x{per_slice} needs "
+                         f"{want} devices; only {len(devs)} available")
+    groups: dict = {}
+    for d in devs:
+        groups.setdefault(device_slice_index(d), []).append(d)
+    if len(groups) > 1:
+        slice_ids = sorted(groups)
+        if dcn_slices > len(slice_ids):
+            raise ValueError(
+                f"hybrid mesh wants {dcn_slices} DCN slices; platform "
+                f"reports {len(slice_ids)}")
+        rows = []
+        for sid in slice_ids[:dcn_slices]:
+            members = sorted(groups[sid], key=lambda d: d.id)
+            if len(members) < per_slice:
+                raise ValueError(
+                    f"slice {sid} has {len(members)} devices; the inner "
+                    f"mesh axis wants {per_slice} and must not cross DCN")
+            rows.append(members[:per_slice])
+        grid = np.empty((dcn_slices, per_slice), dtype=object)
+        for i, row in enumerate(rows):
+            for j, d in enumerate(row):
+                grid[i, j] = d
+        return grid
+    return np.asarray(list(devs[:want])).reshape(dcn_slices, per_slice)
+
+
+def maybe_init_distributed() -> bool:
+    """Initialize jax.distributed for a multi-host run.  Opt-in: fires
+    when ``JAX_COORDINATOR_ADDRESS`` is set (explicit coordinator) or
+    ``GOSSIP_TPU_MULTIHOST=1`` is set (let ``jax.distributed.
+    initialize()`` auto-detect the coordinator from the cluster
+    environment — Cloud TPU metadata, GKE, Slurm).  Returns True when
+    initialization ran.  Without either variable this is a no-op:
+    unconditionally initializing on a single host would hang waiting for
+    peers in partially-configured environments."""
+    import os
+    explicit = os.environ.get("JAX_COORDINATOR_ADDRESS") is not None
+    opted_in = os.environ.get("GOSSIP_TPU_MULTIHOST") == "1"
+    if not (explicit or opted_in):
+        return False
+    jax.distributed.initialize()
+    return True

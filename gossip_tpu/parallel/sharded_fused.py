@@ -18,9 +18,13 @@ only cross-device communication in the whole simulation is the scalar
 coverage reduction in the loop condition.
 
 This is the engine for the 10M-node multi-rumor flagship: 32 rumors per
-chip-plane, R = 32*W rumors total, each plane a 40 MB VMEM-resident table
-at N=10M.  Node-dim sharding of the same workload would all_gather
-O(N*W) words per round; here the per-round ICI cost is a float.
+chip-plane, R = 32*W rumors total.  Planes that fit the VMEM envelope run
+the whole-table value kernel; bigger planes (N=10M is a 38 MiB table,
+~4x that in live windows) route through the staged big-table path of
+ops/pallas_round.py (XLA rotation + grid-blocked gather) — same math,
+block-sized VMEM, no upper bound on n.  Node-dim sharding of the same
+workload would all_gather O(N*W) words per round; here the per-round ICI
+cost is a float.
 
 Rumor padding: planes are always full 32-bit words; rumor columns beyond
 ``rumors`` (and whole planes beyond ``ceil(rumors/32)``, when W is padded

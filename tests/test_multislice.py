@@ -1,0 +1,95 @@
+"""Multi-slice (DCN) hybrid mesh tests (parallel/multislice.py).
+
+Runs on the 8-device virtual CPU mesh (conftest) — the degenerate
+single-slice case of the hybrid layout, which is the point: the same
+mesh/program shapes compile on a real multi-slice pod.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from gossip_tpu.config import ProtocolConfig, RunConfig, TopologyConfig
+from gossip_tpu.parallel.multislice import (detect_slices,
+                                            device_slice_index,
+                                            make_hybrid_mesh,
+                                            maybe_init_distributed)
+
+
+def test_detect_slices_cpu():
+    assert detect_slices() == 1
+    assert all(device_slice_index(d) == 0 for d in jax.devices())
+
+
+def test_hybrid_mesh_shapes_and_errors():
+    mesh = make_hybrid_mesh(2, 4)
+    assert mesh.shape == {"sweep": 2, "nodes": 4}
+    assert mesh.devices.shape == (2, 4)
+    # all 8 devices present exactly once
+    assert (sorted(d.id for d in mesh.devices.ravel())
+            == sorted(d.id for d in jax.devices()[:8]))
+    with pytest.raises(ValueError, match="devices"):
+        make_hybrid_mesh(4, 4)
+    with pytest.raises(ValueError, match=">= 1"):
+        make_hybrid_mesh(0, 4)
+
+
+def test_hybrid_mesh_runs_2d_sweep_identically():
+    # the 2-D pod sweep on a hybrid mesh must reproduce the unsharded
+    # batch exactly (config_sweep_curves_2d's mesh-invariance contract)
+    from gossip_tpu.parallel.sweep import (SweepPoint, config_sweep_curves,
+                                           config_sweep_curves_2d)
+    from gossip_tpu.topology import generators as G
+    topo = G.ring(256, k=4)
+    run = RunConfig(seed=3, max_rounds=12)
+    pts = [SweepPoint(mode=m, fanout=f, drop_prob=d, period=1, seed=5)
+           for m in ("push", "pull") for f in (1, 2) for d in (0.0, 0.2)]
+    mesh = make_hybrid_mesh(2, 4, axis_names=("sweep", "nodes"))
+    got = config_sweep_curves_2d(pts, topo, run, mesh)
+    want = config_sweep_curves(pts, topo, run)
+    np.testing.assert_array_equal(got.curves, want.curves)
+    np.testing.assert_array_equal(got.msgs, want.msgs)
+
+
+def test_maybe_init_distributed_noop_without_env(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("GOSSIP_TPU_MULTIHOST", raising=False)
+    assert maybe_init_distributed() is False
+
+
+class _FakeDev:
+    def __init__(self, id, slice_index):
+        self.id = id
+        self.slice_index = slice_index
+
+    def __repr__(self):
+        return f"dev{self.id}@slice{self.slice_index}"
+
+
+def test_hybrid_grid_groups_by_slice():
+    """On (simulated) multi-slice hardware every mesh row must be one
+    slice — including SUB-POD meshes (fewer slices / fewer chips per
+    slice than the reservation)."""
+    from gossip_tpu.parallel.multislice import _hybrid_device_grid
+    # 2 slices x 4 chips, interleaved enumeration order on purpose
+    devs = [_FakeDev(i, slice_index=i % 2) for i in range(8)]
+    grid = _hybrid_device_grid(devs, 2, 4)
+    assert grid.shape == (2, 4)
+    for row in grid:
+        assert len({d.slice_index for d in row}) == 1   # no DCN inside a row
+    assert {d.slice_index for d in grid[:, 0]} == {0, 1}
+    # sub-pod: one slice of the reservation, 2 chips of it
+    sub = _hybrid_device_grid(devs, 1, 2)
+    assert sub.shape == (1, 2)
+    assert len({d.slice_index for d in sub.ravel()}) == 1
+    # 2x2 sub-pod: 2 chips from each slice
+    sub22 = _hybrid_device_grid(devs, 2, 2)
+    assert all(len({d.slice_index for d in row}) == 1 for row in sub22)
+    # more slices than the platform has
+    with pytest.raises(ValueError, match="DCN slices"):
+        _hybrid_device_grid(devs, 3, 2)
+    # inner axis cannot cross DCN (5 > the 4 devices slice 0 has, even
+    # though 1x5 = 5 <= 8 total)
+    with pytest.raises(ValueError, match="must not cross"):
+        _hybrid_device_grid(devs, 1, 5)

@@ -286,3 +286,48 @@ class TestHardwarePRNG:
         r1 = int(l1(i1).round)
         r2 = int(l2(i2).round)
         assert r2 < r1
+
+
+@pytest.mark.parametrize("n", [128 * 16, 128 * 24 - 37])
+def test_mr_staged_big_path_bitwise_matches_value_kernel(n):
+    """The staged big-table path (XLA rotation + grid-blocked gather —
+    the route for tables past the VMEM envelope, e.g. 10M x 32 rumors)
+    computes the SAME function as the value kernel: bitwise-equal on
+    identical injected bits, including phantom masking at ragged n."""
+    from gossip_tpu.ops.pallas_round import _fused_mr_round_big, _mr_wants_big
+    rng = np.random.default_rng(11 + n)
+    rows = mr_rows(n)
+    seen = rng.random((n, 32)) < 0.03
+    table = jnp.asarray(np.asarray(word_pack(jnp.asarray(seen))))
+    sbits, rbits = _mr_bits(rng, rows, 1)
+    want = fused_multirumor_pull_round(table, 0, 0, n, 1,
+                                       interpret=not ON_TPU,
+                                       inject_bits=(sbits, rbits))
+    got = _fused_mr_round_big(table, 0, 0, n, not ON_TPU, (sbits, rbits))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # routing: the flagship 10M x 32 x fanout-1 picks the big path; small
+    # tables and fanout>1 stay on the value kernel
+    assert _mr_wants_big(mr_rows(10_000_000) * LANES * 4, 1)
+    assert not _mr_wants_big(mr_rows(10_000_000) * LANES * 4, 2)
+    assert not _mr_wants_big(mr_rows(1_000_000) * LANES * 4, 1)
+
+
+def test_mr_staged_big_path_multiblock_grid(monkeypatch):
+    """Exercise the staged path's block-indexed code — the node_id block
+    offset, the per-block rbits BlockSpec index map, and a RAGGED final
+    block (rows not a multiple of the block) — by shrinking the block so
+    the grid has several steps, as it does at the 10M flagship
+    (78128 rows / 1024-row blocks)."""
+    import gossip_tpu.ops.pallas_round as PR
+    monkeypatch.setattr(PR, "_MR_GATHER_BLOCK", 16)
+    rng = np.random.default_rng(23)
+    rows = 40                               # 2 full blocks + ragged 8
+    n = rows * LANES - 13
+    seen = rng.random((n, 32)) < 0.03
+    table = jnp.asarray(np.asarray(word_pack(jnp.asarray(seen))))
+    sbits, rbits = _mr_bits(rng, rows, 1)
+    want = fused_multirumor_pull_round(table, 0, 0, n, 1,
+                                       interpret=not ON_TPU,
+                                       inject_bits=(sbits, rbits))
+    got = PR._fused_mr_round_big(table, 0, 0, n, not ON_TPU, (sbits, rbits))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
