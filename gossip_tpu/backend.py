@@ -278,10 +278,11 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
             raise ValueError(
                 f"exchange={_exchange!r} is a cross-shard pattern; it needs "
                 "n_devices > 1 (single-device runs have no exchange)")
-        if proto.mode == "swim":
+        if proto.mode in ("swim", "rumor"):
             raise ValueError(
-                f"exchange={_exchange!r} is not implemented for swim; "
-                "SWIM shards via the dense pmax kernel")
+                f"exchange={_exchange!r} is not implemented for "
+                f"{proto.mode}; swim and rumor shard via the dense "
+                "kernels (pmax / psum_scatter + all_gather)")
 
     if run.engine == "fused":
         if _exchange != "dense":
@@ -358,6 +359,60 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
             wall_s=round(wall, 4),
             curve=[float(f) for f in fracs] if want_curve else None,
             meta=meta)
+
+    if proto.mode == "rumor":
+        import jax.numpy as jnp
+
+        from gossip_tpu.models.rumor import (simulate_curve_rumor,
+                                             simulate_until_rumor)
+        t0 = time.perf_counter()
+        if want_curve:
+            if n_dev > 1:
+                raise ValueError("mode='rumor' curve capture is "
+                                 "single-device; drop --curve or --devices")
+            covs, hots, msgs, final = simulate_curve_rumor(proto, topo, run,
+                                                           fault)
+            wall = time.perf_counter() - t0
+            _, cov, msgs_f, curve = _curve_summary(
+                covs, msgs, run.target_coverage)
+            # rounds means ROUNDS-TO-EXTINCTION for rumor mongering, same
+            # as the non-curve path (meta["rounds_semantics"]); -1 if the
+            # hot set survived to max_rounds
+            import numpy as _np
+            dead_at = _np.nonzero(_np.asarray(hots) == 0.0)[0]
+            rounds = int(dead_at[0]) + 1 if len(dead_at) else -1
+            residue = 1.0 - float(covs[-1])
+            hot_left = float(hots[-1])
+        else:
+            if n_dev > 1:
+                from gossip_tpu.parallel.sharded import make_mesh
+                from gossip_tpu.parallel.sharded_rumor import (
+                    simulate_until_rumor_sharded)
+                mesh = make_mesh(n_dev)
+                rounds_ext, cov, residue, msgs_f, final = (
+                    simulate_until_rumor_sharded(proto, topo, run, mesh,
+                                                 fault))
+            else:
+                rounds_ext, cov, residue, msgs_f, final = (
+                    simulate_until_rumor(proto, topo, run, fault))
+            wall = time.perf_counter() - t0
+            curve = None
+            # rounds reports rounds-to-extinction; -1 only if hot pairs
+            # survived to max_rounds (no self-termination).  Slice to the
+            # real n rows: the sharded state pads to the mesh.
+            hot_left = float(jnp.mean(jnp.any(final.hot[:tc.n], axis=1)
+                                      .astype(jnp.float32)))
+            rounds = rounds_ext if hot_left == 0.0 else -1
+        return RunReport(
+            backend="jax-tpu", mode="rumor", n=tc.n, rounds=rounds,
+            coverage=cov, msgs=msgs_f, wall_s=round(wall, 4), curve=curve,
+            meta={"clock": "rounds", "devices": n_dev,
+                  "msgs_counts": "transmissions",
+                  "rounds_semantics": "extinction",
+                  "variant": proto.rumor_variant, "rumor_k": proto.rumor_k,
+                  "residue": round(residue, 6),
+                  "hot_fraction_final": hot_left,
+                  "terminated": hot_left == 0.0})
 
     if n_dev > 1 and _exchange == "sparse":
         from gossip_tpu.parallel.sharded import make_mesh

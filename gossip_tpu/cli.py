@@ -32,7 +32,12 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                    choices=("jax-tpu", "go-native"))
     p.add_argument("--mode", default="push",
                    choices=("push", "pull", "pushpull", "flood",
-                            "antientropy", "swim"))
+                            "antientropy", "swim", "rumor"))
+    p.add_argument("--rumor-k", type=int, default=2,
+                   help="rumor mongering: remove a rumor after this many "
+                        "unnecessary (feedback) or total (blind) pushes")
+    p.add_argument("--rumor-variant", default="feedback",
+                   choices=("feedback", "blind"))
     p.add_argument("--n", type=int, default=1024)
     p.add_argument("--fanout", type=int, default=1)
     p.add_argument("--rumors", type=int, default=1)
@@ -106,7 +111,9 @@ def _args_to_configs(a):
                            swim_proxies=a.swim_proxies,
                            swim_suspect_rounds=t,
                            swim_rotate=a.swim_rotate,
-                           swim_epoch_rounds=a.swim_epoch_rounds)
+                           swim_epoch_rounds=a.swim_epoch_rounds,
+                           rumor_k=a.rumor_k,
+                           rumor_variant=a.rumor_variant)
     tc = TopologyConfig(family=a.family, n=a.n, k=a.k, p=a.p,
                         degree_cap=a.degree_cap, seed=a.seed)
     run = RunConfig(target_coverage=a.target, max_rounds=a.max_rounds,
@@ -127,9 +134,10 @@ def cmd_run(a) -> int:
     from gossip_tpu.backend import run_simulation
     proto, tc, run, fault, mesh = _args_to_configs(a)
     if a.ensemble > 1:
-        if a.backend != "jax-tpu" or a.mode == "swim":
-            print("error: --ensemble needs the jax-tpu backend and a "
-                  "non-swim mode", file=sys.stderr)
+        if a.backend != "jax-tpu" or a.mode in ("swim", "rumor"):
+            print("error: --ensemble needs the jax-tpu backend and an "
+                  "SI mode (not swim/rumor — their state machines are "
+                  "not in the vmapped SI sweep)", file=sys.stderr)
             return 2
         if run.engine == "fused":
             # never silently substitute the XLA kernels for a requested

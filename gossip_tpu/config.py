@@ -23,8 +23,12 @@ FLOOD = "flood"          # push to ALL neighbors every round (Go-parity mode:
                          # main.go:72-75; coverage(t) == BFS ball of radius t)
 ANTI_ENTROPY = "antientropy"  # periodic bidirectional digest reconciliation
 SWIM = "swim"            # SWIM-style suspect/confirm failure detection
+RUMOR = "rumor"          # SIR rumor mongering: infective nodes push until
+                         # they lose interest (counter death, models/rumor.py)
 
-MODES = (PUSH, PULL, PUSH_PULL, FLOOD, ANTI_ENTROPY, SWIM)
+MODES = (PUSH, PULL, PUSH_PULL, FLOOD, ANTI_ENTROPY, SWIM, RUMOR)
+
+RUMOR_VARIANTS = ("feedback", "blind")
 
 # Topology families.
 COMPLETE = "complete"    # implicit: uniform random peer, no neighbor table
@@ -91,6 +95,13 @@ class ProtocolConfig:
     # without an [N, N] view table (models/swim.py module doc).
     swim_rotate: bool = False
     swim_epoch_rounds: int = 0
+    # Rumor mongering (mode='rumor', models/rumor.py): an infective
+    # (node, rumor) stops spreading — becomes removed, SIR — once its
+    # unnecessary-contact counter reaches `rumor_k` (Demers et al. §1.4
+    # counter death).  'feedback' counts only pushes whose recipient
+    # already knew the rumor; 'blind' counts every push.
+    rumor_k: int = 2
+    rumor_variant: str = "feedback"
 
     def __post_init__(self):
         if self.mode not in MODES:
@@ -103,6 +114,12 @@ class ProtocolConfig:
             raise ValueError("swim_subjects must be >= 1")
         if self.swim_epoch_rounds < 0:
             raise ValueError("swim_epoch_rounds must be >= 0 (0 = auto)")
+        if self.rumor_k < 1:
+            raise ValueError("rumor_k must be >= 1")
+        if self.rumor_variant not in RUMOR_VARIANTS:
+            raise ValueError(f"unknown rumor_variant "
+                             f"{self.rumor_variant!r}; choose from "
+                             f"{RUMOR_VARIANTS}")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,202 @@
+"""SIR rumor mongering: push gossip that STOPS — the Demers et al. 1987
+"rumor mongering" family (§1.4 of the Clearinghouse paper), counter-death
+variants.
+
+The SI modes (models/si.py) never stop pushing: an infected node stays
+infective forever, so push traffic is Theta(N * fanout) every round even
+at full coverage.  Rumor mongering adds the classic third state — each
+(node, rumor) is susceptible -> infective ("hot", actively forwarded) ->
+REMOVED (known but no longer forwarded) — and nodes lose interest via an
+unnecessary-contact counter:
+
+* ``feedback``: a push whose recipient ALREADY knew the rumor increments
+  the sender's counter for it; ``rumor_k`` such hits remove it.
+* ``blind``: every push increments the counter — removal after exactly
+  ``rumor_k`` pushes, regardless of outcome.
+
+The run self-terminates when the hot set is empty.  The classic quality
+metric is the **residue** s(infinity): the fraction of nodes never
+informed when gossip dies out (Demers: counter feedback k=2 leaves
+~2-6% residue on its own, which is why real systems pair rumor
+mongering with periodic anti-entropy — both live in this framework, and
+``--mode antientropy`` is the complement).
+
+Reference mapping: the reference's relay (main.go:72-88) is SI flood —
+it forwards forever and terminates only because the *dedup set* stops
+re-broadcasts (main.go:113).  Rumor mongering is what the reference
+would need at scale to stop paying O(degree) per duplicate delivery;
+the counter-death semantics here are the batched, round-synchronous
+form of that upgrade.
+
+Everything is a pure array update: one round = sample targets for hot
+(node, rumor) pairs -> scatter-OR the hot payload -> gather recipients'
+prior knowledge for the feedback counters -> threshold against
+``rumor_k``.  No data-dependent shapes: dead (node, rumor) pairs simply
+push nothing.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from gossip_tpu import config as C
+from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
+from gossip_tpu.models.state import alive_mask, bind_tables
+from gossip_tpu.ops.propagate import push_delta
+from gossip_tpu.ops.sampling import apply_drop, sample_peers
+from gossip_tpu.topology.generators import Topology
+
+RUMOR_PUSH_TAG, RUMOR_DROP_TAG = 11, 12
+
+
+class RumorState(NamedTuple):
+    """SIR per-(node, rumor) state carried through the round loop."""
+
+    seen: jax.Array      # bool[N, R] — informed (infective OR removed)
+    hot: jax.Array       # bool[N, R] — infective: still forwarding
+    cnt: jax.Array       # int32[N, R] — unnecessary-contact counter
+    round: jax.Array     # int32 scalar
+    base_key: jax.Array  # PRNG key
+    msgs: jax.Array      # float32 scalar — push messages sent
+
+
+def init_rumor_state(run: RunConfig, proto: ProtocolConfig,
+                     n: int) -> RumorState:
+    """Rumor r starts hot at node (origin + r) % n (models/state contract)."""
+    r = proto.rumors
+    origins = (run.origin + jnp.arange(r)) % n
+    seen = jnp.zeros((n, r), jnp.bool_).at[origins, jnp.arange(r)].set(True)
+    return RumorState(seen=seen, hot=seen, cnt=jnp.zeros((n, r), jnp.int32),
+                      round=jnp.int32(0), base_key=jax.random.key(run.seed),
+                      msgs=jnp.float32(0.0))
+
+
+def make_rumor_round(proto: ProtocolConfig, topo: Topology,
+                     fault: Optional[FaultConfig] = None,
+                     origin: int = 0, tabled: bool = False):
+    """Build the single-device rumor-mongering round step
+    (``RumorState -> RumorState``; ``tabled=True`` as in make_si_round)."""
+    if proto.mode != C.RUMOR:
+        raise ValueError(f"make_rumor_round builds mode='rumor' only "
+                         f"(got {proto.mode!r})")
+    n, k = topo.n, proto.fanout
+    kk = proto.rumor_k
+    feedback = proto.rumor_variant == "feedback"
+    drop_prob = 0.0 if fault is None else fault.drop_prob
+    tables = () if topo.implicit else (topo.nbrs, topo.deg)
+
+    def step_tabled(state: RumorState, *tbl) -> RumorState:
+        nbrs_t, deg_t = tbl if tbl else (None, None)
+        alive = alive_mask(fault, n, origin)
+        ids = jnp.arange(n, dtype=jnp.int32)
+        rkey = jax.random.fold_in(state.base_key, state.round)
+        seen, hot, cnt = state.seen, state.hot, state.cnt
+
+        # What this node forwards this round: its hot rumors (dead nodes
+        # go dark — neither send nor count).
+        payload = hot if alive is None else hot & alive[:, None]   # [N, R]
+
+        pkey = jax.random.fold_in(rkey, RUMOR_PUSH_TAG)
+        targets = sample_peers(pkey, ids, topo, k, proto.exclude_self,
+                               local_nbrs=nbrs_t, local_deg=deg_t)
+        targets = apply_drop(rkey, RUMOR_DROP_TAG, ids, targets,
+                             drop_prob, n)                         # [N, k]
+        sender_active = jnp.any(payload, axis=1)                   # [N]
+        valid = (targets < n) & sender_active[:, None]             # [N, k]
+        safe_t = jnp.where(valid, targets, 0)
+
+        # Deliveries: scatter-OR of the hot payload into the targets.
+        delta = push_delta(n, jnp.where(valid, targets, n), payload)
+        if alive is not None:
+            delta = delta & alive[:, None]     # dead nodes receive nothing
+
+        # Counter update against ROUND-START knowledge (synchronous
+        # semantics: all pushes observe the same snapshot).
+        #   feedback: count pushes whose recipient already knew the rumor;
+        #   blind:    count every push of a hot rumor.
+        if feedback:
+            knew = seen[safe_t] & valid[:, :, None]                # [N,k,R]
+            hits = jnp.sum(knew, axis=1, dtype=jnp.int32)          # [N, R]
+        else:
+            hits = jnp.sum(valid, axis=1, dtype=jnp.int32)[:, None]
+        cnt = cnt + jnp.where(payload, hits, 0)
+
+        # Loss of interest (removal) + fresh infections become hot.
+        new = delta & ~seen
+        hot = (hot & (cnt < kk)) | new
+        msgs = state.msgs + jnp.sum(valid).astype(jnp.float32)
+        return RumorState(seen=seen | delta, hot=hot, cnt=cnt,
+                          round=state.round + 1,
+                          base_key=state.base_key, msgs=msgs)
+
+    return bind_tables(step_tabled, tables, tabled)
+
+
+def rumor_coverage(seen: jax.Array,
+                   alive: Optional[jax.Array] = None) -> jax.Array:
+    """Min-over-rumors informed fraction (same contract as si.coverage)."""
+    if alive is None:
+        return jnp.min(jnp.mean(seen.astype(jnp.float32), axis=0))
+    w = alive.astype(jnp.float32)
+    per_rumor = (seen.astype(jnp.float32) * w[:, None]).sum(0) / w.sum()
+    return jnp.min(per_rumor)
+
+
+def simulate_until_rumor(proto: ProtocolConfig, topo: Topology,
+                         run: RunConfig,
+                         fault: Optional[FaultConfig] = None):
+    """Run to extinction (no hot pairs left) or max_rounds, one compiled
+    while_loop.  Returns (rounds, coverage, residue, msgs, final_state):
+    ``residue`` is the never-informed fraction at termination — the
+    rumor-mongering quality metric (worst rumor)."""
+    step, tbl = make_rumor_round(proto, topo, fault, run.origin, tabled=True)
+    init = init_rumor_state(run, proto, topo.n)
+
+    @jax.jit
+    def loop(state, *tables):
+        def cond(s):
+            return jnp.any(s.hot) & (s.round < run.max_rounds)
+
+        def body(s):
+            return step(s, *tables)
+
+        return jax.lax.while_loop(cond, body, state)
+
+    final = loop(init, *tbl)
+    # alive_mask, NOT static_death_draw: the kernel pins the origin alive,
+    # so the metric weighting must too (matches the sharded twin and
+    # every SI curve path)
+    alive = alive_mask(fault, topo.n, run.origin)
+    cov = float(rumor_coverage(final.seen, alive))
+    return (int(final.round), cov, 1.0 - cov, float(final.msgs), final)
+
+
+def simulate_curve_rumor(proto: ProtocolConfig, topo: Topology,
+                         run: RunConfig,
+                         fault: Optional[FaultConfig] = None):
+    """Fixed-length scan: per-round (coverage, hot_fraction, msgs) curves
+    — hot_fraction shows the infective wave rise and die out."""
+    step, tbl = make_rumor_round(proto, topo, fault, run.origin, tabled=True)
+    init = init_rumor_state(run, proto, topo.n)
+
+    @jax.jit
+    def scan(state, *tables):
+        # alive-weighted coverage, consistent with the until-driver and
+        # the SI curve paths (dead nodes are unreachable, not uninformed)
+        alive = alive_mask(fault, topo.n, run.origin)
+        hot_w = (jnp.float32(1.0) if alive is None
+                 else alive.astype(jnp.float32))
+
+        def body(s, _):
+            s = step(s, *tables)
+            hot_any = jnp.any(s.hot, axis=1).astype(jnp.float32)
+            hot_frac = (jnp.mean(hot_any) if alive is None
+                        else jnp.sum(hot_any * hot_w) / jnp.sum(hot_w))
+            return s, (rumor_coverage(s.seen, alive), hot_frac, s.msgs)
+        return jax.lax.scan(body, state, None, length=run.max_rounds)
+
+    final, (covs, hots, msgs) = scan(init, *tbl)
+    return covs, hots, msgs, final

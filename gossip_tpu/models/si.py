@@ -60,6 +60,9 @@ def make_si_round(proto: ProtocolConfig, topo: Topology,
     mode = proto.mode
     if mode == C.SWIM:
         raise ValueError("SWIM rounds are built by models/swim.py")
+    if mode == C.RUMOR:
+        raise ValueError("rumor-mongering rounds are built by "
+                         "models/rumor.py (SIR state, not SI)")
     if mode == C.FLOOD and topo.implicit:
         raise ValueError("flood mode needs an explicit neighbor table")
     drop_prob = 0.0 if fault is None else fault.drop_prob

@@ -100,6 +100,9 @@ def make_sharded_si_round(
     mode = proto.mode
     if mode == C.SWIM:
         raise ValueError("SWIM rounds are built by models/swim.py")
+    if mode == C.RUMOR:
+        raise ValueError("rumor-mongering rounds are built by "
+                         "parallel/sharded_rumor.py (SIR state, not SI)")
     if mode == C.FLOOD and topo.implicit:
         raise ValueError("flood mode needs an explicit neighbor table")
     n_pad = pad_to_mesh(n, mesh, axis_name)

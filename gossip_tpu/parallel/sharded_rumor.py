@@ -1,0 +1,158 @@
+"""Node-dim sharded SIR rumor mongering — the shard_map twin of
+models/rumor.py, bitwise-identical to the single-device kernel on any
+mesh (same per-node threefry streams keyed by GLOBAL ids, same counter
+semantics; tested in tests/test_rumor.py).
+
+Communication per round (dense-exchange family, parallel/sharded.py):
+``psum_scatter`` of the push counts (deliveries) and — for the feedback
+variant — one ``all_gather`` of the round-start ``seen`` table so each
+shard can check whether its push recipients already knew the rumor.
+Blind needs NO gather: its counters depend only on local state, so a
+blind rumor round moves strictly less ICI than an SI push round at the
+same fanout, and the hot set's extinction makes the total traffic
+O(N * rumor_k) messages instead of SI's O(N * rounds).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gossip_tpu import config as C
+from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
+from gossip_tpu.models.rumor import (RUMOR_DROP_TAG, RUMOR_PUSH_TAG,
+                                     RumorState, init_rumor_state,
+                                     rumor_coverage)
+from gossip_tpu.models.state import bind_tables
+from gossip_tpu.ops.propagate import push_counts
+from gossip_tpu.ops.sampling import apply_drop, sample_peers
+from gossip_tpu.parallel.sharded import (_pad_rows, pad_to_mesh,
+                                         sharded_alive)
+from gossip_tpu.topology.generators import Topology
+
+
+def make_sharded_rumor_round(proto: ProtocolConfig, topo: Topology,
+                             mesh: Mesh,
+                             fault: Optional[FaultConfig] = None,
+                             origin: int = 0, axis_name: str = "nodes",
+                             tabled: bool = False):
+    """Sharded round step; semantics identical to make_rumor_round."""
+    if proto.mode != C.RUMOR:
+        raise ValueError(f"make_sharded_rumor_round builds mode='rumor' "
+                         f"only (got {proto.mode!r})")
+    n, k = topo.n, proto.fanout
+    kk = proto.rumor_k
+    feedback = proto.rumor_variant == "feedback"
+    drop_prob = 0.0 if fault is None else fault.drop_prob
+    n_pad = pad_to_mesh(n, mesh, axis_name)
+    nl = n_pad // mesh.shape[axis_name]
+
+    have_table = not topo.implicit
+    if have_table:
+        nbrs_pad = _pad_rows(topo.nbrs, n_pad, n)
+        deg_pad = _pad_rows(topo.deg, n_pad, 0)
+
+    def local_round(seen_l, hot_l, cnt_l, round_, base_key, msgs, *table):
+        shard = jax.lax.axis_index(axis_name)
+        gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
+        rkey = jax.random.fold_in(base_key, round_)
+        alive_l = sharded_alive(fault, n, n_pad, origin)[gids]
+        nbrs_l, deg_l = table if have_table else (None, None)
+
+        payload = hot_l & alive_l[:, None]                     # [nl, R]
+        pkey = jax.random.fold_in(rkey, RUMOR_PUSH_TAG)
+        targets = sample_peers(pkey, gids, topo, k, proto.exclude_self,
+                               local_nbrs=nbrs_l, local_deg=deg_l)
+        targets = apply_drop(rkey, RUMOR_DROP_TAG, gids, targets,
+                             drop_prob, n)                     # [nl, k]
+        sender_active = jnp.any(payload, axis=1)
+        valid = (targets < n) & sender_active[:, None]
+
+        # Deliveries: scatter counts of the hot payload, reduce-scatter.
+        counts = push_counts(n_pad, jnp.where(valid, targets, n_pad),
+                             payload)
+        counts_l = jax.lax.psum_scatter(counts, axis_name,
+                                        scatter_dimension=0, tiled=True)
+        delta = (counts_l > 0) & alive_l[:, None]
+
+        # Counters against the ROUND-START global seen (feedback needs the
+        # recipients' prior knowledge — one all_gather; blind is local).
+        if feedback:
+            seen_all = jax.lax.all_gather(seen_l, axis_name, tiled=True)
+            safe_t = jnp.where(valid, targets, 0)
+            knew = seen_all[safe_t] & valid[:, :, None]        # [nl,k,R]
+            hits = jnp.sum(knew, axis=1, dtype=jnp.int32)
+        else:
+            hits = jnp.sum(valid, axis=1, dtype=jnp.int32)[:, None]
+        cnt_l = cnt_l + jnp.where(payload, hits, 0)
+
+        new = delta & ~seen_l
+        hot_l = (hot_l & (cnt_l < kk)) | new
+        msgs_new = msgs + jax.lax.psum(
+            jnp.sum(valid).astype(jnp.float32), axis_name)
+        return seen_l | delta, hot_l, cnt_l, msgs_new
+
+    sh2 = P(axis_name, None)
+    rep = P()
+    in_specs = [sh2, sh2, sh2, rep, rep, rep]
+    tables = ()
+    if have_table:
+        in_specs += [sh2, P(axis_name)]
+        tables = (nbrs_pad, deg_pad)
+
+    mapped = jax.shard_map(local_round, mesh=mesh,
+                           in_specs=tuple(in_specs),
+                           out_specs=(sh2, sh2, sh2, rep))
+
+    def step_tabled(state: RumorState, *tbl) -> RumorState:
+        seen, hot, cnt, msgs = mapped(state.seen, state.hot, state.cnt,
+                                      state.round, state.base_key,
+                                      state.msgs, *tbl)
+        return RumorState(seen=seen, hot=hot, cnt=cnt,
+                          round=state.round + 1,
+                          base_key=state.base_key, msgs=msgs)
+
+    return bind_tables(step_tabled, tables, tabled)
+
+
+def init_sharded_rumor_state(run: RunConfig, proto: ProtocolConfig,
+                             topo: Topology, mesh: Mesh,
+                             axis_name: str = "nodes") -> RumorState:
+    n_pad = pad_to_mesh(topo.n, mesh, axis_name)
+    st = init_rumor_state(run, proto, topo.n)
+    put = lambda x, fill: jax.device_put(               # noqa: E731
+        _pad_rows(x, n_pad, fill),
+        NamedSharding(mesh, P(axis_name, None)))
+    return RumorState(seen=put(st.seen, False), hot=put(st.hot, False),
+                      cnt=put(st.cnt, 0), round=st.round,
+                      base_key=st.base_key, msgs=st.msgs)
+
+
+def simulate_until_rumor_sharded(proto: ProtocolConfig, topo: Topology,
+                                 run: RunConfig, mesh: Mesh,
+                                 fault: Optional[FaultConfig] = None,
+                                 axis_name: str = "nodes"):
+    """Run to extinction or max_rounds, one compiled while_loop, state
+    resident sharded.  Same returns as models/rumor.simulate_until_rumor."""
+    step, tables = make_sharded_rumor_round(proto, topo, mesh, fault,
+                                            run.origin, axis_name,
+                                            tabled=True)
+    init = init_sharded_rumor_state(run, proto, topo, mesh, axis_name)
+
+    @jax.jit
+    def loop(state, *tbl):
+        def cond(s):
+            return jnp.any(s.hot) & (s.round < run.max_rounds)
+
+        return jax.lax.while_loop(cond, lambda s: step(s, *tbl), state)
+
+    final = loop(init, *tables)
+    # always weight by the padded alive mask: padding rows must not
+    # deflate coverage (sharded_alive marks them dead even fault-free)
+    n_pad = pad_to_mesh(topo.n, mesh, axis_name)
+    alive = sharded_alive(fault, topo.n, n_pad, run.origin)
+    cov = float(rumor_coverage(final.seen, alive))
+    return (int(final.round), cov, 1.0 - cov, float(final.msgs), final)
