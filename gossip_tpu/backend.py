@@ -331,15 +331,7 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
                     f"dead_nodes {outside} are outside the fixed subject "
                     f"window 0..{proto.swim_subjects - 1}; enable "
                     "--swim-rotate for full-membership detection")
-        rounds = run.max_rounds
-        t0 = time.perf_counter()
-        fracs, final = simulate_swim_curve(
-            proto, tc.n, rounds, dead_nodes=dead, fail_round=fail_round,
-            fault=fault,
-            topo=None if tc.family == "complete" else topo, seed=run.seed,
-            mesh=mesh)
-        wall = time.perf_counter() - t0
-        hit = [i for i, f in enumerate(fracs) if f >= run.target_coverage]
+        swim_topo = None if tc.family == "complete" else topo
         meta = {"clock": "rounds", "metric": "detection_fraction",
                 "dead_subjects": list(dead), "fail_round": fail_round,
                 "default_scenario": default_scenario,
@@ -349,16 +341,46 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
         if proto.swim_rotate:
             meta["subject_window"] = "rotating"
             meta["epoch_rounds"] = resolve_epoch_rounds(proto, tc.n)
-            # rotation: detection is scoped to the dead node's epoch; the
-            # headline number is the best in-window detection achieved
-            meta["peak_detection"] = float(max(fracs))
+        t0 = time.perf_counter()
+        if want_curve:
+            fracs, final = simulate_swim_curve(
+                proto, tc.n, run.max_rounds, dead_nodes=dead,
+                fail_round=fail_round, fault=fault, topo=swim_topo,
+                seed=run.seed, mesh=mesh)
+            wall = time.perf_counter() - t0
+            hit = [i for i, f in enumerate(fracs)
+                   if f >= run.target_coverage]
+            rounds_out = (hit[0] + 1) if hit else -1
+            det_final = float(fracs[-1])
+            if proto.swim_rotate:
+                # rotation: detection is scoped to the dead node's epoch;
+                # the headline number is the best in-window detection
+                meta["peak_detection"] = float(max(fracs))
+            curve = [float(f) for f in fracs]
+        else:
+            # early-exit driver: stops the round detection hits the
+            # target instead of scanning the full max_rounds budget
+            import jax.numpy as jnp
+
+            from gossip_tpu.runtime.simulator import simulate_swim_until
+            r, det_final, det_peak, final = simulate_swim_until(
+                proto, tc.n, run.max_rounds, run.target_coverage,
+                dead_nodes=dead, fail_round=fail_round, fault=fault,
+                topo=swim_topo, seed=run.seed, mesh=mesh)
+            wall = time.perf_counter() - t0
+            # same f32 threshold the loop's cond compared against
+            tgt32 = float(jnp.float32(run.target_coverage))
+            rounds_out = r if det_final >= tgt32 else -1
+            if proto.swim_rotate:
+                # peak over the whole run, like the curve path's
+                # max(fracs): the window may have rotated past the dead
+                # node's epoch by the time the loop stops
+                meta["peak_detection"] = det_peak
+            curve = None
         return RunReport(
-            backend="jax-tpu", mode="swim", n=tc.n,
-            rounds=(hit[0] + 1) if hit else -1,
-            coverage=float(fracs[-1]), msgs=float(final.msgs),
-            wall_s=round(wall, 4),
-            curve=[float(f) for f in fracs] if want_curve else None,
-            meta=meta)
+            backend="jax-tpu", mode="swim", n=tc.n, rounds=rounds_out,
+            coverage=det_final, msgs=float(final.msgs),
+            wall_s=round(wall, 4), curve=curve, meta=meta)
 
     if proto.mode == "rumor":
         import jax.numpy as jnp

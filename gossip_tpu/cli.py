@@ -79,6 +79,19 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                         "that matches the sharded paths bitwise)")
     p.add_argument("--curve", action="store_true",
                    help="include the per-round coverage curve")
+    p.add_argument("--profile", default=None, metavar="LOGDIR",
+                   help="capture a jax.profiler trace of the run into "
+                        "LOGDIR (TensorBoard profile plugin / Perfetto)")
+    p.add_argument("--checkpoint", default=None, metavar="PATH",
+                   help="checkpointed driver (SI modes, single device): "
+                        "run max_rounds rounds saving an atomic npz every "
+                        "--checkpoint-every rounds; with --resume, "
+                        "continue a previous run from PATH (bitwise "
+                        "continuation incl. the PRNG key)")
+    p.add_argument("--checkpoint-every", type=int, default=50)
+    p.add_argument("--resume", action="store_true",
+                   help="load --checkpoint PATH and continue to "
+                        "max_rounds total rounds")
     p.add_argument("--save-curve", default=None, metavar="PATH",
                    help="write the coverage curve as JSONL (implies --curve)")
     p.add_argument("--ensemble", type=int, default=0, metavar="S",
@@ -167,10 +180,33 @@ def cmd_run(a) -> int:
             out["curve_mean"] = [float(c) for c in ens.curves.mean(axis=0)]
         print(json.dumps(out))
         return 0
+    if a.resume and not a.checkpoint:
+        print("error: --resume needs --checkpoint PATH (the file to "
+              "continue from)", file=sys.stderr)
+        return 2
+    if a.checkpoint:
+        if a.curve or a.save_curve:
+            print("error: --checkpoint drives compiled fori_loop segments "
+                  "with no per-round curve capture; drop --curve/"
+                  "--save-curve", file=sys.stderr)
+            return 2
+        if a.profile:
+            from gossip_tpu.utils.trace import trace
+            with trace(a.profile):
+                return _cmd_run_checkpointed(a, proto, tc, run, fault, mesh)
+        return _cmd_run_checkpointed(a, proto, tc, run, fault, mesh)
     want_curve = a.curve or bool(a.save_curve)
-    report = run_simulation(a.backend, proto, tc, run, fault, mesh,
-                            want_curve=want_curve)
+    if a.profile:
+        from gossip_tpu.utils.trace import trace
+        with trace(a.profile):
+            report = run_simulation(a.backend, proto, tc, run, fault, mesh,
+                                    want_curve=want_curve)
+    else:
+        report = run_simulation(a.backend, proto, tc, run, fault, mesh,
+                                want_curve=want_curve)
     out = report.to_dict()
+    if a.profile:
+        out["profile_logdir"] = a.profile
     if a.save_curve:
         from gossip_tpu.utils.metrics import dump_curve_jsonl
         meta = dict(out)
@@ -178,6 +214,73 @@ def cmd_run(a) -> int:
         dump_curve_jsonl(a.save_curve, curve, meta=meta)
         if not a.curve:          # curve went to the file, not the report
             out["curve"] = None
+    print(json.dumps(out))
+    return 0
+
+
+def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
+    """--checkpoint driver: fixed-round SI run in compiled fori_loop
+    segments with an atomic npz every --checkpoint-every rounds; --resume
+    continues a saved run to max_rounds TOTAL rounds, bitwise identical
+    to an uninterrupted run (tests/test_utils.py property)."""
+    import os
+
+    if (a.backend != "jax-tpu" or a.mode in ("swim", "rumor")
+            or (mesh is not None and mesh.n_devices > 1)
+            or run.engine == "fused"):
+        print("error: --checkpoint drives the single-device SI XLA "
+              "kernels (jax-tpu backend, non-swim/rumor mode)",
+              file=sys.stderr)
+        return 2
+    import dataclasses
+
+    from gossip_tpu.models.si import coverage, make_si_round
+    from gossip_tpu.models.state import alive_mask, init_state
+    from gossip_tpu.topology import generators as G
+    from gossip_tpu.utils.checkpoint import (load_meta, load_state,
+                                             run_with_checkpoints)
+    topo = G.build(tc)
+    step, tables = make_si_round(proto, topo, fault, run.origin, tabled=True)
+    # Config fingerprint stored with every checkpoint: resume refuses
+    # mismatched flags instead of silently continuing a DIFFERENT run
+    # (the bitwise-continuation promise is per-config).
+    fingerprint = {"proto": dataclasses.asdict(proto),
+                   "tc": dataclasses.asdict(tc),
+                   "fault": None if fault is None
+                   else dataclasses.asdict(fault),
+                   "seed": run.seed, "origin": run.origin}
+    resumed = False
+    if a.resume:
+        if not os.path.exists(a.checkpoint):
+            print(f"error: --resume: no checkpoint at {a.checkpoint}",
+                  file=sys.stderr)
+            return 2
+        saved = load_meta(a.checkpoint).get("extra", {}).get("config")
+        if saved is not None and saved != json.loads(
+                json.dumps(fingerprint)):
+            diff = [k for k in fingerprint
+                    if json.loads(json.dumps(fingerprint[k]))
+                    != saved.get(k)]
+            print("error: --resume config mismatch vs the checkpoint "
+                  f"(differs in: {', '.join(diff)}); rerun with the "
+                  "flags the checkpoint was written with",
+                  file=sys.stderr)
+            return 2
+        state = load_state(a.checkpoint)
+        resumed = True
+    else:
+        state = init_state(run, proto, tc.n)
+    remaining = max(0, run.max_rounds - int(state.round))
+    state = run_with_checkpoints(step, state, remaining, a.checkpoint,
+                                 every=a.checkpoint_every,
+                                 step_args=tables,
+                                 extra_meta={"config": fingerprint})
+    alive = alive_mask(fault, tc.n, run.origin)
+    out = {"backend": a.backend, "mode": a.mode, "n": tc.n,
+           "rounds": int(state.round),
+           "coverage": float(coverage(state.seen, alive)),
+           "msgs": float(state.msgs), "checkpoint": a.checkpoint,
+           "checkpoint_every": a.checkpoint_every, "resumed": resumed}
     print(json.dumps(out))
     return 0
 

@@ -124,9 +124,15 @@ def make_rumor_round(proto: ProtocolConfig, topo: Topology,
             hits = jnp.sum(valid, axis=1, dtype=jnp.int32)[:, None]
         cnt = cnt + jnp.where(payload, hits, 0)
 
-        # Loss of interest (removal) + fresh infections become hot.
+        # Loss of interest (removal) + fresh infections become hot.  Dead
+        # nodes can hold no hot bits (a dead multi-rumor origin would
+        # otherwise stay "hot" forever with its payload masked, and the
+        # extinction loop would never terminate); like SI, a rumor whose
+        # origin is dead simply never spreads.
         new = delta & ~seen
         hot = (hot & (cnt < kk)) | new
+        if alive is not None:
+            hot = hot & alive[:, None]
         msgs = state.msgs + jnp.sum(valid).astype(jnp.float32)
         return RumorState(seen=seen | delta, hot=hot, cnt=cnt,
                           round=state.round + 1,

@@ -90,7 +90,9 @@ def make_sharded_rumor_round(proto: ProtocolConfig, topo: Topology,
         cnt_l = cnt_l + jnp.where(payload, hits, 0)
 
         new = delta & ~seen_l
-        hot_l = (hot_l & (cnt_l < kk)) | new
+        # dead nodes hold no hot bits (extinction-loop liveness; matches
+        # the single-device kernel — a dead origin's rumor never spreads)
+        hot_l = ((hot_l & (cnt_l < kk)) | new) & alive_l[:, None]
         msgs_new = msgs + jax.lax.psum(
             jnp.sum(valid).astype(jnp.float32), axis_name)
         return seen_l | delta, hot_l, cnt_l, msgs_new

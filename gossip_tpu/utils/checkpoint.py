@@ -24,16 +24,21 @@ from typing import Union
 import jax
 import numpy as np
 
+from gossip_tpu.models.rumor import RumorState
 from gossip_tpu.models.state import SimState
 from gossip_tpu.models.swim import SwimState
 
-_STATE_TYPES = {"SimState": SimState, "SwimState": SwimState}
-State = Union[SimState, SwimState]
+_STATE_TYPES = {"SimState": SimState, "SwimState": SwimState,
+                "RumorState": RumorState}
+State = Union[SimState, SwimState, RumorState]
 
 
-def save_state(path: str, state: State) -> None:
-    """Write a SimState/SwimState to ``path`` (.npz).  Sharded arrays are
-    gathered to host — checkpoint outside the hot loop."""
+def save_state(path: str, state: State, extra_meta=None) -> None:
+    """Write a SimState/SwimState/RumorState to ``path`` (.npz).  Sharded
+    arrays are gathered to host — checkpoint outside the hot loop.
+    ``extra_meta`` (a JSON-able dict) rides in the metadata entry — e.g.
+    the run's config fingerprint, so resume can refuse mismatched flags
+    (:func:`load_meta`)."""
     cls = type(state).__name__
     if cls not in _STATE_TYPES:
         raise TypeError(f"unknown state type {cls}")
@@ -49,10 +54,19 @@ def save_state(path: str, state: State) -> None:
             arrays[name] = np.asarray(val)
     meta = {"cls": cls, "fields": list(fields), "key_field": key_field,
             "key_impl": str(jax.random.key_impl(state.base_key))}
+    if extra_meta is not None:
+        meta["extra"] = extra_meta
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, __meta__=json.dumps(meta), **arrays)
     os.replace(tmp, path)          # atomic: no torn checkpoints on crash
+
+
+def load_meta(path: str) -> dict:
+    """The metadata entry of a checkpoint (incl. any ``extra_meta`` under
+    'extra') without loading the arrays."""
+    with np.load(path, allow_pickle=False) as z:
+        return json.loads(str(z["__meta__"]))
 
 
 def load_state(path: str) -> State:
@@ -85,14 +99,16 @@ def _segment_runner(step):
     runner = _segment_runners.get(step)
     if runner is None:
         @jax.jit
-        def runner(s, n_steps):
-            return jax.lax.fori_loop(0, n_steps, lambda _, st: step(st), s)
+        def runner(s, n_steps, *args):
+            return jax.lax.fori_loop(0, n_steps,
+                                     lambda _, st: step(st, *args), s)
         _segment_runners[step] = runner
     return runner
 
 
 def run_with_checkpoints(step, state: State, rounds: int, path: str,
-                         every: int = 50) -> State:
+                         every: int = 50, step_args=(),
+                         extra_meta=None) -> State:
     """Drive ``step`` for ``rounds`` rounds, checkpointing every ``every``
     rounds (and at the end).  Resume by loading the file and calling again
     with the remaining round budget — long sweeps survive preemption.
@@ -102,18 +118,23 @@ def run_with_checkpoints(step, state: State, rounds: int, path: str,
     the same executable, as does a resume call with the same ``step``):
     the host syncs once per checkpoint, not once per round, preserving the
     while-loop fusion the round kernels are built for (tests/test_utils.py
-    asserts both the bitwise trajectory and the one-trace property)."""
+    asserts both the bitwise trajectory and the one-trace property).
+
+    ``step_args`` travel as traced jit ARGUMENTS into the segment runner
+    — pass a tabled step's topology arrays here instead of closing over
+    them, so 1M+-row tables are not inlined into the compile request
+    (models/swim.py doc)."""
     if every < 1:
         raise ValueError(f"every must be >= 1, got {every}")
     run_segment = _segment_runner(step)
     done = 0
     while done < rounds:
         todo = min(every, rounds - done)
-        state = run_segment(state, todo)
+        state = run_segment(state, todo, *step_args)
         done += todo
         jax.block_until_ready(state.seen if hasattr(state, "seen")
                               else state.wire)
-        save_state(path, state)
+        save_state(path, state, extra_meta)
     if rounds <= 0:
-        save_state(path, state)
+        save_state(path, state, extra_meta)
     return state

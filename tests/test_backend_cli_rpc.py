@@ -407,3 +407,49 @@ def test_engine_xla_is_the_auto_fused_opt_out():
                                                   x.msgs)
     args = request_to_args({"run": {"engine": "xla"}})
     assert args["run"].engine == "xla"
+
+
+def test_cli_checkpoint_resume_and_profile(tmp_path):
+    ck = str(tmp_path / "run.npz")
+    prof = str(tmp_path / "prof")
+    # 12 rounds, checkpoint every 5 -> file exists, rounds == 12
+    p = _cli("run", "--mode", "pushpull", "--n", "512", "--max-rounds",
+             "12", "--checkpoint", ck, "--checkpoint-every", "5")
+    assert p.returncode == 0, p.stderr
+    rep = json.loads(p.stdout)
+    assert rep["rounds"] == 12 and os.path.exists(ck)
+    # resume continues to 20 TOTAL rounds and must match an
+    # uninterrupted 20-round checkpointed run bitwise (same seed)
+    p = _cli("run", "--mode", "pushpull", "--n", "512", "--max-rounds",
+             "20", "--checkpoint", ck, "--resume")
+    assert p.returncode == 0, p.stderr
+    resumed = json.loads(p.stdout)
+    assert resumed["rounds"] == 20 and resumed["resumed"] is True
+    ck2 = str(tmp_path / "solo.npz")
+    p = _cli("run", "--mode", "pushpull", "--n", "512", "--max-rounds",
+             "20", "--checkpoint", ck2)
+    solo = json.loads(p.stdout)
+    assert (resumed["coverage"], resumed["msgs"]) == (solo["coverage"],
+                                                      solo["msgs"])
+    # guard: sharded/swim requests are rejected loudly
+    p = _cli("run", "--mode", "swim", "--n", "256", "--checkpoint", ck)
+    assert p.returncode == 2 and "single-device SI" in p.stderr
+    # resume with different flags refuses (config fingerprint mismatch)
+    p = _cli("run", "--mode", "pushpull", "--n", "512", "--max-rounds",
+             "30", "--seed", "9", "--checkpoint", ck, "--resume")
+    assert p.returncode == 2 and "config mismatch" in p.stderr
+    assert "seed" in p.stderr
+    # --resume without --checkpoint errors instead of silently restarting
+    p = _cli("run", "--mode", "pushpull", "--n", "512", "--resume")
+    assert p.returncode == 2 and "--checkpoint" in p.stderr
+    # --curve is incompatible with the segment driver (no silent drop)
+    p = _cli("run", "--mode", "pushpull", "--n", "512",
+             "--checkpoint", ck, "--curve")
+    assert p.returncode == 2 and "curve" in p.stderr
+    # --profile wraps the run and writes a trace directory
+    p = _cli("run", "--mode", "pull", "--n", "256", "--max-rounds", "16",
+             "--profile", prof)
+    assert p.returncode == 0, p.stderr
+    rep = json.loads(p.stdout)
+    assert rep["profile_logdir"] == prof
+    assert os.path.isdir(prof) and any(os.scandir(prof))

@@ -222,3 +222,31 @@ def test_sharded_swim_detects_on_powerlaw():
         SwimState(st.wire[:n], st.timer[:n], st.round, st.base_key, st.msgs),
         (2,)))
     assert frac > 0.95
+
+
+def test_swim_until_driver_matches_curve_rounds():
+    """The early-exit while_loop driver stops at exactly the round the
+    scan driver's curve first hits the target, single-device and
+    sharded, rotating included."""
+    from gossip_tpu.config import ProtocolConfig
+    from gossip_tpu.parallel.sharded import make_mesh
+    from gossip_tpu.runtime.simulator import (simulate_swim_curve,
+                                              simulate_swim_until)
+
+    n, target = 256, 0.99
+    proto = ProtocolConfig(mode="swim", fanout=2, swim_subjects=4,
+                           swim_proxies=2, swim_suspect_rounds=4)
+    fracs, _ = simulate_swim_curve(proto, n, 40, dead_nodes=(1,),
+                                   fail_round=2, seed=5)
+    hit = [i + 1 for i, f in enumerate(fracs) if f >= target]
+    rounds, det, peak, final = simulate_swim_until(proto, n, 40, target,
+                                                   dead_nodes=(1,),
+                                                   fail_round=2, seed=5)
+    assert hit and rounds == hit[0]
+    assert det >= target
+    assert peak >= det
+    assert int(final.round) == rounds
+    sh_rounds, sh_det, sh_peak, _ = simulate_swim_until(
+        proto, n, 40, target, dead_nodes=(1,), fail_round=2, seed=5,
+        mesh=make_mesh(8))
+    assert (sh_rounds, sh_det, sh_peak) == (rounds, det, peak)
