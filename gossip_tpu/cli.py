@@ -411,6 +411,17 @@ def cmd_maelstrom(a) -> int:
     return 0
 
 
+def cmd_maelstrom_check(a) -> int:
+    import asyncio
+
+    from gossip_tpu.runtime.maelstrom_harness import run_broadcast_workload
+    stats = asyncio.run(run_broadcast_workload(
+        a.n, a.ops, rate=a.rate, latency=a.latency, topology=a.topology,
+        partition_mid=a.partition, seed=a.seed))
+    print(json.dumps(stats))
+    return 0 if stats["invariant_ok"] else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="gossip_tpu",
@@ -470,6 +481,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = sub.add_parser("maelstrom",
                        help="run the Maelstrom protocol node on stdio")
     p.set_defaults(fn=cmd_maelstrom)
+
+    p = sub.add_parser("maelstrom-check",
+                       help="run the Maelstrom broadcast workload against "
+                            "N real node processes and check the "
+                            "eventual-delivery invariant (the external "
+                            "harness the reference was tested with, "
+                            "in-repo)")
+    p.add_argument("--n", type=int, default=5)
+    p.add_argument("--ops", type=int, default=20)
+    p.add_argument("--rate", type=float, default=50.0, help="ops/sec")
+    p.add_argument("--latency", type=float, default=0.002,
+                   help="simulated link latency (s)")
+    p.add_argument("--topology", default="line", choices=("line", "grid"))
+    p.add_argument("--partition", action="store_true",
+                   help="cut a mid-cluster link for the middle third of "
+                        "the run (fault-tolerance variant)")
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=cmd_maelstrom_check)
 
     a = ap.parse_args(argv)
     try:

@@ -55,6 +55,8 @@ class MaelstromHarness:
         self._loop_t0 = 0.0
         self.routed = 0              # inter-node messages routed
         self._last_activity = 0.0
+        self.op_latencies: List[float] = []   # client RPC round trips (s)
+        self.broadcast_ops = 0
 
     # -- lifecycle -------------------------------------------------------
 
@@ -191,8 +193,12 @@ class MaelstromHarness:
         assert all(r["body"]["type"] == "topology_ok" for r in replies)
 
     async def broadcast(self, node: str, value: int) -> dict:
-        return await self._client_rpc(node,
-                                      {"type": "broadcast", "message": value})
+        t0 = self._now()
+        r = await self._client_rpc(node,
+                                   {"type": "broadcast", "message": value})
+        self.op_latencies.append(self._now() - t0)
+        self.broadcast_ops += 1
+        return r
 
     async def read(self, node: str) -> List[int]:
         r = await self._client_rpc(node, {"type": "read"})
@@ -212,6 +218,87 @@ class MaelstromHarness:
                 return
             await asyncio.sleep(idle / 4)
         raise TimeoutError("cluster did not quiesce")
+
+
+    def stats(self) -> dict:
+        """Maelstrom-checker-style workload stats (SURVEY.md §4: the real
+        harness reports messages-per-op and op latencies externally)."""
+        lats = sorted(self.op_latencies)
+
+        def pct(p):
+            return lats[min(len(lats) - 1, int(p * len(lats)))] if lats else 0.0
+        return {
+            "nodes": self.n,
+            "broadcast_ops": self.broadcast_ops,
+            "msgs_routed": self.routed,
+            "msgs_per_op": (self.routed / self.broadcast_ops
+                            if self.broadcast_ops else 0.0),
+            "op_latency_ms": {
+                "mean": 1e3 * sum(lats) / len(lats) if lats else 0.0,
+                "p50": 1e3 * pct(0.50), "p99": 1e3 * pct(0.99),
+                "max": 1e3 * (lats[-1] if lats else 0.0)},
+            "link_latency_ms": 1e3 * self.latency,
+        }
+
+
+async def run_broadcast_workload(n: int, ops: int, rate: float = 50.0,
+                                 latency: float = 0.002,
+                                 topology: str = "line",
+                                 partition_mid: bool = False,
+                                 seed: int = 0,
+                                 argv: Optional[List[str]] = None) -> dict:
+    """The Maelstrom ``broadcast`` workload as a callable: spawn ``n``
+    protocol nodes, send ``ops`` broadcasts at ``rate`` ops/s to random
+    nodes, optionally cut a mid-cluster link for the middle third of the
+    run (the fault-tolerance variant), quiesce, then check the checker's
+    invariant — EVERY value appears in EVERY node's read (SURVEY.md §4).
+    Returns the stats dict (+ ``invariant_ok``, ``values``)."""
+    import random
+    rng = random.Random(seed)
+    h = MaelstromHarness(n, latency=latency, argv=argv)
+    await h.start()
+    try:
+        topo = (line_topology(h.ids) if topology == "line"
+                else grid_topology(h.ids, max(1, int(n ** 0.5))))
+        await h.set_topology(topo)
+        if partition_mid and n >= 2:
+            a, b = h.ids[n // 2 - 1], h.ids[n // 2]
+            # cut the middle third of the send window, anchored NOW (the
+            # send loop starts now) — anchoring at loop start would let
+            # process-spawn/init time expire the window before the first
+            # broadcast and make the fault variant vacuous
+            span = ops / rate
+            h.partition(a, b, duration=span / 3,
+                        start=h._now() + span / 3)
+        for v in range(ops):
+            await h.broadcast(rng.choice(h.ids), v)
+            await asyncio.sleep(1.0 / rate)
+        timed_out = False
+        try:
+            await h.quiesce(timeout=60.0)
+        except TimeoutError:
+            timed_out = True       # report, don't crash: reads still run
+        # The checker invariant is EVENTUAL delivery: a quiesce can look
+        # idle while a node's partition-dropped push sits in its ~2 s
+        # RPC-timeout retry loop, so poll the reads until every value is
+        # everywhere or the deadline passes (nodes retry with capped
+        # backoff — runtime/maelstrom_node.py).
+        want = set(range(ops))
+        deadline = h._now() + 30.0
+        while True:
+            reads = await asyncio.gather(*[h.read(nid) for nid in h.ids])
+            ok = all(want <= set(r) for r in reads)
+            if ok or h._now() > deadline:
+                break
+            await asyncio.sleep(0.5)
+        out = h.stats()
+        out["invariant_ok"] = ok
+        out["quiesce_timeout"] = timed_out
+        out["values"] = ops
+        out["partitioned"] = bool(partition_mid)
+        return out
+    finally:
+        await h.stop()
 
 
 def line_topology(ids: List[str]) -> Dict[str, List[str]]:

@@ -141,3 +141,22 @@ def test_partition_tolerance_retry_heals():
         finally:
             await h.stop()
     run(main())
+
+
+def test_broadcast_workload_stats_and_invariant():
+    """The in-repo Maelstrom 'broadcast' workload: random-node ops at a
+    rate, quiesce, per-node reads — the checker invariant plus the
+    checker-style stats (msgs-per-op, op latencies)."""
+    from gossip_tpu.runtime.maelstrom_harness import run_broadcast_workload
+    stats = asyncio.run(run_broadcast_workload(
+        4, ops=8, rate=100.0, latency=0.001, seed=2))
+    assert stats["invariant_ok"] is True
+    assert stats["broadcast_ops"] == 8
+    assert stats["msgs_per_op"] > 0
+    assert stats["op_latency_ms"]["p99"] >= stats["op_latency_ms"]["p50"] > 0
+    # fault-tolerance variant: invariant must hold THROUGH a partition
+    # (the nodes' retry loops heal the cut)
+    stats_p = asyncio.run(run_broadcast_workload(
+        4, ops=8, rate=25.0, latency=0.001, partition_mid=True, seed=3))
+    assert stats_p["invariant_ok"] is True
+    assert stats_p["partitioned"] is True
