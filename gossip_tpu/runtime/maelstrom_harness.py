@@ -262,7 +262,12 @@ async def run_broadcast_workload(n: int, ops: int, rate: float = 50.0,
                 else grid_topology(h.ids, max(1, int(n ** 0.5))))
         await h.set_topology(topo)
         if partition_mid and n >= 2:
-            a, b = h.ids[n // 2 - 1], h.ids[n // 2]
+            # cut a REAL edge near the middle of the cluster — consecutive
+            # ids are only adjacent in the line topology; on a grid an
+            # arbitrary pair is usually not an edge and the cut would drop
+            # nothing while still reporting partitioned=true
+            a = next(nid for nid in h.ids[n // 2:] + h.ids if topo[nid])
+            b = topo[a][0]
             # cut the middle third of the send window, anchored NOW (the
             # send loop starts now) — anchoring at loop start would let
             # process-spawn/init time expire the window before the first
