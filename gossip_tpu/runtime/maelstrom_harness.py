@@ -265,8 +265,9 @@ async def run_broadcast_workload(n: int, ops: int, rate: float = 50.0,
             # cut a REAL edge near the middle of the cluster — consecutive
             # ids are only adjacent in the line topology; on a grid an
             # arbitrary pair is usually not an edge and the cut would drop
-            # nothing while still reporting partitioned=true
-            a = next(nid for nid in h.ids[n // 2:] + h.ids if topo[nid])
+            # nothing while still reporting partitioned=true (both built
+            # families give every middle node a neighbor at n >= 2)
+            a = h.ids[n // 2]
             b = topo[a][0]
             # cut the middle third of the send window, anchored NOW (the
             # send loop starts now) — anchoring at loop start would let

@@ -453,3 +453,45 @@ def test_cli_checkpoint_resume_and_profile(tmp_path):
     rep = json.loads(p.stdout)
     assert rep["profile_logdir"] == prof
     assert os.path.isdir(prof) and any(os.scandir(prof))
+
+
+def test_rpc_sidecar_runs_rumor_mode():
+    """The new SIR family is reachable through the service seam with its
+    extinction metadata intact."""
+    from gossip_tpu.rpc.sidecar import SidecarClient, serve
+    server, port = serve(port=0, max_workers=2)
+    try:
+        client = SidecarClient(f"127.0.0.1:{port}")
+        rep = client.run(proto={"mode": "rumor", "rumor_k": 2,
+                                "rumor_variant": "blind"},
+                         topology={"family": "complete", "n": 1024},
+                         run={"max_rounds": 128})
+        assert rep["mode"] == "rumor"
+        assert rep["meta"]["terminated"] is True
+        assert rep["meta"]["variant"] == "blind"
+        assert 0 < rep["coverage"] <= 1.0
+    finally:
+        server.stop(0)
+
+
+def test_bench_hermetic_env_preserves_pythonpath(monkeypatch, tmp_path):
+    """The wedged-tunnel CPU fallback must drop ONLY sitecustomize-bearing
+    PYTHONPATH entries (the axon trigger), not dependency paths."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(_REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    keepdir = tmp_path / "deps"
+    axondir = tmp_path / "axon"
+    keepdir.mkdir()
+    axondir.mkdir()
+    (axondir / "sitecustomize.py").write_text("")
+    monkeypatch.setenv("PYTHONPATH",
+                       os.pathsep.join([str(keepdir), str(axondir)]))
+    env = bench._hermetic_cpu_env()
+    parts = env["PYTHONPATH"].split(os.pathsep)
+    assert parts[0] == _REPO
+    assert str(keepdir) in parts
+    assert str(axondir) not in parts
+    assert env["JAX_PLATFORMS"] == "cpu"

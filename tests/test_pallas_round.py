@@ -331,3 +331,56 @@ def test_mr_staged_big_path_multiblock_grid(monkeypatch):
                                        inject_bits=(sbits, rbits))
     got = PR._fused_mr_round_big(table, 0, 0, n, not ON_TPU, (sbits, rbits))
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.skipif(not ON_TPU, reason="hw PRNG path needs a real TPU "
+                    "(interpreter stubs prng_random_bits with zeros)")
+class TestHardwarePRNGStagedBigPath:
+    """Statistical checks of the STAGED big-table path's hw-PRNG scheme —
+    the per-block seed fold is new code with its own randomness shape
+    (one stream per grid block instead of one (rows,128) draw)."""
+
+    def test_block_streams_are_distinct(self):
+        """All-rows-identical table: the rotation is a no-op and each
+        output block is a pure function of its own block's lane draws —
+        if the per-block seed fold degenerated (same stream per block),
+        block outputs would repeat with the grid period."""
+        from gossip_tpu.ops.pallas_round import (_MR_GATHER_BLOCK,
+                                                 _fused_mr_round_big)
+        rows = 4 * _MR_GATHER_BLOCK              # 4 exact grid blocks
+        n = rows * LANES
+        rng = np.random.default_rng(0)
+        row = rng.integers(0, 2**32, size=(1, LANES), dtype=np.uint32)
+        table = jnp.asarray(np.repeat(row, rows, axis=0))
+        out = np.asarray(_fused_mr_round_big(table, 0, 1, n, False, None))
+        blocks = out.reshape(4, _MR_GATHER_BLOCK, LANES)
+        assert not np.array_equal(blocks[0], blocks[1])
+        assert not np.array_equal(blocks[1], blocks[2])
+        assert not np.array_equal(blocks[2], blocks[3])
+        # determinism on the same (seed, round)
+        out2 = np.asarray(_fused_mr_round_big(table, 0, 1, n, False, None))
+        np.testing.assert_array_equal(out, out2)
+        # distinct stream on the next round
+        out3 = np.asarray(_fused_mr_round_big(table, 0, 2, n, False, None))
+        assert not np.array_equal(out, out3)
+
+    def test_big_path_growth_at_flagship_scale(self):
+        """12 rounds at N=10M x 32 rumors through the real routing
+        (fused_multirumor_pull_round picks the staged path): per-rumor
+        populations must grow ~2x/round once past branching noise."""
+        from gossip_tpu.ops.pallas_round import (_mr_wants_big,
+                                                 fused_table_bytes)
+        n = 10_000_000
+        assert _mr_wants_big(fused_table_bytes(n, 32), 1)   # routing sanity
+        st = init_multirumor_state(n, 32)
+        out = st.table
+        for r in range(1, 13):
+            out = fused_multirumor_pull_round(out, jnp.int32(0),
+                                              jnp.int32(r), n, 1)
+        flat = np.asarray(out).reshape(-1)[:n]
+        counts = np.array([int(((flat >> k) & np.uint32(1)).sum())
+                           for k in range(32)])
+        # mean over 32 independent rumors after 12 doublings from 1:
+        # E ~ 2^12; branching variance is tamed by averaging the rumors
+        assert 2**10 <= counts.mean() <= 2**14
+        assert (counts > 0).all()

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""One-shot hardware refresh: every measurement round 2 owes the chip.
+
+Run when the axon tunnel is healthy (probe first — see
+memory: a wedged tunnel hangs any jax init):
+
+    timeout 3600 python tools/hw_refresh.py
+
+Steps (each prints a tagged JSON line; failures don't stop later steps):
+  1. staged big-table MR kernel validation at 10M x 32 rumors
+     (post-padding variant) + per-round timing
+  2. the five BASELINE configs at full scale
+     -> artifacts/baseline_sweep_r02b.jsonl
+  3. bench.py headline
+  4. TPU-only pallas statistics tests
+     -> artifacts/tpu_pallas_tests_r02b.txt
+
+Afterwards update README.md's hardware table and docs/PERF.md's pending
+numbers from the printed lines.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def step(tag, fn):
+    t0 = time.time()
+    try:
+        out = fn()
+        print(json.dumps({"step": tag, "ok": True,
+                          "wall_s": round(time.time() - t0, 1),
+                          "result": out}), flush=True)
+    except Exception as e:  # keep going; later steps still run
+        print(json.dumps({"step": tag, "ok": False,
+                          "wall_s": round(time.time() - t0, 1),
+                          "error": f"{type(e).__name__}: {e}"[:500]}),
+              flush=True)
+
+
+def mr_staged_10m():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gossip_tpu.ops.pallas_round import (fused_multirumor_pull_round,
+                                             init_multirumor_state)
+    n = 10_000_000
+    st = init_multirumor_state(n, 32)
+    jax.block_until_ready(st.table)
+    t0 = time.perf_counter()
+    out = fused_multirumor_pull_round(st.table, jnp.int32(0), jnp.int32(1),
+                                      n, 1)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for r in range(2, 22):
+        out = fused_multirumor_pull_round(out, jnp.int32(0), jnp.int32(r),
+                                          n, 1)
+    jax.block_until_ready(out)
+    per_round_ms = (time.perf_counter() - t0) / 20 * 1e3
+    flat = np.asarray(out).reshape(-1)[:n]
+    counts = [int(((flat >> k) & np.uint32(1)).sum()) for k in range(32)]
+    return {"compile_s": round(compile_s, 2),
+            "per_round_ms": round(per_round_ms, 3),
+            "mean_count_after_21": sum(counts) / 32,
+            "all_rumors_growing": all(c > 64 for c in counts)}
+
+
+def baseline_sweep():
+    art = os.path.join(REPO, "artifacts", "baseline_sweep_r02b.jsonl")
+    p = subprocess.run([sys.executable, "-m", "gossip_tpu", "sweep",
+                        "--scale", "1.0"],
+                       capture_output=True, text=True, timeout=2400,
+                       cwd=REPO)
+    if p.returncode != 0:
+        raise RuntimeError(p.stderr[-400:])
+    with open(art, "w") as f:
+        f.write(p.stdout)
+    rows = [json.loads(line) for line in p.stdout.splitlines()]
+    return [{"config": r["config"], "rounds": r["rounds"],
+             "coverage": round(r["coverage"], 4), "wall_s": r["wall_s"],
+             "engine": r.get("meta", {}).get("engine")}
+            for r in rows]
+
+
+def bench():
+    # must outlast bench.py's own worst case: 240 s probe + 3000 s body
+    # + 1500 s hermetic retry
+    p = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       capture_output=True, text=True, timeout=5100,
+                       cwd=REPO)
+    if p.returncode != 0:
+        raise RuntimeError((p.stderr or p.stdout)[-400:])
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
+def tpu_pallas_tests():
+    art = os.path.join(REPO, "artifacts", "tpu_pallas_tests_r02b.txt")
+    # conftest pins tests to CPU unless this var points at the chip
+    env = {**os.environ, "GOSSIP_TPU_TEST_PLATFORM": "axon"}
+    p = subprocess.run([sys.executable, "-m", "pytest",
+                        "tests/test_pallas.py", "tests/test_pallas_round.py",
+                        "-q"],
+                       capture_output=True, text=True, timeout=2400,
+                       cwd=REPO, env=env)
+    with open(art, "w") as f:
+        f.write(p.stdout + "\n--- stderr ---\n" + p.stderr[-2000:])
+    tail = p.stdout.strip().splitlines()[-1] if p.stdout.strip() else ""
+    if p.returncode != 0:
+        raise RuntimeError(tail)
+    return tail
+
+
+def main():
+    step("mr_staged_10m", mr_staged_10m)
+    step("baseline_sweep", baseline_sweep)
+    step("bench", bench)
+    step("tpu_pallas_tests", tpu_pallas_tests)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
