@@ -159,17 +159,31 @@ def main():
         """(returncode-or-None, stdout).  The child's stdout is CAPTURED
         and only the final JSON line is re-emitted on success — so a body
         that prints its line and then wedges in teardown, or fails fast
-        after printing nothing, can never break the one-line contract."""
+        after printing nothing, can never break the one-line contract.
+        On timeout the output captured SO FAR is returned: a completed
+        measurement whose process wedged in teardown still counts."""
+        def _text(x):
+            return ("" if x is None
+                    else x if isinstance(x, str)
+                    else x.decode(errors="replace"))
         try:
             p = subprocess.run(body_cmd, env=env, timeout=timeout,
                                capture_output=True, text=True)
-            sys.stderr.write(p.stderr)
-            return p.returncode, p.stdout
+            sys.stderr.write(_text(p.stderr))
+            return p.returncode, _text(p.stdout)
         except subprocess.TimeoutExpired as e:
-            if e.stderr:
-                sys.stderr.write(e.stderr if isinstance(e.stderr, str)
-                                 else e.stderr.decode(errors="replace"))
-            return None, ""
+            sys.stderr.write(_text(e.stderr))
+            return None, _text(e.stdout)
+
+    def final_json_line(out):
+        lines = [line for line in out.splitlines() if line.strip()]
+        if not lines:
+            return None
+        try:
+            json.loads(lines[-1])
+        except json.JSONDecodeError:
+            return None
+        return lines[-1]
 
     try:
         subprocess.run(probe, timeout=240, check=True,
@@ -182,15 +196,22 @@ def main():
         ambient_ok = False
         env = _hermetic_cpu_env()
     rc, out = run_body(env, 3000)
-    if rc != 0 and ambient_ok:
-        # the tunnel died BETWEEN the probe and the body — hang (rc None)
-        # or fast init failure (rc nonzero) alike; one hermetic retry
+    line = final_json_line(out)
+    if line is None and rc != 0 and ambient_ok:
+        # no measurement AND the body died on the ambient platform — the
+        # tunnel wedged between probe and body (hang: rc None; fast init
+        # failure: rc nonzero); one hermetic retry
         print(f"bench: body failed on the ambient platform (rc={rc}); "
               "retrying on hermetic CPU", file=sys.stderr)
         rc, out = run_body(_hermetic_cpu_env(), 1500)
-    lines = [line for line in out.splitlines() if line.strip()]
-    if rc == 0 and lines:
-        print(lines[-1])
+        line = final_json_line(out)
+    if line is not None:
+        # a parsable measurement line is THE success criterion: a body
+        # that completed and then wedged/died in teardown still counts
+        if rc != 0:
+            print(f"bench: body exited abnormally (rc={rc}) after "
+                  "emitting its measurement; keeping it", file=sys.stderr)
+        print(line)
         return 0
     # keep the one-JSON-line contract even in total failure
     print(json.dumps({

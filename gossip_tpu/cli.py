@@ -147,10 +147,10 @@ def cmd_run(a) -> int:
     from gossip_tpu.backend import run_simulation
     proto, tc, run, fault, mesh = _args_to_configs(a)
     if a.ensemble > 1:
-        if a.backend != "jax-tpu" or a.mode in ("swim", "rumor"):
-            print("error: --ensemble needs the jax-tpu backend and an "
-                  "SI mode (not swim/rumor — their state machines are "
-                  "not in the vmapped SI sweep)", file=sys.stderr)
+        if a.backend != "jax-tpu" or a.mode == "swim":
+            print("error: --ensemble needs the jax-tpu backend and a "
+                  "non-swim mode (SWIM's detection metric has no "
+                  "seed-ensemble form)", file=sys.stderr)
             return 2
         if run.engine == "fused":
             # never silently substitute the XLA kernels for a requested
@@ -158,11 +158,17 @@ def cmd_run(a) -> int:
             print("error: --ensemble runs the threefry XLA kernels; "
                   "--engine fused is single-run only", file=sys.stderr)
             return 2
-        from gossip_tpu.parallel.sweep import ensemble_curves
+        from gossip_tpu.parallel.sweep import (ensemble_curves,
+                                               ensemble_rumor_curves)
         from gossip_tpu.topology import generators as G
-        ens = ensemble_curves(proto, G.build(tc), run,
-                              [run.seed + i for i in range(a.ensemble)],
-                              fault)
+        seeds = [run.seed + i for i in range(a.ensemble)]
+        if a.mode == "rumor":
+            # SIR: residue/extinction DISTRIBUTIONS across seeds (the
+            # Demers-table form of the result)
+            ens = ensemble_rumor_curves(proto, G.build(tc), run, seeds,
+                                        fault)
+        else:
+            ens = ensemble_curves(proto, G.build(tc), run, seeds, fault)
         out = {"ensemble": ens.summary(), "mode": a.mode, "n": tc.n,
                "backend": a.backend}
         if a.save_curve:

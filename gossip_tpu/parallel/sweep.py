@@ -466,3 +466,106 @@ def config_sweep_curves(points, topo: Topology, run: RunConfig,
                              rounds_to_target=_rounds_to_target(
                                  curves, run.target_coverage),
                              target=run.target_coverage)
+
+
+# -- SIR rumor-mongering ensembles -----------------------------------------
+#
+# The classic rumor-mongering results (Demers et al. §1.4's tables) are
+# DISTRIBUTIONS: residue and extinction time vary seed-to-seed because the
+# whole process is a branching process near its critical point early on.
+# One vmapped scan = |seeds| independent SIR trajectories in one XLA
+# program, same shape as ensemble_curves but carrying the SIR state.
+
+@dataclasses.dataclass
+class RumorEnsembleResult:
+    curves: np.ndarray             # float32[S, T] coverage per seed/round
+    hot: np.ndarray                # float32[S, T] infective fraction
+    msgs: np.ndarray               # float32[S, T]
+    target: float
+
+    @property
+    def extinction_rounds(self) -> np.ndarray:
+        """int[S]: first round with no hot pair (+1), -1 if none."""
+        out = np.full(self.hot.shape[0], -1, np.int64)
+        for i, h in enumerate(self.hot):
+            idx = np.nonzero(h == 0.0)[0]
+            if len(idx):
+                out[i] = idx[0] + 1
+        return out
+
+    @property
+    def residues(self) -> np.ndarray:
+        return 1.0 - self.curves[:, -1]
+
+    def summary(self) -> dict:
+        ext = self.extinction_rounds
+        done = ext >= 0
+        # residue is an AT-EXTINCTION statistic: truncated (still-hot at
+        # max_rounds) seeds would contribute transient not-yet-informed
+        # mass and inflate the distribution, so they are excluded here —
+        # like the extinction stats; raise max_rounds if terminated <
+        # seeds
+        res = self.residues[done]
+        return {
+            "seeds": int(len(ext)),
+            "terminated": int(done.sum()),
+            "extinction_rounds_mean": (float(ext[done].mean())
+                                       if done.any() else None),
+            "extinction_rounds_p95": (float(np.percentile(ext[done], 95))
+                                      if done.any() else None),
+            "residue_mean": float(res.mean()) if len(res) else None,
+            "residue_p50": float(np.median(res)) if len(res) else None,
+            "residue_p95": (float(np.percentile(res, 95))
+                            if len(res) else None),
+            "residue_max": float(res.max()) if len(res) else None,
+            "coverage_mean": float(self.curves[:, -1].mean()),
+            "msgs_mean": float(self.msgs[:, -1].mean()),
+            "target": self.target,
+        }
+
+
+def ensemble_rumor_curves(proto: ProtocolConfig, topo: Topology,
+                          run: RunConfig, seeds: Sequence[int],
+                          fault: Optional[FaultConfig] = None
+                          ) -> RumorEnsembleResult:
+    """|seeds| independent SIR trajectories as ONE batched XLA program.
+    Per-seed trajectories are bitwise identical to solo
+    models/rumor.simulate_curve_rumor runs with the same seed (tested)."""
+    from gossip_tpu.models.rumor import (RumorState, init_rumor_state,
+                                         make_rumor_round, rumor_coverage)
+    step, tables = make_rumor_round(proto, topo, fault, run.origin,
+                                    tabled=True)
+    base = init_rumor_state(run, proto, topo.n)
+    keys = jax.vmap(jax.random.key)(jnp.asarray(list(seeds), jnp.uint32))
+    s = len(seeds)
+    init = RumorState(
+        seen=jnp.broadcast_to(base.seen, (s,) + base.seen.shape),
+        hot=jnp.broadcast_to(base.hot, (s,) + base.hot.shape),
+        cnt=jnp.broadcast_to(base.cnt, (s,) + base.cnt.shape),
+        round=jnp.zeros((s,), jnp.int32),
+        base_key=keys,
+        msgs=jnp.zeros((s,), jnp.float32),
+    )
+
+    @jax.jit
+    def scan(states, *tbl):
+        alive = alive_mask(fault, topo.n, run.origin)
+        hot_w = (None if alive is None else alive.astype(jnp.float32))
+
+        def one_metrics(st):
+            hot_any = jnp.any(st.hot, axis=1).astype(jnp.float32)
+            frac = (jnp.mean(hot_any) if hot_w is None
+                    else jnp.sum(hot_any * hot_w) / jnp.sum(hot_w))
+            return rumor_coverage(st.seen, alive), frac, st.msgs
+
+        def body(st, _):
+            st = jax.vmap(lambda x: step(x, *tbl))(st)
+            covs, hots, msgs = jax.vmap(one_metrics)(st)
+            return st, (covs, hots, msgs)
+        return jax.lax.scan(body, states, None, length=run.max_rounds)
+
+    _, (covs, hots, msgs) = scan(init, *tables)
+    return RumorEnsembleResult(curves=np.asarray(covs).T,
+                               hot=np.asarray(hots).T,
+                               msgs=np.asarray(msgs).T,
+                               target=run.target_coverage)

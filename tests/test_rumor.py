@@ -195,3 +195,28 @@ def test_sharded_rumor_until_matches_single():
     assert rep.meta["devices"] == 8
     assert rep.meta["terminated"] is True
     assert rep.rounds == single[0]
+
+
+def test_rumor_seed_ensemble_matches_solo_trajectories():
+    """One vmapped XLA program = |seeds| SIR trajectories, each bitwise
+    equal to its solo scan; residue/extinction stats come out."""
+    from gossip_tpu.parallel.sweep import ensemble_rumor_curves
+    proto = ProtocolConfig(mode="rumor", fanout=1, rumor_k=2)
+    topo = G.complete(1024)
+    run = RunConfig(max_rounds=96, seed=3)
+    seeds = [3, 4, 5, 6]
+    ens = ensemble_rumor_curves(proto, topo, run, seeds)
+    assert ens.curves.shape == (4, 96)
+    s = ens.summary()
+    assert s["terminated"] == 4
+    assert 0.0 <= s["residue_p95"] <= 1.0
+    assert s["extinction_rounds_mean"] > 0
+    # row 1 (seed 4) must equal the solo curve driver with seed 4
+    solo_covs, solo_hots, solo_msgs, _ = simulate_curve_rumor(
+        proto, topo, RunConfig(max_rounds=96, seed=4))
+    np.testing.assert_array_equal(ens.curves[1], np.asarray(solo_covs))
+    np.testing.assert_array_equal(ens.hot[1], np.asarray(solo_hots))
+    np.testing.assert_array_equal(ens.msgs[1], np.asarray(solo_msgs))
+    # extinction round of row 1 agrees with the solo hot curve
+    idx = np.nonzero(np.asarray(solo_hots) == 0.0)[0]
+    assert ens.extinction_rounds[1] == idx[0] + 1

@@ -42,7 +42,10 @@ def step(tag, fn):
               flush=True)
 
 
-def mr_staged_10m():
+def _mr_staged_body():
+    """Runs in a SUBPROCESS: the axon tunnel is single-client, so the
+    parent must never hold a jax TPU client while later steps spawn
+    their own (they would hang on the busy tunnel)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -65,10 +68,21 @@ def mr_staged_10m():
     per_round_ms = (time.perf_counter() - t0) / 20 * 1e3
     flat = np.asarray(out).reshape(-1)[:n]
     counts = [int(((flat >> k) & np.uint32(1)).sum()) for k in range(32)]
-    return {"compile_s": round(compile_s, 2),
-            "per_round_ms": round(per_round_ms, 3),
-            "mean_count_after_21": sum(counts) / 32,
-            "all_rumors_growing": all(c > 64 for c in counts)}
+    print(json.dumps({"compile_s": round(compile_s, 2),
+                      "per_round_ms": round(per_round_ms, 3),
+                      "mean_count_after_21": sum(counts) / 32,
+                      "all_rumors_growing": all(c > 64 for c in counts)}))
+    return 0
+
+
+def mr_staged_10m():
+    p = subprocess.run([sys.executable, os.path.abspath(__file__),
+                        "--mr-body"],
+                       capture_output=True, text=True, timeout=1200,
+                       cwd=REPO)
+    if p.returncode != 0:
+        raise RuntimeError((p.stderr or p.stdout)[-400:])
+    return json.loads(p.stdout.strip().splitlines()[-1])
 
 
 def baseline_sweep():
@@ -125,4 +139,6 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--mr-body" in sys.argv:
+        sys.exit(_mr_staged_body())
     sys.exit(main())
