@@ -176,14 +176,17 @@ def main():
             return None, _text(e.stdout)
 
     def final_json_line(out):
-        lines = [line for line in out.splitlines() if line.strip()]
-        if not lines:
-            return None
-        try:
-            json.loads(lines[-1])
-        except json.JSONDecodeError:
-            return None
-        return lines[-1]
+        # scan BACKWARDS for the last parsable line: teardown noise
+        # printed after the measurement must not discard it
+        for line in reversed(out.splitlines()):
+            if not line.strip():
+                continue
+            try:
+                json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            return line
+        return None
 
     try:
         subprocess.run(probe, timeout=240, check=True,

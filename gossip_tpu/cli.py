@@ -418,12 +418,19 @@ def cmd_maelstrom(a) -> int:
 
 
 def cmd_maelstrom_check(a) -> int:
-    import asyncio
+    if a.router == "native":
+        from gossip_tpu.runtime.native_router import run_native_workload
+        stats = run_native_workload(
+            a.n, a.ops, rate=a.rate, latency=a.latency,
+            topology=a.topology, partition_mid=a.partition, seed=a.seed)
+    else:
+        import asyncio
 
-    from gossip_tpu.runtime.maelstrom_harness import run_broadcast_workload
-    stats = asyncio.run(run_broadcast_workload(
-        a.n, a.ops, rate=a.rate, latency=a.latency, topology=a.topology,
-        partition_mid=a.partition, seed=a.seed))
+        from gossip_tpu.runtime.maelstrom_harness import (
+            run_broadcast_workload)
+        stats = asyncio.run(run_broadcast_workload(
+            a.n, a.ops, rate=a.rate, latency=a.latency,
+            topology=a.topology, partition_mid=a.partition, seed=a.seed))
     print(json.dumps(stats))
     return 0 if stats["invariant_ok"] else 1
 
@@ -504,6 +511,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="cut a mid-cluster link for the middle third of "
                         "the run (fault-tolerance variant)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--router", default="python",
+                   choices=("python", "native"),
+                   help="harness engine: the asyncio router or the C++ "
+                        "poll()-loop router (native/router.cpp, built on "
+                        "demand)")
     p.set_defaults(fn=cmd_maelstrom_check)
 
     a = ap.parse_args(argv)

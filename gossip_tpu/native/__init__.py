@@ -23,15 +23,20 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
-    # compile to a per-process temp path and os.replace into place, so
-    # concurrent builders (parallel pytest workers, two CLIs on a fresh
-    # checkout) can never interleave writes into a torn .so
-    tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
+def build_native(src: str, out: str, shared: bool = True) -> bool:
+    """One g++ invocation: compile ``src`` to ``out`` (shared lib or
+    binary) via a per-process temp path + os.replace, so concurrent
+    builders (parallel pytest workers, two CLIs on a fresh checkout) can
+    never interleave writes into a torn artifact.  Shared by the event
+    sim (.so) and the native router (binary)."""
+    tmp = f"{out}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-std=c++17"]
+    if shared:
+        cmd += ["-shared", "-fPIC"]
+    cmd += [src, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO)
+        os.replace(tmp, out)
         return True
     except (subprocess.SubprocessError, FileNotFoundError, OSError):
         try:
@@ -41,6 +46,16 @@ def _build() -> bool:
         return False
 
 
+def native_fresh(src: str, out: str) -> bool:
+    """True when ``out`` exists and is at least as new as ``src``."""
+    return (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src))
+
+
+def _build() -> bool:
+    return build_native(_SRC, _SO, shared=True)
+
+
 def load_eventsim() -> Optional[ctypes.CDLL]:
     """Load (building if needed) the event-sim core; None if unavailable."""
     global _lib, _tried
@@ -48,9 +63,7 @@ def load_eventsim() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        fresh = (os.path.exists(_SO)
-                 and os.path.getmtime(_SO) >= os.path.getmtime(_SRC))
-        if not fresh and not _build():
+        if not native_fresh(_SRC, _SO) and not _build():
             return None
         try:
             lib = ctypes.CDLL(_SO)

@@ -1,0 +1,60 @@
+"""Native C++ mini-Maelstrom router (native/router.cpp) — the L-1
+harness twin, driven against the REAL protocol-node processes."""
+
+import shutil
+
+import pytest
+
+from gossip_tpu.runtime.native_router import (build_router,
+                                              run_native_workload)
+
+needs_gxx = pytest.mark.skipif(shutil.which("g++") is None,
+                               reason="no C++ compiler")
+
+
+@needs_gxx
+def test_native_router_broadcast_workload():
+    stats = run_native_workload(4, ops=8, rate=100.0, latency=0.001,
+                                seed=2)
+    assert stats["engine"] == "native-router"
+    assert stats["invariant_ok"] is True
+    assert stats["broadcast_ops"] == 8
+    assert stats["msgs_per_op"] > 0
+    assert stats["op_latency_ms"]["p99"] >= stats["op_latency_ms"]["p50"] > 0
+
+
+@needs_gxx
+def test_native_router_partition_heals():
+    stats = run_native_workload(4, ops=10, rate=25.0, latency=0.001,
+                                partition_mid=True, seed=3)
+    assert stats["invariant_ok"] is True
+    assert stats["partitioned"] is True
+
+
+@needs_gxx
+def test_native_router_grid_topology():
+    stats = run_native_workload(6, ops=6, rate=50.0, latency=0.001,
+                                topology="grid", seed=1)
+    assert stats["invariant_ok"] is True
+    # grid degree > line degree -> flood traffic per op must be higher
+    line = run_native_workload(6, ops=6, rate=50.0, latency=0.001,
+                               topology="line", seed=1)
+    assert stats["msgs_per_op"] > line["msgs_per_op"]
+
+
+@needs_gxx
+def test_native_and_python_harness_agree_on_the_contract():
+    """Same workload shape through both engines: both must satisfy the
+    invariant and report the same stats schema (values differ — the op
+    target streams are engine-local RNG)."""
+    import asyncio
+
+    from gossip_tpu.runtime.maelstrom_harness import run_broadcast_workload
+    nat = run_native_workload(3, ops=6, rate=100.0, latency=0.001, seed=0)
+    py = asyncio.run(run_broadcast_workload(3, ops=6, rate=100.0,
+                                            latency=0.001, seed=0))
+    for k in ("broadcast_ops", "msgs_per_op", "op_latency_ms",
+              "invariant_ok", "values", "partitioned"):
+        assert k in nat and k in py
+    assert nat["invariant_ok"] and py["invariant_ok"]
+    assert build_router() is not None

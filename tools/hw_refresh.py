@@ -76,10 +76,14 @@ def _mr_staged_body():
 
 
 def mr_staged_10m():
+    # run-by-path puts tools/ (not the repo root) on the child's
+    # sys.path; gossip_tpu needs an explicit PYTHONPATH entry
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     p = subprocess.run([sys.executable, os.path.abspath(__file__),
                         "--mr-body"],
                        capture_output=True, text=True, timeout=1200,
-                       cwd=REPO)
+                       cwd=REPO, env=env)
     if p.returncode != 0:
         raise RuntimeError((p.stderr or p.stdout)[-400:])
     return json.loads(p.stdout.strip().splitlines()[-1])
