@@ -117,14 +117,58 @@ def body():
     # mesh in tests/test_packed.py).
     n_chips = 1
     rate = n * rounds / dt / n_chips
-    print(json.dumps({
+    print(json.dumps(measurement_line(rate, backend, n, variant, rounds, dt)))
+    return 0
+
+
+def measurement_line(rate, backend, n, variant, rounds, dt):
+    """The one-JSON-line scoreboard contract (tests/test_bench_contract.py).
+
+    ``vs_baseline`` compares against a TPU-derived north-star rate, so it
+    is only meaningful for a TPU measurement: off-TPU it is ``null`` and
+    the machine-readable ``backend`` field says what actually ran — a CPU
+    fallback can never masquerade as a TPU perf regression/improvement
+    (the round-2 scoreboard read a wedged-tunnel CPU fallback as 0.21x)."""
+    on_tpu = backend == "tpu"
+    return {
         "metric": "node_rounds_per_sec_per_chip",
         "value": round(rate, 1),
         "unit": f"node-rounds/s/chip (N={n}, {variant} to 99% in "
                 f"{rounds} rounds, {dt*1e3:.1f} ms, backend={backend})",
-        "vs_baseline": round(rate / BASELINE_NODE_ROUNDS_PER_SEC_PER_CHIP, 4),
-    }))
-    return 0
+        "vs_baseline": (round(rate / BASELINE_NODE_ROUNDS_PER_SEC_PER_CHIP, 4)
+                        if on_tpu else None),
+        "backend": backend,
+    }
+
+
+# Probe/body timeout constants, exported so tools/hw_refresh.py can
+# compute its outer budget from the same numbers the loops below use.
+PROBE_TIMEOUT_S = 240
+PROBE_SLEEP_S = 300
+BODY_TIMEOUT_S = 3000
+HERMETIC_RETRY_TIMEOUT_S = 1500
+
+
+def worst_case_budget_s():
+    """Upper bound on a full bench.py run: every probe times out, the
+    body uses its whole budget, and the hermetic retry runs too."""
+    attempts = probe_attempts_from_env()
+    return (attempts * PROBE_TIMEOUT_S + (attempts - 1) * PROBE_SLEEP_S
+            + BODY_TIMEOUT_S + HERMETIC_RETRY_TIMEOUT_S)
+
+
+def probe_attempts_from_env(default=3):
+    """GOSSIP_BENCH_PROBE_ATTEMPTS, hardened: malformed values fall back
+    to the default (never crash before the one-JSON-line contract can be
+    met) and the count is clamped to >= 1 so the TPU probe can never be
+    silently disabled."""
+    raw = os.environ.get("GOSSIP_BENCH_PROBE_ATTEMPTS", str(default))
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        print("bench: ignoring malformed GOSSIP_BENCH_PROBE_ATTEMPTS="
+              f"{raw!r}; using {default}", file=sys.stderr)
+        return default
 
 
 def _hermetic_cpu_env():
@@ -188,17 +232,43 @@ def main():
             return line
         return None
 
-    try:
-        subprocess.run(probe, timeout=240, check=True,
-                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-        ambient_ok = True
+    # Retry a timed-out probe before settling for the CPU fallback
+    # (round-2 lesson: one 240 s probe flipped the official scoreboard
+    # to a CPU number on a wedge that cleared later).  Caveats baked
+    # into the shape of the loop: killing a timed-out probe itself
+    # leaves a dead TPU-client process, which can PROLONG a wedge — so
+    # attempts are few and the sleeps long (a hard wedge lasts 1h+ and
+    # no in-budget retry policy beats it; the target is the transient
+    # kind).  Only a probe TIMEOUT (the wedge signature) is retried — a
+    # probe that fails fast (CalledProcessError: broken install, plugin
+    # import error) is deterministic, so fall back immediately.  Worst
+    # case at the default: 3 x 240 s probes + 2 x 300 s sleeps = 1320 s.
+    # GOSSIP_BENCH_PROBE_ATTEMPTS=1 restores the single-probe behavior.
+    probe_attempts = probe_attempts_from_env()
+    ambient_ok = False
+    for attempt in range(probe_attempts):
+        try:
+            subprocess.run(probe, timeout=PROBE_TIMEOUT_S, check=True,
+                           stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL)
+            ambient_ok = True
+            break
+        except subprocess.CalledProcessError:
+            print("bench: platform probe failed fast (broken ambient "
+                  "platform, not a wedge); no retries", file=sys.stderr)
+            break
+        except subprocess.TimeoutExpired:
+            print(f"bench: platform probe {attempt + 1}/{probe_attempts} "
+                  "timed out (wedged TPU tunnel?)", file=sys.stderr)
+            if attempt + 1 < probe_attempts:
+                time.sleep(PROBE_SLEEP_S)
+    if ambient_ok:
         env = dict(os.environ)
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
-        print("bench: ambient JAX platform unusable (wedged TPU tunnel?); "
-              "falling back to hermetic CPU", file=sys.stderr)
-        ambient_ok = False
+    else:
+        print("bench: ambient JAX platform unusable; falling back to "
+              "hermetic CPU", file=sys.stderr)
         env = _hermetic_cpu_env()
-    rc, out = run_body(env, 3000)
+    rc, out = run_body(env, BODY_TIMEOUT_S)
     line = final_json_line(out)
     if line is None and rc != 0 and ambient_ok:
         # no measurement AND the body died on the ambient platform — the
@@ -206,7 +276,7 @@ def main():
         # failure: rc nonzero); one hermetic retry
         print(f"bench: body failed on the ambient platform (rc={rc}); "
               "retrying on hermetic CPU", file=sys.stderr)
-        rc, out = run_body(_hermetic_cpu_env(), 1500)
+        rc, out = run_body(_hermetic_cpu_env(), HERMETIC_RETRY_TIMEOUT_S)
         line = final_json_line(out)
     if line is not None:
         # a parsable measurement line is THE success criterion: a body
@@ -216,12 +286,14 @@ def main():
                   "emitting its measurement; keeping it", file=sys.stderr)
         print(line)
         return 0
-    # keep the one-JSON-line contract even in total failure
+    # keep the one-JSON-line contract even in total failure; vs_baseline
+    # null + backend null: no TPU measurement happened (measurement_line
+    # contract)
     print(json.dumps({
         "metric": "node_rounds_per_sec_per_chip", "value": 0.0,
         "unit": f"bench body failed on every platform (rc={rc}; "
                 "wedged TPU tunnel?)",
-        "vs_baseline": 0.0}))
+        "vs_baseline": None, "backend": None}))
     return 1
 
 

@@ -145,6 +145,7 @@ def _args_to_configs(a):
 
 def cmd_run(a) -> int:
     from gossip_tpu.backend import run_simulation
+    from gossip_tpu.utils.trace import trace   # trace(None) is a no-op
     proto, tc, run, fault, mesh = _args_to_configs(a)
     if a.ensemble > 1:
         if a.backend != "jax-tpu" or a.mode == "swim":
@@ -162,15 +163,19 @@ def cmd_run(a) -> int:
                                                ensemble_rumor_curves)
         from gossip_tpu.topology import generators as G
         seeds = [run.seed + i for i in range(a.ensemble)]
-        if a.mode == "rumor":
-            # SIR: residue/extinction DISTRIBUTIONS across seeds (the
-            # Demers-table form of the result)
-            ens = ensemble_rumor_curves(proto, G.build(tc), run, seeds,
-                                        fault)
-        else:
-            ens = ensemble_curves(proto, G.build(tc), run, seeds, fault)
+        with trace(a.profile):
+            if a.mode == "rumor":
+                # SIR: residue/extinction DISTRIBUTIONS across seeds (the
+                # Demers-table form of the result)
+                ens = ensemble_rumor_curves(proto, G.build(tc), run,
+                                            seeds, fault)
+            else:
+                ens = ensemble_curves(proto, G.build(tc), run, seeds,
+                                      fault)
         out = {"ensemble": ens.summary(), "mode": a.mode, "n": tc.n,
                "backend": a.backend}
+        if a.profile:
+            out["profile_logdir"] = a.profile
         if a.save_curve:
             # per-round ensemble band: mean / min / max over seeds
             from gossip_tpu.utils.metrics import dump_curve_jsonl
@@ -196,18 +201,10 @@ def cmd_run(a) -> int:
                   "with no per-round curve capture; drop --curve/"
                   "--save-curve", file=sys.stderr)
             return 2
-        if a.profile:
-            from gossip_tpu.utils.trace import trace
-            with trace(a.profile):
-                return _cmd_run_checkpointed(a, proto, tc, run, fault, mesh)
-        return _cmd_run_checkpointed(a, proto, tc, run, fault, mesh)
-    want_curve = a.curve or bool(a.save_curve)
-    if a.profile:
-        from gossip_tpu.utils.trace import trace
         with trace(a.profile):
-            report = run_simulation(a.backend, proto, tc, run, fault, mesh,
-                                    want_curve=want_curve)
-    else:
+            return _cmd_run_checkpointed(a, proto, tc, run, fault, mesh)
+    want_curve = a.curve or bool(a.save_curve)
+    with trace(a.profile):
         report = run_simulation(a.backend, proto, tc, run, fault, mesh,
                                 want_curve=want_curve)
     out = report.to_dict()
@@ -287,6 +284,8 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
            "coverage": float(coverage(state.seen, alive)),
            "msgs": float(state.msgs), "checkpoint": a.checkpoint,
            "checkpoint_every": a.checkpoint_every, "resumed": resumed}
+    if a.profile:
+        out["profile_logdir"] = a.profile
     print(json.dumps(out))
     return 0
 
@@ -322,12 +321,14 @@ def baseline_configs(scale: float, devices: int):
         # BASELINE.json configs[4]: "10M-node multi-rumor broadcast,
         # node-dim sharded".  Mode pull: on a multi-chip mesh the node
         # dimension shards across devices; on one chip engine='auto'
-        # routes to the fused Pallas multi-rumor kernel.
+        # routes to the fused Pallas multi-rumor kernel.  revision=2
+        # records the round-2 mode change (pushpull -> pull) so old and
+        # new sweep artifacts are machine-distinguishable (ADVICE r2).
         dict(name="multirumor-10m-sharded", backend="jax-tpu",
              proto=ProtocolConfig(mode="pull", fanout=1, rumors=8),
              tc=TopologyConfig(family="complete", n=n5),
              run=RunConfig(max_rounds=64),
-             mesh=MeshConfig(n_devices=devices)),
+             mesh=MeshConfig(n_devices=devices), revision=2),
     ]
 
 
@@ -344,6 +345,10 @@ def cmd_sweep(a) -> int:
                                 want_curve=a.curve)
         out = report.to_dict()
         out["config"] = cfg["name"]
+        # bump a config's revision whenever its workload definition
+        # changes so sweep artifacts from different definitions can never
+        # be compared as if they measured the same thing
+        out["config_revision"] = cfg.get("revision", 1)
         if cfg.get("compare_gonative"):
             ref = run_simulation("go-native",
                                  ProtocolConfig(mode="flood"), cfg["tc"],

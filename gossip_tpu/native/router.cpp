@@ -368,6 +368,14 @@ int main(int argc, char** argv) {
     close(in_pipe[0]); close(out_pipe[1]);
     fcntl(out_pipe[0], F_SETFL, O_NONBLOCK);
     fcntl(in_pipe[1], F_SETFL, O_NONBLOCK);
+    // CLOEXEC: later-forked siblings must not inherit this node's
+    // parent-side pipe ends.  The load-bearing one is in_pipe[1] (the
+    // write end of this node's STDIN pipe): a sibling holding it would
+    // keep the node from ever seeing EOF after the router closes to_fd.
+    // (POLLHUP on a crashed node's stdout was never at risk — the
+    // parent closes out_pipe[1] above, before any later fork.)
+    fcntl(out_pipe[0], F_SETFD, FD_CLOEXEC);
+    fcntl(in_pipe[1], F_SETFD, FD_CLOEXEC);
     nd.pid = pid;
     nd.to_fd = in_pipe[1];
     nd.from_fd = out_pipe[0];

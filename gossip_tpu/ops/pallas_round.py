@@ -424,13 +424,23 @@ def _fused_mr_round_big(table: jax.Array, seed, round_, n: int,
         operands.append(rbits)
     kernel = functools.partial(_mr_gather_kernel, n=n, block=block,
                                inject=inject_bits is not None)
+    # Donate the table operand unless it is the CALLER's concrete array
+    # (block-aligned rows + eager invocation): donating that would
+    # invalidate the caller's buffer (ADVICE r2).  Under jit the operand
+    # is a tracer for a dead-after-this intermediate, so the alias is
+    # safe and buys the in-place round update the hot while_loop relies
+    # on (pallas_call lowers to a custom call — without the declared
+    # alias XLA cannot reuse the buffer and copies every round).
+    eager_caller_buffer = (table_p is table
+                           and not isinstance(table, jax.core.Tracer))
+    aliases = {} if eager_caller_buffer else {1: 0}
     out = pl.pallas_call(
         kernel,
         grid=(rows_pad // block,),
         out_shape=jax.ShapeDtypeStruct((rows_pad, LANES), jnp.uint32),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((block, LANES), lambda i: (i, 0)),
-        input_output_aliases={1: 0},
+        input_output_aliases=aliases,
         interpret=pltpu.InterpretParams() if interpret else False,
     )(*operands)
     return out[:rows] if rows_pad != rows else out

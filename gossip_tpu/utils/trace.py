@@ -12,13 +12,22 @@ import contextlib
 import time
 from typing import Iterator, Optional
 
-import jax
+# jax is imported lazily inside the helpers: cli.cmd_run imports this
+# module unconditionally, and the go-native/native-router paths must
+# stay runnable without ever touching jax (deferred-import pattern of
+# backend.py/cli.py).
 
 
 @contextlib.contextmanager
-def trace(logdir: str) -> Iterator[None]:
+def trace(logdir: Optional[str]) -> Iterator[None]:
     """Capture a jax.profiler trace of the enclosed block into ``logdir``
-    (TensorBoard's profile plugin / Perfetto read it)."""
+    (TensorBoard's profile plugin / Perfetto read it).  ``None``/empty
+    is a no-op (matching callers' ``if args.profile`` truthiness gates),
+    so callers can wrap unconditionally: ``with trace(args.profile):``."""
+    if not logdir:
+        yield
+        return
+    import jax
     jax.profiler.start_trace(logdir)
     try:
         yield
@@ -29,6 +38,7 @@ def trace(logdir: str) -> Iterator[None]:
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
     """Named region inside an active trace (host + device timeline)."""
+    import jax
     with jax.profiler.TraceAnnotation(name):
         yield
 

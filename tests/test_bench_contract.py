@@ -1,0 +1,60 @@
+"""bench.py scoreboard-line contract (VERDICT r2 item 9).
+
+A CPU fallback must never masquerade as a TPU perf number: off-TPU the
+``vs_baseline`` field is null and the machine-readable ``backend`` field
+records what ran.
+"""
+
+import importlib.util
+import json
+import os
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# repo-root module, not a package member: load by path so collection
+# works from any cwd (same pattern as test_backend_cli_rpc.py)
+_spec = importlib.util.spec_from_file_location(
+    "bench", os.path.join(_REPO, "bench.py"))
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+def test_tpu_measurement_carries_vs_baseline():
+    line = bench.measurement_line(
+        rate=3.2e9, backend="tpu", n=10_000_000,
+        variant="fused-pallas pull SI", rounds=26, dt=0.077)
+    assert line["backend"] == "tpu"
+    assert line["vs_baseline"] == round(
+        3.2e9 / bench.BASELINE_NODE_ROUNDS_PER_SEC_PER_CHIP, 4)
+    assert line["metric"] == "node_rounds_per_sec_per_chip"
+
+
+def test_cpu_fallback_has_null_vs_baseline():
+    line = bench.measurement_line(
+        rate=6.4e6, backend="cpu", n=500_000,
+        variant="bit-packed pull SI (XLA fallback)", rounds=27, dt=2.1)
+    assert line["vs_baseline"] is None
+    assert line["backend"] == "cpu"
+    # and the null survives the JSON trip the driver performs
+    assert json.loads(json.dumps(line))["vs_baseline"] is None
+
+
+def test_probe_attempts_env_hardening(monkeypatch):
+    monkeypatch.delenv("GOSSIP_BENCH_PROBE_ATTEMPTS", raising=False)
+    assert bench.probe_attempts_from_env() == 3
+    monkeypatch.setenv("GOSSIP_BENCH_PROBE_ATTEMPTS", "7")
+    assert bench.probe_attempts_from_env() == 7
+    # malformed -> default (never crash before the one-line contract)
+    monkeypatch.setenv("GOSSIP_BENCH_PROBE_ATTEMPTS", "2x")
+    assert bench.probe_attempts_from_env() == 3
+    # zero/negative can't silently disable the TPU probe
+    monkeypatch.setenv("GOSSIP_BENCH_PROBE_ATTEMPTS", "0")
+    assert bench.probe_attempts_from_env() == 1
+    monkeypatch.setenv("GOSSIP_BENCH_PROBE_ATTEMPTS", "-5")
+    assert bench.probe_attempts_from_env() == 1
+
+
+def test_line_is_json_serializable_and_flat():
+    line = bench.measurement_line(1.0, "cpu", 10, "x", 1, 1.0)
+    parsed = json.loads(json.dumps(line))
+    assert set(parsed) == {"metric", "value", "unit", "vs_baseline",
+                           "backend"}

@@ -2,9 +2,13 @@
 """One-shot hardware refresh: every measurement round 2 owes the chip.
 
 Run when the axon tunnel is healthy (probe first — see
-memory: a wedged tunnel hangs any jax init):
+memory: a wedged tunnel hangs any jax init).  The outer timeout must
+cover the sum of ALL per-step subprocess timeouts at their worst —
+1200 (mr) + 2400 (sweep) + bench's worst case (~6020 s at the default
+GOSSIP_BENCH_PROBE_ATTEMPTS=3; bench.worst_case_budget_s() gives the
+exact number for other settings) + 2400 (pallas tests) ≈ 12,100 s:
 
-    timeout 3600 python tools/hw_refresh.py
+    timeout 12600 python tools/hw_refresh.py      # default attempts
 
 Steps (each prints a tagged JSON line; failures don't stop later steps):
   1. staged big-table MR kernel validation at 10M x 32 rumors
@@ -107,10 +111,17 @@ def baseline_sweep():
 
 
 def bench():
-    # must outlast bench.py's own worst case: 240 s probe + 3000 s body
-    # + 1500 s hermetic retry
+    # must outlast bench.py's own worst case (probe retries + body +
+    # hermetic retry) — computed by bench.py itself from the same
+    # constants its loops use, so the budget can't drift
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    bench_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_mod)
+    budget = bench_mod.worst_case_budget_s() + 200
     p = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
-                       capture_output=True, text=True, timeout=5100,
+                       capture_output=True, text=True, timeout=budget,
                        cwd=REPO)
     if p.returncode != 0:
         raise RuntimeError((p.stderr or p.stdout)[-400:])
