@@ -439,12 +439,39 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
     if n_dev > 1 and _exchange == "sparse":
         from gossip_tpu.parallel.sharded import make_mesh
         from gossip_tpu.parallel.sharded_sparse import (
-            simulate_curve_sparse, simulate_until_sparse)
-        if tc.family != "complete":
-            raise ValueError(
-                "exchange='sparse' runs on the implicit complete topology "
-                f"only (got family {tc.family!r}); use dense or halo")
+            simulate_curve_sparse, simulate_curve_topo_sparse,
+            simulate_until_sparse, simulate_until_topo_sparse)
         mesh = make_mesh(n_dev)
+        if tc.family != "complete":
+            # Explicit topology: capacity-capped all_to_all by partner's
+            # owning shard (VERDICT r2 item 5) — pull only; the factory
+            # raises loudly for other modes (never silently densified).
+            t0 = time.perf_counter()
+            overflow = None
+            if want_curve:
+                covs, msgs, _, smeta, ovfs = simulate_curve_topo_sparse(
+                    proto, topo, run, mesh, fault)
+                wall = time.perf_counter() - t0
+                rounds, cov, msgs_f, curve = _curve_summary(
+                    covs, msgs, run.target_coverage)
+                overflow = float(ovfs[-1])
+            else:
+                (rounds, cov, msgs_f, _, smeta,
+                 overflow) = simulate_until_topo_sparse(
+                    proto, topo, run, mesh, fault)
+                wall = time.perf_counter() - t0
+                curve = None
+            return RunReport(
+                backend="jax-tpu", mode=proto.mode, n=tc.n, rounds=rounds,
+                coverage=cov, msgs=msgs_f, wall_s=round(wall, 4),
+                curve=curve,
+                meta={"clock": "rounds", "devices": n_dev,
+                      "msgs_counts": "transmissions", "exchange": "sparse",
+                      "overflow_dropped_requests": overflow,
+                      "bucket_cap": smeta.cap,
+                      "ici_bytes_per_round": {
+                          "sparse": smeta.sparse_bytes,
+                          "dense_equivalent": smeta.dense_bytes}})
         t0 = time.perf_counter()
         if want_curve:
             covs, msgs, _, smeta = simulate_curve_sparse(

@@ -360,48 +360,58 @@ def cmd_sweep(a) -> int:
 
 def cmd_grid(a) -> int:
     """Batched config sweep: the cartesian product of --modes/--fanouts/
-    --drops/--periods/--seeds runs as ONE compiled XLA program (the
-    north-star "sweep fanout, mode, ... across a pod" sentence —
+    --drops/--periods/--seeds — and, with --families, topology families —
+    runs as ONE compiled XLA program (the north-star "sweep fanout, mode,
+    and graph topology across a pod" sentence —
     parallel/sweep.config_sweep_curves).  --devices shards the config axis
     over a mesh; --pod-mesh S N runs the full 2-D (configs x node-shards)
-    shard_map program."""
+    shard_map program (single family only)."""
     from gossip_tpu.parallel.sweep import (SweepPoint, config_sweep_curves,
                                            config_sweep_curves_2d)
     from gossip_tpu.topology import generators as G
-    tc = TopologyConfig(family=a.family, n=a.n, k=a.k, p=a.p,
-                        degree_cap=a.degree_cap, seed=a.seed)
+    families = a.families or [a.family]
     run = RunConfig(target_coverage=a.target, max_rounds=a.max_rounds,
                     seed=a.seed)
     fault = (FaultConfig(node_death_rate=a.death, seed=a.seed)
              if a.death > 0 else None)
     points = [
         SweepPoint(mode=m, fanout=f, drop_prob=d,
-                   period=(p if m == "antientropy" else 1), seed=s)
+                   period=(p if m == "antientropy" else 1), seed=s,
+                   topo_idx=t)
+        for t in range(len(families))
         for m in a.modes for f in a.fanouts for d in a.drops
         for p in (a.periods if 'antientropy' in a.modes else [1])
         for s in a.seeds]
     # periods multiply only anti-entropy points; dedupe the rest
     points = list(dict.fromkeys(points))
+    topos = [G.build(TopologyConfig(family=f, n=a.n, k=a.k, p=a.p,
+                                    degree_cap=a.degree_cap, seed=a.seed))
+             for f in families]
+    topo_arg = topos if len(topos) > 1 else topos[0]
     if a.pod_mesh:
         # DCN-aware: configs (communication-free) ride the outer/slice
         # axis, node shards (O(N) collectives) stay intra-slice on ICI.
         from gossip_tpu.parallel.multislice import make_hybrid_mesh
         s, nd = a.pod_mesh
         mesh2d = make_hybrid_mesh(s, nd, axis_names=("sweep", "nodes"))
-        res = config_sweep_curves_2d(points, G.build(tc), run, mesh2d,
+        res = config_sweep_curves_2d(points, topo_arg, run, mesh2d,
                                      fault=fault, rumors=a.rumors)
     elif a.devices > 1:
         from gossip_tpu.parallel.sharded import make_mesh
-        res = config_sweep_curves(points, G.build(tc), run, fault=fault,
+        res = config_sweep_curves(points, topo_arg, run, fault=fault,
                                   rumors=a.rumors,
                                   mesh=make_mesh(a.devices,
                                                  axis_name="sweep"))
     else:
-        res = config_sweep_curves(points, G.build(tc), run, fault=fault,
-                                  rumors=a.rumors)
+        # single-device grids partition by mode bucket so pure buckets
+        # never pay the masked other half (falls through to the plain
+        # batch when the grid is single-bucket)
+        from gossip_tpu.parallel.sweep import config_sweep_curves_partitioned
+        res = config_sweep_curves_partitioned(points, topo_arg, run,
+                                              fault=fault, rumors=a.rumors)
     for i, summary in enumerate(res.summaries()):
         summary["n"] = a.n
-        summary["family"] = a.family
+        summary["family"] = families[points[i].topo_idx]
         if a.curve:
             summary["curve"] = [float(c) for c in res.curves[i]]
         print(json.dumps(summary), flush=True)
@@ -475,6 +485,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--family", default="complete",
                    choices=("complete", "ring", "grid", "erdos_renyi",
                             "watts_strogatz", "power_law"))
+    p.add_argument("--families", nargs="+", default=None,
+                   choices=("ring", "grid", "erdos_renyi",
+                            "watts_strogatz", "power_law"),
+                   help="sweep MULTIPLE same-n explicit families as one "
+                        "stacked table operand (overrides --family; the "
+                        "implicit complete graph has no table to stack)")
     p.add_argument("--k", type=int, default=4)
     p.add_argument("--p", type=float, default=0.01)
     p.add_argument("--degree-cap", type=int, default=None)

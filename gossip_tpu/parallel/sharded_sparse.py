@@ -69,6 +69,7 @@ SPARSE_PERM_TAG = 101
 SPARSE_OFFSET_TAG = 102
 SPARSE_ROW_TAG = 103
 SPARSE_DROP_TAG = 104
+TOPO_NBR_TAG = 105
 
 
 class SparseMeta(NamedTuple):
@@ -350,6 +351,333 @@ def init_sparse_state(run: RunConfig, proto: ProtocolConfig, n: int,
                     msgs=st.msgs)
 
 
+# ---------------------------------------------------------------------------
+# Explicit-topology sparse exchange (VERDICT r2 item 5)
+#
+# The complete-graph kernel above stratifies the partner draw BY
+# CONSTRUCTION (round-robin groups -> permuted shards), which is only
+# possible because every row is a legal partner.  With an explicit
+# neighbor table the partner of a slot is dictated by the graph
+# (``nbrs[i, j]`` for a uniform j < deg[i] — the batched analog of the
+# reference's per-neighbor RPC, /root/reference/main.go:81), so
+# per-(src,dst) counts are data-dependent.  Static shapes come instead
+# from CAPACITY-CAPPED buckets: each shard packs its requests into a
+# ``[P, cap]`` buffer by destination shard (owner of the partner row
+# under the equal row-block partition), in local slot order.  The
+# bucket rank is deterministic, so the rare slot that overflows its
+# bucket (cap defaults to the TABLE-DERIVED expected max load plus a
+# 4-sigma tail — auto_topo_cap) is DROPPED deterministically —
+# reproduced bit-for-bit by the single-device reference twin, counted
+# per round, and reported as the ``overflow`` output.  An overflowing
+# slot is a lost pull request for that round only — at-least-once
+# delivery comes from re-sampling every round, exactly like a dropped
+# link in FaultConfig.drop_prob.
+#
+# Traffic: per device per round ``P*cap*(4 + 4W)`` bytes vs the dense
+# packed all_gather's ``n_pad*4W`` (parallel/sharded_packed.py).  On
+# shard-uniform graphs (ER, shuffled power-law) cap ~ nl*k/P and the
+# drop is ~P*4W/(k*(4+4W)) — ~3.6x at P=8, W=1, k=1, linear in mesh
+# size and rumor words.  On banded graphs (WS rings) cap honestly grows
+# toward nl*k and the meta shows no win — halo exchange territory.
+
+
+def auto_topo_cap(nbrs, deg, nl: int, k: int, p: int,
+                  slack_sigma: float = 4.0, floor: int = 4) -> int:
+    """Static per-(src,dst) bucket capacity derived FROM THE TABLE.
+
+    The expected request load on bucket (s, d) is fixed by the graph:
+    ``E[s,d] = k * sum_{rows i in s} |nbrs(i) in d| / deg(i)``.  A
+    uniform balanced-load cap (2*nl*k/p) is catastrophically wrong for
+    banded graphs — on a Watts-Strogatz ring ~80% of every shard's
+    requests target the shard's OWN row block, overflowing a uniform
+    bucket ~4x over.  Instead the cap is ``max_{s,d} E + slack_sigma *
+    sqrt(maxE) + floor`` (the load is a sum of independent per-slot
+    Bernoulli draws, so sqrt(E) bounds its std): overflow stays rare on
+    ANY topology, and a banded graph honestly drives cap toward the slot
+    count ``nl*k`` — where SparseMeta reports no byte win over dense and
+    the halo exchange (parallel/halo.py) is the right tool instead.
+
+    ``nbrs``/``deg`` are the REAL (unpadded) host rows — padding rows
+    have degree 0 and contribute no load.  One O(N*D) numpy pass at
+    build time; no device round-trip of a padded copy."""
+    import numpy as np
+    nbrs = np.asarray(nbrs)
+    deg = np.asarray(deg)
+    n_rows, d_max = nbrs.shape
+    src = np.repeat(np.arange(n_rows) // nl, d_max)
+    valid = np.arange(d_max)[None, :] < deg[:, None]
+    dst = np.where(valid, nbrs // nl, 0).reshape(-1)
+    wts = np.where(valid, k / np.maximum(deg, 1)[:, None], 0.0).reshape(-1)
+    E = np.zeros((p, p))
+    np.add.at(E, (src, dst), wts)
+    max_e = float(E.max())
+    cap = math.ceil(max_e + slack_sigma * math.sqrt(max(max_e, 1.0))
+                    + floor)
+    return min(nl * k, max(1, cap))
+
+
+def resolve_topo_cap(topo, p: int, k: int,
+                     cap: Optional[int] = None) -> int:
+    """The capacity actually used by the topo-sparse kernels: an explicit
+    ``cap`` wins; otherwise :func:`auto_topo_cap` on the raw table."""
+    if cap is not None:
+        return cap
+    n_pad = math.ceil(topo.n / p) * p
+    return auto_topo_cap(topo.nbrs, topo.deg, n_pad // p, k, p)
+
+
+def sparse_topo_meta(n_pad: int, p: int, k: int, w: int,
+                     cap: int) -> SparseMeta:
+    """Traffic accounting for the explicit-topology sparse pull (dense
+    equivalent: the packed all_gather of parallel/sharded_packed.py)."""
+    return SparseMeta(p=p, cap=cap,
+                      request_bytes=p * cap * 4,
+                      response_bytes=p * cap * 4 * w,
+                      dense_bytes=n_pad * 4 * w)
+
+
+def _slot_nbr_choice(rkey: jax.Array, slot_gids: jax.Array,
+                     deg_slot: jax.Array) -> jax.Array:
+    """Uniform neighbor INDEX j in [0, deg) per slot, keyed by global
+    slot id (mesh-shape invariant).  deg==0 yields j=0; such slots are
+    masked invalid by the caller."""
+    base = jax.random.fold_in(rkey, TOPO_NBR_TAG)
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(base, slot_gids)
+    u = jax.vmap(lambda kk: jax.random.uniform(kk))(keys)
+    return jnp.minimum((u * deg_slot).astype(jnp.int32),
+                       jnp.maximum(deg_slot - 1, 0))
+
+
+def _bucket_rank(dst_eff: jax.Array, p: int) -> jax.Array:
+    """Rank of each slot within its destination bucket, in slot order.
+    ``dst_eff == p`` marks an invalid slot (consumes no capacity)."""
+    occ = dst_eff[:, None] == jnp.arange(p, dtype=jnp.int32)    # [S, p]
+    pos = jnp.cumsum(occ.astype(jnp.int32), axis=0) - 1
+    return jnp.take_along_axis(
+        pos, jnp.clip(dst_eff, 0, p - 1)[:, None], axis=1)[:, 0]
+
+
+def make_sparse_topo_pull_round(
+        proto: ProtocolConfig, topo, mesh: Mesh,
+        fault: Optional[FaultConfig] = None, origin: int = 0,
+        axis_name: str = "nodes", cap: Optional[int] = None,
+        tabled: bool = False):
+    """Sharded packed pull round over an EXPLICIT topology with
+    capacity-capped all_to_all request/response exchange (see the block
+    comment above).  State is rumor-packed ``uint32[n_pad, W]``.
+
+    Pull only: anti-entropy's reverse delta needs the responder-side
+    scatter to be capacity-capped too — use the dense kernels
+    (parallel/sharded.py) for explicit-topology anti-entropy.
+
+    Returns ``step(state, overflow, nbrs, deg) -> (state, overflow)``
+    plus the padded tables when ``tabled=True`` (the overflow operand is
+    a replicated float32 running count of capacity-dropped requests).
+    """
+    from gossip_tpu.models.state import SimState as _SimState
+    if proto.mode != C.PULL:
+        raise ValueError("sparse topology exchange is pull-only (got mode "
+                         f"{proto.mode!r}); dense kernels cover the rest")
+    if topo.implicit:
+        raise ValueError("implicit complete topology routes to "
+                         "make_sparse_pull_round (stratified draw)")
+    p = mesh.shape[axis_name]
+    k = proto.fanout
+    n = topo.n
+    n_pad = pad_to_mesh(n, mesh, axis_name)
+    nl = n_pad // p
+    S = nl * k
+    cap = resolve_topo_cap(topo, p, k, cap)
+    drop_prob = 0.0 if fault is None else fault.drop_prob
+    nbrs_pad = _pad_rows(topo.nbrs, n_pad, n)     # sentinel n; deg 0 rows
+    deg_pad = _pad_rows(topo.deg, n_pad, 0)
+
+    def local_round(seen_l, round_, base_key, msgs, ovf, nbrs_l, deg_l):
+        shard = jax.lax.axis_index(axis_name)
+        rkey = jax.random.fold_in(base_key, round_)
+        row_gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
+        alive_l = sharded_alive(fault, n, n_pad, origin)[row_gids]
+
+        slot_gids = shard * S + jnp.arange(S, dtype=jnp.int32)
+        deg_slot = jnp.repeat(deg_l, k)
+        j = _slot_nbr_choice(rkey, slot_gids, deg_slot)
+        row_of_slot = jnp.arange(S, dtype=jnp.int32) // k
+        gid = nbrs_l[row_of_slot, j]                          # [S] global
+        valid = (_slot_valid(rkey, slot_gids, drop_prob, alive_l, k)
+                 & (deg_slot > 0))
+        dst_eff = jnp.where(valid, gid // nl, jnp.int32(p))
+        pos = _bucket_rank(dst_eff, p)
+        sent = valid & (pos < cap)
+
+        # out-of-range (dst_eff == p: invalid; pos >= cap: overflow)
+        # indices are dropped by the scatter, leaving the -1 sentinel
+        send_rows = jnp.full((p, cap), -1, jnp.int32
+                             ).at[dst_eff, pos].set(gid % nl, mode="drop")
+        recv = jax.lax.all_to_all(send_rows, axis_name, 0, 0, tiled=False)
+        visible = jnp.where(alive_l[:, None], seen_l, jnp.uint32(0))
+        ok = recv >= 0
+        resp = visible[jnp.clip(recv, 0, nl - 1)]             # [p, cap, W]
+        resp = jnp.where(ok[:, :, None], resp, jnp.uint32(0))
+        back = jax.lax.all_to_all(resp, axis_name, 0, 0, tiled=False)
+
+        got = back[jnp.clip(dst_eff, 0, p - 1),
+                   jnp.clip(pos, 0, cap - 1)]                 # [S, W]
+        got = jnp.where(sent[:, None], got, jnp.uint32(0))
+        pulled = _or_reduce_k(got, nl, k)
+        pulled = jnp.where(alive_l[:, None], pulled, jnp.uint32(0))
+
+        n_sent = jnp.sum(sent).astype(jnp.float32)
+        n_over = jnp.sum(valid & ~sent).astype(jnp.float32)
+        msgs_new = msgs + jax.lax.psum(2.0 * n_sent, axis_name)
+        ovf_new = ovf + jax.lax.psum(n_over, axis_name)
+        return seen_l | pulled, msgs_new, ovf_new
+
+    sh, sh2, rep = P(axis_name), P(axis_name, None), P()
+    mapped = jax.shard_map(local_round, mesh=mesh,
+                           in_specs=(sh2, rep, rep, rep, rep, sh2, sh),
+                           out_specs=(sh2, rep, rep))
+
+    def step_tabled(state, overflow, nbrs, deg):
+        seen, msgs, ovf = mapped(state.seen, state.round, state.base_key,
+                                 state.msgs, overflow, nbrs, deg)
+        return (_SimState(seen=seen, round=state.round + 1,
+                          base_key=state.base_key, msgs=msgs), ovf)
+
+    if tabled:
+        return step_tabled, (nbrs_pad, deg_pad)
+
+    def step(state, overflow):
+        return step_tabled(state, overflow, nbrs_pad, deg_pad)
+
+    return step
+
+
+def sparse_topo_pull_round_reference(
+        proto: ProtocolConfig, topo, p: int,
+        fault: Optional[FaultConfig] = None, origin: int = 0,
+        cap: Optional[int] = None):
+    """Single-device twin of :func:`make_sparse_topo_pull_round` —
+    identical trajectory INCLUDING the deterministic capacity drops
+    (bucket ranks recomputed per source-shard block in the same slot
+    order).  The parity oracle; collectives only move data."""
+    k = proto.fanout
+    n = topo.n
+    n_pad = math.ceil(n / p) * p
+    nl = n_pad // p
+    S = nl * k
+    cap = resolve_topo_cap(topo, p, k, cap)
+    drop_prob = 0.0 if fault is None else fault.drop_prob
+    nbrs_pad = _pad_rows(topo.nbrs, n_pad, n)
+    deg_pad = _pad_rows(topo.deg, n_pad, 0)
+    alive_pad = sharded_alive(fault, n, n_pad, origin)
+
+    def step(state, overflow):
+        seen, round_ = state.seen, state.round
+        rkey = jax.random.fold_in(state.base_key, round_)
+        slot_gids = jnp.arange(n_pad * k, dtype=jnp.int32)
+        deg_slot = jnp.repeat(deg_pad, k)
+        j = _slot_nbr_choice(rkey, slot_gids, deg_slot)
+        row_of_slot = slot_gids // k
+        gid = nbrs_pad[row_of_slot, j]
+        valid = (_slot_valid(rkey, slot_gids, drop_prob, alive_pad, k)
+                 & (deg_slot > 0))
+        dst_eff = jnp.where(valid, gid // nl, jnp.int32(p))
+        pos = jax.vmap(_bucket_rank, in_axes=(0, None))(
+            dst_eff.reshape(p, S), p).reshape(-1)
+        sent = valid & (pos < cap)
+
+        visible = jnp.where(alive_pad[:, None], seen, jnp.uint32(0))
+        got = visible[jnp.clip(gid, 0, n_pad - 1)]
+        got = jnp.where(sent[:, None], got, jnp.uint32(0))
+        pulled = _or_reduce_k(got, n_pad, k)
+        pulled = jnp.where(alive_pad[:, None], pulled, jnp.uint32(0))
+
+        from gossip_tpu.models.state import SimState as _SimState
+        n_sent = jnp.sum(sent).astype(jnp.float32)
+        n_over = jnp.sum(valid & ~sent).astype(jnp.float32)
+        return (_SimState(seen=seen | pulled, round=round_ + 1,
+                          base_key=state.base_key,
+                          msgs=state.msgs + 2.0 * n_sent),
+                overflow + n_over)
+
+    return step
+
+
+def simulate_curve_topo_sparse(proto: ProtocolConfig, topo, run: RunConfig,
+                               mesh: Mesh,
+                               fault: Optional[FaultConfig] = None,
+                               axis_name: str = "nodes",
+                               cap: Optional[int] = None):
+    """lax.scan over rounds on the explicit-topology sparse pull path.
+    Returns (coverage[T], msgs[T], final, SparseMeta, overflow[T])."""
+    import numpy as np
+    p = mesh.shape[axis_name]
+    cap_used = resolve_topo_cap(topo, p, proto.fanout, cap)
+    step, tables = make_sparse_topo_pull_round(proto, topo, mesh, fault,
+                                               run.origin, axis_name,
+                                               cap_used, tabled=True)
+    n_pad = pad_to_mesh(topo.n, mesh, axis_name)
+    init = init_sparse_state(run, proto, topo.n, mesh, axis_name)
+    r = proto.rumors
+
+    @jax.jit
+    def scan(state, *tbl):
+        alive_pad = sharded_alive(fault, topo.n, n_pad, run.origin)
+        def body(carry, _):
+            s, ovf = step(*carry, *tbl)
+            return (s, ovf), (coverage_packed(s.seen, r, alive_pad),
+                              s.msgs, ovf)
+        return jax.lax.scan(body, (state, jnp.float32(0.0)), None,
+                            length=run.max_rounds)
+
+    (final, _), (covs, msgs, ovfs) = scan(init, *tables)
+    meta = sparse_topo_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
+                            cap_used)
+    return (np.asarray(covs), np.asarray(msgs), final, meta,
+            np.asarray(ovfs))
+
+
+def simulate_until_topo_sparse(proto: ProtocolConfig, topo, run: RunConfig,
+                               mesh: Mesh,
+                               fault: Optional[FaultConfig] = None,
+                               axis_name: str = "nodes",
+                               cap: Optional[int] = None):
+    """while_loop to target coverage on the explicit-topology sparse pull
+    path.  Returns (rounds, coverage, msgs, final, SparseMeta, overflow).
+    """
+    p = mesh.shape[axis_name]
+    cap_used = resolve_topo_cap(topo, p, proto.fanout, cap)
+    step, tables = make_sparse_topo_pull_round(proto, topo, mesh, fault,
+                                               run.origin, axis_name,
+                                               cap_used, tabled=True)
+    n_pad = pad_to_mesh(topo.n, mesh, axis_name)
+    alive_pad = sharded_alive(fault, topo.n, n_pad, run.origin)
+    init = init_sparse_state(run, proto, topo.n, mesh, axis_name)
+    target = jnp.float32(run.target_coverage)
+    r = proto.rumors
+
+    @jax.jit
+    def loop(state, *tbl):
+        # liveness in-trace: no O(N) closed-over constant in the compile
+        # request (bind_tables doc)
+        alive_t = sharded_alive(fault, topo.n, n_pad, run.origin)
+        def cond(carry):
+            s, _ = carry
+            return ((coverage_packed(s.seen, r, alive_t) < target)
+                    & (s.round < run.max_rounds))
+        def body(carry):
+            return step(*carry, *tbl)
+        return jax.lax.while_loop(cond, body,
+                                  (state, jnp.float32(0.0)))
+
+    final, ovf = loop(init, *tables)
+    meta = sparse_topo_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
+                            cap_used)
+    return (int(final.round),
+            float(coverage_packed(final.seen, r, alive_pad)),
+            float(final.msgs), final, meta, float(ovf))
+
+
 def simulate_curve_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
                           mesh: Mesh, fault: Optional[FaultConfig] = None,
                           axis_name: str = "nodes"):
@@ -393,8 +721,11 @@ def simulate_until_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
 
     @jax.jit
     def loop(state):
+        # liveness in-trace: no O(N) closed-over constant (bind_tables
+        # doc) — same hardening as simulate_until_topo_sparse
+        alive_t = sharded_alive(fault, n, n_pad, run.origin)
         def cond(s):
-            return ((coverage_packed(s.seen, r, alive_pad) < target)
+            return ((coverage_packed(s.seen, r, alive_t) < target)
                     & (s.round < run.max_rounds))
         return jax.lax.while_loop(cond, step, state)
 

@@ -22,8 +22,13 @@ Two batching axes live here:
   rumor count, and death masks stay structural (they change shapes or
   tables).
 
-Scope note: sweeping *structural* config (topology family, n, rumors)
-remains a python loop over compiles (see cli.cmd_sweep).
+Round 3 added the TOPOLOGY axis (VERDICT r2 item 6): same-n explicit
+families stack into one ``int32[F, n, D_max]`` traced table operand and
+each point's ``topo_idx`` dynamic-slices its family — completing the
+north star's "sweep fanout, mode, and graph topology" sentence in one
+XLA program.  Still structural (a python loop over compiles, see
+cli.cmd_sweep): n and rumor count (they change array shapes), and the
+implicit complete graph (no table to stack).
 """
 
 from __future__ import annotations
@@ -131,6 +136,10 @@ def config_sweep_curves_2d(points, topo: Topology, run: RunConfig,
     if fault is not None and fault.drop_prob > 0.0:
         raise ValueError("per-config loss goes through SweepPoint.drop_prob;"
                          " FaultConfig.drop_prob would be ambiguous here")
+    if any(pt.topo_idx != 0 for pt in points):
+        raise ValueError("the 2-D pod sweep takes ONE topology; the "
+                         "family axis (topo_idx) is a config_sweep_curves"
+                         " feature")
     cN = len(points)
     p_sweep = mesh.shape[sweep_axis]
     if cN % p_sweep != 0:
@@ -143,6 +152,9 @@ def config_sweep_curves_2d(points, topo: Topology, run: RunConfig,
     if any(pt.fanout > k_max for pt in points):
         raise ValueError("k_max smaller than a point's fanout")
     have_ae = any(pt.mode == C.ANTI_ENTROPY for pt in points)
+    # same static half-elision as config_sweep_curves (VERDICT r2 item 7)
+    need_push = any(_MODE_FLAGS[pt.mode][0] for pt in points)
+    need_pull = any(_MODE_FLAGS[pt.mode][1] for pt in points)
     have_table = not topo.implicit
     if have_table:
         nbrs_pad = _pad_rows(topo.nbrs, n_pad, n)
@@ -168,7 +180,8 @@ def config_sweep_curves_2d(points, topo: Topology, run: RunConfig,
             rkey, round_, gids, visible, alive_l, topo, k_max,
             nbrs_l, deg_l, do_push, do_pull, do_ae, fanout, dropp, period,
             have_ae, scatter_n=n_pad, count_reduce=count_reduce,
-            gather=lambda v: jax.lax.all_gather(v, node_axis, tiled=True))
+            gather=lambda v: jax.lax.all_gather(v, node_axis, tiled=True),
+            need_push=need_push, need_pull=need_pull)
         seen_new = seen_l | delta
         msgs_new = msgs + jax.lax.psum(msgs_round, node_axis)
 
@@ -261,12 +274,18 @@ _MODE_FLAGS = {C.PUSH: (True, False), C.PULL: (False, True),
 
 @dataclasses.dataclass(frozen=True)
 class SweepPoint:
-    """One shape-invariant config point of a batched sweep."""
+    """One shape-invariant config point of a batched sweep.
+
+    ``topo_idx`` selects the point's topology from the family stack when
+    :func:`config_sweep_curves` is given a SEQUENCE of same-n explicit
+    topologies (the north star's "sweep fanout, mode, and graph topology"
+    axis — VERDICT r2 item 6); with a single topology it must stay 0."""
     mode: str = C.PUSH
     fanout: int = 1
     drop_prob: float = 0.0
     period: int = 1          # anti-entropy cadence (1 = every round)
     seed: int = 0
+    topo_idx: int = 0
 
     def __post_init__(self):
         if self.mode not in _MODE_FLAGS:
@@ -281,6 +300,8 @@ class SweepPoint:
             raise ValueError("period > 1 is the anti-entropy cadence; solo "
                              f"{self.mode!r} rounds ignore period, so a "
                              "batched point must not silently differ")
+        if self.topo_idx < 0:
+            raise ValueError("topo_idx must be >= 0")
 
 
 @dataclasses.dataclass
@@ -314,61 +335,110 @@ def _drop_targets(rkey, tag, gids, targets, drop_prob, sentinel):
 
 def _sweep_round_delta(rkey, round_, gids, visible, alive_l, topo, k_max,
                        nbrs, deg, do_push, do_pull, do_ae, fanout, dropp,
-                       period, have_ae, scatter_n, count_reduce, gather):
+                       period, have_ae, scatter_n, count_reduce, gather,
+                       need_push=True, need_pull=True):
     """The ONE per-config sweep round body — shared by the single-device
     batch and the 2-D pod sweep, which differ only in how scatter counts
     reduce (``count_reduce``), how the digest table is assembled
     (``gather``), and the scatter sentinel (``scatter_n``).  Returns
-    (delta, msgs_this_round) for this row block."""
+    (delta, msgs_this_round) for this row block.
+
+    ``need_push``/``need_pull`` are STATIC elision switches (VERDICT r2
+    item 7): when no point in the batch pushes (resp. pulls), the whole
+    half — its sampling, scatter/gather, and reduction — is never built,
+    instead of being computed and masked.  Eliding a half cannot change
+    the other half's trajectory: the halves draw from disjoint RNG tags
+    (PUSH_TAG/PUSH_DROP_TAG vs PULL_TAG/PULL_DROP_TAG), same pattern as
+    the ``have_ae`` elision of the reverse delta."""
     n = topo.n
     col = jnp.arange(k_max, dtype=jnp.int32)[None, :]
+    delta = jnp.zeros_like(visible)
+    msgs = jnp.float32(0.0)
 
-    # push half (computed for every config, masked by do_push)
-    pkey = jax.random.fold_in(rkey, si_mod.PUSH_TAG)
-    targets = sample_peers(pkey, gids, topo, k_max, True,
-                           local_nbrs=nbrs, local_deg=deg)
-    targets = jnp.where(col < fanout, targets, jnp.int32(n))
-    targets = _drop_targets(rkey, si_mod.PUSH_DROP_TAG, gids, targets,
-                            dropp, n)
-    sender_active = jnp.any(visible, axis=1)
-    valid = (targets < n) & sender_active[:, None]
-    counts = push_counts(scatter_n, jnp.where(valid, targets, scatter_n),
-                         visible)
-    delta = (count_reduce(counts) > 0) & do_push
-    msgs = jnp.where(do_push, jnp.sum(valid).astype(jnp.float32), 0.0)
+    if need_push:
+        # push half (masked by do_push for non-push configs in the batch)
+        pkey = jax.random.fold_in(rkey, si_mod.PUSH_TAG)
+        targets = sample_peers(pkey, gids, topo, k_max, True,
+                               local_nbrs=nbrs, local_deg=deg)
+        targets = jnp.where(col < fanout, targets, jnp.int32(n))
+        targets = _drop_targets(rkey, si_mod.PUSH_DROP_TAG, gids, targets,
+                                dropp, n)
+        sender_active = jnp.any(visible, axis=1)
+        valid = (targets < n) & sender_active[:, None]
+        counts = push_counts(scatter_n,
+                             jnp.where(valid, targets, scatter_n), visible)
+        delta = (count_reduce(counts) > 0) & do_push
+        msgs = jnp.where(do_push, jnp.sum(valid).astype(jnp.float32), 0.0)
 
-    # pull half (anti-entropy = bidirectional exchange gated by period)
-    seen_all = gather(visible)
-    qkey = jax.random.fold_in(rkey, si_mod.PULL_TAG)
-    partners = sample_peers(qkey, gids, topo, k_max, True,
-                            local_nbrs=nbrs, local_deg=deg)
-    partners = jnp.where(col < fanout, partners, jnp.int32(n))
-    partners = _drop_targets(rkey, si_mod.PULL_DROP_TAG, gids, partners,
-                             dropp, n)
-    pulled = pull_merge(seen_all, partners, n)
-    partners = jnp.where(alive_l[:, None], partners, n)
-    n_req = jnp.sum(partners < n).astype(jnp.float32)
-    on = do_pull & ((round_ % period) == 0)
-    delta = delta | (pulled & on)
-    if have_ae:
-        # anti-entropy reverse delta: the initiator's state scatters back
-        # into the partner's row (models/si.py) — built only when the
-        # batch has an AE point
-        bcounts = push_counts(scatter_n,
-                              jnp.where(partners < n, partners, scatter_n),
-                              visible)
-        delta = delta | ((count_reduce(bcounts) > 0) & (on & do_ae))
-    mfac = jnp.where(do_ae, 3.0, 2.0)
-    msgs = msgs + jnp.where(on, mfac * n_req, 0.0)
+    if need_pull:
+        # pull half (anti-entropy = bidirectional exchange gated by period)
+        seen_all = gather(visible)
+        qkey = jax.random.fold_in(rkey, si_mod.PULL_TAG)
+        partners = sample_peers(qkey, gids, topo, k_max, True,
+                                local_nbrs=nbrs, local_deg=deg)
+        partners = jnp.where(col < fanout, partners, jnp.int32(n))
+        partners = _drop_targets(rkey, si_mod.PULL_DROP_TAG, gids, partners,
+                                 dropp, n)
+        pulled = pull_merge(seen_all, partners, n)
+        partners = jnp.where(alive_l[:, None], partners, n)
+        n_req = jnp.sum(partners < n).astype(jnp.float32)
+        on = do_pull & ((round_ % period) == 0)
+        delta = delta | (pulled & on)
+        if have_ae:
+            # anti-entropy reverse delta: the initiator's state scatters
+            # back into the partner's row (models/si.py) — built only
+            # when the batch has an AE point
+            bcounts = push_counts(
+                scatter_n, jnp.where(partners < n, partners, scatter_n),
+                visible)
+            delta = delta | ((count_reduce(bcounts) > 0) & (on & do_ae))
+        mfac = jnp.where(do_ae, 3.0, 2.0)
+        msgs = msgs + jnp.where(on, mfac * n_req, 0.0)
     return delta & alive_l[:, None], msgs
 
 
-def config_sweep_curves(points, topo: Topology, run: RunConfig,
+def _stack_topologies(topos):
+    """Same-n explicit topologies -> (nbrs_stack[F, n, D_max],
+    deg_stack[F, n]), neighbor columns padded with the sentinel n.  The
+    sentinel columns sit past every row's degree, so sampling (which
+    draws indices < deg) can never touch them — a point's trajectory is
+    independent of the OTHER families in the stack."""
+    n = topos[0].n
+    for t in topos:
+        if t.implicit:
+            raise ValueError(
+                "a topology sweep needs explicit neighbor tables for "
+                "every family (the implicit complete graph has no table "
+                "to stack); sweep it as its own batch")
+        if t.n != n:
+            raise ValueError(
+                f"topology sweep families must share n; got {t.n} vs {n}"
+                " (different n changes array shapes -> separate compiles)")
+    d_max = max(t.width for t in topos)
+    nbrs = jnp.stack([
+        jnp.pad(t.nbrs, ((0, 0), (0, d_max - t.width)), constant_values=n)
+        for t in topos])
+    deg = jnp.stack([t.deg for t in topos])
+    return nbrs, deg
+
+
+def config_sweep_curves(points, topo, run: RunConfig,
                         fault: Optional[FaultConfig] = None,
                         k_max: Optional[int] = None,
                         rumors: int = 1, mesh=None,
-                        axis_name: str = "sweep") -> ConfigSweepResult:
+                        axis_name: str = "sweep",
+                        _force_both: bool = False) -> ConfigSweepResult:
     """Run C distinct config points as ONE batched XLA program.
+
+    ``topo`` is one Topology, or a SEQUENCE of same-n explicit topologies
+    — the topology axis of the north star's "sweep fanout, mode, and
+    graph topology" sentence (VERDICT r2 item 6).  With a sequence, each
+    point's ``topo_idx`` picks its family from a stacked
+    ``int32[F, n, D_max]`` table operand; one compile covers the whole
+    families x modes x fanouts grid.  A point's trajectory equals the
+    solo single-topology batch BITWISE (same keys; the stack pads
+    neighbor columns with the sentinel past each row's degree, which
+    sampling never draws).
 
     ``fault`` contributes only the static death mask (shared structure);
     per-config loss goes through ``SweepPoint.drop_prob`` — a FaultConfig
@@ -398,18 +468,41 @@ def config_sweep_curves(points, topo: Topology, run: RunConfig,
             f"{len(points)} configs do not divide over the {axis_name} "
             f"mesh axis of size {mesh.shape[axis_name]}; pad the batch "
             "(duplicate a point) or change the mesh")
-    n = topo.n
+    topos = tuple(topo) if isinstance(topo, (list, tuple)) else (topo,)
+    multi = len(topos) > 1
+    if any(pt.topo_idx >= len(topos) for pt in points):
+        raise ValueError(
+            f"a point's topo_idx is past the {len(topos)} supplied "
+            "topolog(ies)")
+    topo0 = topos[0]
+    n = topo0.n
     k_max = k_max or max(pt.fanout for pt in points)
     if any(pt.fanout > k_max for pt in points):
         raise ValueError("k_max smaller than a point's fanout")
     cN = len(points)
     proto_like = ProtocolConfig(mode=C.PUSH, fanout=k_max, rumors=rumors)
-    tables = () if topo.implicit else (topo.nbrs, topo.deg)
+    if multi:
+        tables = _stack_topologies(topos)
+    else:
+        tables = () if topo0.implicit else (topo0.nbrs, topo0.deg)
     have_ae = any(pt.mode == C.ANTI_ENTROPY for pt in points)
+    # static half-elision (VERDICT r2 item 7): a pure-push (resp. pure-
+    # pull) batch never builds the other half.  _force_both is a
+    # benchmarking hook proving the elision's win (tests only).
+    need_push = _force_both or any(_MODE_FLAGS[pt.mode][0]
+                                   for pt in points)
+    need_pull = _force_both or any(_MODE_FLAGS[pt.mode][1]
+                                   for pt in points)
 
     def one_round(seen, round_, base_key, msgs,
-                  do_push, do_pull, do_ae, fanout, dropp, period, *tbl):
-        nbrs, deg = tbl if tbl else (None, None)
+                  do_push, do_pull, do_ae, fanout, dropp, period, tidx,
+                  *tbl):
+        if multi:
+            # per-config family: one dynamic slice out of the stacked
+            # table operand (tables are jit arguments — DESIGN.md §6)
+            nbrs, deg = tbl[0][tidx], tbl[1][tidx]
+        else:
+            nbrs, deg = tbl if tbl else (None, None)
         # O(N) buffers in-trace: no inline constants in the compile request
         gids = jnp.arange(n, dtype=jnp.int32)
         alive = alive_mask(fault, n, run.origin)
@@ -417,13 +510,14 @@ def config_sweep_curves(points, topo: Topology, run: RunConfig,
         rkey = jax.random.fold_in(base_key, round_)
         visible = seen & alive_b[:, None]
         delta, msgs_round = _sweep_round_delta(
-            rkey, round_, gids, visible, alive_b, topo, k_max, nbrs, deg,
+            rkey, round_, gids, visible, alive_b, topo0, k_max, nbrs, deg,
             do_push, do_pull, do_ae, fanout, dropp, period, have_ae,
-            scatter_n=n, count_reduce=lambda c: c, gather=lambda v: v)
+            scatter_n=n, count_reduce=lambda c: c, gather=lambda v: v,
+            need_push=need_push, need_pull=need_pull)
         return seen | delta, round_ + 1, msgs + msgs_round
 
     batched = jax.vmap(one_round,
-                       in_axes=(0,) * 10 + (None,) * len(tables))
+                       in_axes=(0,) * 11 + (None,) * len(tables))
 
     base = init_state(run, proto_like, n)
     init_seen = jnp.broadcast_to(base.seen, (cN,) + base.seen.shape)
@@ -435,15 +529,17 @@ def config_sweep_curves(points, topo: Topology, run: RunConfig,
     fanouts = jnp.asarray([pt.fanout for pt in points], jnp.int32)
     drops = jnp.asarray([pt.drop_prob for pt in points], jnp.float32)
     periods = jnp.asarray([pt.period for pt in points], jnp.int32)
+    tidxs = jnp.asarray([pt.topo_idx for pt in points], jnp.int32)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
         row = NamedSharding(mesh, P(axis_name))
         init_seen = jax.device_put(
             init_seen, NamedSharding(mesh, P(axis_name, None, None)))
         keys = jax.device_put(keys, row)
-        do_push, do_pull, do_ae, fanouts, drops, periods = (
+        do_push, do_pull, do_ae, fanouts, drops, periods, tidxs = (
             jax.device_put(x, row)
-            for x in (do_push, do_pull, do_ae, fanouts, drops, periods))
+            for x in (do_push, do_pull, do_ae, fanouts, drops, periods,
+                      tidxs))
 
     @jax.jit
     def scan(seen, rounds, keys, msgs, *tbl):
@@ -452,7 +548,7 @@ def config_sweep_curves(points, topo: Topology, run: RunConfig,
             seen, rounds, msgs = carry
             seen, rounds, msgs = batched(seen, rounds, keys, msgs, do_push,
                                          do_pull, do_ae, fanouts, drops,
-                                         periods, *tbl)
+                                         periods, tidxs, *tbl)
             covs = jax.vmap(lambda x: coverage(x, alive))(seen)
             return (seen, rounds, msgs), (covs, msgs)
         return jax.lax.scan(body, (seen, rounds, msgs), None,
@@ -463,6 +559,47 @@ def config_sweep_curves(points, topo: Topology, run: RunConfig,
     curves = np.asarray(covs).T
     return ConfigSweepResult(points=points, curves=curves,
                              msgs=np.asarray(msgs).T,
+                             rounds_to_target=_rounds_to_target(
+                                 curves, run.target_coverage),
+                             target=run.target_coverage)
+
+
+def config_sweep_curves_partitioned(points, topo, run: RunConfig,
+                                    fault: Optional[FaultConfig] = None,
+                                    k_max: Optional[int] = None,
+                                    rumors: int = 1) -> ConfigSweepResult:
+    """Mode-partitioned sweep execution (VERDICT r2 item 7): split a
+    MIXED grid into push-only / pull-only / push+pull buckets and batch
+    each separately, so the pure buckets never build (or pay per round
+    for) the other half.  Trajectories are IDENTICAL to the single batch:
+    one shared ``k_max`` across buckets (trajectories are a function of
+    (point, k_max)) and disjoint RNG tags between the halves.  Results
+    are merged back in the caller's point order.
+
+    Single-bucket grids fall through to :func:`config_sweep_curves`
+    directly (whose static elision already skips the absent half).  A
+    config-axis mesh is not supported here — bucket sizes rarely divide
+    a mesh; shard the unpartitioned batch instead (elision still applies
+    when the WHOLE grid is pure)."""
+    points = tuple(points)
+    if not points:
+        raise ValueError("need at least one SweepPoint")
+    k_max = k_max or max(pt.fanout for pt in points)
+
+    buckets: dict = {}
+    for i, pt in enumerate(points):
+        buckets.setdefault(_MODE_FLAGS[pt.mode], []).append(i)
+    if len(buckets) == 1:
+        return config_sweep_curves(points, topo, run, fault, k_max, rumors)
+
+    curves = np.zeros((len(points), run.max_rounds), np.float32)
+    msgs = np.zeros_like(curves)
+    for idxs in buckets.values():
+        sub = config_sweep_curves([points[i] for i in idxs], topo, run,
+                                  fault, k_max, rumors)
+        curves[idxs] = sub.curves
+        msgs[idxs] = sub.msgs
+    return ConfigSweepResult(points=points, curves=curves, msgs=msgs,
                              rounds_to_target=_rounds_to_target(
                                  curves, run.target_coverage),
                              target=run.target_coverage)
