@@ -32,6 +32,10 @@ BACKENDS = ("jax-tpu", "go-native")
 # go-native materializes every edge as python objects; past this it is no
 # longer the quick parity fixture it exists to be.
 _GONATIVE_MAX_NODES = 20_000
+# engine='native' forces the C++ event core (20-100x the Python engine,
+# README) and raises the ceiling so large-N parity spot checks stay
+# CLI-reachable (VERDICT r2 item 8).
+_GONATIVE_NATIVE_MAX_NODES = 1_000_000
 
 
 @dataclasses.dataclass
@@ -72,11 +76,26 @@ def run_gonative(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
     not supported here: the event sim exposes explicit partition windows via
     its own API for targeted tests."""
     from gossip_tpu.runtime.gonative import topology_from_table
-    from gossip_tpu.runtime.native_sim import make_event_sim
-    if tc.n > _GONATIVE_MAX_NODES:
+    from gossip_tpu.runtime.native_sim import (make_event_sim,
+                                               native_available)
+    if run.engine in ("xla", "fused"):
         raise ValueError(
-            f"go-native backend capped at {_GONATIVE_MAX_NODES} nodes "
-            f"(parity fixture, not the scale path); got n={tc.n}")
+            f"engine {run.engine!r} selects jax kernels; the go-native "
+            "backend takes engine 'auto' (C++ core when buildable, "
+            "Python otherwise) or 'native' (force the C++ core, 1M cap)")
+    force_native = run.engine == "native"
+    if force_native and not native_available():
+        raise RuntimeError(
+            "engine='native' needs the C++ event core and no compiler is "
+            "available; drop the flag for the Python engine (20k cap)")
+    cap = _GONATIVE_NATIVE_MAX_NODES if force_native else _GONATIVE_MAX_NODES
+    if tc.n > cap:
+        raise ValueError(
+            f"go-native backend capped at {cap} nodes "
+            + ("(C++ event core ceiling); " if force_native else
+               "(parity fixture, not the scale path; engine='native' "
+               "raises the cap to 1M); ")
+            + f"got n={tc.n}")
     if proto.mode != "flood":
         raise ValueError(
             "go-native reproduces the reference's relay-to-all-neighbors "
@@ -96,19 +115,25 @@ def run_gonative(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
     sim.run()
     wall = time.perf_counter() - t0
     max_h = run.max_rounds
-    curves = [sim.coverage_by_hop(r, max_h) for r in range(proto.rumors)]
-    curve = [min(c[h] for c in curves) for h in range(max_h + 1)]
+    # one hop_depths pass per rumor serves curve, convergence AND final
+    # coverage (delivered <=> present in the log <=> min_hop exists) —
+    # the per-node read() loop this replaces marshalled every node's
+    # whole log and dominated wall time past ~100k nodes.  The curve is
+    # a bincount-cumsum (O(n + max_h)), not a python double loop: nodes
+    # first reached PAST max_h land in the overflow bucket and stay out
+    # of every curve entry.
+    import numpy as np
+    depths = [sim.hop_depths(r) for r in range(proto.rumors)]
+    curves = []
+    for dp in depths:
+        vals = np.fromiter(dp.values(), np.int64, count=len(dp))
+        hist = np.bincount(np.clip(vals, 0, max_h + 1),
+                           minlength=max_h + 2)
+        curves.append(hist[:max_h + 1].cumsum() / tc.n)
+    curve = [float(min(c[h] for c in curves)) for h in range(max_h + 1)]
     hops = next((h for h in range(max_h + 1)
                  if curve[h] >= run.target_coverage), -1)
-    # one log read per node, all rumors tested against that one set (the
-    # native engine's .seen property marshals the whole log per access)
-    holders = [0] * proto.rumors
-    for i in range(tc.n):
-        seen_i = set(sim.read(i))
-        for r in range(proto.rumors):
-            if r in seen_i:
-                holders[r] += 1
-    final_cov = min(h / tc.n for h in holders)
+    final_cov = min(len(dp) / tc.n for dp in depths)
     return RunReport(
         backend="go-native", mode="flood", n=tc.n,
         rounds=hops, coverage=final_cov, msgs=float(sim.msgs_sent),
@@ -268,6 +293,10 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
     """Batched round-synchronous run; shards over a device mesh when
     ``mesh_cfg.n_devices > 1``."""
     from gossip_tpu.topology import generators as G
+    if run.engine == "native":
+        raise ValueError(
+            "engine='native' is the go-native backend's C++ event core; "
+            "jax-tpu engines are auto|xla|fused (use --backend go-native)")
     topo = G.build(tc)
     n_dev = 1 if mesh_cfg is None else mesh_cfg.n_devices
     _exchange = "dense" if mesh_cfg is None else mesh_cfg.exchange
@@ -601,9 +630,11 @@ def run_simulation(backend: str, proto: ProtocolConfig, tc: TopologyConfig,
                    mesh_cfg: Optional[MeshConfig] = None,
                    want_curve: bool = False) -> RunReport:
     """The one entry point both the CLI and the sidecar call."""
-    if backend == "go-native" and run.engine != "auto":
+    if backend == "go-native" and run.engine not in ("auto", "native"):
         raise ValueError(f"engine={run.engine!r} is a jax-tpu kernel "
-                         "selection; go-native has one (event-driven) engine")
+                         "selection; go-native takes 'auto' (C++ core "
+                         "when buildable, Python otherwise) or 'native' "
+                         "(force the C++ core, 1M node cap)")
     if backend == "go-native":
         return run_gonative(proto, tc, run, fault, want_curve)
     if backend == "jax-tpu":

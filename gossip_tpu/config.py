@@ -154,7 +154,7 @@ class FaultConfig:
             raise ValueError("fail_round must be >= 0")
 
 
-ENGINES = ("auto", "fused", "xla")
+ENGINES = ("auto", "fused", "xla", "native")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -184,6 +184,11 @@ class RunConfig:
       node.  Multi-device: rumor planes of 32 sharded across the mesh
       (parallel/sharded_fused.py), zero per-round ICI.  Ineligible
       configs raise rather than silently substituting another engine.
+    * ``native`` — go-native backend only: force the C++ event core
+      (native/eventsim.cpp, 20-100x the Python engine) and raise the
+      node cap to 1M, making large-N parity spot checks CLI-reachable
+      (VERDICT r2 item 8).  Raises if no C++ compiler is available —
+      never a silent Python fallback.  The jax-tpu backend rejects it.
     """
 
     target_coverage: float = 0.99

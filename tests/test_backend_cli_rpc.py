@@ -124,11 +124,15 @@ def test_backend_sparse_exchange():
     assert r.coverage >= 0.99
     b = r.meta["ici_bytes_per_round"]
     assert b["sparse"] < b["dense_equivalent"]
-    with pytest.raises(ValueError, match="complete topology"):
-        run_simulation("jax-tpu", ProtocolConfig(mode="pull"),
-                       TopologyConfig(family="ring", n=512, k=4),
-                       RunConfig(),
-                       mesh_cfg=MeshConfig(n_devices=8, exchange="sparse"))
+    # explicit families route to the capacity-capped topology path
+    # (round 3; was a ValueError before) — full coverage in
+    # tests/test_sharded_sparse.py
+    r2 = run_simulation("jax-tpu", ProtocolConfig(mode="pull"),
+                        TopologyConfig(family="ring", n=512, k=4),
+                        RunConfig(max_rounds=200),
+                        mesh_cfg=MeshConfig(n_devices=8, exchange="sparse"))
+    assert r2.meta["exchange"] == "sparse"
+    assert "overflow_dropped_requests" in r2.meta
 
 
 def test_backend_halo_exchange():
@@ -207,7 +211,7 @@ def test_engine_fused_routing_and_rejections():
                        TopologyConfig(n=50_000_000), fused)
     from gossip_tpu.ops.pallas_round import check_fused_fits
     assert check_fused_fits(50_000_000, 8, 1) > 0
-    with pytest.raises(ValueError, match="event-driven"):
+    with pytest.raises(ValueError, match="jax-tpu kernel"):
         run_simulation("go-native", ProtocolConfig(mode="flood"),
                        TopologyConfig(family="ring", n=64, k=2), fused)
     # the RPC schema reaches the engine knob through the run object
@@ -495,3 +499,55 @@ def test_bench_hermetic_env_preserves_pythonpath(monkeypatch, tmp_path):
     assert str(keepdir) in parts
     assert str(axondir) not in parts
     assert env["JAX_PLATFORMS"] == "cpu"
+
+
+# ---------------------------------------------------------------------
+# engine='native' above the go-native cap + --parity-check (VERDICT r2
+# item 8).
+
+
+def test_gonative_native_engine_raises_cap():
+    """engine='native' forces the C++ core and lifts the 20k ceiling;
+    engine='auto' above the ceiling stays a loud error."""
+    import dataclasses as _dc
+    from gossip_tpu.backend import run_simulation
+    from gossip_tpu.runtime.native_sim import native_available
+    proto = ProtocolConfig(mode="flood")
+    tc = TopologyConfig(family="erdos_renyi", n=25_000, p=0.0004, seed=1)
+    run = RunConfig(max_rounds=24)
+    with pytest.raises(ValueError, match="native"):
+        run_simulation("go-native", proto, tc, run)
+    if not native_available():
+        pytest.skip("no C++ compiler")
+    rep = run_simulation("go-native", proto, tc,
+                         _dc.replace(run, engine="native"))
+    assert rep.meta["engine"] == "NativeGoSim"
+    assert rep.coverage > 0.95
+    # jax-tpu must reject the go-native engine selection loudly
+    with pytest.raises(ValueError, match="go-native"):
+        run_simulation("jax-tpu", proto, tc,
+                       _dc.replace(run, engine="native"))
+    # and xla/fused are jax selections the event backend rejects
+    with pytest.raises(ValueError, match="jax-tpu"):
+        run_simulation("go-native", proto, tc,
+                       _dc.replace(run, engine="xla"))
+
+
+def test_cli_parity_check_race_free_ring():
+    """The CLI parity artifact: on the race-free k=2 ring the two
+    backends agree EXACTLY on the hop clock."""
+    p = _cli("run", "--mode", "flood", "--family", "ring", "--n", "256",
+             "--k", "2", "--max-rounds", "140", "--target", "1.0",
+             "--parity-check")
+    assert p.returncode == 0, p.stderr
+    rep = json.loads(p.stdout)
+    assert rep["curve_gap"] == 0.0
+    assert rep["hop_bound_violation"] == 0.0
+    assert rep["fixed_point_gap"] == 0.0
+
+
+def test_cli_parity_check_rejects_non_flood():
+    p = _cli("run", "--mode", "push", "--family", "ring", "--n", "64",
+             "--parity-check")
+    assert p.returncode == 2
+    assert "flood" in p.stderr
