@@ -85,7 +85,9 @@ def run_gonative(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
             "Python otherwise) or 'native' (force the C++ core, 1M cap)")
     force_native = run.engine == "native"
     if force_native and not native_available():
-        raise RuntimeError(
+        # ValueError like every sibling misconfiguration: the CLI turns
+        # these into 'error: ...' + exit 2 instead of a traceback
+        raise ValueError(
             "engine='native' needs the C++ event core and no compiler is "
             "available; drop the flag for the Python engine (20k cap)")
     cap = _GONATIVE_NATIVE_MAX_NODES if force_native else _GONATIVE_MAX_NODES

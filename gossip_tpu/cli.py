@@ -159,6 +159,12 @@ def cmd_run(a) -> int:
     from gossip_tpu.backend import run_simulation
     from gossip_tpu.utils.trace import trace   # trace(None) is a no-op
     proto, tc, run, fault, mesh = _args_to_configs(a)
+    if a.parity_check and a.ensemble > 1:
+        # the ensemble branch would otherwise win and silently discard
+        # the parity request (no-silent-drop policy)
+        print("error: --parity-check and --ensemble are separate run "
+              "shapes; pick one", file=sys.stderr)
+        return 2
     if a.ensemble > 1:
         if a.backend != "jax-tpu" or a.mode == "swim":
             print("error: --ensemble needs the jax-tpu backend and a "
@@ -216,6 +222,13 @@ def cmd_run(a) -> int:
             print("error: --parity-check needs a fault-free run "
                   "(go-native takes no FaultConfig)", file=sys.stderr)
             return 2
+        if a.curve or a.save_curve or a.checkpoint:
+            # never silently discard a requested output shape (the
+            # repo's incompatible-flag policy)
+            print("error: --parity-check is a self-contained artifact "
+                  "run; drop --curve/--save-curve/--checkpoint",
+                  file=sys.stderr)
+            return 2
         import dataclasses as _dc
         from gossip_tpu.backend import _GONATIVE_MAX_NODES
         from gossip_tpu.utils.metrics import curve_gap
@@ -228,6 +241,15 @@ def cmd_run(a) -> int:
                 engine="native" if tc.n > _GONATIVE_MAX_NODES else "auto")
             ref = run_simulation("go-native", proto, tc, ref_run,
                                  want_curve=True)
+        if rep.rounds < 0:
+            # the event sim always runs to quiescence; a jax run cut off
+            # by --max-rounds would report a bogus fixed_point_gap that
+            # reads as backend divergence
+            print("error: the jax flood run did not reach --target "
+                  f"within --max-rounds={run.max_rounds}; raise "
+                  "--max-rounds past the graph diameter so the parity "
+                  "fixed point is the converged state", file=sys.stderr)
+            return 2
         # The parity contract (tests/test_gonative.py): the flood kernel
         # is the exact BFS ball per round; event-order races can only
         # SLOW the event sim's hop curve (never push it above the

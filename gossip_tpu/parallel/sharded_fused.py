@@ -36,8 +36,14 @@ Testing: the kernel's inject path (tests-only explicit bit operands)
 makes the sharded round bitwise-checkable on the 8-device CPU mesh —
 every plane must equal the single-device multi-rumor kernel run with the
 same bits (tests/test_sharded_fused.py).  The hw-PRNG path additionally
-requires every device to draw the same stream, which holds by
-construction (same seed scalars, same kernel) on a real pod.
+requires every device to draw the same stream — an EXECUTED assertion,
+not an argument: :func:`assert_prng_invariant` runs one identically-
+seeded round on one identical plane per device, all_gathers a
+(popcount, weighted-mix) digest of each device's output, and requires
+all rows equal (tests/test_sharded_fused.py TPU tier; also a
+tools/hw_refresh.py step and part of the dryrun program).  The CPU
+interpreter stubs the hardware PRNG, so off-TPU the check only proves
+the program/collective plumbing; the invariant itself is a TPU artifact.
 """
 
 from __future__ import annotations
@@ -50,7 +56,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gossip_tpu.config import RunConfig
 from gossip_tpu.ops.pallas_round import (
-    BITS, coverage_words, fused_multirumor_pull_round, word_pack)
+    BITS, LANES, coverage_words, fused_multirumor_pull_round, mr_rows,
+    word_pack)
 
 AXIS = "planes"
 
@@ -130,6 +137,62 @@ def make_sharded_fused_round(n: int, mesh: Mesh, fanout: int = 1,
                       jnp.asarray(round_, jnp.int32))
 
     return round_fn
+
+
+def prng_invariant_digests(n: int, mesh: Mesh, seed: int = 0,
+                           round_: int = 1, fanout: int = 1,
+                           interpret: bool = False) -> jax.Array:
+    """Digest of one identically-seeded fused round per device.
+
+    Every device builds the SAME deterministic non-trivial input plane,
+    runs the SAME fused kernel with the SAME seed scalars, and digests
+    its output as (total popcount, index-weighted mix) — two uint32s
+    whose collision probability for diverged PRNG streams is ~2^-64.
+    The digests ride one all_gather; equal rows == the zero-ICI
+    same-stream invariant held on this mesh.  Returns uint32[n_dev, 2].
+    """
+    rows = mr_rows(n)
+
+    def local(_dummy):
+        i = jax.lax.broadcasted_iota(jnp.uint32, (rows, LANES), 0)
+        j = jax.lax.broadcasted_iota(jnp.uint32, (rows, LANES), 1)
+        table = ((i * jnp.uint32(2654435761)) ^ (j * jnp.uint32(40503))
+                 ) | jnp.uint32(1)
+        out = fused_multirumor_pull_round(
+            table, jnp.int32(seed), jnp.int32(round_), n, fanout,
+            interpret)
+        pop = jnp.sum(jax.lax.population_count(out), dtype=jnp.uint32)
+        # distinct odd weight per position (2x+1, not x|1 — OR-ing maps
+        # even/odd lane pairs to the SAME weight, and a weight collision
+        # plus permutation-invariant popcount would let a lane-pair swap
+        # between diverged streams slip through)
+        w = jnp.uint32(2) * (i * jnp.uint32(LANES) + j) + jnp.uint32(1)
+        mix = jnp.sum(out * w, dtype=jnp.uint32)
+        return jax.lax.all_gather(jnp.stack([pop, mix]), AXIS)
+
+    mapped = jax.shard_map(
+        local, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(None, None),
+        check_vma=False)
+    return mapped(jnp.zeros((mesh.shape[AXIS],), jnp.int32))
+
+
+def assert_prng_invariant(n: int, mesh: Mesh, seed: int = 0,
+                          round_: int = 1, fanout: int = 1,
+                          interpret: bool = False):
+    """Raise unless every device drew the identical partner stream.
+    Returns the digest table on success (an artifact to record)."""
+    import numpy as np
+    d = np.asarray(prng_invariant_digests(n, mesh, seed, round_, fanout,
+                                          interpret))
+    if not (d == d[0]).all():
+        raise AssertionError(
+            "zero-ICI plane-sharding PRNG invariant VIOLATED: devices "
+            f"drew different partner streams; digests per device:\n{d}")
+    if int(d[0, 0]) == 0:
+        raise AssertionError(
+            "degenerate digest (popcount 0) — the check input never "
+            "reached the kernel")
+    return d
 
 
 def simulate_until_sharded_fused(n: int, rumors: int, run: RunConfig,

@@ -551,3 +551,18 @@ def test_cli_parity_check_rejects_non_flood():
              "--parity-check")
     assert p.returncode == 2
     assert "flood" in p.stderr
+
+
+def test_cli_parity_check_flag_conflicts_and_truncation():
+    # insufficient --max-rounds must error, not report a bogus gap
+    p = _cli("run", "--mode", "flood", "--family", "ring", "--n", "256",
+             "--k", "2", "--max-rounds", "20", "--target", "1.0",
+             "--parity-check")
+    assert p.returncode == 2 and "max-rounds" in p.stderr
+    # conflicting run shapes are rejected, never silently dropped
+    p = _cli("run", "--mode", "flood", "--family", "ring", "--n", "128",
+             "--k", "2", "--parity-check", "--ensemble", "4")
+    assert p.returncode == 2 and "parity" in p.stderr
+    p = _cli("run", "--mode", "flood", "--family", "ring", "--n", "128",
+             "--k", "2", "--parity-check", "--curve")
+    assert p.returncode == 2 and "self-contained" in p.stderr

@@ -16,8 +16,9 @@ from gossip_tpu.ops.pallas_round import (
     BITS, LANES, fused_multirumor_pull_round, mr_rows, word_pack,
     word_unpack)
 from gossip_tpu.parallel.sharded_fused import (
-    coverage_planes, init_plane_state, make_plane_mesh,
-    make_sharded_fused_round, plane_count, simulate_until_sharded_fused)
+    assert_prng_invariant, coverage_planes, init_plane_state,
+    make_plane_mesh, make_sharded_fused_round, plane_count,
+    simulate_until_sharded_fused)
 
 ON_TPU = jax.default_backend() == "tpu"
 pytestmark = pytest.mark.skipif(
@@ -105,3 +106,17 @@ def test_simulate_until_converges_with_degenerate_prng():
     assert msgs == 2.0 * n * 3
     assert final.shape[0] == plane_count(rumors, 4)
     assert 0.0 < cov < 0.99
+
+
+def test_prng_same_stream_invariant_digests():
+    """The zero-ICI claim as an executed assertion (VERDICT r2 item 4):
+    every device's identically-seeded round digests identically.  On TPU
+    (GOSSIP_TPU_TEST_PLATFORM=axon tier) this checks the HARDWARE PRNG
+    stream; on the CPU interpreter the stubbed PRNG makes equality
+    trivial but the digest/all_gather program is the real one."""
+    mesh = make_plane_mesh(4)
+    d = np.asarray(assert_prng_invariant(128 * 16, mesh,
+                                         interpret=not ON_TPU))
+    assert d.shape == (4, 2)
+    assert (d == d[0]).all()
+    assert int(d[0, 0]) > 0      # non-degenerate: bits actually flowed

@@ -79,6 +79,38 @@ def _mr_staged_body():
     return 0
 
 
+def _prng_body():
+    """Subprocess: hardware-PRNG digest of the plane-sharded fused round
+    (sharded_fused.assert_prng_invariant).  On the single-chip tunnel
+    the all-equal assertion is trivial (one device) but the digest
+    itself is the real hardware PRNG artifact; a multi-chip pod runs
+    the same step and checks the zero-ICI same-stream invariant for
+    real."""
+    import jax
+    import numpy as np
+
+    from gossip_tpu.parallel.sharded_fused import (assert_prng_invariant,
+                                                   make_plane_mesh)
+    n_dev = len(jax.devices())
+    mesh = make_plane_mesh(n_dev)
+    d = assert_prng_invariant(128 * 64, mesh)
+    print(json.dumps({"devices": n_dev,
+                      "digests": np.asarray(d).tolist()}))
+    return 0
+
+
+def prng_invariant():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.run([sys.executable, os.path.abspath(__file__),
+                        "--prng-body"],
+                       capture_output=True, text=True, timeout=900,
+                       cwd=REPO, env=env)
+    if p.returncode != 0:
+        raise RuntimeError((p.stderr or p.stdout)[-400:])
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
 def mr_staged_10m():
     # run-by-path puts tools/ (not the repo root) on the child's
     # sys.path; gossip_tpu needs an explicit PYTHONPATH entry
@@ -147,6 +179,7 @@ def tpu_pallas_tests():
 
 def main():
     step("mr_staged_10m", mr_staged_10m)
+    step("prng_invariant", prng_invariant)
     step("baseline_sweep", baseline_sweep)
     step("bench", bench)
     step("tpu_pallas_tests", tpu_pallas_tests)
@@ -156,4 +189,6 @@ def main():
 if __name__ == "__main__":
     if "--mr-body" in sys.argv:
         sys.exit(_mr_staged_body())
+    if "--prng-body" in sys.argv:
+        sys.exit(_prng_body())
     sys.exit(main())
