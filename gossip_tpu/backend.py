@@ -147,6 +147,17 @@ def run_gonative(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
               "engine": type(sim).__name__})
 
 
+def _timing_meta(timing: Dict[str, float]) -> Dict[str, float]:
+    """compile_s / steady_wall_s meta columns from a driver timing dict
+    (round-2 verdict: reported walls must not mix one-off compile cost
+    with steady-state throughput).  Empty when the driver didn't run
+    the AOT split."""
+    if not timing:
+        return {}
+    return {"compile_s": round(timing["compile_s"], 4),
+            "steady_wall_s": round(timing["steady_s"], 4)}
+
+
 def _curve_summary(covs, msgs, target):
     """(rounds_to_target, final_cov, final_msgs, curve) from per-round
     series — the one place the -1 sentinel / target comparison lives."""
@@ -221,9 +232,10 @@ def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
             origin=run.origin)
         cov_fn = lambda t: coverage_words(t, n, proto.rumors)  # noqa: E731
 
+    from gossip_tpu.utils.trace import maybe_aot_timed
+    timing: Dict[str, float] = {}
     t0 = time.perf_counter()
-    final = loop(init)
-    _jax.block_until_ready(final.table)
+    final = maybe_aot_timed(loop, timing, init)
     wall = time.perf_counter() - t0
     cov = float(cov_fn(final.table))
     rounds = int(final.round)
@@ -237,7 +249,8 @@ def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
               "msgs_counts": "transmissions", "engine": "fused-pallas",
               "layout": ("node-packed bitmap" if proto.rumors == 1
                          else "one 32-rumor word per node"),
-              "vmem_table_bytes": table_bytes})
+              "vmem_table_bytes": table_bytes,
+              **_timing_meta(timing)})
 
 
 def _fused_ineligible_reason(proto: ProtocolConfig, tc: TopologyConfig,
@@ -394,11 +407,13 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
             import jax.numpy as jnp
 
             from gossip_tpu.runtime.simulator import simulate_swim_until
+            timing: Dict[str, float] = {}
             r, det_final, det_peak, final = simulate_swim_until(
                 proto, tc.n, run.max_rounds, run.target_coverage,
                 dead_nodes=dead, fail_round=fail_round, fault=fault,
-                topo=swim_topo, seed=run.seed, mesh=mesh)
+                topo=swim_topo, seed=run.seed, mesh=mesh, timing=timing)
             wall = time.perf_counter() - t0
+            meta.update(_timing_meta(timing))
             # same f32 threshold the loop's cond compared against
             tgt32 = float(jnp.float32(run.target_coverage))
             rounds_out = r if det_final >= tgt32 else -1
@@ -596,15 +611,18 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
 
     if packed_ok:
         from gossip_tpu.models.si_packed import simulate_until_packed
+        timing: Dict[str, float] = {}
         t0 = time.perf_counter()
-        rounds, cov, msgs, _ = simulate_until_packed(proto, topo, run, fault)
+        rounds, cov, msgs, _ = simulate_until_packed(proto, topo, run,
+                                                     fault, timing=timing)
         wall = time.perf_counter() - t0
         return RunReport(backend="jax-tpu", mode=proto.mode, n=tc.n,
                          rounds=rounds, coverage=cov, msgs=msgs,
                          wall_s=round(wall, 4),
                          meta={"clock": "rounds", "devices": 1,
                                "msgs_counts": "transmissions",
-                               "engine": "bit-packed"})
+                               "engine": "bit-packed",
+                               **_timing_meta(timing)})
 
     from gossip_tpu.runtime.simulator import simulate_curve, simulate_until
     t0 = time.perf_counter()
@@ -618,13 +636,15 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
             curve=[float(c) for c in res.coverage],
             meta={"clock": "rounds", "devices": 1,
                   "msgs_counts": "transmissions"})
-    res = simulate_until(proto, topo, run, fault)
+    timing = {}
+    res = simulate_until(proto, topo, run, fault, timing=timing)
     wall = time.perf_counter() - t0
     return RunReport(backend="jax-tpu", mode=proto.mode, n=tc.n,
                      rounds=res.rounds, coverage=res.coverage, msgs=res.msgs,
                      wall_s=round(wall, 4),
                      meta={"clock": "rounds", "devices": 1,
-                           "msgs_counts": "transmissions"})
+                           "msgs_counts": "transmissions",
+                           **_timing_meta(timing)})
 
 
 def run_simulation(backend: str, proto: ProtocolConfig, tc: TopologyConfig,

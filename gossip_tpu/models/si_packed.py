@@ -153,9 +153,11 @@ def make_packed_round(proto: ProtocolConfig, topo: Topology,
 
 def simulate_until_packed(proto: ProtocolConfig, topo: Topology,
                           run: RunConfig,
-                          fault: Optional[FaultConfig] = None):
+                          fault: Optional[FaultConfig] = None,
+                          timing: Optional[dict] = None):
     """while_loop to target coverage on packed state — the bench fast path.
-    Returns (rounds, coverage, msgs, final_state)."""
+    Returns (rounds, coverage, msgs, final_state).  ``timing``: pass a
+    dict for the compile/steady AOT split (utils.trace.aot_timed)."""
     step, tables = make_packed_round(proto, topo, fault, run.origin,
                                      tabled=True)
     alive = alive_mask(fault, topo.n, run.origin)
@@ -173,7 +175,8 @@ def simulate_until_packed(proto: ProtocolConfig, topo: Topology,
             return step(s, *tbl)
         return jax.lax.while_loop(cond, body, state)
 
-    final = loop(init, *tables)
+    from gossip_tpu.utils.trace import maybe_aot_timed
+    final = maybe_aot_timed(loop, timing, init, *tables)
     return (int(final.round),
             float(coverage_packed(final.seen, r, alive)),
             float(final.msgs), final)

@@ -86,7 +86,12 @@ def simulate_curve(proto: ProtocolConfig, topo: Topology, run: RunConfig,
 
 
 def simulate_until(proto: ProtocolConfig, topo: Topology, run: RunConfig,
-                   fault: Optional[FaultConfig] = None) -> UntilResult:
+                   fault: Optional[FaultConfig] = None,
+                   timing: Optional[dict] = None) -> UntilResult:
+    """``timing``: pass a dict to get ``compile_s``/``steady_s`` filled
+    via the AOT split (utils.trace.aot_timed) instead of one fused call —
+    the hardware-table contract that walls never mix compile with
+    steady state."""
     step, tables, init = _build(proto, topo, run, fault)
     target = jnp.float32(run.target_coverage)
     alive = alive_mask(fault, topo.n, run.origin)   # host-side final metric
@@ -101,7 +106,8 @@ def simulate_until(proto: ProtocolConfig, topo: Topology, run: RunConfig,
             return step(state, *tbl)
         return jax.lax.while_loop(cond, body, init_state_)
 
-    final = loop(init, *tables)
+    from gossip_tpu.utils.trace import maybe_aot_timed
+    final = maybe_aot_timed(loop, timing, init, *tables)
     return UntilResult(
         rounds=int(final.round),
         coverage=float(coverage(final.seen, alive)),
@@ -168,7 +174,8 @@ def simulate_swim_until(proto: ProtocolConfig, n: int, max_rounds: int,
                         target: float, dead_nodes=(), fail_round: int = 0,
                         fault: Optional[FaultConfig] = None,
                         topo: Optional[Topology] = None,
-                        seed: int = 0, mesh=None):
+                        seed: int = 0, mesh=None,
+                        timing: Optional[dict] = None):
     """SWIM to target detection (lax.while_loop, one XLA program) — the
     early-exit twin of :func:`simulate_swim_curve` for runs that don't
     need the curve: detection typically completes in ~40% of the curve
@@ -223,7 +230,8 @@ def simulate_swim_until(proto: ProtocolConfig, n: int, max_rounds: int,
         return jax.lax.while_loop(
             cond, body, (state, jnp.float32(0.0), jnp.float32(0.0)))
 
-    final, det, peak = loop(init, *tables)
+    from gossip_tpu.utils.trace import maybe_aot_timed
+    final, det, peak = maybe_aot_timed(loop, timing, init, *tables)
     return int(final.round), float(det), float(peak), final
 
 

@@ -43,6 +43,36 @@ def annotate(name: str) -> Iterator[None]:
         yield
 
 
+def aot_timed(jitted, *args):
+    """(out, compile_s, steady_s): compile the jitted callable for these
+    arguments ahead of time, then time the execution alone.
+
+    The hardware-table contract (round-2 verdict): reported walls must
+    not mix one-off compile cost with steady-state throughput — the
+    64-node sweep row's "11.6 s" was ~all compile.  ``compile_s`` covers
+    trace+lower+compile; ``steady_s`` is the device execution of one
+    call."""
+    import jax
+    t0 = time.perf_counter()
+    compiled = jitted.lower(*args).compile()
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = compiled(*args)
+    jax.block_until_ready(out)
+    steady_s = time.perf_counter() - t0
+    return out, compile_s, steady_s
+
+
+def maybe_aot_timed(jitted, timing, *args):
+    """:func:`aot_timed` when the caller passed a ``timing`` dict (fills
+    ``compile_s``/``steady_s``), a plain call otherwise — the one place
+    the drivers' optional-timing branch and its key names live."""
+    if timing is None:
+        return jitted(*args)
+    out, timing["compile_s"], timing["steady_s"] = aot_timed(jitted, *args)
+    return out
+
+
 class RoundTimer:
     """Wall-clock per-round timing for python-driven loops (the scan/while
     drivers time whole programs instead — this is for stepwise drivers like

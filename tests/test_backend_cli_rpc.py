@@ -566,3 +566,31 @@ def test_cli_parity_check_flag_conflicts_and_truncation():
     p = _cli("run", "--mode", "flood", "--family", "ring", "--n", "128",
              "--k", "2", "--parity-check", "--curve")
     assert p.returncode == 2 and "self-contained" in p.stderr
+
+
+def test_until_reports_split_compile_and_steady_wall():
+    """Hardware-table contract (round-2 verdict): non-curve runs report
+    compile_s and steady_wall_s separately so tables stop mixing one-off
+    compile cost with steady-state throughput."""
+    for proto in (ProtocolConfig(mode="pushpull"),        # bool until
+                  ProtocolConfig(mode="pull")):           # bit-packed
+        r = run_simulation("jax-tpu", proto,
+                           TopologyConfig(family="complete", n=256),
+                           RunConfig(max_rounds=32))
+        assert r.meta["compile_s"] > 0
+        assert r.meta["steady_wall_s"] > 0
+        assert r.meta["compile_s"] + r.meta["steady_wall_s"] \
+            <= r.wall_s + 0.05
+    # swim early-exit driver too
+    r = run_simulation("jax-tpu",
+                       ProtocolConfig(mode="swim", fanout=2,
+                                      swim_subjects=4, swim_proxies=2,
+                                      swim_suspect_rounds=4),
+                       TopologyConfig(family="complete", n=128),
+                       RunConfig(max_rounds=40))
+    assert r.meta["compile_s"] > 0 and r.meta["steady_wall_s"] > 0
+    # curve runs keep the fused wall (no AOT split there)
+    r = run_simulation("jax-tpu", ProtocolConfig(mode="pushpull"),
+                       TopologyConfig(family="complete", n=256),
+                       RunConfig(max_rounds=16), want_curve=True)
+    assert "compile_s" not in r.meta

@@ -138,6 +138,8 @@ def baseline_sweep():
     rows = [json.loads(line) for line in p.stdout.splitlines()]
     return [{"config": r["config"], "rounds": r["rounds"],
              "coverage": round(r["coverage"], 4), "wall_s": r["wall_s"],
+             "compile_s": r.get("meta", {}).get("compile_s"),
+             "steady_wall_s": r.get("meta", {}).get("steady_wall_s"),
              "engine": r.get("meta", {}).get("engine")}
             for r in rows]
 
