@@ -153,6 +153,20 @@ def _or_reduce_k(flat: jax.Array, nl: int, k: int) -> jax.Array:
     return out
 
 
+def _scatter_merge_digests(ok: jax.Array, recv: jax.Array,
+                           recv_d: jax.Array, nl: int, rumors: int,
+                           w: int) -> jax.Array:
+    """Responder-side anti-entropy reverse merge, the ONE canonical
+    implementation both mesh kernels share: OR the received requester
+    digests (``recv_d`` [p, cap, W]) into the locally-requested rows
+    (``recv`` [p, cap]; invalid slots carry the sentinel and drop)."""
+    rows_in = jnp.where(ok, recv, nl).reshape(-1)
+    contrib = unpack(recv_d.reshape(-1, w), rumors)
+    cnt = jnp.zeros((nl, rumors), jnp.int32).at[rows_in].add(
+        contrib.astype(jnp.int32), mode="drop")
+    return pack(cnt > 0)
+
+
 def make_sparse_pull_round(
         proto: ProtocolConfig, n: int, mesh: Mesh,
         fault: Optional[FaultConfig] = None, origin: int = 0,
@@ -232,12 +246,8 @@ def make_sparse_pull_round(
                                   axis=0)                     # [p, cap, W]
                 recv_d = jax.lax.all_to_all(send_d, axis_name, 0, 0,
                                             tiled=False)
-                rows_in = jnp.where(ok, recv, nl).reshape(-1)  # sentinel nl
-                contrib = unpack(recv_d.reshape(-1, w), proto.rumors)
-                cnt = jnp.zeros((nl, proto.rumors), jnp.int32
-                                ).at[rows_in].add(contrib.astype(jnp.int32),
-                                                  mode="drop")
-                return pack(cnt > 0)
+                return _scatter_merge_digests(ok, recv, recv_d, nl,
+                                              proto.rumors, w)
 
             if proto.period > 1:
                 on = (round_ % proto.period) == 0
@@ -426,14 +436,17 @@ def resolve_topo_cap(topo, p: int, k: int,
     return auto_topo_cap(topo.nbrs, topo.deg, n_pad // p, k, p)
 
 
-def sparse_topo_meta(n_pad: int, p: int, k: int, w: int,
-                     cap: int) -> SparseMeta:
+def sparse_topo_meta(n_pad: int, p: int, k: int, w: int, cap: int,
+                     bidirectional: bool = False) -> SparseMeta:
     """Traffic accounting for the explicit-topology sparse pull (dense
-    equivalent: the packed all_gather of parallel/sharded_packed.py)."""
+    equivalent: the packed all_gather of parallel/sharded_packed.py).
+    ``bidirectional``: anti-entropy's piggybacked requester digest, one
+    extra [p, cap, W] all_to_all on exchange rounds."""
     return SparseMeta(p=p, cap=cap,
                       request_bytes=p * cap * 4,
                       response_bytes=p * cap * 4 * w,
-                      dense_bytes=n_pad * 4 * w)
+                      dense_bytes=n_pad * 4 * w,
+                      reverse_bytes=p * cap * 4 * w if bidirectional else 0)
 
 
 def _slot_nbr_choice(rkey: jax.Array, slot_gids: jax.Array,
@@ -462,22 +475,28 @@ def make_sparse_topo_pull_round(
         fault: Optional[FaultConfig] = None, origin: int = 0,
         axis_name: str = "nodes", cap: Optional[int] = None,
         tabled: bool = False):
-    """Sharded packed pull round over an EXPLICIT topology with
-    capacity-capped all_to_all request/response exchange (see the block
-    comment above).  State is rumor-packed ``uint32[n_pad, W]``.
+    """Sharded packed pull / anti-entropy round over an EXPLICIT
+    topology with capacity-capped all_to_all request/response exchange
+    (see the block comment above).  State is rumor-packed
+    ``uint32[n_pad, W]``.
 
-    Pull only: anti-entropy's reverse delta needs the responder-side
-    scatter to be capacity-capped too — use the dense kernels
-    (parallel/sharded.py) for explicit-topology anti-entropy.
+    Anti-entropy piggybacks the requester's digest on the request (one
+    extra [p, cap, W] all_to_all, SparseMeta.reverse_bytes) and the
+    responder scatter-merges it — the capacity cap bounds the reverse
+    side for free, since an overflow-dropped request carries no digest
+    either.  ``period > 1`` cond-skips the reverse collective and masks
+    the forward merge on quiescent rounds (complete-graph twin,
+    :func:`make_sparse_pull_round`).
 
     Returns ``step(state, overflow, nbrs, deg) -> (state, overflow)``
     plus the padded tables when ``tabled=True`` (the overflow operand is
     a replicated float32 running count of capacity-dropped requests).
     """
     from gossip_tpu.models.state import SimState as _SimState
-    if proto.mode != C.PULL:
-        raise ValueError("sparse topology exchange is pull-only (got mode "
-                         f"{proto.mode!r}); dense kernels cover the rest")
+    if proto.mode not in (C.PULL, C.ANTI_ENTROPY):
+        raise ValueError("sparse topology exchange covers pull and "
+                         f"anti-entropy (got mode {proto.mode!r}); push/"
+                         "flood ride the dense kernels")
     if topo.implicit:
         raise ValueError("implicit complete topology routes to "
                          "make_sparse_pull_round (stratified draw)")
@@ -487,6 +506,7 @@ def make_sparse_topo_pull_round(
     n_pad = pad_to_mesh(n, mesh, axis_name)
     nl = n_pad // p
     S = nl * k
+    w = n_words(proto.rumors)
     cap = resolve_topo_cap(topo, p, k, cap)
     drop_prob = 0.0 if fault is None else fault.drop_prob
     nbrs_pad = _pad_rows(topo.nbrs, n_pad, n)     # sentinel n; deg 0 rows
@@ -524,11 +544,39 @@ def make_sparse_topo_pull_round(
                    jnp.clip(pos, 0, cap - 1)]                 # [S, W]
         got = jnp.where(sent[:, None], got, jnp.uint32(0))
         pulled = _or_reduce_k(got, nl, k)
-        pulled = jnp.where(alive_l[:, None], pulled, jnp.uint32(0))
 
         n_sent = jnp.sum(sent).astype(jnp.float32)
         n_over = jnp.sum(valid & ~sent).astype(jnp.float32)
-        msgs_new = msgs + jax.lax.psum(2.0 * n_sent, axis_name)
+        if proto.mode == C.ANTI_ENTROPY:
+            def reverse_delta(_):
+                # requester digest rides WITH the request in the same
+                # (dst, pos) bucket slot; the responder scatter-merges
+                # into the requested rows (complete-graph twin layout)
+                req_digest = visible[row_of_slot]             # [S, W]
+                req_digest = jnp.where(sent[:, None], req_digest,
+                                       jnp.uint32(0))
+                send_d = jnp.zeros((p, cap, w), jnp.uint32
+                                   ).at[dst_eff, pos].set(req_digest,
+                                                          mode="drop")
+                recv_d = jax.lax.all_to_all(send_d, axis_name, 0, 0,
+                                            tiled=False)
+                return _scatter_merge_digests(ok, recv, recv_d, nl,
+                                              proto.rumors, w)
+
+            if proto.period > 1:
+                on = (round_ % proto.period) == 0
+                back_l = jax.lax.cond(on, reverse_delta,
+                                      lambda _: jnp.zeros_like(pulled),
+                                      None)
+                pulled = jnp.where(on, pulled, jnp.uint32(0))
+                n_sent = jnp.where(on, n_sent, 0.0)
+                n_over = jnp.where(on, n_over, 0.0)
+            else:
+                back_l = reverse_delta(None)
+            pulled = pulled | back_l
+        mfac = 3.0 if proto.mode == C.ANTI_ENTROPY else 2.0
+        pulled = jnp.where(alive_l[:, None], pulled, jnp.uint32(0))
+        msgs_new = msgs + jax.lax.psum(mfac * n_sent, axis_name)
         ovf_new = ovf + jax.lax.psum(n_over, axis_name)
         return seen_l | pulled, msgs_new, ovf_new
 
@@ -559,7 +607,11 @@ def sparse_topo_pull_round_reference(
     """Single-device twin of :func:`make_sparse_topo_pull_round` —
     identical trajectory INCLUDING the deterministic capacity drops
     (bucket ranks recomputed per source-shard block in the same slot
-    order).  The parity oracle; collectives only move data."""
+    order) and the anti-entropy reverse merge.  The parity oracle;
+    collectives only move data."""
+    if proto.mode not in (C.PULL, C.ANTI_ENTROPY):
+        raise ValueError("sparse topology exchange covers pull and "
+                         f"anti-entropy (got mode {proto.mode!r})")
     k = proto.fanout
     n = topo.n
     n_pad = math.ceil(n / p) * p
@@ -590,14 +642,35 @@ def sparse_topo_pull_round_reference(
         got = visible[jnp.clip(gid, 0, n_pad - 1)]
         got = jnp.where(sent[:, None], got, jnp.uint32(0))
         pulled = _or_reduce_k(got, n_pad, k)
+
+        n_sent = jnp.sum(sent).astype(jnp.float32)
+        n_over = jnp.sum(valid & ~sent).astype(jnp.float32)
+        if proto.mode == C.ANTI_ENTROPY:
+            # reverse delta: the requester's digest merges into the
+            # partner (mesh kernel's piggybacked digest)
+            req_digest = visible[row_of_slot]
+            req_digest = jnp.where(sent[:, None], req_digest,
+                                   jnp.uint32(0))
+            tgt = jnp.where(sent, gid, n_pad)
+            cnt = jnp.zeros((n_pad, proto.rumors), jnp.int32
+                            ).at[tgt].add(
+                unpack(req_digest, proto.rumors).astype(jnp.int32),
+                mode="drop")
+            back = pack(cnt > 0)
+            if proto.period > 1:
+                on = (round_ % proto.period) == 0
+                pulled = jnp.where(on, pulled, jnp.uint32(0))
+                back = jnp.where(on, back, jnp.uint32(0))
+                n_sent = jnp.where(on, n_sent, 0.0)
+                n_over = jnp.where(on, n_over, 0.0)
+            pulled = pulled | back
+        mfac = 3.0 if proto.mode == C.ANTI_ENTROPY else 2.0
         pulled = jnp.where(alive_pad[:, None], pulled, jnp.uint32(0))
 
         from gossip_tpu.models.state import SimState as _SimState
-        n_sent = jnp.sum(sent).astype(jnp.float32)
-        n_over = jnp.sum(valid & ~sent).astype(jnp.float32)
         return (_SimState(seen=seen | pulled, round=round_ + 1,
                           base_key=state.base_key,
-                          msgs=state.msgs + 2.0 * n_sent),
+                          msgs=state.msgs + mfac * n_sent),
                 overflow + n_over)
 
     return step
@@ -632,7 +705,8 @@ def simulate_curve_topo_sparse(proto: ProtocolConfig, topo, run: RunConfig,
 
     (final, _), (covs, msgs, ovfs) = scan(init, *tables)
     meta = sparse_topo_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
-                            cap_used)
+                            cap_used,
+                            bidirectional=proto.mode == C.ANTI_ENTROPY)
     return (np.asarray(covs), np.asarray(msgs), final, meta,
             np.asarray(ovfs))
 
@@ -672,7 +746,8 @@ def simulate_until_topo_sparse(proto: ProtocolConfig, topo, run: RunConfig,
 
     final, ovf = loop(init, *tables)
     meta = sparse_topo_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
-                            cap_used)
+                            cap_used,
+                            bidirectional=proto.mode == C.ANTI_ENTROPY)
     return (int(final.round),
             float(coverage_packed(final.seen, r, alive_pad)),
             float(final.msgs), final, meta, float(ovf))

@@ -122,22 +122,28 @@ def test_rejects_push_and_unbalanced():
 # Explicit-topology sparse exchange (VERDICT r2 item 5)
 
 
-@pytest.mark.parametrize("family,fanout,rumors,fault", [
-    ("erdos_renyi", 1, 1, None),
-    ("erdos_renyi", 2, 40, None),
-    ("watts_strogatz", 1, 5,
+@pytest.mark.parametrize("family,mode,fanout,rumors,fault", [
+    ("erdos_renyi", C.PULL, 1, 1, None),
+    ("erdos_renyi", C.PULL, 2, 40, None),
+    ("watts_strogatz", C.PULL, 1, 5,
      FaultConfig(node_death_rate=0.1, drop_prob=0.2, seed=3)),
-    ("power_law", 1, 1, None),
+    ("power_law", C.PULL, 1, 1, None),
+    ("erdos_renyi", C.ANTI_ENTROPY, 1, 5, None),
+    ("watts_strogatz", C.ANTI_ENTROPY, 2, 3,
+     FaultConfig(drop_prob=0.15, seed=5)),
 ])
-def test_topo_bitwise_parity_mesh_vs_reference(family, fanout, rumors,
-                                               fault):
+def test_topo_bitwise_parity_mesh_vs_reference(family, mode, fanout,
+                                               rumors, fault):
     """Mesh run == single-device reference BITWISE, including the
-    deterministic capacity drops, on explicit topologies."""
+    deterministic capacity drops and the anti-entropy reverse merge, on
+    explicit topologies (anti-entropy uses period=2: the cond-gated
+    reverse collective and the quiescent-round masking both covered)."""
     n = 256
     topo = {"erdos_renyi": lambda: G.erdos_renyi(n, 0.05, seed=7),
             "watts_strogatz": lambda: G.watts_strogatz(n, 6, 0.1, seed=7),
             "power_law": lambda: G.power_law(n, 3, seed=7)}[family]()
-    proto = ProtocolConfig(mode=C.PULL, fanout=fanout, rumors=rumors)
+    proto = ProtocolConfig(mode=mode, fanout=fanout, rumors=rumors,
+                           period=2 if mode == C.ANTI_ENTROPY else 1)
     run = RunConfig(seed=11)
     mesh = _mesh()
     step_m = make_sparse_topo_pull_round(proto, topo, mesh, fault,
@@ -244,14 +250,35 @@ def test_topo_curve_driver_and_overflow_series():
 def test_topo_rejections():
     mesh = _mesh()
     topo = G.erdos_renyi(256, 0.05, seed=0)
-    with pytest.raises(ValueError, match="pull-only"):
-        make_sparse_topo_pull_round(
-            ProtocolConfig(mode=C.ANTI_ENTROPY), topo, mesh)
-    with pytest.raises(ValueError, match="pull-only"):
+    with pytest.raises(ValueError, match="pull and anti-entropy"):
         make_sparse_topo_pull_round(ProtocolConfig(mode=C.PUSH), topo, mesh)
+    with pytest.raises(ValueError, match="pull and anti-entropy"):
+        make_sparse_topo_pull_round(ProtocolConfig(mode=C.FLOOD), topo,
+                                    mesh)
     with pytest.raises(ValueError, match="implicit"):
         make_sparse_topo_pull_round(
             ProtocolConfig(mode=C.PULL), G.complete(256), mesh)
+
+
+def test_topo_antientropy_converges_and_reverse_accounting():
+    """Anti-entropy through the topo exchange: faster convergence than
+    pure pull (bidirectional merge), reverse bytes in the meta, msgs
+    factor 3 on exchange rounds only."""
+    n = 2048
+    topo = G.erdos_renyi(n, 12.0 / n, seed=4)
+    run = RunConfig(seed=2, target_coverage=0.99, max_rounds=64)
+    r_ae, cov_ae, msgs_ae, _, meta_ae, _ = simulate_until_topo_sparse(
+        ProtocolConfig(mode=C.ANTI_ENTROPY, fanout=1, rumors=1), topo,
+        run, _mesh())
+    r_pl, cov_pl, _, _, meta_pl, _ = simulate_until_topo_sparse(
+        ProtocolConfig(mode=C.PULL, fanout=1, rumors=1), topo, run,
+        _mesh())
+    assert cov_ae >= 0.99 and cov_pl >= 0.99
+    assert r_ae <= r_pl
+    assert meta_ae.reverse_bytes == meta_ae.response_bytes > 0
+    assert meta_pl.reverse_bytes == 0
+    # 3 messages per delivered request (request + digest + reverse)
+    assert msgs_ae == pytest.approx(3.0 * n * r_ae, rel=0.05)
 
 
 def test_topo_dead_nodes_stay_dark():
@@ -288,10 +315,16 @@ def test_backend_routes_explicit_family_to_topo_sparse():
     assert "overflow_dropped_requests" in rep.meta
     assert rep.meta["ici_bytes_per_round"]["sparse"] <= \
         rep.meta["ici_bytes_per_round"]["dense_equivalent"]
-    # anti-entropy on an explicit family must be rejected loudly, never
-    # silently densified
-    with pytest.raises(ValueError, match="pull-only"):
-        run_simulation("jax-tpu", ProtocolConfig(mode=C.ANTI_ENTROPY),
+    # anti-entropy routes through the same path (round 3); push is
+    # rejected loudly, never silently densified
+    rep_ae = run_simulation("jax-tpu",
+                            ProtocolConfig(mode=C.ANTI_ENTROPY, period=2),
+                            tc, run, None,
+                            MeshConfig(n_devices=P8, exchange="sparse"))
+    assert rep_ae.meta["exchange"] == "sparse"
+    assert rep_ae.coverage >= 0.99
+    with pytest.raises(ValueError, match="pull and anti-entropy"):
+        run_simulation("jax-tpu", ProtocolConfig(mode=C.PUSH),
                        tc, run, None,
                        MeshConfig(n_devices=P8, exchange="sparse"))
 
