@@ -516,10 +516,11 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
                       "msgs_counts": "transmissions", "exchange": "sparse",
                       "overflow_dropped_requests": overflow,
                       "bucket_cap": smeta.cap,
-                      # reverse payload moves on EXCHANGE rounds only
-                      # (period-gated lax.cond) — broken out so a
-                      # period>1 anti-entropy report never overstates
-                      # steady per-round traffic (SparseMeta doc)
+                      # for anti-entropy with period>1 the WHOLE
+                      # exchange is cond-skipped on quiescent rounds, so
+                      # every sparse byte figure is per EXCHANGE round
+                      # (steady average = /period — SparseMeta doc);
+                      # reverse broken out as the AE-only payload
                       "ici_bytes_per_round": {
                           "sparse": smeta.sparse_bytes,
                           "dense_equivalent": smeta.dense_bytes,

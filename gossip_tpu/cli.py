@@ -444,7 +444,7 @@ def cmd_grid(a) -> int:
     and graph topology across a pod" sentence —
     parallel/sweep.config_sweep_curves).  --devices shards the config axis
     over a mesh; --pod-mesh S N runs the full 2-D (configs x node-shards)
-    shard_map program (single family only)."""
+    shard_map program, families included."""
     from gossip_tpu.parallel.sweep import (SweepPoint, config_sweep_curves,
                                            config_sweep_curves_2d)
     from gossip_tpu.topology import generators as G

@@ -73,15 +73,20 @@ TOPO_NBR_TAG = 105
 
 
 class SparseMeta(NamedTuple):
-    """Per-round ICI traffic of the sparse exchange vs the dense path."""
+    """Per-round ICI traffic of the sparse exchange vs the dense path.
+
+    For anti-entropy with period > 1 the kernels cond-skip the ENTIRE
+    exchange — request, response, and reverse collectives alike — on
+    quiescent rounds, so every byte figure here is per EXCHANGE round
+    and the steady per-round average is ``sparse_bytes / period``.
+    Pull (and period == 1) exchanges every round, so the figures are
+    then plain per-round numbers."""
     p: int                    # shards
     cap: int                  # requests per (src, dst) pair
-    request_bytes: int        # per device per round, sparse path
-    response_bytes: int       # per device per round, sparse path
+    request_bytes: int        # per device per EXCHANGE round
+    response_bytes: int       # per device per EXCHANGE round
     dense_bytes: int          # per device per round, all_gather equivalent
-    # anti-entropy reverse-delta payload (0 = pull).  Moved on EXCHANGE
-    # rounds only — with period>1 a lax.cond skips the collective on
-    # quiescent rounds, so the per-round average is reverse_bytes/period.
+    # anti-entropy reverse-delta payload (0 = pull)
     reverse_bytes: int = 0
 
     @property
@@ -199,66 +204,77 @@ def make_sparse_pull_round(
     def local_round(seen_l, round_, base_key, msgs, alive_l):
         shard = jax.lax.axis_index(axis_name)
         rkey = jax.random.fold_in(base_key, round_)
-        pi, o = _round_draws(rkey, p)
-        inv_pi = jnp.argsort(pi).astype(jnp.int32)
-
-        slot_gids = shard * (nl * k) + jnp.arange(nl * k, dtype=jnp.int32)
-        rows_req = _slot_rows(rkey, slot_gids, nl)            # [nl*k]
-        valid = _slot_valid(rkey, slot_gids, drop_prob, alive_l, k)
-        rows_req = jnp.where(valid, rows_req, jnp.int32(-1))
-
-        # Column c of the [cap, p] slot view holds group (c + o) % p; the
-        # shard receiving column c is pi[(c + o) % p].  Reorder columns so
-        # send[d] is the block destined to shard d.
-        A = rows_req.reshape(cap, p)                          # [cap, p]
-        cols_for_dst = (inv_pi - o) % p                       # [p]
-        send = jnp.take(A.T, cols_for_dst, axis=0)            # [p, cap]
-
-        recv = jax.lax.all_to_all(send, axis_name, 0, 0, tiled=False)
-        # recv[s, :] = rows requested by shard s from THIS shard.
         visible = jnp.where(alive_l[:, None], seen_l, jnp.uint32(0))
-        ok = recv >= 0
-        resp = visible[jnp.clip(recv, 0, nl - 1)]             # [p, cap, W]
-        resp = jnp.where(ok[:, :, None], resp, jnp.uint32(0))
-        back = jax.lax.all_to_all(resp, axis_name, 0, 0, tiled=False)
 
-        # back[d] answers the column we sent to shard d; undo the reorder.
-        dst_for_col = jnp.take(pi, (jnp.arange(p, dtype=jnp.int32) + o) % p)
-        R_cols = jnp.take(back, dst_for_col, axis=0)          # [p(col),cap,W]
-        flat = jnp.transpose(R_cols, (1, 0, 2)).reshape(nl * k, w)
-        pulled = _or_reduce_k(flat, nl, k)
+        def exchange(_):
+            """The whole round's sampling + collectives.  For
+            anti-entropy with period > 1 a lax.cond skips this ENTIRELY
+            on quiescent rounds — forward and reverse bytes both (draws
+            are keyed by (round, slot id), so skipped rounds never
+            perturb later ones; the reference twin computes-and-zeroes
+            to the identical state)."""
+            pi, o = _round_draws(rkey, p)
+            inv_pi = jnp.argsort(pi).astype(jnp.int32)
 
-        n_req = jnp.sum(valid).astype(jnp.float32)
-        if proto.mode == C.ANTI_ENTROPY:
-            # Bidirectional reconciliation: the requester's own digest rides
-            # ALONG with the request (one extra [p, cap, W] all_to_all) and
-            # the responder merges it locally — the partner pair converges
-            # to the union in one exchange, still O(messages) traffic
-            # (SparseMeta.reverse_bytes).  lax.cond skips the collective on
-            # off-period rounds (replicated predicate, uniform branch).
-            def reverse_delta(_):
+            slot_gids = shard * (nl * k) + jnp.arange(nl * k,
+                                                      dtype=jnp.int32)
+            rows_req = _slot_rows(rkey, slot_gids, nl)        # [nl*k]
+            valid = _slot_valid(rkey, slot_gids, drop_prob, alive_l, k)
+            rows_req = jnp.where(valid, rows_req, jnp.int32(-1))
+
+            # Column c of the [cap, p] slot view holds group (c + o) % p;
+            # the shard receiving column c is pi[(c + o) % p].  Reorder
+            # columns so send[d] is the block destined to shard d.
+            A = rows_req.reshape(cap, p)                      # [cap, p]
+            cols_for_dst = (inv_pi - o) % p                   # [p]
+            send = jnp.take(A.T, cols_for_dst, axis=0)        # [p, cap]
+
+            recv = jax.lax.all_to_all(send, axis_name, 0, 0, tiled=False)
+            # recv[s, :] = rows requested by shard s from THIS shard.
+            ok = recv >= 0
+            resp = visible[jnp.clip(recv, 0, nl - 1)]         # [p, cap, W]
+            resp = jnp.where(ok[:, :, None], resp, jnp.uint32(0))
+            back = jax.lax.all_to_all(resp, axis_name, 0, 0, tiled=False)
+
+            # back[d] answers the column we sent to shard d; undo the
+            # reorder.
+            dst_for_col = jnp.take(pi, (jnp.arange(p, dtype=jnp.int32)
+                                        + o) % p)
+            R_cols = jnp.take(back, dst_for_col, axis=0)   # [p(col),cap,W]
+            flat = jnp.transpose(R_cols, (1, 0, 2)).reshape(nl * k, w)
+            pulled = _or_reduce_k(flat, nl, k)
+
+            if proto.mode == C.ANTI_ENTROPY:
+                # Bidirectional reconciliation: the requester's own
+                # digest rides ALONG with the request (one extra
+                # [p, cap, W] all_to_all) and the responder merges it
+                # locally — the partner pair converges to the union in
+                # one exchange, still O(messages) traffic
+                # (SparseMeta.reverse_bytes).
                 req_digest = visible[
                     jnp.arange(nl * k, dtype=jnp.int32) // k]
                 req_digest = jnp.where(valid[:, None], req_digest,
                                        jnp.uint32(0))
                 D = req_digest.reshape(cap, p, w)             # [cap, p, W]
-                send_d = jnp.take(jnp.transpose(D, (1, 0, 2)), cols_for_dst,
-                                  axis=0)                     # [p, cap, W]
+                send_d = jnp.take(jnp.transpose(D, (1, 0, 2)),
+                                  cols_for_dst, axis=0)       # [p, cap, W]
                 recv_d = jax.lax.all_to_all(send_d, axis_name, 0, 0,
                                             tiled=False)
-                return _scatter_merge_digests(ok, recv, recv_d, nl,
-                                              proto.rumors, w)
+                pulled = pulled | _scatter_merge_digests(
+                    ok, recv, recv_d, nl, proto.rumors, w)
+            return pulled, jnp.sum(valid).astype(jnp.float32)
 
-            if proto.period > 1:
-                on = (round_ % proto.period) == 0
-                back_l = jax.lax.cond(on, reverse_delta,
-                                      lambda _: jnp.zeros_like(pulled),
-                                      None)
-                pulled = jnp.where(on, pulled, jnp.uint32(0))
-                n_req = jnp.where(on, n_req, 0.0)
-            else:
-                back_l = reverse_delta(None)
-            pulled = pulled | back_l
+        if proto.mode == C.ANTI_ENTROPY and proto.period > 1:
+            on = (round_ % proto.period) == 0
+            # the quiescent branch's constants must carry the same
+            # varying-manual-axes type as the exchange outputs
+            zf = jax.lax.pcast(jnp.float32(0.0), (axis_name,),
+                               to="varying")
+            quiet = (jnp.zeros_like(seen_l), zf)
+            pulled, n_req = jax.lax.cond(on, exchange,
+                                         lambda _: quiet, None)
+        else:
+            pulled, n_req = exchange(None)
         mfac = 3.0 if proto.mode == C.ANTI_ENTROPY else 2.0
         pulled = jnp.where(alive_l[:, None], pulled, jnp.uint32(0))
         msgs_new = msgs + jax.lax.psum(mfac * n_req, axis_name)
@@ -517,38 +533,43 @@ def make_sparse_topo_pull_round(
         rkey = jax.random.fold_in(base_key, round_)
         row_gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
         alive_l = sharded_alive(fault, n, n_pad, origin)[row_gids]
-
-        slot_gids = shard * S + jnp.arange(S, dtype=jnp.int32)
-        deg_slot = jnp.repeat(deg_l, k)
-        j = _slot_nbr_choice(rkey, slot_gids, deg_slot)
-        row_of_slot = jnp.arange(S, dtype=jnp.int32) // k
-        gid = nbrs_l[row_of_slot, j]                          # [S] global
-        valid = (_slot_valid(rkey, slot_gids, drop_prob, alive_l, k)
-                 & (deg_slot > 0))
-        dst_eff = jnp.where(valid, gid // nl, jnp.int32(p))
-        pos = _bucket_rank(dst_eff, p)
-        sent = valid & (pos < cap)
-
-        # out-of-range (dst_eff == p: invalid; pos >= cap: overflow)
-        # indices are dropped by the scatter, leaving the -1 sentinel
-        send_rows = jnp.full((p, cap), -1, jnp.int32
-                             ).at[dst_eff, pos].set(gid % nl, mode="drop")
-        recv = jax.lax.all_to_all(send_rows, axis_name, 0, 0, tiled=False)
         visible = jnp.where(alive_l[:, None], seen_l, jnp.uint32(0))
-        ok = recv >= 0
-        resp = visible[jnp.clip(recv, 0, nl - 1)]             # [p, cap, W]
-        resp = jnp.where(ok[:, :, None], resp, jnp.uint32(0))
-        back = jax.lax.all_to_all(resp, axis_name, 0, 0, tiled=False)
 
-        got = back[jnp.clip(dst_eff, 0, p - 1),
-                   jnp.clip(pos, 0, cap - 1)]                 # [S, W]
-        got = jnp.where(sent[:, None], got, jnp.uint32(0))
-        pulled = _or_reduce_k(got, nl, k)
+        def exchange(_):
+            """The whole round's sampling + collectives.  period > 1
+            cond-skips this ENTIRELY on quiescent rounds — no forward
+            bytes move either (draws are keyed by (round, slot id), so
+            skipped rounds never perturb later ones; the reference twin
+            computes-and-zeroes to the identical state)."""
+            slot_gids = shard * S + jnp.arange(S, dtype=jnp.int32)
+            deg_slot = jnp.repeat(deg_l, k)
+            j = _slot_nbr_choice(rkey, slot_gids, deg_slot)
+            row_of_slot = jnp.arange(S, dtype=jnp.int32) // k
+            gid = nbrs_l[row_of_slot, j]                      # [S] global
+            valid = (_slot_valid(rkey, slot_gids, drop_prob, alive_l, k)
+                     & (deg_slot > 0))
+            dst_eff = jnp.where(valid, gid // nl, jnp.int32(p))
+            pos = _bucket_rank(dst_eff, p)
+            sent = valid & (pos < cap)
 
-        n_sent = jnp.sum(sent).astype(jnp.float32)
-        n_over = jnp.sum(valid & ~sent).astype(jnp.float32)
-        if proto.mode == C.ANTI_ENTROPY:
-            def reverse_delta(_):
+            # out-of-range (dst_eff == p: invalid; pos >= cap: overflow)
+            # indices are dropped by the scatter, leaving the -1 sentinel
+            send_rows = jnp.full((p, cap), -1, jnp.int32
+                                 ).at[dst_eff, pos].set(gid % nl,
+                                                        mode="drop")
+            recv = jax.lax.all_to_all(send_rows, axis_name, 0, 0,
+                                      tiled=False)
+            ok = recv >= 0
+            resp = visible[jnp.clip(recv, 0, nl - 1)]         # [p, cap, W]
+            resp = jnp.where(ok[:, :, None], resp, jnp.uint32(0))
+            back = jax.lax.all_to_all(resp, axis_name, 0, 0, tiled=False)
+
+            got = back[jnp.clip(dst_eff, 0, p - 1),
+                       jnp.clip(pos, 0, cap - 1)]             # [S, W]
+            got = jnp.where(sent[:, None], got, jnp.uint32(0))
+            pulled = _or_reduce_k(got, nl, k)
+
+            if proto.mode == C.ANTI_ENTROPY:
                 # requester digest rides WITH the request in the same
                 # (dst, pos) bucket slot; the responder scatter-merges
                 # into the requested rows (complete-graph twin layout)
@@ -560,20 +581,23 @@ def make_sparse_topo_pull_round(
                                                           mode="drop")
                 recv_d = jax.lax.all_to_all(send_d, axis_name, 0, 0,
                                             tiled=False)
-                return _scatter_merge_digests(ok, recv, recv_d, nl,
-                                              proto.rumors, w)
+                pulled = pulled | _scatter_merge_digests(
+                    ok, recv, recv_d, nl, proto.rumors, w)
+            return (pulled,
+                    jnp.sum(sent).astype(jnp.float32),
+                    jnp.sum(valid & ~sent).astype(jnp.float32))
 
-            if proto.period > 1:
-                on = (round_ % proto.period) == 0
-                back_l = jax.lax.cond(on, reverse_delta,
-                                      lambda _: jnp.zeros_like(pulled),
-                                      None)
-                pulled = jnp.where(on, pulled, jnp.uint32(0))
-                n_sent = jnp.where(on, n_sent, 0.0)
-                n_over = jnp.where(on, n_over, 0.0)
-            else:
-                back_l = reverse_delta(None)
-            pulled = pulled | back_l
+        if proto.mode == C.ANTI_ENTROPY and proto.period > 1:
+            on = (round_ % proto.period) == 0
+            # the quiescent branch's constants must carry the same
+            # varying-manual-axes type as the exchange outputs
+            zf = jax.lax.pcast(jnp.float32(0.0), (axis_name,),
+                               to="varying")
+            quiet = (jnp.zeros_like(seen_l), zf, zf)
+            pulled, n_sent, n_over = jax.lax.cond(on, exchange,
+                                                  lambda _: quiet, None)
+        else:
+            pulled, n_sent, n_over = exchange(None)
         mfac = 3.0 if proto.mode == C.ANTI_ENTROPY else 2.0
         pulled = jnp.where(alive_l[:, None], pulled, jnp.uint32(0))
         msgs_new = msgs + jax.lax.psum(mfac * n_sent, axis_name)

@@ -110,7 +110,7 @@ def ensemble_curves(proto: ProtocolConfig, topo: Topology, run: RunConfig,
                           target=run.target_coverage)
 
 
-def config_sweep_curves_2d(points, topo: Topology, run: RunConfig,
+def config_sweep_curves_2d(points, topo, run: RunConfig,
                            mesh, fault: Optional[FaultConfig] = None,
                            k_max: Optional[int] = None, rumors: int = 1,
                            sweep_axis: str = "sweep",
@@ -126,6 +126,13 @@ def config_sweep_curves_2d(points, topo: Topology, run: RunConfig,
     configs.  Same trajectory definition as :func:`config_sweep_curves`
     (same RNG keying by global node id, same shared-``k_max`` draw widths),
     so results are identical to the 1-D batch for any mesh shape.
+
+    ``topo`` may be a SEQUENCE of same-n explicit topologies, exactly as
+    in :func:`config_sweep_curves`: families stack into one
+    ``int32[F, n_pad, D_max]`` operand whose ROWS shard over
+    ``node_axis``, and each point's ``topo_idx`` dynamic-slices its
+    family — the complete "sweep fanout, mode, and graph topology across
+    a TPU pod" program.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
     from gossip_tpu.parallel.sharded import (_pad_rows, pad_to_mesh,
@@ -136,16 +143,13 @@ def config_sweep_curves_2d(points, topo: Topology, run: RunConfig,
     if fault is not None and fault.drop_prob > 0.0:
         raise ValueError("per-config loss goes through SweepPoint.drop_prob;"
                          " FaultConfig.drop_prob would be ambiguous here")
-    if any(pt.topo_idx != 0 for pt in points):
-        raise ValueError("the 2-D pod sweep takes ONE topology; the "
-                         "family axis (topo_idx) is a config_sweep_curves"
-                         " feature")
+    topos, multi, topo0 = _normalize_topos(topo, points)
     cN = len(points)
     p_sweep = mesh.shape[sweep_axis]
     if cN % p_sweep != 0:
         raise ValueError(f"{cN} configs do not divide over the "
                          f"{sweep_axis} axis of size {p_sweep}")
-    n = topo.n
+    n = topo0.n
     n_pad = pad_to_mesh(n, mesh, node_axis)
     nl = n_pad // mesh.shape[node_axis]
     k_max = k_max or max(pt.fanout for pt in points)
@@ -155,15 +159,28 @@ def config_sweep_curves_2d(points, topo: Topology, run: RunConfig,
     # same static half-elision as config_sweep_curves (VERDICT r2 item 7)
     need_push = any(_MODE_FLAGS[pt.mode][0] for pt in points)
     need_pull = any(_MODE_FLAGS[pt.mode][1] for pt in points)
-    have_table = not topo.implicit
-    if have_table:
-        nbrs_pad = _pad_rows(topo.nbrs, n_pad, n)
-        deg_pad = _pad_rows(topo.deg, n_pad, 0)
+    have_table = not topo0.implicit
+    if multi:
+        nbrs_stack, deg_stack = _stack_topologies(topos)
+        # family stack rows pad to the node mesh (sentinel n rows,
+        # degree 0 — permanently dark, same as the single-family pad;
+        # a zero-width pad is a no-op)
+        tables = (jnp.pad(nbrs_stack, ((0, 0), (0, n_pad - n), (0, 0)),
+                          constant_values=n),
+                  jnp.pad(deg_stack, ((0, 0), (0, n_pad - n))))
+    elif have_table:
+        tables = (_pad_rows(topo0.nbrs, n_pad, n),
+                  _pad_rows(topo0.deg, n_pad, 0))
+    else:
+        tables = ()
 
     def one_cfg_round(seen_l, round_, base_key, msgs,
                       do_push, do_pull, do_ae, fanout, dropp, period,
-                      nbrs_l, deg_l):
+                      tidx, nbrs_l, deg_l):
         """One config's round on this node shard ([nl, R] rows)."""
+        if multi:
+            # per-config family slice of the node-sharded stack
+            nbrs_l, deg_l = nbrs_l[tidx], deg_l[tidx]
         shard = jax.lax.axis_index(node_axis)
         gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
         alive_l = sharded_alive(fault, n, n_pad, run.origin)[gids]
@@ -177,7 +194,7 @@ def config_sweep_curves_2d(points, topo: Topology, run: RunConfig,
             return jax.lax.dynamic_slice_in_dim(full, shard * nl, nl, 0)
 
         delta, msgs_round = _sweep_round_delta(
-            rkey, round_, gids, visible, alive_l, topo, k_max,
+            rkey, round_, gids, visible, alive_l, topo0, k_max,
             nbrs_l, deg_l, do_push, do_pull, do_ae, fanout, dropp, period,
             have_ae, scatter_n=n_pad, count_reduce=count_reduce,
             gather=lambda v: jax.lax.all_gather(v, node_axis, tiled=True),
@@ -194,18 +211,21 @@ def config_sweep_curves_2d(points, topo: Topology, run: RunConfig,
         return seen_new, msgs_new, cov
 
     def local_block(seen_b, round_, keys_b, msgs_b,
-                    dpush_b, dpull_b, dae_b, fan_b, drop_b, per_b, *table):
-        nbrs_l, deg_l = table if have_table else (None, None)
+                    dpush_b, dpull_b, dae_b, fan_b, drop_b, per_b, tidx_b,
+                    *table):
+        nbrs_l, deg_l = table if table else (None, None)
         return jax.vmap(
-            lambda s, key, m, a, b, c, f, d, p: one_cfg_round(
-                s, round_, key, m, a, b, c, f, d, p, nbrs_l, deg_l)
+            lambda s, key, m, a, b, c, f, d, p, t: one_cfg_round(
+                s, round_, key, m, a, b, c, f, d, p, t, nbrs_l, deg_l)
         )(seen_b, keys_b, msgs_b, dpush_b, dpull_b, dae_b, fan_b, drop_b,
-          per_b)
+          per_b, tidx_b)
 
     sw = P(sweep_axis)
     in_specs = [P(sweep_axis, node_axis, None), P(), sw, sw,
-                sw, sw, sw, sw, sw, sw]
-    if have_table:
+                sw, sw, sw, sw, sw, sw, sw]
+    if multi:
+        in_specs += [P(None, node_axis, None), P(None, node_axis)]
+    elif have_table:
         in_specs += [P(node_axis, None), P(node_axis)]
     mapped = jax.shard_map(local_block, mesh=mesh,
                            in_specs=tuple(in_specs),
@@ -223,17 +243,17 @@ def config_sweep_curves_2d(points, topo: Topology, run: RunConfig,
              jnp.asarray([pt.mode == C.ANTI_ENTROPY for pt in points]),
              jnp.asarray([pt.fanout for pt in points], jnp.int32),
              jnp.asarray([pt.drop_prob for pt in points], jnp.float32),
-             jnp.asarray([pt.period for pt in points], jnp.int32)]
+             jnp.asarray([pt.period for pt in points], jnp.int32),
+             jnp.asarray([pt.topo_idx for pt in points], jnp.int32)]
     init_seen = jax.device_put(
         init_seen, NamedSharding(mesh, P(sweep_axis, node_axis, None)))
     row = NamedSharding(mesh, P(sweep_axis))
     keys = jax.device_put(keys, row)
     flags = [jax.device_put(f, row) for f in flags]
-    tables = (nbrs_pad, deg_pad) if have_table else ()
 
     @jax.jit
     def scan(seen, keys, msgs, *args):
-        flags_, tbl = args[:6], args[6:]
+        flags_, tbl = args[:7], args[7:]
         def body(carry, round_):
             seen, msgs = carry
             seen, msgs, covs = mapped(seen, round_, keys, msgs, *flags_,
@@ -397,6 +417,17 @@ def _sweep_round_delta(rkey, round_, gids, visible, alive_l, topo, k_max,
     return delta & alive_l[:, None], msgs
 
 
+def _normalize_topos(topo, points):
+    """(topos, multi, topo0) from a Topology-or-sequence argument, with
+    the ONE topo_idx range check both sweep entry points share."""
+    topos = tuple(topo) if isinstance(topo, (list, tuple)) else (topo,)
+    if any(pt.topo_idx >= len(topos) for pt in points):
+        raise ValueError(
+            f"a point's topo_idx is past the {len(topos)} supplied "
+            "topolog(ies)")
+    return topos, len(topos) > 1, topos[0]
+
+
 def _stack_topologies(topos):
     """Same-n explicit topologies -> (nbrs_stack[F, n, D_max],
     deg_stack[F, n]), neighbor columns padded with the sentinel n.  The
@@ -468,13 +499,7 @@ def config_sweep_curves(points, topo, run: RunConfig,
             f"{len(points)} configs do not divide over the {axis_name} "
             f"mesh axis of size {mesh.shape[axis_name]}; pad the batch "
             "(duplicate a point) or change the mesh")
-    topos = tuple(topo) if isinstance(topo, (list, tuple)) else (topo,)
-    multi = len(topos) > 1
-    if any(pt.topo_idx >= len(topos) for pt in points):
-        raise ValueError(
-            f"a point's topo_idx is past the {len(topos)} supplied "
-            "topolog(ies)")
-    topo0 = topos[0]
+    topos, multi, topo0 = _normalize_topos(topo, points)
     n = topo0.n
     k_max = k_max or max(pt.fanout for pt in points)
     if any(pt.fanout > k_max for pt in points):

@@ -199,13 +199,30 @@ def test_topology_axis_validation():
     with pytest.raises(ValueError, match="implicit|explicit"):
         config_sweep_curves([SweepPoint()], [fams[0], G.complete(256)],
                             run)
-    with pytest.raises(ValueError, match="ONE topology"):
+    with pytest.raises(ValueError, match="past"):
         from jax.sharding import Mesh
         import jax as _jax
         mesh2d = Mesh(np.asarray(_jax.devices()[:8]).reshape(2, 4),
                       ("sweep", "nodes"))
-        config_sweep_curves_2d([SweepPoint(topo_idx=1)], fams[0], run,
-                               mesh2d)
+        config_sweep_curves_2d([SweepPoint(topo_idx=1), SweepPoint()],
+                               fams[0], run, mesh2d)
+
+
+def test_2d_pod_sweep_with_topology_axis_matches_1d():
+    """Families × modes on the full 2-D (configs × node-shards) mesh:
+    trajectories identical to the 1-D families batch."""
+    from jax.sharding import Mesh
+    import jax as _jax
+    fams = _families(256)[:2]
+    run = RunConfig(seed=0, max_rounds=16)
+    pts = [SweepPoint(mode=m, fanout=1, seed=1, topo_idx=t)
+           for t in range(2) for m in (C.PUSH, C.PULL)]
+    solo = config_sweep_curves(pts, fams, run)
+    mesh2d = Mesh(np.asarray(_jax.devices()[:8]).reshape(2, 4),
+                  ("sweep", "nodes"))
+    pod = config_sweep_curves_2d(pts, fams, run, mesh2d)
+    np.testing.assert_allclose(pod.curves, solo.curves, atol=1e-6)
+    np.testing.assert_array_equal(pod.msgs, solo.msgs)
 
 
 # ---------------------------------------------------------------------
