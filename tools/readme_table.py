@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Render the README hardware table from a baseline-sweep JSONL artifact.
+
+Usage:
+    python tools/readme_table.py artifacts/baseline_sweep_r02b.jsonl
+
+Prints the markdown table with the round-3 contract columns — wall,
+compile, and steady-state separated (RunReport meta ``compile_s`` /
+``steady_wall_s``; multi-device sharded engines report one fused wall,
+shown as '—').  Paste over the table in README.md's "BASELINE configs
+measured on hardware" section after a hardware refresh
+(tools/hw_refresh.py step 'baseline_sweep' writes the artifact).
+"""
+
+import json
+import sys
+
+
+def fmt_s(v):
+    if v is None:
+        return "—"
+    return f"{v:.1f} s" if v >= 0.095 else f"{v * 1e3:.0f} ms"
+
+
+def main(path):
+    rows = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                rows.append(json.loads(line))
+    print("| config | n | rounds to target | coverage / detection "
+          "| wall | compile | steady |")
+    print("|---|---|---|---|---|---|---|")
+    for r in rows:
+        meta = r.get("meta", {})
+        n = r["n"]
+        n_str = (f"{n // 1_000_000}M" if n >= 1_000_000 and
+                 n % 1_000_000 == 0 else
+                 f"{n // 1000}k" if n >= 1000 and n % 1000 == 0 else
+                 str(n))
+        print(f"| {r['config']} | {n_str} | {r['rounds']} "
+              f"| {round(r['coverage'], 4)} | {fmt_s(r['wall_s'])} "
+              f"| {fmt_s(meta.get('compile_s'))} "
+              f"| {fmt_s(meta.get('steady_wall_s'))} |")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        print(__doc__, file=sys.stderr)
+        sys.exit(2)
+    sys.exit(main(sys.argv[1]))
