@@ -1,26 +1,31 @@
 #!/usr/bin/env python
-"""One-shot hardware refresh: every measurement round 2 owes the chip.
+"""One-shot hardware refresh: every measurement the rounds owe the chip.
 
 Run when the axon tunnel is healthy (probe first — see
-memory: a wedged tunnel hangs any jax init).  The outer timeout must
-cover the sum of ALL per-step subprocess timeouts at their worst —
-1200 (mr) + 2400 (sweep) + bench's worst case (~6020 s at the default
-GOSSIP_BENCH_PROBE_ATTEMPTS=3; bench.worst_case_budget_s() gives the
-exact number for other settings) + 2400 (pallas tests) ≈ 12,100 s:
+memory: a wedged tunnel hangs any jax init; tools/tunnel_watchdog.py
+probes on a schedule and launches this script at the first healthy
+window).  The outer timeout must cover the sum of ALL per-step
+subprocess timeouts at their worst; ``worst_case_budget_s()`` below
+computes it from the same constants the steps use (at the default
+GOSSIP_BENCH_PROBE_ATTEMPTS=3 it is 1200 (mr) + 900 (prng) +
+2400 (sweep) + ~6020 (bench worst case) + 2400 (pallas tests)
+= 12,920 s):
 
-    timeout 12600 python tools/hw_refresh.py      # default attempts
+    timeout 13500 python tools/hw_refresh.py      # default attempts
 
 Steps (each prints a tagged JSON line; failures don't stop later steps):
   1. staged big-table MR kernel validation at 10M x 32 rumors
      (post-padding variant) + per-round timing
-  2. the five BASELINE configs at full scale
-     -> artifacts/baseline_sweep_r02b.jsonl
-  3. bench.py headline
-  4. TPU-only pallas statistics tests
-     -> artifacts/tpu_pallas_tests_r02b.txt
+  2. hardware-PRNG digest of the plane-sharded fused round
+  3. the five BASELINE configs at full scale
+     -> artifacts/baseline_sweep_r04.jsonl
+  4. bench.py headline
+  5. TPU-only pallas statistics tests
+     -> artifacts/tpu_pallas_tests_r04.txt
 
-Afterwards update README.md's hardware table and docs/PERF.md's pending
-numbers from the printed lines.
+All step lines are also collected into artifacts/hw_refresh_r04.json.
+Afterwards update README.md's hardware table (tools/readme_table.py)
+and docs/PERF.md's pending numbers from the recorded lines.
 """
 
 import json
@@ -31,19 +36,84 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+MR_TIMEOUT_S = 1200
+PRNG_TIMEOUT_S = 900
+SWEEP_TIMEOUT_S = 2400
+TESTS_TIMEOUT_S = 2400
+BENCH_SLACK_S = 200
+SUMMARY_PATH = os.path.join(REPO, "artifacts", "hw_refresh_r04.json")
+
+
+def _load_bench():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    bench_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_mod)
+    return bench_mod
+
+
+def bench_budget_s():
+    """bench.py's self-computed worst case plus this script's slack —
+    the ONE place the bench step's timeout is defined."""
+    return _load_bench().worst_case_budget_s() + BENCH_SLACK_S
+
+
+def worst_case_budget_s():
+    """Sum of every per-step subprocess timeout, so the recommended outer
+    ``timeout`` can't silently drift below what a fully wedged run needs
+    (bench's own worst case is computed by bench.py from its probe/body
+    constants)."""
+    return (MR_TIMEOUT_S + PRNG_TIMEOUT_S + SWEEP_TIMEOUT_S
+            + bench_budget_s() + TESTS_TIMEOUT_S)
+
+
+def load_summary():
+    """Prior runs' step lines, keyed by step name — a retry must MERGE
+    with these, never clobber a green result captured in an earlier
+    healthy window."""
+    try:
+        with open(SUMMARY_PATH) as f:
+            return {r["step"]: r for r in json.load(f)}
+    except (OSError, ValueError, KeyError, TypeError):
+        return {}
+
+
+_SUMMARY = load_summary()
+
 
 def step(tag, fn):
+    """Run one step; record its line in the merged summary.  Returns
+    ``True`` (green), ``False`` (failed), or ``"timeout"`` — the
+    subprocess-overran-its-budget case, which on the single-client axon
+    tunnel is the wedge signature: the caller should stop burning the
+    remaining steps' timeouts against a dead tunnel."""
     t0 = time.time()
     try:
         out = fn()
-        print(json.dumps({"step": tag, "ok": True,
-                          "wall_s": round(time.time() - t0, 1),
-                          "result": out}), flush=True)
+        line = {"step": tag, "ok": True,
+                "wall_s": round(time.time() - t0, 1), "result": out}
+    except subprocess.TimeoutExpired as e:
+        line = {"step": tag, "ok": False, "timed_out": True,
+                "wall_s": round(time.time() - t0, 1),
+                "error": f"TimeoutExpired: {e}"[:500]}
     except Exception as e:  # keep going; later steps still run
-        print(json.dumps({"step": tag, "ok": False,
-                          "wall_s": round(time.time() - t0, 1),
-                          "error": f"{type(e).__name__}: {e}"[:500]}),
-              flush=True)
+        line = {"step": tag, "ok": False,
+                "wall_s": round(time.time() - t0, 1),
+                "error": f"{type(e).__name__}: {e}"[:500]}
+    print(json.dumps(line), flush=True)
+    # persist after EVERY step so an outer-timeout kill still leaves the
+    # completed steps on disk as a committable artifact; a failed write
+    # must not abort the remaining steps (stdout still carries the line)
+    _SUMMARY[tag] = line
+    try:
+        with open(SUMMARY_PATH, "w") as f:
+            json.dump(list(_SUMMARY.values()), f, indent=1)
+    except OSError as e:
+        print(f"hw_refresh: summary write failed: {e}", file=sys.stderr)
+    if line.get("timed_out"):
+        return "timeout"
+    return line["ok"]
 
 
 def _mr_staged_body():
@@ -104,8 +174,8 @@ def prng_invariant():
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     p = subprocess.run([sys.executable, os.path.abspath(__file__),
                         "--prng-body"],
-                       capture_output=True, text=True, timeout=900,
-                       cwd=REPO, env=env)
+                       capture_output=True, text=True,
+                       timeout=PRNG_TIMEOUT_S, cwd=REPO, env=env)
     if p.returncode != 0:
         raise RuntimeError((p.stderr or p.stdout)[-400:])
     return json.loads(p.stdout.strip().splitlines()[-1])
@@ -118,24 +188,63 @@ def mr_staged_10m():
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     p = subprocess.run([sys.executable, os.path.abspath(__file__),
                         "--mr-body"],
-                       capture_output=True, text=True, timeout=1200,
-                       cwd=REPO, env=env)
+                       capture_output=True, text=True,
+                       timeout=MR_TIMEOUT_S, cwd=REPO, env=env)
     if p.returncode != 0:
         raise RuntimeError((p.stderr or p.stdout)[-400:])
     return json.loads(p.stdout.strip().splitlines()[-1])
 
 
+def _write_sweep_artifact(stdout):
+    """Persist whatever config lines the sweep produced — a crash or
+    timeout on config 5 must not discard 4 completed full-scale
+    hardware measurements from a scarce healthy window.  MERGES with an
+    existing artifact by config name (new rows win) so a retry that got
+    less far can never clobber rows a fuller earlier attempt captured."""
+    art = os.path.join(REPO, "artifacts", "baseline_sweep_r04.jsonl")
+    if isinstance(stdout, bytes):
+        stdout = stdout.decode(errors="replace")
+    stdout = stdout or ""
+
+    def rows_by_config(text):
+        rows = {}
+        for line in text.splitlines():
+            try:
+                r = json.loads(line)
+                rows[r["config"]] = line
+            except (ValueError, KeyError, TypeError):
+                continue
+        return rows
+
+    new = rows_by_config(stdout)
+    if new:
+        merged = {}
+        try:
+            with open(art) as f:
+                merged = rows_by_config(f.read())
+        except OSError:
+            pass
+        merged.update(new)
+        with open(art, "w") as f:
+            f.write("\n".join(merged.values()) + "\n")
+    return stdout
+
+
 def baseline_sweep():
-    art = os.path.join(REPO, "artifacts", "baseline_sweep_r02b.jsonl")
-    p = subprocess.run([sys.executable, "-m", "gossip_tpu", "sweep",
-                        "--scale", "1.0"],
-                       capture_output=True, text=True, timeout=2400,
-                       cwd=REPO)
+    try:
+        # -u: the per-config JSONL lines must not die in the child's
+        # block buffer when a timeout SIGKILLs it mid-sweep
+        p = subprocess.run([sys.executable, "-u", "-m", "gossip_tpu",
+                            "sweep", "--scale", "1.0"],
+                           capture_output=True, text=True,
+                           timeout=SWEEP_TIMEOUT_S, cwd=REPO)
+    except subprocess.TimeoutExpired as e:
+        _write_sweep_artifact(e.stdout)
+        raise
+    out = _write_sweep_artifact(p.stdout)
     if p.returncode != 0:
         raise RuntimeError(p.stderr[-400:])
-    with open(art, "w") as f:
-        f.write(p.stdout)
-    rows = [json.loads(line) for line in p.stdout.splitlines()]
+    rows = [json.loads(line) for line in out.splitlines() if line.strip()]
     return [{"config": r["config"], "rounds": r["rounds"],
              "coverage": round(r["coverage"], 4), "wall_s": r["wall_s"],
              "compile_s": r.get("meta", {}).get("compile_s"),
@@ -148,29 +257,37 @@ def bench():
     # must outlast bench.py's own worst case (probe retries + body +
     # hermetic retry) — computed by bench.py itself from the same
     # constants its loops use, so the budget can't drift
-    import importlib.util
-    spec = importlib.util.spec_from_file_location(
-        "bench", os.path.join(REPO, "bench.py"))
-    bench_mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench_mod)
-    budget = bench_mod.worst_case_budget_s() + 200
     p = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
-                       capture_output=True, text=True, timeout=budget,
-                       cwd=REPO)
+                       capture_output=True, text=True,
+                       timeout=bench_budget_s(), cwd=REPO)
     if p.returncode != 0:
         raise RuntimeError((p.stderr or p.stdout)[-400:])
     return json.loads(p.stdout.strip().splitlines()[-1])
 
 
 def tpu_pallas_tests():
-    art = os.path.join(REPO, "artifacts", "tpu_pallas_tests_r02b.txt")
+    art = os.path.join(REPO, "artifacts", "tpu_pallas_tests_r04.txt")
     # conftest pins tests to CPU unless this var points at the chip
     env = {**os.environ, "GOSSIP_TPU_TEST_PLATFORM": "axon"}
-    p = subprocess.run([sys.executable, "-m", "pytest",
-                        "tests/test_pallas.py", "tests/test_pallas_round.py",
-                        "-q"],
-                       capture_output=True, text=True, timeout=2400,
-                       cwd=REPO, env=env)
+
+    def _text(x):
+        return ("" if x is None else
+                x if isinstance(x, str) else x.decode(errors="replace"))
+
+    try:
+        # -u for the same reason as the sweep: per-test progress must
+        # survive a timeout SIGKILL for the partial artifact to exist
+        p = subprocess.run([sys.executable, "-u", "-m", "pytest",
+                            "tests/test_pallas.py",
+                            "tests/test_pallas_round.py", "-q"],
+                           capture_output=True, text=True,
+                           timeout=TESTS_TIMEOUT_S, cwd=REPO, env=env)
+    except subprocess.TimeoutExpired as e:
+        with open(art, "w") as f:
+            f.write(_text(e.stdout) + "\n--- TIMED OUT after "
+                    f"{TESTS_TIMEOUT_S} s ---\n--- stderr ---\n"
+                    + _text(e.stderr)[-2000:])
+        raise
     with open(art, "w") as f:
         f.write(p.stdout + "\n--- stderr ---\n" + p.stderr[-2000:])
     tail = p.stdout.strip().splitlines()[-1] if p.stdout.strip() else ""
@@ -179,13 +296,46 @@ def tpu_pallas_tests():
     return tail
 
 
-def main():
-    step("mr_staged_10m", mr_staged_10m)
-    step("prng_invariant", prng_invariant)
-    step("baseline_sweep", baseline_sweep)
-    step("bench", bench)
-    step("tpu_pallas_tests", tpu_pallas_tests)
-    return 0
+STEPS = [("mr_staged_10m", mr_staged_10m),
+         ("prng_invariant", prng_invariant),
+         ("baseline_sweep", baseline_sweep),
+         ("bench", bench),
+         ("tpu_pallas_tests", tpu_pallas_tests)]
+
+
+def pending_steps():
+    """Step names without a green line in the merged summary — what a
+    retry should run instead of re-burning already-captured steps."""
+    done = load_summary()
+    return [t for t, _ in STEPS if not done.get(t, {}).get("ok")]
+
+
+def main(only=None):
+    """Exit code reports overall outcome so callers (tunnel_watchdog)
+    can tell a captured refresh from a burned window: 0 = every
+    requested step ok, 1 = partial (some landed), 2 = nothing
+    succeeded.  ``only`` (or --steps a,b on the CLI) restricts to the
+    named steps; a step TIMEOUT aborts the rest — on the single-client
+    tunnel it means the window just closed, and each remaining step
+    would deterministically burn its full budget against a wedged
+    tunnel before the watchdog could resume probing."""
+    if only is not None and not list(only):
+        print(json.dumps({"nothing_pending": True}), flush=True)
+        return 0
+    results = []
+    for tag, fn in STEPS:
+        if only is not None and tag not in only:
+            continue
+        r = step(tag, fn)
+        results.append(r)
+        if r == "timeout":
+            print(json.dumps({"aborted_after": tag,
+                              "reason": "step timeout = wedge signature; "
+                                        "not burning remaining budgets"}),
+                  flush=True)
+            break
+    oks = [r is True for r in results]
+    return 0 if oks and all(oks) else (1 if any(oks) else 2)
 
 
 if __name__ == "__main__":
@@ -193,4 +343,19 @@ if __name__ == "__main__":
         sys.exit(_mr_staged_body())
     if "--prng-body" in sys.argv:
         sys.exit(_prng_body())
-    sys.exit(main())
+    only = None
+    if "--steps" in sys.argv:
+        idx = sys.argv.index("--steps") + 1
+        if idx >= len(sys.argv):
+            print("--steps needs a comma-separated value, e.g. "
+                  "--steps bench,tpu_pallas_tests", file=sys.stderr)
+            sys.exit(2)
+        names = sys.argv[idx].split(",")
+        known = {t for t, _ in STEPS}
+        bad = [n for n in names if n and n not in known]
+        if bad:
+            print(f"unknown steps: {bad}; known: {sorted(known)}",
+                  file=sys.stderr)
+            sys.exit(2)
+        only = [n for n in names if n]
+    sys.exit(main(only))

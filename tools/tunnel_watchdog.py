@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Tunnel watchdog: probe the axon TPU tunnel on a timer and fire the
+hardware refresh at the FIRST healthy window.
+
+The single-client axon tunnel wedges for an hour or more when a TPU
+process dies mid-operation, and a wedged tunnel hangs ANY jax init —
+so hardware capture can't be an end-of-round step; it has to pounce on
+whatever healthy window appears during the round.  This script:
+
+  1. probes ``jax.devices()`` in a subprocess (120 s timeout — a healthy
+     tunnel answers in seconds; a timeout is the wedge signature),
+  2. appends one JSON line per probe to
+     artifacts/tunnel_health_r04.jsonl,
+  3. on the first success, immediately runs tools/hw_refresh.py under
+     its own worst-case budget, tee-ing output to
+     artifacts/hw_refresh_r04.log, then exits.
+
+Probes are spaced far apart (default 1200 s) because killing a
+timed-out probe itself leaves a dead TPU-client process, which can
+prolong a wedge — few probes, long sleeps is the same trade bench.py's
+retry loop makes.  Only the wedge signature (timeout) is retried;
+three consecutive FAST probe failures (broken install / plugin import
+error) are deterministic, so the watchdog gives up rather than burn
+the round probing a dead configuration.
+
+    nohup python tools/tunnel_watchdog.py --max-hours 10 &
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HEALTH_LOG = os.path.join(REPO, "artifacts", "tunnel_health_r04.jsonl")
+REFRESH_LOG = os.path.join(REPO, "artifacts", "hw_refresh_r04.log")
+PROBE_TIMEOUT_S = 120
+
+
+def log_line(obj):
+    obj["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(HEALTH_LOG, "a") as f:
+        f.write(json.dumps(obj) + "\n")
+    print(json.dumps(obj), flush=True)
+
+
+def probe():
+    """(ok, detail).  detail is 'timeout' for the wedge signature,
+    'fast-fail' for a deterministic init error, or the device list."""
+    t0 = time.time()
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices())"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        return False, "timeout", round(time.time() - t0, 1)
+    wall = round(time.time() - t0, 1)
+    if p.returncode != 0:
+        return False, "fast-fail: " + (p.stderr or "")[-200:], wall
+    return True, p.stdout.strip()[-200:], wall
+
+
+def run_refresh():
+    """hw_refresh (pending steps only) under its worst-case budget.
+
+    Returns hw_refresh's exit code (0 every pending step went green /
+    1 partial / 2 nothing / "timeout").  Retries are incremental:
+    hw_refresh merges its per-step summary across runs, so only the
+    steps without a green line are re-run — a captured headline from an
+    earlier window is never re-burned or clobbered.  The child runs in
+    its own process group and the WHOLE group is killed on timeout:
+    hw_refresh's steps are grandchild subprocesses holding the
+    single-client tunnel, and killing only the middle process would
+    leave an unsupervised TPU client wedging it for everyone after
+    us."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import signal
+
+    import hw_refresh
+    pending = hw_refresh.pending_steps()
+    if not pending:
+        log_line({"event": "hw_refresh_skip",
+                  "reason": "summary already fully green"})
+        return 0
+    budget = hw_refresh.worst_case_budget_s() + 300
+    log_line({"event": "hw_refresh_start", "budget_s": budget,
+              "steps": pending})
+    with open(REFRESH_LOG, "a") as f:
+        f.write(f"\n=== attempt at {time.strftime('%Y-%m-%dT%H:%M:%S')} "
+                f"steps={','.join(pending)} ===\n")
+        f.flush()
+        p = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tools", "hw_refresh.py"),
+             "--steps", ",".join(pending)],
+            stdout=f, stderr=subprocess.STDOUT, cwd=REPO,
+            start_new_session=True)
+        try:
+            rc = p.wait(timeout=budget)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            p.wait()
+            rc = "timeout"
+    log_line({"event": "hw_refresh_done", "rc": rc})
+    return rc
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-hours", type=float, default=10.0)
+    ap.add_argument("--sleep-s", type=int, default=1200)
+    ap.add_argument("--once", action="store_true",
+                    help="one probe, no refresh launch (health logging "
+                         "only)")
+    args = ap.parse_args()
+    deadline = time.time() + args.max_hours * 3600
+    fast_fails = 0
+    refresh_attempts = 0
+    while time.time() < deadline:
+        ok, detail, wall = probe()
+        log_line({"event": "probe", "ok": ok, "wall_s": wall,
+                  "detail": detail})
+        if args.once:
+            return 0 if ok else 1
+        if ok:
+            rc = run_refresh()
+            if rc == 0:
+                return 0
+            # partial/failed/timed-out refresh: the tunnel may have
+            # re-wedged mid-run — keep probing and retry (bounded;
+            # retries are incremental, re-running only non-green steps)
+            refresh_attempts += 1
+            if refresh_attempts >= 3:
+                log_line({"event": "giving_up",
+                          "reason": "3 refresh attempts without a "
+                                    "fully-green run", "last_rc": rc})
+                return 1
+        if detail.startswith("fast-fail"):
+            fast_fails += 1
+            if fast_fails >= 3:
+                log_line({"event": "giving_up",
+                          "reason": "3 consecutive fast probe failures"})
+                return 2
+        else:
+            fast_fails = 0
+        time.sleep(max(0.0, min(args.sleep_s,
+                                deadline - time.time())))
+    log_line({"event": "deadline", "reason": "no healthy window"})
+    return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
