@@ -95,11 +95,14 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                    help="capture a jax.profiler trace of the run into "
                         "LOGDIR (TensorBoard profile plugin / Perfetto)")
     p.add_argument("--checkpoint", default=None, metavar="PATH",
-                   help="checkpointed driver (SI modes, single device): "
+                   help="checkpointed driver (single-device SI, sharded "
+                        "packed via --devices, or --engine fused planes): "
                         "run max_rounds rounds saving an atomic npz every "
                         "--checkpoint-every rounds; with --resume, "
                         "continue a previous run from PATH (bitwise "
-                        "continuation incl. the PRNG key)")
+                        "continuation incl. the PRNG key); composes with "
+                        "--curve/--save-curve (curve persists in the "
+                        "checkpoint and resumes seamlessly)")
     p.add_argument("--checkpoint-every", type=int, default=50)
     p.add_argument("--resume", action="store_true",
                    help="load --checkpoint PATH and continue to "
@@ -275,11 +278,6 @@ def cmd_run(a) -> int:
               "continue from)", file=sys.stderr)
         return 2
     if a.checkpoint:
-        if a.curve or a.save_curve:
-            print("error: --checkpoint drives compiled fori_loop segments "
-                  "with no per-round curve capture; drop --curve/"
-                  "--save-curve", file=sys.stderr)
-            return 2
         with trace(a.profile):
             return _cmd_run_checkpointed(a, proto, tc, run, fault, mesh)
     want_curve = a.curve or bool(a.save_curve)
@@ -301,43 +299,80 @@ def cmd_run(a) -> int:
 
 
 def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
-    """--checkpoint driver: fixed-round SI run in compiled fori_loop
-    segments with an atomic npz every --checkpoint-every rounds; --resume
-    continues a saved run to max_rounds TOTAL rounds, bitwise identical
-    to an uninterrupted run (tests/test_utils.py property)."""
+    """--checkpoint driver: fixed-round run in compiled segments with an
+    atomic npz every --checkpoint-every rounds; --resume continues a
+    saved run to max_rounds TOTAL rounds, bitwise identical to an
+    uninterrupted run (tests/test_utils.py, test_checkpoint_sharded.py).
+
+    Three engines (round-4: the flagship sharded/fused runs are the only
+    ones long enough to need persistence — the reference loses all state
+    on process death, main.go:22-26):
+
+    * single device, engine auto/xla  — the SI XLA kernels;
+    * --devices > 1, dense exchange   — the node-sharded packed engine
+      (pull/antientropy);
+    * --engine fused                  — the rumor-plane fused engine
+      (any --devices; the checkpoint carries the plane stack).
+
+    --curve/--save-curve compose with all of them: segments run as a
+    compiled scan recording per-round coverage, and the curve-so-far is
+    persisted in the checkpoint so --resume continues it seamlessly."""
     import os
 
-    if (a.backend != "jax-tpu" or a.mode in ("swim", "rumor")
-            or (mesh is not None and mesh.n_devices > 1)
-            or run.engine == "fused"):
-        print("error: --checkpoint drives the single-device SI XLA "
-              "kernels (jax-tpu backend, non-swim/rumor mode)",
-              file=sys.stderr)
+    n_dev = 1 if mesh is None else mesh.n_devices
+    exchange = "dense" if mesh is None else mesh.exchange
+    want_curve = a.curve or bool(a.save_curve)
+    if a.backend != "jax-tpu" or a.mode in ("swim", "rumor"):
+        print("error: --checkpoint drives the jax-tpu SI engines "
+              "(non-swim/rumor mode)", file=sys.stderr)
         return 2
+    fused = run.engine == "fused"
+    if fused:
+        from gossip_tpu.backend import _fused_ineligible_reason
+        reason = _fused_ineligible_reason(proto, tc, fault, n_dev,
+                                          want_curve=False)
+        if reason is not None:
+            print(f"error: {reason}", file=sys.stderr)
+            return 2
+    elif n_dev > 1:
+        from gossip_tpu.parallel.sharded_packed import (
+            sharded_checkpoint_ineligible_reason)
+        reason = sharded_checkpoint_ineligible_reason(proto, exchange)
+        if reason is not None:
+            print(f"error: {reason}", file=sys.stderr)
+            return 2
     import dataclasses
 
-    from gossip_tpu.models.si import coverage, make_si_round
-    from gossip_tpu.models.state import alive_mask, init_state
     from gossip_tpu.topology import generators as G
-    from gossip_tpu.utils.checkpoint import (load_meta, load_state,
-                                             run_with_checkpoints)
-    topo = G.build(tc)
-    step, tables = make_si_round(proto, topo, fault, run.origin, tabled=True)
+    from gossip_tpu.utils.checkpoint import load_meta, load_state
+
     # Config fingerprint stored with every checkpoint: resume refuses
     # mismatched flags instead of silently continuing a DIFFERENT run
-    # (the bitwise-continuation promise is per-config).
+    # (the bitwise-continuation promise is per-config; devices is part
+    # of it — mesh padding and plane layout depend on the mesh shape).
     fingerprint = {"proto": dataclasses.asdict(proto),
                    "tc": dataclasses.asdict(tc),
                    "fault": None if fault is None
                    else dataclasses.asdict(fault),
-                   "seed": run.seed, "origin": run.origin}
+                   "seed": run.seed, "origin": run.origin,
+                   "devices": n_dev, "exchange": exchange,
+                   "engine": "fused" if fused else "xla"}
     resumed = False
+    resume_state = None
+    curve_prefix = ()
     if a.resume:
         if not os.path.exists(a.checkpoint):
             print(f"error: --resume: no checkpoint at {a.checkpoint}",
                   file=sys.stderr)
             return 2
-        saved = load_meta(a.checkpoint).get("extra", {}).get("config")
+        meta = load_meta(a.checkpoint)
+        saved = meta.get("extra", {}).get("config")
+        if saved is not None:
+            # pre-round-4 checkpoints lack the devices/exchange/engine
+            # keys; they were all written by the single-device XLA
+            # driver, so defaulting preserves their resumability
+            saved = {"devices": 1, "exchange": "dense", "engine": "xla",
+                     **saved}
         if saved is not None and saved != json.loads(
                 json.dumps(fingerprint)):
             diff = [k for k in fingerprint
@@ -348,23 +383,82 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
                   "flags the checkpoint was written with",
                   file=sys.stderr)
             return 2
-        state = load_state(a.checkpoint)
+        saved_curve = meta.get("extra", {}).get("curve")
+        # curve history must match the request, both ways — a silently
+        # truncated or silently dropped curve is worse than an error
+        # (the repo's incompatible-flag policy)
+        if want_curve and saved_curve is None:
+            print("error: --resume with --curve/--save-curve, but the "
+                  "checkpoint has no curve history (it was written "
+                  "without curve capture); drop the curve flags or "
+                  "restart without --resume", file=sys.stderr)
+            return 2
+        if saved_curve is not None and not want_curve:
+            print("error: the checkpoint carries a curve history; add "
+                  "--curve or --save-curve to continue it (refusing to "
+                  "silently drop it)", file=sys.stderr)
+            return 2
+        curve_prefix = tuple(saved_curve or ())
+        resume_state = load_state(a.checkpoint)
         resumed = True
+
+    extra = {"config": fingerprint}
+    if fused:
+        from gossip_tpu.parallel.sharded_fused import (
+            checkpointed_fused_planes, make_plane_mesh)
+        final, cov, curve = checkpointed_fused_planes(
+            tc.n, proto.rumors, run, make_plane_mesh(n_dev), a.checkpoint,
+            every=a.checkpoint_every, fanout=proto.fanout,
+            resume_state=resume_state, want_curve=want_curve,
+            curve_prefix=curve_prefix, extra_meta=extra)
+        engine_label = "fused-pallas-planes"
+    elif n_dev > 1:
+        from gossip_tpu.parallel.sharded import make_mesh
+        from gossip_tpu.parallel.sharded_packed import (
+            checkpointed_packed_sharded)
+        final, cov, curve = checkpointed_packed_sharded(
+            proto, G.build(tc), run, make_mesh(n_dev), a.checkpoint,
+            every=a.checkpoint_every, fault=fault,
+            resume_state=resume_state, want_curve=want_curve,
+            curve_prefix=curve_prefix, extra_meta=extra)
+        engine_label = "sharded-packed"
     else:
-        state = init_state(run, proto, tc.n)
-    remaining = max(0, run.max_rounds - int(state.round))
-    state = run_with_checkpoints(step, state, remaining, a.checkpoint,
-                                 every=a.checkpoint_every,
-                                 step_args=tables,
-                                 extra_meta={"config": fingerprint})
-    alive = alive_mask(fault, tc.n, run.origin)
+        from gossip_tpu.models.si import coverage, make_si_round
+        from gossip_tpu.models.state import alive_mask, init_state
+        from gossip_tpu.utils.checkpoint import run_with_checkpoints
+        topo = G.build(tc)
+        step, tables = make_si_round(proto, topo, fault, run.origin,
+                                     tabled=True)
+        state = resume_state if resumed else init_state(run, proto, tc.n)
+        curve_fn = None
+        if want_curve:
+            def curve_fn(s):
+                return coverage(s.seen, alive_mask(fault, tc.n,
+                                                   run.origin))
+        remaining = max(0, run.max_rounds - int(state.round))
+        out_state = run_with_checkpoints(step, state, remaining,
+                                         a.checkpoint,
+                                         every=a.checkpoint_every,
+                                         step_args=tables,
+                                         curve_fn=curve_fn,
+                                         curve_prefix=curve_prefix,
+                                         extra_meta=extra)
+        final, curve = (out_state if want_curve else (out_state, None))
+        cov = float(coverage(final.seen,
+                             alive_mask(fault, tc.n, run.origin)))
+        engine_label = "si-xla"
     out = {"backend": a.backend, "mode": a.mode, "n": tc.n,
-           "rounds": int(state.round),
-           "coverage": float(coverage(state.seen, alive)),
-           "msgs": float(state.msgs), "checkpoint": a.checkpoint,
-           "checkpoint_every": a.checkpoint_every, "resumed": resumed}
+           "rounds": int(final.round), "coverage": cov,
+           "msgs": float(final.msgs), "checkpoint": a.checkpoint,
+           "checkpoint_every": a.checkpoint_every, "resumed": resumed,
+           "engine": engine_label, "devices": n_dev}
     if a.profile:
         out["profile_logdir"] = a.profile
+    if a.save_curve:
+        from gossip_tpu.utils.metrics import dump_curve_jsonl
+        dump_curve_jsonl(a.save_curve, list(curve), meta=dict(out))
+    if a.curve:
+        out["curve"] = list(curve)
     print(json.dumps(out))
     return 0
 

@@ -195,6 +195,70 @@ def assert_prng_invariant(n: int, mesh: Mesh, seed: int = 0,
     return d
 
 
+def restore_plane_state(planes, mesh: Mesh):
+    """Re-place host-loaded checkpoint planes under the plane sharding.
+    The stack is already padded to the mesh (init_plane_state contract),
+    so a same-mesh-shape resume is bitwise exact; the CLI fingerprint
+    refuses a different device count."""
+    return jax.device_put(jnp.asarray(planes),
+                          NamedSharding(mesh, P(AXIS, None, None)))
+
+
+def checkpointed_fused_planes(n: int, rumors: int, run: RunConfig,
+                              mesh: Mesh, path: str, every: int = 50,
+                              fanout: int = 1,
+                              resume_state=None, want_curve: bool = False,
+                              interpret: bool = False,
+                              curve_prefix=(), extra_meta=None):
+    """Fixed-budget plane-sharded fused run in compiled segments with
+    atomic npz checkpoints — persistence for the flagship multi-rumor
+    runs, the one scale long enough to need it (the reference loses all
+    state on process death, main.go:22-26).  The checkpoint state is a
+    :class:`~gossip_tpu.ops.pallas_round.FusedState` whose ``table``
+    field carries the [W, rows, 128] plane stack; there is no PRNG key
+    to persist — the kernel's hardware PRNG streams are a pure function
+    of (seed, round), both in the config fingerprint / round counter.
+
+    With ``want_curve`` the segments run as a scan recording
+    min-over-rumors coverage per round — the fused engine's while_loop
+    driver cannot capture curves, this driver can.  ``interpret`` is the
+    CPU-interpreter path for tests (deterministic stubbed PRNG: resume
+    bitwise-equality is still meaningful off-TPU).
+
+    Returns ``(final_state, coverage, curve-or-None)``.
+    """
+    from gossip_tpu.ops.pallas_round import FusedState
+    from gossip_tpu.utils.checkpoint import run_with_checkpoints
+    round_fn = make_sharded_fused_round(n, mesh, fanout, interpret)
+
+    def step(st: FusedState) -> FusedState:
+        return FusedState(table=round_fn(st.table, run.seed, st.round),
+                          round=st.round + 1,
+                          msgs=st.msgs + 2.0 * fanout * n)
+
+    if resume_state is None:
+        state = FusedState(table=init_plane_state(n, rumors, mesh,
+                                                  run.origin),
+                           round=jnp.int32(0), msgs=jnp.float32(0.0))
+    else:
+        state = resume_state._replace(
+            table=restore_plane_state(resume_state.table, mesh))
+
+    curve_fn = None
+    if want_curve:
+        def curve_fn(s):
+            return coverage_planes(s.table, n)
+
+    remaining = max(0, run.max_rounds - int(state.round))
+    out = run_with_checkpoints(step, state, remaining, path, every=every,
+                               curve_fn=curve_fn,
+                               curve_prefix=curve_prefix,
+                               extra_meta=extra_meta)
+    final, curve = out if want_curve else (out, None)
+    cov = float(coverage_planes(final.table, n))
+    return final, cov, curve
+
+
 def simulate_until_sharded_fused(n: int, rumors: int, run: RunConfig,
                                  mesh: Mesh, fanout: int = 1,
                                  interpret: bool = False):

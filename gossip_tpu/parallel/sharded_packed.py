@@ -129,6 +129,83 @@ def init_sharded_packed_state(run: RunConfig, proto: ProtocolConfig,
     return st._replace(seen=seen)
 
 
+def sharded_checkpoint_ineligible_reason(proto: ProtocolConfig,
+                                         exchange: str):
+    """Why a multi-device run cannot use the checkpointed sharded driver,
+    or None — the ONE list of preconditions, shared by the CLI and any
+    future surface (the fused engine's `_fused_ineligible_reason`
+    pattern: two callers can never drift apart)."""
+    if exchange != "dense":
+        return ("--checkpoint shards via the dense packed engine; "
+                f"exchange={exchange!r} has no checkpointed driver")
+    if proto.mode not in (C.PULL, C.ANTI_ENTROPY):
+        return ("the sharded checkpointed driver runs the packed "
+                f"pull/antientropy kernels (got mode {proto.mode!r})")
+    return None
+
+
+def restore_sharded_packed_state(state: SimState, mesh: Mesh,
+                                 axis_name: str = "nodes") -> SimState:
+    """Re-place a host-loaded checkpoint (utils/checkpoint.load_state)
+    onto the mesh: the padded ``seen`` rows go back under the node-axis
+    sharding, scalars stay replicated.  The loaded rows are already
+    mesh-padded (save gathered the padded global array), so a resume on
+    the SAME mesh shape is bitwise exact; a different device count would
+    change the padding contract, which the CLI fingerprint refuses."""
+    seen = jax.device_put(jnp.asarray(state.seen),
+                          NamedSharding(mesh, P(axis_name, None)))
+    return state._replace(seen=seen)
+
+
+def checkpointed_packed_sharded(proto: ProtocolConfig, topo: Topology,
+                                run: RunConfig, mesh: Mesh, path: str,
+                                every: int = 50,
+                                fault: Optional[FaultConfig] = None,
+                                resume_state: Optional[SimState] = None,
+                                want_curve: bool = False,
+                                axis_name: str = "nodes",
+                                curve_prefix=(), extra_meta=None):
+    """Fixed-budget sharded run in compiled segments with atomic npz
+    checkpoints — the multi-device twin of the single-device
+    ``--checkpoint`` driver (utils/checkpoint.run_with_checkpoints):
+    long flagship runs survive preemption (the reference loses
+    everything on process death, main.go:22-26) and, with
+    ``want_curve``, record their convergence curve at the same time.
+
+    Returns ``(final_state, coverage, curve-or-None)``; bitwise equal to
+    an uninterrupted segmented run (tests/test_checkpoint_sharded.py).
+    """
+    from gossip_tpu.utils.checkpoint import run_with_checkpoints
+    step, tables = make_sharded_packed_round(proto, topo, mesh, fault,
+                                             run.origin, axis_name,
+                                             tabled=True)
+    n_pad = pad_to_mesh(topo.n, mesh, axis_name)
+    if resume_state is None:
+        state = init_sharded_packed_state(run, proto, topo, mesh, axis_name)
+    else:
+        state = restore_sharded_packed_state(resume_state, mesh, axis_name)
+    r = proto.rumors
+
+    curve_fn = None
+    if want_curve:
+        def curve_fn(s):
+            # built IN-TRACE (no O(N) host constant in the compile
+            # request — models/swim.py doc); it is loop-invariant, so
+            # XLA hoists the rebuild out of the scan body
+            alive_t = sharded_alive(fault, topo.n, n_pad, run.origin)
+            return coverage_packed(s.seen, r, alive_t)
+
+    remaining = max(0, run.max_rounds - int(state.round))
+    out = run_with_checkpoints(step, state, remaining, path, every=every,
+                               step_args=tables, curve_fn=curve_fn,
+                               curve_prefix=curve_prefix,
+                               extra_meta=extra_meta)
+    final, curve = out if want_curve else (out, None)
+    alive_pad = sharded_alive(fault, topo.n, n_pad, run.origin)
+    cov = float(coverage_packed(final.seen, r, alive_pad))
+    return final, cov, curve
+
+
 def simulate_until_packed_sharded(proto: ProtocolConfig, topo: Topology,
                                   run: RunConfig, mesh: Mesh,
                                   fault: Optional[FaultConfig] = None,

@@ -27,14 +27,19 @@ import numpy as np
 from gossip_tpu.models.rumor import RumorState
 from gossip_tpu.models.state import SimState
 from gossip_tpu.models.swim import SwimState
+from gossip_tpu.ops.pallas_round import FusedState
 
+# FusedState covers BOTH fused layouts: the single-device one-word-per-
+# node table and the plane-sharded [W, rows, 128] stack (the plane stack
+# rides in the ``table`` field) — the config fingerprint distinguishes
+# the runs, the array shape distinguishes the layouts.
 _STATE_TYPES = {"SimState": SimState, "SwimState": SwimState,
-                "RumorState": RumorState}
-State = Union[SimState, SwimState, RumorState]
+                "RumorState": RumorState, "FusedState": FusedState}
+State = Union[SimState, SwimState, RumorState, FusedState]
 
 
 def save_state(path: str, state: State, extra_meta=None) -> None:
-    """Write a SimState/SwimState/RumorState to ``path`` (.npz).  Sharded
+    """Write a registered round-state to ``path`` (.npz).  Sharded
     arrays are gathered to host — checkpoint outside the hot loop.
     ``extra_meta`` (a JSON-able dict) rides in the metadata entry — e.g.
     the run's config fingerprint, so resume can refuse mismatched flags
@@ -52,8 +57,11 @@ def save_state(path: str, state: State, extra_meta=None) -> None:
             arrays[name] = np.asarray(jax.random.key_data(val))
         else:
             arrays[name] = np.asarray(val)
-    meta = {"cls": cls, "fields": list(fields), "key_field": key_field,
-            "key_impl": str(jax.random.key_impl(state.base_key))}
+    meta = {"cls": cls, "fields": list(fields), "key_field": key_field}
+    if key_field is not None:
+        # FusedState has no traced key (the kernel seeds from scalar
+        # (seed, round)); key-less states skip the impl record entirely
+        meta["key_impl"] = str(jax.random.key_impl(state.base_key))
     if extra_meta is not None:
         meta["extra"] = extra_meta
     tmp = path + ".tmp"
@@ -93,22 +101,58 @@ def load_state(path: str) -> State:
 # keys: a dropped step closure (and the topology arrays it captures) must
 # not be pinned in memory by this cache.
 _segment_runners: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_curve_runners: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 def _segment_runner(step):
     runner = _segment_runners.get(step)
     if runner is None:
+        # the runner must NOT strongly capture ``step``: the cache value
+        # referencing its own weak key would make eviction impossible and
+        # pin every dropped step closure (and its captured topology
+        # arrays) forever in long-lived processes (rpc sidecar).  The
+        # weakref is only dereferenced while the cache entry — and hence
+        # the step — is still alive.
+        step_ref = weakref.ref(step)
+
         @jax.jit
         def runner(s, n_steps, *args):
             return jax.lax.fori_loop(0, n_steps,
-                                     lambda _, st: step(st, *args), s)
+                                     lambda _, st: step_ref()(st, *args), s)
         _segment_runners[step] = runner
+    return runner
+
+
+def _curve_segment_runner(step, curve_fn):
+    """Segment runner that also records ``curve_fn(state)`` after every
+    round, as one compiled ``lax.scan``.  Scan lengths are static, so a
+    run compiles at most two executables per (step, curve_fn): the
+    ``every``-long body and the tail.  Identical step sequence to the
+    fori_loop runner — the bitwise-trajectory promise is unchanged."""
+    per_step = _curve_runners.setdefault(step, weakref.WeakKeyDictionary())
+    runner = per_step.get(curve_fn)
+    if runner is None:
+        import functools
+
+        # weak captures, same reason as _segment_runner: the cached
+        # runner must not keep its own keys alive
+        step_ref = weakref.ref(step)
+        curve_ref = weakref.ref(curve_fn)
+
+        @functools.partial(jax.jit, static_argnums=1)
+        def runner(s, n_steps, *args):
+            def body(st, _):
+                st2 = step_ref()(st, *args)
+                return st2, curve_ref()(st2)
+            return jax.lax.scan(body, s, None, length=n_steps)
+        per_step[curve_fn] = runner
     return runner
 
 
 def run_with_checkpoints(step, state: State, rounds: int, path: str,
                          every: int = 50, step_args=(),
-                         extra_meta=None) -> State:
+                         extra_meta=None, curve_fn=None,
+                         curve_prefix=()):
     """Drive ``step`` for ``rounds`` rounds, checkpointing every ``every``
     rounds (and at the end).  Resume by loading the file and calling again
     with the remaining round budget — long sweeps survive preemption.
@@ -123,18 +167,44 @@ def run_with_checkpoints(step, state: State, rounds: int, path: str,
     ``step_args`` travel as traced jit ARGUMENTS into the segment runner
     — pass a tabled step's topology arrays here instead of closing over
     them, so 1M+-row tables are not inlined into the compile request
-    (models/swim.py doc)."""
+    (models/swim.py doc).
+
+    ``curve_fn`` (state -> float scalar) switches the segments to a
+    compiled ``lax.scan`` that records the value after every round: long
+    runs can persist AND capture their convergence curve (the reference
+    could do neither — SURVEY.md §5).  The curve-so-far rides in the
+    checkpoint metadata under ``extra['curve']`` so a resumed run
+    continues it seamlessly (pass the saved list as ``curve_prefix``).
+    Returns ``state`` without ``curve_fn``, ``(state, curve)`` with it.
+    """
     if every < 1:
         raise ValueError(f"every must be >= 1, got {every}")
-    run_segment = _segment_runner(step)
+    curve = list(curve_prefix)
+
+    def meta_now():
+        if curve_fn is None:
+            return extra_meta
+        m = dict(extra_meta or {})
+        m["curve"] = curve
+        return m
+
+    if curve_fn is None:
+        run_segment = _segment_runner(step)
+    else:
+        run_segment = _curve_segment_runner(step, curve_fn)
     done = 0
     while done < rounds:
         todo = min(every, rounds - done)
-        state = run_segment(state, todo, *step_args)
+        if curve_fn is None:
+            state = run_segment(state, todo, *step_args)
+        else:
+            state, seg = run_segment(state, todo, *step_args)
+            curve.extend(float(x) for x in np.asarray(seg))
         done += todo
-        jax.block_until_ready(state.seen if hasattr(state, "seen")
-                              else state.wire)
-        save_state(path, state, extra_meta)
+        jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+        save_state(path, state, meta_now())
     if rounds <= 0:
-        save_state(path, state, extra_meta)
-    return state
+        save_state(path, state, meta_now())
+    if curve_fn is None:
+        return state
+    return state, curve

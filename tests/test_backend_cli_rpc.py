@@ -435,9 +435,9 @@ def test_cli_checkpoint_resume_and_profile(tmp_path):
     solo = json.loads(p.stdout)
     assert (resumed["coverage"], resumed["msgs"]) == (solo["coverage"],
                                                       solo["msgs"])
-    # guard: sharded/swim requests are rejected loudly
+    # guard: swim/rumor requests are rejected loudly
     p = _cli("run", "--mode", "swim", "--n", "256", "--checkpoint", ck)
-    assert p.returncode == 2 and "single-device SI" in p.stderr
+    assert p.returncode == 2 and "SI engines" in p.stderr
     # resume with different flags refuses (config fingerprint mismatch)
     p = _cli("run", "--mode", "pushpull", "--n", "512", "--max-rounds",
              "30", "--seed", "9", "--checkpoint", ck, "--resume")
@@ -446,10 +446,12 @@ def test_cli_checkpoint_resume_and_profile(tmp_path):
     # --resume without --checkpoint errors instead of silently restarting
     p = _cli("run", "--mode", "pushpull", "--n", "512", "--resume")
     assert p.returncode == 2 and "--checkpoint" in p.stderr
-    # --curve is incompatible with the segment driver (no silent drop)
-    p = _cli("run", "--mode", "pushpull", "--n", "512",
-             "--checkpoint", ck, "--curve")
-    assert p.returncode == 2 and "curve" in p.stderr
+    # round 4: --curve composes with the segment driver (scan segments;
+    # deeper coverage in tests/test_checkpoint_sharded.py)
+    p = _cli("run", "--mode", "pushpull", "--n", "512", "--max-rounds",
+             "6", "--checkpoint", str(tmp_path / "curve.npz"), "--curve")
+    assert p.returncode == 0, p.stderr
+    assert len(json.loads(p.stdout)["curve"]) == 6
     # --profile wraps the run and writes a trace directory
     p = _cli("run", "--mode", "pull", "--n", "256", "--max-rounds", "16",
              "--profile", prof)
