@@ -7,13 +7,17 @@ reproduce the exact-zero contract: on a power-of-two ring, jax rounds
 and event-sim hop depths agree point for point in float32.
 """
 
+import importlib.util
 import os
-import sys
 
-sys.path.insert(0, os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
-
-import parity_matrix  # noqa: E402
+# load-by-path, same pattern as test_bench_contract.py: tools/ must not
+# join sys.path for the whole pytest session
+_spec = importlib.util.spec_from_file_location(
+    "parity_matrix",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "tools", "parity_matrix.py"))
+parity_matrix = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(parity_matrix)
 
 
 def test_ring_1024_row_regenerates_exact():

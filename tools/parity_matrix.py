@@ -81,13 +81,15 @@ SKIPPED_BY_DESIGN = [
 
 
 def cpu_env():
-    env = dict(os.environ)
-    keep = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-            if p and p != REPO
-            and not os.path.exists(os.path.join(p, "sitecustomize.py"))]
-    env["PYTHONPATH"] = os.pathsep.join([REPO] + keep)
-    env["JAX_PLATFORMS"] = "cpu"
-    return env
+    """bench.py's hermetic CPU env — imported, not copied: it also pops
+    the tunnel-arming hazard vars (PALLAS_AXON_POOL_IPS etc.), without
+    which a wedged tunnel could burn a cell's whole timeout."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    bench_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_mod)
+    return bench_mod._hermetic_cpu_env()
 
 
 def run_cell(name, argv, timeout):
@@ -158,9 +160,16 @@ def main(only=None):
                    if r["tier"] == QUANT)
     bound_ok = all(r["hop_bound_violation"] < 1e-6
                    and r["fixed_point_gap"] < 1e-6 for r in rows.values())
-    out["contract"] = {"exact_rows_exact": exact_ok,
+    # per-tier row counts ride with the verdicts: a filtered run's
+    # vacuous all-true over an absent tier is visible as its 0 count
+    tiers = [r["tier"] for r in rows.values()]
+    out["contract"] = {"exact_rows": tiers.count(EXACT),
+                       "exact_rows_exact": exact_ok,
+                       "quantization_rows": tiers.count(QUANT),
                        "quantization_rows_below_1e6": quant_ok,
-                       "bounds_all_rows": bound_ok}
+                       "rows_total": len(rows),
+                       "bounds_all_rows": bound_ok,
+                       "partial_selection": bool(only)}
     if only is None or not only:
         with open(ART, "w") as f:
             json.dump(out, f, indent=1)
