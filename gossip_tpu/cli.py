@@ -543,23 +543,27 @@ def cmd_grid(a) -> int:
                                            config_sweep_curves_2d)
     from gossip_tpu.topology import generators as G
     families = a.families or [a.family]
+    ns = a.ns or [a.n]
     run = RunConfig(target_coverage=a.target, max_rounds=a.max_rounds,
                     seed=a.seed)
     fault = (FaultConfig(node_death_rate=a.death, seed=a.seed)
              if a.death > 0 else None)
+    # the topology stack enumerates (family, n) pairs; topo_idx t maps
+    # back as family t // len(ns), size t % len(ns)
+    fam_n = [(f, n) for f in families for n in ns]
     points = [
         SweepPoint(mode=m, fanout=f, drop_prob=d,
                    period=(p if m == "antientropy" else 1), seed=s,
                    topo_idx=t)
-        for t in range(len(families))
+        for t in range(len(fam_n))
         for m in a.modes for f in a.fanouts for d in a.drops
         for p in (a.periods if 'antientropy' in a.modes else [1])
         for s in a.seeds]
     # periods multiply only anti-entropy points; dedupe the rest
     points = list(dict.fromkeys(points))
-    topos = [G.build(TopologyConfig(family=f, n=a.n, k=a.k, p=a.p,
+    topos = [G.build(TopologyConfig(family=f, n=n, k=a.k, p=a.p,
                                     degree_cap=a.degree_cap, seed=a.seed))
-             for f in families]
+             for f, n in fam_n]
     topo_arg = topos if len(topos) > 1 else topos[0]
     if a.pod_mesh:
         # DCN-aware: configs (communication-free) ride the outer/slice
@@ -583,8 +587,9 @@ def cmd_grid(a) -> int:
         res = config_sweep_curves_partitioned(points, topo_arg, run,
                                               fault=fault, rumors=a.rumors)
     for i, summary in enumerate(res.summaries()):
-        summary["n"] = a.n
-        summary["family"] = families[points[i].topo_idx]
+        fam, n = fam_n[points[i].topo_idx]
+        summary["n"] = n
+        summary["family"] = fam
         if a.curve:
             summary["curve"] = [float(c) for c in res.curves[i]]
         print(json.dumps(summary), flush=True)
@@ -601,16 +606,28 @@ def cmd_serve(a) -> int:
 
 def cmd_maelstrom(a) -> int:
     from gossip_tpu.runtime.maelstrom_node import main as node_main
-    node_main()
+    node_main(["--gossip-interval", str(a.gossip_interval)])
     return 0
 
 
+def _node_argv(gossip_interval: float):
+    """Node command for the harnesses; None keeps their default (the
+    immediate-relay node) so the reference-shaped path stays the
+    default."""
+    if gossip_interval <= 0:
+        return None
+    return [sys.executable, "-u", "-m", "gossip_tpu.runtime.maelstrom_node",
+            "--gossip-interval", str(gossip_interval)]
+
+
 def cmd_maelstrom_check(a) -> int:
+    argv = _node_argv(a.gossip_interval)
     if a.router == "native":
         from gossip_tpu.runtime.native_router import run_native_workload
         stats = run_native_workload(
             a.n, a.ops, rate=a.rate, latency=a.latency,
-            topology=a.topology, partition_mid=a.partition, seed=a.seed)
+            topology=a.topology, partition_mid=a.partition, seed=a.seed,
+            argv=argv)
     else:
         import asyncio
 
@@ -618,9 +635,24 @@ def cmd_maelstrom_check(a) -> int:
             run_broadcast_workload)
         stats = asyncio.run(run_broadcast_workload(
             a.n, a.ops, rate=a.rate, latency=a.latency,
-            topology=a.topology, partition_mid=a.partition, seed=a.seed))
+            topology=a.topology, partition_mid=a.partition, seed=a.seed,
+            argv=argv))
+    stats["gossip_interval"] = a.gossip_interval
+    ok = stats["invariant_ok"]
+    if a.assert_msgs_per_op is not None:
+        # Glomers-style efficiency gate: the report carries the target
+        # and the verdict, and the exit code enforces it
+        stats["msgs_per_op_target"] = a.assert_msgs_per_op
+        stats["msgs_per_op_ok"] = (stats["msgs_per_op"]
+                                   <= a.assert_msgs_per_op)
+        ok = ok and stats["msgs_per_op_ok"]
+    if a.assert_latency_ms is not None:
+        stats["op_latency_target_ms"] = a.assert_latency_ms
+        stats["op_latency_ok"] = (stats["op_latency_ms"]["max"]
+                                  <= a.assert_latency_ms)
+        ok = ok and stats["op_latency_ok"]
     print(json.dumps(stats))
-    return 0 if stats["invariant_ok"] else 1
+    return 0 if ok else 1
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -654,6 +686,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="anti-entropy cadences (ignored for other modes)")
     p.add_argument("--seeds", nargs="+", type=int, default=[0])
     p.add_argument("--n", type=int, default=4096)
+    p.add_argument("--ns", nargs="+", type=int, default=None,
+                   help="sweep MULTIPLE graph sizes in the same program "
+                        "(overrides --n; explicit families only — "
+                        "smaller graphs pad with inert phantom rows, "
+                        "each point's coverage uses its own n)")
     p.add_argument("--rumors", type=int, default=1)
     p.add_argument("--family", default="complete",
                    choices=("complete", "ring", "grid", "erdos_renyi",
@@ -687,6 +724,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     p = sub.add_parser("maelstrom",
                        help="run the Maelstrom protocol node on stdio")
+    p.add_argument("--gossip-interval", type=float, default=0.0,
+                   help="batch relays per neighbor every INTERVAL "
+                        "seconds (0 = immediate per-message fan-out)")
     p.set_defaults(fn=cmd_maelstrom)
 
     p = sub.add_parser("maelstrom-check",
@@ -710,6 +750,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="harness engine: the asyncio router or the C++ "
                         "poll()-loop router (native/router.cpp, built on "
                         "demand)")
+    p.add_argument("--gossip-interval", type=float, default=0.0,
+                   help="run the nodes with interval-batched relays "
+                        "(seconds; 0 = the reference's immediate "
+                        "per-message fan-out)")
+    p.add_argument("--assert-msgs-per-op", type=float, default=None,
+                   metavar="T",
+                   help="Glomers-style efficiency gate: fail (exit 1) if "
+                        "msgs_per_op exceeds T; the report records the "
+                        "target and verdict")
+    p.add_argument("--assert-latency-ms", type=float, default=None,
+                   metavar="MS",
+                   help="fail if the max client-op latency exceeds MS")
     p.set_defaults(fn=cmd_maelstrom_check)
 
     a = ap.parse_args(argv)

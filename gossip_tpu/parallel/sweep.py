@@ -26,9 +26,18 @@ Round 3 added the TOPOLOGY axis (VERDICT r2 item 6): same-n explicit
 families stack into one ``int32[F, n, D_max]`` traced table operand and
 each point's ``topo_idx`` dynamic-slices its family — completing the
 north star's "sweep fanout, mode, and graph topology" sentence in one
-XLA program.  Still structural (a python loop over compiles, see
-cli.cmd_sweep): n and rumor count (they change array shapes), and the
-implicit complete graph (no table to stack).
+XLA program.
+
+Round 4 batched the N axis too (VERDICT r3 item 6): different-n explicit
+entries pad to ``n_max`` with PHANTOM rows (degree 0, sentinel
+neighbors, masked out of liveness and coverage), so a families x sizes
+grid is ONE program — `grid --family ring --ns 1000 10000` compiles
+once (explicit families only — see _stack_topologies).  A point's
+curve equals its solo run bitwise on the real prefix (per-node draws
+are keyed by global id).  Still structural (a python loop over
+compiles, see cli.cmd_sweep): rumor count (it changes the state's R
+axis) and the implicit complete graph (its partner draw is bounded by
+a static n; its "table" is the bound itself).
 """
 
 from __future__ import annotations
@@ -144,6 +153,11 @@ def config_sweep_curves_2d(points, topo, run: RunConfig,
         raise ValueError("per-config loss goes through SweepPoint.drop_prob;"
                          " FaultConfig.drop_prob would be ambiguous here")
     topos, multi, topo0 = _normalize_topos(topo, points)
+    if multi and any(t.n != topo0.n for t in topos):
+        raise ValueError(
+            "the 2-D pod sweep shards ONE node dimension; mixed-n "
+            "phantom batching is the 1-D config_sweep_curves path — "
+            "run the pod sweep per n")
     cN = len(points)
     p_sweep = mesh.shape[sweep_axis]
     if cN % p_sweep != 0:
@@ -429,27 +443,35 @@ def _normalize_topos(topo, points):
 
 
 def _stack_topologies(topos):
-    """Same-n explicit topologies -> (nbrs_stack[F, n, D_max],
-    deg_stack[F, n]), neighbor columns padded with the sentinel n.  The
-    sentinel columns sit past every row's degree, so sampling (which
-    draws indices < deg) can never touch them — a point's trajectory is
-    independent of the OTHER families in the stack."""
-    n = topos[0].n
+    """Explicit topologies -> (nbrs_stack[F, n_max, D_max],
+    deg_stack[F, n_max]), neighbor columns padded with the shared
+    sentinel ``n_max``.  The sentinel columns sit past every row's
+    degree, so sampling (which draws indices < deg) can never touch them
+    — a point's trajectory is independent of the OTHER entries in the
+    stack.
+
+    Entries may differ in ``n`` (round 4, VERDICT r3 item 6): smaller
+    graphs pad to ``n_max`` with PHANTOM rows (degree 0, sentinel
+    neighbors).  Phantoms are inert end to end: degree-0 sampling emits
+    the sentinel, no real row's table contains a phantom id, and the
+    sweep masks them out of liveness and coverage — so a point's
+    trajectory on its real prefix is BITWISE the solo run at its own n
+    (per-node draws are keyed by global id, the sharding-invariance
+    contract in ops/sampling)."""
+    n_max = max(t.n for t in topos)
     for t in topos:
         if t.implicit:
             raise ValueError(
                 "a topology sweep needs explicit neighbor tables for "
-                "every family (the implicit complete graph has no table "
-                "to stack); sweep it as its own batch")
-        if t.n != n:
-            raise ValueError(
-                f"topology sweep families must share n; got {t.n} vs {n}"
-                " (different n changes array shapes -> separate compiles)")
+                "every entry (the implicit complete graph has no table "
+                "to stack, and its partner draw is bounded by a static "
+                "n); sweep it as its own batch")
     d_max = max(t.width for t in topos)
     nbrs = jnp.stack([
-        jnp.pad(t.nbrs, ((0, 0), (0, d_max - t.width)), constant_values=n)
+        jnp.pad(t.nbrs, ((0, n_max - t.n), (0, d_max - t.width)),
+                constant_values=n_max)
         for t in topos])
-    deg = jnp.stack([t.deg for t in topos])
+    deg = jnp.stack([jnp.pad(t.deg, (0, n_max - t.n)) for t in topos])
     return nbrs, deg
 
 
@@ -461,15 +483,19 @@ def config_sweep_curves(points, topo, run: RunConfig,
                         _force_both: bool = False) -> ConfigSweepResult:
     """Run C distinct config points as ONE batched XLA program.
 
-    ``topo`` is one Topology, or a SEQUENCE of same-n explicit topologies
-    — the topology axis of the north star's "sweep fanout, mode, and
-    graph topology" sentence (VERDICT r2 item 6).  With a sequence, each
-    point's ``topo_idx`` picks its family from a stacked
-    ``int32[F, n, D_max]`` table operand; one compile covers the whole
-    families x modes x fanouts grid.  A point's trajectory equals the
-    solo single-topology batch BITWISE (same keys; the stack pads
-    neighbor columns with the sentinel past each row's degree, which
-    sampling never draws).
+    ``topo`` is one Topology, or a SEQUENCE of explicit topologies — the
+    topology axis of the north star's "sweep fanout, mode, and graph
+    topology" sentence (VERDICT r2 item 6).  With a sequence, each
+    point's ``topo_idx`` picks its entry from a stacked
+    ``int32[F, n_max, D_max]`` table operand; one compile covers the
+    whole families x modes x fanouts grid.  Entries may differ in n
+    (round 4): smaller graphs pad with inert phantom rows and the
+    point's coverage/liveness use its OWN n — so a families x sizes
+    grid is one program too (mixed-n batches take no FaultConfig and
+    need origin + rumors within the smallest n; see the errors below).
+    A point's trajectory equals the solo single-topology batch BITWISE
+    on its real prefix (same keys; the stack pads neighbor columns with
+    the sentinel past each row's degree, which sampling never draws).
 
     ``fault`` contributes only the static death mask (shared structure);
     per-config loss goes through ``SweepPoint.drop_prob`` — a FaultConfig
@@ -500,7 +526,29 @@ def config_sweep_curves(points, topo, run: RunConfig,
             f"mesh axis of size {mesh.shape[axis_name]}; pad the batch "
             "(duplicate a point) or change the mesh")
     topos, multi, topo0 = _normalize_topos(topo, points)
-    n = topo0.n
+    n = max(t.n for t in topos)
+    ragged = multi and any(t.n != n for t in topos)
+    if ragged:
+        # phantom-row batching (VERDICT r3 item 6): different-n entries
+        # share one program.  The two channels that are seeded at a
+        # point's own n in a solo run must be unambiguous here:
+        if fault is not None:
+            raise ValueError(
+                "a mixed-n sweep takes no FaultConfig: the static death "
+                "draw is shaped by each point's own n in a solo run, so "
+                "a shared draw would silently change trajectories; run "
+                "faulted points as a same-n batch")
+        min_n = min(t.n for t in topos)
+        if run.origin + rumors > min_n:
+            raise ValueError(
+                f"origin {run.origin} + rumors {rumors} exceeds the "
+                f"smallest n ({min_n}) in the batch: rumor r seeds node "
+                "(origin + r) % n, which would differ from the solo run "
+                "on the smaller graphs")
+    if multi:
+        # the sweep's scatter sentinel and partner-validity bound is the
+        # PADDED n; same-n stacks keep n == every entry's n (no change)
+        topo0 = dataclasses.replace(topo0, n=n)
     k_max = k_max or max(pt.fanout for pt in points)
     if any(pt.fanout > k_max for pt in points):
         raise ValueError("k_max smaller than a point's fanout")
@@ -521,7 +569,7 @@ def config_sweep_curves(points, topo, run: RunConfig,
 
     def one_round(seen, round_, base_key, msgs,
                   do_push, do_pull, do_ae, fanout, dropp, period, tidx,
-                  *tbl):
+                  n_pt, *tbl):
         if multi:
             # per-config family: one dynamic slice out of the stacked
             # table operand (tables are jit arguments — DESIGN.md §6)
@@ -532,6 +580,11 @@ def config_sweep_curves(points, topo, run: RunConfig,
         gids = jnp.arange(n, dtype=jnp.int32)
         alive = alive_mask(fault, n, run.origin)
         alive_b = jnp.ones((n,), jnp.bool_) if alive is None else alive
+        if ragged:
+            # phantom rows past this point's own n are never alive —
+            # they cannot send, receive, or count (their table rows are
+            # already degree-0/sentinel, this is the second lock)
+            alive_b = alive_b & (gids < n_pt)
         rkey = jax.random.fold_in(base_key, round_)
         visible = seen & alive_b[:, None]
         delta, msgs_round = _sweep_round_delta(
@@ -542,7 +595,7 @@ def config_sweep_curves(points, topo, run: RunConfig,
         return seen | delta, round_ + 1, msgs + msgs_round
 
     batched = jax.vmap(one_round,
-                       in_axes=(0,) * 11 + (None,) * len(tables))
+                       in_axes=(0,) * 12 + (None,) * len(tables))
 
     base = init_state(run, proto_like, n)
     init_seen = jnp.broadcast_to(base.seen, (cN,) + base.seen.shape)
@@ -555,26 +608,43 @@ def config_sweep_curves(points, topo, run: RunConfig,
     drops = jnp.asarray([pt.drop_prob for pt in points], jnp.float32)
     periods = jnp.asarray([pt.period for pt in points], jnp.int32)
     tidxs = jnp.asarray([pt.topo_idx for pt in points], jnp.int32)
+    n_pts = jnp.asarray([topos[pt.topo_idx].n for pt in points], jnp.int32)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
         row = NamedSharding(mesh, P(axis_name))
         init_seen = jax.device_put(
             init_seen, NamedSharding(mesh, P(axis_name, None, None)))
         keys = jax.device_put(keys, row)
-        do_push, do_pull, do_ae, fanouts, drops, periods, tidxs = (
+        do_push, do_pull, do_ae, fanouts, drops, periods, tidxs, n_pts = (
             jax.device_put(x, row)
             for x in (do_push, do_pull, do_ae, fanouts, drops, periods,
-                      tidxs))
+                      tidxs, n_pts))
 
     @jax.jit
     def scan(seen, rounds, keys, msgs, *tbl):
         alive = alive_mask(fault, n, run.origin)
+        if ragged:
+            def cov_fn(x, n_pt):
+                # per-point divisor: phantom rows are masked, coverage
+                # is over the point's OWN n real rows.  The count is an
+                # exact f32 integer; multiplying by the f32 reciprocal
+                # (not true division) reproduces jnp.mean's lowering in
+                # the solo path BIT FOR BIT (tests assert curve equality
+                # with solo runs, and div vs recip-mul differ by 1 ulp)
+                gids = jnp.arange(n, dtype=jnp.int32)
+                w = (gids < n_pt).astype(jnp.float32)
+                counts = jnp.sum(x.astype(jnp.float32) * w[:, None],
+                                 axis=0)
+                return jnp.min(counts * (1.0 / n_pt.astype(jnp.float32)))
+            cov_all = jax.vmap(cov_fn)
+        else:
+            cov_all = jax.vmap(lambda x: coverage(x, alive))
         def body(carry, _):
             seen, rounds, msgs = carry
             seen, rounds, msgs = batched(seen, rounds, keys, msgs, do_push,
                                          do_pull, do_ae, fanouts, drops,
-                                         periods, tidxs, *tbl)
-            covs = jax.vmap(lambda x: coverage(x, alive))(seen)
+                                         periods, tidxs, n_pts, *tbl)
+            covs = cov_all(seen, n_pts) if ragged else cov_all(seen)
             return (seen, rounds, msgs), (covs, msgs)
         return jax.lax.scan(body, (seen, rounds, msgs), None,
                             length=run.max_rounds)

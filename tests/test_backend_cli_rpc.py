@@ -322,6 +322,20 @@ def test_cli_run_jax_and_error_paths():
     assert "single-run only" in p.stderr
 
 
+def test_cli_grid_ns_one_program():
+    # the n axis of the structural sweep, batched (VERDICT r3 item 6):
+    # two sizes of one family in one compiled program, per-point n/family
+    # reported; deeper bitwise coverage in tests/test_config_sweep.py
+    p = _cli("grid", "--modes", "push", "pull", "--fanouts", "1",
+             "--family", "erdos_renyi", "--ns", "300", "600",
+             "--p", "0.02", "--max-rounds", "24")
+    assert p.returncode == 0, p.stderr
+    rows = [json.loads(line) for line in p.stdout.splitlines()]
+    assert sorted({r["n"] for r in rows}) == [300, 600]
+    assert all(r["family"] == "erdos_renyi" and r["converged"]
+               for r in rows)
+
+
 def test_cli_sweep_smoke():
     p = _cli("sweep", "--scale", "0.002", "--devices", "4",
              "--only", "push-complete-64-goref", "pushpull-er-10k",

@@ -192,10 +192,16 @@ def test_topology_axis_validation():
         SweepPoint(topo_idx=-1)
     with pytest.raises(ValueError, match="past"):
         config_sweep_curves([SweepPoint(topo_idx=3)], fams, run)
-    with pytest.raises(ValueError, match="share n"):
-        config_sweep_curves([SweepPoint()],
-                            [fams[0], G.erdos_renyi(128, 0.1, seed=0)],
-                            run)
+    # mixed-n is 1-D-batchable since round 4; the 2-D pod sweep still
+    # shards one node dimension and refuses it loudly
+    with pytest.raises(ValueError, match="mixed-n"):
+        from jax.sharding import Mesh as _Mesh
+        import jax as _j
+        m2 = _Mesh(np.asarray(_j.devices()[:4]).reshape(2, 2),
+                   ("sweep", "nodes"))
+        config_sweep_curves_2d(
+            [SweepPoint(), SweepPoint(topo_idx=1)],
+            [fams[0], G.erdos_renyi(128, 0.1, seed=0)], run, m2)
     with pytest.raises(ValueError, match="implicit|explicit"):
         config_sweep_curves([SweepPoint()], [fams[0], G.complete(256)],
                             run)
@@ -296,3 +302,77 @@ def test_pure_grid_elides_other_half():
     fat = config_sweep_curves(pts, topo, run, k_max=2, _force_both=True)
     np.testing.assert_array_equal(lean.curves, fat.curves)
     np.testing.assert_array_equal(lean.msgs, fat.msgs)
+
+
+# ---------------------------------------------------------------------
+# The n axis (VERDICT r3 item 6): families x SIZES in one program.
+
+
+def _sizes_stack():
+    """Same family at three sizes + a different family at a fourth —
+    the ragged stack pads everything to n_max=640 with phantom rows."""
+    return [G.erdos_renyi(200, 14.0 / 200, seed=3),
+            G.erdos_renyi(384, 14.0 / 384, seed=3),
+            G.erdos_renyi(640, 14.0 / 640, seed=3),
+            G.ring(333, 4)]
+
+
+def test_n_axis_matches_solo_bitwise():
+    """Every (size, mode, fanout) cell of a mixed-n batch equals the solo
+    single-topology batch at that n BITWISE — phantom rows are inert."""
+    topos = _sizes_stack()
+    run = RunConfig(seed=0, max_rounds=20)
+    pts = [SweepPoint(mode=m, fanout=f, seed=2, topo_idx=t)
+           for t in range(len(topos))
+           for m in (C.PUSH, C.PULL, C.PUSH_PULL)
+           for f in (1, 2)]
+    full = config_sweep_curves(pts, topos, run, k_max=2)
+    assert full.curves.shape[0] == 24
+    for i, pt in enumerate(pts):
+        solo = config_sweep_curves(
+            [SweepPoint(mode=pt.mode, fanout=pt.fanout, seed=pt.seed)],
+            topos[pt.topo_idx], run, k_max=2)
+        np.testing.assert_array_equal(full.curves[i], solo.curves[0])
+        np.testing.assert_array_equal(full.msgs[i], solo.msgs[0])
+
+
+def test_n_axis_antientropy_and_drop_match_solo():
+    # the AE reverse delta and per-point loss survive phantom padding
+    topos = [G.ring(256, 4), G.ring(512, 4)]
+    run = RunConfig(seed=0, max_rounds=24)
+    pts = [SweepPoint(mode=C.ANTI_ENTROPY, fanout=1, period=2, seed=5,
+                      topo_idx=t, drop_prob=d)
+           for t in (0, 1) for d in (0.0, 0.3)]
+    full = config_sweep_curves(pts, topos, run, k_max=1)
+    for i, pt in enumerate(pts):
+        solo = config_sweep_curves(
+            [SweepPoint(mode=pt.mode, fanout=1, period=2, seed=5,
+                        drop_prob=pt.drop_prob)],
+            topos[pt.topo_idx], run, k_max=1)
+        np.testing.assert_array_equal(full.curves[i], solo.curves[0])
+        np.testing.assert_array_equal(full.msgs[i], solo.msgs[0])
+
+
+def test_n_axis_shards_over_sweep_mesh():
+    topos = _sizes_stack()[:2]
+    run = RunConfig(seed=0, max_rounds=16)
+    pts = [SweepPoint(mode=m, fanout=1, seed=1, topo_idx=t)
+           for t in (0, 1) for m in (C.PUSH, C.PULL, C.PUSH_PULL, C.PUSH)]
+    solo = config_sweep_curves(pts, topos, run)
+    sh = config_sweep_curves(pts, topos, run,
+                             mesh=make_mesh(8, axis_name="sweep"))
+    np.testing.assert_array_equal(sh.curves, solo.curves)
+    np.testing.assert_array_equal(sh.msgs, solo.msgs)
+
+
+def test_n_axis_validation():
+    topos = [G.ring(256, 4), G.ring(512, 4)]
+    run = RunConfig(max_rounds=4)
+    with pytest.raises(ValueError, match="FaultConfig"):
+        config_sweep_curves([SweepPoint(), SweepPoint(topo_idx=1)],
+                            topos, run,
+                            fault=FaultConfig(node_death_rate=0.1))
+    with pytest.raises(ValueError, match="smallest n"):
+        config_sweep_curves(
+            [SweepPoint(), SweepPoint(topo_idx=1)], topos,
+            RunConfig(max_rounds=4, origin=255), rumors=2)

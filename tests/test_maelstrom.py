@@ -160,3 +160,78 @@ def test_broadcast_workload_stats_and_invariant():
         4, ops=8, rate=25.0, latency=0.001, partition_mid=True, seed=3))
     assert stats_p["invariant_ok"] is True
     assert stats_p["partitioned"] is True
+
+
+def test_interval_batching_cuts_msgs_per_op():
+    """The efficiency variant the reference never addressed (VERDICT r3
+    item 7): interval-batched relays must pass the same checker
+    invariant with FEWER inter-node messages per op than the immediate
+    fan-out — values share batches instead of each riding its own
+    broadcast+ack chain per edge."""
+    import sys
+
+    from gossip_tpu.runtime.maelstrom_harness import run_broadcast_workload
+    batched_argv = [sys.executable, "-u", "-m",
+                    "gossip_tpu.runtime.maelstrom_node",
+                    "--gossip-interval", "0.05"]
+    # high op rate so many values land inside one 50 ms tick
+    immediate = asyncio.run(run_broadcast_workload(
+        5, ops=20, rate=200.0, latency=0.001, seed=4))
+    batched = asyncio.run(run_broadcast_workload(
+        5, ops=20, rate=200.0, latency=0.001, seed=4, argv=batched_argv))
+    assert immediate["invariant_ok"] and batched["invariant_ok"]
+    assert batched["msgs_per_op"] < immediate["msgs_per_op"]
+    # on a 5-node line at this rate, batching should be WELL under the
+    # immediate path, not marginally (ticks amortize ~10 values each)
+    assert batched["msgs_per_op"] < 0.6 * immediate["msgs_per_op"]
+
+
+def test_batched_node_survives_partition():
+    # at-least-once through a cut: unacked batches retry every tick
+    import sys
+
+    from gossip_tpu.runtime.maelstrom_harness import run_broadcast_workload
+    batched_argv = [sys.executable, "-u", "-m",
+                    "gossip_tpu.runtime.maelstrom_node",
+                    "--gossip-interval", "0.05"]
+    stats = asyncio.run(run_broadcast_workload(
+        4, ops=8, rate=25.0, latency=0.001, partition_mid=True, seed=3,
+        argv=batched_argv))
+    assert stats["invariant_ok"] is True and stats["partitioned"] is True
+
+
+def test_immediate_node_relays_received_batch_without_flusher():
+    """A default-mode node (interval 0) receiving a 'gossip' batch from a
+    batched peer must relay through its immediate path and never start
+    the tick flusher (interval 0 would busy-spin it)."""
+    from gossip_tpu.runtime.maelstrom_node import (BroadcastServer,
+                                                   MaelstromNode)
+
+    async def main():
+        node = MaelstromNode()
+        node.node_id = "n0"
+        srv = BroadcastServer(node, gossip_interval=0.0)
+        srv.topology = {"n0": ["n1", "n2"]}
+        sent = []
+
+        async def fake_reply(msg, body):
+            sent.append(("reply", body["type"]))
+
+        async def fake_rpc(dest, body, timeout=2.0):
+            sent.append((dest, body["type"], tuple(body.get("messages",
+                                                            ()))or
+                         body.get("message")))
+            return {"body": {"type": "broadcast_ok"}}
+
+        node.reply = fake_reply
+        node.rpc = fake_rpc
+        await srv.on_gossip({"src": "n1",
+                             "body": {"type": "gossip",
+                                      "messages": [7, 8]}})
+        assert srv._flusher is None           # no busy-spin flusher
+        assert srv.messages == [7, 8]
+        # relayed to the non-sender neighbor only, via immediate RPCs
+        relays = [s for s in sent if s[0] == "n2"]
+        assert [r[2] for r in relays] == [7, 8]
+        assert not any(s[0] == "n1" for s in sent if s[0] != "reply")
+    run(main())
