@@ -4,8 +4,10 @@ grid (VERDICT r3 item 4).
 
 Runs ``gossip-tpu run --parity-check`` (jax-tpu flood rounds vs the
 go-native event engine's hop depths — the C++ core above 20k nodes) over
-{ring, grid, erdos_renyi} x {~1k, ~100k, ~1M} and writes ONE artifact,
-``artifacts/parity_r04.json``, with every contract metric per cell:
+every explicit family — {ring, grid, erdos_renyi} across {~1k, ~100k,
+~1M}, plus watts_strogatz and power_law at the 100k-class size — and
+writes ONE artifact, ``artifacts/parity_r04.json``, with every contract
+metric per cell:
 
   * ``curve_gap``           — exactly 0.0 on 'exact'-tier rows (race-
     free graph AND power-of-two n: one jax round == one hop depth,
@@ -21,7 +23,7 @@ correctness artifact, not a perf number, and the TPU tunnel must stay
 free for the watchdog/hw_refresh).  A cell that fails or times out is
 recorded as a skipped row with its reason — no silent truncation.
 
-    python tools/parity_matrix.py            # full matrix, ~10-20 min
+    python tools/parity_matrix.py            # full matrix, ~20-40 min
     python tools/parity_matrix.py ring-1024  # named cells only
 """
 
@@ -64,6 +66,16 @@ CELLS = [
                       "--max-rounds", "2200"], 3600, QUANT),
     ("er-1024", ["--family", "erdos_renyi", "--n", "1024", "--p", "0.01",
                  "--max-rounds", "64"], 300, RACY),
+    # the two remaining explicit families, at the 100k-class size: both
+    # racy (WS is a k>2 ring with shortcuts; power-law hubs multiply
+    # same-depth paths), so they carry the bound + fixed-point contract
+    ("ws-131072", ["--family", "watts_strogatz", "--n", "131072",
+                   "--k", "8", "--p", "0.1", "--max-rounds", "200"],
+     900, RACY),
+    # measured ~400 s (the padded power-law table build dominates);
+    # generous timeout so a slower machine doesn't turn it into a skip
+    ("powerlaw-131072", ["--family", "power_law", "--n", "131072",
+                         "--k", "3", "--max-rounds", "64"], 1800, RACY),
     ("er-131072", ["--family", "erdos_renyi", "--n", "131072",
                    "--p", "0.00009", "--max-rounds", "64"], 900, RACY),
     ("er-1000000", ["--family", "erdos_renyi", "--n", "1000000",
@@ -142,7 +154,9 @@ def main(only=None):
         "what": "backend-parity matrix via `gossip-tpu run "
                 "--parity-check` (VERDICT r3 item 4): jax-tpu flood "
                 "rounds vs the go-native event engine's hop depths on "
-                "the same graph, {ring, grid, er} x {~1k, ~100k, ~1M}. "
+                "the same graph — ring/grid/er across {~1k, ~100k, ~1M} "
+                "plus watts_strogatz and power_law at the 100k-class "
+                "size. "
                 "Contract by tier: 'exact' rows have curve_gap EXACTLY "
                 "0.0 (race-free graph, power-of-two n -> dyadic float32 "
                 "coverage); 'quantization' rows are race-free at "
