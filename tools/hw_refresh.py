@@ -12,6 +12,14 @@ GOSSIP_BENCH_PROBE_ATTEMPTS=3 it is 1200 (mr) + 900 (prng) +
 = 12,920 s):
 
     timeout 13500 python tools/hw_refresh.py      # default attempts
+    python tools/hw_refresh.py --smoke            # CPU-scale rehearsal
+
+``--smoke`` runs the SAME five-step pipeline at CPU scale on the
+hermetic env (plugin disarmed, 8 virtual devices, interpreter-mode
+kernels, sweep --scale 0.002, single fast bench probe) writing
+``.smoke``-infixed artifacts — a rehearsal of every subprocess,
+timeout, merge, and artifact path, runnable while the tunnel is down,
+so the real window is never burned by a plumbing bug.
 
 Steps (each prints a tagged JSON line; failures don't stop later steps):
   1. staged big-table MR kernel validation at 10M x 32 rumors
@@ -41,7 +49,24 @@ PRNG_TIMEOUT_S = 900
 SWEEP_TIMEOUT_S = 2400
 TESTS_TIMEOUT_S = 2400
 BENCH_SLACK_S = 200
-SUMMARY_PATH = os.path.join(REPO, "artifacts", "hw_refresh_r04.json")
+
+# --smoke: the full pipeline at CPU scale on the hermetic env — a
+# REHEARSAL of every subprocess/plumbing/artifact path, so the one
+# healthy tunnel window is never burned by a plumbing bug (round 2's
+# capture failed exactly that way).  Smoke artifacts carry a .smoke
+# infix and never touch the real r04 names.
+SMOKE = False
+
+
+def _art(name):
+    if SMOKE:
+        stem, dot, ext = name.rpartition(".")
+        name = f"{stem}.smoke.{ext}" if dot else name + ".smoke"
+    return os.path.join(REPO, "artifacts", name)
+
+
+def summary_path():
+    return _art("hw_refresh_r04.json")
 
 
 def _load_bench():
@@ -73,7 +98,7 @@ def load_summary():
     with these, never clobber a green result captured in an earlier
     healthy window."""
     try:
-        with open(SUMMARY_PATH) as f:
+        with open(summary_path()) as f:
             return {r["step"]: r for r in json.load(f)}
     except (OSError, ValueError, KeyError, TypeError):
         return {}
@@ -107,7 +132,7 @@ def step(tag, fn):
     # must not abort the remaining steps (stdout still carries the line)
     _SUMMARY[tag] = line
     try:
-        with open(SUMMARY_PATH, "w") as f:
+        with open(summary_path(), "w") as f:
             json.dump(list(_SUMMARY.values()), f, indent=1)
     except OSError as e:
         print(f"hw_refresh: summary write failed: {e}", file=sys.stderr)
@@ -126,26 +151,32 @@ def _mr_staged_body():
 
     from gossip_tpu.ops.pallas_round import (fused_multirumor_pull_round,
                                              init_multirumor_state)
-    n = 10_000_000
+    # smoke: tiny n on the CPU interpreter (stubbed PRNG — plumbing
+    # rehearsal, not statistics; all_rumors_growing is reported, not
+    # asserted, and is expected False under the degenerate stub)
+    n = 128 * 8 if SMOKE else 10_000_000
+    rounds = 4 if SMOKE else 20
     st = init_multirumor_state(n, 32)
     jax.block_until_ready(st.table)
     t0 = time.perf_counter()
     out = fused_multirumor_pull_round(st.table, jnp.int32(0), jnp.int32(1),
-                                      n, 1)
+                                      n, 1, interpret=SMOKE)
     jax.block_until_ready(out)
     compile_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    for r in range(2, 22):
+    for r in range(2, rounds + 2):
         out = fused_multirumor_pull_round(out, jnp.int32(0), jnp.int32(r),
-                                          n, 1)
+                                          n, 1, interpret=SMOKE)
     jax.block_until_ready(out)
-    per_round_ms = (time.perf_counter() - t0) / 20 * 1e3
+    per_round_ms = (time.perf_counter() - t0) / rounds * 1e3
     flat = np.asarray(out).reshape(-1)[:n]
     counts = [int(((flat >> k) & np.uint32(1)).sum()) for k in range(32)]
     print(json.dumps({"compile_s": round(compile_s, 2),
                       "per_round_ms": round(per_round_ms, 3),
-                      "mean_count_after_21": sum(counts) / 32,
-                      "all_rumors_growing": all(c > 64 for c in counts)}))
+                      "rounds_run": rounds + 1,
+                      f"mean_count_after_{rounds + 1}": sum(counts) / 32,
+                      "all_rumors_growing": all(c > 64 for c in counts),
+                      "smoke": SMOKE}))
     return 0
 
 
@@ -163,19 +194,42 @@ def _prng_body():
                                                    make_plane_mesh)
     n_dev = len(jax.devices())
     mesh = make_plane_mesh(n_dev)
-    d = assert_prng_invariant(128 * 64, mesh)
+    d = assert_prng_invariant(128 * 8 if SMOKE else 128 * 64, mesh,
+                              interpret=SMOKE)
     print(json.dumps({"devices": n_dev,
-                      "digests": np.asarray(d).tolist()}))
+                      "digests": np.asarray(d).tolist(),
+                      "smoke": SMOKE}))
     return 0
 
 
+def _body_env():
+    """Env for the step subprocesses.  Real runs keep the ambient TPU
+    platform (plus the repo on PYTHONPATH for run-by-path imports); the
+    smoke rehearsal must be fully hermetic — CPU platform, plugin
+    disarmed, an 8-device virtual mesh — or a wedged tunnel would hang
+    the rehearsal whose whole point is to run while the tunnel is down.
+    """
+    if not SMOKE:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        return env
+    env = _load_bench()._hermetic_cpu_env()
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    # conftest honors this var over JAX_PLATFORMS — an operator who has
+    # it exported for hardware runs must not leak it into the rehearsal
+    env.pop("GOSSIP_TPU_TEST_PLATFORM", None)
+    return env
+
+
+def _smoke_argv():
+    return ["--smoke"] if SMOKE else []
+
+
 def prng_invariant():
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     p = subprocess.run([sys.executable, os.path.abspath(__file__),
-                        "--prng-body"],
+                        "--prng-body", *_smoke_argv()],
                        capture_output=True, text=True,
-                       timeout=PRNG_TIMEOUT_S, cwd=REPO, env=env)
+                       timeout=PRNG_TIMEOUT_S, cwd=REPO, env=_body_env())
     if p.returncode != 0:
         raise RuntimeError((p.stderr or p.stdout)[-400:])
     return json.loads(p.stdout.strip().splitlines()[-1])
@@ -184,12 +238,11 @@ def prng_invariant():
 def mr_staged_10m():
     # run-by-path puts tools/ (not the repo root) on the child's
     # sys.path; gossip_tpu needs an explicit PYTHONPATH entry
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # (_body_env provides it both modes)
     p = subprocess.run([sys.executable, os.path.abspath(__file__),
-                        "--mr-body"],
+                        "--mr-body", *_smoke_argv()],
                        capture_output=True, text=True,
-                       timeout=MR_TIMEOUT_S, cwd=REPO, env=env)
+                       timeout=MR_TIMEOUT_S, cwd=REPO, env=_body_env())
     if p.returncode != 0:
         raise RuntimeError((p.stderr or p.stdout)[-400:])
     return json.loads(p.stdout.strip().splitlines()[-1])
@@ -201,7 +254,7 @@ def _write_sweep_artifact(stdout):
     hardware measurements from a scarce healthy window.  MERGES with an
     existing artifact by config name (new rows win) so a retry that got
     less far can never clobber rows a fuller earlier attempt captured."""
-    art = os.path.join(REPO, "artifacts", "baseline_sweep_r04.jsonl")
+    art = _art("baseline_sweep_r04.jsonl")
     if isinstance(stdout, bytes):
         stdout = stdout.decode(errors="replace")
     stdout = stdout or ""
@@ -234,10 +287,13 @@ def baseline_sweep():
     try:
         # -u: the per-config JSONL lines must not die in the child's
         # block buffer when a timeout SIGKILLs it mid-sweep
+        scale = "0.002" if SMOKE else "1.0"
+        extra = ["--devices", "4"] if SMOKE else []
         p = subprocess.run([sys.executable, "-u", "-m", "gossip_tpu",
-                            "sweep", "--scale", "1.0"],
+                            "sweep", "--scale", scale, *extra],
                            capture_output=True, text=True,
-                           timeout=SWEEP_TIMEOUT_S, cwd=REPO)
+                           timeout=SWEEP_TIMEOUT_S, cwd=REPO,
+                           env=_body_env())
     except subprocess.TimeoutExpired as e:
         _write_sweep_artifact(e.stdout)
         raise
@@ -256,19 +312,30 @@ def baseline_sweep():
 def bench():
     # must outlast bench.py's own worst case (probe retries + body +
     # hermetic retry) — computed by bench.py itself from the same
-    # constants its loops use, so the budget can't drift
+    # constants its loops use, so the budget can't drift.  Smoke: one
+    # fast probe on the hermetic CPU env (exercises bench's whole
+    # probe->body->one-JSON-line pipeline via its CPU fallback).
+    # non-smoke deliberately keeps the ambient env untouched (bench owns
+    # its own probe/fallback logic and never needed the PYTHONPATH help)
+    if SMOKE:
+        env = {**_body_env(), "GOSSIP_BENCH_PROBE_ATTEMPTS": "1"}
+    else:
+        env = dict(os.environ)
     p = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
                        capture_output=True, text=True,
-                       timeout=bench_budget_s(), cwd=REPO)
+                       timeout=bench_budget_s(), cwd=REPO, env=env)
     if p.returncode != 0:
         raise RuntimeError((p.stderr or p.stdout)[-400:])
     return json.loads(p.stdout.strip().splitlines()[-1])
 
 
 def tpu_pallas_tests():
-    art = os.path.join(REPO, "artifacts", "tpu_pallas_tests_r04.txt")
-    # conftest pins tests to CPU unless this var points at the chip
-    env = {**os.environ, "GOSSIP_TPU_TEST_PLATFORM": "axon"}
+    art = _art("tpu_pallas_tests_r04.txt")
+    # conftest pins tests to CPU unless this var points at the chip;
+    # smoke keeps CPU (the TPU-only classes skip — the rehearsal proves
+    # the pytest/artifact plumbing, the chip proves the statistics)
+    env = (_body_env() if SMOKE
+           else {**os.environ, "GOSSIP_TPU_TEST_PLATFORM": "axon"})
 
     def _text(x):
         return ("" if x is None else
@@ -339,6 +406,9 @@ def main(only=None):
 
 
 if __name__ == "__main__":
+    if "--smoke" in sys.argv:
+        SMOKE = True
+        _SUMMARY = load_summary()   # re-key to the smoke summary path
     if "--mr-body" in sys.argv:
         sys.exit(_mr_staged_body())
     if "--prng-body" in sys.argv:
