@@ -169,6 +169,42 @@ def base_alive(n: int, dead_nodes: Tuple[int, ...],
     return alive
 
 
+def disseminate_max(flat_t: jax.Array, flat_w: jax.Array, num_rows: int,
+                    impl: str = "scatter") -> jax.Array:
+    """Max-merge pushed wire rows into an ``int32[num_rows, S]`` table.
+
+    The piggyback-dissemination reduce (reference relay loop
+    main.go:72-88, batched): row ``r`` of the result is the elementwise
+    max of every ``flat_w[j]`` with ``flat_t[j] == r``; rows nobody
+    pushed to are 0 (the ALIVE@0 floor — wires are non-negative).
+    Targets outside ``[0, num_rows)`` (the silent-sender sentinel) are
+    dropped.
+
+    Two lowerings, bitwise-identical results (max is order-independent):
+
+    * ``scatter`` — one duplicate-index scatter-max.  On TPU a scatter
+      whose indices repeat serializes its updates, so cost grows with
+      the push count ``len(flat_t)``, not with HBM traffic.
+    * ``sort`` — sort the pushes by receiver, then a segment-max with
+      ``indices_are_sorted=True``.  Pays an O(M log M) sort but hands
+      XLA a monotone-index reduce.  The chip arbitrated
+      (artifacts/swim_ab_r04.json, 1M-node BASELINE shape): sort is
+      2.2x faster steady-state (25.7 s -> 11.6 s over 31 rounds) and
+      1.5x faster to compile (183 s -> 119 s), hence the default;
+      ``ProtocolConfig.swim_diss`` keeps scatter as the control.
+    """
+    if impl == "sort":
+        order = jnp.argsort(flat_t)
+        recv = jax.ops.segment_max(flat_w[order], flat_t[order],
+                                   num_segments=num_rows,
+                                   indices_are_sorted=True)
+        # empty segments fill with int32 min; clamp to the 0 floor the
+        # scatter form produces
+        return jnp.maximum(recv, 0)
+    return jnp.zeros((num_rows, flat_w.shape[1]), jnp.int32
+                     ).at[flat_t].max(flat_w, mode="drop")
+
+
 def probe_draws(rkey, gids, s_count: int, n: int, proxies: int,
                 drop_prob: float):
     """Steps 1-2 random draws: each node's probed subject, direct-probe drop,
@@ -276,7 +312,7 @@ def make_swim_round(proto: ProtocolConfig, n: int,
         flat_t = targets.reshape(-1)
         flat_w = jnp.broadcast_to(wire1[:, None, :],
                                   (n, fanout, s_count)).reshape(-1, s_count)
-        recv = jnp.zeros_like(wire1).at[flat_t].max(flat_w, mode="drop")
+        recv = disseminate_max(flat_t, flat_w, n, proto.swim_diss)
         wire2 = jnp.maximum(wire1, recv)
         msgs_diss = jnp.sum(targets < n).astype(jnp.float32)
 

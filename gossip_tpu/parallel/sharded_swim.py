@@ -112,8 +112,7 @@ def make_sharded_swim_round(
         flat_t = targets.reshape(-1)
         flat_w = jnp.broadcast_to(wire1[:, None, :],
                                   (nl, fanout, s_count)).reshape(-1, s_count)
-        contrib = jnp.zeros((n_pad, s_count), jnp.int32
-                            ).at[flat_t].max(flat_w, mode="drop")
+        contrib = SW.disseminate_max(flat_t, flat_w, n_pad, proto.swim_diss)
         recv_full = jax.lax.pmax(contrib, axis_name)
         recv_l = jax.lax.dynamic_slice_in_dim(recv_full, shard * nl, nl, 0)
         wire2 = jnp.maximum(wire1, recv_l)

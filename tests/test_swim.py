@@ -122,6 +122,36 @@ def test_sharded_swim_bitwise_parity(topo_fn):
     assert float(sharded.msgs) == pytest.approx(float(single.msgs))
 
 
+def test_sort_dissemination_bitwise_equals_scatter():
+    """swim_diss='sort' (the default since the r04 hardware A/B,
+    artifacts/swim_ab_r04.json) is a pure relowering: the whole
+    trajectory — single-device AND sharded — must be bitwise identical
+    to the scatter control (max-merge is order-independent; empty
+    segments clamp to the same 0 floor).  Both impls pinned explicitly
+    so the test outlives default flips."""
+    n, dead = 96, (0, 2)
+    fault = FaultConfig(drop_prob=0.15, seed=8)
+    protos = {impl: ProtocolConfig(mode="swim", fanout=2, swim_proxies=2,
+                                   swim_suspect_rounds=4, swim_subjects=4,
+                                   swim_diss=impl)
+              for impl in ("scatter", "sort")}
+    base = run(make_swim_round(protos["scatter"], n, dead, 4, fault),
+               init_swim_state(n, 4, seed=9), 12)
+    sort_single = run(make_swim_round(protos["sort"], n, dead, 4, fault),
+                      init_swim_state(n, 4, seed=9), 12)
+    np.testing.assert_array_equal(np.asarray(sort_single.wire),
+                                  np.asarray(base.wire))
+    np.testing.assert_array_equal(np.asarray(sort_single.timer),
+                                  np.asarray(base.timer))
+    mesh = make_mesh(8)
+    sort_sharded = run(
+        make_sharded_swim_round(protos["sort"], n, mesh, dead, 4, fault),
+        init_sharded_swim_state(n, protos["sort"], mesh, seed=9), 12)
+    np.testing.assert_array_equal(np.asarray(sort_sharded.wire)[:n],
+                                  np.asarray(base.wire))
+    assert float(sort_sharded.msgs) == pytest.approx(float(base.msgs))
+
+
 ROTATE = ProtocolConfig(mode="swim", fanout=2, swim_proxies=2,
                         swim_suspect_rounds=4, swim_subjects=8,
                         swim_rotate=True)
