@@ -187,6 +187,10 @@ def _hermetic_cpu_env():
             and not os.path.exists(os.path.join(p, "sitecustomize.py"))]
     env["PYTHONPATH"] = os.pathsep.join([repo] + keep)
     env["JAX_PLATFORMS"] = "cpu"
+    # hermetic means cache-off too: CLI children must neither write the
+    # operator's persistent ~/.cache nor report warm-cache walls as if
+    # they were cold measurements (cli._cache_stamp contract)
+    env["GOSSIP_COMPILE_CACHE"] = ""
     return env
 
 

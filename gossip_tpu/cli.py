@@ -20,11 +20,70 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import List, Optional
 
 from gossip_tpu.config import (FaultConfig, MeshConfig, ProtocolConfig,
                                RunConfig, TopologyConfig)
+
+
+_CACHE_DEFAULT = os.environ.get(
+    "GOSSIP_COMPILE_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "gossip_tpu", "xla"))
+
+
+def _add_cache_flags(p: argparse.ArgumentParser) -> None:
+    """JAX persistent compilation cache (default ON for every jax-driven
+    subcommand).  Rationale: the SWIM-1M BASELINE row's wall is ~88%
+    XLA compile (127.7 s of 145.5 s, artifacts/baseline_sweep_r04b.jsonl)
+    and the r04 ablation (artifacts/swim_compile_ablation_r04.json,
+    tools/swim_compile_ablation.py) showed that cost is structural —
+    spread across the whole 1M-row program (every component stub is
+    within the +-4 s repeat-compile noise; compile scales with n, see
+    the artifact's scaling_compile_s_by_n: 28.5 s at 100k -> ~120 s at
+    1M) — so the fix is to pay it once per shape EVER, not once per
+    process."""
+    p.add_argument("--compile-cache", default=_CACHE_DEFAULT, metavar="DIR",
+                   help="persistent XLA compilation cache directory "
+                        "(env GOSSIP_COMPILE_CACHE overrides the "
+                        "default; repeated runs of the same shapes skip "
+                        "recompilation)")
+    p.add_argument("--no-compile-cache", action="store_true",
+                   help="disable the persistent compilation cache (e.g. "
+                        "to measure cold compile_s)")
+
+
+def _enable_compile_cache(a) -> None:
+    if not hasattr(a, "no_compile_cache"):   # subcommand without the flags
+        return
+    import jax
+    if a.no_compile_cache or not a.compile_cache:
+        # explicit disable must also override a JAX_COMPILATION_CACHE_DIR
+        # env var, or the documented "honest cold compile" measurement
+        # could silently hit that cache
+        jax.config.update("jax_compilation_cache_dir", None)
+        return
+    try:
+        os.makedirs(a.compile_cache, exist_ok=True)
+    except OSError as e:   # read-only HOME / sandbox: run uncached
+        print(f"warning: compile cache disabled ({e})", file=sys.stderr)
+        jax.config.update("jax_compilation_cache_dir", None)
+        a.no_compile_cache = True      # keep _cache_stamp honest
+        return
+    jax.config.update("jax_compilation_cache_dir", a.compile_cache)
+    # cache anything that took >2 s to compile; below that the disk
+    # round-trip costs more than the recompile
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+
+def _cache_stamp(a):
+    """What a report row records about the compile cache, so warm-cache
+    compile_s can never masquerade as a cold measurement in an artifact."""
+    if not hasattr(a, "no_compile_cache") or a.no_compile_cache or \
+            not a.compile_cache:
+        return None
+    return a.compile_cache
 
 
 def _add_run_flags(p: argparse.ArgumentParser) -> None:
@@ -274,6 +333,7 @@ def cmd_run(a) -> int:
                "hop_bound_violation": max(0.0, bound),
                "fixed_point_gap": abs(rep.coverage - ref.coverage),
                "n": tc.n, "family": a.family,
+               "compile_cache": _cache_stamp(a),
                "jax": {**rep.to_dict(), "curve": None},
                "gonative": {**ref.to_dict(), "curve": None}}
         if a.profile:
@@ -292,6 +352,7 @@ def cmd_run(a) -> int:
         report = run_simulation(a.backend, proto, tc, run, fault, mesh,
                                 want_curve=want_curve)
     out = report.to_dict()
+    out["compile_cache"] = _cache_stamp(a)
     if a.profile:
         out["profile_logdir"] = a.profile
     if a.save_curve:
@@ -458,7 +519,8 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
            "rounds": int(final.round), "coverage": cov,
            "msgs": float(final.msgs), "checkpoint": a.checkpoint,
            "checkpoint_every": a.checkpoint_every, "resumed": resumed,
-           "engine": engine_label, "devices": n_dev}
+           "engine": engine_label, "devices": n_dev,
+           "compile_cache": _cache_stamp(a)}
     if a.profile:
         out["profile_logdir"] = a.profile
     if a.save_curve:
@@ -529,6 +591,9 @@ def cmd_sweep(a) -> int:
         # changes so sweep artifacts from different definitions can never
         # be compared as if they measured the same thing
         out["config_revision"] = cfg.get("revision", 1)
+        # same principle for timings: a warm-cache compile_s must be
+        # distinguishable from a cold one in the artifact itself
+        out["compile_cache"] = _cache_stamp(a)
         if cfg.get("compare_gonative"):
             ref = run_simulation("go-native",
                                  ProtocolConfig(mode="flood"), cfg["tc"],
@@ -670,6 +735,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     p = sub.add_parser("run", help="run one simulation")
     _add_run_flags(p)
+    _add_cache_flags(p)
     p.set_defaults(fn=cmd_run)
 
     p = sub.add_parser("sweep", help="run the 5 BASELINE benchmark configs")
@@ -680,6 +746,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--only", nargs="*", default=None,
                    help="subset of config names")
     p.add_argument("--curve", action="store_true")
+    _add_cache_flags(p)
     p.set_defaults(fn=cmd_sweep)
 
     p = sub.add_parser("grid", help="batched config sweep: cartesian "
@@ -722,11 +789,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                    metavar=("SWEEP", "NODES"),
                    help="2-D mesh: configs sharded over SWEEP devices, "
                         "each config's nodes over NODES devices")
+    _add_cache_flags(p)
     p.set_defaults(fn=cmd_grid)
 
     p = sub.add_parser("serve", help="start the gRPC sidecar")
     p.add_argument("--port", type=int, default=50051)
     p.add_argument("--workers", type=int, default=4)
+    _add_cache_flags(p)
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("maelstrom",
@@ -778,6 +847,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             # before any jax API (no-op without the coordinator env vars)
             from gossip_tpu.parallel.multislice import maybe_init_distributed
             maybe_init_distributed()
+            _enable_compile_cache(a)
         return a.fn(a)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)

@@ -288,7 +288,11 @@ def test_rpc_sidecar_round_trip():
 
 CLI_ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
-           "PYTHONPATH": _REPO}
+           "PYTHONPATH": _REPO,
+           # empty = cache OFF: tests must not write the developer's
+           # persistent ~/.cache (an explicit --compile-cache flag in a
+           # test still overrides this)
+           "GOSSIP_COMPILE_CACHE": ""}
 
 
 def _cli(*argv):
@@ -320,6 +324,25 @@ def test_cli_run_jax_and_error_paths():
              "--ensemble", "4")
     assert p.returncode == 2
     assert "single-run only" in p.stderr
+
+
+def test_cli_compile_cache_flags(tmp_path):
+    """--compile-cache creates the cache dir and the run still works
+    (whether entries land depends on the 2 s min-compile threshold);
+    --no-compile-cache runs without touching the path."""
+    cache = tmp_path / "xla-cache"
+    p = _cli("run", "--mode", "pushpull", "--n", "256",
+             "--family", "erdos_renyi", "--p", "0.05",
+             "--compile-cache", str(cache))
+    assert p.returncode == 0, p.stderr
+    assert json.loads(p.stdout)["coverage"] >= 0.9
+    assert cache.is_dir()
+    off = tmp_path / "never-created"
+    p = _cli("run", "--mode", "pushpull", "--n", "256",
+             "--family", "erdos_renyi", "--p", "0.05",
+             "--compile-cache", str(off), "--no-compile-cache")
+    assert p.returncode == 0, p.stderr
+    assert not off.exists()
 
 
 def test_cli_grid_ns_one_program():

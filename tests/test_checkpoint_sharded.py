@@ -38,7 +38,9 @@ pytestmark = pytest.mark.skipif(
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CLI_ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
-           "PYTHONPATH": _REPO}
+           "PYTHONPATH": _REPO,
+           # cache OFF: tests must not write the developer's ~/.cache
+           "GOSSIP_COMPILE_CACHE": ""}
 
 
 def _cli(*argv):

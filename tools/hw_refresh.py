@@ -289,8 +289,13 @@ def baseline_sweep():
         # block buffer when a timeout SIGKILLs it mid-sweep
         scale = "0.002" if SMOKE else "1.0"
         extra = ["--devices", "4"] if SMOKE else []
+        # --no-compile-cache: the captured compile_s IS the canonical
+        # cold number; the (default-on) persistent cache would silently
+        # substitute a ~3 s warm compile on any host that ever built
+        # these shapes before
         p = subprocess.run([sys.executable, "-u", "-m", "gossip_tpu",
-                            "sweep", "--scale", scale, *extra],
+                            "sweep", "--scale", scale,
+                            "--no-compile-cache", *extra],
                            capture_output=True, text=True,
                            timeout=SWEEP_TIMEOUT_S, cwd=REPO,
                            env=_body_env())
