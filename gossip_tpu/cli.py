@@ -180,12 +180,16 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                         "(full-membership failure detection)")
     p.add_argument("--swim-epoch-rounds", type=int, default=0,
                    help="rounds per rotating-window epoch (0 = auto)")
-    p.add_argument("--swim-diss", choices=("scatter", "sort"),
+    p.add_argument("--swim-diss", choices=("scatter", "sort", "pack"),
                    default="sort",
-                   help="dissemination reduce lowering: sort-by-receiver "
-                        "+ segment-max (default; 2.2x faster on TPU, "
-                        "artifacts/swim_ab_r04.json), or the duplicate-"
-                        "index scatter-max control (bitwise-identical)")
+                   help="dissemination reduce lowering (all bitwise-"
+                        "identical): 'sort' = sort-by-receiver + "
+                        "segment-max (default; 2.2x faster on TPU, "
+                        "artifacts/swim_ab_r04.json); 'scatter' = "
+                        "duplicate-index scatter-max control; 'pack' = "
+                        "sort with the row gather on 8/16-bit packed "
+                        "codes — needs --max-rounds to prove its lane "
+                        "bound, silently falls back to sort without it")
     p.add_argument("--dead-nodes", nargs="*", type=int, default=None,
                    metavar="ID",
                    help="node ids that fail at --fail-round (swim scenario; "

@@ -97,11 +97,16 @@ class ProtocolConfig:
     swim_epoch_rounds: int = 0
     # Dissemination scatter implementation (models/swim.disseminate_max):
     # 'scatter' = direct duplicate-index scatter-max; 'sort' = sort pushes
-    # by receiver then a sorted segment-max — bitwise-identical results
-    # (max is order-independent), different TPU lowering.  Hardware
-    # arbitrated (artifacts/swim_ab_r04.json, 1M-node BASELINE shape):
-    # sort is 2.2x faster steady-state AND 1.5x faster to compile, so it
-    # is the default; 'scatter' stays selectable as the control.
+    # by receiver then a sorted segment-max; 'pack' = the sort lowering
+    # with the row gather done on 8/16-bit packed transport codes (an
+    # order isomorphism on the wires a round-bounded run can produce) —
+    # all bitwise-identical results (max is order-independent),
+    # different TPU lowerings.  Hardware arbitrated
+    # (artifacts/swim_ab_r04.json, 1M-node BASELINE shape): sort is
+    # 2.2x faster steady-state AND 1.5x faster to compile than scatter,
+    # so it is the default; 'scatter' stays selectable as the control;
+    # 'pack' needs the driver's max_rounds to prove its lane bound and
+    # falls back to 'sort' where that is unknown.
     swim_diss: str = "sort"
     # Rumor mongering (mode='rumor', models/rumor.py): an infective
     # (node, rumor) stops spreading — becomes removed, SIR — once its
@@ -122,9 +127,9 @@ class ProtocolConfig:
             raise ValueError("swim_subjects must be >= 1")
         if self.swim_epoch_rounds < 0:
             raise ValueError("swim_epoch_rounds must be >= 0 (0 = auto)")
-        if self.swim_diss not in ("scatter", "sort"):
+        if self.swim_diss not in ("scatter", "sort", "pack"):
             raise ValueError(f"unknown swim_diss {self.swim_diss!r}; "
-                             "choose 'scatter' or 'sort'")
+                             "choose 'scatter', 'sort', or 'pack'")
         if self.rumor_k < 1:
             raise ValueError("rumor_k must be >= 1")
         if self.rumor_variant not in RUMOR_VARIANTS:

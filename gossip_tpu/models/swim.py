@@ -169,22 +169,44 @@ def base_alive(n: int, dead_nodes: Tuple[int, ...],
     return alive
 
 
-def disseminate_max(flat_t: jax.Array, flat_w: jax.Array, num_rows: int,
-                    impl: str = "scatter") -> jax.Array:
+def pack_width(max_rounds) -> int:
+    """Static transport-lane width (bits) for ``disseminate_max('pack')``.
+
+    Live wires are bounded by ``2*rounds + 1`` (incarnation grows by at
+    most 1 per round, via refutation), so a run capped at ``max_rounds``
+    fits every live wire strictly below the lane cap when
+    ``2*max_rounds + 3 < 2**width - 1`` (margin 2 over the proof bound).
+    Returns 8, 16, or 0 (no width fits / bound unknown — caller falls
+    back to the unpacked ``sort`` lowering)."""
+    if max_rounds is None:
+        return 0
+    bound = 2 * int(max_rounds) + 3
+    if bound < 0xFF:
+        return 8
+    if bound < 0xFFFF:
+        return 16
+    return 0
+
+
+def disseminate_max(targets: jax.Array, wire: jax.Array, num_rows: int,
+                    impl: str = "scatter", max_rounds=None) -> jax.Array:
     """Max-merge pushed wire rows into an ``int32[num_rows, S]`` table.
 
     The piggyback-dissemination reduce (reference relay loop
-    main.go:72-88, batched): row ``r`` of the result is the elementwise
-    max of every ``flat_w[j]`` with ``flat_t[j] == r``; rows nobody
-    pushed to are 0 (the ALIVE@0 floor — wires are non-negative).
-    Targets outside ``[0, num_rows)`` (the silent-sender sentinel) are
-    dropped.
+    main.go:72-88, batched): each sender ``i`` pushes its whole wire
+    row ``wire[i]`` to every receiver in ``targets[i]``; row ``r`` of
+    the result is the elementwise max of every row pushed to it; rows
+    nobody pushed to are 0 (the ALIVE@0 floor — wires are
+    non-negative).  Targets outside ``[0, num_rows)`` (the
+    silent-sender sentinel) are dropped.
 
-    Two lowerings, bitwise-identical results (max is order-independent):
+    Three lowerings, bitwise-identical results (max is
+    order-independent; ``pack``'s transport code is an order
+    isomorphism on the values that can occur):
 
     * ``scatter`` — one duplicate-index scatter-max.  On TPU a scatter
       whose indices repeat serializes its updates, so cost grows with
-      the push count ``len(flat_t)``, not with HBM traffic.
+      the push count ``N*fanout``, not with HBM traffic.
     * ``sort`` — sort the pushes by receiver, then a segment-max with
       ``indices_are_sorted=True``.  Pays an O(M log M) sort but hands
       XLA a monotone-index reduce.  The chip arbitrated
@@ -192,8 +214,50 @@ def disseminate_max(flat_t: jax.Array, flat_w: jax.Array, num_rows: int,
       2.2x faster steady-state (25.7 s -> 11.6 s over 31 rounds) and
       1.5x faster to compile (183 s -> 119 s), hence the default;
       ``ProtocolConfig.swim_diss`` keeps scatter as the control.
+    * ``pack`` — the sort lowering with the random row gather (its
+      dominant HBM cost: ~7 ns/word x M*S words, the repo cost model)
+      done on 8- or 16-bit *transport codes*, 4 or 2 lanes per uint32
+      word.  ``t = min(wire, cap)`` is monotone and injective on the
+      values a ``max_rounds``-bounded run can produce (live wires
+      <= 2*rounds+1 << cap; DEAD_WIRE -> cap), so max commutes with
+      the coding and ``cap -> DEAD_WIRE`` after the reduce restores
+      the exact int32 wires: trajectories stay bitwise identical to
+      ``scatter``/``sort``.  The gather also reads the [N, W] packed
+      table via ``sorted_index // fanout`` instead of a materialized
+      [N*fanout, S] broadcast, cutting the gathered words 4x (8-bit)
+      or 2x (16-bit) plus the operand copy.  Requires ``max_rounds``
+      (the static round budget every driver knows); without it the
+      bound is unprovable and this falls back to ``sort``.
     """
-    if impl == "sort":
+    fanout = targets.shape[1]
+    s_count = wire.shape[1]
+    flat_t = targets.reshape(-1)
+    width = pack_width(max_rounds) if impl == "pack" else 0
+    if impl == "pack" and width:
+        lanes = 32 // width
+        cap = (1 << width) - 1
+        code = jnp.minimum(wire, cap).astype(jnp.uint32)     # order-iso
+        lane_pad = (-s_count) % lanes
+        if lane_pad:
+            code = jnp.pad(code, ((0, 0), (0, lane_pad)))
+        grouped = code.reshape(code.shape[0], -1, lanes)
+        packed = grouped[:, :, 0]
+        for lane in range(1, lanes):
+            packed = packed | (grouped[:, :, lane] << (width * lane))
+        order = jnp.argsort(flat_t)
+        g = packed[order // fanout]          # THE gather, in packed words
+        cols = [((g >> (width * lane)) & cap).astype(jnp.uint16)
+                for lane in range(lanes)]
+        codes = jnp.stack(cols, axis=-1).reshape(g.shape[0], -1)[:, :s_count]
+        # empty segments fill with the uint16 min = 0: the floor for free
+        recv = jax.ops.segment_max(codes, flat_t[order],
+                                   num_segments=num_rows,
+                                   indices_are_sorted=True).astype(jnp.int32)
+        return jnp.where(recv == cap, DEAD_WIRE, recv)
+    flat_w = jnp.broadcast_to(wire[:, None, :],
+                              (wire.shape[0], fanout, s_count)
+                              ).reshape(-1, s_count)
+    if impl in ("sort", "pack"):             # pack w/o a bound: plain sort
         order = jnp.argsort(flat_t)
         recv = jax.ops.segment_max(flat_w[order], flat_t[order],
                                    num_segments=num_rows,
@@ -238,10 +302,16 @@ def make_swim_round(proto: ProtocolConfig, n: int,
                     fault: Optional[FaultConfig] = None,
                     topo: Optional[Topology] = None,
                     tabled: bool = False,
+                    max_rounds=None,
                     ):
     """Single-device SWIM round step (sharded twin:
     :func:`gossip_tpu.parallel.sharded_swim.make_sharded_swim_round`, kept
     semantically identical — tests/test_swim.py asserts bitwise parity).
+
+    ``max_rounds`` (the driver's static round budget) is only consulted
+    by the ``swim_diss='pack'`` dissemination lowering, which needs it to
+    prove its transport-lane bound (:func:`pack_width`); None is always
+    safe (pack falls back to the unpacked sort lowering).
 
     Returns ``step: SwimState -> SwimState``, or with ``tabled=True`` the
     pair ``(step, tables)`` where ``step(state, *tables)`` takes the
@@ -309,10 +379,8 @@ def make_swim_round(proto: ProtocolConfig, n: int,
         targets = sample_peers(dkey, ids, topo, fanout, exclude_self=True,
                                local_nbrs=nbrs, local_deg=deg)
         targets = jnp.where(alive_now[:, None], targets, n)   # dead: silent
-        flat_t = targets.reshape(-1)
-        flat_w = jnp.broadcast_to(wire1[:, None, :],
-                                  (n, fanout, s_count)).reshape(-1, s_count)
-        recv = disseminate_max(flat_t, flat_w, n, proto.swim_diss)
+        recv = disseminate_max(targets, wire1, n, proto.swim_diss,
+                               max_rounds)
         wire2 = jnp.maximum(wire1, recv)
         msgs_diss = jnp.sum(targets < n).astype(jnp.float32)
 

@@ -36,7 +36,8 @@ def make_sharded_swim_round(
         fault: Optional[FaultConfig] = None,
         topo: Optional[Topology] = None,
         axis_name: str = "nodes",
-        tabled: bool = False):
+        tabled: bool = False,
+        max_rounds=None):
     """Returns ``step: SwimState -> SwimState``; ``tabled=True`` returns
     ``(step, tables)`` with the padded topology arrays as step ARGUMENTS
     rather than closure constants — see models/swim.make_swim_round: at
@@ -109,10 +110,8 @@ def make_sharded_swim_round(
         # silent senders (dead/padding) -> n_pad so the scatter drops them
         # (sentinel n would land on a padding row when n < n_pad)
         targets = jnp.where(alive_l[:, None], targets, n_pad)
-        flat_t = targets.reshape(-1)
-        flat_w = jnp.broadcast_to(wire1[:, None, :],
-                                  (nl, fanout, s_count)).reshape(-1, s_count)
-        contrib = SW.disseminate_max(flat_t, flat_w, n_pad, proto.swim_diss)
+        contrib = SW.disseminate_max(targets, wire1, n_pad, proto.swim_diss,
+                                     max_rounds)
         recv_full = jax.lax.pmax(contrib, axis_name)
         recv_l = jax.lax.dynamic_slice_in_dim(recv_full, shard * nl, nl, 0)
         wire2 = jnp.maximum(wire1, recv_l)

@@ -131,7 +131,7 @@ def simulate_swim_curve(proto: ProtocolConfig, n: int, rounds: int,
     if mesh is None:
         step, tables = SW.make_swim_round(proto, n, tuple(dead_nodes),
                                           fail_round, fault, topo,
-                                          tabled=True)
+                                          tabled=True, max_rounds=rounds)
         init = SW.init_swim_state(n, proto.swim_subjects, seed)
     else:
         from gossip_tpu.parallel.sharded_swim import (
@@ -139,7 +139,8 @@ def simulate_swim_curve(proto: ProtocolConfig, n: int, rounds: int,
         step, tables = make_sharded_swim_round(proto, n, mesh,
                                                tuple(dead_nodes),
                                                fail_round, fault, topo,
-                                               tabled=True)
+                                               tabled=True,
+                                               max_rounds=rounds)
         init = init_sharded_swim_state(n, proto, mesh, seed)
     dead = tuple(dead_nodes)
     rotate = proto.swim_rotate
@@ -190,7 +191,7 @@ def simulate_swim_until(proto: ProtocolConfig, n: int, max_rounds: int,
     if mesh is None:
         step, tables = SW.make_swim_round(proto, n, tuple(dead_nodes),
                                           fail_round, fault, topo,
-                                          tabled=True)
+                                          tabled=True, max_rounds=max_rounds)
         init = SW.init_swim_state(n, proto.swim_subjects, seed)
     else:
         from gossip_tpu.parallel.sharded_swim import (
@@ -198,7 +199,8 @@ def simulate_swim_until(proto: ProtocolConfig, n: int, max_rounds: int,
         step, tables = make_sharded_swim_round(proto, n, mesh,
                                                tuple(dead_nodes),
                                                fail_round, fault, topo,
-                                               tabled=True)
+                                               tabled=True,
+                                               max_rounds=max_rounds)
         init = init_sharded_swim_state(n, proto, mesh, seed)
     dead = tuple(dead_nodes)
     rotate = proto.swim_rotate

@@ -122,34 +122,70 @@ def test_sharded_swim_bitwise_parity(topo_fn):
     assert float(sharded.msgs) == pytest.approx(float(single.msgs))
 
 
-def test_sort_dissemination_bitwise_equals_scatter():
-    """swim_diss='sort' (the default since the r04 hardware A/B,
-    artifacts/swim_ab_r04.json) is a pure relowering: the whole
+@pytest.mark.parametrize("impl,max_rounds", [
+    ("sort", None),        # the default since the r04 hardware A/B
+    ("pack", 12),          # 8-bit lanes (2*12+3 < 0xFF)
+    ("pack", 200),         # 16-bit lanes
+    ("pack", None),        # bound unknown -> documented sort fallback
+], ids=["sort", "pack8", "pack16", "pack-fallback"])
+def test_dissemination_relowerings_bitwise_equal_scatter(impl, max_rounds):
+    """swim_diss='sort'/'pack' are pure relowerings
+    (artifacts/swim_ab_r04.json arbitrated sort as default): the whole
     trajectory — single-device AND sharded — must be bitwise identical
     to the scatter control (max-merge is order-independent; empty
-    segments clamp to the same 0 floor).  Both impls pinned explicitly
-    so the test outlives default flips."""
+    segments clamp to the same 0 floor; pack's transport code is an
+    order isomorphism under its round bound).  All impls pinned
+    explicitly so the test outlives default flips."""
     n, dead = 96, (0, 2)
     fault = FaultConfig(drop_prob=0.15, seed=8)
-    protos = {impl: ProtocolConfig(mode="swim", fanout=2, swim_proxies=2,
-                                   swim_suspect_rounds=4, swim_subjects=4,
-                                   swim_diss=impl)
-              for impl in ("scatter", "sort")}
-    base = run(make_swim_round(protos["scatter"], n, dead, 4, fault),
+    mk = lambda i: ProtocolConfig(mode="swim", fanout=2, swim_proxies=2,
+                                  swim_suspect_rounds=4, swim_subjects=4,
+                                  swim_diss=i)
+    base = run(make_swim_round(mk("scatter"), n, dead, 4, fault),
                init_swim_state(n, 4, seed=9), 12)
-    sort_single = run(make_swim_round(protos["sort"], n, dead, 4, fault),
-                      init_swim_state(n, 4, seed=9), 12)
-    np.testing.assert_array_equal(np.asarray(sort_single.wire),
+    single = run(make_swim_round(mk(impl), n, dead, 4, fault,
+                                 max_rounds=max_rounds),
+                 init_swim_state(n, 4, seed=9), 12)
+    np.testing.assert_array_equal(np.asarray(single.wire),
                                   np.asarray(base.wire))
-    np.testing.assert_array_equal(np.asarray(sort_single.timer),
+    np.testing.assert_array_equal(np.asarray(single.timer),
                                   np.asarray(base.timer))
     mesh = make_mesh(8)
-    sort_sharded = run(
-        make_sharded_swim_round(protos["sort"], n, mesh, dead, 4, fault),
-        init_sharded_swim_state(n, protos["sort"], mesh, seed=9), 12)
-    np.testing.assert_array_equal(np.asarray(sort_sharded.wire)[:n],
+    sharded = run(
+        make_sharded_swim_round(mk(impl), n, mesh, dead, 4, fault,
+                                max_rounds=max_rounds),
+        init_sharded_swim_state(n, mk(impl), mesh, seed=9), 12)
+    np.testing.assert_array_equal(np.asarray(sharded.wire)[:n],
                                   np.asarray(base.wire))
-    assert float(sort_sharded.msgs) == pytest.approx(float(base.msgs))
+    assert float(sharded.msgs) == pytest.approx(float(base.msgs))
+
+
+def test_disseminate_max_pack_unit():
+    """Unit contract of the packed transport: bitwise equal to scatter
+    on adversarial inputs (DEAD_WIRE rows to exercise the cap remap,
+    sentinel targets to exercise the drop, odd S to exercise lane
+    padding, wires at the exact proof bound 2*max_rounds+1), at both
+    lane widths; width selection follows models/swim.pack_width."""
+    import jax.numpy as jnp
+    from gossip_tpu.models.swim import DEAD_WIRE, disseminate_max, pack_width
+    assert pack_width(None) == 0
+    assert pack_width(12) == 8
+    assert pack_width(125) == 8
+    assert pack_width(126) == 16
+    assert pack_width(32765) == 16
+    assert pack_width(32766) == 0          # no lane fits: caller falls back
+    rng = np.random.default_rng(3)
+    for max_rounds in (60, 500):
+        n, fanout, s = 257, 3, 5           # odd S: lane padding in play
+        targets = jnp.asarray(rng.integers(0, n + 1, size=(n, fanout)),
+                              jnp.int32)   # n = silent-sender sentinel
+        w = rng.integers(0, 2 * max_rounds + 2, size=(n, s)).astype(np.int32)
+        w[rng.random((n, s)) < 0.1] = int(DEAD_WIRE)
+        w = jnp.asarray(w)
+        base = disseminate_max(targets, w, n, "scatter")
+        out = disseminate_max(targets, w, n, "pack", max_rounds)
+        assert out.dtype == jnp.int32
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
 
 
 ROTATE = ProtocolConfig(mode="swim", fanout=2, swim_proxies=2,

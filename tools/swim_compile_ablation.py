@@ -73,8 +73,8 @@ def main():
                 jnp.zeros((m, proxies), jnp.bool_),
                 jnp.zeros((m, proxies), jnp.bool_))
 
-    def stub_diss(flat_t, flat_w, num_rows, impl="sort"):
-        return jnp.zeros((num_rows, flat_w.shape[1]), jnp.int32)
+    def stub_diss(targets, wire, num_rows, impl="sort", max_rounds=None):
+        return jnp.zeros((num_rows, wire.shape[1]), jnp.int32)
 
     def stub_sample(key, ids, topo_, fanout, exclude_self=True,
                     local_nbrs=None, local_deg=None):

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""A/B the SWIM dissemination lowerings on the real chip.
+
+docs/PERF.md "SWIM-1M cost budget" leaves steady state (~374 ms/round
+at 1M nodes) as the remaining lever, and the repo cost model prices its
+dominant HBM term — the sorted row gather — at ~7 ns/word x M*S words.
+``swim_diss='pack'`` (models/swim.disseminate_max) gathers 8/16-bit
+packed transport codes instead, 4x/2x fewer words, bitwise-identical
+trajectories (tests/test_swim.py pins the equivalence).  This tool
+arbitrates on hardware, exactly like the r04 sort-vs-scatter A/B
+(artifacts/swim_ab_r04.json) whose verdict made sort the default:
+
+  - runs the exact BASELINE SWIM-1M shape through the run CLI once per
+    impl (fresh per-impl compile-cache dir: compile_s stays honest),
+  - asserts the trajectories match (rounds / coverage / msgs equal —
+    anything else means the lowering is NOT pure and must not ship),
+  - writes artifacts/swim_diss_ab_r04.json with walls, steady split,
+    and a verdict line.
+
+Run only when the tunnel is healthy (tools/tunnel_watchdog.py probes
+first).  ``--smoke`` rehearses the plumbing at CPU scale (n=20k, no
+TPU) writing a ``.smoke``-infixed artifact, repo convention.
+
+    python tools/swim_diss_ab.py                 # sort (control) vs pack
+    python tools/swim_diss_ab.py --impls scatter sort pack
+    python tools/swim_diss_ab.py --smoke
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+try:
+    from _bench import hermetic_cpu_env as _hermetic_cpu_env  # noqa: E402
+finally:
+    sys.path.pop(0)
+
+
+class WedgeTimeout(RuntimeError):
+    """A run blew its subprocess budget — the tunnel-wedge signature.
+    Transient, not a verdict: main() maps this to exit code 2, the
+    capture tools' convention for "retry at a later healthy window"
+    (tools/tunnel_watchdog.py --cmd retries 2, gives up on 1)."""
+
+
+class CliFailed(RuntimeError):
+    """The run CLI exited nonzero.  Ambiguous: a wedged tunnel can fail
+    FAST at init (bench.py's 'fast init failure' symptom), or the
+    candidate lowering can genuinely crash.  main() disambiguates by
+    re-probing the tunnel — probe dead -> exit 2 (transient), probe
+    alive -> exit 1 (deterministic; do not retry)."""
+
+
+def probe(timeout_s: int = 120) -> bool:
+    """Cheap tunnel probe (the wedge signature is a hang, so a timeout
+    means NO — tools/tunnel_watchdog.py's contract).  Skipped in smoke
+    mode."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices())"],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False
+    return p.returncode == 0
+
+BASE_ARGS = ["--mode", "swim", "--family", "power_law", "--k", "3",
+             "--degree-cap", "256", "--fanout", "2", "--swim-subjects", "8",
+             "--swim-proxies", "3", "--swim-suspect-rounds", "24",
+             "--max-rounds", "80"]
+
+
+def run_one(impl: str, n: int, timeout_s: int, smoke: bool) -> dict:
+    cmd = [sys.executable, "-m", "gossip_tpu", "run", "--n", str(n),
+           *BASE_ARGS, "--swim-diss", impl]
+    env = _hermetic_cpu_env() if smoke else dict(os.environ)
+    with tempfile.TemporaryDirectory(prefix=f"swimab-{impl}-") as cache:
+        cmd += ["--compile-cache", cache]   # per-impl dir: cold, honest
+        t0 = time.time()
+        # own process group + group kill on timeout: a half-killed TPU
+        # client wedges the single-client tunnel (watchdog contract)
+        p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True, cwd=REPO,
+                             env=env, start_new_session=True)
+        try:
+            stdout, stderr = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            p.communicate()
+            raise WedgeTimeout(
+                f"{impl}: run timed out after {timeout_s} s — tunnel "
+                "wedge signature; aborting (retry at the next healthy "
+                "window, e.g. tools/tunnel_watchdog.py --cmd)")
+    if p.returncode != 0:
+        raise CliFailed(f"{impl}: run CLI failed rc={p.returncode}\n"
+                        f"{stderr[-2000:]}")
+    out = None
+    for line in stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                cand = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "wall_s" in cand:
+                out = cand
+    if out is None:
+        raise RuntimeError(f"{impl}: no result JSON on stdout\n"
+                           f"{stdout[-2000:]}")
+    meta = out.get("meta") or {}
+    return {"swim_diss": impl,
+            "wall_s": out["wall_s"],
+            "compile_s": meta.get("compile_s"),
+            "steady_wall_s": meta.get("steady_wall_s"),
+            "rounds": out["rounds"],
+            "coverage": out["coverage"],
+            "msgs": out["msgs"],
+            "subprocess_wall_s": round(time.time() - t0, 1)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--impls", nargs="+", default=["sort", "pack"])
+    ap.add_argument("--n", type=int, default=1_000_000)
+    ap.add_argument("--timeout", type=int, default=900,
+                    help="per-run subprocess timeout (s)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CPU-scale rehearsal (n=20k, JAX_PLATFORMS=cpu)")
+    a = ap.parse_args()
+    if not a.smoke and not probe():
+        print("tunnel probe failed (wedge signature) — not burning the "
+              "per-run budget; retry at the next healthy window",
+              file=sys.stderr)
+        return 2
+    n = 20_000 if a.smoke else a.n
+    infix = ".smoke" if a.smoke else ""
+    art = os.path.join(REPO, "artifacts", f"swim_diss_ab_r04{infix}.json")
+
+    rows = []
+    for impl in a.impls:
+        try:
+            row = run_one(impl, n, a.timeout, a.smoke)
+        except WedgeTimeout as e:
+            print(str(e), file=sys.stderr)
+            return 2          # transient: the watchdog retries rc 2
+        except CliFailed as e:
+            print(str(e), file=sys.stderr)
+            if not a.smoke and not probe(timeout_s=60):
+                print("post-failure probe dead — wedge-shaped fast init "
+                      "failure; retry at the next healthy window",
+                      file=sys.stderr)
+                return 2      # transient
+            return 1          # deterministic CLI failure: a real bug
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+
+    traj = {(r["rounds"], r["coverage"], r["msgs"]) for r in rows}
+    identical = len(traj) == 1
+    verdict = None
+    if identical and len(rows) >= 2:
+        ctl, cand = rows[0], min(rows[1:], key=lambda r: r["steady_wall_s"])
+        verdict = (f"{cand['swim_diss']}: steady {ctl['steady_wall_s']:.1f}"
+                   f" -> {cand['steady_wall_s']:.1f} s, compile "
+                   f"{ctl['compile_s']:.1f} -> {cand['compile_s']:.1f} s "
+                   f"vs {ctl['swim_diss']}")
+    doc = {
+        "what": ("A/B of ProtocolConfig.swim_diss lowerings on the "
+                 "BASELINE SWIM-1M shape; identical trajectories required "
+                 "(rounds/coverage/msgs) per models/swim.disseminate_max"),
+        "command": ("python -m gossip_tpu run --n %d %s "
+                    "--swim-diss {%s} --compile-cache FRESH_DIR"
+                    % (n, " ".join(BASE_ARGS), "|".join(a.impls))),
+        "rows": rows,
+        "trajectories_identical": identical,
+        "verdict": verdict,
+    }
+    with open(art, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"wrote {art}", file=sys.stderr)
+    if not identical:
+        print("TRAJECTORY MISMATCH — the candidate lowering is not pure; "
+              "do not change the default", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
