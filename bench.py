@@ -35,6 +35,7 @@ Prints exactly one JSON line.
 
 import json
 import os
+import shlex
 import subprocess
 import sys
 import time
@@ -302,6 +303,26 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--print-hermetic-env" in sys.argv:
+        # shell-exportable lines for launching ANY command wedge-immune
+        # (e.g. pytest while the tunnel is down — tests/conftest.py can
+        # only protect test-spawned children, not the pytest parent).
+        # GOSSIP_COMPILE_CACHE is bench's own cold-measurement policy,
+        # not a wedge hazard — exporting it would silently disable the
+        # default-on persistent compile cache for the rest of the
+        # operator's shell, so it is NOT printed.
+        # Only the keys the hermetic env CONTROLS are printed (the env
+        # dict is a full os.environ copy — dumping it would leak the
+        # whole shell), and unconditionally (no skip-if-already-set):
+        # the output must be deterministic so `eval` is idempotent in
+        # any starting shell.
+        henv = _hermetic_cpu_env()
+        for k in ("JAX_PLATFORMS", "PYTHONPATH"):
+            print(f"export {k}={shlex.quote(henv[k])}")
+        for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORM_NAME",
+                  "LIBTPU_INIT_ARGS"):
+            print(f"unset {k}")
+        sys.exit(0)
     if "--body" in sys.argv:
         sys.exit(body())
     sys.exit(main())

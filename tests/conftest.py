@@ -24,5 +24,36 @@ import jax  # noqa: E402
 # (JAX_PLATFORMS=axon): unit tests must be fast and hermetic.  Override with
 # GOSSIP_TPU_TEST_PLATFORM=axon to exercise the suite on real hardware (the
 # tunnel registers its platform under the name "axon", not "tpu").
-jax.config.update("jax_platforms",
-                  os.environ.get("GOSSIP_TPU_TEST_PLATFORM", "cpu"))
+_platform = os.environ.get("GOSSIP_TPU_TEST_PLATFORM", "cpu")
+jax.config.update("jax_platforms", _platform)
+
+# Wedge-immunity for test-spawned subprocesses: the environment's
+# sitecustomize registers the TPU-tunnel PJRT plugin in EVERY interpreter
+# whose env arms it, and a wedged tunnel hangs that registration — so a
+# mid-suite wedge would freeze every test that spawns a child process
+# (the Maelstrom harness runs real node processes, and several tests
+# re-exec the CLI).  For the CPU tier, disarm the plugin in the
+# inherited env via bench.py's _hermetic_cpu_env — imported, not copied,
+# so the hazard list (PALLAS_AXON_POOL_IPS, JAX_PLATFORM_NAME,
+# LIBTPU_INIT_ARGS, sitecustomize-bearing PYTHONPATH entries) lives in
+# exactly one place.  Children neither need nor may touch the tunnel;
+# its GOSSIP_COMPILE_CACHE="" is also right here (cache tests pass
+# explicit --compile-cache flags, which override the env var).  The TPU
+# tier (GOSSIP_TPU_TEST_PLATFORM=axon) keeps the env as-is.
+# NOTE this cannot protect the pytest parent itself — if the tunnel is
+# already wedged, launch pytest under
+# `eval "$(python bench.py --print-hermetic-env)"`.
+if _platform == "cpu":
+    import sys
+    _repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(_repo, "tools"))
+    try:
+        from _bench import hermetic_cpu_env as _hermetic_cpu_env
+    finally:
+        sys.path.pop(0)
+    _henv = _hermetic_cpu_env()
+    for _k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORM_NAME",
+               "LIBTPU_INIT_ARGS"):
+        os.environ.pop(_k, None)
+    for _k in ("PYTHONPATH", "JAX_PLATFORMS", "GOSSIP_COMPILE_CACHE"):
+        os.environ[_k] = _henv[_k]

@@ -58,3 +58,29 @@ def test_line_is_json_serializable_and_flat():
     parsed = json.loads(json.dumps(line))
     assert set(parsed) == {"metric", "value", "unit", "vs_baseline",
                            "backend"}
+
+
+def test_print_hermetic_env_contract():
+    """``--print-hermetic-env`` is the operator's wedge-immunity eval
+    line (a wedged tunnel hangs ANY armed interpreter at jax init, so
+    pytest itself must be launchable disarmed).  Contract: exports the
+    CPU platform + plugin-free PYTHONPATH, unsets every hazard var that
+    arms the sitecustomize plugin, and never exports
+    GOSSIP_COMPILE_CACHE (bench's cold-measurement policy — exporting
+    it would silently disable the default-on persistent compile cache
+    for the rest of the operator's shell)."""
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = "10.0.0.1"   # armed shell
+    p = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"),
+         "--print-hermetic-env"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert p.returncode == 0
+    out = p.stdout
+    assert "export JAX_PLATFORMS=cpu" in out
+    assert "unset PALLAS_AXON_POOL_IPS" in out
+    assert "GOSSIP_COMPILE_CACHE" not in out
+    for line in out.splitlines():
+        assert line.startswith(("export ", "unset ")), line

@@ -1,0 +1,28 @@
+"""Single-source loader for the repo-root ``bench.py`` (which is a
+standalone script, not a package member — the driver contract pins it at
+the repo root, so it cannot simply be imported by name from here).
+
+Every tool that needs bench's hermetic CPU env or budget arithmetic goes
+through this module, so the load mechanism — like the wedge-hazard list
+it fetches — lives in exactly one place.
+"""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def hermetic_cpu_env():
+    """bench.py's CPU env with the tunnel plugin disarmed (the
+    sitecustomize-preloaded TPU tunnel hangs ANY armed jax init while
+    wedged, even under JAX_PLATFORMS=cpu)."""
+    return load_bench()._hermetic_cpu_env()

@@ -70,12 +70,14 @@ def summary_path():
 
 
 def _load_bench():
-    import importlib.util
-    spec = importlib.util.spec_from_file_location(
-        "bench", os.path.join(REPO, "bench.py"))
-    bench_mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench_mod)
-    return bench_mod
+    # single-source loader (tools/_bench.py) — lazy so importing this
+    # module never pays the bench load
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from _bench import load_bench
+    finally:
+        sys.path.pop(0)
+    return load_bench()
 
 
 def bench_budget_s():
@@ -411,6 +413,23 @@ def main(only=None):
 
 
 if __name__ == "__main__":
+    # Hand-rolled args (argparse would fight the --steps comma contract
+    # callers already depend on), so REJECT anything unrecognized: a
+    # typo'd or guessed flag (--help, --dry-run, ...) must print usage,
+    # not silently launch a full hardware-refresh attempt against the
+    # single-client tunnel.
+    _known = {"--smoke", "--mr-body", "--prng-body", "--steps"}
+    _args = sys.argv[1:]
+    _bad = [a for i, a in enumerate(_args)
+            if a not in _known and not (i > 0 and _args[i - 1] == "--steps")]
+    if _bad:
+        print(f"unrecognized args: {_bad}\n"
+              "usage: hw_refresh.py [--smoke] [--steps a,b,...] "
+              "[--mr-body|--prng-body]\n"
+              "NO ARGS runs every pending hardware step (probe the "
+              "tunnel first; see tools/tunnel_watchdog.py)",
+              file=sys.stderr)
+        sys.exit(2)
     if "--smoke" in sys.argv:
         SMOKE = True
         _SUMMARY = load_summary()   # re-key to the smoke summary path

@@ -96,12 +96,12 @@ def cpu_env():
     """bench.py's hermetic CPU env — imported, not copied: it also pops
     the tunnel-arming hazard vars (PALLAS_AXON_POOL_IPS etc.), without
     which a wedged tunnel could burn a cell's whole timeout."""
-    import importlib.util
-    spec = importlib.util.spec_from_file_location(
-        "bench", os.path.join(REPO, "bench.py"))
-    bench_mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench_mod)
-    return bench_mod._hermetic_cpu_env()
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from _bench import hermetic_cpu_env
+    finally:
+        sys.path.pop(0)
+    return hermetic_cpu_env()
 
 
 def run_cell(name, argv, timeout):

@@ -62,6 +62,34 @@ def probe():
     return True, p.stdout.strip()[-200:], wall
 
 
+def run_cmd(cmd, budget_s):
+    """An arbitrary capture command at a healthy window, same contract
+    as :func:`run_refresh`: own process group, whole group killed on
+    budget overrun (a half-killed TPU client wedges the single-client
+    tunnel for everyone after us), output appended to the refresh
+    log."""
+    import signal
+    log_line({"event": "cmd_start", "cmd": cmd, "budget_s": budget_s})
+    with open(REFRESH_LOG, "a") as f:
+        f.write(f"\n=== cmd at {time.strftime('%Y-%m-%dT%H:%M:%S')}: "
+                f"{cmd} ===\n")
+        f.flush()
+        p = subprocess.Popen(cmd, shell=True, stdout=f,
+                             stderr=subprocess.STDOUT, cwd=REPO,
+                             start_new_session=True)
+        try:
+            rc = p.wait(timeout=budget_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            p.wait()
+            rc = "timeout"
+    log_line({"event": "cmd_done", "rc": rc})
+    return rc
+
+
 def run_refresh():
     """hw_refresh (pending steps only) under its worst-case budget.
 
@@ -116,6 +144,13 @@ def main():
     ap.add_argument("--once", action="store_true",
                     help="one probe, no refresh launch (health logging "
                          "only)")
+    ap.add_argument("--cmd", default=None,
+                    help="fire this shell command instead of hw_refresh "
+                         "at the first healthy window (e.g. a one-off "
+                         "A/B capture); exits 0 when it returns 0")
+    ap.add_argument("--cmd-budget-s", type=int, default=1800,
+                    help="kill --cmd's whole process group after this "
+                         "many seconds (default 1800)")
     args = ap.parse_args()
     deadline = time.time() + args.max_hours * 3600
     fast_fails = 0
@@ -127,9 +162,25 @@ def main():
         if args.once:
             return 0 if ok else 1
         if ok:
-            rc = run_refresh()
+            rc = (run_cmd(args.cmd, args.cmd_budget_s) if args.cmd
+                  else run_refresh())
             if rc == 0:
                 return 0
+            if args.cmd and rc != "timeout" and rc != 2:
+                # hw_refresh retries are incremental (only non-green
+                # steps re-run), but an arbitrary --cmd re-runs IN FULL
+                # — and a deterministic nonzero exit (e.g. the A/B's
+                # trajectory-mismatch verdict, rc 1) cannot change on
+                # retry.  Retryable: a budget overrun ("timeout", the
+                # wedge signature) and rc 2 (the capture tools'
+                # convention for "transient: own probe failed, try a
+                # later window" — swim_diss_ab.py).
+                log_line({"event": "giving_up",
+                          "reason": "--cmd failed deterministically "
+                                    "(non-timeout rc); retrying would "
+                                    "burn healthy windows on the same "
+                                    "verdict", "last_rc": rc})
+                return 1
             # partial/failed/timed-out refresh: the tunnel may have
             # re-wedged mid-run — keep probing and retry (bounded;
             # retries are incremental, re-running only non-green steps)
