@@ -294,6 +294,35 @@ def _fused_ineligible_reason(proto: ProtocolConfig, tc: TopologyConfig,
     return None
 
 
+def swim_scenario(proto: ProtocolConfig, n: int,
+                  fault: Optional[FaultConfig]):
+    """Failure scenario for a SWIM run, shared by the streaming and
+    checkpointed drivers: ``(dead_subjects, fail_round,
+    default_scenario)``.  From the FaultConfig (CLI --dead-nodes /
+    --fail-round, RPC fault.dead_nodes); default: node ``1 % S`` fails
+    at round 2 (recorded in run meta so the scenario is discoverable).
+    Validates the subjects against ``n`` and — without rotation —
+    against the fixed subject window."""
+    default_scenario = fault is None or not fault.dead_nodes
+    if default_scenario:
+        dead = (1 % proto.swim_subjects,)
+        fail_round = 2
+    else:
+        dead = fault.dead_nodes
+        fail_round = fault.fail_round
+    bad = [d for d in dead if d >= n]
+    if bad:
+        raise ValueError(f"dead_nodes {bad} out of range for n={n}")
+    if not proto.swim_rotate:
+        outside = [d for d in dead if d >= proto.swim_subjects]
+        if outside:
+            raise ValueError(
+                f"dead_nodes {outside} are outside the fixed subject "
+                f"window 0..{proto.swim_subjects - 1}; enable "
+                "--swim-rotate for full-membership detection")
+    return dead, fail_round, default_scenario
+
+
 def _fused_auto_ok(proto: ProtocolConfig, tc: TopologyConfig,
                    fault: Optional[FaultConfig], want_curve: bool) -> bool:
     """True when a single-device run is eligible for the fused Pallas
@@ -355,26 +384,8 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
         if n_dev > 1:
             from gossip_tpu.parallel.sharded import make_mesh
             mesh = make_mesh(n_dev)
-        # Failure scenario from the FaultConfig (CLI --dead-nodes /
-        # --fail-round, RPC fault.dead_nodes); default: node 1 % S fails
-        # at round 2 (recorded in meta so the scenario is discoverable).
-        default_scenario = fault is None or not fault.dead_nodes
-        if default_scenario:
-            dead = (1 % proto.swim_subjects,)
-            fail_round = 2
-        else:
-            dead = fault.dead_nodes
-            fail_round = fault.fail_round
-        bad = [d for d in dead if d >= tc.n]
-        if bad:
-            raise ValueError(f"dead_nodes {bad} out of range for n={tc.n}")
-        if not proto.swim_rotate:
-            outside = [d for d in dead if d >= proto.swim_subjects]
-            if outside:
-                raise ValueError(
-                    f"dead_nodes {outside} are outside the fixed subject "
-                    f"window 0..{proto.swim_subjects - 1}; enable "
-                    "--swim-rotate for full-membership detection")
+        dead, fail_round, default_scenario = swim_scenario(proto, tc.n,
+                                                          fault)
         swim_topo = None if tc.family == "complete" else topo
         meta = {"clock": "rounds", "metric": "detection_fraction",
                 "dead_subjects": list(dead), "fail_round": fail_round,
@@ -436,10 +447,14 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
         t0 = time.perf_counter()
         if want_curve:
             if n_dev > 1:
-                raise ValueError("mode='rumor' curve capture is "
-                                 "single-device; drop --curve or --devices")
-            covs, hots, msgs, final = simulate_curve_rumor(proto, topo, run,
-                                                           fault)
+                from gossip_tpu.parallel.sharded import make_mesh
+                from gossip_tpu.parallel.sharded_rumor import (
+                    simulate_curve_rumor_sharded)
+                covs, hots, msgs, final = simulate_curve_rumor_sharded(
+                    proto, topo, run, make_mesh(n_dev), fault)
+            else:
+                covs, hots, msgs, final = simulate_curve_rumor(
+                    proto, topo, run, fault)
             wall = time.perf_counter() - t0
             _, cov, msgs_f, curve = _curve_summary(
                 covs, msgs, run.target_coverage)

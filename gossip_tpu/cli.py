@@ -154,8 +154,10 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                    help="capture a jax.profiler trace of the run into "
                         "LOGDIR (TensorBoard profile plugin / Perfetto)")
     p.add_argument("--checkpoint", default=None, metavar="PATH",
-                   help="checkpointed driver (single-device SI, sharded "
-                        "packed via --devices, or --engine fused planes): "
+                   help="checkpointed driver (SI single-device, sharded "
+                        "packed via --devices, --engine fused planes, "
+                        "swim, or rumor — the last two single-device or "
+                        "sharded): "
                         "run max_rounds rounds saving an atomic npz every "
                         "--checkpoint-every rounds; with --resume, "
                         "continue a previous run from PATH (bitwise "
@@ -376,27 +378,35 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
     saved run to max_rounds TOTAL rounds, bitwise identical to an
     uninterrupted run (tests/test_utils.py, test_checkpoint_sharded.py).
 
-    Three engines (round-4: the flagship sharded/fused runs are the only
-    ones long enough to need persistence — the reference loses all state
-    on process death, main.go:22-26):
+    Five engines (round-4; the reference loses all state on process
+    death, main.go:22-26):
 
     * single device, engine auto/xla  — the SI XLA kernels;
     * --devices > 1, dense exchange   — the node-sharded packed engine
       (pull/antientropy);
     * --engine fused                  — the rumor-plane fused engine
-      (any --devices; the checkpoint carries the plane stack).
+      (any --devices; the checkpoint carries the plane stack);
+    * --mode swim                     — failure detection, single-device
+      or node-sharded (runtime/simulator.checkpointed_swim; the
+      rotating window is in-trace, so resume is bitwise);
+    * --mode rumor                    — SIR rumor mongering, single-
+      device or node-sharded (models/rumor.checkpointed_rumor; fixed
+      segments, no extinction early-exit — the extinct state is
+      absorbing).
 
     --curve/--save-curve compose with all of them: segments run as a
-    compiled scan recording per-round coverage, and the curve-so-far is
+    compiled scan recording per-round coverage (SWIM: detection
+    fraction; rumor: coverage + hot-fraction channels, extinction being
+    recoverable only from the hot channel), and the curve-so-far is
     persisted in the checkpoint so --resume continues it seamlessly."""
     import os
 
     n_dev = 1 if mesh is None else mesh.n_devices
     exchange = "dense" if mesh is None else mesh.exchange
     want_curve = a.curve or bool(a.save_curve)
-    if a.backend != "jax-tpu" or a.mode in ("swim", "rumor"):
-        print("error: --checkpoint drives the jax-tpu SI engines "
-              "(non-swim/rumor mode)", file=sys.stderr)
+    if a.backend != "jax-tpu":
+        print("error: --checkpoint drives the jax-tpu engines only",
+              file=sys.stderr)
         return 2
     fused = run.engine == "fused"
     if fused:
@@ -406,7 +416,9 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
         if reason is not None:
             print(f"error: {reason}", file=sys.stderr)
             return 2
-    elif n_dev > 1:
+    elif n_dev > 1 and a.mode not in ("swim", "rumor"):
+        # swim/rumor shard through their own engines; this check guards
+        # the packed SI exchange only
         from gossip_tpu.parallel.sharded_packed import (
             sharded_checkpoint_ineligible_reason)
         reason = sharded_checkpoint_ineligible_reason(proto, exchange)
@@ -470,12 +482,60 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
                   "--curve or --save-curve to continue it (refusing to "
                   "silently drop it)", file=sys.stderr)
             return 2
-        curve_prefix = tuple(saved_curve or ())
+        # rumor checkpoints carry named channels (dict of lists); the
+        # scalar engines carry one flat list
+        curve_prefix = (saved_curve if isinstance(saved_curve, dict)
+                        else tuple(saved_curve or ()))
         resume_state = load_state(a.checkpoint)
         resumed = True
 
     extra = {"config": fingerprint}
-    if fused:
+    out_extra = {}
+    if a.mode == "swim":
+        from gossip_tpu.backend import swim_scenario
+        from gossip_tpu.runtime.simulator import checkpointed_swim
+        dead, fail_round, default_scenario = swim_scenario(proto, tc.n,
+                                                           fault)
+        swim_topo = None if tc.family == "complete" else G.build(tc)
+        mesh_obj = None
+        if n_dev > 1:
+            from gossip_tpu.parallel.sharded import make_mesh
+            mesh_obj = make_mesh(n_dev)
+        final, cov, curve = checkpointed_swim(
+            proto, tc.n, run, a.checkpoint, every=a.checkpoint_every,
+            dead_nodes=dead, fail_round=fail_round, fault=fault,
+            topo=swim_topo, mesh=mesh_obj, resume_state=resume_state,
+            want_curve=want_curve, curve_prefix=curve_prefix,
+            extra_meta=extra)
+        out_extra["metric"] = "detection_fraction"
+        out_extra["default_scenario"] = default_scenario
+        if proto.swim_rotate and curve:
+            # rotation: the window can leave the dead node's epoch, so
+            # the headline is the best in-window detection (exact only
+            # with curve capture; without it only the final is known)
+            out_extra["peak_detection"] = float(max(curve))
+        engine_label = "swim-sharded" if n_dev > 1 else "swim-xla"
+    elif a.mode == "rumor":
+        import numpy as _np
+
+        from gossip_tpu.models.rumor import checkpointed_rumor
+        mesh_obj = None
+        if n_dev > 1:
+            from gossip_tpu.parallel.sharded import make_mesh
+            mesh_obj = make_mesh(n_dev)
+        final, cov, residue, curve = checkpointed_rumor(
+            proto, G.build(tc), run, a.checkpoint,
+            every=a.checkpoint_every, fault=fault, mesh=mesh_obj,
+            resume_state=resume_state, want_curve=want_curve,
+            curve_prefix=curve_prefix, extra_meta=extra)
+        out_extra["residue"] = residue
+        out_extra["extinct"] = not bool(_np.any(_np.asarray(final.hot)))
+        if curve:
+            dead_at = _np.nonzero(_np.asarray(curve["hot"]) == 0.0)[0]
+            out_extra["extinction_round"] = (int(dead_at[0]) + 1
+                                             if len(dead_at) else -1)
+        engine_label = "rumor-sharded" if n_dev > 1 else "rumor-xla"
+    elif fused:
         from gossip_tpu.parallel.sharded_fused import (
             checkpointed_fused_planes, make_plane_mesh)
         final, cov, curve = checkpointed_fused_planes(
@@ -525,13 +585,25 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
            "checkpoint_every": a.checkpoint_every, "resumed": resumed,
            "engine": engine_label, "devices": n_dev,
            "compile_cache": _cache_stamp(a)}
+    out.update(out_extra)
     if a.profile:
         out["profile_logdir"] = a.profile
+    # rumor curves carry named channels; the headline curve is coverage
+    # (the hot channel rides alongside under its own key — in the
+    # save-curve artifact's meta line too, because extinction is only
+    # recoverable from it and a silently dropped channel violates the
+    # curve-history policy above)
+    curve_list = curve["coverage"] if isinstance(curve, dict) else curve
     if a.save_curve:
         from gossip_tpu.utils.metrics import dump_curve_jsonl
-        dump_curve_jsonl(a.save_curve, list(curve), meta=dict(out))
+        save_meta = dict(out)
+        if isinstance(curve, dict):
+            save_meta["hot_curve"] = list(curve["hot"])
+        dump_curve_jsonl(a.save_curve, list(curve_list), meta=save_meta)
     if a.curve:
-        out["curve"] = list(curve)
+        out["curve"] = list(curve_list)
+        if isinstance(curve, dict):
+            out["hot_curve"] = list(curve["hot"])
     print(json.dumps(out))
     return 0
 

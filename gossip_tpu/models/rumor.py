@@ -180,6 +180,75 @@ def simulate_until_rumor(proto: ProtocolConfig, topo: Topology,
     return (int(final.round), cov, 1.0 - cov, float(final.msgs), final)
 
 
+def checkpointed_rumor(proto: ProtocolConfig, topo: Topology,
+                       run: RunConfig, path: str, every: int = 50,
+                       fault: Optional[FaultConfig] = None, mesh=None,
+                       resume_state=None, want_curve: bool = False,
+                       curve_prefix=(), extra_meta=None):
+    """Fixed-budget rumor-mongering run in compiled segments with atomic
+    npz checkpoints (utils/checkpoint.run_with_checkpoints) — the SIR
+    twin of the SI/SWIM ``--checkpoint`` engines.  Unlike
+    :func:`simulate_until_rumor` this does NOT early-exit at extinction
+    (segments are fixed-length); the extinct state is absorbing, so the
+    trailing rounds are no-ops and the trajectory stays bitwise equal to
+    the segmented run it resumes.
+
+    ``want_curve`` records TWO named channels per round — ``coverage``
+    (min-over-rumors informed fraction) and ``hot`` (infective
+    fraction) — because the extinction round is only recoverable from
+    the hot channel (a coverage plateau is NOT extinction: feedback
+    pushes keep flowing between informed pairs).  With ``mesh`` the
+    node-sharded twin runs.  Returns ``(final_state, coverage,
+    residue, curve-dict-or-None)``.
+    """
+    from gossip_tpu.utils.checkpoint import run_with_checkpoints
+    if mesh is None:
+        step, tables = make_rumor_round(proto, topo, fault, run.origin,
+                                        tabled=True)
+        state = (resume_state if resume_state is not None
+                 else init_rumor_state(run, proto, topo.n))
+
+        def alive_now():
+            return alive_mask(fault, topo.n, run.origin)
+    else:
+        from gossip_tpu.parallel.sharded import pad_to_mesh, sharded_alive
+        from gossip_tpu.parallel.sharded_rumor import (
+            init_sharded_rumor_state, make_sharded_rumor_round,
+            restore_sharded_rumor_state)
+        step, tables = make_sharded_rumor_round(proto, topo, mesh, fault,
+                                                run.origin, tabled=True)
+        state = (restore_sharded_rumor_state(resume_state, mesh)
+                 if resume_state is not None
+                 else init_sharded_rumor_state(run, proto, topo, mesh))
+        n_rows = pad_to_mesh(topo.n, mesh, "nodes")
+
+        def alive_now():
+            # padded alive mask: padding rows must not deflate coverage
+            return sharded_alive(fault, topo.n, n_rows, run.origin)
+
+    curve_fn = None
+    if want_curve:
+        def curve_fn(s):
+            alive = alive_now()
+            hot_any = jnp.any(s.hot, axis=1).astype(jnp.float32)
+            if alive is None:
+                hot_frac = jnp.mean(hot_any)
+            else:
+                w = alive.astype(jnp.float32)
+                hot_frac = jnp.sum(hot_any * w) / jnp.sum(w)
+            return {"coverage": rumor_coverage(s.seen, alive),
+                    "hot": hot_frac}
+
+    remaining = max(0, run.max_rounds - int(state.round))
+    out = run_with_checkpoints(step, state, remaining, path, every=every,
+                               step_args=tables, curve_fn=curve_fn,
+                               curve_prefix=curve_prefix,
+                               extra_meta=extra_meta)
+    final, curve = out if want_curve else (out, None)
+    cov = float(rumor_coverage(final.seen, alive_now()))
+    return final, cov, 1.0 - cov, curve
+
+
 def simulate_curve_rumor(proto: ProtocolConfig, topo: Topology,
                          run: RunConfig,
                          fault: Optional[FaultConfig] = None):

@@ -133,6 +133,50 @@ def init_sharded_rumor_state(run: RunConfig, proto: ProtocolConfig,
                       base_key=st.base_key, msgs=st.msgs)
 
 
+def simulate_curve_rumor_sharded(proto: ProtocolConfig, topo: Topology,
+                                 run: RunConfig, mesh: Mesh,
+                                 fault: Optional[FaultConfig] = None,
+                                 axis_name: str = "nodes"):
+    """Fixed-length scan with per-round (coverage, hot_fraction, msgs)
+    curves, state resident sharded — the multi-device twin of
+    models/rumor.simulate_curve_rumor (same returns; curves weighted by
+    the padded alive mask so padding rows deflate nothing).  Closes the
+    round-3 carve-out where rumor curve capture was single-device
+    only."""
+    step, tables = make_sharded_rumor_round(proto, topo, mesh, fault,
+                                            run.origin, axis_name,
+                                            tabled=True)
+    init = init_sharded_rumor_state(run, proto, topo, mesh, axis_name)
+    n_pad = pad_to_mesh(topo.n, mesh, axis_name)
+
+    @jax.jit
+    def scan(state, *tbl):
+        alive = sharded_alive(fault, topo.n, n_pad, run.origin)
+        w = alive.astype(jnp.float32)
+
+        def body(s, _):
+            s = step(s, *tbl)
+            hot_any = jnp.any(s.hot, axis=1).astype(jnp.float32)
+            hot_frac = jnp.sum(hot_any * w) / jnp.sum(w)
+            return s, (rumor_coverage(s.seen, alive), hot_frac, s.msgs)
+        return jax.lax.scan(body, state, None, length=run.max_rounds)
+
+    final, (covs, hots, msgs) = scan(init, *tables)
+    return covs, hots, msgs, final
+
+
+def restore_sharded_rumor_state(state: RumorState, mesh: Mesh,
+                                axis_name: str = "nodes") -> RumorState:
+    """Re-place a host-loaded checkpoint (utils/checkpoint.load_state
+    gathers to host) back onto the mesh; rows are already padded (the
+    config fingerprint pins the mesh shape)."""
+    sharding = NamedSharding(mesh, P(axis_name, None))
+    put = lambda x: jax.device_put(jnp.asarray(x), sharding)  # noqa: E731
+    return RumorState(seen=put(state.seen), hot=put(state.hot),
+                      cnt=put(state.cnt), round=state.round,
+                      base_key=state.base_key, msgs=state.msgs)
+
+
 def simulate_until_rumor_sharded(proto: ProtocolConfig, topo: Topology,
                                  run: RunConfig, mesh: Mesh,
                                  fault: Optional[FaultConfig] = None,

@@ -162,3 +162,17 @@ def init_sharded_swim_state(n: int, proto: ProtocolConfig, mesh: Mesh,
     return SwimState(wire=jax.device_put(st.wire, sharding),
                      timer=jax.device_put(st.timer, sharding),
                      round=st.round, base_key=st.base_key, msgs=st.msgs)
+
+
+def restore_sharded_swim_state(state: SwimState, mesh: Mesh,
+                               axis_name: str = "nodes") -> SwimState:
+    """Re-place a host-loaded checkpoint (utils/checkpoint.load_state
+    gathers to host) back onto the mesh.  The checkpoint already carries
+    the padded rows — the config fingerprint pins the mesh shape, so the
+    row count matches by construction."""
+    sharding = NamedSharding(mesh, P(axis_name, None))
+    return SwimState(wire=jax.device_put(jnp.asarray(state.wire), sharding),
+                     timer=jax.device_put(jnp.asarray(state.timer),
+                                          sharding),
+                     round=state.round, base_key=state.base_key,
+                     msgs=state.msgs)

@@ -237,6 +237,78 @@ def simulate_swim_until(proto: ProtocolConfig, n: int, max_rounds: int,
     return int(final.round), float(det), float(peak), final
 
 
+def checkpointed_swim(proto: ProtocolConfig, n: int, run: RunConfig,
+                      path: str, every: int = 50, dead_nodes=(),
+                      fail_round: int = 0,
+                      fault: Optional[FaultConfig] = None,
+                      topo: Optional[Topology] = None, mesh=None,
+                      resume_state=None, want_curve: bool = False,
+                      curve_prefix=(), extra_meta=None):
+    """Fixed-budget SWIM run in compiled segments with atomic npz
+    checkpoints — the failure-detection twin of the SI ``--checkpoint``
+    engines (utils/checkpoint.run_with_checkpoints; the reference loses
+    all state on process death, main.go:22-26).  The rotating subject
+    window needs no host-side driver — ``subject_window`` is computed
+    in-trace from ``state.round`` — so the generic segment runner drives
+    it unchanged and resume is bitwise (tests/test_checkpoint_sharded).
+
+    ``want_curve`` records the per-round detection fraction; the final
+    detection is computed from the final state either way.  With
+    ``mesh`` the node-sharded twin runs (resume re-places the padded
+    rows via restore_sharded_swim_state).  Returns
+    ``(final_state, detection, curve-or-None)``.
+    """
+    from gossip_tpu.models import swim as SW
+    from gossip_tpu.utils.checkpoint import run_with_checkpoints
+    dead = tuple(dead_nodes)
+    rotate = proto.swim_rotate
+    epoch_rounds = SW.resolve_epoch_rounds(proto, n)
+    if mesh is None:
+        step, tables = SW.make_swim_round(proto, n, dead, fail_round,
+                                          fault, topo, tabled=True,
+                                          max_rounds=run.max_rounds)
+        state = (resume_state if resume_state is not None
+                 else SW.init_swim_state(n, proto.swim_subjects, run.seed))
+    else:
+        from gossip_tpu.parallel.sharded_swim import (
+            init_sharded_swim_state, make_sharded_swim_round,
+            restore_sharded_swim_state)
+        step, tables = make_sharded_swim_round(proto, n, mesh, dead,
+                                               fail_round, fault, topo,
+                                               tabled=True,
+                                               max_rounds=run.max_rounds)
+        state = (restore_sharded_swim_state(resume_state, mesh)
+                 if resume_state is not None
+                 else init_sharded_swim_state(n, proto, mesh, run.seed))
+
+    def detection(s):
+        # same in-trace construction as simulate_swim_curve's body:
+        # detection of the round just executed (window at s.round - 1),
+        # observers sliced to the real rows
+        alive_obs = SW.base_alive(n, dead, fault)
+        window = SW.subject_window(s.round - 1, proto.swim_subjects, n,
+                                   rotate, epoch_rounds)
+        return SW.detection_fraction(
+            SW.SwimState(s.wire[:n], s.timer[:n], s.round,
+                         s.base_key, s.msgs), dead,
+            alive_obs, subj_gids=window) if dead else jnp.float32(0.0)
+
+    curve_fn = detection if want_curve else None
+    remaining = max(0, run.max_rounds - int(state.round))
+    out = run_with_checkpoints(step, state, remaining, path, every=every,
+                               step_args=tables, curve_fn=curve_fn,
+                               curve_prefix=curve_prefix,
+                               extra_meta=extra_meta)
+    final, curve = out if want_curve else (out, None)
+    if curve:
+        det = float(curve[-1])    # the scan already computed it
+    elif int(final.round):
+        det = float(jax.jit(detection)(final))
+    else:
+        det = 0.0
+    return final, det, curve
+
+
 def compiled_until(proto: ProtocolConfig, topo: Topology, run: RunConfig,
                    fault: Optional[FaultConfig] = None):
     """Lowered/compiled while-loop runner + fresh init state, for benchmarks

@@ -169,17 +169,23 @@ def run_with_checkpoints(step, state: State, rounds: int, path: str,
     them, so 1M+-row tables are not inlined into the compile request
     (models/swim.py doc).
 
-    ``curve_fn`` (state -> float scalar) switches the segments to a
-    compiled ``lax.scan`` that records the value after every round: long
-    runs can persist AND capture their convergence curve (the reference
-    could do neither — SURVEY.md §5).  The curve-so-far rides in the
-    checkpoint metadata under ``extra['curve']`` so a resumed run
-    continues it seamlessly (pass the saved list as ``curve_prefix``).
-    Returns ``state`` without ``curve_fn``, ``(state, curve)`` with it.
+    ``curve_fn`` (state -> float scalar, or state -> dict of named float
+    scalars) switches the segments to a compiled ``lax.scan`` that
+    records the value(s) after every round: long runs can persist AND
+    capture their convergence curve (the reference could do neither —
+    SURVEY.md §5).  A dict-valued curve_fn records one list per channel
+    (e.g. rumor mongering's coverage + hot-fraction pair, whose
+    extinction round is only recoverable from the hot channel).  The
+    curve-so-far rides in the checkpoint metadata under
+    ``extra['curve']`` — a list for the scalar form, a dict of lists for
+    the dict form — so a resumed run continues it seamlessly (pass the
+    saved value as ``curve_prefix``).  Returns ``state`` without
+    ``curve_fn``, ``(state, curve)`` with it.
     """
     if every < 1:
         raise ValueError(f"every must be >= 1, got {every}")
-    curve = list(curve_prefix)
+    curve = ({k: list(v) for k, v in curve_prefix.items()}
+             if isinstance(curve_prefix, dict) else list(curve_prefix))
 
     def meta_now():
         if curve_fn is None:
@@ -199,7 +205,24 @@ def run_with_checkpoints(step, state: State, rounds: int, path: str,
             state = run_segment(state, todo, *step_args)
         else:
             state, seg = run_segment(state, todo, *step_args)
-            curve.extend(float(x) for x in np.asarray(seg))
+            if isinstance(seg, dict):
+                if not isinstance(curve, dict):
+                    if curve:      # scalar prefix + dict curve_fn
+                        raise TypeError(
+                            "curve_prefix is a flat list but curve_fn "
+                            "records named channels; pass the saved "
+                            "dict-of-lists instead")
+                    curve = {k: [] for k in seg}
+                for k, v in seg.items():
+                    curve[k].extend(float(x) for x in np.asarray(v))
+            else:
+                if isinstance(curve, dict):
+                    raise TypeError(
+                        "curve_prefix carries named channels but "
+                        "curve_fn records a flat scalar; pass the "
+                        "matching channel list (or the dict-recording "
+                        "curve_fn the checkpoint was written with)")
+                curve.extend(float(x) for x in np.asarray(seg))
         done += todo
         jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
         save_state(path, state, meta_now())

@@ -472,9 +472,16 @@ def test_cli_checkpoint_resume_and_profile(tmp_path):
     solo = json.loads(p.stdout)
     assert (resumed["coverage"], resumed["msgs"]) == (solo["coverage"],
                                                       solo["msgs"])
-    # guard: swim/rumor requests are rejected loudly
-    p = _cli("run", "--mode", "swim", "--n", "256", "--checkpoint", ck)
-    assert p.returncode == 2 and "SI engines" in p.stderr
+    # round-4: swim checkpointing is a supported engine now (the full
+    # resume contract lives in test_checkpoint_sharded.py); the guard
+    # that remains is the backend gate
+    p = _cli("run", "--mode", "swim", "--n", "256", "--max-rounds", "6",
+             "--checkpoint", str(tmp_path / "sw.npz"))
+    assert p.returncode == 0, p.stderr
+    assert json.loads(p.stdout)["engine"] == "swim-xla"
+    p = _cli("run", "--backend", "go-native", "--n", "64",
+             "--checkpoint", str(tmp_path / "gn.npz"))
+    assert p.returncode == 2 and "jax-tpu engines" in p.stderr
     # resume with different flags refuses (config fingerprint mismatch)
     p = _cli("run", "--mode", "pushpull", "--n", "512", "--max-rounds",
              "30", "--seed", "9", "--checkpoint", ck, "--resume")

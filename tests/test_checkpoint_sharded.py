@@ -255,3 +255,185 @@ def test_cli_save_curve_with_checkpoint(tmp_path):
     assert rows[0]["meta"]["engine"] == "sharded-packed"
     points = [r for r in rows if "coverage" in r]
     assert len(points) == 4 and points[-1]["round"] == 4
+
+
+# ---------------------------------------------------------------------------
+# SWIM and rumor checkpointing (round 4: the two modes the --checkpoint
+# driver used to refuse; engines runtime/simulator.checkpointed_swim and
+# models/rumor.checkpointed_rumor)
+
+def _swim_cfg():
+    proto = ProtocolConfig(mode="swim", fanout=2, swim_proxies=2,
+                           swim_subjects=4, swim_suspect_rounds=4)
+    run = RunConfig(seed=9, max_rounds=12)
+    return proto, run, (1,), 2        # dead subjects, fail_round
+
+
+def test_checkpointed_swim_matches_streaming_and_resumes(tmp_path):
+    from gossip_tpu.runtime.simulator import (checkpointed_swim,
+                                              simulate_swim_curve)
+    proto, run, dead, fr = _swim_cfg()
+    n = 96
+    # streaming reference (one lax.scan, no checkpointing)
+    fracs, ref = simulate_swim_curve(proto, n, run.max_rounds,
+                                     dead_nodes=dead, fail_round=fr,
+                                     seed=run.seed)
+    full, det_full, curve_full = checkpointed_swim(
+        proto, n, run, str(tmp_path / "sfull.npz"), every=5,
+        dead_nodes=dead, fail_round=fr, want_curve=True)
+    np.testing.assert_array_equal(np.asarray(full.wire),
+                                  np.asarray(ref.wire))
+    np.testing.assert_array_equal(np.asarray(full.timer),
+                                  np.asarray(ref.timer))
+    np.testing.assert_allclose(curve_full, np.asarray(fracs), rtol=0,
+                               atol=0)
+    assert det_full == float(fracs[-1])
+    # interrupted at 7, resumed to 12 in a "new process" (fresh load)
+    half_run = RunConfig(seed=9, max_rounds=7)
+    checkpointed_swim(proto, n, half_run, str(tmp_path / "shalf.npz"),
+                      every=5, dead_nodes=dead, fail_round=fr,
+                      want_curve=True)
+    meta = load_meta(str(tmp_path / "shalf.npz"))
+    loaded = load_state(str(tmp_path / "shalf.npz"))
+    assert int(loaded.round) == 7
+    res, det_res, curve_res = checkpointed_swim(
+        proto, n, run, str(tmp_path / "shalf.npz"), every=5,
+        dead_nodes=dead, fail_round=fr, resume_state=loaded,
+        want_curve=True, curve_prefix=meta["extra"]["curve"])
+    np.testing.assert_array_equal(np.asarray(res.wire),
+                                  np.asarray(full.wire))
+    assert curve_res == curve_full
+    assert float(res.msgs) == float(full.msgs)
+
+
+def test_checkpointed_swim_sharded_bitwise_matches_single(tmp_path):
+    from gossip_tpu.runtime.simulator import checkpointed_swim
+    proto, run, dead, fr = _swim_cfg()
+    n, mesh = 96, make_mesh(8)
+    single, det_s, curve_s = checkpointed_swim(
+        proto, n, run, str(tmp_path / "s1.npz"), every=5,
+        dead_nodes=dead, fail_round=fr, want_curve=True)
+    full, det_m, curve_m = checkpointed_swim(
+        proto, n, run, str(tmp_path / "s8.npz"), every=5,
+        dead_nodes=dead, fail_round=fr, mesh=mesh, want_curve=True)
+    np.testing.assert_array_equal(np.asarray(full.wire)[:n],
+                                  np.asarray(single.wire))
+    assert curve_m == curve_s and det_m == det_s
+    # resume the sharded run (host-loaded rows re-placed on the mesh)
+    half_run = RunConfig(seed=9, max_rounds=7)
+    checkpointed_swim(proto, n, half_run, str(tmp_path / "s8h.npz"),
+                      every=5, dead_nodes=dead, fail_round=fr, mesh=mesh,
+                      want_curve=True)
+    meta = load_meta(str(tmp_path / "s8h.npz"))
+    loaded = load_state(str(tmp_path / "s8h.npz"))
+    res, _, curve_res = checkpointed_swim(
+        proto, n, run, str(tmp_path / "s8h.npz"), every=5,
+        dead_nodes=dead, fail_round=fr, mesh=mesh, resume_state=loaded,
+        want_curve=True, curve_prefix=meta["extra"]["curve"])
+    np.testing.assert_array_equal(np.asarray(res.wire),
+                                  np.asarray(full.wire))
+    assert curve_res == curve_m
+
+
+def test_checkpointed_rumor_matches_streaming_and_resumes(tmp_path):
+    from gossip_tpu.models.rumor import (checkpointed_rumor,
+                                         simulate_curve_rumor)
+    proto = ProtocolConfig(mode="rumor", fanout=1, rumors=3, rumor_k=2)
+    topo = G.erdos_renyi(200, 0.04, seed=7)
+    run = RunConfig(seed=13, max_rounds=18)
+    covs, hots, _, ref = simulate_curve_rumor(proto, topo, run)
+    full, cov_full, residue, curve = checkpointed_rumor(
+        proto, topo, run, str(tmp_path / "rfull.npz"), every=7,
+        want_curve=True)
+    np.testing.assert_array_equal(np.asarray(full.seen),
+                                  np.asarray(ref.seen))
+    np.testing.assert_array_equal(np.asarray(full.hot),
+                                  np.asarray(ref.hot))
+    np.testing.assert_array_equal(np.asarray(full.cnt),
+                                  np.asarray(ref.cnt))
+    np.testing.assert_allclose(curve["coverage"], np.asarray(covs),
+                               rtol=0, atol=0)
+    np.testing.assert_allclose(curve["hot"], np.asarray(hots), rtol=0,
+                               atol=0)
+    assert residue == 1.0 - cov_full
+    # resume: named channels round-trip through the checkpoint metadata
+    half = RunConfig(seed=13, max_rounds=9)
+    checkpointed_rumor(proto, topo, half, str(tmp_path / "rhalf.npz"),
+                       every=7, want_curve=True)
+    meta = load_meta(str(tmp_path / "rhalf.npz"))
+    saved = meta["extra"]["curve"]
+    assert set(saved) == {"coverage", "hot"} and len(saved["hot"]) == 9
+    loaded = load_state(str(tmp_path / "rhalf.npz"))
+    res, cov_res, _, curve_res = checkpointed_rumor(
+        proto, topo, run, str(tmp_path / "rhalf.npz"), every=7,
+        resume_state=loaded, want_curve=True, curve_prefix=saved)
+    np.testing.assert_array_equal(np.asarray(res.seen),
+                                  np.asarray(full.seen))
+    assert curve_res == curve and cov_res == cov_full
+
+
+def test_checkpointed_rumor_sharded_matches_single(tmp_path):
+    from gossip_tpu.models.rumor import checkpointed_rumor
+    proto = ProtocolConfig(mode="rumor", fanout=1, rumors=2, rumor_k=2)
+    topo = G.erdos_renyi(160, 0.05, seed=8)
+    run = RunConfig(seed=4, max_rounds=14)
+    _, cov_s, _, curve_s = checkpointed_rumor(
+        proto, topo, run, str(tmp_path / "r1.npz"), every=5,
+        want_curve=True)
+    final, cov_m, _, curve_m = checkpointed_rumor(
+        proto, topo, run, str(tmp_path / "r8.npz"), every=5,
+        mesh=make_mesh(8), want_curve=True)
+    # metric curves/final differ in reduction ORDER (weighted sum over
+    # the padded rows vs plain mean), so the last float32 bit may
+    # differ even though the state trajectory is bitwise equal
+    assert set(curve_m) == set(curve_s)
+    for ch in curve_s:
+        np.testing.assert_allclose(curve_m[ch], curve_s[ch], rtol=0,
+                                   atol=1e-6)
+    assert cov_m == pytest.approx(cov_s, abs=1e-6)
+    assert final.seen.shape[0] >= 160     # padded rows in the checkpoint
+
+
+def test_cli_swim_checkpoint_resume(tmp_path):
+    ck = str(tmp_path / "sw.npz")
+    args = ("run", "--n", "300", "--mode", "swim", "--fanout", "2",
+            "--swim-subjects", "4", "--swim-proxies", "2",
+            "--swim-suspect-rounds", "4", "--checkpoint", ck,
+            "--checkpoint-every", "5", "--curve")
+    r1 = _cli(*args, "--max-rounds", "7")
+    assert r1.returncode == 0, r1.stderr
+    r2 = _cli(*args, "--max-rounds", "12", "--resume")
+    assert r2.returncode == 0, r2.stderr
+    out = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert out["resumed"] and out["rounds"] == 12
+    assert out["engine"] == "swim-xla"
+    assert out["metric"] == "detection_fraction"
+    # uninterrupted reference run, same flags
+    ref = _cli("run", "--n", "300", "--mode", "swim", "--fanout", "2",
+               "--swim-subjects", "4", "--swim-proxies", "2",
+               "--swim-suspect-rounds", "4", "--checkpoint",
+               str(tmp_path / "ref.npz"), "--checkpoint-every", "5",
+               "--curve", "--max-rounds", "12")
+    ref_out = json.loads(ref.stdout.strip().splitlines()[-1])
+    assert out["curve"] == ref_out["curve"]
+    assert out["msgs"] == ref_out["msgs"]
+
+
+def test_cli_rumor_checkpoint_carries_extinction(tmp_path):
+    ck = str(tmp_path / "ru.npz")
+    args = ("run", "--n", "400", "--mode", "rumor", "--family",
+            "erdos_renyi", "--p", "0.02", "--fanout", "1", "--rumors",
+            "3", "--checkpoint", ck, "--checkpoint-every", "7",
+            "--curve")
+    r1 = _cli(*args, "--max-rounds", "9")
+    assert r1.returncode == 0, r1.stderr
+    r2 = _cli(*args, "--max-rounds", "30", "--resume")
+    assert r2.returncode == 0, r2.stderr
+    out = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert out["engine"] == "rumor-xla" and out["resumed"]
+    assert len(out["curve"]) == 30 and len(out["hot_curve"]) == 30
+    assert out["residue"] == pytest.approx(1.0 - out["coverage"])
+    if out["extinct"]:
+        er = out["extinction_round"]
+        assert er > 0 and out["hot_curve"][er - 1] == 0.0
+        assert all(h > 0.0 for h in out["hot_curve"][:er - 1])

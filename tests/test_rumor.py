@@ -220,3 +220,38 @@ def test_rumor_seed_ensemble_matches_solo_trajectories():
     # extinction round of row 1 agrees with the solo hot curve
     idx = np.nonzero(np.asarray(solo_hots) == 0.0)[0]
     assert ens.extinction_rounds[1] == idx[0] + 1
+
+
+def test_sharded_rumor_curve_matches_single():
+    """Round-4: sharded rumor CURVE capture (the last rumor carve-out).
+    Both channels — coverage and hot fraction — match the single-device
+    scan point for point on a padded mesh, and the backend routes
+    want_curve + devices>1 to it instead of refusing."""
+    from gossip_tpu.models.rumor import simulate_curve_rumor
+    from gossip_tpu.parallel.sharded import make_mesh
+    from gossip_tpu.parallel.sharded_rumor import (
+        simulate_curve_rumor_sharded)
+
+    n = 300                        # not divisible by 8: padding exercised
+    proto = ProtocolConfig(mode="rumor", fanout=1, rumor_k=2, rumors=2)
+    topo = G.erdos_renyi(n, 0.03, seed=5)
+    run = RunConfig(seed=7, max_rounds=20)
+    covs1, hots1, msgs1, fin1 = simulate_curve_rumor(proto, topo, run)
+    covs8, hots8, msgs8, fin8 = simulate_curve_rumor_sharded(
+        proto, topo, run, make_mesh(8))
+    np.testing.assert_allclose(np.asarray(covs8), np.asarray(covs1),
+                               rtol=0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(hots8), np.asarray(hots1),
+                               rtol=0, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(msgs8), np.asarray(msgs1))
+    np.testing.assert_array_equal(np.asarray(fin8.seen)[:n],
+                                  np.asarray(fin1.seen))
+
+    from gossip_tpu.backend import run_jax
+    from gossip_tpu.config import MeshConfig, TopologyConfig
+    rep = run_jax(proto, TopologyConfig(family="erdos_renyi", n=n,
+                                        p=0.03, seed=5),
+                  RunConfig(seed=7, max_rounds=20), None,
+                  MeshConfig(n_devices=8), want_curve=True)
+    np.testing.assert_allclose(rep.curve, np.asarray(covs1), rtol=0,
+                               atol=1e-6)

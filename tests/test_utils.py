@@ -12,8 +12,8 @@ from gossip_tpu.models.si import make_si_round
 from gossip_tpu.models.state import init_state
 from gossip_tpu.models.swim import init_swim_state, make_swim_round
 from gossip_tpu.topology import generators as G
-from gossip_tpu.utils.checkpoint import (load_state, run_with_checkpoints,
-                                         save_state)
+from gossip_tpu.utils.checkpoint import (load_meta, load_state,
+                                         run_with_checkpoints, save_state)
 from gossip_tpu.utils.metrics import (curve_gap, dump_curve_jsonl,
                                       load_curve_jsonl, summarize_curve)
 from gossip_tpu.utils.trace import RoundTimer, annotate, trace
@@ -139,3 +139,44 @@ def test_trace_smoke(tmp_path):
         with t:
             pass
     assert len(t.times) == 2 and t.mean_ms >= 0
+
+
+def test_run_with_checkpoints_named_curve_channels(tmp_path):
+    """Dict-valued curve_fn (rumor's coverage+hot pair): one list per
+    channel, persisted in the checkpoint meta, resumable via a dict
+    curve_prefix; a flat-list prefix against a dict curve_fn is a
+    TypeError (never silently mixed)."""
+    import pytest
+
+    from gossip_tpu.models.si import coverage
+    proto = ProtocolConfig(mode="pull", fanout=1)
+    topo = G.complete(64)
+    step = jax.jit(make_si_round(proto, topo))
+    st0 = init_state(RunConfig(seed=2), proto, topo.n)
+
+    def channels(s):
+        return {"coverage": coverage(s.seen, None),
+                "msgs": s.msgs}
+
+    p = str(tmp_path / "chan.npz")
+    st, curve = run_with_checkpoints(step, st0, rounds=5, path=p,
+                                     every=2, curve_fn=channels)
+    assert set(curve) == {"coverage", "msgs"}
+    assert len(curve["coverage"]) == len(curve["msgs"]) == 5
+    saved = load_meta(p)["extra"]["curve"]
+    assert saved == curve
+    st2, curve2 = run_with_checkpoints(step, load_state(p), rounds=3,
+                                       path=p, curve_fn=channels,
+                                       curve_prefix=saved)
+    assert len(curve2["coverage"]) == 8
+    assert curve2["coverage"][:5] == curve["coverage"]
+    straight, full = run_with_checkpoints(step, st0, rounds=8,
+                                          path=str(tmp_path / "s.npz"),
+                                          curve_fn=channels)
+    assert curve2 == full
+    np.testing.assert_array_equal(np.asarray(st2.seen),
+                                  np.asarray(straight.seen))
+    with pytest.raises(TypeError):
+        run_with_checkpoints(step, st0, rounds=2,
+                             path=str(tmp_path / "bad.npz"),
+                             curve_fn=channels, curve_prefix=[0.5])
