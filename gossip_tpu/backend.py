@@ -177,9 +177,11 @@ def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
     identical partner stream per device, zero per-round ICI.
 
     Validates eagerly and loudly — the fused kernels cover exactly the
-    flagship envelope (TPU, pull, implicit complete graph, fault-free)
-    and silently substituting a different engine would mislabel the
-    wall-clock numbers, same policy as the exchange routing above.
+    flagship envelope (TPU, pull, implicit complete graph; fault masks
+    on the single-device single-rumor kernel since round 4, fault-free
+    elsewhere) and silently substituting a different engine would
+    mislabel the wall-clock numbers, same policy as the exchange
+    routing above.
     """
     import jax as _jax
     import jax.numpy as jnp
@@ -223,8 +225,10 @@ def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
         loop, init = compiled_until_fused(
             n, seed=run.seed, fanout=proto.fanout,
             target_coverage=run.target_coverage, max_rounds=run.max_rounds,
-            origin=run.origin)
-        cov_fn = lambda t: coverage_node_packed(t, n)  # noqa: E731
+            origin=run.origin, fault=fault)
+        # the SAME weighting chooser the loop's cond uses — cannot drift
+        from gossip_tpu.ops.pallas_round import fused_cov_fn
+        cov_fn = fused_cov_fn(n, fault, run.origin)
     else:
         loop, init = compiled_until_fused_multirumor(
             n, proto.rumors, seed=run.seed, fanout=proto.fanout,
@@ -270,10 +274,23 @@ def _fused_ineligible_reason(proto: ProtocolConfig, tc: TopologyConfig,
     if tc.family != "complete":
         return ("engine='fused' runs on the implicit complete "
                 f"topology only (got family {tc.family!r})")
-    if fault is not None and (fault.node_death_rate or fault.drop_prob
-                              or fault.dead_nodes):
-        return ("engine='fused' has no fault-mask path; "
-                "use engine='auto' for fault injection")
+    if fault is not None and fault.dead_nodes:
+        # scripted dead_nodes/fail_round is a SWIM scenario; the fused
+        # kernels' static masks do not implement it — reject loudly
+        # rather than run fault-free under a fault flag
+        return ("engine='fused' does not implement scripted dead_nodes/"
+                "fail_round; use engine='auto' (or node_death_rate for "
+                "random static deaths)")
+    if fault is not None and (fault.node_death_rate or fault.drop_prob):
+        # round 4: the single-device single-rumor node-packed kernel has
+        # in-kernel fault masks (static alive bitmap + 20-bit drop
+        # threshold, ops/pallas_round._fused_round_kernel); the word/
+        # staged/plane layouts do not yet
+        if n_dev > 1 or proto.rumors > 1:
+            return ("engine='fused' fault masks cover the single-device "
+                    "single-rumor kernel only (got "
+                    f"rumors={proto.rumors}, devices={n_dev}); "
+                    "use engine='auto' for fault injection here")
     if n_dev == 1 and proto.rumors > BITS:
         return (f"engine='fused' packs <= {BITS} rumors per word "
                 f"on one device (got rumors={proto.rumors}); "

@@ -129,9 +129,11 @@ def _rotate_rows(table: jax.Array, sbits: jax.Array, rows: int) -> jax.Array:
 
 
 def _fused_call(kernel, rows: int, seed, round_, table, inject_bits,
-                interpret: bool, round_salt: int = 0):
+                interpret: bool, round_salt: int = 0, alive_table=None):
     """Shared pallas_call plumbing for the fused kernels: SMEM seed pair,
-    VMEM table aliased into the output, optional injected-bits operands."""
+    VMEM table aliased into the output, optional injected-bits operands,
+    optional alive-bitmap operand (fault masks — last, after the inject
+    pair, matching the kernels' ``rest`` unpack order)."""
     seeds = jnp.stack([jnp.asarray(seed, jnp.int32) * jnp.int32(_ROUND_MIX),
                        jnp.asarray(round_, jnp.int32)
                        ^ jnp.int32(round_salt)])
@@ -144,6 +146,9 @@ def _fused_call(kernel, rows: int, seed, round_, table, inject_bits,
                      pl.BlockSpec(memory_space=pltpu.VMEM)]
         operands += [jnp.asarray(sbits, jnp.uint32),
                      jnp.asarray(rbits, jnp.uint32)]
+    if alive_table is not None:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.VMEM)]
+        operands += [jnp.asarray(alive_table, jnp.uint32)]
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.uint32),
@@ -157,7 +162,8 @@ def _fused_call(kernel, rows: int, seed, round_, table, inject_bits,
 
 
 def _fused_round_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
-                        n_valid_words: int, tail_mask: int, inject: bool):
+                        n_valid_words: int, tail_mask: int, inject: bool,
+                        drop_threshold: int = 0, has_alive: bool = False):
     """One pull round, entirely in VMEM.  See module doc for the scheme.
 
     ``inject=True`` replaces the hardware PRNG with caller-supplied bit
@@ -165,21 +171,41 @@ def _fused_round_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
     planes, masking — is unit-testable on CPU, where the Mosaic
     interpreter stubs ``prng_random_bits`` with zeros (tests/test_pallas.py
     round-1 finding).  The TPU path draws the same shapes from the hw PRNG.
-    """
+
+    Fault masks (round 4; static SI semantics, models/state.alive_mask):
+    ``has_alive`` adds an alive-bitmap operand (node-packed like the
+    table) — dead nodes SERVE nothing (their bits are cleared from the
+    rotation source) and ACQUIRE nothing (plane contributions masked by
+    the destination's alive bit); their own initial bits stay put, like
+    the XLA path's dark nodes.  ``drop_threshold`` (static, 20-bit:
+    round(drop_prob * 2^20)) drops an individual pull when the free
+    bits 12..31 of its draw fall below it — bits 0..6 are the lane and
+    7..11 the bit choice, so the drop coin is independent of the
+    partner choice.  Both default OFF, leaving the fault-free lowering
+    byte-identical to round 2's."""
     if inject:
-        sbits_ref, rbits_ref, tout_ref = rest
+        if has_alive:
+            sbits_ref, rbits_ref, alive_ref, tout_ref = rest
+        else:
+            sbits_ref, rbits_ref, tout_ref = rest
     else:
-        (tout_ref,) = rest
+        if has_alive:
+            alive_ref, tout_ref = rest
+        else:
+            (tout_ref,) = rest
         pltpu.prng_seed(seed_ref[0], seed_ref[1])
     table = tin_ref[:]
+    alive = alive_ref[:] if has_alive else None
 
     # Stage 1: one shared rotation per round (all bit planes and fanout
     # draws reuse it; the MR kernel rotates per fanout draw instead).
+    # Dead nodes serve nothing: cleared from the rotation SOURCE only —
+    # their own accumulated bits are untouched.
     if inject:
         sbits = sbits_ref[:]
     else:
         sbits = pltpu.bitcast(pltpu.prng_random_bits((8, LANES)), jnp.uint32)
-    rot = _rotate_rows(table, sbits, rows)
+    rot = _rotate_rows(table & alive if has_alive else table, sbits, rows)
 
     # Stages 2+3: per destination bit-plane k, draw (lane m, bit c) per
     # word, gather the partner word in-row, pull bit c into plane k.
@@ -195,6 +221,11 @@ def _fused_round_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
             c = (rb >> jnp.uint32(7)) & jnp.uint32(BITS - 1)
             partner = jnp.take_along_axis(rot, m, axis=1)
             bit = (partner >> c) & jnp.uint32(1)
+            if drop_threshold:
+                keep = (rb >> jnp.uint32(12)) >= jnp.uint32(drop_threshold)
+                bit = jnp.where(keep, bit, jnp.uint32(0))
+            if has_alive:
+                bit = bit & ((alive >> jnp.uint32(k)) & jnp.uint32(1))
             acc = acc | (bit << jnp.uint32(k))
 
     # Zero phantom words so phantom nodes never read as infected.
@@ -209,15 +240,19 @@ def _fused_round_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("n", "fanout", "interpret"))
+                   static_argnames=("n", "fanout", "interpret",
+                                    "drop_threshold"))
 def fused_pull_round(table: jax.Array, seed: jax.Array, round_: jax.Array,
                      n: int, fanout: int = 1, interpret: bool = False,
-                     inject_bits=None) -> jax.Array:
+                     inject_bits=None, drop_threshold: int = 0,
+                     alive_table=None) -> jax.Array:
     """Apply one fused pull round to a node-packed table. Pure; jittable.
 
     ``inject_bits`` (tests only): a ``(sbits uint32[8,128], rbits
     uint32[fanout*32, rows, 128])`` pair replacing the hardware PRNG —
-    see _fused_round_kernel.
+    see _fused_round_kernel.  ``drop_threshold``/``alive_table`` are the
+    static fault masks (same docstring); both default off and leave the
+    fault-free lowering unchanged.
     """
     rows = table.shape[0]
     n_valid_words = -(-n // BITS)
@@ -226,9 +261,11 @@ def fused_pull_round(table: jax.Array, seed: jax.Array, round_: jax.Array,
     kernel = functools.partial(
         _fused_round_kernel, rows=rows, fanout=fanout,
         n_valid_words=n_valid_words, tail_mask=tail_mask,
-        inject=inject_bits is not None)
+        inject=inject_bits is not None,
+        drop_threshold=drop_threshold,
+        has_alive=alive_table is not None)
     return _fused_call(kernel, rows, seed, round_, table, inject_bits,
-                       interpret)
+                       interpret, alive_table=alive_table)
 
 
 # ---------------------------------------------------------------------------
@@ -568,30 +605,85 @@ def init_fused_state(n: int, origin: int = 0) -> FusedState:
                       msgs=jnp.float32(0.0))
 
 
+def coverage_node_packed_alive(table: jax.Array, alive_table: jax.Array):
+    """Alive-weighted infected fraction: the fault-run twin of
+    :func:`coverage_node_packed` (dead nodes are unreachable, not
+    uninformed — si.coverage's weighting).  ``alive_table`` is the
+    node-packed alive bitmap; phantoms are zero in BOTH tables."""
+    pop = jnp.sum(jax.lax.population_count(table & alive_table),
+                  dtype=jnp.uint32)
+    n_alive = jnp.sum(jax.lax.population_count(alive_table),
+                      dtype=jnp.uint32)
+    return pop.astype(jnp.float32) / n_alive.astype(jnp.float32)
+
+
+def fault_masks_node_packed(fault, n: int, origin: int = 0):
+    """(alive_table-or-None, drop_threshold) for the fused fault path —
+    the node-packed rendering of models/state.alive_mask (static SI
+    fault semantics: node_death_rate draws a static dead set, origin
+    pinned alive; drop_prob drops individual pulls).  The 20-bit
+    threshold quantizes drop_prob to 1/2^20 (< 1e-6), documented like
+    the rotation's modulo bias.  Safe to call IN-TRACE: the bitmap is
+    pure jnp from the fault config, so jitted callers rebuild it
+    loop-invariantly (XLA hoists it) instead of closing over an O(N)
+    inline constant — the bind_tables rule."""
+    from gossip_tpu.models.state import alive_mask
+    alive = alive_mask(fault, n, origin)
+    alive_table = None if alive is None else node_pack(alive)
+    drop_prob = 0.0 if fault is None else fault.drop_prob
+    drop_threshold = int(round(drop_prob * (1 << 20))) if drop_prob else 0
+    return alive_table, drop_threshold
+
+
+def fused_cov_fn(n: int, fault=None, origin: int = 0):
+    """``table -> coverage`` for a fused run: alive-weighted exactly when
+    the fault draws deaths.  The ONE place the weighting choice lives —
+    the while-loop cond and the driver's final report both use it, so
+    they can never disagree.  In-trace callers rebuild the alive bitmap
+    per call (hoisted); eager callers pay one small draw."""
+    if fault is None or not fault.node_death_rate:
+        return lambda t: coverage_node_packed(t, n)
+
+    def cov(t):
+        alive_tab, _ = fault_masks_node_packed(fault, n, origin)
+        return coverage_node_packed_alive(t, alive_tab)
+    return cov
+
+
 def compiled_until_fused(n: int, seed: int, fanout: int = 1,
                          target_coverage: float = 0.99,
                          max_rounds: int = 128, origin: int = 0,
-                         interpret: bool = False):
+                         interpret: bool = False, fault=None):
     """(loop, init): compiled while_loop to target coverage, fused kernel.
 
     Same contract as models/si_packed.compiled_until_packed: every node
     issues `fanout` pull requests per round, each answered by one digest
     (msgs += 2*fanout*N per round — phantom/self pulls are counted as real
-    requests, matching the threefry path's accounting of dropped pulls).
+    requests, matching the threefry path's accounting of dropped pulls;
+    dropped and dead-partner pulls likewise).  ``fault`` (round 4)
+    enables the kernel's static fault masks; the loop's target compare
+    switches to the alive-weighted coverage (fused_cov_fn).
     """
     target = jnp.float32(target_coverage)
+    _, drop_threshold = fault_masks_node_packed(fault, n, origin)
+    has_alive = fault is not None and bool(fault.node_death_rate)
+    cov = fused_cov_fn(n, fault, origin)
 
     def step(st: FusedState) -> FusedState:
+        # alive bitmap rebuilt IN-TRACE (loop-invariant, hoisted): no
+        # O(N) constant baked into the donated jit below
+        alive_tab = (fault_masks_node_packed(fault, n, origin)[0]
+                     if has_alive else None)
         tab = fused_pull_round(st.table, seed, st.round, n, fanout,
-                               interpret)
+                               interpret, drop_threshold=drop_threshold,
+                               alive_table=alive_tab)
         return FusedState(table=tab, round=st.round + 1,
                           msgs=st.msgs + 2.0 * fanout * n)
 
     @functools.partial(jax.jit, donate_argnums=0)
     def loop(st: FusedState) -> FusedState:
         def cond(s):
-            return ((coverage_node_packed(s.table, n) < target)
-                    & (s.round < max_rounds))
+            return (cov(s.table) < target) & (s.round < max_rounds)
         return jax.lax.while_loop(cond, step, st)
 
     return loop, init_fused_state(n, origin)

@@ -384,3 +384,150 @@ class TestHardwarePRNGStagedBigPath:
         # E ~ 2^12; branching variance is tamed by averaging the rumors
         assert 2**10 <= counts.mean() <= 2**14
         assert (counts > 0).all()
+
+
+# ---------------------------------------------------------------------------
+# Fault masks (round 4): static alive bitmap + 20-bit drop threshold in the
+# single-rumor fused kernel.  Same CPU strategy as above — injected bits,
+# independent numpy model, exact equality.
+
+def numpy_fault_round(table, sbits, rbits, n, fanout, drop_threshold,
+                      alive_table):
+    """numpy_reference_round + the documented fault-mask semantics:
+    dead nodes cleared from the rotation SOURCE (serve nothing) and from
+    plane contributions (acquire nothing); a pull whose draw's bits
+    12..31 fall below drop_threshold is dropped."""
+    rows = table.shape[0]
+    s = (sbits[0, :].astype(np.uint64) % rows).astype(np.int64)
+    i = np.arange(rows)[:, None]
+    src = table & alive_table if alive_table is not None else table
+    rot = src[(i - s[None, :]) % rows, np.arange(LANES)[None, :]]
+    acc = table.copy()
+    for k in range(BITS):
+        for f in range(fanout):
+            rb = rbits[k * fanout + f]
+            m = rb & (LANES - 1)
+            c = (rb >> 7) & (BITS - 1)
+            partner = np.take_along_axis(rot, m.astype(np.int64), axis=1)
+            bit = ((partner >> c) & 1).astype(np.uint32)
+            if drop_threshold:
+                bit = np.where((rb >> 12) >= drop_threshold, bit,
+                               np.uint32(0))
+            if alive_table is not None:
+                bit = bit & ((alive_table >> np.uint32(k)) & 1)
+            acc = acc | (bit << np.uint32(k))
+    flat = acc.reshape(-1)
+    n_valid_words = -(-n // BITS)
+    tail = n % BITS
+    out = flat.copy()
+    out[n_valid_words:] = 0
+    if tail:
+        out[n_valid_words - 1] &= np.uint32((1 << tail) - 1)
+    return out.reshape(rows, LANES)
+
+
+@pytest.mark.parametrize("drop_p,death", [(0.3, 0.0), (0.0, 0.25),
+                                          (0.2, 0.2)])
+def test_kernel_fault_masks_match_numpy_model(drop_p, death):
+    from gossip_tpu.config import FaultConfig
+    from gossip_tpu.ops.pallas_round import fault_masks_node_packed
+    n, fanout = 4096 * 8 - 37, 1
+    rng = np.random.default_rng(97)
+    rows = n_rows(n)
+    infected = rng.random(n) < 0.05
+    table = np.asarray(node_pack(jnp.asarray(infected)))
+    fault = FaultConfig(drop_prob=drop_p, node_death_rate=death, seed=3)
+    alive_tab, thresh = fault_masks_node_packed(fault, n, origin=0)
+    alive_np = None if alive_tab is None else np.asarray(alive_tab)
+    assert (thresh > 0) == (drop_p > 0)
+    assert (alive_np is not None) == (death > 0)
+    sbits, rbits = _random_bits(rng, rows, fanout)
+    got = fused_pull_round(jnp.asarray(table), 0, 0, n, fanout,
+                           interpret=not ON_TPU,
+                           inject_bits=(sbits, rbits),
+                           drop_threshold=thresh,
+                           alive_table=alive_tab)
+    want = numpy_fault_round(table, sbits, rbits, n, fanout, thresh,
+                             alive_np)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_fault_free_path_unchanged_by_fault_args():
+    """drop_threshold=0 + alive_table=None must be EXACTLY the round-2
+    kernel: the flagship bench lowering cannot shift under the fault
+    feature."""
+    n, fanout = 4096 * 8, 1
+    rng = np.random.default_rng(5)
+    rows = n_rows(n)
+    table = np.asarray(node_pack(jnp.asarray(rng.random(n) < 0.05)))
+    sbits, rbits = _random_bits(rng, rows, fanout)
+    a = fused_pull_round(jnp.asarray(table), 0, 0, n, fanout,
+                         interpret=not ON_TPU, inject_bits=(sbits, rbits))
+    b = fused_pull_round(jnp.asarray(table), 0, 0, n, fanout,
+                         interpret=not ON_TPU, inject_bits=(sbits, rbits),
+                         drop_threshold=0, alive_table=None)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_compiled_until_fused_fault_semantics():
+    """Driver-level contract on the CPU interpreter.  The stubbed PRNG
+    draws zeros -> no rotation, and every word (row i, lane j, plane k)
+    pulls bit 0 of word (i, 0): the only initially-infected such source
+    is the origin (node 0), so the epidemic's deterministic fixed point
+    is "every ALIVE node of row 0" — enough structure to pin the mask
+    semantics exactly.  A drop_threshold of 2^20 (drop everything)
+    freezes the epidemic entirely."""
+    from gossip_tpu.config import FaultConfig
+    from gossip_tpu.ops.pallas_round import NODES_PER_ROW
+    n = 4096 * 8
+    fault = FaultConfig(node_death_rate=0.3, seed=11)
+    loop, init = compiled_until_fused(n, seed=0, max_rounds=3,
+                                      interpret=True, fault=fault)
+    final = loop(init)
+    from gossip_tpu.models.state import alive_mask
+    alive = np.asarray(alive_mask(fault, n, 0))
+    inf = np.asarray(node_unpack(final.table, n))
+    assert not np.any(inf & ~alive), "a dead node acquired infection"
+    want = alive & (np.arange(n) < NODES_PER_ROW)   # row 0, alive only
+    np.testing.assert_array_equal(inf, want)
+    assert int(final.round) == 3                    # fixed point < target
+
+    # drop everything: nothing ever spreads
+    frozen = FaultConfig(drop_prob=1.0, seed=1)
+    loop2, init2 = compiled_until_fused(n, seed=0, max_rounds=3,
+                                        interpret=True, fault=frozen)
+    final2 = loop2(init2)
+    assert float(coverage_node_packed(final2.table, n)) * n == 1.0
+    assert int(final2.round) == 3
+
+
+@pytest.mark.skipif(not ON_TPU, reason="hw PRNG path needs a real TPU "
+                                       "(interpreter stubs random bits)")
+class TestHardwarePRNGFaultMasks:
+    def test_dead_stay_dark_and_drop_slows_convergence(self):
+        """Fault masks under the REAL hardware PRNG: dead nodes never
+        acquire infection over a full epidemic, the alive-weighted
+        epidemic still completes, and a heavy drop rate costs extra
+        rounds vs the fault-free run (statistical, wide margin)."""
+        from gossip_tpu.config import FaultConfig
+        from gossip_tpu.models.state import alive_mask
+        from gossip_tpu.ops.pallas_round import (
+            coverage_node_packed_alive, fault_masks_node_packed)
+        n = 1 << 18
+        fault = FaultConfig(node_death_rate=0.2, seed=7)
+        loop, init = compiled_until_fused(n, seed=3, max_rounds=64,
+                                          fault=fault)
+        final = loop(init)
+        alive = np.asarray(alive_mask(fault, n, 0))
+        inf = np.asarray(node_unpack(final.table, n))
+        assert not np.any(inf & ~alive)
+        alive_tab, _ = fault_masks_node_packed(fault, n, 0)
+        assert float(coverage_node_packed_alive(final.table,
+                                                alive_tab)) >= 0.99
+        l0, i0 = compiled_until_fused(n, seed=3, max_rounds=64)
+        r0 = int(l0(i0).round)
+        drop = FaultConfig(drop_prob=0.5, seed=2)
+        ld, idr = compiled_until_fused(n, seed=3, max_rounds=64,
+                                       fault=drop)
+        rd = int(ld(idr).round)
+        assert rd > r0, (rd, r0)    # half the pulls dropped: more rounds
