@@ -178,10 +178,11 @@ def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
 
     Validates eagerly and loudly — the fused kernels cover exactly the
     flagship envelope (TPU, pull, implicit complete graph; fault masks
-    on the single-device single-rumor kernel since round 4, fault-free
-    elsewhere) and silently substituting a different engine would
-    mislabel the wall-clock numbers, same policy as the exchange
-    routing above.
+    on every SINGLE-DEVICE layout since round 4 — node-packed,
+    one-word-per-node, staged big path — while the plane-sharded
+    layout stays fault-free) and silently substituting a different
+    engine would mislabel the wall-clock numbers, same policy as the
+    exchange routing above.
     """
     import jax as _jax
     import jax.numpy as jnp
@@ -233,8 +234,9 @@ def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
         loop, init = compiled_until_fused_multirumor(
             n, proto.rumors, seed=run.seed, fanout=proto.fanout,
             target_coverage=run.target_coverage, max_rounds=run.max_rounds,
-            origin=run.origin)
-        cov_fn = lambda t: coverage_words(t, n, proto.rumors)  # noqa: E731
+            origin=run.origin, fault=fault)
+        from gossip_tpu.ops.pallas_round import fused_mr_cov_fn
+        cov_fn = fused_mr_cov_fn(n, proto.rumors, fault, run.origin)
 
     from gossip_tpu.utils.trace import maybe_aot_timed
     timing: Dict[str, float] = {}
@@ -282,14 +284,13 @@ def _fused_ineligible_reason(proto: ProtocolConfig, tc: TopologyConfig,
                 "fail_round; use engine='auto' (or node_death_rate for "
                 "random static deaths)")
     if fault is not None and (fault.node_death_rate or fault.drop_prob):
-        # round 4: the single-device single-rumor node-packed kernel has
-        # in-kernel fault masks (static alive bitmap + 20-bit drop
-        # threshold, ops/pallas_round._fused_round_kernel); the word/
-        # staged/plane layouts do not yet
-        if n_dev > 1 or proto.rumors > 1:
+        # round 4: the single-device kernels (node-packed single-rumor,
+        # one-word-per-node multi-rumor incl. the staged big path) have
+        # in-kernel fault masks (static alive bitmap/words + 20-bit drop
+        # threshold); the plane-sharded layout does not yet
+        if n_dev > 1:
             return ("engine='fused' fault masks cover the single-device "
-                    "single-rumor kernel only (got "
-                    f"rumors={proto.rumors}, devices={n_dev}); "
+                    f"kernels only (got devices={n_dev}); "
                     "use engine='auto' for fault injection here")
     if n_dev == 1 and proto.rumors > BITS:
         return (f"engine='fused' packs <= {BITS} rumors per word "

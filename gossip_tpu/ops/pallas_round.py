@@ -320,14 +320,33 @@ def coverage_words(table: jax.Array, n: int, rumors: int) -> jax.Array:
 
 
 def _fused_mr_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
-                     n: int, inject: bool):
-    """One multi-rumor pull round, table fully VMEM-resident."""
+                     n: int, inject: bool, drop_threshold: int = 0,
+                     has_alive: bool = False):
+    """One multi-rumor pull round, table fully VMEM-resident.
+
+    Fault masks (round 4, same contract as _fused_round_kernel, adapted
+    to the one-word-per-NODE layout): the alive operand holds
+    0xFFFFFFFF for alive nodes and 0 for dead ones — dead nodes serve
+    nothing (cleared from the rotation source) and acquire nothing
+    (the gathered partner word is AND-masked), while their own word
+    stays put.  ``drop_threshold`` drops a whole pull (all rumors ride
+    one exchange) on bits 12..31 of its draw; the lane choice uses
+    bits 0..6, so the coin is independent.  Defaults leave the
+    fault-free lowering unchanged."""
     if inject:
-        sbits_ref, rbits_ref, tout_ref = rest
+        if has_alive:
+            sbits_ref, rbits_ref, alive_ref, tout_ref = rest
+        else:
+            sbits_ref, rbits_ref, tout_ref = rest
     else:
-        (tout_ref,) = rest
+        if has_alive:
+            alive_ref, tout_ref = rest
+        else:
+            (tout_ref,) = rest
         pltpu.prng_seed(seed_ref[0], seed_ref[1])
     table = tin_ref[:]
+    alive = alive_ref[:] if has_alive else None
+    src = table & alive if has_alive else table
 
     acc = table
     for f in range(fanout):
@@ -337,7 +356,7 @@ def _fused_mr_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
         else:
             sbits = pltpu.bitcast(pltpu.prng_random_bits((8, LANES)),
                                   jnp.uint32)
-        rot = _rotate_rows(table, sbits, rows)
+        rot = _rotate_rows(src, sbits, rows)
         # per-element lane choice -> partner's whole rumor word
         if inject:
             rb = rbits_ref[f]
@@ -345,7 +364,13 @@ def _fused_mr_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
             rb = pltpu.bitcast(pltpu.prng_random_bits((rows, LANES)),
                                jnp.uint32)
         m = (rb & jnp.uint32(LANES - 1)).astype(jnp.int32)
-        acc = acc | jnp.take_along_axis(rot, m, axis=1)
+        partner = jnp.take_along_axis(rot, m, axis=1)
+        if drop_threshold:
+            keep = (rb >> jnp.uint32(12)) >= jnp.uint32(drop_threshold)
+            partner = jnp.where(keep, partner, jnp.uint32(0))
+        if has_alive:
+            partner = partner & alive
+        acc = acc | partner
 
     # zero phantom words (node id >= n)
     node_id = (jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0) * LANES
@@ -385,14 +410,24 @@ _MR_GATHER_BLOCK = 1024   # rows per grid step (512 KiB windows)
 
 
 def _mr_gather_kernel(seed_ref, tin_ref, rot_ref, *rest, n: int, block: int,
-                      inject: bool):
-    """Grid step: partner lane-gather from the pre-rotated table + OR."""
+                      inject: bool, drop_threshold: int = 0,
+                      has_alive: bool = False):
+    """Grid step: partner lane-gather from the pre-rotated table + OR.
+    Fault masks as in _fused_mr_kernel — the rotation source is already
+    serve-masked by the caller's XLA stage; this kernel applies the drop
+    coin and the destination's acquire mask."""
     b = pl.program_id(0)
     if inject:
-        rbits_ref, tout_ref = rest
+        if has_alive:
+            rbits_ref, alive_ref, tout_ref = rest
+        else:
+            rbits_ref, tout_ref = rest
         rb = rbits_ref[0]
     else:
-        (tout_ref,) = rest
+        if has_alive:
+            alive_ref, tout_ref = rest
+        else:
+            (tout_ref,) = rest
         # per-block stream: fold the block id into the round seed word
         # (prng_set_seed_32 rejects a third traced operand)
         pltpu.prng_seed(seed_ref[0],
@@ -401,6 +436,11 @@ def _mr_gather_kernel(seed_ref, tin_ref, rot_ref, *rest, n: int, block: int,
                            jnp.uint32)
     m = (rb & jnp.uint32(LANES - 1)).astype(jnp.int32)
     partner = jnp.take_along_axis(rot_ref[:], m, axis=1)
+    if drop_threshold:
+        keep = (rb >> jnp.uint32(12)) >= jnp.uint32(drop_threshold)
+        partner = jnp.where(keep, partner, jnp.uint32(0))
+    if has_alive:
+        partner = partner & alive_ref[:]
     node_id = ((jax.lax.broadcasted_iota(jnp.int32, (block, LANES), 0)
                 + b * block) * LANES
                + jax.lax.broadcasted_iota(jnp.int32, (block, LANES), 1))
@@ -409,8 +449,13 @@ def _mr_gather_kernel(seed_ref, tin_ref, rot_ref, *rest, n: int, block: int,
 
 
 def _fused_mr_round_big(table: jax.Array, seed, round_, n: int,
-                        interpret: bool, inject_bits) -> jax.Array:
-    """One fanout-1 multi-rumor pull round via the staged big-table path."""
+                        interpret: bool, inject_bits,
+                        drop_threshold: int = 0,
+                        alive_words=None) -> jax.Array:
+    """One fanout-1 multi-rumor pull round via the staged big-table path.
+    Fault masks as in the value kernel: the serve mask is applied to the
+    rotation SOURCE in the XLA stage, the drop coin and acquire mask in
+    the grid kernel."""
     rows = table.shape[0]
     block = min(_MR_GATHER_BLOCK, rows)
 
@@ -427,7 +472,7 @@ def _fused_mr_round_big(table: jax.Array, seed, round_, n: int,
 
     # Stage 1 (XLA): per-lane row rotation, binary decomposition.
     s = (sbits[0:1, :] % jnp.uint32(rows)).astype(jnp.int32)   # [1, 128]
-    rot = table
+    rot = table if alive_words is None else table & alive_words
     shift = 1
     while shift < rows:
         take = (s & shift) != 0
@@ -440,6 +485,7 @@ def _fused_mr_round_big(table: jax.Array, seed, round_, n: int,
     rows_pad = -(-rows // block) * block
     rbits = None if inject_bits is None else jnp.asarray(
         inject_bits[1], jnp.uint32)
+    alive_p = alive_words
     if rows_pad != rows:
         zpad = jnp.zeros((rows_pad - rows, LANES), jnp.uint32)
         table_p = jnp.concatenate([table, zpad], axis=0)
@@ -448,6 +494,8 @@ def _fused_mr_round_big(table: jax.Array, seed, round_, n: int,
             rbits = jnp.concatenate(
                 [rbits, jnp.zeros((rbits.shape[0], rows_pad - rows, LANES),
                                   jnp.uint32)], axis=1)
+        if alive_p is not None:
+            alive_p = jnp.concatenate([alive_p, zpad], axis=0)  # pad: dead
     else:
         table_p = table
     seeds = jnp.stack([jnp.asarray(seed, jnp.int32) * jnp.int32(_ROUND_MIX),
@@ -459,8 +507,13 @@ def _fused_mr_round_big(table: jax.Array, seed, round_, n: int,
     if rbits is not None:
         in_specs.append(pl.BlockSpec((1, block, LANES), lambda i: (0, i, 0)))
         operands.append(rbits)
+    if alive_p is not None:
+        in_specs.append(pl.BlockSpec((block, LANES), lambda i: (i, 0)))
+        operands.append(alive_p)
     kernel = functools.partial(_mr_gather_kernel, n=n, block=block,
-                               inject=inject_bits is not None)
+                               inject=inject_bits is not None,
+                               drop_threshold=drop_threshold,
+                               has_alive=alive_words is not None)
     # Donate the table operand unless it is the CALLER's concrete array
     # (block-aligned rows + eager invocation): donating that would
     # invalidate the caller's buffer (ADVICE r2).  Under jit the operand
@@ -493,11 +546,59 @@ def _mr_wants_big(table_bytes: int, fanout: int) -> bool:
             and fanout == 1)
 
 
-@functools.partial(jax.jit, static_argnames=("n", "fanout", "interpret"))
+def fault_masks_word(fault, n: int, origin: int = 0):
+    """(alive_words-or-None, drop_threshold) for the multi-rumor fused
+    fault path: the one-word-per-NODE rendering of
+    models/state.alive_mask — 0xFFFFFFFF for alive nodes, 0 for dead
+    and phantom rows.  In-trace safe, like fault_masks_node_packed."""
+    from gossip_tpu.models.state import alive_mask
+    alive = alive_mask(fault, n, origin)
+    if alive is None:
+        alive_words = None
+    else:
+        rows = mr_rows(n)
+        flat = jnp.zeros((rows * LANES,), jnp.uint32).at[:n].set(
+            jnp.where(alive, jnp.uint32(0xFFFFFFFF), jnp.uint32(0)))
+        alive_words = flat.reshape(rows, LANES)
+    drop_prob = 0.0 if fault is None else fault.drop_prob
+    drop_threshold = int(round(drop_prob * (1 << 20))) if drop_prob else 0
+    return alive_words, drop_threshold
+
+
+def coverage_words_alive(table: jax.Array, alive_words: jax.Array,
+                         rumors: int) -> jax.Array:
+    """Alive-weighted min-over-rumors fraction — the fault-run twin of
+    :func:`coverage_words` (alive_words elements are 0xFFFFFFFF/0, so
+    bit 0 counts alive nodes)."""
+    masked = (table & alive_words).reshape(-1)
+    n_alive = jnp.sum(alive_words.reshape(-1) & jnp.uint32(1),
+                      dtype=jnp.uint32).astype(jnp.float32)
+    shifts = jnp.arange(rumors, dtype=jnp.uint32)
+    per_rumor = jnp.sum((masked[:, None] >> shifts[None, :])
+                        & jnp.uint32(1), axis=0,
+                        dtype=jnp.uint32).astype(jnp.float32) / n_alive
+    return jnp.min(per_rumor)
+
+
+def fused_mr_cov_fn(n: int, rumors: int, fault=None, origin: int = 0):
+    """``table -> coverage`` for a multi-rumor fused run — the one place
+    the alive-weighting choice lives (cf. fused_cov_fn)."""
+    if fault is None or not fault.node_death_rate:
+        return lambda t: coverage_words(t, n, rumors)
+
+    def cov(t):
+        alive_words, _ = fault_masks_word(fault, n, origin)
+        return coverage_words_alive(t, alive_words, rumors)
+    return cov
+
+
+@functools.partial(jax.jit, static_argnames=("n", "fanout", "interpret",
+                                             "drop_threshold"))
 def fused_multirumor_pull_round(table: jax.Array, seed: jax.Array,
                                 round_: jax.Array, n: int, fanout: int = 1,
                                 interpret: bool = False,
-                                inject_bits=None) -> jax.Array:
+                                inject_bits=None, drop_threshold: int = 0,
+                                alive_words=None) -> jax.Array:
     """One fused pull round on a one-word-per-node table.  Pure; jittable.
 
     Tables whose 4-window working set exceeds the VMEM budget route to the
@@ -506,16 +607,23 @@ def fused_multirumor_pull_round(table: jax.Array, seed: jax.Array,
 
     ``inject_bits`` (tests only): ``(sbits uint32[fanout, 8, 128], rbits
     uint32[fanout, rows, 128])`` replacing the hardware PRNG so the kernel
-    math runs under the CPU interpreter."""
+    math runs under the CPU interpreter.  ``drop_threshold``/
+    ``alive_words`` are the fault masks (fault_masks_word); defaults
+    leave the fault-free lowering unchanged on BOTH routes."""
     rows = table.shape[0]
     if _mr_wants_big(rows * LANES * 4, fanout):
         return _fused_mr_round_big(table, seed, round_, n, interpret,
-                                   inject_bits)
+                                   inject_bits,
+                                   drop_threshold=drop_threshold,
+                                   alive_words=alive_words)
     kernel = functools.partial(_fused_mr_kernel, rows=rows, fanout=fanout,
-                               n=n, inject=inject_bits is not None)
+                               n=n, inject=inject_bits is not None,
+                               drop_threshold=drop_threshold,
+                               has_alive=alive_words is not None)
     # round_salt: distinct hw-PRNG stream from the single-rumor kernel
     return _fused_call(kernel, rows, seed, round_, table, inject_bits,
-                       interpret, round_salt=0x5D0)
+                       interpret, round_salt=0x5D0,
+                       alive_table=alive_words)
 
 
 def fused_table_bytes(n: int, rumors: int) -> int:
@@ -566,23 +674,33 @@ def compiled_until_fused_multirumor(n: int, rumors: int, seed: int,
                                     fanout: int = 1,
                                     target_coverage: float = 0.99,
                                     max_rounds: int = 128, origin: int = 0,
-                                    interpret: bool = False):
+                                    interpret: bool = False, fault=None):
     """(loop, init): compiled while_loop to min-over-rumors target coverage
     using the multi-rumor fused kernel (hw PRNG — distributionally equal to
-    but a different stream from the threefry path)."""
+    but a different stream from the threefry path).  ``fault`` enables
+    the kernel's static fault masks; the cond switches to the
+    alive-weighted coverage (fused_mr_cov_fn)."""
     target = jnp.float32(target_coverage)
+    _, drop_threshold = fault_masks_word(fault, n, origin)
+    has_alive = fault is not None and bool(fault.node_death_rate)
+    cov = fused_mr_cov_fn(n, rumors, fault, origin)
 
     def step(st: FusedState) -> FusedState:
+        # alive words rebuilt IN-TRACE (loop-invariant, hoisted): no
+        # O(N) constant baked into the donated jit below
+        alive_words = (fault_masks_word(fault, n, origin)[0]
+                       if has_alive else None)
         tab = fused_multirumor_pull_round(st.table, seed, st.round, n,
-                                          fanout, interpret)
+                                          fanout, interpret,
+                                          drop_threshold=drop_threshold,
+                                          alive_words=alive_words)
         return FusedState(table=tab, round=st.round + 1,
                           msgs=st.msgs + 2.0 * fanout * n)
 
     @functools.partial(jax.jit, donate_argnums=0)
     def loop(st: FusedState) -> FusedState:
         def cond(s):
-            return ((coverage_words(s.table, n, rumors) < target)
-                    & (s.round < max_rounds))
+            return (cov(s.table) < target) & (s.round < max_rounds)
         return jax.lax.while_loop(cond, step, st)
 
     return loop, init_multirumor_state(n, rumors, origin)

@@ -531,3 +531,153 @@ class TestHardwarePRNGFaultMasks:
                                        fault=drop)
         rd = int(ld(idr).round)
         assert rd > r0, (rd, r0)    # half the pulls dropped: more rounds
+
+
+def numpy_mr_fault_round(table, sbits, rbits, n, fanout, drop_threshold,
+                         alive_words):
+    """numpy_mr_round + the word-layout fault-mask semantics."""
+    rows = table.shape[0]
+    src = table & alive_words if alive_words is not None else table
+    acc = table.copy()
+    for f in range(fanout):
+        s = (sbits[f, 0, :].astype(np.uint64) % rows).astype(np.int64)
+        i = np.arange(rows)[:, None]
+        rot = src[(i - s[None, :]) % rows, np.arange(LANES)[None, :]]
+        rb = rbits[f]
+        m = rb & (LANES - 1)
+        partner = np.take_along_axis(rot, m.astype(np.int64), axis=1)
+        if drop_threshold:
+            partner = np.where((rb >> 12) >= drop_threshold, partner,
+                               np.uint32(0))
+        if alive_words is not None:
+            partner = partner & alive_words
+        acc = acc | partner
+    flat = acc.reshape(-1)
+    flat[n:] = 0
+    return flat.reshape(rows, LANES)
+
+
+@pytest.mark.parametrize("drop_p,death,fanout", [(0.4, 0.0, 2),
+                                                 (0.0, 0.3, 1),
+                                                 (0.25, 0.15, 1)])
+def test_mr_kernel_fault_masks_match_numpy_model(drop_p, death, fanout):
+    from gossip_tpu.config import FaultConfig
+    from gossip_tpu.ops.pallas_round import fault_masks_word
+    n, r = 128 * 16 - 29, 8
+    rng = np.random.default_rng(31)
+    rows = mr_rows(n)
+    seen = rng.random((n, r)) < 0.06
+    table = np.asarray(word_pack(jnp.asarray(seen)))
+    fault = FaultConfig(drop_prob=drop_p, node_death_rate=death, seed=5)
+    alive_words, thresh = fault_masks_word(fault, n, origin=0)
+    alive_np = None if alive_words is None else np.asarray(alive_words)
+    sbits, rbits = _mr_bits(rng, rows, fanout)
+    got = fused_multirumor_pull_round(jnp.asarray(table), 0, 0, n, fanout,
+                                      interpret=not ON_TPU,
+                                      inject_bits=(sbits, rbits),
+                                      drop_threshold=thresh,
+                                      alive_words=alive_words)
+    want = numpy_mr_fault_round(table, sbits, rbits, n, fanout, thresh,
+                                alive_np)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_mr_staged_big_path_fault_masks_match_value_kernel():
+    """Both MR routes implement the SAME faulted function: bitwise-equal
+    on identical injected bits with the alive + drop masks on."""
+    from gossip_tpu.config import FaultConfig
+    from gossip_tpu.ops.pallas_round import (_fused_mr_round_big,
+                                             fault_masks_word)
+    n = 128 * 16 - 29
+    rng = np.random.default_rng(13)
+    rows = mr_rows(n)
+    seen = rng.random((n, 32)) < 0.04
+    table = jnp.asarray(np.asarray(word_pack(jnp.asarray(seen))))
+    fault = FaultConfig(drop_prob=0.3, node_death_rate=0.2, seed=9)
+    alive_words, thresh = fault_masks_word(fault, n, origin=0)
+    sbits, rbits = _mr_bits(rng, rows, 1)
+    want = fused_multirumor_pull_round(table, 0, 0, n, 1,
+                                       interpret=not ON_TPU,
+                                       inject_bits=(sbits, rbits),
+                                       drop_threshold=thresh,
+                                       alive_words=alive_words)
+    got = _fused_mr_round_big(table, 0, 0, n, not ON_TPU, (sbits, rbits),
+                              drop_threshold=thresh,
+                              alive_words=alive_words)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_mr_fault_free_path_unchanged_by_fault_args():
+    n, r = 128 * 16, 8
+    rng = np.random.default_rng(8)
+    rows = mr_rows(n)
+    table = jnp.asarray(np.asarray(word_pack(
+        jnp.asarray(rng.random((n, r)) < 0.05))))
+    sbits, rbits = _mr_bits(rng, rows, 1)
+    a = fused_multirumor_pull_round(table, 0, 0, n, 1,
+                                    interpret=not ON_TPU,
+                                    inject_bits=(sbits, rbits))
+    b = fused_multirumor_pull_round(table, 0, 0, n, 1,
+                                    interpret=not ON_TPU,
+                                    inject_bits=(sbits, rbits),
+                                    drop_threshold=0, alive_words=None)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_coverage_words_alive_weighting():
+    """Alive-weighted MR coverage: dead nodes leave the denominator and
+    their rumor bits stop counting."""
+    from gossip_tpu.config import FaultConfig
+    from gossip_tpu.ops.pallas_round import (coverage_words_alive,
+                                             fault_masks_word)
+    from gossip_tpu.models.state import alive_mask
+    n, r = 500, 4
+    rng = np.random.default_rng(2)
+    seen = rng.random((n, r)) < 0.5
+    fault = FaultConfig(node_death_rate=0.3, seed=6)
+    alive = np.asarray(alive_mask(fault, n, 0))
+    alive_words, _ = fault_masks_word(fault, n, 0)
+    got = float(coverage_words_alive(word_pack(jnp.asarray(seen)),
+                                     alive_words, r))
+    want = (seen[alive].mean(axis=0)).min()
+    assert got == pytest.approx(want, abs=1e-6)
+
+
+@pytest.mark.skipif(not ON_TPU, reason="hw PRNG path needs a real TPU "
+                                       "(interpreter stubs random bits)")
+class TestHardwarePRNGFaultMasksMultirumor:
+    def test_mr_dead_stay_dark_under_hw_prng(self):
+        """Per-rumor contract: a rumor whose origin survives the death
+        draw floods the alive population; a rumor whose origin is dead
+        never spreads (rumor.py's documented SI property) — and no dead
+        node ever holds any rumor.  Only the loop's max_rounds drives
+        the run (the min-over-rumors cond can't reach target when any
+        origin is dead, which the alive draw here includes on
+        purpose)."""
+        from gossip_tpu.config import FaultConfig
+        from gossip_tpu.models.state import alive_mask
+        from gossip_tpu.ops.pallas_round import (
+            compiled_until_fused_multirumor, word_unpack)
+        n, r = 1 << 16, 8
+        fault = FaultConfig(node_death_rate=0.2, drop_prob=0.1, seed=4)
+        loop, init = compiled_until_fused_multirumor(n, r, seed=5,
+                                                     max_rounds=48,
+                                                     fault=fault)
+        final = loop(init)
+        alive = np.asarray(alive_mask(fault, n, 0))
+        seen = np.asarray(word_unpack(final.table, n, r))
+        # dead nodes ACQUIRE nothing, but their own state stays put
+        # (kernel contract: acc starts from the table) — so a dead
+        # ORIGIN keeps exactly its own seeded bit; every other dead
+        # node holds nothing
+        dead_ids = np.arange(n)[~alive]
+        expect_dark = np.zeros((len(dead_ids), r), bool)
+        is_origin = dead_ids < r
+        expect_dark[is_origin, dead_ids[is_origin]] = True
+        np.testing.assert_array_equal(seen[~alive], expect_dark)
+        per_rumor = seen[alive].mean(axis=0)
+        for rr in range(r):
+            if alive[rr]:              # origin of rumor rr is node rr
+                assert per_rumor[rr] >= 0.99, (rr, per_rumor[rr])
+            else:
+                assert per_rumor[rr] == 0.0, (rr, per_rumor[rr])
