@@ -177,10 +177,10 @@ def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
     identical partner stream per device, zero per-round ICI.
 
     Validates eagerly and loudly — the fused kernels cover exactly the
-    flagship envelope (TPU, pull, implicit complete graph; fault masks
-    on every SINGLE-DEVICE layout since round 4 — node-packed,
-    one-word-per-node, staged big path — while the plane-sharded
-    layout stays fault-free) and silently substituting a different
+    flagship envelope (TPU, pull, implicit complete graph; static fault
+    masks on EVERY layout since round 4 — node-packed,
+    one-word-per-node, staged big path, plane-sharded — with scripted
+    dead_nodes still rejected) and silently substituting a different
     engine would mislabel the wall-clock numbers, same policy as the
     exchange routing above.
     """
@@ -207,7 +207,7 @@ def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
         w = plane_count(proto.rumors, n_dev)
         t0 = time.perf_counter()
         rounds, cov, msgs, final = simulate_until_sharded_fused(
-            n, proto.rumors, run, mesh, fanout=proto.fanout)
+            n, proto.rumors, run, mesh, fanout=proto.fanout, fault=fault)
         _jax.block_until_ready(final)
         wall = time.perf_counter() - t0
         hit = cov >= float(jnp.float32(run.target_coverage))
@@ -283,15 +283,9 @@ def _fused_ineligible_reason(proto: ProtocolConfig, tc: TopologyConfig,
         return ("engine='fused' does not implement scripted dead_nodes/"
                 "fail_round; use engine='auto' (or node_death_rate for "
                 "random static deaths)")
-    if fault is not None and (fault.node_death_rate or fault.drop_prob):
-        # round 4: the single-device kernels (node-packed single-rumor,
-        # one-word-per-node multi-rumor incl. the staged big path) have
-        # in-kernel fault masks (static alive bitmap/words + 20-bit drop
-        # threshold); the plane-sharded layout does not yet
-        if n_dev > 1:
-            return ("engine='fused' fault masks cover the single-device "
-                    f"kernels only (got devices={n_dev}); "
-                    "use engine='auto' for fault injection here")
+    # node_death_rate / drop_prob: in-kernel static fault masks cover
+    # every fused layout since round 4 (node-packed, one-word-per-node,
+    # staged big path, plane-sharded) — no restriction to return
     if n_dev == 1 and proto.rumors > BITS:
         return (f"engine='fused' packs <= {BITS} rumors per word "
                 f"on one device (got rumors={proto.rumors}); "

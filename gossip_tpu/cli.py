@@ -542,7 +542,7 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
             tc.n, proto.rumors, run, make_plane_mesh(n_dev), a.checkpoint,
             every=a.checkpoint_every, fanout=proto.fanout,
             resume_state=resume_state, want_curve=want_curve,
-            curve_prefix=curve_prefix, extra_meta=extra)
+            curve_prefix=curve_prefix, extra_meta=extra, fault=fault)
         engine_label = "fused-pallas-planes"
     elif n_dev > 1:
         from gossip_tpu.parallel.sharded import make_mesh

@@ -560,9 +560,7 @@ def fault_masks_word(fault, n: int, origin: int = 0):
         flat = jnp.zeros((rows * LANES,), jnp.uint32).at[:n].set(
             jnp.where(alive, jnp.uint32(0xFFFFFFFF), jnp.uint32(0)))
         alive_words = flat.reshape(rows, LANES)
-    drop_prob = 0.0 if fault is None else fault.drop_prob
-    drop_threshold = int(round(drop_prob * (1 << 20))) if drop_prob else 0
-    return alive_words, drop_threshold
+    return alive_words, drop_threshold_for(fault)
 
 
 def coverage_words_alive(table: jax.Array, alive_words: jax.Array,
@@ -681,7 +679,7 @@ def compiled_until_fused_multirumor(n: int, rumors: int, seed: int,
     the kernel's static fault masks; the cond switches to the
     alive-weighted coverage (fused_mr_cov_fn)."""
     target = jnp.float32(target_coverage)
-    _, drop_threshold = fault_masks_word(fault, n, origin)
+    drop_threshold = drop_threshold_for(fault)
     has_alive = fault is not None and bool(fault.node_death_rate)
     cov = fused_mr_cov_fn(n, rumors, fault, origin)
 
@@ -735,6 +733,14 @@ def coverage_node_packed_alive(table: jax.Array, alive_table: jax.Array):
     return pop.astype(jnp.float32) / n_alive.astype(jnp.float32)
 
 
+def drop_threshold_for(fault) -> int:
+    """The static 20-bit drop threshold alone (round(drop_prob * 2^20))
+    — for drivers that need the Python int WITHOUT paying the O(n)
+    alive-mask build the full fault_masks_* helpers do."""
+    drop_prob = 0.0 if fault is None else fault.drop_prob
+    return int(round(drop_prob * (1 << 20))) if drop_prob else 0
+
+
 def fault_masks_node_packed(fault, n: int, origin: int = 0):
     """(alive_table-or-None, drop_threshold) for the fused fault path —
     the node-packed rendering of models/state.alive_mask (static SI
@@ -748,9 +754,7 @@ def fault_masks_node_packed(fault, n: int, origin: int = 0):
     from gossip_tpu.models.state import alive_mask
     alive = alive_mask(fault, n, origin)
     alive_table = None if alive is None else node_pack(alive)
-    drop_prob = 0.0 if fault is None else fault.drop_prob
-    drop_threshold = int(round(drop_prob * (1 << 20))) if drop_prob else 0
-    return alive_table, drop_threshold
+    return alive_table, drop_threshold_for(fault)
 
 
 def fused_cov_fn(n: int, fault=None, origin: int = 0):
@@ -783,7 +787,7 @@ def compiled_until_fused(n: int, seed: int, fanout: int = 1,
     switches to the alive-weighted coverage (fused_cov_fn).
     """
     target = jnp.float32(target_coverage)
-    _, drop_threshold = fault_masks_node_packed(fault, n, origin)
+    drop_threshold = drop_threshold_for(fault)
     has_alive = fault is not None and bool(fault.node_death_rate)
     cov = fused_cov_fn(n, fault, origin)
 

@@ -56,8 +56,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gossip_tpu.config import RunConfig
 from gossip_tpu.ops.pallas_round import (
-    BITS, LANES, coverage_words, fused_multirumor_pull_round, mr_rows,
-    word_pack)
+    BITS, LANES, coverage_words, coverage_words_alive, drop_threshold_for,
+    fault_masks_word, fused_multirumor_pull_round, mr_rows, word_pack)
 
 AXIS = "planes"
 
@@ -106,19 +106,48 @@ def coverage_planes(planes: jax.Array, n: int) -> jax.Array:
     return jnp.min(per_plane)
 
 
+def fused_planes_cov_fn(n: int, fault=None, origin: int = 0):
+    """``planes -> coverage`` — alive-weighted iff the fault draws
+    deaths (cf. ops/pallas_round.fused_cov_fn; padding rumors stay 1.0
+    under the weighting: every alive node holds their all-ones bits)."""
+    if fault is None or not fault.node_death_rate:
+        return lambda p: coverage_planes(p, n)
+
+    def cov(p):
+        alive_words, _ = fault_masks_word(fault, n, origin)
+        per_plane = jax.vmap(
+            lambda t: coverage_words_alive(t, alive_words, BITS))(p)
+        return jnp.min(per_plane)
+    return cov
+
+
 def make_sharded_fused_round(n: int, mesh: Mesh, fanout: int = 1,
-                             interpret: bool = False, inject_bits=None):
+                             interpret: bool = False, inject_bits=None,
+                             fault=None, origin: int = 0):
     """shard_map'd round: each device advances its local planes with the
     identically-seeded fused kernel — same partner draw on every device,
     zero ICI.  ``inject_bits`` (tests) is one (sbits, rbits) pair reused
-    for every plane, which IS the semantic: one shared partner stream."""
+    for every plane, which IS the semantic: one shared partner stream.
+
+    ``fault`` (round 4) threads the static fault masks into every
+    plane's kernel call.  The masks are a pure function of the fault
+    config over the REPLICATED node dimension, rebuilt in-trace on each
+    device (same values everywhere), and they consume no hardware PRNG
+    (the drop coin rides free bits of the existing partner draw) — so
+    the zero-ICI same-stream invariant is untouched."""
     n_dev = mesh.shape[AXIS]
+    drop_threshold = drop_threshold_for(fault)
+    has_alive = fault is not None and bool(fault.node_death_rate)
 
     def local_round(planes_l, seed, round_):
         w_local = planes_l.shape[0]
+        alive_words = (fault_masks_word(fault, n, origin)[0]
+                       if has_alive else None)
         outs = [fused_multirumor_pull_round(
                     planes_l[i], seed, round_, n, fanout, interpret,
-                    inject_bits=inject_bits)
+                    inject_bits=inject_bits,
+                    drop_threshold=drop_threshold,
+                    alive_words=alive_words)
                 for i in range(w_local)]
         return jnp.stack(outs)
 
@@ -209,7 +238,8 @@ def checkpointed_fused_planes(n: int, rumors: int, run: RunConfig,
                               fanout: int = 1,
                               resume_state=None, want_curve: bool = False,
                               interpret: bool = False,
-                              curve_prefix=(), extra_meta=None):
+                              curve_prefix=(), extra_meta=None,
+                              fault=None):
     """Fixed-budget plane-sharded fused run in compiled segments with
     atomic npz checkpoints — persistence for the flagship multi-rumor
     runs, the one scale long enough to need it (the reference loses all
@@ -229,7 +259,9 @@ def checkpointed_fused_planes(n: int, rumors: int, run: RunConfig,
     """
     from gossip_tpu.ops.pallas_round import FusedState
     from gossip_tpu.utils.checkpoint import run_with_checkpoints
-    round_fn = make_sharded_fused_round(n, mesh, fanout, interpret)
+    round_fn = make_sharded_fused_round(n, mesh, fanout, interpret,
+                                        fault=fault, origin=run.origin)
+    cov_planes = fused_planes_cov_fn(n, fault, run.origin)
 
     def step(st: FusedState) -> FusedState:
         return FusedState(table=round_fn(st.table, run.seed, st.round),
@@ -247,7 +279,7 @@ def checkpointed_fused_planes(n: int, rumors: int, run: RunConfig,
     curve_fn = None
     if want_curve:
         def curve_fn(s):
-            return coverage_planes(s.table, n)
+            return cov_planes(s.table)
 
     remaining = max(0, run.max_rounds - int(state.round))
     out = run_with_checkpoints(step, state, remaining, path, every=every,
@@ -255,27 +287,32 @@ def checkpointed_fused_planes(n: int, rumors: int, run: RunConfig,
                                curve_prefix=curve_prefix,
                                extra_meta=extra_meta)
     final, curve = out if want_curve else (out, None)
-    cov = float(coverage_planes(final.table, n))
+    cov = float(cov_planes(final.table))
     return final, cov, curve
 
 
 def simulate_until_sharded_fused(n: int, rumors: int, run: RunConfig,
                                  mesh: Mesh, fanout: int = 1,
-                                 interpret: bool = False):
+                                 interpret: bool = False, fault=None):
     """(rounds, coverage, msgs, final_planes): compiled while_loop to
     min-over-rumors target coverage on the plane-sharded state.
 
     msgs counts transmissions (request + whole-digest response per
-    partner draw, all W words riding one exchange): 2*fanout*n/round."""
-    step = make_sharded_fused_round(n, mesh, fanout, interpret)
+    partner draw, all W words riding one exchange): 2*fanout*n/round.
+    ``fault`` threads the static fault masks into every plane's kernel;
+    the cond and the reported coverage switch to the alive-weighted
+    metric (fused_planes_cov_fn — one chooser for both)."""
+    step = make_sharded_fused_round(n, mesh, fanout, interpret,
+                                    fault=fault, origin=run.origin)
     init = init_plane_state(n, rumors, mesh, run.origin)
     target = jnp.float32(run.target_coverage)
+    cov_fn = fused_planes_cov_fn(n, fault, run.origin)
 
     @functools.partial(jax.jit, donate_argnums=0)
     def loop(planes):
         def cond(c):
             planes_c, round_c = c
-            return ((coverage_planes(planes_c, n) < target)
+            return ((cov_fn(planes_c) < target)
                     & (round_c < run.max_rounds))
 
         def body(c):
@@ -286,6 +323,6 @@ def simulate_until_sharded_fused(n: int, rumors: int, run: RunConfig,
 
     final, rounds = loop(init)
     rounds = int(rounds)
-    cov = float(coverage_planes(final, n))
+    cov = float(cov_fn(final))
     msgs = 2.0 * fanout * n * rounds
     return rounds, cov, msgs, final
