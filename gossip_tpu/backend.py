@@ -191,7 +191,7 @@ def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
         BITS, compiled_until_fused, compiled_until_fused_multirumor,
         coverage_node_packed, coverage_words, fused_table_bytes)
 
-    reason = _fused_ineligible_reason(proto, tc, fault, n_dev, want_curve)
+    reason = _fused_ineligible_reason(proto, tc, fault, n_dev)
     if reason is not None:
         raise ValueError(reason)
     # multi-device shards rumor PLANES, so the per-device table is always
@@ -202,25 +202,73 @@ def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
     n = tc.n
     if n_dev > 1:
         from gossip_tpu.parallel.sharded_fused import (
-            make_plane_mesh, plane_count, simulate_until_sharded_fused)
+            make_plane_mesh, plane_count, simulate_curve_sharded_fused,
+            simulate_until_sharded_fused)
         mesh = make_plane_mesh(n_dev)
         w = plane_count(proto.rumors, n_dev)
         t0 = time.perf_counter()
-        rounds, cov, msgs, final = simulate_until_sharded_fused(
-            n, proto.rumors, run, mesh, fanout=proto.fanout, fault=fault)
-        _jax.block_until_ready(final)
-        wall = time.perf_counter() - t0
-        hit = cov >= float(jnp.float32(run.target_coverage))
+        if want_curve:
+            # fixed-length scan (no early exit): rounds-to-target and
+            # the -1 sentinel derive from the curve like the XLA paths
+            covs, final = simulate_curve_sharded_fused(
+                n, proto.rumors, run, mesh, fanout=proto.fanout,
+                fault=fault)
+            _jax.block_until_ready(final)
+            wall = time.perf_counter() - t0
+            # _curve_summary reads only msgs[-1]; the fused accounting
+            # is the closed form 2*fanout*n per round over the full scan
+            rounds, cov, msgs, curve = _curve_summary(
+                covs, [2.0 * proto.fanout * n * run.max_rounds],
+                run.target_coverage)
+        else:
+            rounds_ex, cov, msgs, final = simulate_until_sharded_fused(
+                n, proto.rumors, run, mesh, fanout=proto.fanout,
+                fault=fault)
+            _jax.block_until_ready(final)
+            wall = time.perf_counter() - t0
+            hit = cov >= float(jnp.float32(run.target_coverage))
+            rounds, curve = (rounds_ex if hit else -1), None
         return RunReport(
             backend="jax-tpu", mode=proto.mode, n=n,
-            rounds=rounds if hit else -1, coverage=cov, msgs=msgs,
-            wall_s=round(wall, 4),
+            rounds=rounds, coverage=cov, msgs=msgs,
+            wall_s=round(wall, 4), curve=curve,
             meta={"clock": "rounds", "devices": n_dev,
                   "msgs_counts": "transmissions",
                   "engine": "fused-pallas-planes",
                   "layout": f"{w} rumor planes x one 32-rumor word per node",
                   "vmem_table_bytes_per_plane": table_bytes,
                   "ici_bytes_per_round": 0.0})
+
+    if want_curve:
+        from gossip_tpu.ops.pallas_round import (
+            compiled_curve_fused, compiled_curve_fused_multirumor)
+        if proto.rumors == 1:
+            scan, init = compiled_curve_fused(
+                n, seed=run.seed, fanout=proto.fanout,
+                max_rounds=run.max_rounds, origin=run.origin,
+                interpret=False, fault=fault)
+        else:
+            scan, init = compiled_curve_fused_multirumor(
+                n, proto.rumors, seed=run.seed, fanout=proto.fanout,
+                max_rounds=run.max_rounds, origin=run.origin,
+                interpret=False, fault=fault)
+        from gossip_tpu.utils.trace import maybe_aot_timed
+        timing: Dict[str, float] = {}
+        t0 = time.perf_counter()
+        final, covs = maybe_aot_timed(scan, timing, init)
+        wall = time.perf_counter() - t0
+        # the scanned state already accumulated the closed-form total
+        rounds, cov, msgs, curve = _curve_summary(
+            covs, [float(final.msgs)], run.target_coverage)
+        return RunReport(
+            backend="jax-tpu", mode=proto.mode, n=n, rounds=rounds,
+            coverage=cov, msgs=msgs, wall_s=round(wall, 4), curve=curve,
+            meta={"clock": "rounds", "devices": 1,
+                  "msgs_counts": "transmissions", "engine": "fused-pallas",
+                  "layout": ("node-packed bitmap" if proto.rumors == 1
+                             else "one 32-rumor word per node"),
+                  "vmem_table_bytes": table_bytes,
+                  **_timing_meta(timing)})
 
     if proto.rumors == 1:
         loop, init = compiled_until_fused(
@@ -260,8 +308,8 @@ def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
 
 
 def _fused_ineligible_reason(proto: ProtocolConfig, tc: TopologyConfig,
-                             fault: Optional[FaultConfig], n_dev: int,
-                             want_curve: bool) -> Optional[str]:
+                             fault: Optional[FaultConfig],
+                             n_dev: int) -> Optional[str]:
     """Why this run cannot use the fused Pallas engine, or None if it can.
 
     The ONE list of preconditions: engine='fused' raises it verbatim,
@@ -290,9 +338,10 @@ def _fused_ineligible_reason(proto: ProtocolConfig, tc: TopologyConfig,
         return (f"engine='fused' packs <= {BITS} rumors per word "
                 f"on one device (got rumors={proto.rumors}); "
                 "shard rumor planes with --devices")
-    if want_curve:
-        return ("engine='fused' runs a compiled while_loop with no "
-                "per-round curve capture; use engine='auto'")
+    # curve capture is no longer a restriction — round 4 added
+    # fixed-length scan twins of every fused driver (compiled_curve_*,
+    # simulate_curve_sharded_fused), so eligibility no longer consults
+    # want_curve at all
     try:
         check_fused_fits(tc.n, proto.rumors if n_dev == 1 else BITS,
                          proto.fanout)
@@ -336,10 +385,10 @@ def swim_scenario(proto: ProtocolConfig, n: int,
 
 
 def _fused_auto_ok(proto: ProtocolConfig, tc: TopologyConfig,
-                   fault: Optional[FaultConfig], want_curve: bool) -> bool:
+                   fault: Optional[FaultConfig]) -> bool:
     """True when a single-device run is eligible for the fused Pallas
     engine and it is safe to pick it silently under engine='auto'."""
-    return _fused_ineligible_reason(proto, tc, fault, 1, want_curve) is None
+    return _fused_ineligible_reason(proto, tc, fault, 1) is None
 
 
 def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
@@ -383,7 +432,7 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
     # Multi-device auto keeps the node-dim sharded engines (fused shards
     # rumor PLANES, a different scaling story the user opts into).
     if (run.engine == "auto" and n_dev == 1
-            and _fused_auto_ok(proto, tc, fault, want_curve)):
+            and _fused_auto_ok(proto, tc, fault)):
         rep = _run_fused(proto, tc, run, fault, 1, want_curve)
         rep.meta["engine_auto"] = "fused"
         return rep

@@ -411,8 +411,7 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
     fused = run.engine == "fused"
     if fused:
         from gossip_tpu.backend import _fused_ineligible_reason
-        reason = _fused_ineligible_reason(proto, tc, fault, n_dev,
-                                          want_curve=False)
+        reason = _fused_ineligible_reason(proto, tc, fault, n_dev)
         if reason is not None:
             print(f"error: {reason}", file=sys.stderr)
             return 2

@@ -668,6 +668,35 @@ def init_multirumor_state(n: int, rumors: int, origin: int = 0):
                       msgs=jnp.float32(0.0))
 
 
+def compiled_curve_fused(n: int, seed: int, fanout: int = 1,
+                         max_rounds: int = 128, origin: int = 0,
+                         interpret: bool = False, fault=None):
+    """(scan, init): fixed-length ``lax.scan`` over the fused
+    single-rumor kernel recording per-round coverage — the curve twin of
+    :func:`compiled_until_fused` (no early exit; rounds-to-target is
+    derived from the curve by the caller).  Same kernel, same fault
+    masks, same alive-weighted coverage chooser."""
+    drop_threshold = drop_threshold_for(fault)
+    has_alive = fault is not None and bool(fault.node_death_rate)
+    cov = fused_cov_fn(n, fault, origin)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def scan(st: FusedState):
+        def body(s, _):
+            alive_tab = (fault_masks_node_packed(fault, n, origin)[0]
+                         if has_alive else None)
+            tab = fused_pull_round(s.table, seed, s.round, n, fanout,
+                                   interpret,
+                                   drop_threshold=drop_threshold,
+                                   alive_table=alive_tab)
+            s2 = FusedState(table=tab, round=s.round + 1,
+                            msgs=s.msgs + 2.0 * fanout * n)
+            return s2, cov(s2.table)
+        return jax.lax.scan(body, st, None, length=max_rounds)
+
+    return scan, init_fused_state(n, origin)
+
+
 def compiled_until_fused_multirumor(n: int, rumors: int, seed: int,
                                     fanout: int = 1,
                                     target_coverage: float = 0.99,
@@ -702,6 +731,34 @@ def compiled_until_fused_multirumor(n: int, rumors: int, seed: int,
         return jax.lax.while_loop(cond, step, st)
 
     return loop, init_multirumor_state(n, rumors, origin)
+
+
+def compiled_curve_fused_multirumor(n: int, rumors: int, seed: int,
+                                    fanout: int = 1, max_rounds: int = 128,
+                                    origin: int = 0,
+                                    interpret: bool = False, fault=None):
+    """(scan, init): the curve twin of
+    :func:`compiled_until_fused_multirumor` — fixed-length scan
+    recording per-round min-over-rumors coverage (alive-weighted under
+    deaths)."""
+    drop_threshold = drop_threshold_for(fault)
+    has_alive = fault is not None and bool(fault.node_death_rate)
+    cov = fused_mr_cov_fn(n, rumors, fault, origin)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def scan(st: FusedState):
+        def body(s, _):
+            alive_words = (fault_masks_word(fault, n, origin)[0]
+                           if has_alive else None)
+            tab = fused_multirumor_pull_round(
+                s.table, seed, s.round, n, fanout, interpret,
+                drop_threshold=drop_threshold, alive_words=alive_words)
+            s2 = FusedState(table=tab, round=s.round + 1,
+                            msgs=s.msgs + 2.0 * fanout * n)
+            return s2, cov(s2.table)
+        return jax.lax.scan(body, st, None, length=max_rounds)
+
+    return scan, init_multirumor_state(n, rumors, origin)
 
 
 class FusedState(NamedTuple):

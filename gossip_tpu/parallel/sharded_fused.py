@@ -250,8 +250,8 @@ def checkpointed_fused_planes(n: int, rumors: int, run: RunConfig,
     of (seed, round), both in the config fingerprint / round counter.
 
     With ``want_curve`` the segments run as a scan recording
-    min-over-rumors coverage per round — the fused engine's while_loop
-    driver cannot capture curves, this driver can.  ``interpret`` is the
+    min-over-rumors coverage per round (alive-weighted under a fault,
+    like the non-checkpoint scan twins).  ``interpret`` is the
     CPU-interpreter path for tests (deterministic stubbed PRNG: resume
     bitwise-equality is still meaningful off-TPU).
 
@@ -289,6 +289,32 @@ def checkpointed_fused_planes(n: int, rumors: int, run: RunConfig,
     final, curve = out if want_curve else (out, None)
     cov = float(cov_planes(final.table))
     return final, cov, curve
+
+
+def simulate_curve_sharded_fused(n: int, rumors: int, run: RunConfig,
+                                 mesh: Mesh, fanout: int = 1,
+                                 interpret: bool = False, fault=None):
+    """(covs[max_rounds], final_planes): fixed-length scan over the
+    plane-sharded round recording per-round min-over-rumors coverage —
+    the curve twin of :func:`simulate_until_sharded_fused` (no early
+    exit; the caller derives rounds-to-target from the curve)."""
+    step = make_sharded_fused_round(n, mesh, fanout, interpret,
+                                    fault=fault, origin=run.origin)
+    init = init_plane_state(n, rumors, mesh, run.origin)
+    cov_fn = fused_planes_cov_fn(n, fault, run.origin)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def scan(planes):
+        def body(c, _):
+            planes_c, round_c = c
+            planes_n = step(planes_c, run.seed, round_c)
+            return (planes_n, round_c + 1), cov_fn(planes_n)
+        (final, _), covs = jax.lax.scan(body, (planes, jnp.int32(0)),
+                                        None, length=run.max_rounds)
+        return final, covs
+
+    final, covs = scan(init)
+    return covs, final
 
 
 def simulate_until_sharded_fused(n: int, rumors: int, run: RunConfig,

@@ -681,3 +681,43 @@ class TestHardwarePRNGFaultMasksMultirumor:
                 assert per_rumor[rr] >= 0.99, (rr, per_rumor[rr])
             else:
                 assert per_rumor[rr] == 0.0, (rr, per_rumor[rr])
+
+
+def test_compiled_curve_fused_matches_stepwise():
+    """The fixed-length curve scan is the SAME trajectory as stepping
+    the kernel by hand (stubbed interpreter PRNG is deterministic),
+    with the per-round coverage recorded — single-rumor and MR twins,
+    fault masks included."""
+    from gossip_tpu.config import FaultConfig
+    from gossip_tpu.ops.pallas_round import (
+        compiled_curve_fused, compiled_curve_fused_multirumor,
+        fault_masks_node_packed, fused_cov_fn, fused_mr_cov_fn)
+    n, rounds = 4096 * 8, 3
+    fault = FaultConfig(node_death_rate=0.25, seed=3)
+    scan, init = compiled_curve_fused(n, seed=0, max_rounds=rounds,
+                                      interpret=True, fault=fault)
+    final, covs = scan(init)
+    assert covs.shape == (rounds,) and int(final.round) == rounds
+    # stepwise twin
+    alive_tab, thresh = fault_masks_node_packed(fault, n, 0)
+    tab = init_fused_state(n, 0).table
+    cov = fused_cov_fn(n, fault, 0)
+    for t in range(rounds):
+        tab = fused_pull_round(tab, 0, t, n, 1, interpret=True,
+                               drop_threshold=thresh, alive_table=alive_tab)
+        assert float(covs[t]) == float(cov(tab)), t
+    np.testing.assert_array_equal(np.asarray(final.table), np.asarray(tab))
+
+    n_mr, r = 128 * 16, 8
+    scan_mr, init_mr = compiled_curve_fused_multirumor(
+        n_mr, r, seed=0, max_rounds=rounds, interpret=True)
+    final_mr, covs_mr = scan_mr(init_mr)
+    assert covs_mr.shape == (rounds,)
+    tab = init_multirumor_state(n_mr, r, 0).table
+    cov_mr = fused_mr_cov_fn(n_mr, r)
+    for t in range(rounds):
+        tab = fused_multirumor_pull_round(tab, 0, t, n_mr, 1,
+                                          interpret=True)
+        assert float(covs_mr[t]) == float(cov_mr(tab)), t
+    np.testing.assert_array_equal(np.asarray(final_mr.table),
+                                  np.asarray(tab))

@@ -185,3 +185,23 @@ def test_fused_planes_cov_fn_alive_weighting():
     got2 = float(fused_planes_cov_fn(n, drop_only)(planes))
     assert got2 == pytest.approx(float(coverage_planes(planes, n)),
                                  abs=1e-7)
+
+
+def test_simulate_curve_sharded_fused_matches_stepwise():
+    """The plane-sharded curve scan equals stepping the sharded round by
+    hand (stubbed interpreter PRNG), coverage recorded per round."""
+    from gossip_tpu.parallel.sharded_fused import (
+        fused_planes_cov_fn, simulate_curve_sharded_fused)
+    n, rumors, n_dev, rounds = 128 * 16, 128, 4, 3
+    mesh = make_plane_mesh(n_dev)
+    run = RunConfig(seed=0, max_rounds=rounds)
+    covs, final = simulate_curve_sharded_fused(n, rumors, run, mesh,
+                                               interpret=not ON_TPU)
+    assert covs.shape == (rounds,)
+    step = make_sharded_fused_round(n, mesh, interpret=not ON_TPU)
+    planes = init_plane_state(n, rumors, mesh, 0)
+    cov_fn = fused_planes_cov_fn(n)
+    for t in range(rounds):
+        planes = step(planes, 0, t)
+        assert float(covs[t]) == float(cov_fn(planes)), t
+    np.testing.assert_array_equal(np.asarray(final), np.asarray(planes))
