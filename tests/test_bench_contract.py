@@ -9,6 +9,8 @@ import importlib.util
 import json
 import os
 
+import pytest
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # repo-root module, not a package member: load by path so collection
 # works from any cwd (same pattern as test_backend_cli_rpc.py)
@@ -84,3 +86,32 @@ def test_print_hermetic_env_contract():
     assert "GOSSIP_COMPILE_CACHE" not in out
     for line in out.splitlines():
         assert line.startswith(("export ", "unset ")), line
+
+
+@pytest.mark.skipif(
+    os.environ.get("GOSSIP_TPU_TEST_PLATFORM", "cpu") != "cpu",
+    reason="the axon tier deliberately keeps the tunnel plugin armed")
+def test_conftest_disarms_tunnel_plugin_for_children():
+    """Wedge-immunity contract (round 4): for the CPU tier, conftest
+    scrubs the env that test-spawned subprocesses inherit — no
+    tunnel-arming vars, no sitecustomize-bearing PYTHONPATH entries —
+    so a mid-suite tunnel wedge cannot freeze child interpreters at
+    startup.  This test IS a child-env observer: it asserts the state
+    conftest promised."""
+    import subprocess
+    import sys
+    assert os.environ.get("PALLAS_AXON_POOL_IPS") is None
+    assert os.environ.get("JAX_PLATFORM_NAME") is None
+    assert os.environ.get("LIBTPU_INIT_ARGS") is None
+    for entry in os.environ.get("PYTHONPATH", "").split(os.pathsep):
+        if entry:
+            assert not os.path.exists(
+                os.path.join(entry, "sitecustomize.py")), entry
+    # and a real child sees the same scrubbed env + CPU platform
+    p = subprocess.run(
+        [sys.executable, "-c",
+         "import os; print(os.environ.get('PALLAS_AXON_POOL_IPS'), "
+         "os.environ.get('JAX_PLATFORMS'))"],
+        capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stderr
+    assert p.stdout.split() == ["None", "cpu"], (p.stdout, p.stderr)

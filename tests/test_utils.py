@@ -180,3 +180,4 @@ def test_run_with_checkpoints_named_curve_channels(tmp_path):
         run_with_checkpoints(step, st0, rounds=2,
                              path=str(tmp_path / "bad.npz"),
                              curve_fn=channels, curve_prefix=[0.5])
+
