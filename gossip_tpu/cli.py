@@ -689,6 +689,11 @@ def cmd_grid(a) -> int:
     from gossip_tpu.parallel.sweep import (SweepPoint, config_sweep_curves,
                                            config_sweep_curves_2d)
     from gossip_tpu.topology import generators as G
+    if any(r < 1 for r in a.rumors):
+        # 0 is SweepPoint's internal batch-default sentinel; letting it
+        # through would run 1 rumor while the summary prints 0
+        print("error: --rumors values must be >= 1", file=sys.stderr)
+        return 2
     families = a.families or [a.family]
     ns = a.ns or [a.n]
     run = RunConfig(target_coverage=a.target, max_rounds=a.max_rounds,
@@ -701,11 +706,11 @@ def cmd_grid(a) -> int:
     points = [
         SweepPoint(mode=m, fanout=f, drop_prob=d,
                    period=(p if m == "antientropy" else 1), seed=s,
-                   topo_idx=t)
+                   topo_idx=t, rumors=r)
         for t in range(len(fam_n))
         for m in a.modes for f in a.fanouts for d in a.drops
         for p in (a.periods if 'antientropy' in a.modes else [1])
-        for s in a.seeds]
+        for s in a.seeds for r in a.rumors]
     # periods multiply only anti-entropy points; dedupe the rest
     points = list(dict.fromkeys(points))
     topos = [G.build(TopologyConfig(family=f, n=n, k=a.k, p=a.p,
@@ -719,11 +724,10 @@ def cmd_grid(a) -> int:
         s, nd = a.pod_mesh
         mesh2d = make_hybrid_mesh(s, nd, axis_names=("sweep", "nodes"))
         res = config_sweep_curves_2d(points, topo_arg, run, mesh2d,
-                                     fault=fault, rumors=a.rumors)
+                                     fault=fault)
     elif a.devices > 1:
         from gossip_tpu.parallel.sharded import make_mesh
         res = config_sweep_curves(points, topo_arg, run, fault=fault,
-                                  rumors=a.rumors,
                                   mesh=make_mesh(a.devices,
                                                  axis_name="sweep"))
     else:
@@ -732,7 +736,7 @@ def cmd_grid(a) -> int:
         # batch when the grid is single-bucket)
         from gossip_tpu.parallel.sweep import config_sweep_curves_partitioned
         res = config_sweep_curves_partitioned(points, topo_arg, run,
-                                              fault=fault, rumors=a.rumors)
+                                              fault=fault)
     for i, summary in enumerate(res.summaries()):
         fam, n = fam_n[points[i].topo_idx]
         summary["n"] = n
@@ -840,7 +844,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "(overrides --n; explicit families only — "
                         "smaller graphs pad with inert phantom rows, "
                         "each point's coverage uses its own n)")
-    p.add_argument("--rumors", type=int, default=1)
+    p.add_argument("--rumors", nargs="+", type=int, default=[1],
+                   help="rumor counts to sweep; multiple values batch "
+                        "into the same program (the rumor axis pads to "
+                        "the max with inert all-false phantom columns, "
+                        "masked out of each point's coverage; 1-D grids "
+                        "only — the pod mesh takes one value)")
     p.add_argument("--family", default="complete",
                    choices=("complete", "ring", "grid", "erdos_renyi",
                             "watts_strogatz", "power_law"))

@@ -34,10 +34,17 @@ neighbors, masked out of liveness and coverage), so a families x sizes
 grid is ONE program — `grid --family ring --ns 1000 10000` compiles
 once (explicit families only — see _stack_topologies).  A point's
 curve equals its solo run bitwise on the real prefix (per-node draws
-are keyed by global id).  Still structural (a python loop over
-compiles, see cli.cmd_sweep): rumor count (it changes the state's R
-axis) and the implicit complete graph (its partner draw is bounded by
-a static n; its "table" is the bound itself).
+are keyed by global id).
+
+Later in round 4 the RUMOR axis joined them: per-point rumor counts
+(``SweepPoint.rumors``) pad the state's R axis to the batch max with
+ALL-FALSE phantom columns — never seeded, so they scatter nothing,
+gather nothing, and flip no ``sender_active`` bit (msgs and the real
+prefix stay bitwise equal to the solo run) — and the coverage min
+masks them out per point.  `grid --rumors 1 4` is one program.  The
+ONE remaining structural axis is the implicit complete graph (its
+partner draw is bounded by a static n; its "table" is the bound
+itself — cli.cmd_sweep documents the python loop).
 """
 
 from __future__ import annotations
@@ -158,6 +165,13 @@ def config_sweep_curves_2d(points, topo, run: RunConfig,
             "the 2-D pod sweep shards ONE node dimension; mixed-n "
             "phantom batching is the 1-D config_sweep_curves path — "
             "run the pod sweep per n")
+    eff_rumors_2d = {pt.rumors or rumors for pt in points}
+    if len(eff_rumors_2d) > 1:
+        raise ValueError(
+            "the 2-D pod sweep carries ONE rumor axis; mixed-rumor "
+            "phantom batching is the 1-D config_sweep_curves path — "
+            "run the pod sweep per rumor count")
+    rumors = eff_rumors_2d.pop()
     cN = len(points)
     p_sweep = mesh.shape[sweep_axis]
     if cN % p_sweep != 0:
@@ -320,6 +334,10 @@ class SweepPoint:
     period: int = 1          # anti-entropy cadence (1 = every round)
     seed: int = 0
     topo_idx: int = 0
+    rumors: int = 0          # 0 = the batch-level default (round 4:
+    #                          mixed rumor counts batch by padding to
+    #                          the max with inert all-false phantom
+    #                          columns, masked out of the coverage min)
 
     def __post_init__(self):
         if self.mode not in _MODE_FLAGS:
@@ -336,6 +354,8 @@ class SweepPoint:
                              "batched point must not silently differ")
         if self.topo_idx < 0:
             raise ValueError("topo_idx must be >= 0")
+        if self.rumors < 0:
+            raise ValueError("rumors must be >= 0 (0 = batch default)")
 
 
 @dataclasses.dataclass
@@ -539,9 +559,10 @@ def config_sweep_curves(points, topo, run: RunConfig,
                 "a shared draw would silently change trajectories; run "
                 "faulted points as a same-n batch")
         min_n = min(t.n for t in topos)
-        if run.origin + rumors > min_n:
+        worst_r = max((pt.rumors or rumors) for pt in points)
+        if run.origin + worst_r > min_n:
             raise ValueError(
-                f"origin {run.origin} + rumors {rumors} exceeds the "
+                f"origin {run.origin} + rumors {worst_r} exceeds the "
                 f"smallest n ({min_n}) in the batch: rumor r seeds node "
                 "(origin + r) % n, which would differ from the solo run "
                 "on the smaller graphs")
@@ -553,7 +574,17 @@ def config_sweep_curves(points, topo, run: RunConfig,
     if any(pt.fanout > k_max for pt in points):
         raise ValueError("k_max smaller than a point's fanout")
     cN = len(points)
-    proto_like = ProtocolConfig(mode=C.PUSH, fanout=k_max, rumors=rumors)
+    # Per-point rumor counts (round 4): pad the rumor axis to the batch
+    # max; a point's phantom columns are ALL-FALSE forever (no origin
+    # seed, so they never scatter, never gather, never flip a
+    # sender_active bit — msgs and the real prefix stay bitwise equal
+    # to the solo run) and are masked out of the coverage min (an inert
+    # all-true column would instead cap reported coverage at
+    # n*(1/n) != 1.0 in f32 on non-dyadic n).
+    eff_rumors = [pt.rumors or rumors for pt in points]
+    r_max = max(eff_rumors)
+    mixed_rumors = len(set(eff_rumors)) > 1
+    proto_like = ProtocolConfig(mode=C.PUSH, fanout=k_max, rumors=r_max)
     if multi:
         tables = _stack_topologies(topos)
     else:
@@ -598,7 +629,15 @@ def config_sweep_curves(points, topo, run: RunConfig,
                        in_axes=(0,) * 12 + (None,) * len(tables))
 
     base = init_state(run, proto_like, n)
-    init_seen = jnp.broadcast_to(base.seen, (cN,) + base.seen.shape)
+    if mixed_rumors:
+        # zero the phantom columns per point in ONE broadcasted where
+        # (base seeds all r_max origins; a point with fewer rumors must
+        # not seed the rest)
+        colr = jnp.arange(r_max)[None, None, :]
+        ers = jnp.asarray(eff_rumors, jnp.int32)[:, None, None]
+        init_seen = jnp.where(colr < ers, base.seen[None], False)
+    else:
+        init_seen = jnp.broadcast_to(base.seen, (cN,) + base.seen.shape)
     keys = jax.vmap(jax.random.key)(
         jnp.asarray([pt.seed for pt in points], jnp.uint32))
     do_push = jnp.asarray([_MODE_FLAGS[pt.mode][0] for pt in points])
@@ -609,42 +648,56 @@ def config_sweep_curves(points, topo, run: RunConfig,
     periods = jnp.asarray([pt.period for pt in points], jnp.int32)
     tidxs = jnp.asarray([pt.topo_idx for pt in points], jnp.int32)
     n_pts = jnp.asarray([topos[pt.topo_idx].n for pt in points], jnp.int32)
+    rum_pts = jnp.asarray(eff_rumors, jnp.int32)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
         row = NamedSharding(mesh, P(axis_name))
         init_seen = jax.device_put(
             init_seen, NamedSharding(mesh, P(axis_name, None, None)))
         keys = jax.device_put(keys, row)
-        do_push, do_pull, do_ae, fanouts, drops, periods, tidxs, n_pts = (
+        (do_push, do_pull, do_ae, fanouts, drops, periods, tidxs, n_pts,
+         rum_pts) = (
             jax.device_put(x, row)
             for x in (do_push, do_pull, do_ae, fanouts, drops, periods,
-                      tidxs, n_pts))
+                      tidxs, n_pts, rum_pts))
 
     @jax.jit
     def scan(seen, rounds, keys, msgs, *tbl):
         alive = alive_mask(fault, n, run.origin)
-        if ragged:
-            def cov_fn(x, n_pt):
-                # per-point divisor: phantom rows are masked, coverage
-                # is over the point's OWN n real rows.  The count is an
-                # exact f32 integer; multiplying by the f32 reciprocal
-                # (not true division) reproduces jnp.mean's lowering in
-                # the solo path BIT FOR BIT (tests assert curve equality
-                # with solo runs, and div vs recip-mul differ by 1 ulp)
+        colr = jnp.arange(r_max)
+
+        def cov_fn(x, n_pt, r_pt):
+            # One coverage body for every batching shape, ops chosen to
+            # reproduce the solo paths BIT FOR BIT (tests assert curve
+            # equality with solo runs):
+            #  * ragged n — per-point divisor via recip-MUL, matching
+            #    jnp.mean's lowering (true division differs by 1 ulp);
+            #  * uniform n — models/si.coverage's exact expressions;
+            #  * mixed rumors — phantom columns masked out of the min
+            #    (they are all-false, so unmasked they would win it).
+            if ragged:
                 gids = jnp.arange(n, dtype=jnp.int32)
                 w = (gids < n_pt).astype(jnp.float32)
                 counts = jnp.sum(x.astype(jnp.float32) * w[:, None],
                                  axis=0)
-                return jnp.min(counts * (1.0 / n_pt.astype(jnp.float32)))
-            cov_all = jax.vmap(cov_fn)
-        else:
-            cov_all = jax.vmap(lambda x: coverage(x, alive))
+                vals = counts * (1.0 / n_pt.astype(jnp.float32))
+            elif alive is None:
+                vals = jnp.mean(x.astype(jnp.float32), axis=0)
+            else:
+                w = alive.astype(jnp.float32)
+                vals = (x.astype(jnp.float32) * w[:, None]).sum(0) / w.sum()
+            if mixed_rumors:
+                vals = jnp.where(colr < r_pt, vals, 2.0)
+            return jnp.min(vals)
+
+        cov_all = jax.vmap(cov_fn)
+
         def body(carry, _):
             seen, rounds, msgs = carry
             seen, rounds, msgs = batched(seen, rounds, keys, msgs, do_push,
                                          do_pull, do_ae, fanouts, drops,
                                          periods, tidxs, n_pts, *tbl)
-            covs = cov_all(seen, n_pts) if ragged else cov_all(seen)
+            covs = cov_all(seen, n_pts, rum_pts)
             return (seen, rounds, msgs), (covs, msgs)
         return jax.lax.scan(body, (seen, rounds, msgs), None,
                             length=run.max_rounds)

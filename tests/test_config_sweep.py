@@ -376,3 +376,56 @@ def test_n_axis_validation():
         config_sweep_curves(
             [SweepPoint(), SweepPoint(topo_idx=1)], topos,
             RunConfig(max_rounds=4, origin=255), rumors=2)
+
+
+def test_mixed_rumor_batch_matches_solo_bitwise():
+    """The rumor axis (round 4): points with DIFFERENT rumor counts batch
+    into one program by padding R to the max with all-false phantom
+    columns.  Each point's curve AND msgs must equal the solo batch of
+    just that point at its own rumor count — bitwise, since phantom
+    columns never scatter, never gather, and never flip sender_active."""
+    n = 384
+    topo = G.complete(n)
+    run = RunConfig(seed=5, max_rounds=16, target_coverage=0.999)
+    pts = [SweepPoint(mode=C.PUSH, fanout=1, seed=3, rumors=1),
+           SweepPoint(mode=C.PULL, fanout=2, seed=4, rumors=3),
+           SweepPoint(mode=C.PUSH_PULL, fanout=1, seed=5, rumors=2),
+           SweepPoint(mode=C.ANTI_ENTROPY, fanout=1, period=2, seed=6,
+                      rumors=4)]
+    batch = config_sweep_curves(pts, topo, run, k_max=2)
+    for i, pt in enumerate(pts):
+        solo = config_sweep_curves([pt], topo, run, k_max=2,
+                                   rumors=pt.rumors)
+        np.testing.assert_array_equal(batch.curves[i], solo.curves[0],
+                                      err_msg=f"point {i}")
+        np.testing.assert_array_equal(batch.msgs[i], solo.msgs[0],
+                                      err_msg=f"point {i} msgs")
+    # summaries carry the per-point rumor count
+    assert [s["point"]["rumors"] for s in batch.summaries()] == [1, 3, 2, 4]
+
+
+def test_mixed_rumor_batch_composes_with_mixed_n():
+    """Both phantom axes at once: a (sizes x rumor-counts) grid in one
+    program, each cell bitwise equal to its solo run."""
+    topos = [G.ring(96, k=4), G.ring(160, k=4)]
+    run = RunConfig(seed=2, max_rounds=24, target_coverage=0.999)
+    pts = [SweepPoint(mode=C.PUSH, fanout=1, seed=1, topo_idx=t, rumors=r)
+           for t in (0, 1) for r in (1, 3)]
+    batch = config_sweep_curves(pts, topos, run, k_max=1)
+    for i, pt in enumerate(pts):
+        solo = config_sweep_curves([pt], topos, run, k_max=1,
+                                   rumors=pt.rumors)
+        np.testing.assert_array_equal(batch.curves[i], solo.curves[0],
+                                      err_msg=f"cell {i}")
+        np.testing.assert_array_equal(batch.msgs[i], solo.msgs[0],
+                                      err_msg=f"cell {i} msgs")
+
+
+def test_2d_pod_sweep_rejects_mixed_rumors():
+    from gossip_tpu.parallel.multislice import make_hybrid_mesh
+    mesh2d = make_hybrid_mesh(2, 4, axis_names=("sweep", "nodes"))
+    pts = [SweepPoint(mode=C.PUSH, seed=s, rumors=r)
+           for s, r in ((0, 1), (1, 2))]
+    with pytest.raises(ValueError, match="ONE rumor axis"):
+        config_sweep_curves_2d(pts, G.complete(128),
+                               RunConfig(max_rounds=4), mesh2d)
