@@ -841,9 +841,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--n", type=int, default=4096)
     p.add_argument("--ns", nargs="+", type=int, default=None,
                    help="sweep MULTIPLE graph sizes in the same program "
-                        "(overrides --n; explicit families only — "
-                        "smaller graphs pad with inert phantom rows, "
-                        "each point's coverage uses its own n)")
+                        "(overrides --n; smaller graphs pad with inert "
+                        "phantom rows — or, on the implicit complete "
+                        "graph, bound each point's partner draw by its "
+                        "own traced n — and each point's coverage uses "
+                        "its own n)")
     p.add_argument("--rumors", nargs="+", type=int, default=[1],
                    help="rumor counts to sweep; multiple values batch "
                         "into the same program (the rumor axis pads to "

@@ -53,15 +53,25 @@ def apply_drop(round_key: jax.Array, tag: int, global_ids: jax.Array,
 
 
 def sample_peers_complete(round_key: jax.Array, global_ids: jax.Array,
-                          n_total: int, k: int,
+                          n_total, k: int,
                           exclude_self: bool = True) -> jax.Array:
     """Uniform peers on the implicit complete graph -> int32[len(ids), k].
 
     Self-exclusion uses the shift trick (draw from n-1, bump >= self) so no
-    rejection loop is needed.
+    rejection loop is needed.  ``n_total`` may be a TRACED scalar (the
+    mixed-n config sweep passes each point's own n as an operand, one
+    program for all sizes); ``jax.random.randint`` takes traced bounds
+    and its draw depends only on the bound's VALUE, so a traced bound
+    reproduces the static-bound solo trajectory bitwise.  Traced bounds
+    require n_total >= 2 when excluding self (the static path keeps the
+    n==1 degenerate-case guard).
     """
     keys = node_keys(round_key, global_ids)
-    if exclude_self and n_total > 1:
+    # value check for ANY static integer (python or numpy scalar);
+    # only a traced bound skips it (callers guarantee n >= 2 there)
+    degenerate = (not isinstance(n_total, jax.core.Tracer)
+                  and int(n_total) <= 1)
+    if exclude_self and not degenerate:
         def one(key, i):
             r = jax.random.randint(key, (k,), 0, n_total - 1, dtype=jnp.int32)
             return r + (r >= i).astype(jnp.int32)

@@ -41,10 +41,16 @@ Later in round 4 the RUMOR axis joined them: per-point rumor counts
 ALL-FALSE phantom columns — never seeded, so they scatter nothing,
 gather nothing, and flip no ``sender_active`` bit (msgs and the real
 prefix stay bitwise equal to the solo run) — and the coverage min
-masks them out per point.  `grid --rumors 1 4` is one program.  The
-ONE remaining structural axis is the implicit complete graph (its
-partner draw is bounded by a static n; its "table" is the bound
-itself — cli.cmd_sweep documents the python loop).
+masks them out per point.  `grid --rumors 1 4` is one program.
+
+Finally, mixed-n IMPLICIT (complete-graph) batches joined too: a
+complete graph has no table to stack, so each point's uniform partner
+draw is bounded by its own n as a TRACED operand
+(ops/sampling.sample_peers_complete) — randint's draw depends only on
+the bound's value, so the solo static-bound trajectory reproduces
+bitwise.  The one structural split left is implicit-vs-explicit:
+stacked tables and traced bounds are different programs, so a batch
+must be one kind or the other (each batches fully within its kind).
 """
 
 from __future__ import annotations
@@ -62,7 +68,8 @@ from gossip_tpu.models import si as si_mod
 from gossip_tpu.models.si import coverage, make_si_round
 from gossip_tpu.models.state import SimState, alive_mask, init_state
 from gossip_tpu.ops.propagate import pull_merge, push_counts
-from gossip_tpu.ops.sampling import drop_mask, sample_peers
+from gossip_tpu.ops.sampling import (drop_mask, sample_peers,
+                                     sample_peers_complete)
 from gossip_tpu.topology.generators import Topology
 
 
@@ -390,7 +397,7 @@ def _drop_targets(rkey, tag, gids, targets, drop_prob, sentinel):
 def _sweep_round_delta(rkey, round_, gids, visible, alive_l, topo, k_max,
                        nbrs, deg, do_push, do_pull, do_ae, fanout, dropp,
                        period, have_ae, scatter_n, count_reduce, gather,
-                       need_push=True, need_pull=True):
+                       need_push=True, need_pull=True, peer_bound=None):
     """The ONE per-config sweep round body — shared by the single-device
     batch and the 2-D pod sweep, which differ only in how scatter counts
     reduce (``count_reduce``), how the digest table is assembled
@@ -403,17 +410,28 @@ def _sweep_round_delta(rkey, round_, gids, visible, alive_l, topo, k_max,
     instead of being computed and masked.  Eliding a half cannot change
     the other half's trajectory: the halves draw from disjoint RNG tags
     (PUSH_TAG/PUSH_DROP_TAG vs PULL_TAG/PULL_DROP_TAG), same pattern as
-    the ``have_ae`` elision of the reverse delta."""
+    the ``have_ae`` elision of the reverse delta.
+
+    ``peer_bound`` (mixed-n IMPLICIT batches): the point's own n as a
+    traced scalar, bounding its uniform partner draw on the complete
+    graph — randint with a traced bound reproduces the solo static-n
+    draw bitwise (sample_peers_complete).  None keeps the static
+    ``topo.n`` path, byte-identical to the pre-round-4 lowering."""
     n = topo.n
     col = jnp.arange(k_max, dtype=jnp.int32)[None, :]
     delta = jnp.zeros_like(visible)
     msgs = jnp.float32(0.0)
 
+    def _peers(key):
+        if peer_bound is not None:
+            return sample_peers_complete(key, gids, peer_bound, k_max, True)
+        return sample_peers(key, gids, topo, k_max, True,
+                            local_nbrs=nbrs, local_deg=deg)
+
     if need_push:
         # push half (masked by do_push for non-push configs in the batch)
         pkey = jax.random.fold_in(rkey, si_mod.PUSH_TAG)
-        targets = sample_peers(pkey, gids, topo, k_max, True,
-                               local_nbrs=nbrs, local_deg=deg)
+        targets = _peers(pkey)
         targets = jnp.where(col < fanout, targets, jnp.int32(n))
         targets = _drop_targets(rkey, si_mod.PUSH_DROP_TAG, gids, targets,
                                 dropp, n)
@@ -428,8 +446,7 @@ def _sweep_round_delta(rkey, round_, gids, visible, alive_l, topo, k_max,
         # pull half (anti-entropy = bidirectional exchange gated by period)
         seen_all = gather(visible)
         qkey = jax.random.fold_in(rkey, si_mod.PULL_TAG)
-        partners = sample_peers(qkey, gids, topo, k_max, True,
-                                local_nbrs=nbrs, local_deg=deg)
+        partners = _peers(qkey)
         partners = jnp.where(col < fanout, partners, jnp.int32(n))
         partners = _drop_targets(rkey, si_mod.PULL_DROP_TAG, gids, partners,
                                  dropp, n)
@@ -546,6 +563,12 @@ def config_sweep_curves(points, topo, run: RunConfig,
             f"mesh axis of size {mesh.shape[axis_name]}; pad the batch "
             "(duplicate a point) or change the mesh")
     topos, multi, topo0 = _normalize_topos(topo, points)
+    all_implicit = all(t.implicit for t in topos)
+    if multi and not all_implicit and any(t.implicit for t in topos):
+        raise ValueError(
+            "a topology batch mixes implicit (complete) and explicit "
+            "entries; the stacked-table operand and the traced-bound "
+            "draw are different programs — batch them separately")
     n = max(t.n for t in topos)
     ragged = multi and any(t.n != n for t in topos)
     if ragged:
@@ -585,10 +608,18 @@ def config_sweep_curves(points, topo, run: RunConfig,
     r_max = max(eff_rumors)
     mixed_rumors = len(set(eff_rumors)) > 1
     proto_like = ProtocolConfig(mode=C.PUSH, fanout=k_max, rumors=r_max)
-    if multi:
+    if multi and not all_implicit:
         tables = _stack_topologies(topos)
+    elif topo0.implicit:
+        # mixed-n COMPLETE graphs (round 4, the last structural axis):
+        # no table to stack — each point's uniform draw is bounded by
+        # its own n as a traced operand (sample_peers_complete)
+        tables = ()
+        if ragged and min(t.n for t in topos) < 2:
+            raise ValueError("mixed-n complete batches need every "
+                             "n >= 2 (the traced self-exclusion bound)")
     else:
-        tables = () if topo0.implicit else (topo0.nbrs, topo0.deg)
+        tables = (topo0.nbrs, topo0.deg)
     have_ae = any(pt.mode == C.ANTI_ENTROPY for pt in points)
     # static half-elision (VERDICT r2 item 7): a pure-push (resp. pure-
     # pull) batch never builds the other half.  _force_both is a
@@ -601,7 +632,7 @@ def config_sweep_curves(points, topo, run: RunConfig,
     def one_round(seen, round_, base_key, msgs,
                   do_push, do_pull, do_ae, fanout, dropp, period, tidx,
                   n_pt, *tbl):
-        if multi:
+        if multi and tbl:
             # per-config family: one dynamic slice out of the stacked
             # table operand (tables are jit arguments — DESIGN.md §6)
             nbrs, deg = tbl[0][tidx], tbl[1][tidx]
@@ -613,8 +644,11 @@ def config_sweep_curves(points, topo, run: RunConfig,
         alive_b = jnp.ones((n,), jnp.bool_) if alive is None else alive
         if ragged:
             # phantom rows past this point's own n are never alive —
-            # they cannot send, receive, or count (their table rows are
-            # already degree-0/sentinel, this is the second lock)
+            # they cannot send, receive, or count.  For explicit tables
+            # this is the second lock (their rows are already degree-0/
+            # sentinel); for the tableless implicit case it is the ONLY
+            # lock — the traced-bound draw targets [0, n_pt) but phantom
+            # SENDERS exist, and this mask is what silences them.
             alive_b = alive_b & (gids < n_pt)
         rkey = jax.random.fold_in(base_key, round_)
         visible = seen & alive_b[:, None]
@@ -622,7 +656,8 @@ def config_sweep_curves(points, topo, run: RunConfig,
             rkey, round_, gids, visible, alive_b, topo0, k_max, nbrs, deg,
             do_push, do_pull, do_ae, fanout, dropp, period, have_ae,
             scatter_n=n, count_reduce=lambda c: c, gather=lambda v: v,
-            need_push=need_push, need_pull=need_pull)
+            need_push=need_push, need_pull=need_pull,
+            peer_bound=(n_pt if (ragged and topo0.implicit) else None))
         return seen | delta, round_ + 1, msgs + msgs_round
 
     batched = jax.vmap(one_round,

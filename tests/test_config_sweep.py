@@ -1,5 +1,7 @@
 """Tests for the batched config sweep (parallel/sweep.config_sweep_curves)."""
 
+import dataclasses
+
 import jax
 import numpy as np
 import pytest
@@ -429,3 +431,56 @@ def test_2d_pod_sweep_rejects_mixed_rumors():
     with pytest.raises(ValueError, match="ONE rumor axis"):
         config_sweep_curves_2d(pts, G.complete(128),
                                RunConfig(max_rounds=4), mesh2d)
+
+
+def test_mixed_n_complete_batch_matches_solo_bitwise():
+    """The last structural axis (round 4): mixed-n IMPLICIT batches.
+    Complete graphs have no table to stack; each point's uniform draw is
+    bounded by its own n as a traced operand, and randint's draw depends
+    only on the bound's VALUE — so every cell of a sizes batch equals
+    its solo run bitwise, msgs included."""
+    topos = [G.complete(96), G.complete(160), G.complete(257)]
+    run = RunConfig(seed=7, max_rounds=14, target_coverage=0.999)
+    pts = [SweepPoint(mode=m, fanout=1, seed=4 + t, topo_idx=t)
+           for t in range(3) for m in (C.PUSH, C.PULL)]
+    batch = config_sweep_curves(pts, topos, run, k_max=1)
+    for i, pt in enumerate(pts):
+        solo = config_sweep_curves([pt], topos, run, k_max=1)
+        np.testing.assert_array_equal(batch.curves[i], solo.curves[0],
+                                      err_msg=f"cell {i}")
+        np.testing.assert_array_equal(batch.msgs[i], solo.msgs[0],
+                                      err_msg=f"cell {i} msgs")
+    # ... and equals the plain single-topology batch at that n — the
+    # TRUE static-bound program — for a PUSH and a PULL cell, curves
+    # AND msgs (the traced-bound lowering must match the constant-bound
+    # lowering on both halves and on the accounting)
+    for i, pt in ((0, pts[0]), (3, pts[3])):
+        assert (pt.mode, pt.topo_idx) in ((C.PUSH, 0), (C.PULL, 1))
+        one = config_sweep_curves(
+            [dataclasses.replace(pt, topo_idx=0)],
+            topos[pt.topo_idx], run, k_max=1)
+        np.testing.assert_array_equal(batch.curves[i], one.curves[0],
+                                      err_msg=f"static cell {i}")
+        np.testing.assert_array_equal(batch.msgs[i], one.msgs[0],
+                                      err_msg=f"static cell {i} msgs")
+
+
+def test_mixed_n_complete_composes_with_mixed_rumors():
+    topos = [G.complete(96), G.complete(200)]
+    run = RunConfig(seed=3, max_rounds=12, target_coverage=0.999)
+    pts = [SweepPoint(mode=C.PUSH_PULL, fanout=1, seed=9, topo_idx=t,
+                      rumors=r)
+           for t in (0, 1) for r in (1, 3)]
+    batch = config_sweep_curves(pts, topos, run, k_max=1)
+    for i, pt in enumerate(pts):
+        solo = config_sweep_curves([pt], topos, run, k_max=1,
+                                   rumors=pt.rumors)
+        np.testing.assert_array_equal(batch.curves[i], solo.curves[0],
+                                      err_msg=f"cell {i}")
+
+
+def test_implicit_explicit_topology_mix_rejected():
+    with pytest.raises(ValueError, match="mixes implicit"):
+        config_sweep_curves(
+            [SweepPoint(seed=0), SweepPoint(seed=1, topo_idx=1)],
+            [G.complete(64), G.ring(64, k=2)], RunConfig(max_rounds=4))
