@@ -404,6 +404,12 @@ def test_mixed_rumor_batch_matches_solo_bitwise():
                                       err_msg=f"point {i} msgs")
     # summaries carry the per-point rumor count
     assert [s["point"]["rumors"] for s in batch.summaries()] == [1, 3, 2, 4]
+    # sharding the config axis never changes values (the rum_pts operand
+    # rides the same row sharding as the other per-point scalars)
+    meshed = config_sweep_curves(pts, topo, run, k_max=2,
+                                 mesh=make_mesh(4, axis_name="sweep"))
+    np.testing.assert_array_equal(meshed.curves, batch.curves)
+    np.testing.assert_array_equal(meshed.msgs, batch.msgs)
 
 
 def test_mixed_rumor_batch_composes_with_mixed_n():
