@@ -384,6 +384,17 @@ def swim_scenario(proto: ProtocolConfig, n: int,
     return dead, fail_round, default_scenario
 
 
+def swim_scenario_meta(proto: ProtocolConfig, n: int,
+                       fault: Optional[FaultConfig]):
+    """(dead, fail_round, meta) — the scenario plus the discoverability
+    meta keys EVERY swim driver reports (streaming, checkpointed,
+    ensemble), so the three surfaces cannot drift."""
+    dead, fail_round, default_scenario = swim_scenario(proto, n, fault)
+    meta = {"metric": "detection_fraction", "dead_subjects": list(dead),
+            "fail_round": fail_round, "default_scenario": default_scenario}
+    return dead, fail_round, meta
+
+
 def _fused_auto_ok(proto: ProtocolConfig, tc: TopologyConfig,
                    fault: Optional[FaultConfig]) -> bool:
     """True when a single-device run is eligible for the fused Pallas
@@ -445,15 +456,12 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
         if n_dev > 1:
             from gossip_tpu.parallel.sharded import make_mesh
             mesh = make_mesh(n_dev)
-        dead, fail_round, default_scenario = swim_scenario(proto, tc.n,
-                                                          fault)
+        dead, fail_round, meta = swim_scenario_meta(proto, tc.n, fault)
         swim_topo = None if tc.family == "complete" else topo
-        meta = {"clock": "rounds", "metric": "detection_fraction",
-                "dead_subjects": list(dead), "fail_round": fail_round,
-                "default_scenario": default_scenario,
-                "suggested_suspect_rounds":
-                    suggested_suspect_rounds(tc.n, proto.fanout),
-                "devices": n_dev}
+        meta.update({"clock": "rounds",
+                     "suggested_suspect_rounds":
+                         suggested_suspect_rounds(tc.n, proto.fanout),
+                     "devices": n_dev})
         if proto.swim_rotate:
             meta["subject_window"] = "rotating"
             meta["epoch_rounds"] = resolve_epoch_rounds(proto, tc.n)

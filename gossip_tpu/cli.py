@@ -172,7 +172,9 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                    help="write the coverage curve as JSONL (implies --curve)")
     p.add_argument("--ensemble", type=int, default=0, metavar="S",
                    help="run S seeds as one vmapped batch and report "
-                        "ensemble statistics (jax-tpu, non-swim)")
+                        "ensemble statistics (jax-tpu; for swim this "
+                        "is the detection-latency distribution of one "
+                        "failure scenario across seeds)")
     p.add_argument("--swim-subjects", type=int, default=8)
     p.add_argument("--swim-proxies", type=int, default=3)
     p.add_argument("--swim-suspect-rounds", type=int, default=0,
@@ -241,10 +243,16 @@ def cmd_run(a) -> int:
               "shapes; pick one", file=sys.stderr)
         return 2
     if a.ensemble > 1:
-        if a.backend != "jax-tpu" or a.mode == "swim":
-            print("error: --ensemble needs the jax-tpu backend and a "
-                  "non-swim mode (SWIM's detection metric has no "
-                  "seed-ensemble form)", file=sys.stderr)
+        if a.backend != "jax-tpu":
+            print("error: --ensemble needs the jax-tpu backend",
+                  file=sys.stderr)
+            return 2
+        if a.devices > 1:
+            # the seed axis IS the batch dimension here; a node mesh
+            # would be silently dropped otherwise (no-silent-drop
+            # policy — shard the config axis with `grid` instead)
+            print("error: --ensemble is single-device (the seed axis is "
+                  "the vmap batch); drop --devices", file=sys.stderr)
             return 2
         if run.engine == "fused":
             # never silently substitute the XLA kernels for a requested
@@ -253,20 +261,44 @@ def cmd_run(a) -> int:
                   "--engine fused is single-run only", file=sys.stderr)
             return 2
         from gossip_tpu.parallel.sweep import (ensemble_curves,
-                                               ensemble_rumor_curves)
+                                               ensemble_rumor_curves,
+                                               ensemble_swim_curves)
         from gossip_tpu.topology import generators as G
         seeds = [run.seed + i for i in range(a.ensemble)]
+        out_extra = {}
         with trace(a.profile):
             if a.mode == "rumor":
                 # SIR: residue/extinction DISTRIBUTIONS across seeds (the
                 # Demers-table form of the result)
                 ens = ensemble_rumor_curves(proto, G.build(tc), run,
                                             seeds, fault)
+            elif a.mode == "swim":
+                # detection-latency distribution for one failure
+                # scenario across seeds (round 4; probe/proxy/fan-out
+                # draws redraw per seed) — rounds_to_target is
+                # rounds-to-DETECTION here
+                from gossip_tpu.backend import swim_scenario_meta
+                dead, fail_round, out_extra = swim_scenario_meta(
+                    proto, tc.n, fault)
+                swim_topo = (None if tc.family == "complete"
+                             else G.build(tc))
+                ens = ensemble_swim_curves(proto, tc.n, run, seeds,
+                                           dead_nodes=dead,
+                                           fail_round=fail_round,
+                                           fault=fault, topo=swim_topo)
+                if proto.swim_rotate:
+                    # rotation: detection drops after the window leaves
+                    # the dead node's epoch, so the headline is the
+                    # per-seed PEAK (same contract as the solo drivers)
+                    peaks = ens.curves.max(axis=1)
+                    out_extra["subject_window"] = "rotating"
+                    out_extra["peak_detection_mean"] = float(peaks.mean())
+                    out_extra["peak_detection_min"] = float(peaks.min())
             else:
                 ens = ensemble_curves(proto, G.build(tc), run, seeds,
                                       fault)
         out = {"ensemble": ens.summary(), "mode": a.mode, "n": tc.n,
-               "backend": a.backend}
+               "backend": a.backend, **out_extra}
         if a.profile:
             out["profile_logdir"] = a.profile
         if a.save_curve:

@@ -844,6 +844,63 @@ class RumorEnsembleResult:
         }
 
 
+def ensemble_swim_curves(proto: ProtocolConfig, n: int, run: RunConfig,
+                         seeds: Sequence[int], dead_nodes=(),
+                         fail_round: int = 0,
+                         fault: Optional[FaultConfig] = None,
+                         topo: Optional[Topology] = None) -> EnsembleResult:
+    """|seeds| independent SWIM failure-detection trajectories as ONE
+    batched XLA program — the detection-LATENCY distribution for a fixed
+    failure scenario across PRNG seeds (probe targets, proxy choices,
+    and dissemination fan-outs all redraw per seed), which is the
+    operational question SWIM answers ("how long until the cluster
+    knows?").  Per-seed curves are bitwise identical to solo
+    runtime/simulator.simulate_swim_curve runs with the same seed
+    (tested); ``curves`` carries the per-round detection fraction, so
+    ``rounds_to_target`` is rounds-to-detection."""
+    from gossip_tpu.models import swim as SW
+    dead = tuple(dead_nodes)
+    step, tables = SW.make_swim_round(proto, n, dead, fail_round, fault,
+                                      topo, tabled=True,
+                                      max_rounds=run.max_rounds)
+    base = SW.init_swim_state(n, proto.swim_subjects, 0)
+    keys = jax.vmap(jax.random.key)(jnp.asarray(list(seeds), jnp.uint32))
+    s = len(seeds)
+    init = SW.SwimState(
+        wire=jnp.broadcast_to(base.wire, (s,) + base.wire.shape),
+        timer=jnp.broadcast_to(base.timer, (s,) + base.timer.shape),
+        round=jnp.zeros((s,), jnp.int32),
+        base_key=keys,
+        msgs=jnp.zeros((s,), jnp.float32),
+    )
+    rotate = proto.swim_rotate
+    epoch_rounds = SW.resolve_epoch_rounds(proto, n)
+
+    @jax.jit
+    def scan(states, *tbl):
+        alive_obs = SW.base_alive(n, dead, fault)
+
+        def detection(st):
+            window = SW.subject_window(st.round - 1, proto.swim_subjects,
+                                       n, rotate, epoch_rounds)
+            return SW.detection_fraction(
+                SW.SwimState(st.wire[:n], st.timer[:n], st.round,
+                             st.base_key, st.msgs), dead,
+                alive_obs, subj_gids=window) if dead else jnp.float32(0.0)
+
+        def body(st, _):
+            st = jax.vmap(lambda x: step(x, *tbl))(st)
+            return st, (jax.vmap(detection)(st), st.msgs)
+        return jax.lax.scan(body, states, None, length=run.max_rounds)
+
+    _, (dets, msgs) = scan(init, *tables)
+    curves = np.asarray(dets).T
+    return EnsembleResult(curves=curves, msgs=np.asarray(msgs).T,
+                          rounds_to_target=_rounds_to_target(
+                              curves, run.target_coverage),
+                          target=run.target_coverage)
+
+
 def ensemble_rumor_curves(proto: ProtocolConfig, topo: Topology,
                           run: RunConfig, seeds: Sequence[int],
                           fault: Optional[FaultConfig] = None

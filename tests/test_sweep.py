@@ -47,3 +47,30 @@ def test_ensemble_with_faults_some_may_stall():
     # a ring with 20% dead nodes cannot reach full alive-coverage in 8
     # rounds from one origin; -1 entries must be well-formed
     assert set(ens.rounds_to_target) <= {-1} | set(range(1, 9))
+
+
+def test_ensemble_swim_matches_solo_curves_bitwise():
+    """Round 4: the SWIM seed ensemble (detection-latency distribution
+    for one failure scenario).  Every lane must equal the solo curve
+    driver with the same seed bitwise; rounds_to_target is
+    rounds-to-detection."""
+    from gossip_tpu.config import ProtocolConfig, RunConfig
+    from gossip_tpu.parallel.sweep import ensemble_swim_curves
+    from gossip_tpu.runtime.simulator import simulate_swim_curve
+    proto = ProtocolConfig(mode="swim", fanout=2, swim_proxies=2,
+                           swim_subjects=4, swim_suspect_rounds=4)
+    n, rounds, dead, fr = 96, 14, (1,), 2
+    run = RunConfig(seed=11, max_rounds=rounds, target_coverage=0.9)
+    seeds = [11, 12, 13, 14]
+    ens = ensemble_swim_curves(proto, n, run, seeds, dead_nodes=dead,
+                               fail_round=fr)
+    assert ens.curves.shape == (4, rounds)
+    for i, s in enumerate(seeds):
+        fracs, final = simulate_swim_curve(proto, n, rounds,
+                                           dead_nodes=dead, fail_round=fr,
+                                           seed=s)
+        np.testing.assert_array_equal(ens.curves[i],
+                                      np.asarray(fracs, np.float32),
+                                      err_msg=f"seed {s}")
+        assert float(ens.msgs[i, -1]) == float(final.msgs)
+    assert (ens.rounds_to_target > 0).all()     # every seed detected
