@@ -129,8 +129,10 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--engine", default="auto",
                    choices=("auto", "fused", "xla", "native"),
                    help="round kernel: auto = best eligible (fused Pallas "
-                        "on TPU for single-device fault-free pull on the "
-                        "complete graph, bit-packed XLA otherwise); fused "
+                        "on TPU for single-device pull on the complete "
+                        "graph — static fault masks and --curve "
+                        "included since round 4 — bit-packed XLA "
+                        "otherwise); fused "
                         "= force the Pallas kernel (TPU, pull, complete "
                         "graph; <= 32 rumors on one device, rumor planes "
                         "sharded zero-ICI with --devices beyond that); "

@@ -179,11 +179,12 @@ class RunConfig:
 
     ``engine`` selects the round implementation:
 
-    * ``auto``  — the best eligible engine.  On a TPU, single-device,
-      fault-free pull runs on the implicit complete topology (no curve
-      capture, <= 32 rumors) route to the fused Pallas kernel
-      automatically (meta records ``engine_auto``); other pull /
-      anti-entropy runs take the bit-packed XLA fast path
+    * ``auto``  — the best eligible engine.  On a TPU, single-device
+      pull runs on the implicit complete topology (<= 32 rumors) route
+      to the fused Pallas kernel automatically (meta records
+      ``engine_auto``) — since round 4 that includes static-fault and
+      --curve runs (in-kernel masks; fixed-length scan twins); other
+      pull / anti-entropy runs take the bit-packed XLA fast path
       (models/si_packed.py); everything else the bool kernels
       (models/si.py).  Works on any backend, any mode.
     * ``xla``   — force the XLA kernels even where the fused engine is
@@ -195,11 +196,13 @@ class RunConfig:
       hardware-PRNG partner sampling + in-row gather + OR-merge in one
       ``pallas_call`` (tables past the VMEM envelope use the staged
       big-table path).  TPU only (the hardware PRNG has no CPU
-      equivalent); pull mode on the implicit complete topology,
-      fault-free.  Single device: <= 32 rumors packed in one word per
-      node.  Multi-device: rumor planes of 32 sharded across the mesh
-      (parallel/sharded_fused.py), zero per-round ICI.  Ineligible
-      configs raise rather than silently substituting another engine.
+      equivalent); pull mode on the implicit complete topology; static
+      fault masks (node_death_rate / drop_prob) in-kernel on every
+      layout, scripted dead_nodes rejected.  Single device: <= 32
+      rumors packed in one word per node.  Multi-device: rumor planes
+      of 32 sharded across the mesh (parallel/sharded_fused.py), zero
+      per-round ICI.  Ineligible configs raise rather than silently
+      substituting another engine.
     * ``native`` — go-native backend only: force the C++ event core
       (native/eventsim.cpp, 20-100x the Python engine) and raise the
       node cap to 1M, making large-N parity spot checks CLI-reachable
