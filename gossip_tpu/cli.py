@@ -176,7 +176,8 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                    help="run S seeds as one vmapped batch and report "
                         "ensemble statistics (jax-tpu; for swim this "
                         "is the detection-latency distribution of one "
-                        "failure scenario across seeds)")
+                        "failure scenario across seeds; --devices "
+                        "shards the SEED axis over a mesh)")
     p.add_argument("--swim-subjects", type=int, default=8)
     p.add_argument("--swim-proxies", type=int, default=3)
     p.add_argument("--swim-suspect-rounds", type=int, default=0,
@@ -249,13 +250,6 @@ def cmd_run(a) -> int:
             print("error: --ensemble needs the jax-tpu backend",
                   file=sys.stderr)
             return 2
-        if a.devices > 1:
-            # the seed axis IS the batch dimension here; a node mesh
-            # would be silently dropped otherwise (no-silent-drop
-            # policy — shard the config axis with `grid` instead)
-            print("error: --ensemble is single-device (the seed axis is "
-                  "the vmap batch); drop --devices", file=sys.stderr)
-            return 2
         if run.engine == "fused":
             # never silently substitute the XLA kernels for a requested
             # engine (same policy as backend._run_fused)
@@ -267,13 +261,27 @@ def cmd_run(a) -> int:
                                                ensemble_swim_curves)
         from gossip_tpu.topology import generators as G
         seeds = [run.seed + i for i in range(a.ensemble)]
+        ens_mesh = None
+        if a.devices > 1:
+            if a.exchange != "dense":
+                # the seed-axis mesh has no cross-shard exchange to
+                # route; a requested pattern must not be silently
+                # dropped (no-silent-drop policy)
+                print("error: --ensemble shards the SEED axis; "
+                      "--exchange does not apply (drop it)",
+                      file=sys.stderr)
+                return 2
+            # the SEED axis shards over the mesh (embarrassingly
+            # parallel, value-invariant; seeds must divide devices)
+            from gossip_tpu.parallel.sharded import make_mesh
+            ens_mesh = make_mesh(a.devices, axis_name="seed")
         out_extra = {}
         with trace(a.profile):
             if a.mode == "rumor":
                 # SIR: residue/extinction DISTRIBUTIONS across seeds (the
                 # Demers-table form of the result)
                 ens = ensemble_rumor_curves(proto, G.build(tc), run,
-                                            seeds, fault)
+                                            seeds, fault, mesh=ens_mesh)
             elif a.mode == "swim":
                 # detection-latency distribution for one failure
                 # scenario across seeds (round 4; probe/proxy/fan-out
@@ -287,7 +295,8 @@ def cmd_run(a) -> int:
                 ens = ensemble_swim_curves(proto, tc.n, run, seeds,
                                            dead_nodes=dead,
                                            fail_round=fail_round,
-                                           fault=fault, topo=swim_topo)
+                                           fault=fault, topo=swim_topo,
+                                           mesh=ens_mesh)
                 if proto.swim_rotate:
                     # rotation: detection drops after the window leaves
                     # the dead node's epoch, so the headline is the
@@ -298,7 +307,7 @@ def cmd_run(a) -> int:
                     out_extra["peak_detection_min"] = float(peaks.min())
             else:
                 ens = ensemble_curves(proto, G.build(tc), run, seeds,
-                                      fault)
+                                      fault, mesh=ens_mesh)
         out = {"ensemble": ens.summary(), "mode": a.mode, "n": tc.n,
                "backend": a.backend, **out_extra}
         if a.profile:

@@ -99,10 +99,33 @@ class EnsembleResult:
         }
 
 
+
+
+def _shard_ensemble(init, mesh, axis_name: str, n_seeds: int):
+    """Place a stacked ensemble state under a 1-D seed-axis mesh (the
+    batch is embarrassingly parallel, like the config sweep's mesh:
+    sharding never changes values — pinned in tests).  Scalars-per-seed
+    shard on the axis; per-seed arrays shard on their leading dim."""
+    if mesh is None:
+        return init
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    if n_seeds % mesh.shape[axis_name] != 0:
+        raise ValueError(
+            f"{n_seeds} seeds do not divide over the {axis_name} mesh "
+            f"axis of size {mesh.shape[axis_name]}; pad the seed list "
+            "or change the mesh")
+    def place(x):
+        spec = P(axis_name, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+    return jax.tree_util.tree_map(place, init)
+
 def ensemble_curves(proto: ProtocolConfig, topo: Topology, run: RunConfig,
                     seeds: Sequence[int],
-                    fault: Optional[FaultConfig] = None) -> EnsembleResult:
-    """Run |seeds| independent trajectories as ONE batched XLA program."""
+                    fault: Optional[FaultConfig] = None, mesh=None,
+                    axis_name: str = "seed") -> EnsembleResult:
+    """Run |seeds| independent trajectories as ONE batched XLA program.
+    ``mesh``: a 1-D device mesh shards the SEED axis (value-invariant,
+    embarrassingly parallel — _shard_ensemble)."""
     # tables as jit ARGUMENTS + liveness in-trace: no O(N) closure
     # constants in the compile request (models/swim.py doc)
     step, tables = make_si_round(proto, topo, fault, run.origin, tabled=True)
@@ -115,6 +138,7 @@ def ensemble_curves(proto: ProtocolConfig, topo: Topology, run: RunConfig,
         base_key=keys,
         msgs=jnp.zeros((s,), jnp.float32),
     )
+    init = _shard_ensemble(init, mesh, axis_name, s)
 
     @jax.jit
     def scan(states, *tbl):
@@ -848,7 +872,8 @@ def ensemble_swim_curves(proto: ProtocolConfig, n: int, run: RunConfig,
                          seeds: Sequence[int], dead_nodes=(),
                          fail_round: int = 0,
                          fault: Optional[FaultConfig] = None,
-                         topo: Optional[Topology] = None) -> EnsembleResult:
+                         topo: Optional[Topology] = None, mesh=None,
+                         axis_name: str = "seed") -> EnsembleResult:
     """|seeds| independent SWIM failure-detection trajectories as ONE
     batched XLA program — the detection-LATENCY distribution for a fixed
     failure scenario across PRNG seeds (probe targets, proxy choices,
@@ -873,6 +898,7 @@ def ensemble_swim_curves(proto: ProtocolConfig, n: int, run: RunConfig,
         base_key=keys,
         msgs=jnp.zeros((s,), jnp.float32),
     )
+    init = _shard_ensemble(init, mesh, axis_name, s)
     rotate = proto.swim_rotate
     epoch_rounds = SW.resolve_epoch_rounds(proto, n)
 
@@ -903,7 +929,8 @@ def ensemble_swim_curves(proto: ProtocolConfig, n: int, run: RunConfig,
 
 def ensemble_rumor_curves(proto: ProtocolConfig, topo: Topology,
                           run: RunConfig, seeds: Sequence[int],
-                          fault: Optional[FaultConfig] = None
+                          fault: Optional[FaultConfig] = None, mesh=None,
+                          axis_name: str = "seed"
                           ) -> RumorEnsembleResult:
     """|seeds| independent SIR trajectories as ONE batched XLA program.
     Per-seed trajectories are bitwise identical to solo
@@ -923,6 +950,7 @@ def ensemble_rumor_curves(proto: ProtocolConfig, topo: Topology,
         base_key=keys,
         msgs=jnp.zeros((s,), jnp.float32),
     )
+    init = _shard_ensemble(init, mesh, axis_name, s)
 
     @jax.jit
     def scan(states, *tbl):

@@ -74,3 +74,40 @@ def test_ensemble_swim_matches_solo_curves_bitwise():
                                       err_msg=f"seed {s}")
         assert float(ens.msgs[i, -1]) == float(final.msgs)
     assert (ens.rounds_to_target > 0).all()     # every seed detected
+
+
+def test_ensemble_seed_axis_mesh_is_value_invariant():
+    """Round 4: the ensembles shard their SEED axis over a 1-D mesh —
+    values never change (embarrassingly parallel), for SI, SWIM, and
+    rumor ensembles alike; non-dividing seed counts reject loudly."""
+    from gossip_tpu.config import ProtocolConfig, RunConfig
+    from gossip_tpu.parallel.sharded import make_mesh
+    from gossip_tpu.parallel.sweep import (ensemble_curves,
+                                           ensemble_rumor_curves,
+                                           ensemble_swim_curves)
+    mesh = make_mesh(4, axis_name="seed")
+    seeds = [3, 4, 5, 6, 7, 8, 9, 10]
+    run = RunConfig(seed=0, max_rounds=10)
+    topo = G.complete(256)
+    a = ensemble_curves(ProtocolConfig(mode="pushpull"), topo, run, seeds)
+    b = ensemble_curves(ProtocolConfig(mode="pushpull"), topo, run, seeds,
+                        mesh=mesh)
+    np.testing.assert_array_equal(a.curves, b.curves)
+    np.testing.assert_array_equal(a.msgs, b.msgs)
+    sp = ProtocolConfig(mode="swim", fanout=2, swim_proxies=2,
+                        swim_subjects=4, swim_suspect_rounds=4)
+    sa = ensemble_swim_curves(sp, 96, run, seeds, dead_nodes=(1,),
+                              fail_round=2)
+    sb = ensemble_swim_curves(sp, 96, run, seeds, dead_nodes=(1,),
+                              fail_round=2, mesh=mesh)
+    np.testing.assert_array_equal(sa.curves, sb.curves)
+    np.testing.assert_array_equal(sa.msgs, sb.msgs)
+    rp = ProtocolConfig(mode="rumor", fanout=1, rumor_k=2, rumors=2)
+    ra = ensemble_rumor_curves(rp, topo, run, seeds)
+    rb = ensemble_rumor_curves(rp, topo, run, seeds, mesh=mesh)
+    np.testing.assert_array_equal(ra.curves, rb.curves)
+    np.testing.assert_array_equal(ra.hot, rb.hot)
+    np.testing.assert_array_equal(ra.msgs, rb.msgs)
+    with pytest.raises(ValueError, match="do not divide"):
+        ensemble_curves(ProtocolConfig(mode="push"), topo, run,
+                        seeds[:6], mesh=mesh)
