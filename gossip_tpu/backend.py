@@ -761,6 +761,55 @@ _CFG_TYPES = {"proto": ProtocolConfig, "topology": TopologyConfig,
               "run": RunConfig, "fault": FaultConfig, "mesh": MeshConfig}
 
 
+def run_ensemble(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
+                 fault: Optional[FaultConfig] = None, seeds=None,
+                 count: Optional[int] = None, mesh=None):
+    """Mode-dispatched seed ensemble — the ONE place the CLI's
+    ``--ensemble`` and the sidecar's ``Ensemble`` RPC share: SI modes,
+    SIR rumor mongering (residue/extinction distributions), and SWIM
+    failure detection (detection-latency distribution for one scenario).
+    Pass ``seeds`` explicitly or ``count`` (seeds become ``run.seed +
+    i`` — the ONE place that default lives); ``mesh`` shards the seed
+    axis (value-invariant).  Flood is admitted but varies across seeds
+    only through fault randomness (its relay has no peer draw).
+    Returns ``(ens, extra)`` — the ensemble result and the
+    mode-specific report keys."""
+    from gossip_tpu.parallel.sweep import (ensemble_curves,
+                                           ensemble_rumor_curves,
+                                           ensemble_swim_curves)
+    from gossip_tpu.topology import generators as G
+    if run.engine == "fused":
+        raise ValueError("ensembles run the threefry XLA kernels; "
+                         "engine='fused' is single-run only")
+    if seeds is None and count is not None:
+        seeds = [run.seed + i for i in range(int(count))]
+    seeds = list(seeds) if seeds else None
+    if not seeds:
+        raise ValueError("need at least one seed (pass seeds or count)")
+    extra: Dict[str, Any] = {}
+    if proto.mode == "rumor":
+        ens = ensemble_rumor_curves(proto, G.build(tc), run, seeds,
+                                    fault, mesh=mesh)
+    elif proto.mode == "swim":
+        dead, fail_round, extra = swim_scenario_meta(proto, tc.n, fault)
+        swim_topo = None if tc.family == "complete" else G.build(tc)
+        ens = ensemble_swim_curves(proto, tc.n, run, seeds,
+                                   dead_nodes=dead, fail_round=fail_round,
+                                   fault=fault, topo=swim_topo, mesh=mesh)
+        if proto.swim_rotate:
+            # rotation: detection drops after the window leaves the dead
+            # node's epoch — the headline is the per-seed PEAK (the solo
+            # drivers' contract)
+            peaks = ens.curves.max(axis=1)
+            extra["subject_window"] = "rotating"
+            extra["peak_detection_mean"] = float(peaks.mean())
+            extra["peak_detection_min"] = float(peaks.min())
+    else:
+        ens = ensemble_curves(proto, G.build(tc), run, seeds, fault,
+                              mesh=mesh)
+    return ens, extra
+
+
 def request_to_args(req: Dict[str, Any]) -> Dict[str, Any]:
     """JSON request dict -> kwargs for :func:`run_simulation`.  Unknown
     fields are rejected (typos should not silently become defaults)."""

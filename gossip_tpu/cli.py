@@ -250,17 +250,7 @@ def cmd_run(a) -> int:
             print("error: --ensemble needs the jax-tpu backend",
                   file=sys.stderr)
             return 2
-        if run.engine == "fused":
-            # never silently substitute the XLA kernels for a requested
-            # engine (same policy as backend._run_fused)
-            print("error: --ensemble runs the threefry XLA kernels; "
-                  "--engine fused is single-run only", file=sys.stderr)
-            return 2
-        from gossip_tpu.parallel.sweep import (ensemble_curves,
-                                               ensemble_rumor_curves,
-                                               ensemble_swim_curves)
-        from gossip_tpu.topology import generators as G
-        seeds = [run.seed + i for i in range(a.ensemble)]
+        from gossip_tpu.backend import run_ensemble
         ens_mesh = None
         if a.devices > 1:
             if a.exchange != "dense":
@@ -275,39 +265,14 @@ def cmd_run(a) -> int:
             # parallel, value-invariant; seeds must divide devices)
             from gossip_tpu.parallel.sharded import make_mesh
             ens_mesh = make_mesh(a.devices, axis_name="seed")
-        out_extra = {}
         with trace(a.profile):
-            if a.mode == "rumor":
-                # SIR: residue/extinction DISTRIBUTIONS across seeds (the
-                # Demers-table form of the result)
-                ens = ensemble_rumor_curves(proto, G.build(tc), run,
-                                            seeds, fault, mesh=ens_mesh)
-            elif a.mode == "swim":
-                # detection-latency distribution for one failure
-                # scenario across seeds (round 4; probe/proxy/fan-out
-                # draws redraw per seed) — rounds_to_target is
-                # rounds-to-DETECTION here
-                from gossip_tpu.backend import swim_scenario_meta
-                dead, fail_round, out_extra = swim_scenario_meta(
-                    proto, tc.n, fault)
-                swim_topo = (None if tc.family == "complete"
-                             else G.build(tc))
-                ens = ensemble_swim_curves(proto, tc.n, run, seeds,
-                                           dead_nodes=dead,
-                                           fail_round=fail_round,
-                                           fault=fault, topo=swim_topo,
-                                           mesh=ens_mesh)
-                if proto.swim_rotate:
-                    # rotation: detection drops after the window leaves
-                    # the dead node's epoch, so the headline is the
-                    # per-seed PEAK (same contract as the solo drivers)
-                    peaks = ens.curves.max(axis=1)
-                    out_extra["subject_window"] = "rotating"
-                    out_extra["peak_detection_mean"] = float(peaks.mean())
-                    out_extra["peak_detection_min"] = float(peaks.min())
-            else:
-                ens = ensemble_curves(proto, G.build(tc), run, seeds,
-                                      fault, mesh=ens_mesh)
+            # mode dispatch (SI / rumor / swim-scenario) lives in
+            # backend.run_ensemble, shared with the sidecar's Ensemble
+            # RPC so the two surfaces cannot drift
+            # run_ensemble owns the seed default, the engine guard,
+            # and the mode dispatch (shared with the Ensemble RPC)
+            ens, out_extra = run_ensemble(proto, tc, run, fault,
+                                          count=a.ensemble, mesh=ens_mesh)
         out = {"ensemble": ens.summary(), "mode": a.mode, "n": tc.n,
                "backend": a.backend, **out_extra}
         if a.profile:

@@ -16,7 +16,10 @@ Wire format: requests and responses are UTF-8 JSON.  ``Run`` takes
 ``{"backend": ..., "proto": {...}, "topology": {...}, "run": {...},
 "fault": {...}|null, "mesh": {...}|null, "curve": bool}`` (field names =
 the config dataclasses, validated strictly) and returns a RunReport dict.
-``Health`` returns backend/device facts.
+``Ensemble`` takes the same minus curve/mesh plus ``seeds`` or
+``ensemble`` (count) and returns seed-ensemble statistics (round 4 —
+incl. SWIM detection-latency distributions).  ``Health`` returns
+backend/device facts.
 """
 
 from __future__ import annotations
@@ -45,6 +48,39 @@ def _run(request: bytes, context) -> bytes:
     return json.dumps(report.to_dict()).encode()
 
 
+def _ensemble(request: bytes, context) -> bytes:
+    """Seed-ensemble statistics in one call (still coarse-grained: one
+    RPC = one batched XLA program).  Request = the Run fields minus
+    ``curve``/``mesh``, plus ``seeds`` (list of ints) or ``ensemble``
+    (count, seeded run.seed + i); response = {"ensemble": summary,
+    mode-specific keys...} exactly like the CLI's --ensemble output."""
+    from gossip_tpu.backend import request_to_args, run_ensemble
+    try:
+        req = json.loads(request)
+        seeds = req.pop("seeds", None)
+        count = req.pop("ensemble", None)
+        if (seeds is None) == (count is None):
+            raise ValueError("pass exactly one of 'seeds' (list) or "
+                             "'ensemble' (count)")
+        args = request_to_args(req)
+        if args.pop("backend") != "jax-tpu":
+            raise ValueError("ensembles need the jax-tpu backend")
+        if args.pop("mesh_cfg", None) is not None:
+            raise ValueError("the Ensemble RPC is single-process "
+                             "single-device; shard seed axes via the "
+                             "library API")
+        if args.pop("want_curve", None):
+            raise ValueError("the Ensemble RPC returns summary "
+                             "statistics, not curves; drop 'curve' "
+                             "(bands are a CLI --save-curve feature)")
+        ens, extra = run_ensemble(seeds=seeds, count=count, **args)
+        out = {"ensemble": ens.summary(), "mode": args["proto"].mode,
+               "n": args["tc"].n, **extra}
+    except (ValueError, TypeError, json.JSONDecodeError) as e:
+        context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+    return json.dumps(out).encode()
+
+
 def _health(request: bytes, context) -> bytes:
     import jax
     return json.dumps({
@@ -63,6 +99,9 @@ def serve(port: int = 50051, max_workers: int = 4,
     handlers = {
         "Run": grpc.unary_unary_rpc_method_handler(
             _run, request_deserializer=_identity,
+            response_serializer=_identity),
+        "Ensemble": grpc.unary_unary_rpc_method_handler(
+            _ensemble, request_deserializer=_identity,
             response_serializer=_identity),
         "Health": grpc.unary_unary_rpc_method_handler(
             _health, request_deserializer=_identity,
@@ -86,6 +125,9 @@ class SidecarClient:
         self._run = self._channel.unary_unary(
             f"/{SERVICE}/Run", request_serializer=_identity,
             response_deserializer=_identity)
+        self._ensemble = self._channel.unary_unary(
+            f"/{SERVICE}/Ensemble", request_serializer=_identity,
+            response_deserializer=_identity)
         self._health = self._channel.unary_unary(
             f"/{SERVICE}/Health", request_serializer=_identity,
             response_deserializer=_identity)
@@ -95,6 +137,13 @@ class SidecarClient:
         backend, proto, topology, run, fault, mesh, curve."""
         return json.loads(self._run(json.dumps(request).encode(),
                                     timeout=timeout))
+
+    def ensemble(self, timeout: Optional[float] = 600.0,
+                 **request) -> dict:
+        """Seed-ensemble statistics; kwargs mirror the Run fields plus
+        seeds=[...] or ensemble=count."""
+        return json.loads(self._ensemble(json.dumps(request).encode(),
+                                         timeout=timeout))
 
     def health(self, timeout: float = 10.0) -> dict:
         return json.loads(self._health(b"{}", timeout=timeout))

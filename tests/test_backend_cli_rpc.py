@@ -647,3 +647,42 @@ def test_until_reports_split_compile_and_steady_wall():
                        TopologyConfig(family="complete", n=256),
                        RunConfig(max_rounds=16), want_curve=True)
     assert "compile_s" not in r.meta
+
+
+def test_rpc_sidecar_ensemble():
+    """Round 4: the Ensemble RPC — seed-ensemble statistics in one
+    coarse call, mode-dispatched through backend.run_ensemble (shared
+    with the CLI so the two surfaces cannot drift)."""
+    import grpc
+
+    from gossip_tpu.rpc.sidecar import SidecarClient, serve
+    server, port = serve(0, 2)
+    c = SidecarClient(f"localhost:{port}")
+    try:
+        r = c.ensemble(proto={"mode": "pushpull"},
+                       topology={"family": "complete", "n": 256},
+                       run={"max_rounds": 24}, ensemble=4)
+        assert r["ensemble"]["seeds"] == 4
+        assert r["ensemble"]["converged"] == 4
+        r = c.ensemble(proto={"mode": "swim", "fanout": 2,
+                              "swim_subjects": 4, "swim_proxies": 2,
+                              "swim_suspect_rounds": 4},
+                       topology={"family": "complete", "n": 128},
+                       run={"max_rounds": 40}, seeds=[5, 6, 7])
+        assert r["metric"] == "detection_fraction"
+        assert r["ensemble"]["converged"] == 3
+        # strict schema: flood, both/neither seed forms, unknown fields
+        for bad in (dict(proto={"mode": "flood"}, topology={"n": 64},
+                         run={}, ensemble=2),
+                    dict(proto={"mode": "push"}, topology={"n": 64},
+                         run={}),
+                    dict(proto={"mode": "push"}, topology={"n": 64},
+                         run={}, ensemble=2, seeds=[1]),
+                    dict(proto={"mode": "push"}, topology={"n": 64},
+                         run={}, ensemble=2, bogus=1)):
+            with pytest.raises(grpc.RpcError) as exc:
+                c.ensemble(**bad)
+            assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        c.close()
+        server.stop(0)
