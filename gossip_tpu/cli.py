@@ -664,6 +664,12 @@ def cmd_sweep(a) -> int:
     configs = baseline_configs(a.scale, devices)
     if a.only:
         configs = [c for c in configs if c["name"] in a.only]
+    if a.swim_diss:
+        import dataclasses as _dc
+        configs = [dict(cfg, proto=_dc.replace(cfg["proto"],
+                                               swim_diss=a.swim_diss))
+                   if cfg["proto"].mode == "swim" else cfg
+                   for cfg in configs]
     for cfg in configs:
         report = run_simulation(cfg["backend"], cfg["proto"], cfg["tc"],
                                 cfg["run"], None, cfg.get("mesh"),
@@ -833,6 +839,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--only", nargs="*", default=None,
                    help="subset of config names")
     p.add_argument("--curve", action="store_true")
+    p.add_argument("--swim-diss", choices=("scatter", "sort", "pack"),
+                   default=None,
+                   help="override the SWIM config's dissemination "
+                        "lowering (bitwise-identical trajectories; lets "
+                        "the hardware capture re-measure the SWIM row "
+                        "under an A/B-arbitrated winner without a code "
+                        "change — tools/hw_refresh.py)")
     _add_cache_flags(p)
     p.set_defaults(fn=cmd_sweep)
 

@@ -7,31 +7,35 @@ probes on a schedule and launches this script at the first healthy
 window).  The outer timeout must cover the sum of ALL per-step
 subprocess timeouts at their worst; ``worst_case_budget_s()`` below
 computes it from the same constants the steps use (at the default
-GOSSIP_BENCH_PROBE_ATTEMPTS=3 it is 1200 (mr) + 900 (prng) +
-2400 (sweep) + ~6020 (bench worst case) + 2400 (pallas tests)
-= 12,920 s):
+GOSSIP_BENCH_PROBE_ATTEMPTS=3 it is ~2100 (swim A/B) + 1200 (mr) +
+900 (prng) + 2400 (sweep) + ~6020 (bench worst case) + 2400 (pallas
+tests) = ~15,020 s):
 
-    timeout 13500 python tools/hw_refresh.py      # default attempts
+    timeout 15600 python tools/hw_refresh.py      # default attempts
     python tools/hw_refresh.py --smoke            # CPU-scale rehearsal
 
-``--smoke`` runs the SAME five-step pipeline at CPU scale on the
+``--smoke`` runs the SAME six-step pipeline at CPU scale on the
 hermetic env (plugin disarmed, 8 virtual devices, interpreter-mode
 kernels, sweep --scale 0.002, single fast bench probe) writing
 ``.smoke``-infixed artifacts — a rehearsal of every subprocess,
 timeout, merge, and artifact path, runnable while the tunnel is down,
 so the real window is never burned by a plumbing bug.
 
-Steps (each prints a tagged JSON line; failures don't stop later steps):
-  1. staged big-table MR kernel validation at 10M x 32 rumors
+Steps (each prints a tagged JSON line; failures don't stop later steps;
+ordered by VERDICT r4 priority so a short window lands the most
+important captures first):
+  1. SWIM dissemination A/B (sort vs pack) on the BASELINE-1M shape
+     -> artifacts/swim_diss_ab_r05.json  (VERDICT r4 task 1a)
+  2. bench.py headline
+  3. staged big-table MR kernel validation at 10M x 32 rumors
      (post-padding variant) + per-round timing
-  2. hardware-PRNG digest of the plane-sharded fused round
-  3. the five BASELINE configs at full scale
-     -> artifacts/baseline_sweep_r04.jsonl
-  4. bench.py headline
-  5. TPU-only pallas statistics tests
-     -> artifacts/tpu_pallas_tests_r04.txt
+  4. hardware-PRNG digest of the plane-sharded fused round
+  5. the five BASELINE configs at full scale, SWIM row under the
+     arbitrated A/B winner -> artifacts/baseline_sweep_r05.jsonl
+  6. TPU-only pallas statistics tests
+     -> artifacts/tpu_pallas_tests_r05.txt
 
-All step lines are also collected into artifacts/hw_refresh_r04.json.
+All step lines are also collected into artifacts/hw_refresh_r05.json.
 Afterwards update README.md's hardware table (tools/readme_table.py)
 and docs/PERF.md's pending numbers from the recorded lines.
 """
@@ -50,11 +54,24 @@ SWEEP_TIMEOUT_S = 2400
 TESTS_TIMEOUT_S = 2400
 BENCH_SLACK_S = 200
 
+
+def swim_ab_budget_s():
+    """swim_diss_ab.py's self-computed worst case plus slack — derived
+    from the child's own constants so this budget can't drift below
+    what the child needs to run its own group-kill (killing it early
+    would orphan a live TPU client on the single-client tunnel)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import swim_diss_ab
+    finally:
+        sys.path.pop(0)
+    return swim_diss_ab.worst_case_budget_s() + 120
+
 # --smoke: the full pipeline at CPU scale on the hermetic env — a
 # REHEARSAL of every subprocess/plumbing/artifact path, so the one
 # healthy tunnel window is never burned by a plumbing bug (round 2's
 # capture failed exactly that way).  Smoke artifacts carry a .smoke
-# infix and never touch the real r04 names.
+# infix and never touch the real r05 names.
 SMOKE = False
 
 
@@ -66,7 +83,7 @@ def _art(name):
 
 
 def summary_path():
-    return _art("hw_refresh_r04.json")
+    return _art("hw_refresh_r05.json")
 
 
 def _load_bench():
@@ -91,8 +108,8 @@ def worst_case_budget_s():
     ``timeout`` can't silently drift below what a fully wedged run needs
     (bench's own worst case is computed by bench.py from its probe/body
     constants)."""
-    return (MR_TIMEOUT_S + PRNG_TIMEOUT_S + SWEEP_TIMEOUT_S
-            + bench_budget_s() + TESTS_TIMEOUT_S)
+    return (swim_ab_budget_s() + MR_TIMEOUT_S + PRNG_TIMEOUT_S
+            + SWEEP_TIMEOUT_S + bench_budget_s() + TESTS_TIMEOUT_S)
 
 
 def load_summary():
@@ -227,6 +244,49 @@ def _smoke_argv():
     return ["--smoke"] if SMOKE else []
 
 
+def swim_diss_ab():
+    """Arbitrate the SWIM dissemination lowerings (sort control vs pack
+    candidate) on the chip — VERDICT r4 task 1a.  Delegates to
+    tools/swim_diss_ab.py (probe-first, per-impl fresh compile cache,
+    group-kill on wedge); its rc 2 is the transient convention (tunnel
+    re-wedged mid-A/B), surfaced here as a failure so the step stays
+    pending and the watchdog retries it at the next window."""
+    p = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "swim_diss_ab.py"),
+                        *_smoke_argv()],
+                       capture_output=True, text=True,
+                       timeout=swim_ab_budget_s(), cwd=REPO,
+                       env=_body_env())
+    if p.returncode != 0:
+        kind = ("transient rc 2 (tunnel re-wedged mid-A/B; retry)"
+                if p.returncode == 2 else f"rc {p.returncode}")
+        raise RuntimeError(kind + "\n" + (p.stderr or p.stdout)[-400:])
+    with open(_art("swim_diss_ab_r05.json")) as f:
+        doc = json.load(f)
+    return {"verdict": doc.get("verdict"),
+            "trajectories_identical": doc.get("trajectories_identical"),
+            "rows": [{k: r.get(k) for k in ("swim_diss", "wall_s",
+                                            "compile_s", "steady_wall_s")}
+                     for r in doc.get("rows", [])]}
+
+
+def swim_diss_winner():
+    """The arbitrated dissemination lowering from this round's committed
+    A/B artifact (its explicit ``winner`` field — ONE definition, owned
+    by swim_diss_ab.py), or None (CLI default) when no clean verdict
+    exists — the sweep recapture below passes it through so the SWIM
+    row is re-measured under the winner in the SAME window (VERDICT r4
+    1a)."""
+    try:
+        with open(_art("swim_diss_ab_r05.json")) as f:
+            doc = json.load(f)
+        if not doc.get("trajectories_identical"):
+            return None
+        return doc.get("winner")
+    except (OSError, ValueError):
+        return None
+
+
 def prng_invariant():
     p = subprocess.run([sys.executable, os.path.abspath(__file__),
                         "--prng-body", *_smoke_argv()],
@@ -256,7 +316,7 @@ def _write_sweep_artifact(stdout):
     hardware measurements from a scarce healthy window.  MERGES with an
     existing artifact by config name (new rows win) so a retry that got
     less far can never clobber rows a fuller earlier attempt captured."""
-    art = _art("baseline_sweep_r04.jsonl")
+    art = _art("baseline_sweep_r05.jsonl")
     if isinstance(stdout, bytes):
         stdout = stdout.decode(errors="replace")
     stdout = stdout or ""
@@ -295,6 +355,9 @@ def baseline_sweep():
         # cold number; the (default-on) persistent cache would silently
         # substitute a ~3 s warm compile on any host that ever built
         # these shapes before
+        winner = swim_diss_winner()
+        if winner:
+            extra += ["--swim-diss", winner]
         p = subprocess.run([sys.executable, "-u", "-m", "gossip_tpu",
                             "sweep", "--scale", scale,
                             "--no-compile-cache", *extra],
@@ -337,7 +400,7 @@ def bench():
 
 
 def tpu_pallas_tests():
-    art = _art("tpu_pallas_tests_r04.txt")
+    art = _art("tpu_pallas_tests_r05.txt")
     # conftest pins tests to CPU unless this var points at the chip;
     # smoke keeps CPU (the TPU-only classes skip — the rehearsal proves
     # the pytest/artifact plumbing, the chip proves the statistics)
@@ -370,10 +433,17 @@ def tpu_pallas_tests():
     return tail
 
 
-STEPS = [("mr_staged_10m", mr_staged_10m),
+# Priority order = VERDICT r4 task 1: the A/B arbitration first (it
+# unblocks the SWIM default flip and the sweep recapture), then the
+# scoreboard headline, then the cheap kernel validations, then the
+# five-config sweep (which picks up the A/B winner), then the test tier.
+# A window that closes mid-run lands the most important steps first;
+# retries are incremental (pending steps only).
+STEPS = [("swim_diss_ab", swim_diss_ab),
+         ("bench", bench),
+         ("mr_staged_10m", mr_staged_10m),
          ("prng_invariant", prng_invariant),
          ("baseline_sweep", baseline_sweep),
-         ("bench", bench),
          ("tpu_pallas_tests", tpu_pallas_tests)]
 
 

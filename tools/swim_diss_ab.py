@@ -14,7 +14,7 @@ arbitrates on hardware, exactly like the r04 sort-vs-scatter A/B
     impl (fresh per-impl compile-cache dir: compile_s stays honest),
   - asserts the trajectories match (rounds / coverage / msgs equal —
     anything else means the lowering is NOT pure and must not ship),
-  - writes artifacts/swim_diss_ab_r04.json with walls, steady split,
+  - writes artifacts/swim_diss_ab_r05.json with walls, steady split,
     and a verdict line.
 
 Run only when the tunnel is healthy (tools/tunnel_watchdog.py probes
@@ -43,6 +43,22 @@ finally:
     sys.path.pop(0)
 
 
+PROBE_TIMEOUT_S = 120
+POST_FAILURE_PROBE_S = 60
+DEFAULT_RUN_TIMEOUT_S = 900
+
+
+def worst_case_budget_s(n_impls: int = 2,
+                        run_timeout_s: int = DEFAULT_RUN_TIMEOUT_S) -> int:
+    """Upper bound on a full A/B run (probe + every run at its full
+    timeout + the post-failure disambiguation probe), exported so
+    tools/hw_refresh.py derives its step budget from the same constants
+    this file's loops use — a parent timeout below this can kill us
+    before our own group-kill fires, orphaning a live TPU client."""
+    return (PROBE_TIMEOUT_S + n_impls * run_timeout_s
+            + POST_FAILURE_PROBE_S)
+
+
 class WedgeTimeout(RuntimeError):
     """A run blew its subprocess budget — the tunnel-wedge signature.
     Transient, not a verdict: main() maps this to exit code 2, the
@@ -58,7 +74,7 @@ class CliFailed(RuntimeError):
     alive -> exit 1 (deterministic; do not retry)."""
 
 
-def probe(timeout_s: int = 120) -> bool:
+def probe(timeout_s: int = PROBE_TIMEOUT_S) -> bool:
     """Cheap tunnel probe (the wedge signature is a hang, so a timeout
     means NO — tools/tunnel_watchdog.py's contract).  Skipped in smoke
     mode."""
@@ -131,7 +147,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--impls", nargs="+", default=["sort", "pack"])
     ap.add_argument("--n", type=int, default=1_000_000)
-    ap.add_argument("--timeout", type=int, default=900,
+    ap.add_argument("--timeout", type=int, default=DEFAULT_RUN_TIMEOUT_S,
                     help="per-run subprocess timeout (s)")
     ap.add_argument("--smoke", action="store_true",
                     help="CPU-scale rehearsal (n=20k, JAX_PLATFORMS=cpu)")
@@ -143,7 +159,7 @@ def main():
         return 2
     n = 20_000 if a.smoke else a.n
     infix = ".smoke" if a.smoke else ""
-    art = os.path.join(REPO, "artifacts", f"swim_diss_ab_r04{infix}.json")
+    art = os.path.join(REPO, "artifacts", f"swim_diss_ab_r05{infix}.json")
 
     rows = []
     for impl in a.impls:
@@ -154,7 +170,7 @@ def main():
             return 2          # transient: the watchdog retries rc 2
         except CliFailed as e:
             print(str(e), file=sys.stderr)
-            if not a.smoke and not probe(timeout_s=60):
+            if not a.smoke and not probe(timeout_s=POST_FAILURE_PROBE_S):
                 print("post-failure probe dead — wedge-shaped fast init "
                       "failure; retry at the next healthy window",
                       file=sys.stderr)
@@ -165,13 +181,18 @@ def main():
 
     traj = {(r["rounds"], r["coverage"], r["msgs"]) for r in rows}
     identical = len(traj) == 1
-    verdict = None
+    verdict = winner = None
     if identical and len(rows) >= 2:
-        ctl, cand = rows[0], min(rows[1:], key=lambda r: r["steady_wall_s"])
-        verdict = (f"{cand['swim_diss']}: steady {ctl['steady_wall_s']:.1f}"
-                   f" -> {cand['steady_wall_s']:.1f} s, compile "
-                   f"{ctl['compile_s']:.1f} -> {cand['compile_s']:.1f} s "
-                   f"vs {ctl['swim_diss']}")
+        # winner = min steady over ALL rows (control included): a
+        # candidate that regresses must lose to the control, and the
+        # artifact's field is THE arbitration consumers read
+        # (hw_refresh.swim_diss_winner) — one definition, one file
+        ctl, best = rows[0], min(rows, key=lambda r: r["steady_wall_s"])
+        winner = best["swim_diss"]
+        verdict = (f"winner {winner}: steady {ctl['steady_wall_s']:.1f}"
+                   f" -> {best['steady_wall_s']:.1f} s, compile "
+                   f"{ctl['compile_s']:.1f} -> {best['compile_s']:.1f} s "
+                   f"vs {ctl['swim_diss']} control")
     doc = {
         "what": ("A/B of ProtocolConfig.swim_diss lowerings on the "
                  "BASELINE SWIM-1M shape; identical trajectories required "
@@ -181,6 +202,7 @@ def main():
                     % (n, " ".join(BASE_ARGS), "|".join(a.impls))),
         "rows": rows,
         "trajectories_identical": identical,
+        "winner": winner,
         "verdict": verdict,
     }
     with open(art, "w") as f:
