@@ -122,6 +122,58 @@ def body():
     return 0
 
 
+def last_tpu_capture():
+    """Newest committed TPU bench capture from the hardware-refresh
+    artifacts, as a machine-readable pointer (VERDICT r4 task 2: the
+    scoreboard must survive a wedged-tunnel fallback — rounds 2-4 all
+    recorded "null" while the proof of 116x sat one directory over in
+    artifacts/hw_refresh_r04.json).  Returns None when no committed TPU
+    capture exists.  ``vs_baseline`` on the live line stays null either
+    way: this field POINTS at proof, it never substitutes for a live
+    measurement."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    art_dir = os.path.join(repo, "artifacts")
+    best = None
+    try:
+        names = sorted(os.listdir(art_dir))
+    except OSError:
+        return None
+    for name in names:
+        # lexicographic r01 < r02 < ... ordering; later rounds win.
+        # .smoke rehearsal artifacts are hermetic-CPU by construction
+        # and excluded by the backend check anyway.
+        if not (name.startswith("hw_refresh_r") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(art_dir, name)) as f:
+                steps = {r.get("step"): r for r in json.load(f)}
+        except (OSError, ValueError, TypeError, AttributeError):
+            continue
+        step = steps.get("bench") or {}
+        line = step.get("result") or {}
+        if step.get("ok") and line.get("backend") == "tpu":
+            best = {
+                "artifact": os.path.join("artifacts", name),
+                "value": line.get("value"),
+                "unit": line.get("unit"),
+                "vs_baseline": line.get("vs_baseline"),
+            }
+    if best is not None:
+        # provenance: the commit that captured the artifact (None when
+        # uncommitted or git is unavailable — the pointer still stands)
+        try:
+            p = subprocess.run(
+                ["git", "log", "-1", "--format=%H %cI", "--",
+                 best["artifact"]],
+                capture_output=True, text=True, timeout=30, cwd=repo)
+            parts = p.stdout.strip().split()
+            if p.returncode == 0 and len(parts) == 2:
+                best["git_commit"], best["captured"] = parts
+        except (OSError, subprocess.SubprocessError):
+            pass
+    return best
+
+
 def measurement_line(rate, backend, n, variant, rounds, dt):
     """The one-JSON-line scoreboard contract (tests/test_bench_contract.py).
 
@@ -129,9 +181,12 @@ def measurement_line(rate, backend, n, variant, rounds, dt):
     is only meaningful for a TPU measurement: off-TPU it is ``null`` and
     the machine-readable ``backend`` field says what actually ran — a CPU
     fallback can never masquerade as a TPU perf regression/improvement
-    (the round-2 scoreboard read a wedged-tunnel CPU fallback as 0.21x)."""
+    (the round-2 scoreboard read a wedged-tunnel CPU fallback as 0.21x).
+    A fallback line additionally carries ``last_tpu``, a pointer to the
+    newest committed TPU capture, so a wedge can hide the live number
+    but never the proof."""
     on_tpu = backend == "tpu"
-    return {
+    line = {
         "metric": "node_rounds_per_sec_per_chip",
         "value": round(rate, 1),
         "unit": f"node-rounds/s/chip (N={n}, {variant} to 99% in "
@@ -140,6 +195,9 @@ def measurement_line(rate, backend, n, variant, rounds, dt):
                         if on_tpu else None),
         "backend": backend,
     }
+    if not on_tpu:
+        line["last_tpu"] = last_tpu_capture()
+    return line
 
 
 # Probe/body timeout constants, exported so tools/hw_refresh.py can
@@ -298,7 +356,8 @@ def main():
         "metric": "node_rounds_per_sec_per_chip", "value": 0.0,
         "unit": f"bench body failed on every platform (rc={rc}; "
                 "wedged TPU tunnel?)",
-        "vs_baseline": None, "backend": None}))
+        "vs_baseline": None, "backend": None,
+        "last_tpu": last_tpu_capture()}))
     return 1
 
 

@@ -59,7 +59,44 @@ def test_line_is_json_serializable_and_flat():
     line = bench.measurement_line(1.0, "cpu", 10, "x", 1, 1.0)
     parsed = json.loads(json.dumps(line))
     assert set(parsed) == {"metric", "value", "unit", "vs_baseline",
-                           "backend"}
+                           "backend", "last_tpu"}
+
+
+def test_fallback_carries_last_tpu_pointer():
+    """VERDICT r4 task 2: a wedged-tunnel fallback line must point at
+    the newest COMMITTED TPU capture so the scoreboard survives a
+    wedge.  The repo ships artifacts/hw_refresh_r04.json with a green
+    TPU bench step, so the pointer must resolve against this tree."""
+    line = bench.measurement_line(
+        rate=6.4e6, backend="cpu", n=500_000,
+        variant="bit-packed pull SI (XLA fallback)", rounds=27, dt=2.1)
+    ptr = line["last_tpu"]
+    assert ptr is not None
+    assert ptr["artifact"].startswith("artifacts/hw_refresh_r")
+    assert ".smoke" not in ptr["artifact"]
+    assert ptr["value"] > 1e9            # the r04 capture reads 3.49B
+    assert ptr["vs_baseline"] > 100      # ... at 116.2x north star
+    assert "backend=tpu" in ptr["unit"]
+    # provenance fields resolve when the artifact is committed AND git
+    # is available — last_tpu_capture tolerates their absence (source
+    # exports without .git), so only assert where they can exist
+    import shutil
+    if shutil.which("git") and os.path.isdir(os.path.join(_REPO, ".git")):
+        assert len(ptr.get("git_commit", "")) == 40
+        assert ptr.get("captured", "").startswith("20")
+    # the pointer never masquerades as a live measurement
+    assert line["vs_baseline"] is None
+    # and the whole line still survives the driver's JSON trip
+    assert json.loads(json.dumps(line))["last_tpu"]["value"] == ptr["value"]
+
+
+def test_tpu_line_has_no_last_tpu_field():
+    """A live TPU measurement IS the record; the pointer only appears
+    on fallback lines (keeps the scoreboard schema unambiguous)."""
+    line = bench.measurement_line(
+        rate=3.2e9, backend="tpu", n=10_000_000,
+        variant="fused-pallas pull SI", rounds=26, dt=0.077)
+    assert "last_tpu" not in line
 
 
 def test_print_hermetic_env_contract():

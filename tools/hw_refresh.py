@@ -141,6 +141,12 @@ def step(tag, fn):
         line = {"step": tag, "ok": False, "timed_out": True,
                 "wall_s": round(time.time() - t0, 1),
                 "error": f"TimeoutExpired: {e}"[:500]}
+    except WedgeDetected as e:
+        # child-diagnosed wedge (rc 2 convention): same abort semantics
+        # as an actual budget overrun
+        line = {"step": tag, "ok": False, "timed_out": True,
+                "wall_s": round(time.time() - t0, 1),
+                "error": f"WedgeDetected: {e}"[:500]}
     except Exception as e:  # keep going; later steps still run
         line = {"step": tag, "ok": False,
                 "wall_s": round(time.time() - t0, 1),
@@ -244,23 +250,35 @@ def _smoke_argv():
     return ["--smoke"] if SMOKE else []
 
 
+class WedgeDetected(RuntimeError):
+    """A step's child diagnosed the tunnel-wedge signature itself (the
+    capture tools' rc 2 convention) — same meaning as the step blowing
+    its subprocess budget: the window just closed, and every remaining
+    step would deterministically burn its full budget against a dead
+    tunnel.  step() maps this to the "timeout" abort like an actual
+    TimeoutExpired."""
+
+
 def swim_diss_ab():
     """Arbitrate the SWIM dissemination lowerings (sort control vs pack
     candidate) on the chip — VERDICT r4 task 1a.  Delegates to
     tools/swim_diss_ab.py (probe-first, per-impl fresh compile cache,
     group-kill on wedge); its rc 2 is the transient convention (tunnel
-    re-wedged mid-A/B), surfaced here as a failure so the step stays
-    pending and the watchdog retries it at the next window."""
+    re-wedged mid-A/B), surfaced as the wedge signature so the
+    remaining steps abort and the watchdog retries at the next
+    window."""
     p = subprocess.run([sys.executable,
                         os.path.join(REPO, "tools", "swim_diss_ab.py"),
                         *_smoke_argv()],
                        capture_output=True, text=True,
                        timeout=swim_ab_budget_s(), cwd=REPO,
                        env=_body_env())
+    if p.returncode == 2:
+        raise WedgeDetected("swim_diss_ab rc 2 (tunnel re-wedged "
+                            "mid-A/B)\n" + (p.stderr or p.stdout)[-400:])
     if p.returncode != 0:
-        kind = ("transient rc 2 (tunnel re-wedged mid-A/B; retry)"
-                if p.returncode == 2 else f"rc {p.returncode}")
-        raise RuntimeError(kind + "\n" + (p.stderr or p.stdout)[-400:])
+        raise RuntimeError(f"rc {p.returncode}\n"
+                           + (p.stderr or p.stdout)[-400:])
     with open(_art("swim_diss_ab_r05.json")) as f:
         doc = json.load(f)
     return {"verdict": doc.get("verdict"),
@@ -358,6 +376,18 @@ def baseline_sweep():
         winner = swim_diss_winner()
         if winner:
             extra += ["--swim-diss", winner]
+        elif not os.path.exists(_art("swim_diss_ab_r05.json")):
+            # the SWIM row's whole point this round is re-measurement
+            # under the ARBITRATED lowering (VERDICT r4 1a).  If the A/B
+            # hasn't produced an artifact yet (step pending/transient),
+            # a sweep run now would go green under the CLI default and
+            # never be re-captured on retry (pending_steps skips green
+            # steps) — so stay pending until the A/B lands.  A written
+            # artifact with no winner (trajectory mismatch) is a real
+            # verdict: proceed under the default.
+            raise RuntimeError(
+                "blocked: swim_diss_ab has no artifact yet; the SWIM "
+                "row must be captured under the arbitrated lowering")
         p = subprocess.run([sys.executable, "-u", "-m", "gossip_tpu",
                             "sweep", "--scale", scale,
                             "--no-compile-cache", *extra],
