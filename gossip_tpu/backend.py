@@ -458,10 +458,17 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
             mesh = make_mesh(n_dev)
         dead, fail_round, meta = swim_scenario_meta(proto, tc.n, fault)
         swim_topo = None if tc.family == "complete" else topo
+        from gossip_tpu.models.swim import effective_diss
         meta.update({"clock": "rounds",
                      "suggested_suspect_rounds":
                          suggested_suspect_rounds(tc.n, proto.fanout),
-                     "devices": n_dev})
+                     "devices": n_dev,
+                     # the lowering disseminate_max actually ran: 'pack'
+                     # degrades to 'sort' when max_rounds proves no
+                     # transport-lane bound (bitwise-identical results,
+                     # but a benchmark must see the substitution)
+                     "swim_diss_effective": effective_diss(
+                         proto.swim_diss, run.max_rounds)})
         if proto.swim_rotate:
             meta["subject_window"] = "rotating"
             meta["epoch_rounds"] = resolve_epoch_rounds(proto, tc.n)

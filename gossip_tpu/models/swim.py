@@ -188,6 +188,18 @@ def pack_width(max_rounds) -> int:
     return 0
 
 
+def effective_diss(impl: str, max_rounds) -> str:
+    """The dissemination lowering :func:`disseminate_max` will actually
+    run: ``pack`` silently degrades to ``sort`` when no transport-lane
+    width fits (``pack_width`` 0 — unbounded ``max_rounds``).  Results
+    are bitwise-identical either way, but a benchmark of ``pack`` that
+    measured ``sort`` must be visible in run meta, not silent
+    (ADVICE r4: the no-silent-substitution policy)."""
+    if impl == "pack" and not pack_width(max_rounds):
+        return "sort"
+    return impl
+
+
 def disseminate_max(targets: jax.Array, wire: jax.Array, num_rows: int,
                     impl: str = "scatter", max_rounds=None) -> jax.Array:
     """Max-merge pushed wire rows into an ``int32[num_rows, S]`` table.

@@ -68,9 +68,16 @@ def sample_peers_complete(round_key: jax.Array, global_ids: jax.Array,
     """
     keys = node_keys(round_key, global_ids)
     # value check for ANY static integer (python or numpy scalar);
-    # only a traced bound skips it (callers guarantee n >= 2 there)
-    degenerate = (not isinstance(n_total, jax.core.Tracer)
-                  and int(n_total) <= 1)
+    # only a traced bound skips it (callers guarantee n >= 2 there).
+    # "is it traced" is probed by attempting the int() conversion
+    # itself rather than isinstance against jax.core.Tracer — the
+    # jax.core access path is deprecated/namespace-unstable, while
+    # the public error types are a supported API (ADVICE r4).
+    try:
+        degenerate = int(n_total) <= 1
+    except (jax.errors.TracerIntegerConversionError,
+            jax.errors.ConcretizationTypeError):
+        degenerate = False
     if exclude_self and not degenerate:
         def one(key, i):
             r = jax.random.randint(key, (k,), 0, n_total - 1, dtype=jnp.int32)

@@ -227,6 +227,16 @@ def run_with_checkpoints(step, state: State, rounds: int, path: str,
         jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
         save_state(path, state, meta_now())
     if rounds <= 0:
+        if curve_fn is not None and not isinstance(curve, dict) and not curve:
+            # zero segments ran, so the dict-vs-scalar branch above never
+            # told us curve_fn's shape: a dict-valued curve_fn must still
+            # return a dict of channels, not a bare empty list, or
+            # downstream channel extraction (e.g. the CLI's hot_curve)
+            # silently loses the names (ADVICE r4).  eval_shape reads the
+            # channel keys without running any compute.
+            shape = jax.eval_shape(curve_fn, state)
+            if isinstance(shape, dict):
+                curve = {k: [] for k in shape}
         save_state(path, state, meta_now())
     if curve_fn is None:
         return state

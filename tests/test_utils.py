@@ -180,4 +180,14 @@ def test_run_with_checkpoints_named_curve_channels(tmp_path):
         run_with_checkpoints(step, st0, rounds=2,
                              path=str(tmp_path / "bad.npz"),
                              curve_fn=channels, curve_prefix=[0.5])
+    # zero-rounds resume of an already-complete run: a dict-valued
+    # curve_fn must still return its named channels, never a bare []
+    # (ADVICE r4 — downstream channel extraction would silently lose
+    # the names)
+    st3, curve3 = run_with_checkpoints(step, load_state(p), rounds=0,
+                                       path=p, curve_fn=channels,
+                                       curve_prefix=())
+    assert isinstance(curve3, dict)
+    assert set(curve3) == {"coverage", "msgs"}
+    assert curve3 == {"coverage": [], "msgs": []}
 
