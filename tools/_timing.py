@@ -1,0 +1,31 @@
+"""Shared device-timing scaffold for the capture tools.
+
+One definition (roofline.py and kernel_numbers.py both time chained
+round applications): ``timed_chain`` returns SECONDS per iteration —
+callers convert to ms at the call site, so there is exactly one unit
+in this file and no ms/s twin to drift."""
+
+import time
+
+
+def timed_chain(step, init, iters: int) -> float:
+    """Median-of-3 wall seconds per iteration for ``iters`` chained
+    applications of ``step`` (i, carry) -> carry inside ONE jitted
+    fori_loop — no host dispatch in the measured region."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def chain(t0):
+        return jax.lax.fori_loop(
+            0, iters, lambda i, t: step(jnp.int32(i), t), t0)
+
+    out = chain(init)                   # compile + warm
+    jax.block_until_ready(out)
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = chain(init)
+        jax.block_until_ready(out)
+        samples.append((time.perf_counter() - t0) / iters)
+    return sorted(samples)[1]

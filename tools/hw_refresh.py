@@ -7,14 +7,15 @@ probes on a schedule and launches this script at the first healthy
 window).  The outer timeout must cover the sum of ALL per-step
 subprocess timeouts at their worst; ``worst_case_budget_s()`` below
 computes it from the same constants the steps use (at the default
-GOSSIP_BENCH_PROBE_ATTEMPTS=3 it is ~2100 (swim A/B) + 1200 (mr) +
-900 (prng) + 2400 (sweep) + ~6020 (bench worst case) + 2400 (pallas
-tests) = ~15,020 s):
+GOSSIP_BENCH_PROBE_ATTEMPTS=3 it is ~2100 (swim A/B) + 1500 (kernel
+numbers) + 1200 (mr) + 900 (prng) + 1200 (roofline) + 2400 (sweep) +
+2700 (ensembles) + ~6020 (bench worst case) + 2400 (pallas tests)
+= ~20,420 s):
 
-    timeout 15600 python tools/hw_refresh.py      # default attempts
+    timeout 21000 python tools/hw_refresh.py      # default attempts
     python tools/hw_refresh.py --smoke            # CPU-scale rehearsal
 
-``--smoke`` runs the SAME six-step pipeline at CPU scale on the
+``--smoke`` runs the SAME nine-step pipeline at CPU scale on the
 hermetic env (plugin disarmed, 8 virtual devices, interpreter-mode
 kernels, sweep --scale 0.002, single fast bench probe) writing
 ``.smoke``-infixed artifacts — a rehearsal of every subprocess,
@@ -27,12 +28,18 @@ important captures first):
   1. SWIM dissemination A/B (sort vs pack) on the BASELINE-1M shape
      -> artifacts/swim_diss_ab_r05.json  (VERDICT r4 task 1a)
   2. bench.py headline
-  3. staged big-table MR kernel validation at 10M x 32 rumors
+  3. PERF.md interactive-provenance kernel numbers re-measured
+     -> artifacts/kernel_numbers_r05.json  (task 1b)
+  4. staged big-table MR kernel validation at 10M x 32 rumors
      (post-padding variant) + per-round timing
-  4. hardware-PRNG digest of the plane-sharded fused round
-  5. the five BASELINE configs at full scale, SWIM row under the
+  5. hardware-PRNG digest of the plane-sharded fused round
+  6. roofline: utilization vs first-principles floors, both fused
+     layouts -> artifacts/roofline_r05.json  (task 3)
+  7. the five BASELINE configs at full scale, SWIM row under the
      arbitrated A/B winner -> artifacts/baseline_sweep_r05.jsonl
-  6. TPU-only pallas statistics tests
+  8. ensemble surface on hardware via the public CLI
+     -> artifacts/ensembles_r05.json  (task 6)
+  9. TPU-only pallas statistics tests
      -> artifacts/tpu_pallas_tests_r05.txt
 
 All step lines are also collected into artifacts/hw_refresh_r05.json.
@@ -108,8 +115,9 @@ def worst_case_budget_s():
     ``timeout`` can't silently drift below what a fully wedged run needs
     (bench's own worst case is computed by bench.py from its probe/body
     constants)."""
-    return (swim_ab_budget_s() + MR_TIMEOUT_S + PRNG_TIMEOUT_S
-            + SWEEP_TIMEOUT_S + bench_budget_s() + TESTS_TIMEOUT_S)
+    return (swim_ab_budget_s() + KERNEL_NUMBERS_TIMEOUT_S + MR_TIMEOUT_S
+            + PRNG_TIMEOUT_S + ROOFLINE_TIMEOUT_S + SWEEP_TIMEOUT_S
+            + ENSEMBLES_TIMEOUT_S + bench_budget_s() + TESTS_TIMEOUT_S)
 
 
 def load_summary():
@@ -305,6 +313,52 @@ def swim_diss_winner():
         return None
 
 
+KERNEL_NUMBERS_TIMEOUT_S = 1500
+ROOFLINE_TIMEOUT_S = 1200
+ENSEMBLES_TIMEOUT_S = 2700     # covers both sub-captures' own budgets
+
+
+def _run_tool(script: str, timeout_s: int):
+    """Run a capture tool (tools/<script>) and return ITS last stdout
+    JSON line — the tool owns its artifact, smoke infixing, and summary
+    keys (one definition, one file; hw_refresh never re-derives them).
+    rc 2 is the capture-tool transient convention (a sub-run hit the
+    wedge signature) and aborts the remaining steps via WedgeDetected."""
+    p = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", script),
+                        *_smoke_argv()],
+                       capture_output=True, text=True,
+                       timeout=timeout_s, cwd=REPO, env=_body_env())
+    if p.returncode == 2:
+        raise WedgeDetected(f"{script} rc 2 (wedge signature mid-run)\n"
+                            + (p.stderr or p.stdout)[-400:])
+    if p.returncode != 0:
+        raise RuntimeError(f"rc {p.returncode}\n"
+                           + (p.stderr or p.stdout)[-400:])
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
+def kernel_numbers():
+    """Re-measure docs/PERF.md's interactive-provenance kernel numbers
+    (VERDICT r4 task 1b) — single-rumor ms/round, VMEM OOM ladder,
+    topology build, fault-mask on-cost."""
+    return _run_tool("kernel_numbers.py", KERNEL_NUMBERS_TIMEOUT_S)
+
+
+def roofline():
+    """Utilization vs first-principles floors for both fused layouts
+    (VERDICT r4 task 3)."""
+    return _run_tool("roofline.py", ROOFLINE_TIMEOUT_S)
+
+
+def ensembles():
+    """The round-4 ensemble surface on hardware via the public CLI
+    (VERDICT r4 task 6).  The tool merges sub-captures incrementally;
+    a deterministic sub-capture failure (rc 1) keeps this pending for
+    the watchdog's bounded retries, a wedge (rc 2) aborts the rest."""
+    return _run_tool("ensemble_capture.py", ENSEMBLES_TIMEOUT_S)
+
+
 def prng_invariant():
     p = subprocess.run([sys.executable, os.path.abspath(__file__),
                         "--prng-body", *_smoke_argv()],
@@ -471,9 +525,12 @@ def tpu_pallas_tests():
 # retries are incremental (pending steps only).
 STEPS = [("swim_diss_ab", swim_diss_ab),
          ("bench", bench),
+         ("kernel_numbers", kernel_numbers),
          ("mr_staged_10m", mr_staged_10m),
          ("prng_invariant", prng_invariant),
+         ("roofline", roofline),
          ("baseline_sweep", baseline_sweep),
+         ("ensembles", ensembles),
          ("tpu_pallas_tests", tpu_pallas_tests)]
 
 

@@ -1,0 +1,330 @@
+#!/usr/bin/env python
+"""Roofline the fused kernels: is 74.6 ms the chip's floor? (VERDICT r4 #3)
+
+The r04 capture proved the flagship 10M-node pull SI runs 2.87 ms/round
+(fused value kernel) and the 10M x 32-rumor staged path 0.251 ms/round —
+but nowhere stated what fraction of the chip those numbers are.  This
+tool derives per-round floors from first principles, calibrates the
+primitive rates ON THE CHIP, measures the actual kernels in the same
+session, and writes artifacts/roofline_r05.json with utilization
+fractions.
+
+Methodology (stated honestly):
+
+* The per-round work is counted from the kernel structure in
+  ops/pallas_round.py (reference hot loop: /root/reference/main.go:72-88
+  — the semantics contract; the counts are ours, not the reference's):
+
+  - single-rumor value kernel (rows R = n_rows(n), fanout 1, all VMEM):
+      prng_words = 8*128 + 32*R*128      (sbits + one draw per plane)
+      gathers    = 32*R*128              (in-row dynamic_gather per plane)
+      vpu_ops   ~= (3*ceil(log2 R) + 7*32 + 4) * R*128
+  - staged big-MR path (rows M = mr_rows(n), table T = M*128*4 bytes):
+      HBM floor traffic = 5*T  (XLA rotation: read T + write rot T;
+      grid kernel: read table+rot 2T + write T).  If XLA instead
+      materialized every roll stage the traffic would be
+      (2*ceil(log2 M) + 3)*T — both floors are reported, and which one
+      the measured number lands near ARBITRATES the PERF.md claim that
+      the roll chain fuses to address arithmetic.
+
+* Primitive rates are calibrated with Pallas microkernels at the SAME
+  shapes the real kernel uses (draw count, gather count, op chain on
+  [R, 128] uint32): prng_rate from a draw-only kernel, gather_rate
+  differentially (draw+gather kernel minus the draw-only kernel, so the
+  shared PRNG cost cancels), vpu_rate from an elementwise chain,
+  hbm_rate from a streamed xor at the MR table size.
+
+* Floors are reported two ways: ``serial_ms`` (sum of component times —
+  exact if the units never overlap) and ``overlap_ms`` (max component —
+  exact if they overlap perfectly).  The truth lies between; both are
+  published so "utilization" can't be gamed by picking the flattering
+  denominator.
+
+Run at a healthy tunnel window (tools/tunnel_watchdog.py probes first;
+hw_refresh runs this as its ``roofline`` step).  ``--smoke`` rehearses
+the whole pipeline on the CPU interpreter at tiny shapes (the PRNG stub
+returns zeros — plumbing rehearsal, not statistics).
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+LANES = 128
+BITS = 32
+
+
+# ---------------------------------------------------------------- counts
+
+def single_rumor_counts(n: int) -> dict:
+    """Per-round primitive counts for the single-rumor value kernel
+    (ops/pallas_round._fused_round_kernel, fanout 1)."""
+    from gossip_tpu.ops.pallas_round import n_rows
+    rows = n_rows(n)
+    words = rows * LANES
+    stages = max(1, math.ceil(math.log2(rows)))
+    return {
+        "rows": rows,
+        "table_bytes": words * 4,
+        "prng_words": 8 * LANES + BITS * words,
+        "gathers": BITS * words,
+        # rotation: roll+cmp+select per stage; planes: ~7 elementwise
+        # ops around each gather (index math, shift, and, or); +4 mask
+        "vpu_ops": (3 * stages + 7 * BITS + 4) * words,
+    }
+
+
+def mr_staged_counts(n: int) -> dict:
+    """Per-round traffic/counts for the staged big-MR path
+    (ops/pallas_round._fused_mr_round_big)."""
+    from gossip_tpu.ops.pallas_round import mr_rows
+    rows = mr_rows(n)
+    words = rows * LANES
+    t_bytes = words * 4
+    stages = max(1, math.ceil(math.log2(rows)))
+    return {
+        "rows": rows,
+        "table_bytes": t_bytes,
+        "roll_stages": stages,
+        # fused rotation: read table + write rot; grid: read table+rot,
+        # write out
+        "hbm_bytes_fused_rot": 5 * t_bytes,
+        # if every roll stage materialized instead
+        "hbm_bytes_materialized_rot": (2 * stages + 3) * t_bytes,
+        "prng_words": words,
+        "gathers": words,
+    }
+
+
+# ---------------------------------------------------- timing scaffolding
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+try:
+    from _timing import timed_chain as _timed_chain  # noqa: E402
+finally:
+    sys.path.pop(0)
+
+
+def _microkernel(body, rows: int, interpret: bool):
+    """Shared pallas_call plumbing for the calibration kernels: SMEM
+    seed pair + VMEM table in/out (aliased), same as the real kernels'
+    (ops/pallas_round._fused_call)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def call(i, table):
+        seeds = jnp.stack([jnp.asarray(i, jnp.int32) * jnp.int32(1000003),
+                           jnp.asarray(i, jnp.int32)])
+        return pl.pallas_call(
+            body,
+            out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.uint32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                      pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            input_output_aliases={1: 0},
+            interpret=pltpu.InterpretParams() if interpret else False,
+        )(seeds, table)
+    return call
+
+
+def calibrate(rows: int, interpret: bool, iters: int) -> dict:
+    """Primitive rates at the single-rumor kernel's shapes.  Returns
+    words/s (prng), gathers/s, ops/s (vpu) — gather differentially so
+    the PRNG cost the two kernels share cancels."""
+    import jax.numpy as jnp
+    from jax.experimental.pallas import tpu as pltpu
+
+    words = rows * LANES
+
+    def prng_body(seed_ref, tin_ref, tout_ref):
+        pltpu.prng_seed(seed_ref[0], seed_ref[1])
+        acc = tin_ref[:]
+        for _ in range(BITS):
+            acc = acc | pltpu.bitcast(
+                pltpu.prng_random_bits((rows, LANES)), jnp.uint32)
+        tout_ref[:] = acc
+
+    def prng_gather_body(seed_ref, tin_ref, tout_ref):
+        pltpu.prng_seed(seed_ref[0], seed_ref[1])
+        table = tin_ref[:]
+        acc = table
+        for _ in range(BITS):
+            rb = pltpu.bitcast(
+                pltpu.prng_random_bits((rows, LANES)), jnp.uint32)
+            m = (rb & jnp.uint32(LANES - 1)).astype(jnp.int32)
+            acc = acc | jnp.take_along_axis(table, m, axis=1)
+        tout_ref[:] = acc
+
+    VPU_CHAIN = 256
+
+    def vpu_body(seed_ref, tin_ref, tout_ref):
+        acc = tin_ref[:]
+        s = seed_ref[0].astype(jnp.uint32)
+        for k in range(VPU_CHAIN):
+            # alternating dependent ops, constants folded per k so the
+            # chain cannot collapse
+            acc = (acc ^ (s + jnp.uint32(k))) | (acc >> jnp.uint32(1))
+        tout_ref[:] = acc
+
+    init = jnp.zeros((rows, LANES), jnp.uint32)
+    t_prng = _timed_chain(_microkernel(prng_body, rows, interpret),
+                          init, iters)
+    t_pg = _timed_chain(_microkernel(prng_gather_body, rows, interpret),
+                        init, iters)
+    t_vpu = _timed_chain(_microkernel(vpu_body, rows, interpret),
+                         init, iters)
+    t_gather = max(t_pg - t_prng, 1e-9)
+    return {
+        "shape": [rows, LANES],
+        "prng_words_per_s": BITS * words / t_prng,
+        "gathers_per_s": BITS * words / t_gather,
+        # 2 elementary ops per chain step (xor+add folded, or+shift)
+        "vpu_ops_per_s": 3 * VPU_CHAIN * words / t_vpu,
+        "t_prng_ms": t_prng * 1e3,
+        "t_prng_gather_ms": t_pg * 1e3,
+        "t_vpu_ms": t_vpu * 1e3,
+    }
+
+
+def hbm_rate(table_bytes: int, iters: int) -> dict:
+    """Streamed read+write rate at the MR table size (jitted xor chain:
+    each step reads T and writes T)."""
+    import jax
+    import jax.numpy as jnp
+
+    words = table_bytes // 4
+    init = jnp.zeros((words,), jnp.uint32)
+
+    def step(i, t):
+        return t ^ (i.astype(jnp.uint32) | jnp.uint32(1))
+
+    per_iter = _timed_chain(step, init, iters)
+    return {"bytes_per_s": 2 * table_bytes / per_iter,
+            "stream_ms_per_iter": per_iter * 1e3}
+
+
+# ------------------------------------------------------------ actual runs
+
+def measure_single(n: int, interpret: bool, rounds: int) -> float:
+    """Measured ms/round for the real single-rumor fused kernel."""
+    from gossip_tpu.ops.pallas_round import (fused_pull_round,
+                                             init_fused_state)
+    st = init_fused_state(n)
+
+    def step(i, table):
+        return fused_pull_round(table, 0, i, n, 1, interpret)
+
+    return _timed_chain(step, st.table, rounds) * 1e3
+
+
+def measure_mr_staged(n: int, rumors: int, interpret: bool,
+                      rounds: int) -> float:
+    """Measured ms/round for the real staged big-MR path."""
+    from gossip_tpu.ops.pallas_round import (fused_multirumor_pull_round,
+                                             init_multirumor_state)
+    st = init_multirumor_state(n, rumors)
+
+    def step(i, table):
+        return fused_multirumor_pull_round(table, 0, i, n, 1, interpret)
+
+    return _timed_chain(step, st.table, rounds) * 1e3
+
+
+# ----------------------------------------------------------------- driver
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=10_000_000)
+    ap.add_argument("--rumors", type=int, default=32)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CPU interpreter rehearsal at tiny shapes")
+    a = ap.parse_args()
+    smoke = a.smoke
+    if smoke:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        n, rumors, iters = 4096 * 8, 8, 2
+    else:
+        n, rumors, iters = a.n, a.rumors, a.iters
+
+    import jax
+    backend = jax.default_backend()
+
+    sr = single_rumor_counts(n)
+    mr = mr_staged_counts(n)
+
+    cal = calibrate(sr["rows"], smoke, iters)
+    hbm = hbm_rate(mr["table_bytes"], iters)
+
+    actual_sr_ms = measure_single(n, smoke, iters)
+    actual_mr_ms = measure_mr_staged(n, rumors, smoke, iters)
+
+    # component floors for the single-rumor kernel
+    prng_ms = sr["prng_words"] / cal["prng_words_per_s"] * 1e3
+    gather_ms = sr["gathers"] / cal["gathers_per_s"] * 1e3
+    vpu_ms = sr["vpu_ops"] / cal["vpu_ops_per_s"] * 1e3
+    serial_ms = prng_ms + gather_ms + vpu_ms
+    overlap_ms = max(prng_ms, gather_ms, vpu_ms)
+
+    # HBM floors for the staged path
+    mr_floor_fused = mr["hbm_bytes_fused_rot"] / hbm["bytes_per_s"] * 1e3
+    mr_floor_mat = (mr["hbm_bytes_materialized_rot"]
+                    / hbm["bytes_per_s"] * 1e3)
+
+    doc = {
+        "what": ("first-principles per-round floors vs measured actuals "
+                 "for both fused layouts; primitive rates calibrated "
+                 "on-chip this session (see module doc for the count "
+                 "derivations)"),
+        "backend": backend,
+        "smoke": smoke,
+        "n": n,
+        "rumors": rumors,
+        "calibration": {**cal, "hbm": hbm},
+        "single_rumor": {
+            "counts": sr,
+            "actual_ms_per_round": round(actual_sr_ms, 4),
+            "floor_components_ms": {"prng": round(prng_ms, 4),
+                                    "gather": round(gather_ms, 4),
+                                    "vpu": round(vpu_ms, 4)},
+            "floor_serial_ms": round(serial_ms, 4),
+            "floor_overlap_ms": round(overlap_ms, 4),
+            "utilization_vs_serial": round(serial_ms / actual_sr_ms, 4),
+            "utilization_vs_overlap": round(overlap_ms / actual_sr_ms, 4),
+        },
+        "mr_staged": {
+            "counts": mr,
+            "actual_ms_per_round": round(actual_mr_ms, 4),
+            "floor_ms_fused_rotation": round(mr_floor_fused, 4),
+            "floor_ms_materialized_rotation": round(mr_floor_mat, 4),
+            "utilization_vs_fused_floor": round(
+                mr_floor_fused / actual_mr_ms, 4),
+            "rotation_fuses": bool(actual_mr_ms < mr_floor_mat / 2),
+        },
+    }
+    infix = ".smoke" if smoke else ""
+    art = os.path.join(REPO, "artifacts", f"roofline_r05{infix}.json")
+    with open(art, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({"single_actual_ms": doc["single_rumor"]
+                      ["actual_ms_per_round"],
+                      "single_util_serial": doc["single_rumor"]
+                      ["utilization_vs_serial"],
+                      "mr_actual_ms": doc["mr_staged"]
+                      ["actual_ms_per_round"],
+                      "mr_util_hbm": doc["mr_staged"]
+                      ["utilization_vs_fused_floor"],
+                      "backend": backend, "smoke": smoke}))
+    print(f"wrote {art}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

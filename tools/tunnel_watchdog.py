@@ -192,9 +192,13 @@ def main():
             # re-wedged mid-run — keep probing and retry (bounded;
             # retries are incremental, re-running only non-green steps)
             refresh_attempts += 1
-            if refresh_attempts >= 3:
+            # round 5 runs nine incremental steps (up from six): more
+            # windows may be needed to land them all, and each retry
+            # only re-runs the non-green steps, so extra attempts are
+            # cheap when the tunnel is down and productive when it isn't
+            if refresh_attempts >= 6:
                 log_line({"event": "giving_up",
-                          "reason": "3 refresh attempts without a "
+                          "reason": "6 refresh attempts without a "
                                     "fully-green run", "last_rc": rc})
                 return 1
         if detail.startswith("fast-fail"):
