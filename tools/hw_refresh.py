@@ -9,13 +9,13 @@ subprocess timeouts at their worst; ``worst_case_budget_s()`` below
 computes it from the same constants the steps use (at the default
 GOSSIP_BENCH_PROBE_ATTEMPTS=3 it is ~2100 (swim A/B) + 1500 (kernel
 numbers) + 1200 (mr) + 900 (prng) + 1200 (roofline) + 2400 (sweep) +
-2700 (ensembles) + ~6020 (bench worst case) + 2400 (pallas tests)
-= ~20,420 s):
+1800 (swim ablation) + 2700 (ensembles) + ~6020 (bench worst case) +
+2400 (pallas tests) = ~22,220 s):
 
-    timeout 21000 python tools/hw_refresh.py      # default attempts
+    timeout 22800 python tools/hw_refresh.py      # default attempts
     python tools/hw_refresh.py --smoke            # CPU-scale rehearsal
 
-``--smoke`` runs the SAME nine-step pipeline at CPU scale on the
+``--smoke`` runs the SAME ten-step pipeline at CPU scale on the
 hermetic env (plugin disarmed, 8 virtual devices, interpreter-mode
 kernels, sweep --scale 0.002, single fast bench probe) writing
 ``.smoke``-infixed artifacts — a rehearsal of every subprocess,
@@ -37,9 +37,11 @@ important captures first):
      layouts -> artifacts/roofline_r05.json  (task 3)
   7. the five BASELINE configs at full scale, SWIM row under the
      arbitrated A/B winner -> artifacts/baseline_sweep_r05.jsonl
-  8. ensemble surface on hardware via the public CLI
+  8. SWIM steady-state ms/round decomposition by component stubbing
+     -> artifacts/swim_steady_ablation_r05.json  (task 4)
+  9. ensemble surface on hardware via the public CLI
      -> artifacts/ensembles_r05.json  (task 6)
-  9. TPU-only pallas statistics tests
+ 10. TPU-only pallas statistics tests
      -> artifacts/tpu_pallas_tests_r05.txt
 
 All step lines are also collected into artifacts/hw_refresh_r05.json.
@@ -117,7 +119,8 @@ def worst_case_budget_s():
     constants)."""
     return (swim_ab_budget_s() + KERNEL_NUMBERS_TIMEOUT_S + MR_TIMEOUT_S
             + PRNG_TIMEOUT_S + ROOFLINE_TIMEOUT_S + SWEEP_TIMEOUT_S
-            + ENSEMBLES_TIMEOUT_S + bench_budget_s() + TESTS_TIMEOUT_S)
+            + SWIM_ABLATION_TIMEOUT_S + ENSEMBLES_TIMEOUT_S
+            + bench_budget_s() + TESTS_TIMEOUT_S)
 
 
 def load_summary():
@@ -316,6 +319,7 @@ def swim_diss_winner():
 KERNEL_NUMBERS_TIMEOUT_S = 1500
 ROOFLINE_TIMEOUT_S = 1200
 ENSEMBLES_TIMEOUT_S = 2700     # covers both sub-captures' own budgets
+SWIM_ABLATION_TIMEOUT_S = 1800  # ~6 variants x ~130 s compile + timing
 
 
 def _run_tool(script: str, timeout_s: int):
@@ -357,6 +361,13 @@ def ensembles():
     a deterministic sub-capture failure (rc 1) keeps this pending for
     the watchdog's bounded retries, a wedge (rc 2) aborts the rest."""
     return _run_tool("ensemble_capture.py", ENSEMBLES_TIMEOUT_S)
+
+
+def swim_steady_ablation():
+    """Steady-state ms/round decomposition of the BASELINE SWIM shape
+    (VERDICT r4 task 4: name the residual 374 ms/round's owner or the
+    floor).  Merges variant rows across retries."""
+    return _run_tool("swim_steady_ablation.py", SWIM_ABLATION_TIMEOUT_S)
 
 
 def prng_invariant():
@@ -530,6 +541,7 @@ STEPS = [("swim_diss_ab", swim_diss_ab),
          ("prng_invariant", prng_invariant),
          ("roofline", roofline),
          ("baseline_sweep", baseline_sweep),
+         ("swim_steady_ablation", swim_steady_ablation),
          ("ensembles", ensembles),
          ("tpu_pallas_tests", tpu_pallas_tests)]
 

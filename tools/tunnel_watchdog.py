@@ -192,7 +192,7 @@ def main():
             # re-wedged mid-run — keep probing and retry (bounded;
             # retries are incremental, re-running only non-green steps)
             refresh_attempts += 1
-            # round 5 runs nine incremental steps (up from six): more
+            # round 5 runs ten incremental steps (up from six): more
             # windows may be needed to land them all, and each retry
             # only re-runs the non-green steps, so extra attempts are
             # cheap when the tunnel is down and productive when it isn't
