@@ -468,7 +468,8 @@ def run_jax(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
                      # transport-lane bound (bitwise-identical results,
                      # but a benchmark must see the substitution)
                      "swim_diss_effective": effective_diss(
-                         proto.swim_diss, run.max_rounds)})
+                         proto.swim_diss, run.max_rounds),
+                     "swim_rng": proto.swim_rng})
         if proto.swim_rotate:
             meta["subject_window"] = "rotating"
             meta["epoch_rounds"] = resolve_epoch_rounds(proto, tc.n)

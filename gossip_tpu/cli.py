@@ -197,6 +197,16 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                         "sort with the row gather on 8/16-bit packed "
                         "codes — needs --max-rounds to prove its lane "
                         "bound, silently falls back to sort without it")
+    p.add_argument("--swim-rng", choices=("split", "packed"),
+                   default="split",
+                   help="per-round randomness lowering: 'split' = one "
+                        "independent threefry chain per quantity (the "
+                        "original contract); 'packed' = one key chain + "
+                        "one multi-word draw per node, fields split by "
+                        "bits (opt-in statistical contract — different "
+                        "trajectories, uniform marginals up to a "
+                        "documented <= m/2^32 modulo bias, mesh-"
+                        "invariant; models/swim.packed_round_draws)")
     p.add_argument("--dead-nodes", nargs="*", type=int, default=None,
                    metavar="ID",
                    help="node ids that fail at --fail-round (swim scenario; "
@@ -217,6 +227,7 @@ def _args_to_configs(a):
                            swim_rotate=a.swim_rotate,
                            swim_epoch_rounds=a.swim_epoch_rounds,
                            swim_diss=a.swim_diss,
+                           swim_rng=a.swim_rng,
                            rumor_k=a.rumor_k,
                            rumor_variant=a.rumor_variant)
     tc = TopologyConfig(family=a.family, n=a.n, k=a.k, p=a.p,

@@ -108,6 +108,20 @@ class ProtocolConfig:
     # 'pack' needs the driver's max_rounds to prove its lane bound and
     # falls back to 'sort' where that is unknown.
     swim_diss: str = "sort"
+    # Per-round randomness lowering (models/swim.packed_round_draws):
+    # 'split' = the original contract — an independent fold_in+draw
+    # chain per random quantity (subject, proxies, peers, drop coins),
+    # ~5 threefry streams per node per round; 'packed' = ONE per-node
+    # key chain and ONE multi-word draw per round, bit-fields split
+    # into the same quantities.  Packed is an OPT-IN statistical
+    # contract change, not a relowering: trajectories differ from
+    # 'split' (different streams), per-draw marginals are uniform up to
+    # a documented modulo bias <= m/2^32 (m = the draw's range), and
+    # mesh-invariance (draws keyed by global node id) is preserved —
+    # the same contract class as the fused SI kernels vs the threefry
+    # path.  Motivation: PERF.md names the per-node threefry chains as
+    # a steady-state suspect at 1M nodes (VERDICT r4 task 4).
+    swim_rng: str = "split"
     # Rumor mongering (mode='rumor', models/rumor.py): an infective
     # (node, rumor) stops spreading — becomes removed, SIR — once its
     # unnecessary-contact counter reaches `rumor_k` (Demers et al. §1.4
@@ -130,6 +144,9 @@ class ProtocolConfig:
         if self.swim_diss not in ("scatter", "sort", "pack"):
             raise ValueError(f"unknown swim_diss {self.swim_diss!r}; "
                              "choose 'scatter', 'sort', or 'pack'")
+        if self.swim_rng not in ("split", "packed"):
+            raise ValueError(f"unknown swim_rng {self.swim_rng!r}; "
+                             "choose 'split' or 'packed'")
         if self.rumor_k < 1:
             raise ValueError("rumor_k must be >= 1")
         if self.rumor_variant not in RUMOR_VARIANTS:

@@ -308,6 +308,82 @@ def probe_draws(rkey, gids, s_count: int, n: int, proxies: int,
     return subj, d_drop, proxy_ids, to_p, p_to_s
 
 
+_PACKED_TAG = 16          # the packed-rng lowering's one fold_in tag
+
+
+def packed_round_draws(rkey, gids, s_count: int, n: int, proxies: int,
+                       fanout: int, drop_prob: float,
+                       nbrs=None, deg=None, sentinel: Optional[int] = None):
+    """ALL of a SWIM round's per-node randomness from ONE key chain and
+    ONE multi-word draw (``ProtocolConfig.swim_rng='packed'``).
+
+    The 'split' contract derives an independent per-node key chain per
+    random quantity — subject, proxies, dissemination peers, and (with
+    loss) three drop-coin streams — each a full threefry pass over
+    every node's key, ~5 such passes per node per round at the BASELINE
+    shape.  This lowering derives per-node keys ONCE
+    (``node_keys(fold_in(rkey, _PACKED_TAG), gids)``) and draws one
+    ``uint32[W]`` word vector per node, splitting fields:
+
+      word 0                      -> probed subject       (mod s_count)
+      words 1..proxies            -> proxy ids            (mod n)
+      next ``fanout`` words       -> dissemination peers
+                                     (complete: mod n-1 + self-shift;
+                                      table: mod deg, row gather)
+      [when drop_prob > 0]
+      next word                   -> direct-probe drop coin
+      next 2*proxies words        -> per-proxy hop drop coins
+                                     (uint32 threshold compare:
+                                      quantization 2^-32)
+
+    Statistical contract (opt-in; tests/test_swim.py): each field is
+    uniform on its range up to the documented modulo bias <= m/2^32
+    (m = range; 2.3e-4 relative at n=1M — the same documentation
+    standard as the fused kernel's rotation bias), fields of one node
+    are independent bits of one threefry stream, and draws are keyed by
+    GLOBAL node id, so the sharded twin reproduces them bitwise
+    (SURVEY.md §7 "Cross-shard randomness").  Trajectories differ from
+    'split' (different streams) — this is an engine-level contract
+    like fused-SI-vs-threefry, not a relowering.
+
+    Returns ``(subj, d_drop, proxy_ids, to_p, p_to_s, targets)`` —
+    probe_draws' tuple plus the dissemination targets."""
+    have_drop = drop_prob > 0.0
+    w = 1 + proxies + fanout + (1 + 2 * proxies if have_drop else 0)
+    keys = node_keys(jax.random.fold_in(rkey, _PACKED_TAG), gids)
+    words = jax.vmap(
+        lambda k: jax.random.bits(k, (w,), jnp.uint32))(keys)
+
+    subj = (words[:, 0] % jnp.uint32(s_count)).astype(jnp.int32)
+    proxy_ids = (words[:, 1:1 + proxies]
+                 % jnp.uint32(n)).astype(jnp.int32)
+    peer_w = words[:, 1 + proxies:1 + proxies + fanout]
+    if nbrs is None:
+        # complete graph; n >= 2 guaranteed by the swim_subjects <= n
+        # validation upstream
+        from gossip_tpu.ops.sampling import shift_excluding_self
+        r = (peer_w % jnp.uint32(max(n - 1, 1))).astype(jnp.int32)
+        targets = shift_excluding_self(r, gids[:, None])
+    else:
+        from gossip_tpu.ops.sampling import table_lookup_or_sentinel
+        idx = (peer_w % jnp.maximum(deg, 1)[:, None].astype(jnp.uint32)
+               ).astype(jnp.int32)
+        targets = table_lookup_or_sentinel(idx, nbrs, deg[:, None],
+                                           sentinel)
+
+    m = len(gids)
+    if have_drop:
+        thresh = jnp.uint32(min(int(drop_prob * 2**32), 2**32 - 1))
+        base = 1 + proxies + fanout
+        d_drop = words[:, base] < thresh
+        to_p = words[:, base + 1:base + 1 + proxies] < thresh
+        p_to_s = words[:, base + 1 + proxies:base + 1 + 2 * proxies] < thresh
+    else:
+        d_drop = jnp.zeros((m,), jnp.bool_)
+        to_p = p_to_s = jnp.zeros((m, proxies), jnp.bool_)
+    return subj, d_drop, proxy_ids, to_p, p_to_s, targets
+
+
 def make_swim_round(proto: ProtocolConfig, n: int,
                     dead_nodes: Tuple[int, ...] = (),
                     fail_round: int = 0,
@@ -369,8 +445,15 @@ def make_swim_round(proto: ProtocolConfig, n: int,
         wire0 = wire_prev
 
         # 1-2: probe + suspect -------------------------------------------
-        subj, d_drop, proxy_ids, to_p, p_to_s = probe_draws(
-            rkey, ids, s_count, n, proxies, drop_prob)
+        if proto.swim_rng == "packed":
+            (subj, d_drop, proxy_ids, to_p, p_to_s,
+             diss_targets) = packed_round_draws(
+                rkey, ids, s_count, n, proxies, fanout, drop_prob,
+                nbrs=nbrs, deg=deg, sentinel=n)
+        else:
+            subj, d_drop, proxy_ids, to_p, p_to_s = probe_draws(
+                rkey, ids, s_count, n, proxies, drop_prob)
+            diss_targets = None
         direct_ok = subj_alive[subj] & ~d_drop
         proxy_ok = (alive_now[proxy_ids] & ~to_p & ~p_to_s
                     & subj_alive[subj][:, None])
@@ -387,9 +470,13 @@ def make_swim_round(proto: ProtocolConfig, n: int,
                       * (1.0 + 4.0 * proxies))
 
         # 3: dissemination (scatter-max of wire rows) --------------------
-        dkey = jax.random.fold_in(rkey, _DISS_TAG)
-        targets = sample_peers(dkey, ids, topo, fanout, exclude_self=True,
-                               local_nbrs=nbrs, local_deg=deg)
+        if diss_targets is None:
+            dkey = jax.random.fold_in(rkey, _DISS_TAG)
+            targets = sample_peers(dkey, ids, topo, fanout,
+                                   exclude_self=True,
+                                   local_nbrs=nbrs, local_deg=deg)
+        else:
+            targets = diss_targets
         targets = jnp.where(alive_now[:, None], targets, n)   # dead: silent
         recv = disseminate_max(targets, wire1, n, proto.swim_diss,
                                max_rounds)

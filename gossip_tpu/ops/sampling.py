@@ -52,6 +52,25 @@ def apply_drop(round_key: jax.Array, tag: int, global_ids: jax.Array,
     return jnp.where(dropped, jnp.int32(sentinel), targets)
 
 
+def shift_excluding_self(r: jax.Array, gid) -> jax.Array:
+    """The complete-graph self-exclusion shift trick, ONE definition:
+    ``r`` uniform on [0, n-1) becomes uniform on [0, n) \\ {gid} by
+    bumping every draw >= gid.  Shape-polymorphic (broadcasts ``gid``
+    against ``r``) — shared by the per-key sampler below and the SWIM
+    packed-word lowering (models/swim.packed_round_draws)."""
+    return r + (r >= gid).astype(jnp.int32)
+
+
+def table_lookup_or_sentinel(idx: jax.Array, rows: jax.Array,
+                             deg: jax.Array, sentinel: int) -> jax.Array:
+    """Neighbor-table peer resolution, ONE definition: gather ``idx``
+    along each row; degree-0 rows emit the sentinel (dropped by
+    scatters, masked by gathers).  ``deg`` broadcasts against ``idx``
+    (scalar per row under vmap, or [N, 1] batched)."""
+    t = jnp.take_along_axis(rows, idx, axis=-1)
+    return jnp.where(deg > 0, t, jnp.int32(sentinel))
+
+
 def sample_peers_complete(round_key: jax.Array, global_ids: jax.Array,
                           n_total, k: int,
                           exclude_self: bool = True) -> jax.Array:
@@ -81,7 +100,7 @@ def sample_peers_complete(round_key: jax.Array, global_ids: jax.Array,
     if exclude_self and not degenerate:
         def one(key, i):
             r = jax.random.randint(key, (k,), 0, n_total - 1, dtype=jnp.int32)
-            return r + (r >= i).astype(jnp.int32)
+            return shift_excluding_self(r, i)
     else:
         def one(key, i):
             del i
@@ -102,8 +121,7 @@ def sample_peers_table(round_key: jax.Array, global_ids: jax.Array,
     def one(key, row, d):
         idx = jax.random.randint(key, (k,), 0, jnp.maximum(d, 1),
                                  dtype=jnp.int32)
-        t = row[idx]
-        return jnp.where(d > 0, t, jnp.int32(sentinel))
+        return table_lookup_or_sentinel(idx, row, d, sentinel)
 
     return jax.vmap(one)(keys, nbrs, deg)
 

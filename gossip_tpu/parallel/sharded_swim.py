@@ -87,8 +87,15 @@ def make_sharded_swim_round(
         nbrs_l, deg_l = table if have_table else (None, None)
 
         # 1-2: probe + suspect (draws keyed by global id — bitwise == twin)
-        subj, d_drop, proxy_ids, to_p, p_to_s = SW.probe_draws(
-            rkey, gids, s_count, n, proxies, drop_prob)
+        if proto.swim_rng == "packed":
+            (subj, d_drop, proxy_ids, to_p, p_to_s,
+             diss_targets) = SW.packed_round_draws(
+                rkey, gids, s_count, n, proxies, fanout, drop_prob,
+                nbrs=nbrs_l, deg=deg_l, sentinel=n)
+        else:
+            subj, d_drop, proxy_ids, to_p, p_to_s = SW.probe_draws(
+                rkey, gids, s_count, n, proxies, drop_prob)
+            diss_targets = None
         direct_ok = subj_alive[subj] & ~d_drop
         proxy_ok = (alive_full[proxy_ids] & ~to_p & ~p_to_s
                     & subj_alive[subj][:, None])
@@ -102,9 +109,13 @@ def make_sharded_swim_round(
                       * (1.0 + 4.0 * proxies))
 
         # 3: dissemination — local scatter-max, pmax over the mesh ---------
-        dkey = jax.random.fold_in(rkey, SW._DISS_TAG)
-        targets = sample_peers(dkey, gids, topo, fanout, exclude_self=True,
-                               local_nbrs=nbrs_l, local_deg=deg_l)
+        if diss_targets is None:
+            dkey = jax.random.fold_in(rkey, SW._DISS_TAG)
+            targets = sample_peers(dkey, gids, topo, fanout,
+                                   exclude_self=True,
+                                   local_nbrs=nbrs_l, local_deg=deg_l)
+        else:
+            targets = diss_targets
         msgs_local = msgs_local + jnp.sum(
             (targets < n) & alive_l[:, None]).astype(jnp.float32)
         # silent senders (dead/padding) -> n_pad so the scatter drops them

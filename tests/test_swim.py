@@ -193,6 +193,114 @@ ROTATE = ProtocolConfig(mode="swim", fanout=2, swim_proxies=2,
                         swim_rotate=True)
 
 
+PACKED = ProtocolConfig(mode="swim", fanout=2, swim_proxies=2,
+                        swim_suspect_rounds=4, swim_subjects=4,
+                        swim_rng="packed")
+
+
+@pytest.mark.parametrize("topo_fn", [lambda n: None,
+                                     lambda n: G.erdos_renyi(n, 0.1, seed=6)],
+                         ids=["complete", "er-table"])
+def test_packed_rng_sharded_bitwise_parity(topo_fn):
+    """swim_rng='packed' draws are keyed by GLOBAL node id, so the
+    sharded twin must reproduce the single-device trajectory bitwise —
+    the same mesh-invariance contract the 'split' scheme carries."""
+    n, dead = 96, (0, 2)
+    fault = FaultConfig(drop_prob=0.15, seed=8)
+    topo = topo_fn(n)
+    mesh = make_mesh(8)
+    single = run(make_swim_round(PACKED, n, dead, 4, fault, topo),
+                 init_swim_state(n, PACKED.swim_subjects, seed=9), 12)
+    sharded = run(
+        make_sharded_swim_round(PACKED, n, mesh, dead, 4, fault, topo),
+        init_sharded_swim_state(n, PACKED, mesh, seed=9), 12)
+    np.testing.assert_array_equal(np.asarray(sharded.wire)[:n],
+                                  np.asarray(single.wire))
+    np.testing.assert_array_equal(np.asarray(sharded.timer)[:n],
+                                  np.asarray(single.timer))
+    assert float(sharded.msgs) == pytest.approx(float(single.msgs))
+
+
+def test_packed_rng_detects_and_stays_accurate():
+    """The SWIM properties hold under the packed lowering: dead
+    subjects confirmed everywhere (completeness), and with no loss an
+    alive subject is never suspected (accuracy)."""
+    n, dead = 128, (1, 3)
+    step = make_swim_round(PACKED, n, dead_nodes=dead, fail_round=3)
+    st = run(step, init_swim_state(n, PACKED.swim_subjects, seed=0), 40)
+    status = np.asarray(decode_status(st.wire))
+    alive_obs = np.ones(n, bool)
+    alive_obs[list(dead)] = False
+    assert (status[alive_obs][:, list(dead)] == DEAD).all()
+    assert float(detection_fraction(st, dead)) > 0.97
+    # accuracy: no deaths, no loss -> never even SUSPECT
+    st2 = run(make_swim_round(PACKED, n),
+              init_swim_state(n, PACKED.swim_subjects, seed=1), 30)
+    assert (np.asarray(decode_status(st2.wire)) == ALIVE).all()
+
+
+def test_packed_rng_field_marginals():
+    """Distributional contract of packed_round_draws: every field is
+    uniform on its range (loose chi-square-style bound over many
+    rounds), peers exclude self on the complete graph, proxies cover
+    [0, n), and degree-0 table rows emit the sentinel."""
+    from gossip_tpu.models.swim import packed_round_draws
+    import jax.numpy as jnp
+    n, s_count, proxies, fanout = 64, 4, 3, 2
+    gids = jnp.arange(n, dtype=jnp.int32)
+    subj_counts = np.zeros(s_count)
+    proxy_counts = np.zeros(n)
+    peer_counts = np.zeros(n)
+    rounds = 200
+    base = jax.random.key(3)
+    jitted = jax.jit(packed_round_draws, static_argnums=(2, 3, 4, 5, 6))
+    for r in range(rounds):
+        rkey = jax.random.fold_in(base, r)
+        subj, d_drop, proxy_ids, to_p, p_to_s, targets = jitted(
+            rkey, gids, s_count, n, proxies, fanout, 0.0)
+        subj_counts += np.bincount(np.asarray(subj), minlength=s_count)
+        proxy_counts += np.bincount(
+            np.asarray(proxy_ids).ravel(), minlength=n)
+        t = np.asarray(targets)
+        assert ((t >= 0) & (t < n)).all()
+        assert (t != np.arange(n)[:, None]).all()      # self excluded
+        peer_counts += np.bincount(t.ravel(), minlength=n)
+        assert not np.asarray(d_drop).any()            # drop_prob 0
+        assert not np.asarray(to_p).any()
+    # uniformity: each bucket within 20% of the expected mean
+    for counts in (subj_counts, proxy_counts):
+        assert counts.min() > counts.mean() * 0.8
+        assert counts.max() < counts.mean() * 1.2
+    # peers exclude self, so each node is drawn n-1 times out of n(n-1)
+    assert peer_counts.min() > peer_counts.mean() * 0.8
+    assert peer_counts.max() < peer_counts.mean() * 1.2
+    # degree-0 rows emit the sentinel on the table path
+    nbrs = jnp.zeros((n, 4), jnp.int32)
+    deg = jnp.zeros((n,), jnp.int32).at[0].set(4)
+    _, _, _, _, _, t2 = packed_round_draws(
+        jax.random.fold_in(base, 0), gids, s_count, n, proxies, fanout,
+        0.0, nbrs=nbrs, deg=deg, sentinel=n)
+    t2 = np.asarray(t2)
+    assert (t2[1:] == n).all()
+    assert (t2[0] == 0).all()
+
+
+def test_packed_rng_drop_coins():
+    """Drop coins materialize with drop_prob > 0 at ~the right rate and
+    stay independent of the partner fields (distinct words)."""
+    from gossip_tpu.models.swim import packed_round_draws
+    import jax.numpy as jnp
+    n, proxies, fanout, p = 4096, 3, 2, 0.3
+    gids = jnp.arange(n, dtype=jnp.int32)
+    rkey = jax.random.fold_in(jax.random.key(5), 1)
+    _, d_drop, _, to_p, p_to_s, _ = packed_round_draws(
+        rkey, gids, 4, n, proxies, fanout, p)
+    for mask in (np.asarray(d_drop), np.asarray(to_p),
+                 np.asarray(p_to_s)):
+        rate = mask.mean()
+        assert 0.25 < rate < 0.35, rate
+
+
 def test_subject_window_covers_all_nodes():
     # Full-membership property: over one full rotation every node id
     # appears in some epoch's window.
