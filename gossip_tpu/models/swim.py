@@ -359,8 +359,12 @@ def packed_round_draws(rkey, gids, s_count: int, n: int, proxies: int,
                  % jnp.uint32(n)).astype(jnp.int32)
     peer_w = words[:, 1 + proxies:1 + proxies + fanout]
     if nbrs is None:
-        # complete graph; n >= 2 guaranteed by the swim_subjects <= n
-        # validation upstream
+        # complete graph.  Degenerate n=1 (one node, one subject —
+        # passes the swim_subjects <= n validation): the max(n-1, 1)
+        # guard makes the draw 0 and the self-shift maps it to gid+1,
+        # an out-of-range target the scatter's sentinel handling drops
+        # — the lone node gossips to nobody, like the split path's
+        # degenerate guard in sample_peers_complete.
         from gossip_tpu.ops.sampling import shift_excluding_self
         r = (peer_w % jnp.uint32(max(n - 1, 1))).astype(jnp.int32)
         targets = shift_excluding_self(r, gids[:, None])

@@ -291,16 +291,20 @@ def checkpointed_fused_planes(n: int, rumors: int, run: RunConfig,
     return final, cov, curve
 
 
-def simulate_curve_sharded_fused(n: int, rumors: int, run: RunConfig,
-                                 mesh: Mesh, fanout: int = 1,
-                                 interpret: bool = False, fault=None):
-    """(covs[max_rounds], final_planes): fixed-length scan over the
-    plane-sharded round recording per-round min-over-rumors coverage —
-    the curve twin of :func:`simulate_until_sharded_fused` (no early
-    exit; the caller derives rounds-to-target from the curve)."""
+@functools.lru_cache(maxsize=32)
+def _cached_curve_scan(n: int, run: RunConfig, mesh: Mesh, fanout: int,
+                       interpret: bool, fault):
+    """The compiled curve-scan driver, memoized by its full static
+    signature (every argument is hashable: the config dataclasses are
+    frozen, Mesh hashes structurally).  Re-entering the driver with the
+    same statics — a sweep server, the RPC sidecar, the multichip
+    dryrun's steady pass — reuses the jitted callable instead of
+    retracing the whole shard_map program per call (VERDICT r4 task 7:
+    driver-level steady timings must be executable-cache hits like
+    every other family's).  The plane state is a runtime ARGUMENT, so
+    different ``rumors`` shapes share one entry via jit's own cache."""
     step = make_sharded_fused_round(n, mesh, fanout, interpret,
                                     fault=fault, origin=run.origin)
-    init = init_plane_state(n, rumors, mesh, run.origin)
     cov_fn = fused_planes_cov_fn(n, fault, run.origin)
 
     @functools.partial(jax.jit, donate_argnums=0)
@@ -313,24 +317,32 @@ def simulate_curve_sharded_fused(n: int, rumors: int, run: RunConfig,
                                         None, length=run.max_rounds)
         return final, covs
 
+    return scan
+
+
+def simulate_curve_sharded_fused(n: int, rumors: int, run: RunConfig,
+                                 mesh: Mesh, fanout: int = 1,
+                                 interpret: bool = False, fault=None):
+    """(covs[max_rounds], final_planes): fixed-length scan over the
+    plane-sharded round recording per-round min-over-rumors coverage —
+    the curve twin of :func:`simulate_until_sharded_fused` (no early
+    exit; the caller derives rounds-to-target from the curve)."""
+    scan = _cached_curve_scan(n, run, mesh, fanout, interpret, fault)
+    init = init_plane_state(n, rumors, mesh, run.origin)
     final, covs = scan(init)
     return covs, final
 
 
-def simulate_until_sharded_fused(n: int, rumors: int, run: RunConfig,
-                                 mesh: Mesh, fanout: int = 1,
-                                 interpret: bool = False, fault=None):
-    """(rounds, coverage, msgs, final_planes): compiled while_loop to
-    min-over-rumors target coverage on the plane-sharded state.
-
-    msgs counts transmissions (request + whole-digest response per
-    partner draw, all W words riding one exchange): 2*fanout*n/round.
-    ``fault`` threads the static fault masks into every plane's kernel;
-    the cond and the reported coverage switch to the alive-weighted
-    metric (fused_planes_cov_fn — one chooser for both)."""
+@functools.lru_cache(maxsize=32)
+def _cached_until_loop(n: int, run: RunConfig, mesh: Mesh, fanout: int,
+                       interpret: bool, fault):
+    """(loop, cov_fn): the compiled until-target driver, memoized like
+    :func:`_cached_curve_scan` (same key contract and rationale).  The
+    cov_fn used by the loop's cond is RETURNED too, so the caller
+    reports coverage through the same chooser the convergence test used
+    — one chooser for both."""
     step = make_sharded_fused_round(n, mesh, fanout, interpret,
                                     fault=fault, origin=run.origin)
-    init = init_plane_state(n, rumors, mesh, run.origin)
     target = jnp.float32(run.target_coverage)
     cov_fn = fused_planes_cov_fn(n, fault, run.origin)
 
@@ -347,6 +359,23 @@ def simulate_until_sharded_fused(n: int, rumors: int, run: RunConfig,
 
         return jax.lax.while_loop(cond, body, (planes, jnp.int32(0)))
 
+    return loop, cov_fn
+
+
+def simulate_until_sharded_fused(n: int, rumors: int, run: RunConfig,
+                                 mesh: Mesh, fanout: int = 1,
+                                 interpret: bool = False, fault=None):
+    """(rounds, coverage, msgs, final_planes): compiled while_loop to
+    min-over-rumors target coverage on the plane-sharded state.
+
+    msgs counts transmissions (request + whole-digest response per
+    partner draw, all W words riding one exchange): 2*fanout*n/round.
+    ``fault`` threads the static fault masks into every plane's kernel;
+    the cond and the reported coverage switch to the alive-weighted
+    metric (fused_planes_cov_fn — one chooser for both)."""
+    loop, cov_fn = _cached_until_loop(n, run, mesh, fanout, interpret,
+                                      fault)
+    init = init_plane_state(n, rumors, mesh, run.origin)
     final, rounds = loop(init)
     rounds = int(rounds)
     cov = float(cov_fn(final))
