@@ -681,7 +681,9 @@ def cmd_sweep(a) -> int:
                                                swim_diss=a.swim_diss))
                    if cfg["proto"].mode == "swim" else cfg
                    for cfg in configs]
+    import time as _time
     for cfg in configs:
+        t0_row = _time.perf_counter()
         report = run_simulation(cfg["backend"], cfg["proto"], cfg["tc"],
                                 cfg["run"], None, cfg.get("mesh"),
                                 want_curve=a.curve)
@@ -699,6 +701,19 @@ def cmd_sweep(a) -> int:
                                  ProtocolConfig(mode="flood"), cfg["tc"],
                                  cfg["run"], want_curve=a.curve)
             out["gonative_ref"] = ref.to_dict()
+        # row-level reconciliation (VERDICT r4 task 5): the ROW wall is
+        # everything this config cost — engine wall + topo build + the
+        # go-native reference run + residual host overhead — so
+        # row_wall_s ~= wall_s + meta.topo_build_s +
+        # gonative_ref.wall_s + row_overhead_s by construction, and the
+        # r04 table's ~10 s of unattributed first-row time can never
+        # recur unexplained
+        row_wall = _time.perf_counter() - t0_row
+        parts = (out["wall_s"]
+                 + (out.get("meta") or {}).get("topo_build_s", 0.0)
+                 + (out.get("gonative_ref") or {}).get("wall_s", 0.0))
+        out["row_wall_s"] = round(row_wall, 4)
+        out["row_overhead_s"] = round(max(0.0, row_wall - parts), 4)
         print(json.dumps(out), flush=True)
     return 0
 

@@ -218,9 +218,11 @@ def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
 def simulate_until_halo(proto: ProtocolConfig, topo: Topology,
                         run: RunConfig, mesh: Mesh,
                         fault: Optional[FaultConfig] = None,
-                        axis_name: str = "nodes"):
+                        axis_name: str = "nodes", timing=None):
     """lax.while_loop to target coverage on the O(band) halo path.
-    Returns (rounds, coverage, msgs, final_state, band)."""
+    Returns (rounds, coverage, msgs, final_state, band).
+    ``timing``: optional compile/steady AOT-split dict."""
+    from gossip_tpu.utils.trace import maybe_aot_timed
     from gossip_tpu.models.si import coverage
     from gossip_tpu.parallel.sharded import init_sharded_state
     step, tables = make_halo_round(proto, topo, mesh, fault, run.origin,
@@ -239,7 +241,7 @@ def simulate_until_halo(proto: ProtocolConfig, topo: Topology,
             return step(s, *tbl)
         return jax.lax.while_loop(cond, body, state)
 
-    final = loop(init, *tables)
+    final = maybe_aot_timed(loop, timing, init, *tables)
     alive = alive_mask(fault, n, run.origin)
     return (int(final.round), float(coverage(final.seen, alive)),
             float(final.msgs), final, band_of(topo))
@@ -248,9 +250,11 @@ def simulate_until_halo(proto: ProtocolConfig, topo: Topology,
 def simulate_curve_halo(proto: ProtocolConfig, topo: Topology,
                         run: RunConfig, mesh: Mesh,
                         fault: Optional[FaultConfig] = None,
-                        axis_name: str = "nodes"):
+                        axis_name: str = "nodes", timing=None):
     """lax.scan over rounds recording (coverage, msgs) on the halo path.
-    Returns (coverage[T], msgs[T], final_state, band)."""
+    Returns (coverage[T], msgs[T], final_state, band).
+    ``timing``: optional compile/steady AOT-split dict."""
+    from gossip_tpu.utils.trace import maybe_aot_timed
     from gossip_tpu.models.si import coverage
     from gossip_tpu.parallel.sharded import init_sharded_state
     step, tables = make_halo_round(proto, topo, mesh, fault, run.origin,
@@ -266,5 +270,5 @@ def simulate_curve_halo(proto: ProtocolConfig, topo: Topology,
             return s, (coverage(s.seen, alive), s.msgs)
         return jax.lax.scan(body, state, None, length=run.max_rounds)
 
-    final, (covs, msgs) = scan(init, *tables)
+    final, (covs, msgs) = maybe_aot_timed(scan, timing, init, *tables)
     return np.asarray(covs), np.asarray(msgs), final, band_of(topo)

@@ -238,11 +238,16 @@ def init_sharded_state(run: RunConfig, proto: ProtocolConfig, topo: Topology,
 def simulate_curve_sharded(proto: ProtocolConfig, topo: Topology,
                            run: RunConfig, mesh: Mesh,
                            fault: Optional[FaultConfig] = None,
-                           axis_name: str = "nodes"):
+                           axis_name: str = "nodes", timing=None):
     """``lax.scan`` over rounds recording (coverage, msgs) per round, state
     resident sharded.  Sharded twin of runtime/simulator.simulate_curve.
-    Returns (coverage[T], msgs[T], final_state) as host arrays/state."""
+    Returns (coverage[T], msgs[T], final_state) as host arrays/state.
+    ``timing``: optional dict filled with the compile/steady AOT split
+    (utils/trace.maybe_aot_timed — VERDICT r4 task 5: sharded rows must
+    decompose like single-device ones)."""
     import numpy as np
+
+    from gossip_tpu.utils.trace import maybe_aot_timed
     step, tables = make_sharded_si_round(proto, topo, mesh, fault,
                                          run.origin, axis_name, tabled=True)
     n_pad = pad_to_mesh(topo.n, mesh, axis_name)
@@ -256,17 +261,19 @@ def simulate_curve_sharded(proto: ProtocolConfig, topo: Topology,
             return s, (coverage(s.seen, alive_pad), s.msgs)
         return jax.lax.scan(body, state, None, length=run.max_rounds)
 
-    final, (covs, msgs) = scan(init, *tables)
+    final, (covs, msgs) = maybe_aot_timed(scan, timing, init, *tables)
     return np.asarray(covs), np.asarray(msgs), final
 
 
 def simulate_until_sharded(proto: ProtocolConfig, topo: Topology,
                            run: RunConfig, mesh: Mesh,
                            fault: Optional[FaultConfig] = None,
-                           axis_name: str = "nodes"):
+                           axis_name: str = "nodes", timing=None):
     """``lax.while_loop`` to target coverage, whole loop one XLA program, state
     resident sharded across the mesh.  Returns (rounds, coverage, msgs, state).
-    """
+    ``timing``: optional compile/steady AOT-split dict (see
+    simulate_curve_sharded)."""
+    from gossip_tpu.utils.trace import maybe_aot_timed
     step, tables = make_sharded_si_round(proto, topo, mesh, fault,
                                          run.origin, axis_name, tabled=True)
     n_pad = pad_to_mesh(topo.n, mesh, axis_name)
@@ -284,6 +291,6 @@ def simulate_until_sharded(proto: ProtocolConfig, topo: Topology,
             return step(s, *tbl)
         return jax.lax.while_loop(cond, body, state)
 
-    final = loop(init, *tables)
+    final = maybe_aot_timed(loop, timing, init, *tables)
     return (int(final.round), float(coverage(final.seen, alive_pad)),
             float(final.msgs), final)

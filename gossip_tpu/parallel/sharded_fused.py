@@ -292,29 +292,32 @@ def checkpointed_fused_planes(n: int, rumors: int, run: RunConfig,
 
 
 @functools.lru_cache(maxsize=32)
-def _cached_curve_scan(n: int, run: RunConfig, mesh: Mesh, fanout: int,
-                       interpret: bool, fault):
-    """The compiled curve-scan driver, memoized by its full static
-    signature (every argument is hashable: the config dataclasses are
-    frozen, Mesh hashes structurally).  Re-entering the driver with the
-    same statics — a sweep server, the RPC sidecar, the multichip
-    dryrun's steady pass — reuses the jitted callable instead of
-    retracing the whole shard_map program per call (VERDICT r4 task 7:
-    driver-level steady timings must be executable-cache hits like
-    every other family's).  The plane state is a runtime ARGUMENT, so
-    different ``rumors`` shapes share one entry via jit's own cache."""
+def _cached_curve_scan(n: int, seed: int, max_rounds: int, origin: int,
+                       mesh: Mesh, fanout: int, interpret: bool, fault):
+    """The compiled curve-scan driver, memoized by EXACTLY the statics
+    its trace bakes in (seed and max_rounds are closed-over literals;
+    origin feeds the step and the coverage chooser) — not the whole
+    RunConfig, whose unused fields (engine, checkpoint knobs) would
+    fragment the cache.  Every argument is hashable (Mesh hashes
+    structurally).  Re-entering the driver with the same statics — a
+    sweep server, the RPC sidecar, the multichip dryrun's steady pass —
+    reuses the jitted callable instead of retracing the whole shard_map
+    program per call (VERDICT r4 task 7: driver-level steady timings
+    must be executable-cache hits like every other family's).  The
+    plane state is a runtime ARGUMENT, so different ``rumors`` shapes
+    share one entry via jit's own cache."""
     step = make_sharded_fused_round(n, mesh, fanout, interpret,
-                                    fault=fault, origin=run.origin)
-    cov_fn = fused_planes_cov_fn(n, fault, run.origin)
+                                    fault=fault, origin=origin)
+    cov_fn = fused_planes_cov_fn(n, fault, origin)
 
     @functools.partial(jax.jit, donate_argnums=0)
     def scan(planes):
         def body(c, _):
             planes_c, round_c = c
-            planes_n = step(planes_c, run.seed, round_c)
+            planes_n = step(planes_c, seed, round_c)
             return (planes_n, round_c + 1), cov_fn(planes_n)
         (final, _), covs = jax.lax.scan(body, (planes, jnp.int32(0)),
-                                        None, length=run.max_rounds)
+                                        None, length=max_rounds)
         return final, covs
 
     return scan
@@ -322,40 +325,48 @@ def _cached_curve_scan(n: int, run: RunConfig, mesh: Mesh, fanout: int,
 
 def simulate_curve_sharded_fused(n: int, rumors: int, run: RunConfig,
                                  mesh: Mesh, fanout: int = 1,
-                                 interpret: bool = False, fault=None):
+                                 interpret: bool = False, fault=None,
+                                 timing=None):
     """(covs[max_rounds], final_planes): fixed-length scan over the
     plane-sharded round recording per-round min-over-rumors coverage —
     the curve twin of :func:`simulate_until_sharded_fused` (no early
-    exit; the caller derives rounds-to-target from the curve)."""
-    scan = _cached_curve_scan(n, run, mesh, fanout, interpret, fault)
+    exit; the caller derives rounds-to-target from the curve).
+    ``timing``: optional compile/steady AOT-split dict
+    (parallel/sharded.simulate_curve_sharded contract; the AOT path
+    bypasses the memoized executable to measure a real compile)."""
+    from gossip_tpu.utils.trace import maybe_aot_timed
+    scan = _cached_curve_scan(n, run.seed, run.max_rounds, run.origin,
+                              mesh, fanout, interpret, fault)
     init = init_plane_state(n, rumors, mesh, run.origin)
-    final, covs = scan(init)
+    final, covs = maybe_aot_timed(scan, timing, init)
     return covs, final
 
 
 @functools.lru_cache(maxsize=32)
-def _cached_until_loop(n: int, run: RunConfig, mesh: Mesh, fanout: int,
-                       interpret: bool, fault):
+def _cached_until_loop(n: int, seed: int, max_rounds: int,
+                       target_coverage: float, origin: int, mesh: Mesh,
+                       fanout: int, interpret: bool, fault):
     """(loop, cov_fn): the compiled until-target driver, memoized like
-    :func:`_cached_curve_scan` (same key contract and rationale).  The
-    cov_fn used by the loop's cond is RETURNED too, so the caller
-    reports coverage through the same chooser the convergence test used
-    — one chooser for both."""
+    :func:`_cached_curve_scan` (same key contract and rationale, plus
+    the target the cond compares against).  The cov_fn used by the
+    loop's cond is RETURNED too, so the caller reports coverage through
+    the same chooser the convergence test used — one chooser for
+    both."""
     step = make_sharded_fused_round(n, mesh, fanout, interpret,
-                                    fault=fault, origin=run.origin)
-    target = jnp.float32(run.target_coverage)
-    cov_fn = fused_planes_cov_fn(n, fault, run.origin)
+                                    fault=fault, origin=origin)
+    target = jnp.float32(target_coverage)
+    cov_fn = fused_planes_cov_fn(n, fault, origin)
 
     @functools.partial(jax.jit, donate_argnums=0)
     def loop(planes):
         def cond(c):
             planes_c, round_c = c
             return ((cov_fn(planes_c) < target)
-                    & (round_c < run.max_rounds))
+                    & (round_c < max_rounds))
 
         def body(c):
             planes_c, round_c = c
-            return step(planes_c, run.seed, round_c), round_c + 1
+            return step(planes_c, seed, round_c), round_c + 1
 
         return jax.lax.while_loop(cond, body, (planes, jnp.int32(0)))
 
@@ -364,7 +375,8 @@ def _cached_until_loop(n: int, run: RunConfig, mesh: Mesh, fanout: int,
 
 def simulate_until_sharded_fused(n: int, rumors: int, run: RunConfig,
                                  mesh: Mesh, fanout: int = 1,
-                                 interpret: bool = False, fault=None):
+                                 interpret: bool = False, fault=None,
+                                 timing=None):
     """(rounds, coverage, msgs, final_planes): compiled while_loop to
     min-over-rumors target coverage on the plane-sharded state.
 
@@ -372,11 +384,14 @@ def simulate_until_sharded_fused(n: int, rumors: int, run: RunConfig,
     partner draw, all W words riding one exchange): 2*fanout*n/round.
     ``fault`` threads the static fault masks into every plane's kernel;
     the cond and the reported coverage switch to the alive-weighted
-    metric (fused_planes_cov_fn — one chooser for both)."""
-    loop, cov_fn = _cached_until_loop(n, run, mesh, fanout, interpret,
-                                      fault)
+    metric (fused_planes_cov_fn — one chooser for both).  ``timing``:
+    optional compile/steady AOT-split dict (see the curve twin)."""
+    from gossip_tpu.utils.trace import maybe_aot_timed
+    loop, cov_fn = _cached_until_loop(n, run.seed, run.max_rounds,
+                                      run.target_coverage, run.origin,
+                                      mesh, fanout, interpret, fault)
     init = init_plane_state(n, rumors, mesh, run.origin)
-    final, rounds = loop(init)
+    final, rounds = maybe_aot_timed(loop, timing, init)
     rounds = int(rounds)
     cov = float(cov_fn(final))
     msgs = 2.0 * fanout * n * rounds

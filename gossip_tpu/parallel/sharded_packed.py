@@ -209,7 +209,10 @@ def checkpointed_packed_sharded(proto: ProtocolConfig, topo: Topology,
 def simulate_until_packed_sharded(proto: ProtocolConfig, topo: Topology,
                                   run: RunConfig, mesh: Mesh,
                                   fault: Optional[FaultConfig] = None,
-                                  axis_name: str = "nodes"):
+                                  axis_name: str = "nodes", timing=None):
+    """``timing``: optional compile/steady AOT-split dict
+    (parallel/sharded.simulate_until_sharded contract)."""
+    from gossip_tpu.utils.trace import maybe_aot_timed
     step, tables = make_sharded_packed_round(proto, topo, mesh, fault,
                                              run.origin, axis_name,
                                              tabled=True)
@@ -229,7 +232,7 @@ def simulate_until_packed_sharded(proto: ProtocolConfig, topo: Topology,
             return step(s, *tbl)
         return jax.lax.while_loop(cond, body, state)
 
-    final = loop(init, *tables)
+    final = maybe_aot_timed(loop, timing, init, *tables)
     return (int(final.round),
             float(coverage_packed(final.seen, r, alive_pad)),
             float(final.msgs), final)

@@ -704,10 +704,14 @@ def simulate_curve_topo_sparse(proto: ProtocolConfig, topo, run: RunConfig,
                                mesh: Mesh,
                                fault: Optional[FaultConfig] = None,
                                axis_name: str = "nodes",
-                               cap: Optional[int] = None):
+                               cap: Optional[int] = None, timing=None):
     """lax.scan over rounds on the explicit-topology sparse pull path.
-    Returns (coverage[T], msgs[T], final, SparseMeta, overflow[T])."""
+    Returns (coverage[T], msgs[T], final, SparseMeta, overflow[T]).
+    ``timing``: optional compile/steady AOT-split dict
+    (parallel/sharded.simulate_curve_sharded contract)."""
     import numpy as np
+
+    from gossip_tpu.utils.trace import maybe_aot_timed
     p = mesh.shape[axis_name]
     cap_used = resolve_topo_cap(topo, p, proto.fanout, cap)
     step, tables = make_sparse_topo_pull_round(proto, topo, mesh, fault,
@@ -727,7 +731,8 @@ def simulate_curve_topo_sparse(proto: ProtocolConfig, topo, run: RunConfig,
         return jax.lax.scan(body, (state, jnp.float32(0.0)), None,
                             length=run.max_rounds)
 
-    (final, _), (covs, msgs, ovfs) = scan(init, *tables)
+    (final, _), (covs, msgs, ovfs) = maybe_aot_timed(scan, timing,
+                                                     init, *tables)
     meta = sparse_topo_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
                             cap_used,
                             bidirectional=proto.mode == C.ANTI_ENTROPY)
@@ -739,10 +744,11 @@ def simulate_until_topo_sparse(proto: ProtocolConfig, topo, run: RunConfig,
                                mesh: Mesh,
                                fault: Optional[FaultConfig] = None,
                                axis_name: str = "nodes",
-                               cap: Optional[int] = None):
+                               cap: Optional[int] = None, timing=None):
     """while_loop to target coverage on the explicit-topology sparse pull
     path.  Returns (rounds, coverage, msgs, final, SparseMeta, overflow).
-    """
+    ``timing``: optional compile/steady AOT-split dict."""
+    from gossip_tpu.utils.trace import maybe_aot_timed
     p = mesh.shape[axis_name]
     cap_used = resolve_topo_cap(topo, p, proto.fanout, cap)
     step, tables = make_sparse_topo_pull_round(proto, topo, mesh, fault,
@@ -768,7 +774,7 @@ def simulate_until_topo_sparse(proto: ProtocolConfig, topo, run: RunConfig,
         return jax.lax.while_loop(cond, body,
                                   (state, jnp.float32(0.0)))
 
-    final, ovf = loop(init, *tables)
+    final, ovf = maybe_aot_timed(loop, timing, init, *tables)
     meta = sparse_topo_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
                             cap_used,
                             bidirectional=proto.mode == C.ANTI_ENTROPY)
@@ -779,10 +785,13 @@ def simulate_until_topo_sparse(proto: ProtocolConfig, topo, run: RunConfig,
 
 def simulate_curve_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
                           mesh: Mesh, fault: Optional[FaultConfig] = None,
-                          axis_name: str = "nodes"):
+                          axis_name: str = "nodes", timing=None):
     """lax.scan over rounds recording (coverage, msgs) on the sparse
-    exchange path.  Returns (coverage[T], msgs[T], final, SparseMeta)."""
+    exchange path.  Returns (coverage[T], msgs[T], final, SparseMeta).
+    ``timing``: optional compile/steady AOT-split dict."""
     import numpy as np
+
+    from gossip_tpu.utils.trace import maybe_aot_timed
     step = make_sparse_pull_round(proto, n, mesh, fault, run.origin,
                                   axis_name)
     p = mesh.shape[axis_name]
@@ -798,7 +807,7 @@ def simulate_curve_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
             return s, (coverage_packed(s.seen, r, alive_pad), s.msgs)
         return jax.lax.scan(body, state, None, length=run.max_rounds)
 
-    final, (covs, msgs) = scan(init)
+    final, (covs, msgs) = maybe_aot_timed(scan, timing, init)
     meta = sparse_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
                        bidirectional=proto.mode == C.ANTI_ENTROPY)
     return np.asarray(covs), np.asarray(msgs), final, meta
@@ -806,9 +815,11 @@ def simulate_curve_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
 
 def simulate_until_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
                           mesh: Mesh, fault: Optional[FaultConfig] = None,
-                          axis_name: str = "nodes"):
+                          axis_name: str = "nodes", timing=None):
     """while_loop to target coverage on the sparse exchange path.
-    Returns (rounds, coverage, msgs, final_state, SparseMeta)."""
+    Returns (rounds, coverage, msgs, final_state, SparseMeta).
+    ``timing``: optional compile/steady AOT-split dict."""
+    from gossip_tpu.utils.trace import maybe_aot_timed
     step = make_sparse_pull_round(proto, n, mesh, fault, run.origin,
                                   axis_name)
     p = mesh.shape[axis_name]
@@ -828,7 +839,7 @@ def simulate_until_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
                     & (s.round < run.max_rounds))
         return jax.lax.while_loop(cond, step, state)
 
-    final = loop(init)
+    final = maybe_aot_timed(loop, timing, init)
     meta = sparse_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
                        bidirectional=proto.mode == C.ANTI_ENTROPY)
     return (int(final.round),
