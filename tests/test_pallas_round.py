@@ -305,10 +305,19 @@ def test_mr_staged_big_path_bitwise_matches_value_kernel(n):
                                        inject_bits=(sbits, rbits))
     got = _fused_mr_round_big(table, 0, 0, n, not ON_TPU, (sbits, rbits))
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
-    # routing: the flagship 10M x 32 x fanout-1 picks the big path; small
-    # tables and fanout>1 stay on the value kernel
+    # fanout 2 (round 5): multi-pass accumulation must still compute the
+    # value kernel's function bitwise on identical injected bits
+    sbits2, rbits2 = _mr_bits(rng, rows, 2)
+    want2 = fused_multirumor_pull_round(table, 0, 0, n, 2,
+                                        interpret=not ON_TPU,
+                                        inject_bits=(sbits2, rbits2))
+    got2 = _fused_mr_round_big(table, 0, 0, n, not ON_TPU,
+                               (sbits2, rbits2), fanout=2)
+    np.testing.assert_array_equal(np.asarray(got2), np.asarray(want2))
+    # routing: any over-VMEM table picks the big path regardless of
+    # fanout (round 5); small tables stay on the value kernel
     assert _mr_wants_big(mr_rows(10_000_000) * LANES * 4, 1)
-    assert not _mr_wants_big(mr_rows(10_000_000) * LANES * 4, 2)
+    assert _mr_wants_big(mr_rows(10_000_000) * LANES * 4, 2)
     assert not _mr_wants_big(mr_rows(1_000_000) * LANES * 4, 1)
 
 

@@ -20,6 +20,9 @@ artifacts/kernel_numbers_r05.json:
   4. fault-mask on-cost at the 10M flagship shape: ms/round with
      masks off vs drop_prob=0.05 + 1% dead nodes in-kernel (designed
      ~zero off / one VMEM AND per pull on — round-5 candidate #3)
+  5. the staged big-MR path at fanout 2 (round-5 multi-pass
+     accumulation) timed at the flagship shape — VERDICT r4 task 8's
+     "route works at 10M x 32 fanout=2" as a measured row
 
 Reference for the hot loop all of these serve: /root/reference/
 main.go:72-88 (semantics contract; the numbers are ours).
@@ -98,6 +101,25 @@ def vmem_oom_ladder(n: int, rumors: int, interpret: bool) -> dict:
     return out
 
 
+def mr_staged_fanout2_ms(n: int, rumors: int, interpret: bool,
+                         rounds: int) -> dict:
+    """Per-round ms of the staged big-MR path at fanout 2 (round-5
+    multi-pass accumulation — VERDICT r4 task 8 wants the route proven
+    at the flagship 10M x 32 shape; expected ~2x the fanout-1 HBM
+    cost)."""
+    from gossip_tpu.ops import pallas_round as PR
+    st = PR.init_multirumor_state(n, rumors)
+    # call the staged path DIRECTLY: at smoke scale the public router
+    # would pick the value kernel and the artifact row would mislabel
+    # which code path produced the number
+    ms = _time_rounds(
+        lambda i, t: PR._fused_mr_round_big(t, 0, i, n, interpret, None,
+                                            fanout=2),
+        st.table, rounds)
+    return {"n": n, "rumors": rumors, "fanout": 2, "path": "staged_big",
+            "ms_per_round": round(ms, 4)}
+
+
 def topology_build_s(n: int) -> dict:
     from gossip_tpu.config import TopologyConfig
     from gossip_tpu.topology import generators as G
@@ -154,6 +176,7 @@ def main():
                     "module doc for the four items"),
            "backend": backend, "smoke": smoke}
     doc["single_rumor"] = single_rumor_ms(n, smoke, rounds)
+    doc["mr_staged_fanout2"] = mr_staged_fanout2_ms(n, 32, smoke, rounds)
     doc["vmem_oom_ladder"] = vmem_oom_ladder(n, 32, smoke)
     doc["topology_build"] = topology_build_s(topo_n)
     doc["fault_mask"] = fault_mask_cost(n, smoke, rounds)
@@ -163,6 +186,8 @@ def main():
     with open(art, "w") as f:
         json.dump(doc, f, indent=1)
     print(json.dumps({"single_ms": doc["single_rumor"]["ms_per_round"],
+                      "mr_fanout2_ms": doc["mr_staged_fanout2"]
+                      ["ms_per_round"],
                       "oom_captured": not doc["vmem_oom_ladder"]
                       .get("value_kernel_compiles", True),
                       "topo_build_s": doc["topology_build"]["build_s"],
