@@ -24,7 +24,7 @@ Layout:
   - :mod:`gossip_tpu.utils`    — metrics, checkpointing, tracing
 """
 
-__version__ = "0.4.1"
+__version__ = "0.5.0"
 
 from gossip_tpu.config import (  # noqa: F401
     FaultConfig,

@@ -1,6 +1,6 @@
 """The parity-matrix artifact regenerates (VERDICT r3 item 4).
 
-One race-free cell of artifacts/parity_r04.json is rebuilt end-to-end
+One race-free cell of artifacts/parity_r05.json is rebuilt end-to-end
 through the same tool path that wrote the artifact (tools/parity_matrix
 -> `gossip-tpu run --parity-check` subprocess -> both engines) and must
 reproduce the exact-zero contract: on a power-of-two ring, jax rounds

@@ -4,7 +4,7 @@
 Runs the broadcast workload twice through `gossip-tpu maelstrom-check`
 — the reference-shaped immediate fan-out and the interval-batched
 variant (VERDICT r3 item 7) — on the same seeded 5-node line at a high
-op rate, and writes ``artifacts/maelstrom_batching_r04.json`` with both
+op rate, and writes ``artifacts/maelstrom_batching_r05.json`` with both
 reports plus the Glomers-style gates the batched run is held to
 (msgs-per-op <= 12 on a 5-node line at 20 values; the checker's
 eventual-delivery invariant on both).  Routing counts are measured from
@@ -21,7 +21,7 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-ART = os.path.join(REPO, "artifacts", "maelstrom_batching_r04.json")
+ART = os.path.join(REPO, "artifacts", "maelstrom_batching_r05.json")
 
 
 def check(*extra, n=5, ops=20):

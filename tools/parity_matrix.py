@@ -6,7 +6,7 @@ Runs ``gossip-tpu run --parity-check`` (jax-tpu flood rounds vs the
 go-native event engine's hop depths — the C++ core above 20k nodes) over
 every explicit family — {ring, grid, erdos_renyi} across {~1k, ~100k,
 ~1M}, plus watts_strogatz and power_law at the 100k-class size — and
-writes ONE artifact, ``artifacts/parity_r04.json``, with every contract
+writes ONE artifact, ``artifacts/parity_r05.json``, with every contract
 metric per cell:
 
   * ``curve_gap``           — exactly 0.0 on 'exact'-tier rows (race-
@@ -34,7 +34,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-ART = os.path.join(REPO, "artifacts", "parity_r04.json")
+ART = os.path.join(REPO, "artifacts", "parity_r05.json")
 
 # Expectation tiers, measured before they were codified:
 #   exact        — curve_gap EXACTLY 0.0: race-free graph (k=2 ring or
