@@ -90,6 +90,24 @@ def test_backend_sharded_path():
     assert len(r.curve) == 64
 
 
+def test_wall_reconciliation_contract():
+    """VERDICT r4 task 5: every reported wall decomposes in the report
+    itself — wall == compile_s + steady_wall_s + driver_overhead_s, the
+    topology build is attributed separately, and the split exists on
+    SHARDED engines too (round 4 left them as one fused wall)."""
+    proto = ProtocolConfig(mode="pull", fanout=1)
+    tc = TopologyConfig(family="erdos_renyi", n=1024, p=0.02)
+    run = RunConfig(max_rounds=64)
+    for mesh_cfg in (None, MeshConfig(n_devices=8)):
+        r = run_simulation("jax-tpu", proto, tc, run, mesh_cfg=mesh_cfg)
+        m = r.meta
+        assert m["topo_build_s"] >= 0.0
+        parts = (m["compile_s"] + m["steady_wall_s"]
+                 + m["driver_overhead_s"])
+        # == up to the 4-decimal rounding of the three parts
+        assert r.wall_s == pytest.approx(parts, abs=2e-3)
+
+
 def test_backend_packed_routing_matches_bool_path():
     # pull/anti-entropy route through the bit-packed engine; trajectories
     # are bitwise-identical to the bool path, so rounds-to-target and final
@@ -375,6 +393,15 @@ def test_cli_sweep_smoke():
     assert byname["push-complete-64-goref"]["gonative_ref"]["coverage"] == 1.0
     assert byname["multirumor-10m-sharded"]["meta"]["devices"] == 4
     assert all(line["coverage"] >= 0.99 for line in lines)
+    # row-level reconciliation (VERDICT r4 task 5): the row wall covers
+    # engine wall + topo build + the go-native ref + named residual
+    for line in lines:
+        parts = (line["wall_s"]
+                 + (line.get("meta") or {}).get("topo_build_s", 0.0)
+                 + (line.get("gonative_ref") or {}).get("wall_s", 0.0)
+                 + line["row_overhead_s"])
+        assert line["row_wall_s"] >= line["wall_s"]
+        assert abs(line["row_wall_s"] - parts) < 0.05
 
 
 def test_fused_auto_routing_decision():
