@@ -449,10 +449,19 @@ def baseline_sweep():
             # never be re-captured on retry (pending_steps skips green
             # steps) — so stay pending until the A/B lands.  A written
             # artifact with no winner (trajectory mismatch) is a real
-            # verdict: proceed under the default.
-            raise RuntimeError(
-                "blocked: swim_diss_ab has no artifact yet; the SWIM "
-                "row must be captured under the arbitrated lowering")
+            # verdict, and so is a recorded DETERMINISTIC A/B failure
+            # (e.g. the candidate lowering crashing on the chip — rc 1,
+            # no artifact): both proceed under the default rather than
+            # blocking the five-config capture forever.
+            ab = load_summary().get("swim_diss_ab", {})
+            deterministic_ab_failure = (
+                ab and not ab.get("ok") and not ab.get("timed_out")
+                and "WedgeDetected" not in ab.get("error", ""))
+            if not deterministic_ab_failure:
+                raise RuntimeError(
+                    "blocked: swim_diss_ab has no artifact yet; the "
+                    "SWIM row must be captured under the arbitrated "
+                    "lowering")
         p = subprocess.run([sys.executable, "-u", "-m", "gossip_tpu",
                             "sweep", "--scale", scale,
                             "--no-compile-cache", *extra],
