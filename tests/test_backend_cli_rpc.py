@@ -405,6 +405,23 @@ def test_cli_sweep_smoke():
         assert abs(line["row_wall_s"] - parts) < 0.05
 
 
+def test_cli_sweep_swim_diss_override():
+    """`sweep --swim-diss` re-measures the SWIM row under an A/B-
+    arbitrated lowering without a code change (hw_refresh contract);
+    trajectories must be identical across lowerings and the effective
+    lowering must be visible in the row's meta."""
+    rows = {}
+    for impl in ("sort", "pack"):
+        p = _cli("sweep", "--scale", "0.002", "--only", "swim-powerlaw-1m",
+                 "--swim-diss", impl)
+        assert p.returncode == 0, p.stderr
+        rows[impl] = json.loads(p.stdout.splitlines()[0])
+        assert rows[impl]["meta"]["swim_diss_effective"] == impl
+    a, b = rows["sort"], rows["pack"]
+    assert (a["rounds"], a["coverage"], a["msgs"]) == \
+        (b["rounds"], b["coverage"], b["msgs"])
+
+
 def test_fused_auto_routing_decision():
     """engine='auto' picks the fused engine exactly when a single-device
     run satisfies every _run_fused precondition (quietly)."""
