@@ -163,7 +163,8 @@ def _fused_call(kernel, rows: int, seed, round_, table, inject_bits,
 
 def _fused_round_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
                         n_valid_words: int, tail_mask: int, inject: bool,
-                        drop_threshold: int = 0, has_alive: bool = False):
+                        drop_threshold: int = 0, has_alive: bool = False,
+                        plane_sharing: int = 1):
     """One pull round, entirely in VMEM.  See module doc for the scheme.
 
     ``inject=True`` replaces the hardware PRNG with caller-supplied bit
@@ -209,24 +210,37 @@ def _fused_round_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
 
     # Stages 2+3: per destination bit-plane k, draw (lane m, bit c) per
     # word, gather the partner word in-row, pull bit c into plane k.
+    # ``plane_sharing=2`` (round-5 opt-in — the roofline's PRNG-harvest
+    # candidate): a PAIR of adjacent planes splits one 32-bit draw —
+    # plane j of the pair uses bits 12j..12j+6 (lane) and 12j+7..12j+11
+    # (bit choice), disjoint bits of one uniform word, so per-node
+    # partner marginals stay exactly uniform while the PRNG word count
+    # halves.  A DIFFERENT stream from sharing=1 (engine-level
+    # statistical contract, like fused-vs-threefry); incompatible with
+    # the drop coin (which owns bits 12..31 at sharing=1), enforced by
+    # the caller.
     acc = table
-    for k in range(BITS):
+    for k in range(0, BITS, plane_sharing):
         for f in range(fanout):
             if inject:
-                rb = rbits_ref[k * fanout + f]
+                rb = rbits_ref[(k // plane_sharing) * fanout + f]
             else:
                 rb = pltpu.bitcast(pltpu.prng_random_bits((rows, LANES)),
                                    jnp.uint32)
-            m = (rb & jnp.uint32(LANES - 1)).astype(jnp.int32)
-            c = (rb >> jnp.uint32(7)) & jnp.uint32(BITS - 1)
-            partner = jnp.take_along_axis(rot, m, axis=1)
-            bit = (partner >> c) & jnp.uint32(1)
-            if drop_threshold:
-                keep = (rb >> jnp.uint32(12)) >= jnp.uint32(drop_threshold)
-                bit = jnp.where(keep, bit, jnp.uint32(0))
-            if has_alive:
-                bit = bit & ((alive >> jnp.uint32(k)) & jnp.uint32(1))
-            acc = acc | (bit << jnp.uint32(k))
+            for j in range(plane_sharing):
+                sh = jnp.uint32(12 * j)
+                m = ((rb >> sh) & jnp.uint32(LANES - 1)).astype(jnp.int32)
+                c = (rb >> (sh + jnp.uint32(7))) & jnp.uint32(BITS - 1)
+                partner = jnp.take_along_axis(rot, m, axis=1)
+                bit = (partner >> c) & jnp.uint32(1)
+                if drop_threshold:
+                    keep = ((rb >> jnp.uint32(12))
+                            >= jnp.uint32(drop_threshold))
+                    bit = jnp.where(keep, bit, jnp.uint32(0))
+                if has_alive:
+                    bit = bit & ((alive >> jnp.uint32(k + j))
+                                 & jnp.uint32(1))
+                acc = acc | (bit << jnp.uint32(k + j))
 
     # Zero phantom words so phantom nodes never read as infected.
     word_id = (jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0) * LANES
@@ -241,19 +255,30 @@ def _fused_round_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
 
 @functools.partial(jax.jit,
                    static_argnames=("n", "fanout", "interpret",
-                                    "drop_threshold"))
+                                    "drop_threshold", "plane_sharing"))
 def fused_pull_round(table: jax.Array, seed: jax.Array, round_: jax.Array,
                      n: int, fanout: int = 1, interpret: bool = False,
                      inject_bits=None, drop_threshold: int = 0,
-                     alive_table=None) -> jax.Array:
+                     alive_table=None, plane_sharing: int = 1) -> jax.Array:
     """Apply one fused pull round to a node-packed table. Pure; jittable.
 
     ``inject_bits`` (tests only): a ``(sbits uint32[8,128], rbits
-    uint32[fanout*32, rows, 128])`` pair replacing the hardware PRNG —
-    see _fused_round_kernel.  ``drop_threshold``/``alive_table`` are the
-    static fault masks (same docstring); both default off and leave the
-    fault-free lowering unchanged.
+    uint32[fanout*32//plane_sharing, rows, 128])`` pair replacing the
+    hardware PRNG — see _fused_round_kernel.  ``drop_threshold``/
+    ``alive_table`` are the static fault masks (same docstring); both
+    default off and leave the fault-free lowering unchanged.
+    ``plane_sharing=2`` halves the PRNG words per round by splitting one
+    draw's disjoint bit-fields across an adjacent plane pair — an
+    OPT-IN different stream (kernel docstring); requires no drop coin.
     """
+    if plane_sharing not in (1, 2):
+        raise ValueError(f"plane_sharing must be 1 or 2, "
+                         f"got {plane_sharing}")
+    if plane_sharing > 1 and drop_threshold:
+        raise ValueError(
+            "plane_sharing=2 splits the draw's bit-fields across a "
+            "plane pair and leaves no room for the 20-bit drop coin; "
+            "use plane_sharing=1 with drop_prob faults")
     rows = table.shape[0]
     n_valid_words = -(-n // BITS)
     tail = n % BITS
@@ -263,7 +288,8 @@ def fused_pull_round(table: jax.Array, seed: jax.Array, round_: jax.Array,
         n_valid_words=n_valid_words, tail_mask=tail_mask,
         inject=inject_bits is not None,
         drop_threshold=drop_threshold,
-        has_alive=alive_table is not None)
+        has_alive=alive_table is not None,
+        plane_sharing=plane_sharing)
     return _fused_call(kernel, rows, seed, round_, table, inject_bits,
                        interpret, alive_table=alive_table)
 

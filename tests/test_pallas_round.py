@@ -27,22 +27,26 @@ from gossip_tpu.ops.pallas_round import (
 ON_TPU = jax.default_backend() == "tpu"
 
 
-def numpy_reference_round(table, sbits, rbits, n, fanout):
-    """Independent model of the kernel's documented sampling scheme."""
+def numpy_reference_round(table, sbits, rbits, n, fanout, sharing=1):
+    """Independent model of the kernel's documented sampling scheme
+    (``sharing=2``: a plane pair splits one draw's disjoint 12-bit
+    fields — the round-5 PRNG-harvest variant)."""
     rows = table.shape[0]
     s = (sbits[0, :].astype(np.uint64) % rows).astype(np.int64)   # [128]
     # rot[i, j] = table[(i - s_j) mod rows, j]
     i = np.arange(rows)[:, None]
     rot = table[(i - s[None, :]) % rows, np.arange(LANES)[None, :]]
     acc = table.copy()
-    for k in range(BITS):
+    for k in range(0, BITS, sharing):
         for f in range(fanout):
-            rb = rbits[k * fanout + f]
-            m = rb & (LANES - 1)
-            c = (rb >> 7) & (BITS - 1)
-            partner = np.take_along_axis(rot, m.astype(np.int64), axis=1)
-            bit = (partner >> c) & 1
-            acc = acc | (bit.astype(np.uint32) << np.uint32(k))
+            rb = rbits[(k // sharing) * fanout + f]
+            for j in range(sharing):
+                m = (rb >> (12 * j)) & (LANES - 1)
+                c = (rb >> (12 * j + 7)) & (BITS - 1)
+                partner = np.take_along_axis(rot, m.astype(np.int64),
+                                             axis=1)
+                bit = (partner >> c) & 1
+                acc = acc | (bit.astype(np.uint32) << np.uint32(k + j))
     # phantom masking
     flat = acc.reshape(-1)
     n_valid_words = -(-n // BITS)
@@ -54,26 +58,43 @@ def numpy_reference_round(table, sbits, rbits, n, fanout):
     return out.reshape(rows, LANES)
 
 
-def _random_bits(rng, rows, fanout):
+def _random_bits(rng, rows, fanout, sharing=1):
+    """Injected-bit buffers at the kernel's contract shapes — the ONE
+    place the (sbits, rbits) layout lives (``sharing`` divides the rbits
+    draw count: a plane pair shares one word)."""
     sbits = rng.integers(0, 2**32, size=(8, LANES), dtype=np.uint32)
-    rbits = rng.integers(0, 2**32, size=(fanout * BITS, rows, LANES),
+    rbits = rng.integers(0, 2**32,
+                         size=(fanout * BITS // sharing, rows, LANES),
                          dtype=np.uint32)
     return sbits, rbits
 
 
-@pytest.mark.parametrize("n,fanout", [(4096 * 8, 1), (4096 * 8 - 37, 1),
-                                      (4096 * 16, 2)])
-def test_kernel_math_matches_numpy_model(n, fanout):
-    rng = np.random.default_rng(42 + n + fanout)
+@pytest.mark.parametrize("n,fanout,sharing",
+                         [(4096 * 8, 1, 1), (4096 * 8 - 37, 1, 1),
+                          (4096 * 16, 2, 1),
+                          (4096 * 8, 1, 2), (4096 * 8 - 37, 2, 2)])
+def test_kernel_math_matches_numpy_model(n, fanout, sharing):
+    rng = np.random.default_rng(42 + n + fanout + sharing)
     rows = n_rows(n)
     infected = rng.random(n) < 0.03
     table = np.asarray(node_pack(jnp.asarray(infected)))
-    sbits, rbits = _random_bits(rng, rows, fanout)
+    sbits, rbits = _random_bits(rng, rows, fanout, sharing)
     got = fused_pull_round(jnp.asarray(table), 0, 0, n, fanout,
                            interpret=not ON_TPU,
-                           inject_bits=(sbits, rbits))
-    want = numpy_reference_round(table, sbits, rbits, n, fanout)
+                           inject_bits=(sbits, rbits),
+                           plane_sharing=sharing)
+    want = numpy_reference_round(table, sbits, rbits, n, fanout, sharing)
     np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_plane_sharing_validation():
+    t = init_fused_state(4096 * 8).table
+    with pytest.raises(ValueError, match="plane_sharing"):
+        fused_pull_round(t, 0, 0, 4096 * 8, 1, interpret=not ON_TPU,
+                         plane_sharing=3)
+    with pytest.raises(ValueError, match="drop coin"):
+        fused_pull_round(t, 0, 0, 4096 * 8, 1, interpret=not ON_TPU,
+                         drop_threshold=1000, plane_sharing=2)
 
 
 def test_pack_unpack_roundtrip():

@@ -148,9 +148,20 @@ def main():
         fc = s["floor_components_ms"]
         print(f"  components: prng {fc['prng']} ms, gather "
               f"{fc['gather']} ms, vpu {fc['vpu']} ms")
+        if not s.get("gather_floor_resolved", True):
+            print("  WARNING: gather rate unresolved (differential "
+                  "below noise) — the floors are lower bounds missing "
+                  "the gather term; re-run before quoting utilization")
         dom = max(fc, key=fc.get)
         print(f"  dominant primitive: {dom} — the harvest target if "
               "utilization is high and actual >> floor")
+        s2 = s.get("actual_ms_plane_sharing2")
+        if s2 is not None:
+            verdict = ("WINS — consider shipping as the bench variant"
+                       if s2 < s["actual_ms_per_round"] * 0.95
+                       else "no win")
+            print(f"  plane_sharing=2 (half the PRNG words): {s2} "
+                  f"ms/round -> {verdict}")
         m = rf["mr_staged"]
         print(f"- staged MR: {m['actual_ms_per_round']} ms/round vs HBM "
               f"floor {m['floor_ms_fused_rotation']} ms (fused rot) / "

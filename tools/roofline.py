@@ -180,11 +180,18 @@ def calibrate(rows: int, interpret: bool, iters: int) -> dict:
                         init, iters)
     t_vpu = _timed_chain(_microkernel(vpu_body, rows, interpret),
                          init, iters)
-    t_gather = max(t_pg - t_prng, 1e-9)
+    # the differential only resolves the gather when the combined kernel
+    # is measurably slower than draw-only; below ~5% of t_prng the
+    # difference is timing noise (or fusion hid the gather entirely) and
+    # an honest artifact must say "unresolved", not emit an impossible
+    # 1e13 gathers/s that skews the floors
+    t_gather = t_pg - t_prng
+    resolved = t_gather > 0.05 * t_prng
     return {
         "shape": [rows, LANES],
         "prng_words_per_s": BITS * words / t_prng,
-        "gathers_per_s": BITS * words / t_gather,
+        "gathers_per_s": (BITS * words / t_gather) if resolved else None,
+        "gather_resolved": resolved,
         # 2 elementary ops per chain step (xor+add folded, or+shift)
         "vpu_ops_per_s": 3 * VPU_CHAIN * words / t_vpu,
         "t_prng_ms": t_prng * 1e3,
@@ -212,14 +219,18 @@ def hbm_rate(table_bytes: int, iters: int) -> dict:
 
 # ------------------------------------------------------------ actual runs
 
-def measure_single(n: int, interpret: bool, rounds: int) -> float:
-    """Measured ms/round for the real single-rumor fused kernel."""
+def measure_single(n: int, interpret: bool, rounds: int,
+                   plane_sharing: int = 1) -> float:
+    """Measured ms/round for the real single-rumor fused kernel
+    (``plane_sharing=2``: the PRNG-harvest variant — half the draw
+    words; measuring both arbitrates the harvest on-chip)."""
     from gossip_tpu.ops.pallas_round import (fused_pull_round,
                                              init_fused_state)
     st = init_fused_state(n)
 
     def step(i, table):
-        return fused_pull_round(table, 0, i, n, 1, interpret)
+        return fused_pull_round(table, 0, i, n, 1, interpret,
+                                plane_sharing=plane_sharing)
 
     return _timed_chain(step, st.table, rounds) * 1e3
 
@@ -264,11 +275,17 @@ def main():
     hbm = hbm_rate(mr["table_bytes"], iters)
 
     actual_sr_ms = measure_single(n, smoke, iters)
+    actual_sr2_ms = measure_single(n, smoke, iters, plane_sharing=2)
     actual_mr_ms = measure_mr_staged(n, rumors, smoke, iters)
 
-    # component floors for the single-rumor kernel
+    # component floors for the single-rumor kernel.  An unresolved
+    # gather rate contributes 0 to the floor (a LOWER bound stays valid
+    # — the true floor can only be higher) and is flagged so consumers
+    # (tools/postcapture.py) don't present a skewed utilization as
+    # doc-ready.
     prng_ms = sr["prng_words"] / cal["prng_words_per_s"] * 1e3
-    gather_ms = sr["gathers"] / cal["gathers_per_s"] * 1e3
+    gather_ms = (sr["gathers"] / cal["gathers_per_s"] * 1e3
+                 if cal["gather_resolved"] else 0.0)
     vpu_ms = sr["vpu_ops"] / cal["vpu_ops_per_s"] * 1e3
     serial_ms = prng_ms + gather_ms + vpu_ms
     overlap_ms = max(prng_ms, gather_ms, vpu_ms)
@@ -291,9 +308,15 @@ def main():
         "single_rumor": {
             "counts": sr,
             "actual_ms_per_round": round(actual_sr_ms, 4),
+            # the PRNG-harvest candidate (plane pairs split one draw;
+            # opt-in different stream — ops/pallas_round docstring):
+            # if this beats actual_ms and PRNG is the dominant floor
+            # component, the harvest is proven on-chip
+            "actual_ms_plane_sharing2": round(actual_sr2_ms, 4),
             "floor_components_ms": {"prng": round(prng_ms, 4),
                                     "gather": round(gather_ms, 4),
                                     "vpu": round(vpu_ms, 4)},
+            "gather_floor_resolved": cal["gather_resolved"],
             "floor_serial_ms": round(serial_ms, 4),
             "floor_overlap_ms": round(overlap_ms, 4),
             "utilization_vs_serial": round(serial_ms / actual_sr_ms, 4),
