@@ -164,6 +164,10 @@ def _timing_meta(timing: Dict[str, float],
         return {}
     out = {"compile_s": round(timing["compile_s"], 4),
            "steady_wall_s": round(timing["steady_s"], 4)}
+    if "init_build_s" in timing:
+        # drivers that decompose further (sharded_fused._init_and_masks)
+        # report the state/mask build — a named slice of the overhead
+        out["init_build_s"] = round(timing["init_build_s"], 4)
     if wall is not None:
         out["driver_overhead_s"] = round(
             max(0.0, wall - timing["compile_s"] - timing["steady_s"]), 4)

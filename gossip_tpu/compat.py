@@ -1,0 +1,95 @@
+"""Version shims for the two jax API seams this codebase straddles.
+
+The sharded engines target the modern spellings (``jax.shard_map`` with
+``check_vma``; ``pltpu.CompilerParams`` / ``pltpu.InterpretParams``),
+but the pinned toolchain on some build hosts carries jax 0.4.x, where
+shard_map still lives in ``jax.experimental.shard_map`` (``check_rep``)
+and the Pallas params classes have their old names.  Every call site
+goes through this module so the version split lives in exactly one
+place and each engine file stays written against one API.
+
+The 0.4.x Mosaic interpreter also has NO CPU lowering for the TPU
+hardware-PRNG primitives (``prng_seed`` raises NotImplementedError;
+newer versions stub the draw with zeros).  That asymmetry is why the
+fused kernels' default ``interpret=True`` path is the pure-JAX
+reference lowering in ops/pallas_round.py — the Mosaic interpreter is
+reachable via ``interpret="mosaic"`` only for injected-bit tests,
+which never touch the PRNG primitives.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` on modern jax; the ``jax.experimental`` spelling
+    (``check_rep`` kwarg) on 0.4.x.  Semantics are identical for the
+    programs here — the kwarg was renamed, not redefined."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
+def interpret_impl(interpret):
+    """Normalize the ``interpret`` argument of the Pallas entry points.
+
+    ``False`` -> None (compiled TPU lowering).  ``True``/'reference' ->
+    ``'reference'``: the pure-JAX lowering of the kernel math, with the
+    hardware PRNG reproduced as the Mosaic interpreter defines it
+    off-TPU (all-zero draws) — compiled by XLA, so interpret-mode driver
+    runs execute as ordinary jitted programs instead of paying a Python
+    interpreter callback per pallas_call.  ``'mosaic'`` -> the real
+    Mosaic interpreter (kernel-body tests); on jax 0.4.x it cannot
+    reach the TPU PRNG primitives on CPU (module doc)."""
+    if not interpret:
+        return None
+    if interpret is True or interpret == "reference":
+        return "reference"
+    if interpret == "mosaic":
+        return "mosaic"
+    raise ValueError(f"interpret must be a bool, 'reference' or 'mosaic'; "
+                     f"got {interpret!r}")
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` on modern jax; the classic ``psum(1, axis)``
+    idiom (statically folded inside shard_map) on 0.4.x."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pvary(x, axes):
+    """Mark a value as varying over manual mesh ``axes`` — the
+    ``jax.lax.pcast(..., to="varying")`` VMA cast of modern shard_map.
+    0.4.x has no VMA type system, so there the cast is an identity (cond
+    branch outputs already unify)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axes)
+    return x
+
+
+def pallas_interpret_mode(on) -> object:
+    """The ``interpret=`` argument for a ``pallas_call``: the structured
+    ``InterpretParams`` where it exists, the legacy bool otherwise."""
+    if not on:
+        return False
+    from jax.experimental.pallas import tpu as pltpu
+    if hasattr(pltpu, "InterpretParams"):
+        return pltpu.InterpretParams()
+    return True
+
+
+def pallas_compiler_params(*, vmem_limit_bytes: int):
+    """Mosaic compiler params under whichever class name this jax has."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(vmem_limit_bytes=vmem_limit_bytes)

@@ -62,6 +62,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from gossip_tpu.compat import (interpret_impl, pallas_compiler_params,
+                               pallas_interpret_mode)
+
 LANES = 128
 BITS = 32
 NODES_PER_ROW = LANES * BITS            # 4096 nodes per table row
@@ -128,12 +131,137 @@ def _rotate_rows(table: jax.Array, sbits: jax.Array, rows: int) -> jax.Array:
     return rot
 
 
+def _rotate_rows_xla(table: jax.Array, sbits: jax.Array,
+                     rows: int) -> jax.Array:
+    """:func:`_rotate_rows` as plain XLA (``jnp.roll`` in place of
+    ``pltpu.roll`` — same function, bitwise).  Stage 1 of the staged
+    big-table path and of the reference interpret lowering."""
+    s = (sbits[0:1, :] % jnp.uint32(rows)).astype(jnp.int32)   # [1, 128]
+    rot = table
+    shift = 1
+    while shift < rows:
+        take = (s & shift) != 0
+        rot = jnp.where(take, jnp.roll(rot, shift, axis=0), rot)
+        shift <<= 1
+    return rot
+
+
+# interpret routing (compat.interpret_impl): True/'reference' -> the
+# pure-JAX reference lowerings below, 'mosaic' -> the real Mosaic
+# interpreter.  The reference path is why driver-level interpret runs
+# (CPU tests, the multichip dry run) execute as ordinary jitted programs
+# instead of paying a Python interpreter callback per pallas_call per
+# plane per round — the 8-device dry run's fused families sat at
+# ~360-460 ms steady for exactly that reason.
+_interpret_impl = interpret_impl
+
+
+def _phantom_word_keep(rows: int, n_valid_words: int, tail_mask: int):
+    """uint32[rows, 128] keep-mask zeroing phantom words (and the tail
+    word's phantom bits) — the reference twin of the kernels' inline
+    phantom masking."""
+    word_id = (jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0) * LANES
+               + jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1))
+    full = word_id < (n_valid_words - (1 if tail_mask else 0))
+    keep = jnp.where(full, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+    if tail_mask:
+        keep = jnp.where(word_id == n_valid_words - 1,
+                         jnp.uint32(tail_mask), keep)
+    return keep
+
+
+def _fused_round_ref(table, n: int, fanout: int, inject_bits,
+                     drop_threshold: int, alive_table,
+                     plane_sharing: int) -> jax.Array:
+    """Pure-JAX reference of :func:`_fused_round_kernel` (single-rumor,
+    node-packed).  Bitwise-equal to the Mosaic interpreter on the same
+    operands (tests/test_pallas_round.py); hardware-PRNG draws reproduce
+    the interpreter's off-TPU stub (zeros)."""
+    rows = table.shape[0]
+    inject = inject_bits is not None
+    if inject:
+        sbits = jnp.asarray(inject_bits[0], jnp.uint32)
+        rbits = jnp.asarray(inject_bits[1], jnp.uint32)
+    else:
+        sbits = jnp.zeros((8, LANES), jnp.uint32)
+    src = table & alive_table if alive_table is not None else table
+    rot = _rotate_rows_xla(src, sbits, rows)
+
+    acc = table
+    for k in range(0, BITS, plane_sharing):
+        for f in range(fanout):
+            rb = (rbits[(k // plane_sharing) * fanout + f] if inject
+                  else jnp.zeros((rows, LANES), jnp.uint32))
+            for j in range(plane_sharing):
+                sh = jnp.uint32(12 * j)
+                m = ((rb >> sh) & jnp.uint32(LANES - 1)).astype(jnp.int32)
+                c = (rb >> (sh + jnp.uint32(7))) & jnp.uint32(BITS - 1)
+                partner = jnp.take_along_axis(rot, m, axis=1)
+                bit = (partner >> c) & jnp.uint32(1)
+                if drop_threshold:
+                    keep = ((rb >> jnp.uint32(12))
+                            >= jnp.uint32(drop_threshold))
+                    bit = jnp.where(keep, bit, jnp.uint32(0))
+                if alive_table is not None:
+                    bit = bit & ((alive_table >> jnp.uint32(k + j))
+                                 & jnp.uint32(1))
+                acc = acc | (bit << jnp.uint32(k + j))
+
+    n_valid_words = -(-n // BITS)
+    tail = n % BITS
+    tail_mask = ((1 << tail) - 1) if tail else 0
+    return acc & _phantom_word_keep(rows, n_valid_words, tail_mask)
+
+
+def _fused_mr_round_ref(table, n: int, fanout: int, inject_bits,
+                        drop_threshold: int, alive_words) -> jax.Array:
+    """Pure-JAX reference of :func:`_fused_mr_kernel` (multi-rumor,
+    one-word-per-node).  Same contract as :func:`_fused_round_ref`."""
+    rows = table.shape[0]
+    inject = inject_bits is not None
+    if inject:
+        sbits_all = jnp.asarray(inject_bits[0], jnp.uint32)
+        rbits_all = jnp.asarray(inject_bits[1], jnp.uint32)
+    src = table & alive_words if alive_words is not None else table
+
+    acc = table
+    for f in range(fanout):
+        sbits = (sbits_all[f] if inject
+                 else jnp.zeros((8, LANES), jnp.uint32))
+        rot = _rotate_rows_xla(src, sbits, rows)
+        rb = (rbits_all[f] if inject
+              else jnp.zeros((rows, LANES), jnp.uint32))
+        m = (rb & jnp.uint32(LANES - 1)).astype(jnp.int32)
+        partner = jnp.take_along_axis(rot, m, axis=1)
+        if drop_threshold:
+            keep = (rb >> jnp.uint32(12)) >= jnp.uint32(drop_threshold)
+            partner = jnp.where(keep, partner, jnp.uint32(0))
+        if alive_words is not None:
+            partner = partner & alive_words
+        acc = acc | partner
+
+    node_id = (jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0) * LANES
+               + jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1))
+    return jnp.where(node_id < n, acc, jnp.uint32(0))
+
+
 def _fused_call(kernel, rows: int, seed, round_, table, inject_bits,
                 interpret: bool, round_salt: int = 0, alive_table=None):
     """Shared pallas_call plumbing for the fused kernels: SMEM seed pair,
     VMEM table aliased into the output, optional injected-bits operands,
     optional alive-bitmap operand (fault masks — last, after the inject
-    pair, matching the kernels' ``rest`` unpack order)."""
+    pair, matching the kernels' ``rest`` unpack order).
+
+    Donation contract: the whole-table value kernels ALWAYS declare the
+    ``{1: 0}`` table->output alias.  It is safe because nothing after
+    this call reads the pre-round table — the entry points consume their
+    table operand exactly once, and the jit wrappers never donate the
+    caller's own buffers — and it is what lets the compiled
+    while_loop/scan drivers update the table in place every round
+    (pallas_call lowers to a custom call; without the declared alias XLA
+    cannot reuse the buffer and copies the full table per round).  The
+    staged big-table path has a subtler per-draw rule — see the
+    donation-contract comment in :func:`_fused_mr_round_big`."""
     seeds = jnp.stack([jnp.asarray(seed, jnp.int32) * jnp.int32(_ROUND_MIX),
                        jnp.asarray(round_, jnp.int32)
                        ^ jnp.int32(round_salt)])
@@ -155,9 +283,9 @@ def _fused_call(kernel, rows: int, seed, round_, table, inject_bits,
         in_specs=in_specs,
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         input_output_aliases={1: 0},
-        compiler_params=None if interpret else pltpu.CompilerParams(
+        compiler_params=None if interpret else pallas_compiler_params(
             vmem_limit_bytes=_VMEM_LIMIT_BYTES),
-        interpret=pltpu.InterpretParams() if interpret else False,
+        interpret=pallas_interpret_mode(interpret),
     )(*operands)
 
 
@@ -270,6 +398,11 @@ def fused_pull_round(table: jax.Array, seed: jax.Array, round_: jax.Array,
     ``plane_sharing=2`` halves the PRNG words per round by splitting one
     draw's disjoint bit-fields across an adjacent plane pair — an
     OPT-IN different stream (kernel docstring); requires no drop coin.
+
+    ``interpret`` may be a bool or an impl name: ``True``/'reference'
+    is the pure-JAX reference lowering (fast, compiled by XLA — the
+    driver-test and dry-run path), 'mosaic' the real Mosaic interpreter
+    (kernel-body tests; see :func:`_interpret_impl`).
     """
     if plane_sharing not in (1, 2):
         raise ValueError(f"plane_sharing must be 1 or 2, "
@@ -279,6 +412,9 @@ def fused_pull_round(table: jax.Array, seed: jax.Array, round_: jax.Array,
             "plane_sharing=2 splits the draw's bit-fields across a "
             "plane pair and leaves no room for the 20-bit drop coin; "
             "use plane_sharing=1 with drop_prob faults")
+    if _interpret_impl(interpret) == "reference":
+        return _fused_round_ref(table, n, fanout, inject_bits,
+                                drop_threshold, alive_table, plane_sharing)
     rows = table.shape[0]
     n_valid_words = -(-n // BITS)
     tail = n % BITS
@@ -495,6 +631,7 @@ def _fused_mr_round_big(table: jax.Array, seed, round_, n: int,
     draws on a table too big for VMEM."""
     rows = table.shape[0]
     block = min(_MR_GATHER_BLOCK, rows)
+    impl = _interpret_impl(interpret)
 
     if inject_bits is not None:
         sbits_all = jnp.asarray(inject_bits[0], jnp.uint32)  # [F, 8, 128]
@@ -529,19 +666,11 @@ def _fused_mr_round_big(table: jax.Array, seed, round_, n: int,
 
         # Stage 1 (XLA): per-lane row rotation, binary decomposition —
         # always from the PRE-round serve-masked table.
-        s = (sbits[0:1, :] % jnp.uint32(rows)).astype(jnp.int32)  # [1,128]
-        rot = src
-        shift = 1
-        while shift < rows:
-            take = (s & shift) != 0
-            rot = jnp.where(take, jnp.roll(rot, shift, axis=0), rot)
-            shift <<= 1
+        rot = _padded(_rotate_rows_xla(src, sbits, rows))
 
-        # Stage 2 (Pallas grid): lane choice + in-row gather + OR + mask.
-        # Rows pad up to a block multiple (pad rows are phantom nodes —
-        # the kernel masks them to zero) so every grid step sees a full
-        # block.
-        rot = _padded(rot)
+        # Stage 2: lane choice + in-row gather + OR + mask.  Rows pad up
+        # to a block multiple (pad rows are phantom nodes — the kernel
+        # masks them to zero) so every grid step sees a full block.
         rbits = None
         if inject_bits is not None:
             rbits = rbits_all[f:f + 1]
@@ -549,6 +678,27 @@ def _fused_mr_round_big(table: jax.Array, seed, round_, n: int,
                 rbits = jnp.concatenate(
                     [rbits, jnp.zeros((1, rows_pad - rows, LANES),
                                       jnp.uint32)], axis=1)
+
+        if impl == "reference":
+            # whole-table jnp twin of the grid kernel (the per-block
+            # split is pure blocking; with no inject the hw-PRNG draw is
+            # the interpreter's off-TPU stub, zeros)
+            rb = (rbits[0] if rbits is not None
+                  else jnp.zeros((rows_pad, LANES), jnp.uint32))
+            m = (rb & jnp.uint32(LANES - 1)).astype(jnp.int32)
+            partner = jnp.take_along_axis(rot, m, axis=1)
+            if drop_threshold:
+                keep = (rb >> jnp.uint32(12)) >= jnp.uint32(drop_threshold)
+                partner = jnp.where(keep, partner, jnp.uint32(0))
+            if alive_p is not None:
+                partner = partner & alive_p
+            node_id = (jax.lax.broadcasted_iota(
+                jnp.int32, (rows_pad, LANES), 0) * LANES
+                + jax.lax.broadcasted_iota(
+                    jnp.int32, (rows_pad, LANES), 1))
+            acc_p = jnp.where(node_id < n, acc_p | partner, jnp.uint32(0))
+            continue
+
         # draw 0's per-block salt is the pre-round-5 constant; later
         # draws perturb seeds[1] with a static odd multiplier
         seeds = jnp.stack(
@@ -570,25 +720,33 @@ def _fused_mr_round_big(table: jax.Array, seed, round_, n: int,
                                    inject=inject_bits is not None,
                                    drop_threshold=drop_threshold,
                                    has_alive=alive_words is not None)
-        # Donate the table operand unless it is the CALLER's concrete
-        # array (block-aligned rows + eager invocation): donating that
-        # would invalidate the caller's buffer (ADVICE r2).  Under jit
-        # the operand is a tracer for a dead-after-this intermediate, so
-        # the alias is safe and buys the in-place round update the hot
-        # while_loop relies on (pallas_call lowers to a custom call —
-        # without the declared alias XLA cannot reuse the buffer and
-        # copies every round).
+        # Donation contract for the staged path's table operand (the
+        # whole-table kernels' simpler rule is at _fused_call):
+        #   * draws f >= 1 always alias {1: 0}: their table operand is
+        #     the previous draw's output — dead after this call — so XLA
+        #     reuses the buffer in place.
+        #   * draw 0 aliases ONLY in a fanout-1 round.  With fanout > 1
+        #     every later draw's stage-1 rotation still reads the same
+        #     pre-round table buffer (``src``), so a declared draw-0
+        #     alias makes XLA re-materialize that still-live buffer via
+        #     copy-insertion — a hidden full-table HBM copy per round.
+        #     Skipping the alias keeps the table live with no copy; only
+        #     the fanout-1 round is in-place, which is the only case the
+        #     hot while_loop drivers ever relied on.
+        #   * never alias the CALLER's concrete array (block-aligned
+        #     rows + eager invocation): donating it would invalidate the
+        #     caller's buffer (ADVICE r2).
         eager_caller_buffer = (acc_p is table
                                and not isinstance(table, jax.core.Tracer))
-        aliases = {} if eager_caller_buffer else {1: 0}
+        no_alias = eager_caller_buffer or (f == 0 and fanout > 1)
         acc_p = pl.pallas_call(
             kernel,
             grid=(rows_pad // block,),
             out_shape=jax.ShapeDtypeStruct((rows_pad, LANES), jnp.uint32),
             in_specs=in_specs,
             out_specs=pl.BlockSpec((block, LANES), lambda i: (i, 0)),
-            input_output_aliases=aliases,
-            interpret=pltpu.InterpretParams() if interpret else False,
+            input_output_aliases={} if no_alias else {1: 0},
+            interpret=pallas_interpret_mode(interpret),
         )(*operands)
     return acc_p[:rows] if rows_pad != rows else acc_p
 
@@ -674,6 +832,9 @@ def fused_multirumor_pull_round(table: jax.Array, seed: jax.Array,
                                    inject_bits,
                                    drop_threshold=drop_threshold,
                                    alive_words=alive_words, fanout=fanout)
+    if _interpret_impl(interpret) == "reference":
+        return _fused_mr_round_ref(table, n, fanout, inject_bits,
+                                   drop_threshold, alive_words)
     kernel = functools.partial(_fused_mr_kernel, rows=rows, fanout=fanout,
                                n=n, inject=inject_bits is not None,
                                drop_threshold=drop_threshold,

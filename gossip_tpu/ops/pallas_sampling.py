@@ -43,6 +43,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from gossip_tpu.compat import interpret_impl, pallas_interpret_mode
+
 _BLOCK_ROWS = 4096          # fixed: part of the determinism contract
 
 
@@ -83,6 +85,16 @@ def sample_targets_pallas(seed: jax.Array, n_rows: int, n_total: int,
     """
     rows_pad = _pad_up(n_rows, _BLOCK_ROWS)
     grid = rows_pad // _BLOCK_ROWS
+    if interpret_impl(interpret) == "reference":
+        # pure-JAX reference of the kernel with the hw PRNG reproduced
+        # as the Mosaic interpreter defines it off-TPU (all-zero draws)
+        # — compiled by XLA; 'mosaic' forces the real interpreter
+        bits = jnp.zeros((rows_pad, k), jnp.uint32)
+        if exclude_self and n_total > 1:
+            t = (bits % jnp.uint32(n_total - 1)).astype(jnp.int32)
+            rows = jax.lax.broadcasted_iota(jnp.int32, (rows_pad, k), 0)
+            return (t + (t >= rows).astype(jnp.int32))[:n_rows]
+        return (bits % jnp.uint32(n_total)).astype(jnp.int32)[:n_rows]
     kernel = functools.partial(_sampler_kernel, n_total=n_total, k=k,
                                exclude_self=exclude_self,
                                block_rows=_BLOCK_ROWS)
@@ -95,7 +107,7 @@ def sample_targets_pallas(seed: jax.Array, n_rows: int, n_total: int,
                                memory_space=pltpu.VMEM),
         # TPU-semantics interpreter (plain interpret=True lacks the TPU
         # PRNG primitives on CPU)
-        interpret=pltpu.InterpretParams() if interpret else False,
+        interpret=pallas_interpret_mode(interpret),
     )(jnp.asarray([seed], jnp.int32))
     return out[:n_rows]
 

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from gossip_tpu.compat import axis_size, shard_map
 from gossip_tpu import config as C
 from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
 from gossip_tpu.models import si as si_mod
@@ -67,7 +68,7 @@ def _ring_perms(axis_name: str):
     """(to_right, to_left) ppermute pairs on the mesh ring — the single
     source of the neighbor convention for both the forward halo read and
     the reverse push write-back."""
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     to_right = [(i, (i + 1) % p) for i in range(p)]
     to_left = [(i, (i - 1) % p) for i in range(p)]
     return to_right, to_left
@@ -201,7 +202,7 @@ def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
 
     sh2 = P(axis_name, None)
     rep = P()
-    mapped = jax.shard_map(
+    mapped = shard_map(
         local_round, mesh=mesh,
         in_specs=(sh2, rep, rep, rep, sh2, P(axis_name)),
         out_specs=(sh2, rep))

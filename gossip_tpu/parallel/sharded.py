@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from gossip_tpu.compat import shard_map
 from gossip_tpu import config as C
 from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
 from gossip_tpu.models import si as si_mod
@@ -211,7 +212,7 @@ def make_sharded_si_round(
         in_specs += [sh2, sh]
         tables = (nbrs_pad, deg_pad)
 
-    mapped = jax.shard_map(local_round, mesh=mesh,
+    mapped = shard_map(local_round, mesh=mesh,
                            in_specs=tuple(in_specs),
                            out_specs=(sh2, rep))
 

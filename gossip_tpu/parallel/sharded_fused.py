@@ -49,11 +49,13 @@ the program/collective plumbing; the invariant itself is a TPU artifact.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from gossip_tpu.compat import shard_map
 from gossip_tpu.config import RunConfig
 from gossip_tpu.ops.pallas_round import (
     BITS, LANES, coverage_words, coverage_words_alive, drop_threshold_for,
@@ -76,6 +78,36 @@ def plane_count(rumors: int, n_devices: int) -> int:
     return -(-w // n_devices) * n_devices
 
 
+@functools.lru_cache(maxsize=32)
+def _cached_plane_init(n: int, rumors: int, origin: int, mesh: Mesh):
+    """Jitted builder of the initial plane stack, memoized per statics.
+
+    The per-plane Python loop below runs ONCE at trace time; every later
+    call is an executable-cache hit producing a fresh (donation-safe)
+    device buffer under the plane sharding.  Before this, the dry run's
+    steady re-entry rebuilt the stack with ~6 eager dispatches per plane
+    per call — host-side driver overhead the device-resident loop then
+    sat waiting on."""
+    w_total = plane_count(rumors, mesh.shape[AXIS])
+
+    def build():
+        planes = []
+        for p in range(w_total):
+            lo = p * BITS
+            real = max(0, min(rumors - lo, BITS))
+            seen = jnp.concatenate(
+                [jnp.zeros((n, real), jnp.bool_),
+                 jnp.ones((n, BITS - real), jnp.bool_)], axis=1)
+            if real:
+                origins = (origin + lo + jnp.arange(real)) % n
+                seen = seen.at[origins, jnp.arange(real)].set(True)
+            planes.append(word_pack(seen))
+        return jnp.stack(planes)
+
+    return jax.jit(build,
+                   out_shardings=NamedSharding(mesh, P(AXIS, None, None)))
+
+
 def init_plane_state(n: int, rumors: int, mesh: Mesh,
                      origin: int = 0) -> jax.Array:
     """uint32[W, rows, 128] plane-sharded state; rumor r starts at node
@@ -83,20 +115,7 @@ def init_plane_state(n: int, rumors: int, mesh: Mesh,
     columns/planes are all-ones (coverage 1.0, inert under OR-merge)."""
     if not 0 <= origin < n:
         raise ValueError(f"origin {origin} out of range for n={n}")
-    w_total = plane_count(rumors, mesh.shape[AXIS])
-    planes = []
-    for p in range(w_total):
-        lo = p * BITS
-        real = max(0, min(rumors - lo, BITS))
-        seen = jnp.concatenate(
-            [jnp.zeros((n, real), jnp.bool_),
-             jnp.ones((n, BITS - real), jnp.bool_)], axis=1)
-        if real:
-            origins = (origin + lo + jnp.arange(real)) % n
-            seen = seen.at[origins, jnp.arange(real)].set(True)
-        planes.append(word_pack(seen))
-    stacked = jnp.stack(planes)
-    return jax.device_put(stacked, NamedSharding(mesh, P(AXIS, None, None)))
+    return _cached_plane_init(n, rumors, origin, mesh)()
 
 
 def coverage_planes(planes: jax.Array, n: int) -> jax.Array:
@@ -106,19 +125,94 @@ def coverage_planes(planes: jax.Array, n: int) -> jax.Array:
     return jnp.min(per_plane)
 
 
+def coverage_planes_masked(planes: jax.Array, n: int,
+                           alive_words=None) -> jax.Array:
+    """The ONE plane-coverage body: plain min-over-rumors fraction, or
+    the alive-weighted twin when a death mask rides along (padding
+    rumors stay 1.0 under the weighting: every alive node holds their
+    all-ones bits).  ``alive_words`` is a runtime OPERAND — the compiled
+    drivers share one executable across fault configurations."""
+    if alive_words is None:
+        return coverage_planes(planes, n)
+    per_plane = jax.vmap(
+        lambda t: coverage_words_alive(t, alive_words, BITS))(planes)
+    return jnp.min(per_plane)
+
+
+@functools.lru_cache(maxsize=32)
+def _cached_alive_words(fault, n: int, origin: int):
+    """Jitted builder of the plane engine's alive mask (fault_masks_word
+    rendering) — the steady-state twin of :func:`_cached_plane_init`:
+    re-entering a faulted driver re-executes a cached program instead of
+    dispatching the O(n) mask build eagerly per call."""
+    return jax.jit(lambda: fault_masks_word(fault, n, origin)[0])
+
+
 def fused_planes_cov_fn(n: int, fault=None, origin: int = 0):
     """``planes -> coverage`` — alive-weighted iff the fault draws
-    deaths (cf. ops/pallas_round.fused_cov_fn; padding rumors stay 1.0
-    under the weighting: every alive node holds their all-ones bits)."""
+    deaths (cf. ops/pallas_round.fused_cov_fn); a fault-binding wrapper
+    around :func:`coverage_planes_masked`, which the compiled drivers
+    call directly with the mask as an operand."""
     if fault is None or not fault.node_death_rate:
-        return lambda p: coverage_planes(p, n)
+        return lambda p: coverage_planes_masked(p, n)
 
     def cov(p):
         alive_words, _ = fault_masks_word(fault, n, origin)
-        per_plane = jax.vmap(
-            lambda t: coverage_words_alive(t, alive_words, BITS))(p)
-        return jnp.min(per_plane)
+        return coverage_planes_masked(p, n, alive_words)
     return cov
+
+
+def make_sharded_fused_round_masked(n: int, mesh: Mesh, fanout: int = 1,
+                                    interpret: bool = False,
+                                    inject_bits=None,
+                                    drop_threshold: int = 0,
+                                    has_alive: bool = False):
+    """The masked core of :func:`make_sharded_fused_round`:
+    ``round_fn(planes, seed, round_, alive_words=None)`` with the death
+    mask as a runtime OPERAND (replicated over the mesh) instead of a
+    trace-baked constant.  The compiled drivers built on this share one
+    executable across every fault configuration with the same (static)
+    ``drop_threshold`` — a fault-curve sweep over death rates or seeds
+    re-enters one cached program per shape instead of recompiling the
+    whole shard_map loop per point.  Same values as the baked form: the
+    mask is a pure function of the fault config over the REPLICATED
+    node dimension, and it consumes no hardware PRNG (the drop coin
+    rides free bits of the existing partner draw) — the zero-ICI
+    same-stream invariant is untouched."""
+    n_dev = mesh.shape[AXIS]
+
+    def local_round(planes_l, seed, round_, *masks):
+        alive_words = masks[0] if has_alive else None
+        w_local = planes_l.shape[0]
+        outs = [fused_multirumor_pull_round(
+                    planes_l[i], seed, round_, n, fanout, interpret,
+                    inject_bits=inject_bits,
+                    drop_threshold=drop_threshold,
+                    alive_words=alive_words)
+                for i in range(w_local)]
+        return jnp.stack(outs)
+
+    in_specs = (P(AXIS, None, None), P(), P())
+    if has_alive:
+        in_specs += (P(None, None),)
+    # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
+    # annotation, which the default shard_map VMA check rejects
+    mapped = shard_map(
+        local_round, mesh=mesh, in_specs=in_specs,
+        out_specs=P(AXIS, None, None), check_vma=False)
+
+    def round_fn(planes, seed, round_, alive_words=None):
+        if planes.shape[0] % n_dev:
+            raise ValueError(f"{planes.shape[0]} planes do not divide "
+                             f"over {n_dev} devices")
+        if (alive_words is not None) != has_alive:
+            raise ValueError("alive_words must be passed exactly when the "
+                             "round was built with has_alive=True")
+        masks = (alive_words,) if has_alive else ()
+        return mapped(planes, jnp.asarray(seed, jnp.int32),
+                      jnp.asarray(round_, jnp.int32), *masks)
+
+    return round_fn
 
 
 def make_sharded_fused_round(n: int, mesh: Mesh, fanout: int = 1,
@@ -130,40 +224,19 @@ def make_sharded_fused_round(n: int, mesh: Mesh, fanout: int = 1,
     for every plane, which IS the semantic: one shared partner stream.
 
     ``fault`` (round 4) threads the static fault masks into every
-    plane's kernel call.  The masks are a pure function of the fault
-    config over the REPLICATED node dimension, rebuilt in-trace on each
-    device (same values everywhere), and they consume no hardware PRNG
-    (the drop coin rides free bits of the existing partner draw) — so
-    the zero-ICI same-stream invariant is untouched."""
-    n_dev = mesh.shape[AXIS]
+    plane's kernel call — a fault-binding wrapper around
+    :func:`make_sharded_fused_round_masked` that rebuilds the alive mask
+    in-trace per call (loop-invariant, hoisted by jitted callers)."""
     drop_threshold = drop_threshold_for(fault)
     has_alive = fault is not None and bool(fault.node_death_rate)
-
-    def local_round(planes_l, seed, round_):
-        w_local = planes_l.shape[0]
-        alive_words = (fault_masks_word(fault, n, origin)[0]
-                       if has_alive else None)
-        outs = [fused_multirumor_pull_round(
-                    planes_l[i], seed, round_, n, fanout, interpret,
-                    inject_bits=inject_bits,
-                    drop_threshold=drop_threshold,
-                    alive_words=alive_words)
-                for i in range(w_local)]
-        return jnp.stack(outs)
-
-    # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
-    # annotation, which the default shard_map VMA check rejects
-    mapped = jax.shard_map(
-        local_round, mesh=mesh,
-        in_specs=(P(AXIS, None, None), P(), P()),
-        out_specs=P(AXIS, None, None), check_vma=False)
+    core = make_sharded_fused_round_masked(
+        n, mesh, fanout, interpret, inject_bits=inject_bits,
+        drop_threshold=drop_threshold, has_alive=has_alive)
 
     def round_fn(planes, seed, round_):
-        if planes.shape[0] % n_dev:
-            raise ValueError(f"{planes.shape[0]} planes do not divide "
-                             f"over {n_dev} devices")
-        return mapped(planes, jnp.asarray(seed, jnp.int32),
-                      jnp.asarray(round_, jnp.int32))
+        alive_words = (fault_masks_word(fault, n, origin)[0]
+                       if has_alive else None)
+        return core(planes, seed, round_, alive_words)
 
     return round_fn
 
@@ -199,7 +272,7 @@ def prng_invariant_digests(n: int, mesh: Mesh, seed: int = 0,
         mix = jnp.sum(out * w, dtype=jnp.uint32)
         return jax.lax.all_gather(jnp.stack([pop, mix]), AXIS)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         local, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(None, None),
         check_vma=False)
     return mapped(jnp.zeros((mesh.shape[AXIS],), jnp.int32))
@@ -292,35 +365,61 @@ def checkpointed_fused_planes(n: int, rumors: int, run: RunConfig,
 
 
 @functools.lru_cache(maxsize=32)
-def _cached_curve_scan(n: int, seed: int, max_rounds: int, origin: int,
-                       mesh: Mesh, fanout: int, interpret: bool, fault):
+def _cached_curve_scan(n: int, seed: int, max_rounds: int, mesh: Mesh,
+                       fanout: int, interpret: bool, drop_threshold: int,
+                       has_alive: bool):
     """The compiled curve-scan driver, memoized by EXACTLY the statics
-    its trace bakes in (seed and max_rounds are closed-over literals;
-    origin feeds the step and the coverage chooser) — not the whole
-    RunConfig, whose unused fields (engine, checkpoint knobs) would
-    fragment the cache.  Every argument is hashable (Mesh hashes
-    structurally).  Re-entering the driver with the same statics — a
-    sweep server, the RPC sidecar, the multichip dryrun's steady pass —
-    reuses the jitted callable instead of retracing the whole shard_map
-    program per call (VERDICT r4 task 7: driver-level steady timings
-    must be executable-cache hits like every other family's).  The
-    plane state is a runtime ARGUMENT, so different ``rumors`` shapes
-    share one entry via jit's own cache."""
-    step = make_sharded_fused_round(n, mesh, fanout, interpret,
-                                    fault=fault, origin=origin)
-    cov_fn = fused_planes_cov_fn(n, fault, origin)
+    its trace bakes in (seed and max_rounds are closed-over literals) —
+    not the whole RunConfig, whose unused fields (engine, checkpoint
+    knobs) would fragment the cache, and since this round NOT the fault
+    config either: the alive mask is a runtime OPERAND (``*masks``), so
+    a fault-curve sweep over death rates/seeds shares ONE compiled loop
+    per shape instead of recompiling per point (only ``drop_threshold``
+    stays in the key — it specializes the kernel).  Every argument is
+    hashable (Mesh hashes structurally).  Re-entering the driver with
+    the same statics — a sweep server, the RPC sidecar, the multichip
+    dryrun's steady pass — reuses the jitted callable instead of
+    retracing the whole shard_map program per call (VERDICT r4 task 7:
+    driver-level steady timings must be executable-cache hits like
+    every other family's).  The plane state is a runtime ARGUMENT, so
+    different ``rumors`` shapes share one entry via jit's own cache.
+    Convergence/coverage is computed ON DEVICE inside the scan — the
+    steady path does no per-round host round-trip."""
+    step = make_sharded_fused_round_masked(
+        n, mesh, fanout, interpret, drop_threshold=drop_threshold,
+        has_alive=has_alive)
 
     @functools.partial(jax.jit, donate_argnums=0)
-    def scan(planes):
+    def scan(planes, *masks):
+        alive_words = masks[0] if has_alive else None
+
         def body(c, _):
             planes_c, round_c = c
-            planes_n = step(planes_c, seed, round_c)
-            return (planes_n, round_c + 1), cov_fn(planes_n)
+            planes_n = step(planes_c, seed, round_c, alive_words)
+            return ((planes_n, round_c + 1),
+                    coverage_planes_masked(planes_n, n, alive_words))
         (final, _), covs = jax.lax.scan(body, (planes, jnp.int32(0)),
                                         None, length=max_rounds)
         return final, covs
 
     return scan
+
+
+def _init_and_masks(n: int, rumors: int, run: RunConfig, mesh: Mesh,
+                    fault, has_alive: bool, timing):
+    """(init_planes, masks): the cached-jitted state/mask builders shared
+    by both simulate drivers.  With a ``timing`` dict the build is
+    blocked-on and recorded as ``init_build_s`` — the driver-side
+    component of the wall decomposition (backend._timing_meta folds it
+    into ``driver_overhead_s``; the dry run reports it per family)."""
+    t0 = time.perf_counter()
+    init = init_plane_state(n, rumors, mesh, run.origin)
+    masks = ((_cached_alive_words(fault, n, run.origin)(),)
+             if has_alive else ())
+    if timing is not None:
+        jax.block_until_ready((init,) + masks)
+        timing["init_build_s"] = time.perf_counter() - t0
+    return init, masks
 
 
 def simulate_curve_sharded_fused(n: int, rumors: int, run: RunConfig,
@@ -331,46 +430,59 @@ def simulate_curve_sharded_fused(n: int, rumors: int, run: RunConfig,
     plane-sharded round recording per-round min-over-rumors coverage —
     the curve twin of :func:`simulate_until_sharded_fused` (no early
     exit; the caller derives rounds-to-target from the curve).
-    ``timing``: optional compile/steady AOT-split dict
-    (parallel/sharded.simulate_curve_sharded contract; the AOT path
-    bypasses the memoized executable to measure a real compile)."""
+    ``timing``: optional wall-decomposition dict (utils/trace
+    maybe_aot_timed contract — AOT compile/steady split by default,
+    ``{"aot": False}`` for a steady-only probe on the cached
+    executable; plus ``init_build_s``, see :func:`_init_and_masks`)."""
     from gossip_tpu.utils.trace import maybe_aot_timed
-    scan = _cached_curve_scan(n, run.seed, run.max_rounds, run.origin,
-                              mesh, fanout, interpret, fault)
-    init = init_plane_state(n, rumors, mesh, run.origin)
-    final, covs = maybe_aot_timed(scan, timing, init)
+    has_alive = fault is not None and bool(fault.node_death_rate)
+    scan = _cached_curve_scan(n, run.seed, run.max_rounds, mesh, fanout,
+                              interpret, drop_threshold_for(fault),
+                              has_alive)
+    init, masks = _init_and_masks(n, rumors, run, mesh, fault, has_alive,
+                                  timing)
+    final, covs = maybe_aot_timed(scan, timing, init, *masks)
     return covs, final
 
 
 @functools.lru_cache(maxsize=32)
 def _cached_until_loop(n: int, seed: int, max_rounds: int,
-                       target_coverage: float, origin: int, mesh: Mesh,
-                       fanout: int, interpret: bool, fault):
-    """(loop, cov_fn): the compiled until-target driver, memoized like
+                       target_coverage: float, mesh: Mesh,
+                       fanout: int, interpret: bool, drop_threshold: int,
+                       has_alive: bool):
+    """The compiled until-target driver, memoized like
     :func:`_cached_curve_scan` (same key contract and rationale, plus
-    the target the cond compares against).  The cov_fn used by the
-    loop's cond is RETURNED too, so the caller reports coverage through
-    the same chooser the convergence test used — one chooser for
-    both."""
-    step = make_sharded_fused_round(n, mesh, fanout, interpret,
-                                    fault=fault, origin=origin)
+    the target the cond compares against).  Returns ``loop(planes,
+    *masks) -> (final_planes, rounds, coverage)`` — the reported
+    coverage is computed INSIDE the program through the SAME chooser
+    the cond used (one chooser for both, and one executable dispatch
+    per steady call instead of loop + separate coverage).  The
+    convergence check runs on device inside the while_loop cond; steady
+    state does no per-round host round-trip."""
+    step = make_sharded_fused_round_masked(
+        n, mesh, fanout, interpret, drop_threshold=drop_threshold,
+        has_alive=has_alive)
     target = jnp.float32(target_coverage)
-    cov_fn = fused_planes_cov_fn(n, fault, origin)
 
     @functools.partial(jax.jit, donate_argnums=0)
-    def loop(planes):
+    def loop(planes, *masks):
+        alive_words = masks[0] if has_alive else None
+
         def cond(c):
             planes_c, round_c = c
-            return ((cov_fn(planes_c) < target)
+            return ((coverage_planes_masked(planes_c, n, alive_words)
+                     < target)
                     & (round_c < max_rounds))
 
         def body(c):
             planes_c, round_c = c
-            return step(planes_c, seed, round_c), round_c + 1
+            return step(planes_c, seed, round_c, alive_words), round_c + 1
 
-        return jax.lax.while_loop(cond, body, (planes, jnp.int32(0)))
+        final, rounds = jax.lax.while_loop(cond, body,
+                                           (planes, jnp.int32(0)))
+        return final, rounds, coverage_planes_masked(final, n, alive_words)
 
-    return loop, cov_fn
+    return loop
 
 
 def simulate_until_sharded_fused(n: int, rumors: int, run: RunConfig,
@@ -384,15 +496,18 @@ def simulate_until_sharded_fused(n: int, rumors: int, run: RunConfig,
     partner draw, all W words riding one exchange): 2*fanout*n/round.
     ``fault`` threads the static fault masks into every plane's kernel;
     the cond and the reported coverage switch to the alive-weighted
-    metric (fused_planes_cov_fn — one chooser for both).  ``timing``:
-    optional compile/steady AOT-split dict (see the curve twin)."""
+    metric (coverage_planes_masked — one chooser for both).  ``timing``:
+    optional wall-decomposition dict (see the curve twin)."""
     from gossip_tpu.utils.trace import maybe_aot_timed
-    loop, cov_fn = _cached_until_loop(n, run.seed, run.max_rounds,
-                                      run.target_coverage, run.origin,
-                                      mesh, fanout, interpret, fault)
-    init = init_plane_state(n, rumors, mesh, run.origin)
-    final, rounds = maybe_aot_timed(loop, timing, init)
+    has_alive = fault is not None and bool(fault.node_death_rate)
+    loop = _cached_until_loop(n, run.seed, run.max_rounds,
+                              run.target_coverage, mesh, fanout,
+                              interpret, drop_threshold_for(fault),
+                              has_alive)
+    init, masks = _init_and_masks(n, rumors, run, mesh, fault, has_alive,
+                                  timing)
+    final, rounds, cov = maybe_aot_timed(loop, timing, init, *masks)
     rounds = int(rounds)
-    cov = float(cov_fn(final))
+    cov = float(cov)
     msgs = 2.0 * fanout * n * rounds
     return rounds, cov, msgs, final

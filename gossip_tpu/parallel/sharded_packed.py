@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from gossip_tpu.compat import shard_map
 from gossip_tpu import config as C
 from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
 from gossip_tpu.models import si as si_mod
@@ -107,7 +108,7 @@ def make_sharded_packed_round(
         in_specs += [sh2, P(axis_name)]
         tables = (nbrs_pad, deg_pad)
 
-    mapped = jax.shard_map(local_round, mesh=mesh, in_specs=tuple(in_specs),
+    mapped = shard_map(local_round, mesh=mesh, in_specs=tuple(in_specs),
                            out_specs=(sh2, rep))
 
     def step_tabled(state: SimState, *tbl) -> SimState:

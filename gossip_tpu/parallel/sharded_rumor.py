@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from gossip_tpu.compat import shard_map
 from gossip_tpu import config as C
 from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
 from gossip_tpu.models.rumor import (RUMOR_DROP_TAG, RUMOR_PUSH_TAG,
@@ -105,7 +106,7 @@ def make_sharded_rumor_round(proto: ProtocolConfig, topo: Topology,
         in_specs += [sh2, P(axis_name)]
         tables = (nbrs_pad, deg_pad)
 
-    mapped = jax.shard_map(local_round, mesh=mesh,
+    mapped = shard_map(local_round, mesh=mesh,
                            in_specs=tuple(in_specs),
                            out_specs=(sh2, sh2, sh2, rep))
 

@@ -57,6 +57,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from gossip_tpu.compat import pvary, shard_map
 from gossip_tpu import config as C
 from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
 from gossip_tpu.models.state import SimState
@@ -268,8 +269,7 @@ def make_sparse_pull_round(
             on = (round_ % proto.period) == 0
             # the quiescent branch's constants must carry the same
             # varying-manual-axes type as the exchange outputs
-            zf = jax.lax.pcast(jnp.float32(0.0), (axis_name,),
-                               to="varying")
+            zf = pvary(jnp.float32(0.0), (axis_name,))
             quiet = (jnp.zeros_like(seen_l), zf)
             pulled, n_req = jax.lax.cond(on, exchange,
                                          lambda _: quiet, None)
@@ -281,7 +281,7 @@ def make_sparse_pull_round(
         return seen_l | pulled, msgs_new
 
     sh, sh2, rep = P(axis_name), P(axis_name, None), P()
-    mapped = jax.shard_map(local_round, mesh=mesh,
+    mapped = shard_map(local_round, mesh=mesh,
                            in_specs=(sh2, rep, rep, rep, sh),
                            out_specs=(sh2, rep))
 
@@ -591,8 +591,7 @@ def make_sparse_topo_pull_round(
             on = (round_ % proto.period) == 0
             # the quiescent branch's constants must carry the same
             # varying-manual-axes type as the exchange outputs
-            zf = jax.lax.pcast(jnp.float32(0.0), (axis_name,),
-                               to="varying")
+            zf = pvary(jnp.float32(0.0), (axis_name,))
             quiet = (jnp.zeros_like(seen_l), zf, zf)
             pulled, n_sent, n_over = jax.lax.cond(on, exchange,
                                                   lambda _: quiet, None)
@@ -605,7 +604,7 @@ def make_sparse_topo_pull_round(
         return seen_l | pulled, msgs_new, ovf_new
 
     sh, sh2, rep = P(axis_name), P(axis_name, None), P()
-    mapped = jax.shard_map(local_round, mesh=mesh,
+    mapped = shard_map(local_round, mesh=mesh,
                            in_specs=(sh2, rep, rep, rep, rep, sh2, sh),
                            out_specs=(sh2, rep, rep))
 

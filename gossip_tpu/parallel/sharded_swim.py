@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from gossip_tpu.compat import shard_map
 from gossip_tpu.config import FaultConfig, ProtocolConfig
 from gossip_tpu.models import swim as SW
 from gossip_tpu.models.state import bind_tables
@@ -151,7 +152,7 @@ def make_sharded_swim_round(
     if have_table:
         in_specs += [sh2, P(axis_name)]
 
-    mapped = jax.shard_map(local_round, mesh=mesh, in_specs=tuple(in_specs),
+    mapped = shard_map(local_round, mesh=mesh, in_specs=tuple(in_specs),
                            out_specs=(sh2, sh2, rep))
     tables = (nbrs_pad, deg_pad) if have_table else ()
 

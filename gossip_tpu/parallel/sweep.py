@@ -63,6 +63,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gossip_tpu.compat import shard_map
 from gossip_tpu import config as C
 from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
 from gossip_tpu.models import si as si_mod
@@ -242,7 +243,7 @@ def _cached_pod_sweep_scan(n: int, n_pad: int, nl: int, k_max: int,
         in_specs += [P(None, node_axis, None), P(None, node_axis)]
     elif have_table:
         in_specs += [P(node_axis, None), P(node_axis)]
-    mapped = jax.shard_map(local_block, mesh=mesh,
+    mapped = shard_map(local_block, mesh=mesh,
                            in_specs=tuple(in_specs),
                            out_specs=(P(sweep_axis, node_axis, None), sw,
                                       sw))

@@ -383,6 +383,7 @@ def test_cli_grid_ns_one_program():
                for r in rows)
 
 
+@pytest.mark.slow
 def test_cli_sweep_smoke():
     p = _cli("sweep", "--scale", "0.002", "--devices", "4",
              "--only", "push-complete-64-goref", "pushpull-er-10k",
@@ -503,6 +504,7 @@ def test_engine_xla_is_the_auto_fused_opt_out():
     assert args["run"].engine == "xla"
 
 
+@pytest.mark.slow
 def test_cli_checkpoint_resume_and_profile(tmp_path):
     ck = str(tmp_path / "run.npz")
     prof = str(tmp_path / "prof")

@@ -61,6 +61,7 @@ def _packed_run(tmp_path, name, max_rounds, resume_state=None,
         curve_prefix=curve_prefix)
 
 
+@pytest.mark.slow
 def test_sharded_packed_resume_bitwise(tmp_path):
     # uninterrupted 8-round run vs 4 rounds + load-in-"new-process" + 4
     full, cov_full, _ = _packed_run(tmp_path, "full.npz", 8)
@@ -76,6 +77,7 @@ def test_sharded_packed_resume_bitwise(tmp_path):
     assert cov_full == cov_res
 
 
+@pytest.mark.slow
 def test_sharded_packed_checkpoint_curve_resumes(tmp_path):
     # the curve persists in the checkpoint and the resumed curve equals
     # the uninterrupted one point-for-point
@@ -96,6 +98,7 @@ def test_sharded_packed_checkpoint_curve_resumes(tmp_path):
     assert all(b >= a - 1e-6 for a, b in zip(curve_res, curve_res[1:]))
 
 
+@pytest.mark.slow
 def test_sharded_packed_checkpoint_matches_plain_driver(tmp_path):
     # the segmented checkpointed trajectory equals the single-device
     # packed reference on the unpadded prefix (same seeds, same kernels)
@@ -152,6 +155,7 @@ def test_fused_planes_checkpoint_curve(tmp_path):
     assert curve_res == curve_full
 
 
+@pytest.mark.slow
 def test_cli_sharded_checkpoint_resume_and_curve(tmp_path):
     ck = str(tmp_path / "cli.npz")
     args = ("run", "--mode", "pull", "--family", "erdos_renyi",
@@ -179,6 +183,7 @@ def test_cli_sharded_checkpoint_resume_and_curve(tmp_path):
     assert rep["msgs"] == ref["msgs"]
 
 
+@pytest.mark.slow
 def test_cli_checkpoint_error_paths(tmp_path):
     ck = str(tmp_path / "e.npz")
     # fused engine off-TPU: the shared ineligibility list speaks
@@ -243,6 +248,7 @@ def test_cli_resume_accepts_pre_round4_fingerprint(tmp_path):
     assert json.loads(p.stdout)["rounds"] == 5
 
 
+@pytest.mark.slow
 def test_cli_save_curve_with_checkpoint(tmp_path):
     ck = str(tmp_path / "s.npz")
     curve_path = str(tmp_path / "curve.jsonl")
@@ -306,6 +312,7 @@ def test_checkpointed_swim_matches_streaming_and_resumes(tmp_path):
     assert float(res.msgs) == float(full.msgs)
 
 
+@pytest.mark.slow
 def test_checkpointed_swim_sharded_bitwise_matches_single(tmp_path):
     from gossip_tpu.runtime.simulator import checkpointed_swim
     proto, run, dead, fr = _swim_cfg()
@@ -372,6 +379,7 @@ def test_checkpointed_rumor_matches_streaming_and_resumes(tmp_path):
     assert curve_res == curve and cov_res == cov_full
 
 
+@pytest.mark.slow
 def test_checkpointed_rumor_sharded_matches_single(tmp_path):
     from gossip_tpu.models.rumor import checkpointed_rumor
     proto = ProtocolConfig(mode="rumor", fanout=1, rumors=2, rumor_k=2)
@@ -394,6 +402,7 @@ def test_checkpointed_rumor_sharded_matches_single(tmp_path):
     assert final.seen.shape[0] >= 160     # padded rows in the checkpoint
 
 
+@pytest.mark.slow
 def test_cli_swim_checkpoint_resume(tmp_path):
     ck = str(tmp_path / "sw.npz")
     args = ("run", "--n", "300", "--mode", "swim", "--fanout", "2",

@@ -45,7 +45,8 @@ def test_sweep_axis_sharding_is_value_invariant():
         config_sweep_curves(pts[:3], topo, run, mesh=mesh)
 
 
-@pytest.mark.parametrize("family", ["complete", "er"])
+@pytest.mark.parametrize("family", [
+    "complete", pytest.param("er", marks=pytest.mark.slow)])
 def test_2d_pod_sweep_matches_1d_batch(family):
     # full 2-D mesh: configs x node shards in ONE shard_map program —
     # trajectories identical to the single-device batch
@@ -155,6 +156,7 @@ def _families(n=512):
             G.power_law(n, 3, seed=3)]
 
 
+@pytest.mark.slow
 def test_topology_axis_matches_solo_bitwise():
     """Every (family, mode, fanout) cell of the batched families grid
     must equal the solo single-topology batch BITWISE."""
@@ -216,6 +218,7 @@ def test_topology_axis_validation():
                                fams[0], run, mesh2d)
 
 
+@pytest.mark.slow
 def test_2d_pod_sweep_with_topology_axis_matches_1d():
     """Families × modes on the full 2-D (configs × node-shards) mesh:
     trajectories identical to the 1-D families batch."""
@@ -319,6 +322,7 @@ def _sizes_stack():
             G.ring(333, 4)]
 
 
+@pytest.mark.slow
 def test_n_axis_matches_solo_bitwise():
     """Every (size, mode, fanout) cell of a mixed-n batch equals the solo
     single-topology batch at that n BITWISE — phantom rows are inert."""
@@ -439,6 +443,7 @@ def test_2d_pod_sweep_rejects_mixed_rumors():
                                RunConfig(max_rounds=4), mesh2d)
 
 
+@pytest.mark.slow
 def test_mixed_n_complete_batch_matches_solo_bitwise():
     """The last structural axis (round 4): mixed-n IMPLICIT batches.
     Complete graphs have no table to stack; each point's uniform draw is
@@ -471,6 +476,7 @@ def test_mixed_n_complete_batch_matches_solo_bitwise():
                                       err_msg=f"static cell {i} msgs")
 
 
+@pytest.mark.slow
 def test_mixed_n_complete_composes_with_mixed_rumors():
     topos = [G.complete(96), G.complete(200)]
     run = RunConfig(seed=3, max_rounds=12, target_coverage=0.999)

@@ -1,0 +1,59 @@
+"""Dry-run contract: schema + steady-state budget guard (tier-1).
+
+``__graft_entry__.dryrun_multichip`` is the driver's MULTICHIP record;
+its per-family table is how collective-layout and driver-cache
+regressions surface round-over-round.  This test pins the contract so
+the schema (all 10 families, the wall-decomposition keys on the fused
+rows) and the per-family steady budgets (tools/dryrun_budgets.json —
+the guard that catches the next 100x outlier at PR time) cannot
+silently regress.  The dry run re-execs itself in a hermetic scrubbed
+subprocess, so this is safe on any ambient platform.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# repo-root module, not a package member: load by path so collection
+# works from any cwd (same pattern as test_bench_contract.py)
+_spec = importlib.util.spec_from_file_location(
+    "graft_entry", os.path.join(_REPO, "__graft_entry__.py"))
+graft_entry = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(graft_entry)
+
+FAMILIES = frozenset({
+    "dense_pushpull", "packed_pull", "sparse_antientropy",
+    "topo_sparse_antientropy", "swim_rotating", "halo_banded",
+    "fused_planes", "fused_planes_fault_curve", "rumor_sir",
+    "hybrid_2d_sweep"})
+DECOMPOSED = ("fused_planes", "fused_planes_fault_curve")
+DECOMP_KEYS = ("steady_exec_ms", "init_build_ms", "driver_overhead_ms")
+
+
+def test_budget_file_parses_and_covers_every_family():
+    budgets = graft_entry.dryrun_steady_budgets()
+    assert set(budgets) == FAMILIES
+    assert all(v > 0 for v in budgets.values())
+
+
+def test_dryrun_carries_all_families_and_wall_decomposition():
+    """One real dry run on a 4-device hermetic CPU mesh: every family
+    present with first/steady timings, the fused rows wall-decomposed,
+    and the in-body budget guard green (a budget trip raises through
+    dryrun_multichip's subprocess rc check)."""
+    out = graft_entry.dryrun_multichip(4)
+    fam = out["dryrun_family_ms"]
+    assert set(fam) == FAMILIES
+    for name, row in fam.items():
+        assert row["first_ms"] > 0, name
+        assert row["steady_ms"] > 0, name
+    for name in DECOMPOSED:
+        row = fam[name]
+        for key in DECOMP_KEYS:
+            assert key in row, (name, key)
+        # the decomposition reconciles: steady ~= exec + init + residual
+        total = (row["steady_exec_ms"] + row["init_build_ms"]
+                 + row["driver_overhead_ms"])
+        assert total == pytest.approx(row["steady_ms"], abs=0.5), name

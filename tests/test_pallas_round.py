@@ -713,6 +713,86 @@ class TestHardwarePRNGFaultMasksMultirumor:
                 assert per_rumor[rr] == 0.0, (rr, per_rumor[rr])
 
 
+# ---------------------------------------------------------------------------
+# Reference-vs-Mosaic interpret equivalence.  ``interpret=True`` routes the
+# fused entry points through the pure-JAX reference lowering (fast XLA — the
+# driver/dry-run path); ``interpret="mosaic"`` forces the real Mosaic
+# interpreter.  These tests pin them bitwise-equal on injected bits, so the
+# kernel BODIES stay executed in CI and the reference can never drift.
+# (Injected bits only: the 0.4.x Mosaic interpreter has no CPU lowering for
+# the TPU PRNG primitives — gossip_tpu/compat.py module doc.)
+
+@pytest.mark.parametrize("fanout,sharing,drop_p,death",
+                         [(1, 1, 0.0, 0.0), (2, 1, 0.3, 0.2),
+                          (1, 2, 0.0, 0.0)])
+def test_reference_interpret_matches_mosaic_single_rumor(fanout, sharing,
+                                                         drop_p, death):
+    from gossip_tpu.config import FaultConfig
+    from gossip_tpu.ops.pallas_round import fault_masks_node_packed
+    n = 4096 * 8 - 37
+    rng = np.random.default_rng(71 + fanout + sharing)
+    rows = n_rows(n)
+    table = jnp.asarray(np.asarray(node_pack(
+        jnp.asarray(rng.random(n) < 0.05))))
+    alive_tab, thresh = (None, 0)
+    if drop_p or death:
+        fault = FaultConfig(drop_prob=drop_p, node_death_rate=death, seed=3)
+        alive_tab, thresh = fault_masks_node_packed(fault, n, 0)
+    bits = _random_bits(rng, rows, fanout, sharing)
+    kw = dict(inject_bits=bits, drop_threshold=thresh,
+              alive_table=alive_tab, plane_sharing=sharing)
+    ref = fused_pull_round(table, 0, 0, n, fanout, interpret=True, **kw)
+    mos = fused_pull_round(table, 0, 0, n, fanout, interpret="mosaic", **kw)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(mos))
+
+
+@pytest.mark.parametrize("fanout,drop_p,death", [(1, 0.0, 0.0),
+                                                 (2, 0.25, 0.15)])
+def test_reference_interpret_matches_mosaic_multirumor(fanout, drop_p,
+                                                       death):
+    from gossip_tpu.config import FaultConfig
+    from gossip_tpu.ops.pallas_round import fault_masks_word
+    n = 128 * 16 - 29
+    rng = np.random.default_rng(83 + fanout)
+    rows = mr_rows(n)
+    table = jnp.asarray(np.asarray(word_pack(
+        jnp.asarray(rng.random((n, 16)) < 0.05))))
+    alive_words, thresh = (None, 0)
+    if drop_p or death:
+        fault = FaultConfig(drop_prob=drop_p, node_death_rate=death, seed=5)
+        alive_words, thresh = fault_masks_word(fault, n, 0)
+    bits = _mr_bits(rng, rows, fanout)
+    kw = dict(inject_bits=bits, drop_threshold=thresh,
+              alive_words=alive_words)
+    ref = fused_multirumor_pull_round(table, 0, 0, n, fanout,
+                                      interpret=True, **kw)
+    mos = fused_multirumor_pull_round(table, 0, 0, n, fanout,
+                                      interpret="mosaic", **kw)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(mos))
+
+
+@pytest.mark.parametrize("fanout", [1, 2])
+def test_reference_interpret_matches_mosaic_staged_big_path(fanout):
+    """Both interpret impls of the STAGED path agree bitwise — and at
+    fanout > 1 the mosaic route exercises the no-draw-0-alias donation
+    rule (the fanout>1 fix) against the same operands."""
+    from gossip_tpu.ops.pallas_round import _fused_mr_round_big
+    n = 128 * 16 - 29
+    rng = np.random.default_rng(97 + fanout)
+    rows = mr_rows(n)
+    table = jnp.asarray(np.asarray(word_pack(
+        jnp.asarray(rng.random((n, 32)) < 0.04))))
+    bits = _mr_bits(rng, rows, fanout)
+    ref = _fused_mr_round_big(table, 0, 0, n, True, bits, fanout=fanout)
+    mos = _fused_mr_round_big(table, 0, 0, n, "mosaic", bits,
+                              fanout=fanout)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(mos))
+    # the value kernel computes the same function on the same bits
+    want = fused_multirumor_pull_round(table, 0, 0, n, fanout,
+                                       interpret=True, inject_bits=bits)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(want))
+
+
 def test_compiled_curve_fused_matches_stepwise():
     """The fixed-length curve scan is the SAME trajectory as stepping
     the kernel by hand (stubbed interpreter PRNG is deterministic),

@@ -144,7 +144,9 @@ def test_works_on_explicit_tables():
     assert cov > 0.8
 
 
-@pytest.mark.parametrize("variant", ["feedback", "blind"])
+@pytest.mark.parametrize("variant", [
+    pytest.param("feedback", marks=pytest.mark.slow),
+    pytest.param("blind", marks=pytest.mark.slow)])
 def test_sharded_rumor_bitwise_parity(variant):
     """The shard_map twin is bitwise-identical to the single-device
     kernel — same per-node threefry streams (keyed by global id), same
@@ -177,6 +179,7 @@ def test_sharded_rumor_bitwise_parity(variant):
     assert float(st1.msgs) == float(st8.msgs)
 
 
+@pytest.mark.slow
 def test_sharded_rumor_until_matches_single():
     from gossip_tpu.parallel.sharded import make_mesh
     from gossip_tpu.parallel.sharded_rumor import (
@@ -222,6 +225,7 @@ def test_rumor_seed_ensemble_matches_solo_trajectories():
     assert ens.extinction_rounds[1] == idx[0] + 1
 
 
+@pytest.mark.slow
 def test_sharded_rumor_curve_matches_single():
     """Round-4: sharded rumor CURVE capture (the last rumor carve-out).
     Both channels — coverage and hot fraction — match the single-device

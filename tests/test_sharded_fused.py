@@ -187,6 +187,76 @@ def test_fused_planes_cov_fn_alive_weighting():
                                  abs=1e-7)
 
 
+@pytest.mark.parametrize("fanout,with_fault", [(1, False), (2, False),
+                                               (1, True), (2, True)])
+def test_device_resident_loop_matches_per_round_driver(fanout, with_fault):
+    """The memoized device-resident drivers (curve scan + until loop,
+    on-device convergence, cached jitted init, alive mask as operand)
+    reproduce the per-round driver EXACTLY: same coverage curve, same
+    final planes — CPU, fanout 1 and 2, with and without FaultConfig.
+    This is the byte-identity contract behind the dry-run steady-state
+    speedup: faster, not different."""
+    from gossip_tpu.config import FaultConfig
+    from gossip_tpu.parallel.sharded_fused import (
+        fused_planes_cov_fn, simulate_curve_sharded_fused)
+    n, rumors, n_dev, rounds = 128 * 8, 96, 4, 3
+    mesh = make_plane_mesh(n_dev)
+    fault = (FaultConfig(node_death_rate=0.2, drop_prob=0.3, seed=7)
+             if with_fault else None)
+    run = RunConfig(seed=0, max_rounds=rounds)
+    covs, final = simulate_curve_sharded_fused(
+        n, rumors, run, mesh, fanout=fanout, interpret=not ON_TPU,
+        fault=fault)
+    # the per-round driver: step eagerly, coverage recorded per round
+    step = make_sharded_fused_round(n, mesh, fanout=fanout,
+                                    interpret=not ON_TPU, fault=fault)
+    planes = init_plane_state(n, rumors, mesh, 0)
+    cov_fn = fused_planes_cov_fn(n, fault)
+    for t in range(rounds):
+        planes = step(planes, 0, t)
+        assert float(covs[t]) == float(cov_fn(planes)), t
+    np.testing.assert_array_equal(np.asarray(final), np.asarray(planes))
+    # the until twin walks the same trajectory (the degenerate stubbed
+    # PRNG never reaches target, so it runs the full budget) and must
+    # land on the same planes and report coverage through the same
+    # chooser
+    rounds_u, cov_u, msgs_u, final_u = simulate_until_sharded_fused(
+        n, rumors, run, mesh, fanout=fanout, interpret=not ON_TPU,
+        fault=fault)
+    assert rounds_u == rounds
+    assert msgs_u == 2.0 * fanout * n * rounds
+    np.testing.assert_array_equal(np.asarray(final_u), np.asarray(planes))
+    assert float(cov_u) == float(cov_fn(planes))
+
+
+def test_fault_loop_shares_executable_across_death_draws():
+    """The fault-curve driver must NOT recompile per fault point: two
+    configs differing only in death rate/seed (same drop_prob) hit the
+    SAME memoized compiled loop — the alive mask is a runtime operand
+    (sharded_fused._cached_curve_scan key contract)."""
+    from gossip_tpu.config import FaultConfig
+    from gossip_tpu.parallel.sharded_fused import (
+        _cached_curve_scan, drop_threshold_for,
+        simulate_curve_sharded_fused)
+    n, rumors, n_dev = 128 * 8, 64, 4
+    mesh = make_plane_mesh(n_dev)
+    run = RunConfig(seed=0, max_rounds=2)
+    f1 = FaultConfig(node_death_rate=0.1, drop_prob=0.2, seed=3)
+    f2 = FaultConfig(node_death_rate=0.3, drop_prob=0.2, seed=11)
+    assert drop_threshold_for(f1) == drop_threshold_for(f2)
+    covs1, _ = simulate_curve_sharded_fused(n, rumors, run, mesh,
+                                            interpret=not ON_TPU, fault=f1)
+    info_before = _cached_curve_scan.cache_info()
+    covs2, _ = simulate_curve_sharded_fused(n, rumors, run, mesh,
+                                            interpret=not ON_TPU, fault=f2)
+    info_after = _cached_curve_scan.cache_info()
+    assert info_after.misses == info_before.misses   # shared loop builder
+    assert info_after.hits == info_before.hits + 1
+    # ... and the shared executable still separates the trajectories
+    # (different death draws weight coverage differently)
+    assert covs1.shape == covs2.shape == (2,)
+
+
 def test_simulate_curve_sharded_fused_matches_stepwise():
     """The plane-sharded curve scan equals stepping the sharded round by
     hand (stubbed interpreter PRNG), coverage recorded per round."""

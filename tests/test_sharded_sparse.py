@@ -24,11 +24,16 @@ def _mesh():
     return make_mesh(P8)
 
 
+# one param stays in the tier-1 gate as the structural smoke; the rest
+# are depth coverage on the slow tier (tier-1 wall budget — PERF.md
+# "Dry-run steady-state budget" round-6 note)
 @pytest.mark.parametrize("mode,fanout,rumors,fault", [
     (C.PULL, 1, 1, None),
-    (C.PULL, 2, 40, None),
-    (C.PULL, 1, 1, FaultConfig(node_death_rate=0.1, drop_prob=0.2, seed=3)),
-    (C.ANTI_ENTROPY, 1, 5, None),
+    pytest.param(C.PULL, 2, 40, None, marks=pytest.mark.slow),
+    pytest.param(C.PULL, 1, 1,
+                 FaultConfig(node_death_rate=0.1, drop_prob=0.2, seed=3),
+                 marks=pytest.mark.slow),
+    pytest.param(C.ANTI_ENTROPY, 1, 5, None, marks=pytest.mark.slow),
 ])
 def test_bitwise_parity_mesh_vs_reference(mode, fanout, rumors, fault):
     """The mesh run and the single-device reference must agree BITWISE for
@@ -74,6 +79,7 @@ def test_partner_marginal_is_uniform():
     assert chi2 < 323, chi2
 
 
+@pytest.mark.slow
 def test_converges_and_traffic_accounting():
     n = 1024
     proto = ProtocolConfig(mode=C.PULL, fanout=2, rumors=40)
@@ -94,6 +100,7 @@ def test_converges_and_traffic_accounting():
     assert float(msgs) == pytest.approx(2.0 * 2 * n * rounds)
 
 
+@pytest.mark.slow
 def test_sparse_matches_dense_pull_statistically():
     """Same protocol, different exchange: rounds-to-99% must agree within
     +/-2 rounds of the dense packed pull path."""
@@ -123,14 +130,20 @@ def test_rejects_push_and_unbalanced():
 
 
 @pytest.mark.parametrize("family,mode,fanout,rumors,fault", [
-    ("erdos_renyi", C.PULL, 1, 1, None),
-    ("erdos_renyi", C.PULL, 2, 40, None),
-    ("watts_strogatz", C.PULL, 1, 5,
-     FaultConfig(node_death_rate=0.1, drop_prob=0.2, seed=3)),
-    ("power_law", C.PULL, 1, 1, None),
-    ("erdos_renyi", C.ANTI_ENTROPY, 1, 5, None),
-    ("watts_strogatz", C.ANTI_ENTROPY, 2, 3,
-     FaultConfig(drop_prob=0.15, seed=5)),
+    pytest.param("erdos_renyi", C.PULL, 1, 1, None,
+                 marks=pytest.mark.slow),
+    pytest.param("erdos_renyi", C.PULL, 2, 40, None,
+                 marks=pytest.mark.slow),
+    pytest.param("watts_strogatz", C.PULL, 1, 5,
+                 FaultConfig(node_death_rate=0.1, drop_prob=0.2, seed=3),
+                 marks=pytest.mark.slow),
+    pytest.param("power_law", C.PULL, 1, 1, None,
+                 marks=pytest.mark.slow),
+    pytest.param("erdos_renyi", C.ANTI_ENTROPY, 1, 5, None,
+                 marks=pytest.mark.slow),
+    pytest.param("watts_strogatz", C.ANTI_ENTROPY, 2, 3,
+                 FaultConfig(drop_prob=0.15, seed=5),
+                 marks=pytest.mark.slow),
 ])
 def test_topo_bitwise_parity_mesh_vs_reference(family, mode, fanout,
                                                rumors, fault):
@@ -162,6 +175,7 @@ def test_topo_bitwise_parity_mesh_vs_reference(family, mode, fanout,
         assert float(ovf_m) == float(ovf_r)
 
 
+@pytest.mark.slow
 def test_topo_overflow_is_deterministic_and_counted():
     """With a tiny forced cap, overflow drops happen, are counted, and
     stay bitwise-identical between mesh and reference."""
@@ -190,6 +204,7 @@ def test_topo_overflow_is_deterministic_and_counted():
     assert float(st_m.msgs) < 2.0 * 2 * n * 5
 
 
+@pytest.mark.slow
 def test_topo_byte_accounting_er_100k():
     """The VERDICT item's 'done' criterion: on a 100k-node ER graph the
     sparse exchange moves O(messages), not O(N) — the per-round ICI
@@ -219,9 +234,14 @@ def test_topo_byte_accounting_er_100k():
     assert meta.dense_bytes == n_pad * 4
 
 
+@pytest.mark.slow
 def test_topo_sparse_matches_dense_statistically():
     """Same ER pull protocol through the sparse exchange and the dense
-    sharded path: rounds-to-99% within +/-2 (different RNG streams)."""
+    sharded path: rounds-to-99% within +/-2 (different RNG streams).
+
+    NOTE the +/-2 margin was tuned on the modern-jax random stream; on
+    the jax-0.4.x fallback stream this seed lands 3 apart (16 vs 19) —
+    re-tune the seed or margin when the pinned toolchain settles."""
     from gossip_tpu.parallel.sharded import simulate_until_sharded
     n = 2048
     topo = G.erdos_renyi(n, 12.0 / n, seed=9)
@@ -234,6 +254,7 @@ def test_topo_sparse_matches_dense_statistically():
     assert abs(r_s - r_d) <= 2, (r_s, r_d)
 
 
+@pytest.mark.slow
 def test_topo_curve_driver_and_overflow_series():
     n = 1024
     topo = G.watts_strogatz(n, 8, 0.2, seed=3)
@@ -260,6 +281,7 @@ def test_topo_rejections():
             ProtocolConfig(mode=C.PULL), G.complete(256), mesh)
 
 
+@pytest.mark.slow
 def test_topo_antientropy_converges_and_reverse_accounting():
     """Anti-entropy through the topo exchange: faster convergence than
     pure pull (bidirectional merge), reverse bytes in the meta, msgs
@@ -281,6 +303,7 @@ def test_topo_antientropy_converges_and_reverse_accounting():
     assert msgs_ae == pytest.approx(3.0 * n * r_ae, rel=0.05)
 
 
+@pytest.mark.slow
 def test_topo_dead_nodes_stay_dark():
     n = 256
     fault = FaultConfig(node_death_rate=0.3, seed=9)
@@ -300,6 +323,7 @@ def test_topo_dead_nodes_stay_dark():
     assert (seen[alive] != 0).mean() > 0.8
 
 
+@pytest.mark.slow
 def test_backend_routes_explicit_family_to_topo_sparse():
     """run_simulation(exchange='sparse') on an explicit family must take
     the capacity-capped topology path and report its traffic meta."""
@@ -329,6 +353,7 @@ def test_backend_routes_explicit_family_to_topo_sparse():
                        MeshConfig(n_devices=P8, exchange="sparse"))
 
 
+@pytest.mark.slow
 def test_dead_nodes_never_infected_or_requesting():
     n = 256
     fault = FaultConfig(node_death_rate=0.3, seed=9)

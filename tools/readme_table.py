@@ -3,6 +3,8 @@
 
 Usage:
     python tools/readme_table.py artifacts/baseline_sweep_r02b.jsonl
+    python tools/readme_table.py --dryrun-budgets MULTICHIP_r05.json \\
+        [MULTICHIP_r06.json]
 
 Prints the markdown table with the round-3 contract columns — wall,
 compile, and steady-state separated (RunReport meta ``compile_s`` /
@@ -10,9 +12,18 @@ compile, and steady-state separated (RunReport meta ``compile_s`` /
 shown as '—').  Paste over the table in README.md's "BASELINE configs
 measured on hardware" section after a hardware refresh
 (tools/hw_refresh.py step 'baseline_sweep' writes the artifact).
+
+``--dryrun-budgets`` renders the per-family steady-state budget table
+instead (docs/PERF.md "Dry-run steady-state budget"): families and
+budgets from tools/dryrun_budgets.json, measured steady_ms columns from
+one or two dry-run records — either a MULTICHIP_rNN.json (the table is
+parsed out of its ``tail``) or a raw ``{"dryrun_family_ms": ...}``
+dump.  With two records the first renders as "before" and the second
+as "after".
 """
 
 import json
+import os
 import sys
 
 
@@ -45,7 +56,48 @@ def main(path):
     return 0
 
 
+def _load_family_ms(path):
+    """The ``dryrun_family_ms`` table out of a dry-run record: a raw
+    dump, or a MULTICHIP_rNN.json whose ``tail`` holds the JSON line."""
+    with open(path) as f:
+        rec = json.load(f)
+    if "dryrun_family_ms" in rec:
+        return rec["dryrun_family_ms"]
+    for line in reversed(rec.get("tail", "").splitlines()):
+        if line.strip():
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(parsed, dict) and "dryrun_family_ms" in parsed:
+                return parsed["dryrun_family_ms"]
+    raise ValueError(f"{path} carries no dryrun_family_ms table")
+
+
+def main_dryrun_budgets(paths):
+    if not 1 <= len(paths) <= 2:
+        print("--dryrun-budgets takes one record (steady_ms) or two "
+              "(before/after)", file=sys.stderr)
+        return 2
+    budgets_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "dryrun_budgets.json")
+    with open(budgets_path) as f:
+        budgets = json.load(f)
+    tables = [_load_family_ms(p) for p in paths]
+    cols = (["steady_ms (before)", "steady_ms (after)"] if len(tables) == 2
+            else ["steady_ms"])
+    print("| family | " + " | ".join(cols) + " | budget_ms |")
+    print("|---|" + "---|" * (len(cols) + 1))
+    for fam in budgets:
+        cells = [str(t[fam]["steady_ms"]) if fam in t else "—"
+                 for t in tables]
+        print(f"| {fam} | " + " | ".join(cells) + f" | {budgets[fam]} |")
+    return 0
+
+
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--dryrun-budgets":
+        sys.exit(main_dryrun_budgets(sys.argv[2:]))
     if len(sys.argv) != 2:
         print(__doc__, file=sys.stderr)
         sys.exit(2)

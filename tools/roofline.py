@@ -119,6 +119,8 @@ def _microkernel(body, rows: int, interpret: bool):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    from gossip_tpu.compat import pallas_interpret_mode
+
     def call(i, table):
         seeds = jnp.stack([jnp.asarray(i, jnp.int32) * jnp.int32(1000003),
                            jnp.asarray(i, jnp.int32)])
@@ -129,7 +131,7 @@ def _microkernel(body, rows: int, interpret: bool):
                       pl.BlockSpec(memory_space=pltpu.VMEM)],
             out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
             input_output_aliases={1: 0},
-            interpret=pltpu.InterpretParams() if interpret else False,
+            interpret=pallas_interpret_mode(interpret),
         )(seeds, table)
     return call
 
@@ -192,7 +194,8 @@ def calibrate(rows: int, interpret: bool, iters: int) -> dict:
         "prng_words_per_s": BITS * words / t_prng,
         "gathers_per_s": (BITS * words / t_gather) if resolved else None,
         "gather_resolved": resolved,
-        # 2 elementary ops per chain step (xor+add folded, or+shift)
+        # 3 elementary vector ops per step (xor, shift, or; the s+k
+        # addend is scalar, folded per k) — matches the 3x multiplier
         "vpu_ops_per_s": 3 * VPU_CHAIN * words / t_vpu,
         "t_prng_ms": t_prng * 1e3,
         "t_prng_gather_ms": t_pg * 1e3,
