@@ -262,7 +262,25 @@ def main():
     measurement there; if the platform cannot even enumerate devices
     (single-client TPU tunnel wedged by an earlier killed process — it
     stays down for an hour+), fall back to a hermetic CPU measurement
-    instead of hanging the whole bench run.  One JSON line either way."""
+    instead of hanging the whole bench run.  One JSON line either way.
+
+    Every probe attempt, fallback decision, and the final measurement
+    are recorded in the run ledger (utils/telemetry: $GOSSIP_TELEMETRY,
+    default artifacts/ledger_bench.jsonl, fsync per event, echoed to
+    stderr) instead of ad-hoc stderr prints — the round-5 dark window
+    left 78/78 timed-out probes with no machine-readable trace; now a
+    wedge that hides the live number still leaves its own timeline."""
+    from gossip_tpu.utils import telemetry
+    led = telemetry.from_env(default_path=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "artifacts",
+        "ledger_bench.jsonl"), echo=True)
+    try:
+        return _main_ledgered(led)
+    finally:
+        led.close()
+
+
+def _main_ledgered(led):
     probe = [sys.executable, "-c", "import jax; jax.devices()"]
     body_cmd = [sys.executable, os.path.abspath(__file__), "--body"]
 
@@ -314,26 +332,36 @@ def main():
     probe_attempts = probe_attempts_from_env()
     ambient_ok = False
     for attempt in range(probe_attempts):
+        t0 = time.perf_counter()
         try:
             subprocess.run(probe, timeout=PROBE_TIMEOUT_S, check=True,
                            stdout=subprocess.DEVNULL,
                            stderr=subprocess.DEVNULL)
+            led.event("probe", outcome="ok", attempt=attempt + 1,
+                      of=probe_attempts,
+                      wall_s=round(time.perf_counter() - t0, 1))
             ambient_ok = True
             break
         except subprocess.CalledProcessError:
-            print("bench: platform probe failed fast (broken ambient "
-                  "platform, not a wedge); no retries", file=sys.stderr)
+            # broken ambient platform, not a wedge — deterministic, so
+            # no retries
+            led.event("probe", outcome="fast-fail", attempt=attempt + 1,
+                      of=probe_attempts,
+                      wall_s=round(time.perf_counter() - t0, 1))
             break
         except subprocess.TimeoutExpired:
-            print(f"bench: platform probe {attempt + 1}/{probe_attempts} "
-                  "timed out (wedged TPU tunnel?)", file=sys.stderr)
+            # the wedge signature
+            led.event("probe", outcome="timeout", attempt=attempt + 1,
+                      of=probe_attempts, timeout_s=PROBE_TIMEOUT_S)
+            led.counter("probe_timeouts")
             if attempt + 1 < probe_attempts:
                 time.sleep(PROBE_SLEEP_S)
     if ambient_ok:
         env = dict(os.environ)
     else:
-        print("bench: ambient JAX platform unusable; falling back to "
-              "hermetic CPU", file=sys.stderr)
+        led.event("fallback", to="hermetic-cpu",
+                  reason="ambient JAX platform unusable "
+                         "(wedged TPU tunnel?)")
         env = _hermetic_cpu_env()
     rc, out = run_body(env, BODY_TIMEOUT_S)
     line = final_json_line(out)
@@ -341,21 +369,23 @@ def main():
         # no measurement AND the body died on the ambient platform — the
         # tunnel wedged between probe and body (hang: rc None; fast init
         # failure: rc nonzero); one hermetic retry
-        print(f"bench: body failed on the ambient platform (rc={rc}); "
-              "retrying on hermetic CPU", file=sys.stderr)
+        led.event("fallback", to="hermetic-cpu-retry", rc=rc,
+                  reason="body failed on the ambient platform")
         rc, out = run_body(_hermetic_cpu_env(), HERMETIC_RETRY_TIMEOUT_S)
         line = final_json_line(out)
     if line is not None:
         # a parsable measurement line is THE success criterion: a body
         # that completed and then wedged/died in teardown still counts
         if rc != 0:
-            print(f"bench: body exited abnormally (rc={rc}) after "
-                  "emitting its measurement; keeping it", file=sys.stderr)
+            led.event("body_abnormal_exit", rc=rc,
+                      note="measurement emitted before death; keeping it")
+        led.event("measurement", line=json.loads(line))
         print(line)
         return 0
     # keep the one-JSON-line contract even in total failure; vs_baseline
     # null + backend null: no TPU measurement happened (measurement_line
     # contract)
+    led.event("measurement_failed", rc=rc)
     print(json.dumps({
         "metric": "node_rounds_per_sec_per_chip", "value": 0.0,
         "unit": f"bench body failed on every platform (rc={rc}; "

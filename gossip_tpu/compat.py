@@ -22,6 +22,16 @@ from __future__ import annotations
 import jax
 
 
+def legacy_jax() -> bool:
+    """True on the 0.4.x fallback toolchain (the ``jax.shard_map``
+    probe is the same seam every shim below keys off).  Version-gated
+    *behaviors* — not just spellings — route through this: jax.random's
+    partner-draw streams differ between the two lines, so statistical
+    tests tuned on one stream may need a wider margin on the other
+    (tests/test_sharded_sparse.py)."""
+    return not hasattr(jax, "shard_map")
+
+
 def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
     """``jax.shard_map`` on modern jax; the ``jax.experimental`` spelling
     (``check_rep`` kwarg) on 0.4.x.  Semantics are identical for the

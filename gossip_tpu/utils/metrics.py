@@ -71,7 +71,15 @@ def dump_curve_jsonl(path: str, coverage: Sequence[float],
                      msgs: Optional[Sequence[float]] = None,
                      meta: Optional[dict] = None) -> None:
     """One JSON object per round: {round, coverage, msgs?} with an optional
-    leading meta line ({"meta": ...}) — trivially greppable/plottable."""
+    leading meta line ({"meta": ...}) — trivially greppable/plottable.
+
+    A msgs series of the wrong length is rejected BEFORE the file is
+    opened: the old behavior raised IndexError mid-write, leaving a
+    torn artifact on disk that silently parsed as a shorter run."""
+    if msgs is not None and len(msgs) != len(coverage):
+        raise ValueError(
+            f"len(msgs)={len(msgs)} != len(coverage)={len(coverage)}; "
+            "each round needs both series (pass msgs=None to omit)")
     with open(path, "w") as f:
         if meta is not None:
             f.write(json.dumps({"meta": meta}) + "\n")

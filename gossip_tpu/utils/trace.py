@@ -9,6 +9,7 @@ TensorBoard / Perfetto (`trace(...)`) or annotate host-side phases
 from __future__ import annotations
 
 import contextlib
+import math
 import time
 from typing import Iterator, Optional
 
@@ -91,8 +92,23 @@ def maybe_aot_timed(jitted, timing, *args):
     if timing.get("aot", True) is False:
         out, timing["steady_s"] = steady_timed(jitted, *args)
         timing.setdefault("compile_s", 0.0)
-        return out
-    out, timing["compile_s"], timing["steady_s"] = aot_timed(jitted, *args)
+    else:
+        out, timing["compile_s"], timing["steady_s"] = aot_timed(jitted,
+                                                                 *args)
+    # every driver's wall decomposition reaches the ambient run ledger
+    # (utils/telemetry) with no per-driver plumbing; a NullLedger makes
+    # this a no-op.  The emit happens AFTER this call's own timed
+    # region, but the CALLER may be timing us (the dry run's family
+    # windows) — so sync=False: flush-only, no fsync latency inside
+    # anyone's measured wall
+    from gossip_tpu.utils import telemetry
+    telemetry.current().event(
+        "driver_timing", sync=False,
+        fn=getattr(jitted, "__name__", None) or type(jitted).__name__,
+        # walls only: the bool "aot" control flag is an int subclass
+        # and must not masquerade as a timing field
+        **{k: v for k, v in timing.items()
+           if isinstance(v, (int, float)) and not isinstance(v, bool)})
     return out
 
 
@@ -116,3 +132,26 @@ class RoundTimer:
     @property
     def mean_ms(self) -> float:
         return 1e3 * sum(self.times) / max(1, len(self.times))
+
+    def percentile_ms(self, q: float) -> float:
+        """Nearest-rank percentile (q in [0, 1]) of the recorded round
+        walls, in ms; 0.0 with no samples (mean_ms convention)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q={q} outside [0, 1]")
+        if not self.times:
+            return 0.0
+        ordered = sorted(self.times)
+        # epsilon guards float artifacts like 0.95*20 -> 19.000000000000004
+        rank = math.ceil(q * len(ordered) - 1e-9)
+        return 1e3 * ordered[min(len(ordered) - 1, max(0, rank - 1))]
+
+    @property
+    def p50_ms(self) -> float:
+        return self.percentile_ms(0.50)
+
+    @property
+    def p95_ms(self) -> float:
+        """Stepwise drivers report means that hide stragglers (a single
+        wedged round disappears into 100 fast ones); the tail
+        percentile is the straggler detector."""
+        return self.percentile_ms(0.95)

@@ -15,6 +15,8 @@ import os
 
 import pytest
 
+from gossip_tpu.utils import telemetry
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # repo-root module, not a package member: load by path so collection
 # works from any cwd (same pattern as test_bench_contract.py)
@@ -22,6 +24,12 @@ _spec = importlib.util.spec_from_file_location(
     "graft_entry", os.path.join(_REPO, "__graft_entry__.py"))
 graft_entry = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(graft_entry)
+
+_rspec = importlib.util.spec_from_file_location(
+    "telemetry_report", os.path.join(_REPO, "tools",
+                                     "telemetry_report.py"))
+telemetry_report = importlib.util.module_from_spec(_rspec)
+_rspec.loader.exec_module(telemetry_report)
 
 FAMILIES = frozenset({
     "dense_pushpull", "packed_pull", "sparse_antientropy",
@@ -38,12 +46,19 @@ def test_budget_file_parses_and_covers_every_family():
     assert all(v > 0 for v in budgets.values())
 
 
-def test_dryrun_carries_all_families_and_wall_decomposition():
+def test_dryrun_carries_all_families_and_wall_decomposition(tmp_path):
     """One real dry run on a 4-device hermetic CPU mesh: every family
     present with first/steady timings, the fused rows wall-decomposed,
     and the in-body budget guard green (a budget trip raises through
-    dryrun_multichip's subprocess rc check)."""
-    out = graft_entry.dryrun_multichip(4)
+    dryrun_multichip's subprocess rc check).
+
+    Since round 7 the same run is also the telemetry contract: the
+    budget guard runs with the ledger ENABLED (so a green guard
+    certifies telemetry adds no steady-state cost), and the per-family
+    table must be reproducible from ledger data alone
+    (tools/telemetry_report.family_table == the stdout table)."""
+    ledger_path = str(tmp_path / "dryrun_ledger.jsonl")
+    out = graft_entry.dryrun_multichip(4, ledger_path=ledger_path)
     fam = out["dryrun_family_ms"]
     assert set(fam) == FAMILIES
     for name, row in fam.items():
@@ -57,3 +72,53 @@ def test_dryrun_carries_all_families_and_wall_decomposition():
         total = (row["steady_exec_ms"] + row["init_build_ms"]
                  + row["driver_overhead_ms"])
         assert total == pytest.approx(row["steady_ms"], abs=0.5), name
+
+    # --- the run ledger reproduces the table from its own data alone
+    assert out["ledger_path"] == ledger_path
+    events = telemetry.load_ledger(ledger_path, run="last")
+    assert events[0]["ev"] == "provenance"
+    assert any(e["ev"] == "runtime" and e["device_count"] == 4
+               for e in events)
+    assert telemetry_report.family_table(events) == fam
+    # one span per family timing, all closed, rooted under the run span
+    tree = telemetry_report.span_tree(events)
+    names = {n["name"] for _, n in tree}
+    assert "dryrun_multichip" in names
+    for name in FAMILIES:
+        assert f"{name}:first_ms" in names
+        assert f"{name}:steady_ms" in names
+    assert not [n["name"] for _, n in tree if n["unclosed"]]
+    # the guard verdict is ledgered (green — telemetry was on)
+    guard = [e for e in events if e["ev"] == "budget_guard"][-1]
+    assert guard["ok"] is True
+    # and the markdown render carries every family row + the verdict
+    md = telemetry_report.render_markdown(events)
+    for name in FAMILIES:
+        assert name in md
+    assert "green" in md
+
+
+def test_committed_8dev_dryrun_ledger_renders():
+    """The committed 8-device dry-run ledger artifact
+    (artifacts/ledger_dryrun_r07.jsonl) is the doc-ready record: it
+    must keep parsing, carry provenance, and render the full
+    per-family table (first/steady/decomposition) from ledger data
+    alone."""
+    path = os.path.join(_REPO, "artifacts", "ledger_dryrun_r07.jsonl")
+    events = telemetry.load_ledger(path, run="last")
+    prov = events[0]
+    assert prov["ev"] == "provenance"
+    assert len(prov["git_commit"]) == 40
+    assert any(e["ev"] == "runtime" and e["device_count"] == 8
+               for e in events)
+    fam = telemetry_report.family_table(events)
+    assert set(fam) == FAMILIES
+    for name in DECOMPOSED:
+        for key in DECOMP_KEYS:
+            assert key in fam[name], (name, key)
+    budgets = graft_entry.dryrun_steady_budgets()
+    assert all(fam[f]["steady_ms"] <= budgets[f] for f in fam)
+    md = telemetry_report.render_markdown(events)
+    for name in FAMILIES:
+        assert name in md
+    assert "budget_ms" in md and "steady_exec_ms" in md

@@ -1,0 +1,309 @@
+"""Run-ledger telemetry (utils/telemetry): schema, crash contract, and
+the flight-recorder proof — a SIGKILLed dry run leaves a parseable
+ledger with provenance and every span up to the kill point."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from gossip_tpu.utils import telemetry
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_ledger_schema_spans_counters_gauges(tmp_path):
+    p = str(tmp_path / "led.jsonl")
+    with telemetry.Ledger(p, argv=["prog", "--x"]) as led:
+        with led.span("outer", tag="t") as ext:
+            with led.span("inner"):
+                pass
+            ext["rows"] = 3
+        led.counter("timeouts")
+        led.counter("timeouts", 2)
+        led.gauge("coverage", 0.5)
+        led.event("probe", outcome="ok")
+    events = telemetry.load_ledger(p)
+    # provenance first, with the one artifact schema's keys
+    prov = events[0]
+    assert prov["ev"] == "provenance"
+    for key in ("run_id", "git_commit", "captured", "argv", "jax_version",
+                "schema"):
+        assert key in prov, key
+    assert prov["argv"] == ["prog", "--x"]
+    # every line is run-scoped and timestamped
+    assert all(e["run"] == prov["run_id"] and "ts" in e for e in events)
+    # span nesting via parent ids; walls recorded on end
+    starts = {e["name"]: e for e in events if e["ev"] == "span_start"}
+    ends = {e["name"]: e for e in events if e["ev"] == "span_end"}
+    assert starts["inner"]["parent"] == starts["outer"]["span"]
+    assert ends["outer"]["wall_ms"] >= ends["inner"]["wall_ms"] >= 0
+    assert ends["outer"]["ok"] and ends["outer"]["rows"] == 3
+    assert starts["outer"]["tag"] == "t"
+    # counters carry a running total so partial ledgers read high-water
+    totals = [e["total"] for e in events if e["ev"] == "counter"]
+    assert totals == [1, 3]
+
+
+def test_span_records_failure_and_start_precedes_work(tmp_path):
+    p = str(tmp_path / "led.jsonl")
+    led = telemetry.Ledger(p)
+    with pytest.raises(RuntimeError):
+        with led.span("doomed"):
+            raise RuntimeError("boom")
+    led.close()
+    events = telemetry.load_ledger(p)
+    end = next(e for e in events if e["ev"] == "span_end")
+    assert end["ok"] is False
+    # span_start is durable BEFORE the block body runs — the kill-proof
+    # property (the start line was already fsynced when the body raised)
+    assert [e["ev"] for e in events] == ["provenance", "span_start",
+                                        "span_end"]
+
+
+def test_from_env_null_and_activate(tmp_path, monkeypatch):
+    monkeypatch.delenv(telemetry.ENV_VAR, raising=False)
+    led = telemetry.from_env()
+    assert isinstance(led, telemetry.NullLedger)
+    with led.span("x") as ext:       # the no-op twin still yields a dict
+        ext["k"] = 1
+    led.event("y")
+    led.counter("z")
+    # explicit empty disables even over a default path
+    monkeypatch.setenv(telemetry.ENV_VAR, "")
+    assert isinstance(
+        telemetry.from_env(str(tmp_path / "d.jsonl")),
+        telemetry.NullLedger)
+    # env var wins; activate() installs/restores the ambient ledger
+    p = str(tmp_path / "env.jsonl")
+    monkeypatch.setenv(telemetry.ENV_VAR, p)
+    real = telemetry.from_env()
+    assert real.path == os.path.abspath(p)
+    prev = telemetry.activate(real)
+    try:
+        assert telemetry.current() is real
+    finally:
+        telemetry.activate(prev)
+    real.close()
+    assert telemetry.load_ledger(p)[0]["ev"] == "provenance"
+
+
+def test_torn_lines_dropped_and_strict_mode(tmp_path):
+    p = str(tmp_path / "led.jsonl")
+    with telemetry.Ledger(p) as led:
+        led.event("a")
+        led.event("b")
+    n = len(telemetry.load_ledger(p))
+    # a kill between write and fsync tears at most one line per writer
+    with open(p, "a") as f:
+        f.write('{"ev": "torn_mid_wri')
+    assert len(telemetry.load_ledger(p)) == n
+    # mid-file tears happen in SHARED files (a killed step subprocess,
+    # then the parent appends) — the post-mortem read-out must survive
+    # them, so the default drops; strict mode (single-writer) raises
+    lines = [ln for ln in open(p).read().splitlines() if ln.strip()]
+    bad = str(tmp_path / "bad.jsonl")
+    with open(bad, "w") as f:
+        f.write(lines[0] + "\nGARBAGE\n" + lines[1] + "\n")
+    assert len(telemetry.load_ledger(bad)) == 2
+    with pytest.raises(ValueError, match="corrupt"):
+        telemetry.load_ledger(bad, strict=True)
+
+
+def test_new_writer_heals_torn_tail_of_shared_file(tmp_path):
+    """A writer opening a file whose last line is torn (killed previous
+    writer) must newline-separate before its provenance line — the
+    fragment stays its own (dropped) line instead of corrupting the
+    new run's first event."""
+    p = str(tmp_path / "led.jsonl")
+    with telemetry.Ledger(p) as led:
+        led.event("a")
+    with open(p, "a") as f:
+        f.write('{"ev": "killed_mid_wri')       # no newline
+    with telemetry.Ledger(p) as led2:
+        led2.event("b")
+    events = telemetry.load_ledger(p)
+    assert any(e["ev"] == "provenance" and e["run"] == led2.run_id
+               for e in events)
+    assert any(e["ev"] == "b" for e in events)
+
+
+def test_load_ledger_run_filter(tmp_path):
+    p = str(tmp_path / "led.jsonl")
+    with telemetry.Ledger(p) as a:
+        a.event("first_run_event")
+    with telemetry.Ledger(p) as b:
+        b.event("second_run_event")
+    assert a.run_id != b.run_id
+    last = telemetry.load_ledger(p, run="last")
+    assert {e["run"] for e in last} == {b.run_id}
+    assert any(e["ev"] == "second_run_event" for e in last)
+    only_a = telemetry.load_ledger(p, run=a.run_id)
+    assert any(e["ev"] == "first_run_event" for e in only_a)
+    assert not any(e["ev"] == "second_run_event" for e in only_a)
+
+
+def test_maybe_aot_timed_emits_driver_timing(tmp_path):
+    """Every sharded driver's wall decomposition reaches the ambient
+    ledger through the ONE timing chokepoint (utils/trace) — no
+    per-driver plumbing."""
+    import jax.numpy as jnp
+
+    import jax
+    from gossip_tpu.utils.trace import maybe_aot_timed
+    p = str(tmp_path / "led.jsonl")
+    led = telemetry.Ledger(p)
+    prev = telemetry.activate(led)
+    try:
+        timing = {"init_build_s": 0.001}
+        out = maybe_aot_timed(jax.jit(lambda x: x * 2), timing,
+                              jnp.arange(4))
+        assert int(out[1]) == 2
+        # no ledger event without a timing dict (the plain-call path)
+        maybe_aot_timed(jax.jit(lambda x: x * 2), None, jnp.arange(4))
+    finally:
+        telemetry.activate(prev)
+        led.close()
+    events = [e for e in telemetry.load_ledger(p)
+              if e["ev"] == "driver_timing"]
+    assert len(events) == 1
+    assert events[0]["compile_s"] >= 0
+    assert events[0]["steady_s"] > 0
+    assert events[0]["init_build_s"] == 0.001
+
+
+@pytest.mark.skipif(not hasattr(signal, "SIGKILL"),
+                    reason="needs POSIX SIGKILL")
+def test_flight_recorder_survives_sigkill_mid_dryrun(tmp_path):
+    """THE flight-recorder proof (ISSUE 2 acceptance): SIGKILL a
+    dry-run family mid-round and the ledger on disk still parses,
+    containing provenance plus every span up to the kill point.
+
+    The child runs the real ``_dryrun_multichip_body`` on a 2-device
+    hermetic CPU mesh; the parent polls the ledger and pulls the
+    trigger as soon as the first FAMILY span has started (i.e. mid
+    compile/round of dense_pushpull) — exactly the dark-round shape:
+    a wedged/killed capture with work in flight."""
+    ledger = str(tmp_path / "killed.jsonl")
+    env = dict(os.environ)
+    for hazard in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORM_NAME",
+                   "LIBTPU_INIT_ARGS", "JAX_NUM_CPU_DEVICES"):
+        env.pop(hazard, None)
+    env["PYTHONPATH"] = _REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["GOSSIP_TELEMETRY"] = ledger
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "from __graft_entry__ import _dryrun_multichip_body; "
+         "_dryrun_multichip_body(2)"],
+        env=env, cwd=_REPO, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 180
+        killed_during = None
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                pytest.fail("dry run finished before the kill — poll "
+                            "window missed (raise the family count?)")
+            if os.path.exists(ledger):
+                try:
+                    events = telemetry.load_ledger(ledger)
+                except ValueError:
+                    events = []
+                fam_spans = [e for e in events
+                             if e.get("ev") == "span_start"
+                             and ":" in (e.get("name") or "")]
+                if fam_spans:
+                    killed_during = fam_spans[0]["name"]
+                    proc.send_signal(signal.SIGKILL)
+                    break
+            time.sleep(0.05)
+        else:
+            pytest.fail("no family span appeared within 180 s")
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    # the ledger parses IN FULL (fsync-per-event contract: at most a
+    # torn final line, which the loader drops by contract)
+    events = telemetry.load_ledger(ledger)
+    assert events[0]["ev"] == "provenance"
+    assert events[0]["git_commit"] is None or len(
+        events[0]["git_commit"]) == 40
+    # runtime context captured before any family ran
+    assert any(e["ev"] == "runtime" for e in events)
+    # every span up to the kill point is present; the family the run
+    # died inside shows an un-ended span — the "why was it dark" answer
+    names = [e["name"] for e in events if e["ev"] == "span_start"]
+    assert "dryrun_multichip" in names
+    assert killed_during in names
+    ended = {e["span"] for e in events if e["ev"] == "span_end"}
+    started = {e["span"]: e["name"] for e in events
+               if e["ev"] == "span_start"}
+    unclosed = [started[s] for s in started if s not in ended]
+    assert killed_during in unclosed
+    # and the report tool renders the partial ledger without error,
+    # naming the span the run died in
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        import telemetry_report
+    finally:
+        sys.path.pop(0)
+    md = telemetry_report.render_markdown(events)
+    assert "unclosed" in md
+    assert killed_during.split(":")[0] in md
+
+
+def test_reserved_keys_never_collide(tmp_path):
+    """A caller-supplied run/ts/ev field (the pre-ledger watchdog
+    format carried its own 'ts') must not corrupt run filtering — it
+    is prefixed, never overwriting."""
+    p = str(tmp_path / "led.jsonl")
+    with telemetry.Ledger(p) as led:
+        led.event("probe", ts="2026-01-01T00:00:00", run="bogus", ev="x")
+    events = telemetry.load_ledger(p, run="last")
+    probe = next(e for e in events if e["ev"] == "probe")
+    assert probe["run"] == events[0]["run_id"]       # filtering intact
+    assert probe["x_ts"] == "2026-01-01T00:00:00"
+    assert probe["x_run"] == "bogus" and probe["x_ev"] == "x"
+
+
+def test_disabled_file_keeps_echo_diagnostics(tmp_path, monkeypatch,
+                                              capsys):
+    """GOSSIP_TELEMETRY='' disables the FILE, but an echo-requesting
+    surface (bench.py) still gets stderr diagnostics — disabling the
+    recorder must never recreate the silent dark window."""
+    monkeypatch.setenv(telemetry.ENV_VAR, "")
+    led = telemetry.from_env(str(tmp_path / "d.jsonl"), echo=True)
+    assert isinstance(led, telemetry.EchoLedger)
+    assert led.path is None
+    led.event("probe", outcome="timeout")
+    led.counter("probe_timeouts")
+    err = capsys.readouterr().err
+    assert '"probe"' in err and "timeout" in err
+    assert not os.path.exists(tmp_path / "d.jsonl")
+
+
+def test_sync_false_event_still_lands(tmp_path):
+    """sync=False (the in-window driver_timing path) skips only the
+    fsync; the flushed line is still on disk immediately after."""
+    p = str(tmp_path / "led.jsonl")
+    led = telemetry.Ledger(p)
+    led.event("driver_timing", sync=False, steady_s=0.1)
+    events = telemetry.load_ledger(p)     # ledger still open
+    led.close()
+    assert any(e["ev"] == "driver_timing" and e["steady_s"] == 0.1
+               for e in events)
+
+
+def test_device_memory_stats_shape():
+    """CPU devices report no memory_stats: the helper returns None (and
+    memory_snapshot emits nothing) rather than fabricating zeros."""
+    stats = telemetry.device_memory_stats()
+    assert stats is None or (isinstance(stats, list) and stats
+                             and "device" in stats[0])

@@ -126,6 +126,50 @@ def test_curve_jsonl_round_trip(tmp_path):
     assert rows[0] == {"meta": {"mode": "pull"}}
     assert rows[1] == {"round": 1, "coverage": 0.5, "msgs": 3.0}
     assert rows[2]["coverage"] == 1.0
+    # full dump -> load round trip including the meta line: the loaded
+    # rows reconstruct exactly the series that were dumped
+    cov = [r["coverage"] for r in rows if "round" in r]
+    msgs = [r["msgs"] for r in rows if "round" in r]
+    p2 = str(tmp_path / "curve2.jsonl")
+    dump_curve_jsonl(p2, cov, msgs, meta=rows[0]["meta"])
+    assert load_curve_jsonl(p2) == rows
+    # msgs-free dump omits the field entirely
+    dump_curve_jsonl(p2, cov)
+    assert all("msgs" not in r for r in load_curve_jsonl(p2))
+
+
+def test_curve_jsonl_rejects_length_mismatch(tmp_path):
+    """A msgs series of the wrong length must raise ValueError BEFORE
+    any write (the old IndexError fired mid-write and left a torn
+    artifact that parsed as a shorter run)."""
+    p = str(tmp_path / "bad.jsonl")
+    with pytest.raises(ValueError, match="len"):
+        dump_curve_jsonl(p, [0.5, 1.0], [3])
+    with pytest.raises(ValueError, match="len"):
+        dump_curve_jsonl(p, [0.5], [3, 7], meta={"m": 1})
+    assert not os.path.exists(p), "nothing may be written on rejection"
+
+
+def test_round_timer_percentiles():
+    """p50/p95 alongside mean: stepwise drivers report means that hide
+    stragglers — one wedged round in 100 fast ones moves p95, not the
+    mean."""
+    t = RoundTimer()
+    assert t.mean_ms == t.p50_ms == t.p95_ms == 0.0   # no samples yet
+    t.times = [0.001 * v for v in range(1, 101)]      # 1..100 ms
+    assert t.p50_ms == pytest.approx(50.0)
+    assert t.p95_ms == pytest.approx(95.0)
+    assert t.mean_ms == pytest.approx(50.5)
+    # a straggler dominates the tail, barely moves the mean
+    t.times = [0.001] * 99 + [1.0]
+    assert t.p50_ms == pytest.approx(1.0)
+    assert t.p95_ms == pytest.approx(1.0)
+    assert t.percentile_ms(1.0) == pytest.approx(1000.0)
+    # single sample: every percentile is that sample
+    t.times = [0.004]
+    assert t.p50_ms == t.p95_ms == pytest.approx(4.0)
+    with pytest.raises(ValueError, match="outside"):
+        t.percentile_ms(1.5)
 
 
 def test_trace_smoke(tmp_path):

@@ -1,0 +1,99 @@
+"""Artifact provenance gate (tools/validate_artifacts.py, tier-1):
+every committed artifacts/*.json(l) parses, and every new-format
+artifact carries the one provenance schema (run_id/git_commit/
+captured — utils/telemetry.provenance).  Legacy pre-ledger artifacts
+are allowlisted BY NAME, never silently grandfathered."""
+
+import importlib.util
+import json
+import os
+
+from gossip_tpu.utils import telemetry
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "validate_artifacts",
+    os.path.join(_REPO, "tools", "validate_artifacts.py"))
+va = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(va)
+
+
+def test_repo_artifacts_all_valid():
+    """The actual gate: the committed artifacts directory is green.  A
+    failure here means someone added an artifact without provenance
+    (embed utils/telemetry.provenance()) or corrupted one."""
+    failures = va.validate_dir(os.path.join(_REPO, "artifacts"))
+    assert failures == {}, failures
+
+
+def test_legacy_allowlist_names_only_committed_files():
+    """The allowlist can only SHRINK: every name on it must still exist
+    (a retired artifact must leave the list, keeping it an honest
+    census of the pre-ledger debt)."""
+    art = os.path.join(_REPO, "artifacts")
+    missing = [n for n in va.LEGACY
+               if not os.path.exists(os.path.join(art, n))]
+    assert missing == [], missing
+
+
+def test_new_json_requires_provenance(tmp_path):
+    bad = tmp_path / "new_capture_r99.json"
+    bad.write_text(json.dumps({"value": 1}))
+    assert any("provenance" in p for p in va.validate_file(str(bad)))
+    good = tmp_path / "good_capture_r99.json"
+    good.write_text(json.dumps({"value": 1,
+                                "provenance": telemetry.provenance()}))
+    assert va.validate_file(str(good)) == []
+    # top-level keys (the bench last_tpu style) also satisfy the schema
+    flat = tmp_path / "flat_r99.json"
+    flat.write_text(json.dumps({"run_id": "x", "git_commit": None,
+                                "captured": "2026-01-01", "value": 2}))
+    assert va.validate_file(str(flat)) == []
+
+
+def test_new_jsonl_requires_provenance_line_and_ledgers_pass(tmp_path):
+    bare = tmp_path / "rows_r99.jsonl"
+    bare.write_text('{"round": 1}\n{"round": 2}\n')
+    assert any("provenance" in p for p in va.validate_file(str(bare)))
+    led_path = tmp_path / "ledger_x.jsonl"
+    with telemetry.Ledger(str(led_path)) as led:
+        led.event("probe", outcome="ok")
+    assert va.validate_file(str(led_path)) == []
+    # the crash contract carries over: torn lines (a killed writer —
+    # tail for single-writer files, mid-file for shared ones) are
+    # dropped, and the surviving lines still satisfy provenance
+    with open(led_path, "a") as f:
+        f.write('{"ev": "torn')
+    assert va.validate_file(str(led_path)) == []
+    lines = [ln for ln in led_path.read_text().splitlines()
+             if ln.strip()]
+    shared = tmp_path / "shared_r99.jsonl"
+    shared.write_text(lines[0] + "\nTORN_CHILD_FRAGMENT\n"
+                      + "\n".join(lines[1:]) + "\n")
+    assert va.validate_file(str(shared)) == []
+    # but a file whose PARSEABLE lines lack provenance still fails
+    noprov = tmp_path / "noprov_r99.jsonl"
+    noprov.write_text('TORN\n{"round": 1}\n')
+    assert any("provenance" in p for p in va.validate_file(str(noprov)))
+
+
+def test_malformed_json_fails_even_when_legacy(tmp_path):
+    """Legacy exempts a file from provenance, never from parsing."""
+    # a .json legacy name: a one-line bad .jsonl would be dropped as a
+    # legal torn tail, which is the crash contract, not a parse pass
+    legacy_name = sorted(n for n in va.LEGACY if n.endswith(".json"))[0]
+    p = tmp_path / legacy_name
+    p.write_text("{not json")
+    assert any("parse" in msg for msg in va.validate_file(str(p)))
+
+
+def test_validate_dir_and_main(tmp_path):
+    (tmp_path / "ok_r99.json").write_text(
+        json.dumps({"provenance": telemetry.provenance()}))
+    (tmp_path / "bad_r99.json").write_text(json.dumps({"v": 1}))
+    (tmp_path / "ignored.txt").write_text("not json, out of scope")
+    failures = va.validate_dir(str(tmp_path))
+    assert set(failures) == {"bad_r99.json"}
+    assert va.main([str(tmp_path)]) == 1
+    os.remove(tmp_path / "bad_r99.json")
+    assert va.main([str(tmp_path)]) == 0

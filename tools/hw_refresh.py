@@ -95,6 +95,28 @@ def summary_path():
     return _art("hw_refresh_r05.json")
 
 
+_LEDGER = None
+
+
+def _ledger():
+    """The refresh run's flight recorder (utils/telemetry), opened
+    lazily AFTER --smoke has been parsed (the path is smoke-infixed).
+    Step subprocesses inherit the same file via GOSSIP_TELEMETRY
+    (_body_env), so a window that closes mid-step still leaves one
+    mechanically readable timeline: provenance, per-step spans (start
+    fsynced before the subprocess launches), step verdict events, and
+    whatever the children recorded before the kill."""
+    global _LEDGER
+    if _LEDGER is None:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        try:
+            from _telemetry import open_ledger
+        finally:
+            sys.path.pop(0)
+        _LEDGER = open_ledger(_art("ledger_hw_refresh.jsonl"))
+    return _LEDGER
+
+
 def _load_bench():
     # single-source loader (tools/_bench.py) — lazy so importing this
     # module never pays the bench load
@@ -143,9 +165,11 @@ def step(tag, fn):
     subprocess-overran-its-budget case, which on the single-client axon
     tunnel is the wedge signature: the caller should stop burning the
     remaining steps' timeouts against a dead tunnel."""
-    t0 = time.time()
+    led = _ledger()     # lazy init (file open + git rev-parse) must not
+    t0 = time.time()    # bill its cost to the first step's wall_s
     try:
-        out = fn()
+        with led.span(tag, step=tag):
+            out = fn()
         line = {"step": tag, "ok": True,
                 "wall_s": round(time.time() - t0, 1), "result": out}
     except subprocess.TimeoutExpired as e:
@@ -163,6 +187,7 @@ def step(tag, fn):
                 "wall_s": round(time.time() - t0, 1),
                 "error": f"{type(e).__name__}: {e}"[:500]}
     print(json.dumps(line), flush=True)
+    led.event("step", **line)
     # persist after EVERY step so an outer-timeout kill still leaves the
     # completed steps on disk as a committable artifact; a failed write
     # must not abort the remaining steps (stdout still carries the line)
@@ -248,12 +273,21 @@ def _body_env():
     if not SMOKE:
         env = dict(os.environ)
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        return env
+        return _share_ledger(env)
     env = _load_bench()._hermetic_cpu_env()
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     # conftest honors this var over JAX_PLATFORMS — an operator who has
     # it exported for hardware runs must not leak it into the rehearsal
     env.pop("GOSSIP_TPU_TEST_PLATFORM", None)
+    return _share_ledger(env)
+
+
+def _share_ledger(env):
+    """Children append to the refresh ledger (one timeline per window;
+    their own provenance lines carry distinct run ids)."""
+    path = _ledger().path
+    if path:
+        env.setdefault("GOSSIP_TELEMETRY", path)
     return env
 
 
@@ -494,7 +528,7 @@ def bench():
     if SMOKE:
         env = {**_body_env(), "GOSSIP_BENCH_PROBE_ATTEMPTS": "1"}
     else:
-        env = dict(os.environ)
+        env = _share_ledger(dict(os.environ))
     p = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
                        capture_output=True, text=True,
                        timeout=bench_budget_s(), cwd=REPO, env=env)
@@ -574,6 +608,9 @@ def main(only=None):
     if only is not None and not list(only):
         print(json.dumps({"nothing_pending": True}), flush=True)
         return 0
+    _ledger().event("refresh_start", smoke=SMOKE,
+                    steps=[t for t, _ in STEPS
+                           if only is None or t in only])
     results = []
     for tag, fn in STEPS:
         if only is not None and tag not in only:
@@ -585,6 +622,8 @@ def main(only=None):
                               "reason": "step timeout = wedge signature; "
                                         "not burning remaining budgets"}),
                   flush=True)
+            _ledger().event("refresh_abort", after=tag,
+                            reason="step timeout = wedge signature")
             break
     oks = [r is True for r in results]
     return 0 if oks and all(oks) else (1 if any(oks) else 2)

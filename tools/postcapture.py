@@ -198,8 +198,9 @@ def main():
 
     if not any_found:
         print("\n(no r05 hardware artifacts yet — the watchdog is "
-              "presumably still probing; artifacts/tunnel_health_r05."
-              "jsonl has the probe history)")
+              "presumably still probing; artifacts/ledger_tunnel_"
+              "watchdog.jsonl has the probe history, rendered by "
+              "tools/telemetry_report.py)")
     return 0
 
 

@@ -58,19 +58,22 @@ def main(path):
 
 def _load_family_ms(path):
     """The ``dryrun_family_ms`` table out of a dry-run record: a raw
-    dump, or a MULTICHIP_rNN.json whose ``tail`` holds the JSON line."""
+    dump, or a MULTICHIP_rNN.json whose ``tail`` holds the JSON line —
+    scanned by telemetry.parse_dryrun_table, the one parser of the
+    dry-run stdout contract (jax-free import)."""
     with open(path) as f:
         rec = json.load(f)
     if "dryrun_family_ms" in rec:
         return rec["dryrun_family_ms"]
-    for line in reversed(rec.get("tail", "").splitlines()):
-        if line.strip():
-            try:
-                parsed = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if isinstance(parsed, dict) and "dryrun_family_ms" in parsed:
-                return parsed["dryrun_family_ms"]
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        from gossip_tpu.utils.telemetry import parse_dryrun_table
+    finally:
+        sys.path.pop(0)
+    parsed = parse_dryrun_table(rec.get("tail", ""))
+    if parsed is not None:
+        return parsed["dryrun_family_ms"]
     raise ValueError(f"{path} carries no dryrun_family_ms table")
 
 

@@ -9,8 +9,9 @@ whatever healthy window appears during the round.  This script:
 
   1. probes ``jax.devices()`` in a subprocess (120 s timeout — a healthy
      tunnel answers in seconds; a timeout is the wedge signature),
-  2. appends one JSON line per probe to
-     artifacts/tunnel_health_r05.jsonl,
+  2. ledgers one event per probe to
+     artifacts/ledger_tunnel_watchdog.jsonl (utils/telemetry schema;
+     render with tools/telemetry_report.py),
   3. on the first success, immediately runs tools/hw_refresh.py under
      its own worst-case budget, tee-ing output to
      artifacts/hw_refresh_r05.log, then exits.
@@ -36,16 +37,39 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-HEALTH_LOG = os.path.join(REPO, "artifacts", "tunnel_health_r05.jsonl")
+HEALTH_LOG = os.path.join(REPO, "artifacts", "ledger_tunnel_watchdog.jsonl")
 REFRESH_LOG = os.path.join(REPO, "artifacts", "hw_refresh_r05.log")
 PROBE_TIMEOUT_S = 120
 
+_LEDGER = None
+
+
+def _ledger():
+    """The watchdog's health log IS a run ledger since round 7
+    (utils/telemetry schema: provenance line, run ids, fsync per
+    event) — the hand-rolled r04/r05 tunnel_health JSONLs were the
+    only evidence the dark rounds left, and they carried no
+    provenance, so probe timelines could not be mechanically joined
+    with the refresh artifacts they gated.  Render / join with
+    tools/telemetry_report.py."""
+    global _LEDGER
+    if _LEDGER is None:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        try:
+            from _telemetry import open_ledger
+        finally:
+            sys.path.pop(0)
+        _LEDGER = open_ledger(HEALTH_LOG)
+    return _LEDGER
+
 
 def log_line(obj):
-    obj["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
-    with open(HEALTH_LOG, "a") as f:
-        f.write(json.dumps(obj) + "\n")
-    print(json.dumps(obj), flush=True)
+    """One durable ledger event (kind = the line's ``event`` field),
+    still echoed to stdout for the operator's nohup log."""
+    obj = dict(obj)
+    kind = obj.pop("event", "note")
+    _ledger().event(kind, **obj)
+    print(json.dumps({"event": kind, **obj}), flush=True)
 
 
 def probe():

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""CI gate: every committed artifact parses, every new-format artifact
+carries provenance.
+
+The round-ledger contract (round 7, docs/OBSERVABILITY.md): an
+artifact whose numbers are meant to be believed must say which commit,
+toolchain, and run produced them — the provenance keys ``run_id``,
+``git_commit``, ``captured`` (utils/telemetry.provenance).  Ledger
+JSONLs carry them on their first ``provenance`` event line; plain-JSON
+artifacts embed the dict under a ``"provenance"`` key (or the three
+keys at top level, the bench ``last_tpu`` style).
+
+Artifacts that predate the ledger are ALLOWLISTED BY NAME below — an
+explicit, reviewable list, not a silent grandfather clause: adding a
+new artifact without provenance fails loudly, and retiring a legacy
+file shrinks the list.  Every file, legacy or not, must still parse
+(torn jsonl lines — a killed writer's fragment, tail or mid-file in
+shared flight-recorder files — are dropped by the crash contract; the
+surviving lines must satisfy the schema).
+
+    python tools/validate_artifacts.py            # repo artifacts/
+    python tools/validate_artifacts.py DIR        # any directory
+
+Exit 0 all green; exit 1 with one line per failure.  Run in tier-1 by
+tests/test_validate_artifacts.py.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROVENANCE_KEYS = ("run_id", "git_commit", "captured")
+
+# Pre-ledger artifacts, frozen by name.  Do NOT add new files here —
+# new artifacts must carry provenance (utils/telemetry.provenance);
+# this list only shrinks.
+LEGACY = frozenset({
+    "baseline_sweep_r02.jsonl",
+    "baseline_sweep_r04.jsonl",
+    "baseline_sweep_r04.smoke.jsonl",
+    "baseline_sweep_r04b.jsonl",
+    "baseline_sweep_r05.smoke.jsonl",
+    "dryrun_steady_budget_r06.json",
+    "ensembles_r05.smoke.json",
+    "hw_refresh_r04.json",
+    "hw_refresh_r04.smoke.json",
+    "hw_refresh_r05.smoke.json",
+    "kernel_numbers_r05.smoke.json",
+    "maelstrom_batching_r04.json",
+    "maelstrom_batching_r05.json",
+    "parity_r03.json",
+    "parity_r04.json",
+    "parity_r05.json",
+    "roofline_r05.smoke.json",
+    "swim_ab_r04.json",
+    "swim_cache_r04.json",
+    "swim_compile_ablation_r04.json",
+    "swim_diss_ab_r04.smoke.json",
+    "swim_diss_ab_r05.smoke.json",
+    "swim_steady_ablation_r05.smoke.json",
+    "tunnel_health_r04.jsonl",
+    "tunnel_health_r05.jsonl",
+})
+
+
+def _parse_jsonl(path):
+    """Parsed lines via the ONE crash-contract parser
+    (utils/telemetry.load_ledger: torn lines dropped — tail for
+    single-writer ledgers, mid-file for shared flight-recorder files)
+    — the contract must not fork between the writer and this gate."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from _telemetry import telemetry
+    finally:
+        sys.path.pop(0)
+    return telemetry().load_ledger(path)
+
+
+def _has_provenance_keys(obj) -> bool:
+    if not isinstance(obj, dict):
+        return False
+    if all(k in obj for k in PROVENANCE_KEYS):
+        return True
+    prov = obj.get("provenance")
+    return isinstance(prov, dict) and all(k in prov
+                                          for k in PROVENANCE_KEYS)
+
+
+def validate_file(path):
+    """[] when valid, else a list of human-readable problems."""
+    name = os.path.basename(path)
+    problems = []
+    try:
+        if name.endswith(".jsonl"):
+            rows = _parse_jsonl(path)
+            with open(path) as f:
+                nonblank = sum(1 for ln in f if ln.strip())
+            if nonblank and not rows:
+                # torn-line tolerance must not bless a file with NO
+                # surviving lines — that is destruction, not a crash
+                problems.append("does not parse: no parseable lines "
+                                f"among {nonblank}")
+            if name not in LEGACY:
+                if not any(_has_provenance_keys(r) for r in rows
+                           if isinstance(r, dict)):
+                    problems.append(
+                        "new-format jsonl without a provenance line "
+                        f"carrying {PROVENANCE_KEYS} "
+                        "(utils/telemetry.provenance)")
+        else:
+            with open(path) as f:
+                doc = json.load(f)
+            if name not in LEGACY and not _has_provenance_keys(doc):
+                problems.append(
+                    "new-format json without provenance keys "
+                    f"{PROVENANCE_KEYS} (embed utils/telemetry."
+                    "provenance() under a 'provenance' key)")
+    except ValueError as e:
+        problems.append(f"does not parse: {e}")
+    except OSError as e:
+        problems.append(f"unreadable: {e}")
+    return problems
+
+
+def validate_dir(art_dir):
+    """{filename: [problems]} for every *.json / *.jsonl in the dir
+    (empty dict == all green).  Non-JSON artifacts (.txt/.log capture
+    transcripts) are out of scope."""
+    failures = {}
+    for name in sorted(os.listdir(art_dir)):
+        if not name.endswith((".json", ".jsonl")):
+            continue
+        problems = validate_file(os.path.join(art_dir, name))
+        if problems:
+            failures[name] = problems
+    return failures
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    art_dir = argv[0] if argv else os.path.join(REPO, "artifacts")
+    if not os.path.isdir(art_dir):
+        print(f"no such directory: {art_dir}", file=sys.stderr)
+        return 2
+    failures = validate_dir(art_dir)
+    checked = [n for n in sorted(os.listdir(art_dir))
+               if n.endswith((".json", ".jsonl"))]
+    for name, problems in failures.items():
+        for p in problems:
+            print(f"FAIL {name}: {p}")
+    print(f"{len(checked) - len(failures)}/{len(checked)} artifacts "
+          f"valid in {art_dir}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
