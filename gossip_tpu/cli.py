@@ -55,26 +55,34 @@ def _add_cache_flags(p: argparse.ArgumentParser) -> None:
 
 
 def _enable_compile_cache(a) -> None:
+    """One definition of "the cache is on": utils/compile_cache, which
+    also probes the knob set (compat.persistent_cache_knobs) so a jax
+    line missing a knob degrades instead of crashing.  An explicit
+    disable must also override a JAX_COMPILATION_CACHE_DIR env var, or
+    the documented "honest cold compile" measurement could silently
+    hit that cache."""
     if not hasattr(a, "no_compile_cache"):   # subcommand without the flags
         return
-    import jax
+    from gossip_tpu.utils import compile_cache
     if a.no_compile_cache or not a.compile_cache:
-        # explicit disable must also override a JAX_COMPILATION_CACHE_DIR
-        # env var, or the documented "honest cold compile" measurement
-        # could silently hit that cache
-        jax.config.update("jax_compilation_cache_dir", None)
+        compile_cache.enable_persistent(None)
+        # the AOT executable store reads GOSSIP_COMPILE_CACHE directly
+        # (trace.aot_timed chokepoint) — an explicit disable must shut
+        # BOTH layers, or the store serves a warm compile_s that
+        # _cache_stamp then records as cold
+        os.environ[compile_cache.ENV_VAR] = ""
         return
-    try:
-        os.makedirs(a.compile_cache, exist_ok=True)
-    except OSError as e:   # read-only HOME / sandbox: run uncached
-        print(f"warning: compile cache disabled ({e})", file=sys.stderr)
-        jax.config.update("jax_compilation_cache_dir", None)
-        a.no_compile_cache = True      # keep _cache_stamp honest
-        return
-    jax.config.update("jax_compilation_cache_dir", a.compile_cache)
     # cache anything that took >2 s to compile; below that the disk
-    # round-trip costs more than the recompile
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    # round-trip costs more than the recompile (operator ~/.cache
+    # hygiene — the dry run's own dir caches everything instead)
+    status = compile_cache.enable_persistent(a.compile_cache,
+                                             min_compile_time_secs=2.0)
+    if not status["persistent"]:   # read-only HOME / sandbox: uncached
+        a.no_compile_cache = True  # keep _cache_stamp honest
+        os.environ[compile_cache.ENV_VAR] = ""
+        return
+    # both layers on one dir: the AOT store lands beside the XLA cache
+    os.environ[compile_cache.ENV_VAR] = a.compile_cache
 
 
 def _cache_stamp(a):

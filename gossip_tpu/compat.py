@@ -103,3 +103,50 @@ def pallas_compiler_params(*, vmem_limit_bytes: int):
     if cls is None:
         cls = pltpu.TPUCompilerParams
     return cls(vmem_limit_bytes=vmem_limit_bytes)
+
+
+# -- persistent-compilation-cache probes (utils/compile_cache) --------
+#
+# The cache knobs moved and grew across jax lines (the enable-xla-caches
+# flag does not exist everywhere; CPU-backend caching itself was once
+# gated).  utils/compile_cache PROBES through these helpers instead of
+# assuming, so the compile-once layer degrades to "no cache" cleanly on
+# a toolchain that lacks a knob rather than crashing at import or — the
+# worse failure — silently recording warm walls as cold ones.
+
+PERSISTENT_CACHE_KNOBS = (
+    "jax_compilation_cache_dir",
+    "jax_enable_compilation_cache",
+    "jax_persistent_cache_min_compile_time_secs",
+    "jax_persistent_cache_min_entry_size_bytes",
+    "jax_persistent_cache_enable_xla_caches",
+)
+
+
+def persistent_cache_knobs() -> dict:
+    """{knob_name: present_on_this_jax} for every cache knob the
+    compile-once layer may touch.  On 0.4.37 (this container) all five
+    exist; the consumer must tolerate any subset."""
+    return {k: hasattr(jax.config, k) for k in PERSISTENT_CACHE_KNOBS}
+
+
+def set_cache_knob(name: str, value) -> bool:
+    """``jax.config.update`` that reports instead of raising when the
+    knob does not exist on this jax line (False = not set)."""
+    if not hasattr(jax.config, name):
+        return False
+    jax.config.update(name, value)
+    return True
+
+
+def serialize_executable_fns():
+    """(serialize, deserialize_and_load) for the AOT executable store,
+    or None when this jax cannot round-trip compiled executables — the
+    store then reports every lookup as ``disabled`` and drivers compile
+    normally."""
+    try:
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load, serialize)
+    except ImportError:
+        return None
+    return serialize, deserialize_and_load

@@ -262,11 +262,61 @@ def _cached_pod_sweep_scan(n: int, n_pad: int, nl: int, k_max: int,
     return scan
 
 
+def _pod_sweep_cache_stats(info, before=None) -> tuple:
+    """(gauges, evicting) from ``lru_cache.cache_info()`` snapshots:
+    the telemetry view of the pod-sweep scan memo.  ``evicting`` is
+    the per-call thrash signature — THIS call missed (``misses`` grew
+    past ``before``'s) while the memo was already full, so lru_cache
+    evicted an entry to admit the new scan and some earlier shape's
+    re-entry will now recompile the whole shard_map program.  Judged
+    from the delta, not cumulative totals: a process that has seen 17
+    distinct shapes over its lifetime is not thrashing when a later
+    memo-hit sweep runs.  Pure function of the info tuples so the
+    predicate is unit-testable without 17 real compiles."""
+    gauges = {"pod_sweep_scan_cache_hits": info.hits,
+              "pod_sweep_scan_cache_misses": info.misses,
+              "pod_sweep_scan_cache_size": info.currsize,
+              "pod_sweep_scan_cache_maxsize": info.maxsize}
+    evicting = (before is not None
+                and info.maxsize is not None
+                and info.misses > before.misses
+                and before.currsize >= info.maxsize)
+    return gauges, evicting
+
+
+def _emit_pod_sweep_cache_telemetry(before) -> None:
+    """Sweep-end cache telemetry (the compile-once PR): gauges for the
+    memoized scan's hit/miss/size, and a ``sweep_cache_eviction``
+    warning event when this sweep's scan displaced a cached one — a
+    grid of more than the memo's 16 distinct shape keys used to thrash
+    and recompile silently.  ``before`` is the cache_info snapshot the
+    sweep took before building its scan."""
+    from gossip_tpu.utils import telemetry
+    led = telemetry.current()
+    gauges, evicting = _pod_sweep_cache_stats(
+        _cached_pod_sweep_scan.cache_info(), before)
+    # sync=False throughout: this emitter runs INSIDE whatever wall
+    # the caller is timing around the sweep (the dry run's
+    # hybrid_2d_sweep windows) — flush-only, no fsync latency in a
+    # measured steady_ms (the driver_timing contract, utils/trace)
+    for name, value in gauges.items():
+        led.gauge(name, value, sync=False)
+    if evicting:
+        led.event(
+            "sweep_cache_eviction", sync=False,
+            **gauges,
+            note="grid exceeds the pod-sweep scan memo (maxsize=16 "
+                 "distinct shape keys): some re-entries recompile the "
+                 "whole shard_map program; split the grid by shape or "
+                 "raise _cached_pod_sweep_scan's maxsize")
+
+
 def config_sweep_curves_2d(points, topo, run: RunConfig,
                            mesh, fault: Optional[FaultConfig] = None,
                            k_max: Optional[int] = None, rumors: int = 1,
                            sweep_axis: str = "sweep",
-                           node_axis: str = "nodes") -> ConfigSweepResult:
+                           node_axis: str = "nodes",
+                           timing=None) -> ConfigSweepResult:
     """The north star's full 2-D pod sweep: distinct configs sharded over
     ``sweep_axis`` AND every config's node dimension sharded over
     ``node_axis`` — one ``shard_map`` over a 2-D mesh, one XLA program.
@@ -285,6 +335,15 @@ def config_sweep_curves_2d(points, topo, run: RunConfig,
     ``node_axis``, and each point's ``topo_idx`` dynamic-slices its
     family — the complete "sweep fanout, mode, and graph topology across
     a TPU pod" program.
+
+    ``timing``: optional wall-decomposition dict (utils/trace
+    .maybe_aot_timed contract) — the AOT path additionally routes the
+    scan's compile through the GOSSIP_COMPILE_CACHE executable store
+    (``timing["compile_cache"]`` records hit|miss|disabled), making
+    the pod sweep warm-startable across processes like the other
+    sharded drivers.  Sweep-end telemetry always reports the scan
+    memo's hit/miss gauges and warns when the grid exceeded its 16
+    shape keys (:func:`_emit_pod_sweep_cache_telemetry`).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
     from gossip_tpu.parallel.sharded import _pad_rows, pad_to_mesh
@@ -337,6 +396,7 @@ def config_sweep_curves_2d(points, topo, run: RunConfig,
     else:
         tables = ()
 
+    cache_before = _cached_pod_sweep_scan.cache_info()
     scan = _cached_pod_sweep_scan(n, n_pad, nl, k_max, have_ae, need_push,
                                   need_pull, multi, have_table,
                                   run.max_rounds, run.origin, mesh,
@@ -361,8 +421,11 @@ def config_sweep_curves_2d(points, topo, run: RunConfig,
     keys = jax.device_put(keys, row)
     flags = [jax.device_put(f, row) for f in flags]
 
-    _, (covs, msgs) = scan(init_seen, keys,
-                           jnp.zeros((cN,), jnp.float32), *flags, *tables)
+    from gossip_tpu.utils.trace import maybe_aot_timed
+    _, (covs, msgs) = maybe_aot_timed(scan, timing, init_seen, keys,
+                                      jnp.zeros((cN,), jnp.float32),
+                                      *flags, *tables)
+    _emit_pod_sweep_cache_telemetry(cache_before)
     curves = np.asarray(covs).T
     return ConfigSweepResult(points=points, curves=curves,
                              msgs=np.asarray(msgs).T,

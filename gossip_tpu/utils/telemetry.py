@@ -203,8 +203,12 @@ class Ledger:
         self._counters[name] = total
         self._emit("counter", {"name": name, "inc": inc, "total": total})
 
-    def gauge(self, name: str, value):
-        self._emit("gauge", {"name": name, "value": value})
+    def gauge(self, name: str, value, sync: bool = True):
+        """``sync=False`` is for gauges emitted from INSIDE a caller's
+        timed window (the sweep's cache stats — the sweep call itself
+        is what the dry run measures): flush-only, same contract as
+        ``event(..., sync=False)``."""
+        self._emit("gauge", {"name": name, "value": value}, sync=sync)
 
     # -- spans ---------------------------------------------------------
 
@@ -304,7 +308,7 @@ class NullLedger:
     def counter(self, name, inc=1):
         pass
 
-    def gauge(self, name, value):
+    def gauge(self, name, value, sync=True):
         pass
 
     @contextlib.contextmanager
@@ -342,7 +346,7 @@ class EchoLedger(NullLedger):
     def counter(self, name, inc=1):
         self.event("counter", name=name, inc=inc)
 
-    def gauge(self, name, value):
+    def gauge(self, name, value, sync=True):
         self.event("gauge", name=name, value=value)
 
 

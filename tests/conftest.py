@@ -36,10 +36,8 @@ jax.config.update("jax_platforms", _platform)
 # inherited env via bench.py's _hermetic_cpu_env — imported, not copied,
 # so the hazard list (PALLAS_AXON_POOL_IPS, JAX_PLATFORM_NAME,
 # LIBTPU_INIT_ARGS, sitecustomize-bearing PYTHONPATH entries) lives in
-# exactly one place.  Children neither need nor may touch the tunnel;
-# its GOSSIP_COMPILE_CACHE="" is also right here (cache tests pass
-# explicit --compile-cache flags, which override the env var).  The TPU
-# tier (GOSSIP_TPU_TEST_PLATFORM=axon) keeps the env as-is.
+# exactly one place.  Children neither need nor may touch the tunnel.
+# The TPU tier (GOSSIP_TPU_TEST_PLATFORM=axon) keeps the env as-is.
 # NOTE this cannot protect the pytest parent itself — if the tunnel is
 # already wedged, launch pytest under
 # `eval "$(python bench.py --print-hermetic-env)"`.
@@ -55,5 +53,41 @@ if _platform == "cpu":
     for _k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORM_NAME",
                "LIBTPU_INIT_ARGS"):
         os.environ.pop(_k, None)
-    for _k in ("PYTHONPATH", "JAX_PLATFORMS", "GOSSIP_COMPILE_CACHE"):
+    for _k in ("PYTHONPATH", "JAX_PLATFORMS"):
         os.environ[_k] = _henv[_k]
+
+# Compile-once session cache (utils/compile_cache), EVERY tier: one
+# cache dir shared by every test-spawned CHILD, so the suite's
+# subprocess-heavy tests (CLI re-execs, checkpoint resumes, the
+# dry-run contract's cold+warm pair) compile each program once per
+# SESSION instead of once per child — what un-slowed the compile-heavy
+# resume tests back into tier-1.  Setting it on the axon tier too is a
+# guard, not an optimization: without it, CLI children would fall
+# through to cli.py's ~/.cache default and write the OPERATOR'S
+# persistent cache (the hazard the pre-compile-once "" pin protected
+# on every tier).  Tests that must measure cold compiles pin "" (or
+# pass explicit --compile-cache flags) in their own child envs, which
+# override this.  The PERSISTENT XLA layer is deliberately NOT
+# enabled in the pytest process itself (no jax.config update here):
+# one in-process persistent-cache HIT permanently breaks executable
+# DESERIALIZATION for the whole process on this toolchain ("Symbols
+# not found" — utils/compile_cache module doc), which would poison
+# the AOT-store tests that must observe real miss->hit round-trips
+# in-process.  The AOT STORE, by contrast, is ambient in-process via
+# this env var (trace.aot_timed reads it) and safely so: store hits
+# are bitwise-identical executables by contract, and tests that
+# assert store choreography pin their own dir over this one.
+_pinned_cache = os.environ.get("GOSSIP_TPU_TEST_COMPILE_CACHE")
+if _pinned_cache:
+    # caller-owned dir for cross-session reuse during local iteration
+    os.environ["GOSSIP_COMPILE_CACHE"] = _pinned_cache
+else:
+    import atexit
+    import shutil
+    import tempfile
+    _session_cache = tempfile.mkdtemp(prefix="gossip_test_compile_cache_")
+    os.environ["GOSSIP_COMPILE_CACHE"] = _session_cache
+    # a session's cache holds the whole suite's XLA entries + AOT
+    # executables (multi-MB) — reap it ourselves rather than betting
+    # on /tmp aging
+    atexit.register(shutil.rmtree, _session_cache, ignore_errors=True)

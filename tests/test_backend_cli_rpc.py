@@ -310,13 +310,14 @@ def test_rpc_sidecar_round_trip():
         server.stop(grace=None)
 
 
+# Children inherit the session-scoped compile cache dir conftest put
+# in GOSSIP_COMPILE_CACHE (a fresh temp dir — never the developer's
+# persistent ~/.cache, which the old "" pin guarded against): CLI
+# re-execs sharing a shape start warm.  An explicit --compile-cache /
+# --no-compile-cache flag in a test still overrides the env default.
 CLI_ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
-           "PYTHONPATH": _REPO,
-           # empty = cache OFF: tests must not write the developer's
-           # persistent ~/.cache (an explicit --compile-cache flag in a
-           # test still overrides this)
-           "GOSSIP_COMPILE_CACHE": ""}
+           "PYTHONPATH": _REPO}
 
 
 def _cli(*argv):

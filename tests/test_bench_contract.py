@@ -62,6 +62,35 @@ def test_line_is_json_serializable_and_flat():
                            "backend", "last_tpu"}
 
 
+def test_line_carries_compile_split():
+    """Compile-once PR: the probe's cold/warm compile walls ride the
+    scoreboard line (the reproducible CPU-side warm-start signal on
+    boxes where no TPU rate moves) and survive the JSON trip."""
+    split = {"cold_s": 9.31, "warm_s": 0.42, "statuses": ["miss", "hit"]}
+    line = bench.measurement_line(1.0, "cpu", 10, "x", 1, 1.0,
+                                  compile_split=split)
+    parsed = json.loads(json.dumps(line))
+    assert parsed["compile_split"] == split
+    # absent when the body did not measure one (old artifacts replay)
+    assert "compile_split" not in bench.measurement_line(
+        1.0, "cpu", 10, "x", 1, 1.0)
+
+
+def test_bench_compile_split_measures_store_roundtrip():
+    """_bench_compile_split on a small jitted program: the recorded
+    statuses must be a true (miss, hit) pair — timed_split suspends
+    the ambient persistent cache itself, so anything else means the
+    warm wall was not a store round-trip — and both walls are real."""
+    import jax
+    import jax.numpy as jnp
+    compiled, split = bench._bench_compile_split(
+        jax.jit(lambda x: jnp.cumsum(x * 2.0)),
+        jnp.arange(256, dtype=jnp.float32))
+    assert split["statuses"] == ["miss", "hit"]
+    assert split["cold_s"] > 0 and split["warm_s"] > 0
+    assert float(compiled(jnp.arange(256, dtype=jnp.float32))[-1]) > 0
+
+
 def test_fallback_carries_last_tpu_pointer():
     """VERDICT r4 task 2: a wedged-tunnel fallback line must point at
     the newest COMMITTED TPU capture so the scoreboard survives a

@@ -36,11 +36,15 @@ pytestmark = pytest.mark.skipif(
     len(jax.devices()) < 4, reason="needs the virtual multi-device mesh")
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# Children INHERIT the session-scoped compile cache conftest put in
+# GOSSIP_COMPILE_CACHE (a fresh temp dir — never the developer's
+# ~/.cache, which the old "" pin guarded against): every CLI re-exec
+# below runs the SAME 200-node shapes, so the first child compiles and
+# the rest start warm — what moved the resume tests below back out of
+# `slow` into tier-1 (compile-once PR).
 CLI_ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
-           "PYTHONPATH": _REPO,
-           # cache OFF: tests must not write the developer's ~/.cache
-           "GOSSIP_COMPILE_CACHE": ""}
+           "PYTHONPATH": _REPO}
 
 
 def _cli(*argv):
@@ -155,7 +159,6 @@ def test_fused_planes_checkpoint_curve(tmp_path):
     assert curve_res == curve_full
 
 
-@pytest.mark.slow
 def test_cli_sharded_checkpoint_resume_and_curve(tmp_path):
     ck = str(tmp_path / "cli.npz")
     args = ("run", "--mode", "pull", "--family", "erdos_renyi",
@@ -183,8 +186,9 @@ def test_cli_sharded_checkpoint_resume_and_curve(tmp_path):
     assert rep["msgs"] == ref["msgs"]
 
 
-@pytest.mark.slow
-def test_cli_checkpoint_error_paths(tmp_path):
+@pytest.mark.slow       # 6 CLI children: the ~3 s/child interpreter+
+def test_cli_checkpoint_error_paths(tmp_path):   # jax-import floor
+    # dominates even fully warm — stays out of the tier-1 gate
     ck = str(tmp_path / "e.npz")
     # fused engine off-TPU: the shared ineligibility list speaks
     p = _cli("run", "--mode", "pull", "--n", "1024", "--engine", "fused",
@@ -248,7 +252,6 @@ def test_cli_resume_accepts_pre_round4_fingerprint(tmp_path):
     assert json.loads(p.stdout)["rounds"] == 5
 
 
-@pytest.mark.slow
 def test_cli_save_curve_with_checkpoint(tmp_path):
     ck = str(tmp_path / "s.npz")
     curve_path = str(tmp_path / "curve.jsonl")

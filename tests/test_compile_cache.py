@@ -1,0 +1,307 @@
+"""Compile-once runtime contract (utils/compile_cache).
+
+Three layers of proof:
+
+  1. **Store mechanics** — a miss writes a serialized executable, the
+     next identical lookup hits it, corrupt entries degrade to misses,
+     and GOSSIP_COMPILE_CACHE="" disables cleanly.
+  2. **Warm-vs-cold bitwise equality, per driver** — every sharded
+     driver whose ``timing=`` path goes through the
+     ``utils/trace.aot_timed`` chokepoint (sharded / sharded_sparse /
+     sharded_fused / the 2-D pod sweep) must produce IDENTICAL outputs
+     whether its executable was compiled cold, compiled into the store
+     (miss), or deserialized from it (hit) — an executable round-trip
+     that changed results would silently corrupt every warm process.
+  3. **Cross-process** — process A populates the store, process B must
+     hit it and reproduce A's trajectory bitwise (the dry-run contract
+     test additionally proves the same for the persistent XLA cache
+     layer on whole processes).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossip_tpu import config as C
+from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
+from gossip_tpu.topology import generators as G
+from gossip_tpu.utils import compile_cache, telemetry
+from gossip_tpu.utils.trace import maybe_aot_timed
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def no_persistent_cache():
+    """Suspend the session-scoped XLA persistent cache (conftest) for
+    tests asserting the AOT store's miss/hit choreography: with it
+    active the "cold" compile can be served warm by the OTHER layer —
+    and a persistent-cache-loaded executable cannot enter the store at
+    all (the round-trip verify in compile_cache._try_store)."""
+    prev = getattr(jax.config, "jax_compilation_cache_dir", None)
+    jax.config.update("jax_compilation_cache_dir", None)
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev)
+
+
+@pytest.fixture
+def own_cache(tmp_path, monkeypatch, no_persistent_cache):
+    """A fresh store dir, made the ambient one (overriding the
+    session-scoped conftest dir so hit/miss assertions see only this
+    test's traffic)."""
+    d = str(tmp_path / "cc")
+    monkeypatch.setenv(compile_cache.ENV_VAR, d)
+    return d
+
+
+def test_store_miss_then_hit_bitwise(own_cache):
+    f = jax.jit(lambda x: jnp.cumsum(jnp.sin(x) * 3.0))
+    x = jnp.arange(64, dtype=jnp.float32)
+    c1, s1 = compile_cache.load_or_compile(f, x)
+    assert s1 == "miss"
+    assert compile_cache.entry_count(own_cache) == 1
+    c2, s2 = compile_cache.load_or_compile(f, x)
+    assert s2 == "hit"
+    np.testing.assert_array_equal(np.asarray(c1(x)), np.asarray(c2(x)))
+
+
+def test_store_disabled_by_empty_env(tmp_path, monkeypatch):
+    monkeypatch.setenv(compile_cache.ENV_VAR, "")
+    f = jax.jit(lambda x: x * 2)
+    _, status = compile_cache.load_or_compile(f, jnp.arange(4))
+    assert status == "disabled"
+    assert compile_cache.entry_count(str(tmp_path)) == 0
+
+
+def test_corrupt_entry_degrades_to_miss(own_cache):
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.arange(8)
+    compiled, s1 = compile_cache.load_or_compile(f, x)
+    assert s1 == "miss"
+    aot = os.path.join(own_cache, "aot")
+    (entry,) = os.listdir(aot)
+    with open(os.path.join(aot, entry), "wb") as fh:
+        fh.write(b"not a pickled executable")
+    c2, s2 = compile_cache.load_or_compile(f, x)
+    assert s2 == "miss"            # dropped + recompiled, never raised
+    np.testing.assert_array_equal(np.asarray(c2(x)), np.arange(8) + 1)
+
+
+def test_distinct_programs_get_distinct_entries(own_cache):
+    x = jnp.arange(8, dtype=jnp.float32)
+    _, s1 = compile_cache.load_or_compile(jax.jit(lambda v: v * 2), x)
+    _, s2 = compile_cache.load_or_compile(jax.jit(lambda v: v * 3), x)
+    # different closed-over constants -> different HLO -> both miss
+    assert (s1, s2) == ("miss", "miss")
+    assert compile_cache.entry_count(own_cache) == 2
+    # shape is part of the key too
+    _, s3 = compile_cache.load_or_compile(
+        jax.jit(lambda v: v * 2), jnp.arange(16, dtype=jnp.float32))
+    assert s3 == "miss"
+
+
+def test_compile_span_and_counters_reach_ledger(own_cache, tmp_path):
+    p = str(tmp_path / "led.jsonl")
+    led = telemetry.Ledger(p)
+    prev = telemetry.activate(led)
+    try:
+        f = jax.jit(lambda x: x - 7)
+        timing = {}
+        out = maybe_aot_timed(f, timing, jnp.arange(4))
+        assert timing["compile_cache"] == "miss"
+        timing2 = {}
+        maybe_aot_timed(f, timing2, jnp.arange(4))
+        assert timing2["compile_cache"] == "hit"
+        assert int(out[0]) == -7
+    finally:
+        telemetry.activate(prev)
+        led.close()
+    events = telemetry.load_ledger(p)
+    spans = [e for e in events if e["ev"] == "span_end"
+             and e["name"] == "compile"]
+    assert [e["cache"] for e in spans] == ["miss", "hit"]
+    assert all("key" in e for e in spans)
+    counters = {e["name"]: e["total"] for e in events
+                if e["ev"] == "counter"}
+    assert counters["compile_cache_miss"] == 1
+    assert counters["compile_cache_hit"] == 1
+    # the driver_timing event carries the verdict alongside the walls
+    dts = [e for e in events if e["ev"] == "driver_timing"]
+    assert [e["cache"] for e in dts] == ["miss", "hit"]
+
+
+# timed_split itself is covered through its one production consumer
+# (tests/test_bench_contract.py::test_bench_compile_split_measures_
+# store_roundtrip, which asserts the (miss, hit) statuses and walls) —
+# a second in-process exercise would pay another process-wide
+# jax.clear_caches() de-warming for no extra coverage.
+
+# -- warm-vs-cold bitwise equality, driver by driver -------------------
+
+def _mesh(n_devices=4):
+    from gossip_tpu.parallel.sharded import make_mesh
+    return make_mesh(n_devices)
+
+
+def _drive_sharded(timing):
+    from gossip_tpu.parallel.sharded import simulate_curve_sharded
+    covs, msgs, final = simulate_curve_sharded(
+        ProtocolConfig(mode=C.PUSH_PULL, fanout=2, rumors=2),
+        G.erdos_renyi(64, p=0.2, seed=0), RunConfig(seed=0, max_rounds=4),
+        _mesh(), fault=FaultConfig(node_death_rate=0.05, drop_prob=0.1,
+                                   seed=1), timing=timing)
+    return np.asarray(covs), np.asarray(msgs), np.asarray(final.seen)
+
+
+def _drive_sparse(timing):
+    from gossip_tpu.parallel.sharded_sparse import simulate_curve_sparse
+    covs, msgs, final, _meta = simulate_curve_sparse(
+        ProtocolConfig(mode=C.ANTI_ENTROPY, fanout=2, rumors=5, period=2),
+        128, RunConfig(seed=0, max_rounds=4), _mesh(), timing=timing)
+    return np.asarray(covs), np.asarray(msgs), np.asarray(final.seen)
+
+
+def _drive_fused(timing):
+    from gossip_tpu.parallel.sharded_fused import (
+        make_plane_mesh, simulate_curve_sharded_fused)
+    covs, final = simulate_curve_sharded_fused(
+        128, 40, RunConfig(seed=0, max_rounds=3), make_plane_mesh(4),
+        interpret=True, timing=timing)
+    return np.asarray(covs), np.asarray(final)
+
+
+def _drive_sweep(timing):
+    from gossip_tpu.parallel.multislice import make_hybrid_mesh
+    from gossip_tpu.parallel.sweep import (SweepPoint,
+                                           config_sweep_curves_2d)
+    pts = [SweepPoint(mode=m, fanout=f, drop_prob=0.0, period=1, seed=0)
+           for m in (C.PUSH, C.PULL) for f in (1, 2)]
+    res = config_sweep_curves_2d(
+        pts, G.ring(64, k=4), RunConfig(seed=0, max_rounds=3),
+        make_hybrid_mesh(2, 2, axis_names=("sweep", "nodes")),
+        timing=timing)
+    return np.asarray(res.curves), np.asarray(res.msgs)
+
+
+DRIVERS = {"sharded": _drive_sharded, "sharded_sparse": _drive_sparse,
+           "sharded_fused": _drive_fused, "pod_sweep_2d": _drive_sweep}
+
+
+@pytest.mark.parametrize("name", sorted(DRIVERS))
+def test_driver_warm_vs_cold_bitwise(name, tmp_path, monkeypatch,
+                                     no_persistent_cache):
+    """Cold (store-miss: a real XLA compile) and warm (store-hit: the
+    deserialized executable) executions of the same driver call must
+    agree BITWISE on every output — the warm path can change walls,
+    never values.  (A disabled-cache leg would be the identical
+    compile path as the miss leg minus the store write, so it buys no
+    extra coverage for a third driver compile.)"""
+    drive = DRIVERS[name]
+    monkeypatch.setenv(compile_cache.ENV_VAR, str(tmp_path / "cc"))
+    t_miss = {}
+    cold = drive(t_miss)
+    assert t_miss["compile_cache"] == "miss"
+    t_hit = {}
+    warm = drive(t_hit)
+    assert t_hit["compile_cache"] == "hit"
+    for a, b in zip(cold, warm):
+        np.testing.assert_array_equal(a, b)
+
+
+_CHILD = r"""
+import json, sys
+import numpy as np
+import jax
+sys.path.insert(0, {repo!r})
+from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
+from gossip_tpu import config as C
+from gossip_tpu.topology import generators as G
+from gossip_tpu.parallel.sharded import make_mesh, simulate_curve_sharded
+timing = {{}}
+covs, msgs, final = simulate_curve_sharded(
+    ProtocolConfig(mode=C.PUSH_PULL, fanout=2, rumors=2),
+    G.erdos_renyi(64, p=0.2, seed=0), RunConfig(seed=0, max_rounds=4),
+    make_mesh(4), fault=FaultConfig(node_death_rate=0.05, drop_prob=0.1,
+                                    seed=1), timing=timing)
+print(json.dumps({{"cache": timing["compile_cache"],
+                   "covs": np.asarray(covs).tolist(),
+                   "digest": int(np.asarray(final.seen).sum())}}))
+"""
+
+
+def test_cross_process_populate_then_hit(tmp_path):
+    """Process A populates the AOT store; process B — a fresh
+    interpreter, same program — must HIT it and reproduce A's
+    trajectory bitwise.  The compile-once claim is exactly this
+    cross-process reuse; same-process hits (above) would also be
+    served by jax's in-memory caches."""
+    env = dict(os.environ)
+    for hazard in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORM_NAME",
+                   "LIBTPU_INIT_ARGS"):
+        env.pop(hazard, None)
+    env["PYTHONPATH"] = _REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["GOSSIP_COMPILE_CACHE"] = str(tmp_path / "cc")
+    env["GOSSIP_TELEMETRY"] = ""
+
+    def run():
+        p = subprocess.run([sys.executable, "-c",
+                            _CHILD.format(repo=_REPO)],
+                           env=env, capture_output=True, text=True,
+                           timeout=600)
+        assert p.returncode == 0, p.stderr[-2000:]
+        return json.loads(p.stdout.splitlines()[-1])
+
+    a = run()
+    b = run()
+    assert a["cache"] == "miss"
+    assert b["cache"] == "hit"
+    assert a["covs"] == b["covs"]
+    assert a["digest"] == b["digest"] > 0
+
+
+# -- sweep cache telemetry (satellite) ---------------------------------
+
+def test_pod_sweep_cache_stats_eviction_predicate():
+    from collections import namedtuple
+
+    from gossip_tpu.parallel.sweep import _pod_sweep_cache_stats
+    Info = namedtuple("CacheInfo", "hits misses maxsize currsize")
+    g, ev = _pod_sweep_cache_stats(Info(5, 3, 16, 3), Info(5, 2, 16, 2))
+    assert not ev and g["pod_sweep_scan_cache_hits"] == 5
+    # a miss while the memo was full: lru evicted to admit this scan
+    _, ev = _pod_sweep_cache_stats(Info(0, 17, 16, 16),
+                                   Info(0, 16, 16, 16))
+    assert ev
+    # over-subscribed HISTORY but this call was a memo hit: no warning
+    # (the cumulative-totals predicate would cry wolf forever here)
+    _, ev = _pod_sweep_cache_stats(Info(9, 17, 16, 16),
+                                   Info(8, 17, 16, 16))
+    assert not ev
+    # a miss while the memo still had room: growth, not eviction
+    _, ev = _pod_sweep_cache_stats(Info(0, 4, 16, 4), Info(0, 3, 16, 3))
+    assert not ev
+
+
+def test_pod_sweep_emits_cache_gauges(tmp_path):
+    p = str(tmp_path / "led.jsonl")
+    led = telemetry.Ledger(p)
+    prev = telemetry.activate(led)
+    try:
+        _drive_sweep(None)
+    finally:
+        telemetry.activate(prev)
+        led.close()
+    gauges = {e["name"]: e["value"]
+              for e in telemetry.load_ledger(p) if e["ev"] == "gauge"}
+    assert "pod_sweep_scan_cache_hits" in gauges
+    assert "pod_sweep_scan_cache_misses" in gauges
+    assert gauges["pod_sweep_scan_cache_maxsize"] == 16
+    assert gauges["pod_sweep_scan_cache_size"] >= 1

@@ -1,4 +1,5 @@
-"""Dry-run contract: schema + steady-state budget guard (tier-1).
+"""Dry-run contract: schema + steady-state budget + warm-start guard
+(tier-1).
 
 ``__graft_entry__.dryrun_multichip`` is the driver's MULTICHIP record;
 its per-family table is how collective-layout and driver-cache
@@ -8,6 +9,13 @@ rows) and the per-family steady budgets (tools/dryrun_budgets.json —
 the guard that catches the next 100x outlier at PR time) cannot
 silently regress.  The dry run re-execs itself in a hermetic scrubbed
 subprocess, so this is safe on any ambient platform.
+
+Since the compile-once PR the SAME pair of runs is also the warm-start
+contract: the module fixture runs the dry run twice against one fresh
+compile-cache dir — process A populates it cold, process B must reuse
+it — so the cross-process cache proof, the ``first_warm_ms`` budget
+guard, and the ledger's per-family ``compile`` events (cache:
+hit|miss|disabled) are all exercised by tier-1 on every PR.
 """
 
 import importlib.util
@@ -31,6 +39,11 @@ _rspec = importlib.util.spec_from_file_location(
 telemetry_report = importlib.util.module_from_spec(_rspec)
 _rspec.loader.exec_module(telemetry_report)
 
+_tspec = importlib.util.spec_from_file_location(
+    "readme_table", os.path.join(_REPO, "tools", "readme_table.py"))
+readme_table = importlib.util.module_from_spec(_tspec)
+_tspec.loader.exec_module(readme_table)
+
 FAMILIES = frozenset({
     "dense_pushpull", "packed_pull", "sparse_antientropy",
     "topo_sparse_antientropy", "swim_rotating", "halo_banded",
@@ -41,12 +54,39 @@ DECOMP_KEYS = ("steady_exec_ms", "init_build_ms", "driver_overhead_ms")
 
 
 def test_budget_file_parses_and_covers_every_family():
-    budgets = graft_entry.dryrun_steady_budgets()
-    assert set(budgets) == FAMILIES
-    assert all(v > 0 for v in budgets.values())
+    steady = graft_entry.dryrun_steady_budgets()
+    warm = graft_entry.dryrun_first_warm_budgets()
+    assert set(steady) == FAMILIES
+    assert set(warm) == FAMILIES
+    assert all(v > 0 for v in steady.values())
+    assert all(v > 0 for v in warm.values())
 
 
-def test_dryrun_carries_all_families_and_wall_decomposition(tmp_path):
+@pytest.fixture(scope="module")
+def dryrun_pair(tmp_path_factory):
+    """(cold, warm) 4-device dry runs sharing ONE fresh compile-cache
+    dir — the cross-process warm-start proof: process A populates the
+    cache, process B (expect_warm=True: the body ENFORCES the
+    first_warm_ms budgets) must hit it.  4 devices for tier-1 wall
+    budget; the full 8-device shape with the >= 3x acceptance ratio is
+    pinned on the committed r08 record below (a 4-device pair
+    under-reports the win — cold compile grows with the mesh, warm
+    trace cost does not — which is why the LIVE ratio threshold is
+    softer).  Module-scoped so tier-1 pays the pair exactly once; each
+    run keeps its own ledger."""
+    tmp = tmp_path_factory.mktemp("dryrun_cc")
+    cache = str(tmp / "compile_cache")
+    cold_ledger = str(tmp / "cold_ledger.jsonl")
+    warm_ledger = str(tmp / "warm_ledger.jsonl")
+    cold = graft_entry.dryrun_multichip(4, ledger_path=cold_ledger,
+                                        compile_cache_dir=cache)
+    warm = graft_entry.dryrun_multichip(4, ledger_path=warm_ledger,
+                                        compile_cache_dir=cache,
+                                        expect_warm=True)
+    return {"cold": cold, "warm": warm, "cache": cache}
+
+
+def test_dryrun_carries_all_families_and_wall_decomposition(dryrun_pair):
     """One real dry run on a 4-device hermetic CPU mesh: every family
     present with first/steady timings, the fused rows wall-decomposed,
     and the in-body budget guard green (a budget trip raises through
@@ -57,8 +97,7 @@ def test_dryrun_carries_all_families_and_wall_decomposition(tmp_path):
     certifies telemetry adds no steady-state cost), and the per-family
     table must be reproducible from ledger data alone
     (tools/telemetry_report.family_table == the stdout table)."""
-    ledger_path = str(tmp_path / "dryrun_ledger.jsonl")
-    out = graft_entry.dryrun_multichip(4, ledger_path=ledger_path)
+    out = dryrun_pair["cold"]
     fam = out["dryrun_family_ms"]
     assert set(fam) == FAMILIES
     for name, row in fam.items():
@@ -74,8 +113,7 @@ def test_dryrun_carries_all_families_and_wall_decomposition(tmp_path):
         assert total == pytest.approx(row["steady_ms"], abs=0.5), name
 
     # --- the run ledger reproduces the table from its own data alone
-    assert out["ledger_path"] == ledger_path
-    events = telemetry.load_ledger(ledger_path, run="last")
+    events = telemetry.load_ledger(out["ledger_path"], run="last")
     assert events[0]["ev"] == "provenance"
     assert any(e["ev"] == "runtime" and e["device_count"] == 4
                for e in events)
@@ -96,6 +134,65 @@ def test_dryrun_carries_all_families_and_wall_decomposition(tmp_path):
     for name in FAMILIES:
         assert name in md
     assert "green" in md
+
+
+def test_dryrun_warm_process_reuses_cold_process_cache(dryrun_pair):
+    """THE compile-once contract pair: process B's aggregate
+    first-call wall must be far below process A's (the body already
+    enforced the per-family first_warm_ms budgets via expect_warm —
+    this asserts the headline ratio on the same data).  The LIVE
+    threshold is 2.0x: a de-warmed cache reads ~1.0x unambiguously,
+    while the 4-device pair's honest ratio is only ~2.8x (smaller mesh
+    = cheaper cold compiles over the same warm trace cost) and host
+    contention inflates the warm column's fixed costs slightly more;
+    the exact >= 3x acceptance is pinned on the committed 8-device r08
+    record below, where there is no host noise.  Trajectories must be
+    BITWISE unaffected by where the executables came from (identical
+    per-family tables modulo walls is necessary; the value-level
+    equality is pinned driver-by-driver in
+    tests/test_compile_cache.py)."""
+    cold_fam = dryrun_pair["cold"]["dryrun_family_ms"]
+    warm_fam = dryrun_pair["warm"]["dryrun_family_ms"]
+    assert set(warm_fam) == set(cold_fam) == FAMILIES
+    cold_total = sum(r["first_ms"] for r in cold_fam.values())
+    warm_total = sum(r["first_ms"] for r in warm_fam.values())
+    assert warm_total * 2.0 <= cold_total, (
+        f"warm-start win below 2x: cold {cold_total:.0f} ms vs warm "
+        f"{warm_total:.0f} ms — the persistent cache did not serve "
+        "the warm process")
+    # the cache dir actually holds the executables both layers wrote
+    assert os.path.isdir(dryrun_pair["cache"])
+    assert any(os.scandir(dryrun_pair["cache"]))
+
+    # --- ledger: per-family compile events carry the cache verdict
+    def compile_events(out):
+        evs = telemetry.load_ledger(out["ledger_path"], run="last")
+        return evs, [e for e in evs if e["ev"] == "compile"
+                     and e.get("phase") == "first_ms"]
+
+    cold_evs, cold_compiles = compile_events(dryrun_pair["cold"])
+    warm_evs, warm_compiles = compile_events(dryrun_pair["warm"])
+    assert {e["family"] for e in cold_compiles} == FAMILIES
+    assert {e["family"] for e in warm_compiles} == FAMILIES
+    # process A pays real compiles; process B is served by the cache
+    assert all(e["cache"] == "miss" for e in cold_compiles)
+    assert all(e["cache"] == "hit" for e in warm_compiles), [
+        (e["family"], e["cache"]) for e in warm_compiles
+        if e["cache"] != "hit"]
+    # the enable event recorded the shared dir in both ledgers
+    for evs in (cold_evs, warm_evs):
+        cc = [e for e in evs if e["ev"] == "compile_cache"]
+        assert cc and cc[-1]["dir"] == os.path.abspath(
+            dryrun_pair["cache"])
+        assert cc[-1]["persistent"] is True
+    # the warm guard's verdict is ledgered green
+    wguard = [e for e in warm_evs if e["ev"] == "budget_guard"
+              and e.get("phase") == "first_warm"][-1]
+    assert wguard["ok"] is True
+    # and the report's cache table renders both verdicts
+    assert "miss" in telemetry_report.render_markdown(cold_evs)
+    warm_md = telemetry_report.render_markdown(warm_evs)
+    assert "## Compile cache" in warm_md and "hit" in warm_md
 
 
 def test_committed_8dev_dryrun_ledger_renders():
@@ -122,3 +219,63 @@ def test_committed_8dev_dryrun_ledger_renders():
     for name in FAMILIES:
         assert name in md
     assert "budget_ms" in md and "steady_exec_ms" in md
+
+
+def test_committed_warmstart_ledger_renders_cache_table():
+    """The committed warm-start record
+    (artifacts/ledger_dryrun_r08.jsonl): TWO 8-device runs in one
+    flight-recorder file — run 1 cold into a fresh cache, run 2 warm
+    from it.  Pins that (a) the warm run met the first_warm_ms budgets
+    and beat the cold run's aggregate >= 3x (the acceptance line, on
+    committed evidence), (b) every family timing carries a ``compile``
+    event with the cache verdict, and (c) the report renders the
+    hit/miss table from the artifact alone."""
+    path = os.path.join(_REPO, "artifacts", "ledger_dryrun_r08.jsonl")
+    all_events = telemetry.load_ledger(path)
+    run_ids = telemetry_report.runs(all_events)
+    assert len(run_ids) == 2, "expect exactly a cold and a warm run"
+    cold = [e for e in all_events if e.get("run") == run_ids[0]]
+    warm = [e for e in all_events if e.get("run") == run_ids[1]]
+    for events in (cold, warm):
+        assert events[0]["ev"] == "provenance"
+        assert len(events[0]["git_commit"]) == 40
+        assert any(e["ev"] == "runtime" and e["device_count"] == 8
+                   for e in events)
+        assert set(telemetry_report.family_table(events)) == FAMILIES
+    cold_fam = telemetry_report.family_table(cold)
+    warm_fam = telemetry_report.family_table(warm)
+    cold_total = sum(r["first_ms"] for r in cold_fam.values())
+    warm_total = sum(r["first_ms"] for r in warm_fam.values())
+    assert warm_total * 3 <= cold_total
+    wbudgets = graft_entry.dryrun_first_warm_budgets()
+    assert all(warm_fam[f]["first_ms"] <= wbudgets[f] for f in warm_fam)
+    # cache verdicts: all-miss cold, all-hit warm
+    cold_cache = telemetry_report.compile_cache_table(cold)
+    warm_cache = telemetry_report.compile_cache_table(warm)
+    assert cold_cache["status"]["persistent"] is True
+    assert {r["where"] for r in cold_cache["rows"]
+            if r["phase"] == "first_ms"} == FAMILIES
+    assert all(r["cache"] == "miss" for r in cold_cache["rows"]
+               if r["phase"] == "first_ms")
+    assert all(r["cache"] == "hit" for r in warm_cache["rows"]
+               if r["phase"] == "first_ms")
+    md = telemetry_report.render_markdown(warm)
+    assert "## Compile cache" in md
+    assert "| hit " in md          # per-family verdict rows rendered
+    # the headline event made it too
+    totals = [e for e in warm if e["ev"] == "first_ms_total"]
+    assert totals and totals[-1]["total_ms"] == pytest.approx(
+        warm_total, abs=1.0)
+    # and the docs/PERF.md cold/warm budget table renders from the
+    # artifact alone (tools/readme_table.py --first-budgets)
+    import contextlib
+    import io
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = readme_table.main_first_budgets([path])
+    assert rc == 0
+    table = buf.getvalue()
+    assert "first_warm_budget_ms" in table
+    for fam in FAMILIES:
+        assert fam in table
+    assert "**total**" in table

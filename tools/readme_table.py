@@ -5,6 +5,8 @@ Usage:
     python tools/readme_table.py artifacts/baseline_sweep_r02b.jsonl
     python tools/readme_table.py --dryrun-budgets MULTICHIP_r05.json \\
         [MULTICHIP_r06.json]
+    python tools/readme_table.py --first-budgets \\
+        artifacts/ledger_dryrun_r08.jsonl
 
 Prints the markdown table with the round-3 contract columns — wall,
 compile, and steady-state separated (RunReport meta ``compile_s`` /
@@ -77,15 +79,28 @@ def _load_family_ms(path):
     raise ValueError(f"{path} carries no dryrun_family_ms table")
 
 
+def _load_budget_table(table):
+    """One table out of tools/dryrun_budgets.json, via the sibling
+    report tool's loader — ONE parser of the two-table format
+    (telemetry_report.load_budgets), not a second drifting copy."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from telemetry_report import load_budgets
+    finally:
+        sys.path.pop(0)
+    budgets = load_budgets(table=table)
+    if not budgets:
+        raise ValueError(
+            f"tools/dryrun_budgets.json has no usable {table!r} table")
+    return budgets
+
+
 def main_dryrun_budgets(paths):
     if not 1 <= len(paths) <= 2:
         print("--dryrun-budgets takes one record (steady_ms) or two "
               "(before/after)", file=sys.stderr)
         return 2
-    budgets_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "dryrun_budgets.json")
-    with open(budgets_path) as f:
-        budgets = json.load(f)
+    budgets = _load_budget_table("steady_ms")
     tables = [_load_family_ms(p) for p in paths]
     cols = (["steady_ms (before)", "steady_ms (after)"] if len(tables) == 2
             else ["steady_ms"])
@@ -98,9 +113,77 @@ def main_dryrun_budgets(paths):
     return 0
 
 
+def _ledger_family_runs(path):
+    """[(run_id, {family: row})] for every run in a dry-run ledger that
+    carries ``family`` events, file order — run 1 of the committed
+    warm-start artifact is the cold process, run 2 the warm one."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        from gossip_tpu.utils.telemetry import load_ledger
+    finally:
+        sys.path.pop(0)
+    events = load_ledger(path)
+    by_run = {}
+    order = []
+    for e in events:
+        if e.get("ev") == "family" and e.get("run") is not None:
+            if e["run"] not in by_run:
+                order.append(e["run"])
+            by_run.setdefault(e["run"], {})[e["family"]] = {
+                k: v for k, v in e.items()
+                if k not in ("ev", "ts", "run", "family")}
+    return [(r, by_run[r]) for r in order]
+
+
+def main_first_budgets(paths):
+    """The compile-once cold/warm first-round table (docs/PERF.md):
+    per-family first_ms from the cold and warm runs of a dry-run
+    LEDGER (two runs in one file — the r08 artifact shape — or two
+    single-run ledgers), against the ``first_warm_ms`` budgets the
+    warm process is held to."""
+    if not 1 <= len(paths) <= 2:
+        print("--first-budgets takes one dry-run ledger (cold+warm "
+              "runs in file order) or two (cold, warm)", file=sys.stderr)
+        return 2
+    runs = [fr for p in paths for fr in _ledger_family_runs(p)]
+    if len(runs) < 2:
+        print(f"need a cold and a warm run; found {len(runs)} run(s) "
+              "with family events", file=sys.stderr)
+        return 2
+    budgets = _load_budget_table("first_warm_ms")
+    cold, warm = runs[0][1], runs[-1][1]
+    print("| family | first_ms (cold) | first_ms (warm) | speedup "
+          "| first_warm_budget_ms |")
+    print("|---|---|---|---|---|")
+    tc = tw = 0.0
+    # union, budget order first: a ledger family the budget table has
+    # not caught up with still renders (with '—' for its budget), and
+    # the totals only count families present in BOTH runs — a one-
+    # sided row must not inflate the headline speedup
+    fams = list(budgets) + sorted((set(cold) | set(warm)) - set(budgets))
+    for fam in fams:
+        c = cold.get(fam, {}).get("first_ms")
+        w = warm.get(fam, {}).get("first_ms")
+        if c is not None and w is not None:
+            tc += c
+            tw += w
+        speed = f"{c / w:.1f}x" if c and w else "—"
+        b = budgets.get(fam, "—")
+        print(f"| {fam} | {c if c is not None else '—'} "
+              f"| {w if w is not None else '—'} | {speed} "
+              f"| {b} |")
+    if tw:
+        print(f"| **total** | **{round(tc, 1)}** | **{round(tw, 1)}** "
+              f"| **{tc / tw:.1f}x** | — |")
+    return 0
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--dryrun-budgets":
         sys.exit(main_dryrun_budgets(sys.argv[2:]))
+    if len(sys.argv) >= 3 and sys.argv[1] == "--first-budgets":
+        sys.exit(main_first_budgets(sys.argv[2:]))
     if len(sys.argv) != 2:
         print(__doc__, file=sys.stderr)
         sys.exit(2)

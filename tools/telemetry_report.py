@@ -139,12 +139,49 @@ def probe_timeline(events):
     return rows
 
 
-def load_budgets(path=BUDGETS_PATH):
+def load_budgets(path=BUDGETS_PATH, table="steady_ms"):
+    """One per-family budget table (default the steady one).  The
+    budgets file became two-table in the compile-once PR
+    (``{"steady_ms": ..., "first_warm_ms": ...}``); a flat legacy file
+    is read as the steady table so old records keep rendering."""
     try:
         with open(path) as f:
-            return json.load(f)
+            doc = json.load(f)
     except (OSError, ValueError):
         return {}
+    if isinstance(doc.get("steady_ms"), dict):
+        return doc.get(table) or {}
+    return doc if table == "steady_ms" else {}
+
+
+def compile_cache_table(events):
+    """The compile-once read-out: ``{"status", "rows", "totals"}`` from
+    a run's cache events.  ``status`` is the last ``compile_cache``
+    enable event (dir/persistent/knobs); ``rows`` is one entry per
+    compile — the dry-run body's per-family ``compile`` events and the
+    chokepoint's ``compile`` span_ends (utils/compile_cache) — each
+    carrying ``cache: hit|miss|disabled``; ``totals`` counts rows by
+    verdict.  Empty rows/None status on pre-compile-cache ledgers."""
+    status = None
+    rows = []
+    totals = {}
+    for e in events:
+        row = None
+        if e.get("ev") == "compile_cache":
+            status = {k: v for k, v in e.items()
+                      if k not in ("ev", "ts", "run")}
+        elif e.get("ev") == "compile":
+            row = {"where": e.get("family") or e.get("fn"),
+                   "phase": e.get("phase"), "cache": e.get("cache"),
+                   "ms": e.get("measured_ms"),
+                   "hits": e.get("hits"), "misses": e.get("misses")}
+        elif e.get("ev") == "span_end" and e.get("name") == "compile":
+            row = {"where": e.get("fn"), "phase": "aot",
+                   "cache": e.get("cache"), "ms": e.get("wall_ms")}
+        if row is not None:
+            rows.append(row)
+            totals[row["cache"]] = totals.get(row["cache"], 0) + 1
+    return {"status": status, "rows": rows, "totals": totals}
 
 
 def _fmt(v):
@@ -209,6 +246,28 @@ def render_markdown(events, budgets=None, title=None):
             out.append(f"Budget guard (tools/dryrun_budgets.json): "
                        f"{verdict}.")
             out.append("")
+
+    cache = compile_cache_table(events)
+    if cache["status"] or cache["rows"]:
+        out.append("## Compile cache")
+        out.append("")
+        st = cache["status"]
+        if st:
+            out.append(f"- cache dir `{st.get('dir')}` "
+                       f"(persistent={st.get('persistent')})")
+        if cache["totals"]:
+            out.append("- compiles by verdict: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(cache["totals"].items(),
+                                              key=lambda kv: str(kv[0]))))
+        if cache["rows"]:
+            out.append("")
+            out.append("| where | phase | cache | ms |")
+            out.append("|---|---|---|---|")
+            for r in cache["rows"]:
+                out.append(f"| {r['where']} | {r.get('phase') or '-'} "
+                           f"| {r['cache']} "
+                           f"| {_fmt(r['ms']) if r.get('ms') is not None else '-'} |")
+        out.append("")
 
     tree = span_tree(events)
     if tree:
