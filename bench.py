@@ -143,14 +143,21 @@ def body():
     """The measurement itself — runs in a subprocess whose platform the
     parent has already probed (or forced to CPU)."""
     import jax
+
+    from gossip_tpu.utils import trace as tr
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
     # Full 10M-node config on TPU; scaled down on CPU so CI stays fast.
     n = 10_000_000 if on_tpu else 500_000
-    if on_tpu:
-        rounds, dt, variant, split = run_tpu_fused(n)
-    else:
-        rounds, dt, variant, split = run_xla_packed(n)
+    # GOSSIP_PROFILE=<dir>: capture the whole measurement leg as a
+    # jax.profiler trace (no-op unset; compat-probed).  A profiled leg's
+    # walls carry profiler overhead — use the capture as a timeline, and
+    # never commit its scoreboard line as a clean measurement.
+    with tr.profile(f"bench:{backend}"):
+        if on_tpu:
+            rounds, dt, variant, split = run_tpu_fused(n)
+        else:
+            rounds, dt, variant, split = run_xla_packed(n)
 
     # Single-device flagship runs on one chip regardless of how many are
     # attached (multi-chip twin: parallel/sharded_packed.py, dry-run by

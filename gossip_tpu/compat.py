@@ -105,6 +105,37 @@ def pallas_compiler_params(*, vmem_limit_bytes: int):
     return cls(vmem_limit_bytes=vmem_limit_bytes)
 
 
+# -- profiler probes (utils/trace, GOSSIP_PROFILE) --------------------
+#
+# The profiler API is stable on both lines this repo straddles, but the
+# GOSSIP_PROFILE hooks must DEGRADE, never crash, on a jax that lacks a
+# piece (a trimmed build, a future rename): a profiling run that can't
+# profile should still produce its numbers.
+
+
+def profiler_trace_fns():
+    """(start_trace, stop_trace) for a jax.profiler capture, or None
+    when this jax has no trace API — the GOSSIP_PROFILE wrapper then
+    runs the block unprofiled (probed like the cache knobs below,
+    never assumed)."""
+    prof = getattr(jax, "profiler", None)
+    start = getattr(prof, "start_trace", None)
+    stop = getattr(prof, "stop_trace", None)
+    return (start, stop) if callable(start) and callable(stop) else None
+
+
+def trace_annotation(name: str):
+    """A named ``jax.profiler.TraceAnnotation`` region (host + device
+    timeline), or a no-op context manager when this jax lacks the
+    class — callers annotate unconditionally and degrade cleanly."""
+    prof = getattr(jax, "profiler", None)
+    cls = getattr(prof, "TraceAnnotation", None)
+    if cls is None:
+        import contextlib
+        return contextlib.nullcontext()
+    return cls(name)
+
+
 # -- persistent-compilation-cache probes (utils/compile_cache) --------
 #
 # The cache knobs moved and grew across jax lines (the enable-xla-caches

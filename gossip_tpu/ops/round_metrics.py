@@ -1,0 +1,305 @@
+"""Device-resident per-round protocol metrics: the epidemic observables
+of Demers et al. (PODC 1987) captured INSIDE the compiled round loops.
+
+Until this layer, a scanned/while-looped driver was a black box between
+its first and last round: the ledger (utils/telemetry) records host-side
+spans and walls, but nothing observes per-round *protocol* dynamics —
+how many nodes a round newly infected, how much of the traffic was
+redundant re-delivery, where the coverage front sits per shard.  Those
+are the classic epidemic health metrics (residue/traffic/delay), and on
+this codebase they must be measured without breaking the one property
+every perf PR fought for: **steady state does no per-round host work**
+(docs/PERF.md "Dry-run steady-state budget").
+
+So the capture is Dapper-style — where the work happens, at zero
+steady-state cost:
+
+  * :func:`init` preallocates small device buffers (`f32[T]` per
+    counter, `f32[T, S]` for the per-shard coverage front, one i32
+    cursor) that ride the loop CARRY of every instrumented driver;
+  * the round body calls :func:`record` — pure in-trace scatter writes
+    at the cursor row, no callbacks, no syncs, no RNG consumption (the
+    trajectory is bitwise what it was without metrics);
+  * the whole stack is flushed to the host ONCE per driver call:
+    `utils.trace.maybe_aot_timed` — the chokepoint every instrumented
+    driver already returns through — finds :class:`RoundMetrics` leaves
+    in the driver's output pytree and emits one ``round_metrics``
+    ledger event per stack (:func:`emit`), so no driver threads a
+    ledger argument anywhere.
+
+The budget guard (tools/dryrun_budgets.json) runs with the ledger — and
+therefore metrics — enabled on every dry run; a green guard is the
+standing proof that the in-loop arithmetic costs nothing measurable.
+
+Counter semantics (each a per-round f32; exact unless marked)
+-------------------------------------------------------------
+``newly``   newly-infected (node, rumor) entries this round — exact,
+            from the monotone ``seen`` delta (SWIM: newly
+            confirmed-dead wire entries; rumor: newly seen).
+``msgs``    protocol messages this round — exact (the drivers' own
+            accounting, differenced per round).
+``dup``     redundant-delivery estimate: ``offered - newly`` clamped at
+            0, where ``offered = rumors * payload_factor(mode) * msgs``
+            counts the (receiver, rumor) delivery slots of the round's
+            payload-bearing messages (:func:`payload_factor`).  An
+            upper bound on true duplicates — it also counts slots whose
+            sender had nothing new to offer — except for the rumor
+            driver's feedback variant, where the kernel's own counters
+            make it exact.
+``bytes``   analytic per-device ICI egress of the round's collectives
+            (the SparseMeta convention), gated in-trace on quiescent
+            anti-entropy rounds.  A formula, not a NIC counter — it
+            exists so a collective-layout regression (an accidental
+            O(N) gather) is visible per round.
+``front``   per-shard coverage fraction after the round (f32[S]) — the
+            convergence front: a shard whose column lags shows a
+            placement/topology pathology no global mean exposes.
+
+``GOSSIP_ROUND_METRICS=0`` (or empty) is the kill switch; metrics are
+also skipped when no run ledger is active (:func:`wanted`) — the
+buffers exist to be ledgered, and dark buffers would tax every test
+that never reads them.  Both gates act at TRACE time, so a memoized
+driver loop (parallel/sharded_fused) keys its cache on the choice.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from gossip_tpu import config as C
+
+ENV_VAR = "GOSSIP_ROUND_METRICS"
+
+
+def enabled() -> bool:
+    """The env kill switch: on unless GOSSIP_ROUND_METRICS is ""/0/off
+    (the GOSSIP_TELEMETRY convention, inverted default: metrics cost
+    nothing measurable, so presence is the useful default)."""
+    return os.environ.get(ENV_VAR, "1").lower() not in ("", "0", "off")
+
+
+def wanted() -> bool:
+    """Should a driver build its loop WITH metrics buffers?  True iff
+    the env switch is on AND a run ledger is active — without a ledger
+    the flush is a no-op, so the buffers would be dead carry weight in
+    every un-ledgered test/caller.  Read at trace/build time (memoized
+    loops key on it)."""
+    if not enabled():
+        return False
+    from gossip_tpu.utils import telemetry
+    return bool(getattr(telemetry.current(), "active", False))
+
+
+class RoundMetrics:
+    """The preallocated per-round buffer stack carried through a loop.
+
+    A registered pytree: array fields are children (so it rides scan /
+    while_loop carries and crosses jit boundaries), ``label`` is static
+    aux data naming the driver for the ledger event.  ``cursor`` is the
+    next write row == rounds recorded so far."""
+
+    __slots__ = ("cursor", "newly", "dup", "msgs", "bytes", "front",
+                 "label")
+
+    def __init__(self, cursor, newly, dup, msgs, bytes, front,
+                 label: str):
+        self.cursor = cursor
+        self.newly = newly
+        self.dup = dup
+        self.msgs = msgs
+        self.bytes = bytes
+        self.front = front
+        self.label = label
+
+    def _replace(self, **kw):
+        fields = {k: getattr(self, k) for k in self.__slots__}
+        fields.update(kw)
+        return RoundMetrics(**fields)
+
+
+def _rm_flatten(m):
+    return ((m.cursor, m.newly, m.dup, m.msgs, m.bytes, m.front),
+            m.label)
+
+
+def _rm_unflatten(label, children):
+    return RoundMetrics(*children, label=label)
+
+
+jax.tree_util.register_pytree_node(RoundMetrics, _rm_flatten,
+                                   _rm_unflatten)
+
+
+def init(max_rounds: int, n_shards: int, label: str) -> RoundMetrics:
+    """Zeroed buffer stack for up to ``max_rounds`` rounds over
+    ``n_shards`` shards (1 for single-device drivers).  Tiny: 4 T + T*S
+    floats — at the flagship's T=128, S=8 that is 2.5 KB."""
+    if max_rounds < 1:
+        raise ValueError(f"max_rounds={max_rounds} must be >= 1")
+    if n_shards < 1:
+        raise ValueError(f"n_shards={n_shards} must be >= 1")
+    z = jnp.zeros((max_rounds,), jnp.float32)
+    return RoundMetrics(cursor=jnp.int32(0), newly=z, dup=z, msgs=z,
+                        bytes=z,
+                        front=jnp.zeros((max_rounds, n_shards),
+                                        jnp.float32),
+                        label=label)
+
+
+def record(m: RoundMetrics, *, newly, dup, msgs, bytes,
+           front) -> RoundMetrics:
+    """Write one round's row at the cursor (in-trace; scatter writes
+    only).  The cursor is clamped to the last row so an over-long loop
+    can never write out of bounds — by contract the drivers size the
+    buffers with ``run.max_rounds``, which also bounds their loops."""
+    i = jnp.minimum(m.cursor, m.newly.shape[0] - 1)
+    f32 = lambda v: jnp.asarray(v, jnp.float32)       # noqa: E731
+    return m._replace(
+        cursor=m.cursor + 1,
+        newly=m.newly.at[i].set(f32(newly)),
+        dup=m.dup.at[i].set(f32(dup)),
+        msgs=m.msgs.at[i].set(f32(msgs)),
+        bytes=m.bytes.at[i].set(f32(bytes)),
+        front=m.front.at[i].set(jnp.asarray(front, jnp.float32)))
+
+
+# -- per-round counter helpers (all pure in-trace arithmetic) ---------
+
+def payload_factor(mode: str) -> float:
+    """Fraction of a mode's counted messages that carry a digest
+    payload toward the receiver — the ``offered`` normalizer for the
+    ``dup`` estimate.  Push and flood messages all carry payload (1.0);
+    pull counts request + response per exchange, only the response
+    carries (0.5); push-pull and anti-entropy carry payload on 2 of
+    every 3 counted messages (sends + responses vs. sends + requests +
+    responses; reconciliation's reverse delta rides the request)."""
+    return {C.PUSH: 1.0, C.FLOOD: 1.0, C.RUMOR: 1.0, C.PULL: 0.5,
+            C.PUSH_PULL: 2.0 / 3.0, C.ANTI_ENTROPY: 2.0 / 3.0}[mode]
+
+
+def gate_on_exchange_rounds(value, period: int, round_, off=0.0):
+    """``value`` on exchange rounds, ``off`` on quiescent anti-entropy
+    rounds — the ONE ``round_ % period == 0`` predicate, shared by
+    every recorder so the per-driver ``bytes`` series can never
+    disagree with the lax.cond the kernels gate their collectives on
+    (dense/packed add a reverse-psum term; sparse drops to the 4-byte
+    msgs psum)."""
+    value = jnp.asarray(value, jnp.float32)
+    if period <= 1:
+        return value
+    return jnp.where((round_ % period) == 0, value,
+                     jnp.asarray(off, jnp.float32))
+
+
+def dup_estimate(offered, newly):
+    """``max(offered - newly, 0)`` — delivery slots that produced no
+    new infection (module doc: an upper bound on true duplicates)."""
+    return jnp.maximum(jnp.asarray(offered, jnp.float32)
+                       - jnp.asarray(newly, jnp.float32), 0.0)
+
+
+def count_bool(seen, alive):
+    """Total set (node, rumor) entries over alive rows of a bool
+    digest table ``seen[N, R]``."""
+    return jnp.sum(seen & alive[:, None], dtype=jnp.float32)
+
+
+def count_packed(words, alive):
+    """Set-bit total over alive rows of a rumor-packed ``uint32[N, W]``
+    table (padding bits beyond ``rumors`` are never set — ops/bitpack
+    contract — so no mask is needed)."""
+    pc = jnp.where(alive[:, None], jax.lax.population_count(words), 0)
+    return jnp.sum(pc, dtype=jnp.float32)
+
+
+def count_planes(planes):
+    """Set-bit total of a fused plane stack ``uint32[W, rows, 128]``.
+    The all-ones rumor-padding columns contribute a CONSTANT, which
+    cancels in the per-round deltas the drivers record."""
+    return jnp.sum(jax.lax.population_count(planes), dtype=jnp.float32)
+
+
+def front_bool(seen, alive, n_shards: int):
+    """Per-shard covered-fraction f32[S] of a row-sharded bool table:
+    covered = alive and holding any rumor; the denominator is the
+    shard's alive row count (padding rows are dead by construction and
+    deflate nothing)."""
+    covered = jnp.any(seen, axis=1) & alive
+    per = jnp.sum(covered.reshape(n_shards, -1), axis=1,
+                  dtype=jnp.float32)
+    tot = jnp.sum(alive.reshape(n_shards, -1), axis=1,
+                  dtype=jnp.float32)
+    return per / jnp.maximum(tot, 1.0)
+
+
+def front_packed(words, alive, n_shards: int):
+    """:func:`front_bool` for the rumor-packed uint32 layout."""
+    covered = jnp.any(words != 0, axis=1) & alive
+    per = jnp.sum(covered.reshape(n_shards, -1), axis=1,
+                  dtype=jnp.float32)
+    tot = jnp.sum(alive.reshape(n_shards, -1), axis=1,
+                  dtype=jnp.float32)
+    return per / jnp.maximum(tot, 1.0)
+
+
+def front_planes(planes, n: int, n_shards: int):
+    """Per-shard min-over-rumors coverage f32[S] of a plane-sharded
+    fused stack: each shard's column is the min coverage over the
+    planes IT owns (plane p lives on shard p // (W/S) — the
+    init_plane_state layout).  Padding planes are all-ones (coverage
+    1.0) and never win the min."""
+    from gossip_tpu.ops.pallas_round import BITS, coverage_words
+    per_plane = jax.vmap(lambda t: coverage_words(t, n, BITS))(planes)
+    return jnp.min(per_plane.reshape(n_shards, -1), axis=1)
+
+
+# -- the once-per-driver-call flush -----------------------------------
+
+def find(out):
+    """Every RoundMetrics leaf in a driver output pytree (is_leaf stops
+    the flatten from decomposing the stacks into bare arrays)."""
+    leaves = jax.tree_util.tree_leaves(
+        out, is_leaf=lambda x: isinstance(x, RoundMetrics))
+    return [x for x in leaves if isinstance(x, RoundMetrics)]
+
+
+def emit(out, ledger, fn=None):
+    """ONE host transfer + one ``round_metrics`` ledger event per
+    RoundMetrics stack in ``out`` — called by utils.trace.maybe_aot_timed
+    after the driver's timed region, never per round.  Series are
+    truncated to the rounds actually recorded (the cursor: a while_loop
+    that exited early leaves its tail rows zero and unreported).
+
+    ``sync=False``: the emit may run inside a CALLER's timed window
+    (the dry run's family walls), so it is flush-only like the
+    ``driver_timing`` event — durability arrives with the next fsynced
+    event (utils/telemetry contract)."""
+    stacks = find(out)
+    if not stacks:
+        return
+    import numpy as np
+    for m in stacks:
+        cursor, newly, dup, msgs, bytes_, front = jax.device_get(
+            (m.cursor, m.newly, m.dup, m.msgs, m.bytes, m.front))
+        r = min(int(cursor), int(newly.shape[0]))
+
+        def ser(a, nd=3):
+            return [round(float(v), nd) for v in np.asarray(a)[:r]]
+
+        front = np.asarray(front)
+        ledger.event(
+            "round_metrics", sync=False, driver=m.label, fn=fn,
+            rounds=r, shards=int(front.shape[1]),
+            newly=ser(newly), dup=ser(dup), msgs=ser(msgs),
+            bytes=ser(bytes_),
+            front=[[round(float(v), 4) for v in row]
+                   for row in front[:r]],
+            totals={"newly": round(float(np.sum(newly[:r])), 3),
+                    "dup": round(float(np.sum(dup[:r])), 3),
+                    "msgs": round(float(np.sum(msgs[:r])), 3),
+                    "bytes": round(float(np.sum(bytes_[:r])), 3)},
+            front_final=([round(float(v), 4) for v in front[r - 1]]
+                         if r else None))

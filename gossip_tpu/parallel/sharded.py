@@ -236,6 +236,60 @@ def init_sharded_state(run: RunConfig, proto: ProtocolConfig, topo: Topology,
                     msgs=st.msgs)
 
 
+def _dense_round_bytes(proto: ProtocolConfig, n_pad: int, nl: int):
+    """``round_ -> f32`` analytic per-device ICI egress of one dense
+    round (ops/round_metrics ``bytes`` semantics — the SparseMeta
+    per-device convention): the psum_scatter contribution table is
+    ``4*n_pad*R`` int32 bytes, the all_gather egress ``nl*R`` bool
+    bytes, the msgs psum 4; anti-entropy's reverse psum_scatter moves
+    only on exchange rounds, which the returned closure gates in-trace
+    on ``round_`` exactly as the kernel's lax.cond does."""
+    r = proto.rumors
+    mode = proto.mode
+    base = 4.0
+    if mode in (C.PUSH, C.PUSH_PULL):
+        base += 4.0 * n_pad * r
+    if mode in (C.PULL, C.PUSH_PULL, C.ANTI_ENTROPY, C.FLOOD):
+        base += 1.0 * nl * r
+
+    def per_round(round_):
+        from gossip_tpu.ops import round_metrics as RM
+        b = jnp.float32(base)
+        if mode == C.ANTI_ENTROPY:
+            b = b + RM.gate_on_exchange_rounds(4.0 * n_pad * r,
+                                               proto.period, round_)
+        return b
+
+    return per_round
+
+
+def _dense_recorder(proto: ProtocolConfig, n_pad: int, n_shards: int):
+    """``(m, prev_count, round0, msgs0, s_after, alive) -> (m, count)``
+    — the in-loop metrics row for the dense bool-digest drivers
+    (ops/round_metrics counter semantics; a pure readout, so
+    trajectories are bitwise what they were without it).  The previous
+    round's entry count rides the carry as ONE scalar instead of
+    re-reading the pre-step table after the step — keeping the old
+    digest alive across the round body would force XLA to double-buffer
+    (or copy) the state every round, the exact liveness pathology the
+    fused engine's donation contract documents."""
+    from gossip_tpu.ops import round_metrics as RM
+    bytes_of = _dense_round_bytes(proto, n_pad, n_pad // n_shards)
+    offered_per_msg = proto.rumors * RM.payload_factor(proto.mode)
+
+    def rec(m, prev_count, round0, msgs0, s1, alive_pad):
+        count = RM.count_bool(s1.seen, alive_pad)
+        newly = count - prev_count
+        msgs = s1.msgs - msgs0
+        return RM.record(
+            m, newly=newly, msgs=msgs,
+            dup=RM.dup_estimate(offered_per_msg * msgs, newly),
+            bytes=bytes_of(round0),
+            front=RM.front_bool(s1.seen, alive_pad, n_shards)), count
+
+    return rec
+
+
 def simulate_curve_sharded(proto: ProtocolConfig, topo: Topology,
                            run: RunConfig, mesh: Mesh,
                            fault: Optional[FaultConfig] = None,
@@ -245,24 +299,38 @@ def simulate_curve_sharded(proto: ProtocolConfig, topo: Topology,
     Returns (coverage[T], msgs[T], final_state) as host arrays/state.
     ``timing``: optional dict filled with the compile/steady AOT split
     (utils/trace.maybe_aot_timed — VERDICT r4 task 5: sharded rows must
-    decompose like single-device ones)."""
+    decompose like single-device ones).  With an active run ledger the
+    scan carries a round-metrics buffer stack, flushed once by the
+    chokepoint (ops/round_metrics)."""
     import numpy as np
 
+    from gossip_tpu.ops import round_metrics as RM
     from gossip_tpu.utils.trace import maybe_aot_timed
     step, tables = make_sharded_si_round(proto, topo, mesh, fault,
                                          run.origin, axis_name, tabled=True)
     n_pad = pad_to_mesh(topo.n, mesh, axis_name)
     init = init_sharded_state(run, proto, topo, mesh, axis_name)
+    n_shards = mesh.shape[axis_name]
+    rec = _dense_recorder(proto, n_pad, n_shards) if RM.wanted() else None
 
     @jax.jit
     def scan(state, *tbl):
         alive_pad = sharded_alive(fault, topo.n, n_pad, run.origin)
-        def body(s, _):
-            s = step(s, *tbl)
-            return s, (coverage(s.seen, alive_pad), s.msgs)
-        return jax.lax.scan(body, state, None, length=run.max_rounds)
+        m0 = (RM.init(run.max_rounds, n_shards, "simulate_curve_sharded")
+              if rec else None)
+        c0 = RM.count_bool(state.seen, alive_pad) if rec else None
+        def body(carry, _):
+            s0, m, cnt = carry
+            round0, msgs0 = s0.round, s0.msgs
+            s = step(s0, *tbl)
+            if m is not None:
+                m, cnt = rec(m, cnt, round0, msgs0, s, alive_pad)
+            return (s, m, cnt), (coverage(s.seen, alive_pad), s.msgs)
+        return jax.lax.scan(body, (state, m0, c0), None,
+                            length=run.max_rounds)
 
-    final, (covs, msgs) = maybe_aot_timed(scan, timing, init, *tables)
+    (final, _, _), (covs, msgs) = maybe_aot_timed(scan, timing, init,
+                                                  *tables)
     return np.asarray(covs), np.asarray(msgs), final
 
 
@@ -273,7 +341,10 @@ def simulate_until_sharded(proto: ProtocolConfig, topo: Topology,
     """``lax.while_loop`` to target coverage, whole loop one XLA program, state
     resident sharded across the mesh.  Returns (rounds, coverage, msgs, state).
     ``timing``: optional compile/steady AOT-split dict (see
-    simulate_curve_sharded)."""
+    simulate_curve_sharded).  With an active run ledger the loop carries
+    a round-metrics buffer stack, flushed once by the chokepoint
+    (ops/round_metrics)."""
+    from gossip_tpu.ops import round_metrics as RM
     from gossip_tpu.utils.trace import maybe_aot_timed
     step, tables = make_sharded_si_round(proto, topo, mesh, fault,
                                          run.origin, axis_name, tabled=True)
@@ -281,17 +352,28 @@ def simulate_until_sharded(proto: ProtocolConfig, topo: Topology,
     alive_pad = sharded_alive(fault, topo.n, n_pad, run.origin)
     init = init_sharded_state(run, proto, topo, mesh, axis_name)
     target = jnp.float32(run.target_coverage)
+    n_shards = mesh.shape[axis_name]
+    rec = _dense_recorder(proto, n_pad, n_shards) if RM.wanted() else None
 
     @jax.jit
     def loop(state, *tbl):
         alive_t = sharded_alive(fault, topo.n, n_pad, run.origin)
-        def cond(s):
+        m0 = (RM.init(run.max_rounds, n_shards, "simulate_until_sharded")
+              if rec else None)
+        c0 = RM.count_bool(state.seen, alive_t) if rec else None
+        def cond(carry):
+            s, _, _ = carry
             return ((coverage(s.seen, alive_t) < target)
                     & (s.round < run.max_rounds))
-        def body(s):
-            return step(s, *tbl)
-        return jax.lax.while_loop(cond, body, state)
+        def body(carry):
+            s0, m, cnt = carry
+            round0, msgs0 = s0.round, s0.msgs
+            s = step(s0, *tbl)
+            if m is not None:
+                m, cnt = rec(m, cnt, round0, msgs0, s, alive_t)
+            return s, m, cnt
+        return jax.lax.while_loop(cond, body, (state, m0, c0))
 
-    final = maybe_aot_timed(loop, timing, init, *tables)
+    final, _, _ = maybe_aot_timed(loop, timing, init, *tables)
     return (int(final.round), float(coverage(final.seen, alive_pad)),
             float(final.msgs), final)

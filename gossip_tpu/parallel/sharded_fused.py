@@ -364,10 +364,39 @@ def checkpointed_fused_planes(n: int, rumors: int, run: RunConfig,
     return final, cov, curve
 
 
+def _plane_recorder(n: int, fanout: int, mesh: Mesh):
+    """In-loop metrics row for the plane-sharded fused drivers
+    (ops/round_metrics).  ``msgs`` is the driver's own accounting
+    (2*fanout*n transmissions per round, all W word-planes riding one
+    exchange); ``offered`` counts every delivered digest bit including
+    the all-ones rumor padding (an upper bound, consistent with the
+    module contract); ``bytes`` is 4.0 — the scalar coverage reduction
+    is the ONLY cross-device traffic, which is exactly the zero-ICI
+    claim this plane makes checkable per round.  The previous round's
+    bit count rides the carry as ONE scalar — re-reading the pre-step
+    plane stack after the kernel call would extend its liveness across
+    the aliased pallas_call and resurrect the copy-insertion full-table
+    copy the donation contract exists to kill."""
+    from gossip_tpu.ops import round_metrics as RM
+    n_shards = mesh.shape[AXIS]
+
+    def rec(m, prev_count, planes1):
+        count = RM.count_planes(planes1)
+        newly = count - prev_count
+        offered = (jnp.float32(fanout * n)
+                   * jnp.float32(planes1.shape[0] * BITS))
+        return RM.record(
+            m, newly=newly, msgs=2.0 * fanout * n,
+            dup=RM.dup_estimate(offered, newly), bytes=4.0,
+            front=RM.front_planes(planes1, n, n_shards)), count
+
+    return rec
+
+
 @functools.lru_cache(maxsize=32)
 def _cached_curve_scan(n: int, seed: int, max_rounds: int, mesh: Mesh,
                        fanout: int, interpret: bool, drop_threshold: int,
-                       has_alive: bool):
+                       has_alive: bool, metrics: bool = False):
     """The compiled curve-scan driver, memoized by EXACTLY the statics
     its trace bakes in (seed and max_rounds are closed-over literals) —
     not the whole RunConfig, whose unused fields (engine, checkpoint
@@ -384,23 +413,34 @@ def _cached_curve_scan(n: int, seed: int, max_rounds: int, mesh: Mesh,
     every other family's).  The plane state is a runtime ARGUMENT, so
     different ``rumors`` shapes share one entry via jit's own cache.
     Convergence/coverage is computed ON DEVICE inside the scan — the
-    steady path does no per-round host round-trip."""
+    steady path does no per-round host round-trip.  ``metrics`` bakes
+    the round-metrics buffer carry into the program (ops/round_metrics
+    — part of the memo key: the instrumented and bare loops are
+    different executables)."""
+    from gossip_tpu.ops import round_metrics as RM
     step = make_sharded_fused_round_masked(
         n, mesh, fanout, interpret, drop_threshold=drop_threshold,
         has_alive=has_alive)
+    rec = _plane_recorder(n, fanout, mesh) if metrics else None
 
     @functools.partial(jax.jit, donate_argnums=0)
     def scan(planes, *masks):
         alive_words = masks[0] if has_alive else None
+        m0 = (RM.init(max_rounds, mesh.shape[AXIS],
+                      "simulate_curve_sharded_fused") if rec else None)
+        c0 = RM.count_planes(planes) if rec else None
 
         def body(c, _):
-            planes_c, round_c = c
+            planes_c, round_c, m, cnt = c
             planes_n = step(planes_c, seed, round_c, alive_words)
-            return ((planes_n, round_c + 1),
+            if m is not None:
+                m, cnt = rec(m, cnt, planes_n)
+            return ((planes_n, round_c + 1, m, cnt),
                     coverage_planes_masked(planes_n, n, alive_words))
-        (final, _), covs = jax.lax.scan(body, (planes, jnp.int32(0)),
-                                        None, length=max_rounds)
-        return final, covs
+        (final, _, m, _), covs = jax.lax.scan(
+            body, (planes, jnp.int32(0), m0, c0), None,
+            length=max_rounds)
+        return final, covs, m
 
     return scan
 
@@ -434,14 +474,15 @@ def simulate_curve_sharded_fused(n: int, rumors: int, run: RunConfig,
     maybe_aot_timed contract — AOT compile/steady split by default,
     ``{"aot": False}`` for a steady-only probe on the cached
     executable; plus ``init_build_s``, see :func:`_init_and_masks`)."""
+    from gossip_tpu.ops import round_metrics as RM
     from gossip_tpu.utils.trace import maybe_aot_timed
     has_alive = fault is not None and bool(fault.node_death_rate)
     scan = _cached_curve_scan(n, run.seed, run.max_rounds, mesh, fanout,
                               interpret, drop_threshold_for(fault),
-                              has_alive)
+                              has_alive, RM.wanted())
     init, masks = _init_and_masks(n, rumors, run, mesh, fault, has_alive,
                                   timing)
-    final, covs = maybe_aot_timed(scan, timing, init, *masks)
+    final, covs, _ = maybe_aot_timed(scan, timing, init, *masks)
     return covs, final
 
 
@@ -449,7 +490,7 @@ def simulate_curve_sharded_fused(n: int, rumors: int, run: RunConfig,
 def _cached_until_loop(n: int, seed: int, max_rounds: int,
                        target_coverage: float, mesh: Mesh,
                        fanout: int, interpret: bool, drop_threshold: int,
-                       has_alive: bool):
+                       has_alive: bool, metrics: bool = False):
     """The compiled until-target driver, memoized like
     :func:`_cached_curve_scan` (same key contract and rationale, plus
     the target the cond compares against).  Returns ``loop(planes,
@@ -458,29 +499,40 @@ def _cached_until_loop(n: int, seed: int, max_rounds: int,
     the cond used (one chooser for both, and one executable dispatch
     per steady call instead of loop + separate coverage).  The
     convergence check runs on device inside the while_loop cond; steady
-    state does no per-round host round-trip."""
+    state does no per-round host round-trip.  ``metrics`` bakes the
+    round-metrics buffer carry into the program (part of the memo
+    key, as in :func:`_cached_curve_scan`)."""
+    from gossip_tpu.ops import round_metrics as RM
     step = make_sharded_fused_round_masked(
         n, mesh, fanout, interpret, drop_threshold=drop_threshold,
         has_alive=has_alive)
     target = jnp.float32(target_coverage)
+    rec = _plane_recorder(n, fanout, mesh) if metrics else None
 
     @functools.partial(jax.jit, donate_argnums=0)
     def loop(planes, *masks):
         alive_words = masks[0] if has_alive else None
+        m0 = (RM.init(max_rounds, mesh.shape[AXIS],
+                      "simulate_until_sharded_fused") if rec else None)
+        c0 = RM.count_planes(planes) if rec else None
 
         def cond(c):
-            planes_c, round_c = c
+            planes_c, round_c, _, _ = c
             return ((coverage_planes_masked(planes_c, n, alive_words)
                      < target)
                     & (round_c < max_rounds))
 
         def body(c):
-            planes_c, round_c = c
-            return step(planes_c, seed, round_c, alive_words), round_c + 1
+            planes_c, round_c, m, cnt = c
+            planes_n = step(planes_c, seed, round_c, alive_words)
+            if m is not None:
+                m, cnt = rec(m, cnt, planes_n)
+            return planes_n, round_c + 1, m, cnt
 
-        final, rounds = jax.lax.while_loop(cond, body,
-                                           (planes, jnp.int32(0)))
-        return final, rounds, coverage_planes_masked(final, n, alive_words)
+        final, rounds, m, _ = jax.lax.while_loop(
+            cond, body, (planes, jnp.int32(0), m0, c0))
+        return (final, rounds,
+                coverage_planes_masked(final, n, alive_words), m)
 
     return loop
 
@@ -498,15 +550,16 @@ def simulate_until_sharded_fused(n: int, rumors: int, run: RunConfig,
     the cond and the reported coverage switch to the alive-weighted
     metric (coverage_planes_masked — one chooser for both).  ``timing``:
     optional wall-decomposition dict (see the curve twin)."""
+    from gossip_tpu.ops import round_metrics as RM
     from gossip_tpu.utils.trace import maybe_aot_timed
     has_alive = fault is not None and bool(fault.node_death_rate)
     loop = _cached_until_loop(n, run.seed, run.max_rounds,
                               run.target_coverage, mesh, fanout,
                               interpret, drop_threshold_for(fault),
-                              has_alive)
+                              has_alive, RM.wanted())
     init, masks = _init_and_masks(n, rumors, run, mesh, fault, has_alive,
                                   timing)
-    final, rounds, cov = maybe_aot_timed(loop, timing, init, *masks)
+    final, rounds, cov, _ = maybe_aot_timed(loop, timing, init, *masks)
     rounds = int(rounds)
     cov = float(cov)
     msgs = 2.0 * fanout * n * rounds

@@ -207,12 +207,46 @@ def checkpointed_packed_sharded(proto: ProtocolConfig, topo: Topology,
     return final, cov, curve
 
 
+def _packed_recorder(proto: ProtocolConfig, n_pad: int, n_shards: int):
+    """In-loop metrics row for the packed pull/anti-entropy kernels
+    (ops/round_metrics; the dense-driver twin lives in
+    parallel/sharded._dense_recorder).  Per-device egress: the packed
+    all_gather moves ``nl*W*4`` uint32 bytes every round; anti-entropy's
+    reverse psum_scatter contributes ``4*n_pad*R`` int32 bytes (the
+    counts table is unpacked) on exchange rounds only."""
+    from gossip_tpu.ops import round_metrics as RM
+    from gossip_tpu.ops.bitpack import n_words
+    r = proto.rumors
+    nl = n_pad // n_shards
+    base = 4.0 + 4.0 * nl * n_words(r)
+    offered_per_msg = r * RM.payload_factor(proto.mode)
+
+    def rec(m, prev_count, round0, msgs0, s1, alive_pad):
+        count = RM.count_packed(s1.seen, alive_pad)
+        newly = count - prev_count
+        msgs = s1.msgs - msgs0
+        b = jnp.float32(base)
+        if proto.mode == C.ANTI_ENTROPY:
+            b = b + RM.gate_on_exchange_rounds(4.0 * n_pad * r,
+                                               proto.period, round0)
+        return RM.record(
+            m, newly=newly, msgs=msgs,
+            dup=RM.dup_estimate(offered_per_msg * msgs, newly),
+            bytes=b,
+            front=RM.front_packed(s1.seen, alive_pad, n_shards)), count
+
+    return rec
+
+
 def simulate_until_packed_sharded(proto: ProtocolConfig, topo: Topology,
                                   run: RunConfig, mesh: Mesh,
                                   fault: Optional[FaultConfig] = None,
                                   axis_name: str = "nodes", timing=None):
     """``timing``: optional compile/steady AOT-split dict
-    (parallel/sharded.simulate_until_sharded contract)."""
+    (parallel/sharded.simulate_until_sharded contract).  With an active
+    run ledger the loop carries a round-metrics buffer stack, flushed
+    once by the chokepoint (ops/round_metrics)."""
+    from gossip_tpu.ops import round_metrics as RM
     from gossip_tpu.utils.trace import maybe_aot_timed
     step, tables = make_sharded_packed_round(proto, topo, mesh, fault,
                                              run.origin, axis_name,
@@ -222,18 +256,30 @@ def simulate_until_packed_sharded(proto: ProtocolConfig, topo: Topology,
     init = init_sharded_packed_state(run, proto, topo, mesh, axis_name)
     target = jnp.float32(run.target_coverage)
     r = proto.rumors
+    n_shards = mesh.shape[axis_name]
+    rec = (_packed_recorder(proto, n_pad, n_shards)
+           if RM.wanted() else None)
 
     @jax.jit
     def loop(state, *tbl):
         alive_t = sharded_alive(fault, topo.n, n_pad, run.origin)
-        def cond(s):
+        m0 = (RM.init(run.max_rounds, n_shards,
+                      "simulate_until_packed_sharded") if rec else None)
+        c0 = RM.count_packed(state.seen, alive_t) if rec else None
+        def cond(carry):
+            s, _, _ = carry
             return ((coverage_packed(s.seen, r, alive_t) < target)
                     & (s.round < run.max_rounds))
-        def body(s):
-            return step(s, *tbl)
-        return jax.lax.while_loop(cond, body, state)
+        def body(carry):
+            s0, m, cnt = carry
+            round0, msgs0 = s0.round, s0.msgs
+            s = step(s0, *tbl)
+            if m is not None:
+                m, cnt = rec(m, cnt, round0, msgs0, s, alive_t)
+            return s, m, cnt
+        return jax.lax.while_loop(cond, body, (state, m0, c0))
 
-    final = maybe_aot_timed(loop, timing, init, *tables)
+    final, _, _ = maybe_aot_timed(loop, timing, init, *tables)
     return (int(final.round),
             float(coverage_packed(final.seen, r, alive_pad)),
             float(final.msgs), final)

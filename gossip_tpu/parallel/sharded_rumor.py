@@ -134,35 +134,93 @@ def init_sharded_rumor_state(run: RunConfig, proto: ProtocolConfig,
                       base_key=st.base_key, msgs=st.msgs)
 
 
+def _rumor_recorder(proto: ProtocolConfig, n_pad: int,
+                    n_shards: int):
+    """In-loop metrics row for the SIR rumor drivers
+    (ops/round_metrics).  The kernel's own hit counters make ``dup``
+    EXACT for the feedback variant — ``cnt`` grows by precisely the
+    contacts whose recipient already knew — while blind's counter
+    counts all contacts, so there the estimator subtracts the round's
+    new infections (module-doc upper bound).  The previous round's
+    seen/cnt totals ride the carry as two scalars
+    (parallel/sharded._dense_recorder liveness rationale)."""
+    from gossip_tpu.ops import round_metrics as RM
+    feedback = proto.rumor_variant == "feedback"
+    r = proto.rumors
+    nl = n_pad // n_shards
+    # psum_scatter of the int32 counts table every round; feedback adds
+    # the round-start seen all_gather (bool egress); plus the msgs psum
+    base_bytes = 4.0 * n_pad * r + (1.0 * nl * r if feedback else 0.0) \
+        + 4.0
+
+    def rec(m, prev, msgs0, s1, alive):
+        count = RM.count_bool(s1.seen, alive)
+        cntsum = jnp.sum(jnp.where(alive[:, None], s1.cnt, 0),
+                         dtype=jnp.float32)
+        newly = count - prev[0]
+        contacts = cntsum - prev[1]
+        return RM.record(
+            m, newly=newly, msgs=s1.msgs - msgs0,
+            dup=(contacts if feedback
+                 else RM.dup_estimate(contacts, newly)),
+            bytes=base_bytes,
+            front=RM.front_bool(s1.seen, alive, n_shards)), \
+            (count, cntsum)
+
+    def init_prev(state, alive):
+        return (RM.count_bool(state.seen, alive),
+                jnp.sum(jnp.where(alive[:, None], state.cnt, 0),
+                        dtype=jnp.float32))
+
+    return rec, init_prev
+
+
 def simulate_curve_rumor_sharded(proto: ProtocolConfig, topo: Topology,
                                  run: RunConfig, mesh: Mesh,
                                  fault: Optional[FaultConfig] = None,
-                                 axis_name: str = "nodes"):
+                                 axis_name: str = "nodes", timing=None):
     """Fixed-length scan with per-round (coverage, hot_fraction, msgs)
     curves, state resident sharded — the multi-device twin of
     models/rumor.simulate_curve_rumor (same returns; curves weighted by
     the padded alive mask so padding rows deflate nothing).  Closes the
     round-3 carve-out where rumor curve capture was single-device
-    only."""
+    only.  ``timing``: optional compile/steady AOT-split dict
+    (utils/trace.maybe_aot_timed contract); with an active run ledger
+    the scan carries a round-metrics buffer stack (ops/round_metrics)."""
+    from gossip_tpu.ops import round_metrics as RM
+    from gossip_tpu.utils.trace import maybe_aot_timed
     step, tables = make_sharded_rumor_round(proto, topo, mesh, fault,
                                             run.origin, axis_name,
                                             tabled=True)
     init = init_sharded_rumor_state(run, proto, topo, mesh, axis_name)
     n_pad = pad_to_mesh(topo.n, mesh, axis_name)
+    n_shards = mesh.shape[axis_name]
+    rec, init_prev = (_rumor_recorder(proto, n_pad, n_shards)
+                      if RM.wanted() else (None, None))
 
     @jax.jit
     def scan(state, *tbl):
         alive = sharded_alive(fault, topo.n, n_pad, run.origin)
         w = alive.astype(jnp.float32)
+        m0 = (RM.init(run.max_rounds, n_shards,
+                      "simulate_curve_rumor_sharded") if rec else None)
+        p0 = init_prev(state, alive) if rec else None
 
-        def body(s, _):
-            s = step(s, *tbl)
+        def body(carry, _):
+            s0, m, prev = carry
+            msgs0 = s0.msgs
+            s = step(s0, *tbl)
+            if m is not None:
+                m, prev = rec(m, prev, msgs0, s, alive)
             hot_any = jnp.any(s.hot, axis=1).astype(jnp.float32)
             hot_frac = jnp.sum(hot_any * w) / jnp.sum(w)
-            return s, (rumor_coverage(s.seen, alive), hot_frac, s.msgs)
-        return jax.lax.scan(body, state, None, length=run.max_rounds)
+            return ((s, m, prev),
+                    (rumor_coverage(s.seen, alive), hot_frac, s.msgs))
+        return jax.lax.scan(body, (state, m0, p0), None,
+                            length=run.max_rounds)
 
-    final, (covs, hots, msgs) = scan(init, *tables)
+    (final, _, _), (covs, hots, msgs) = maybe_aot_timed(scan, timing,
+                                                        init, *tables)
     return covs, hots, msgs, final
 
 
@@ -181,22 +239,45 @@ def restore_sharded_rumor_state(state: RumorState, mesh: Mesh,
 def simulate_until_rumor_sharded(proto: ProtocolConfig, topo: Topology,
                                  run: RunConfig, mesh: Mesh,
                                  fault: Optional[FaultConfig] = None,
-                                 axis_name: str = "nodes"):
+                                 axis_name: str = "nodes", timing=None):
     """Run to extinction or max_rounds, one compiled while_loop, state
-    resident sharded.  Same returns as models/rumor.simulate_until_rumor."""
+    resident sharded.  Same returns as models/rumor.simulate_until_rumor.
+    ``timing``: optional compile/steady AOT-split dict; with an active
+    run ledger the loop carries a round-metrics buffer stack
+    (ops/round_metrics)."""
+    from gossip_tpu.ops import round_metrics as RM
+    from gossip_tpu.utils.trace import maybe_aot_timed
     step, tables = make_sharded_rumor_round(proto, topo, mesh, fault,
                                             run.origin, axis_name,
                                             tabled=True)
     init = init_sharded_rumor_state(run, proto, topo, mesh, axis_name)
+    n_pad_m = pad_to_mesh(topo.n, mesh, axis_name)
+    n_shards = mesh.shape[axis_name]
+    rec, init_prev = (_rumor_recorder(proto, n_pad_m, n_shards)
+                      if RM.wanted() else (None, None))
 
     @jax.jit
     def loop(state, *tbl):
-        def cond(s):
+        alive = sharded_alive(fault, topo.n, n_pad_m, run.origin)
+        m0 = (RM.init(run.max_rounds, n_shards,
+                      "simulate_until_rumor_sharded") if rec else None)
+        p0 = init_prev(state, alive) if rec else None
+
+        def cond(carry):
+            s, _, _ = carry
             return jnp.any(s.hot) & (s.round < run.max_rounds)
 
-        return jax.lax.while_loop(cond, lambda s: step(s, *tbl), state)
+        def body(carry):
+            s0, m, prev = carry
+            msgs0 = s0.msgs
+            s = step(s0, *tbl)
+            if m is not None:
+                m, prev = rec(m, prev, msgs0, s, alive)
+            return s, m, prev
 
-    final = loop(init, *tables)
+        return jax.lax.while_loop(cond, body, (state, m0, p0))
+
+    final, _, _ = maybe_aot_timed(loop, timing, init, *tables)
     # always weight by the padded alive mask: padding rows must not
     # deflate coverage (sharded_alive marks them dead even fault-free)
     n_pad = pad_to_mesh(topo.n, mesh, axis_name)

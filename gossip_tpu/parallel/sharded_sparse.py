@@ -699,6 +699,37 @@ def sparse_topo_pull_round_reference(
     return step
 
 
+def _sparse_recorder(proto: ProtocolConfig, n_shards: int,
+                     meta: SparseMeta):
+    """In-loop metrics row for the sparse exchange drivers
+    (ops/round_metrics).  ``bytes`` comes straight from the driver's own
+    :class:`SparseMeta` traffic accounting — per device per EXCHANGE
+    round — gated in-trace on quiescent anti-entropy rounds exactly as
+    the kernels cond-skip the collectives (plus the 4-byte msgs
+    psum, which moves every round).  The previous round's entry count
+    rides the carry as one scalar (the parallel/sharded._dense_recorder
+    liveness rationale)."""
+    from gossip_tpu.ops import round_metrics as RM
+    offered_per_msg = proto.rumors * RM.payload_factor(proto.mode)
+    exchange_b = float(meta.sparse_bytes) + 4.0
+
+    def rec(m, prev_count, round0, msgs0, s1, alive_pad):
+        count = RM.count_packed(s1.seen, alive_pad)
+        newly = count - prev_count
+        msgs = s1.msgs - msgs0
+        b = jnp.float32(exchange_b)
+        if proto.mode == C.ANTI_ENTROPY:
+            b = RM.gate_on_exchange_rounds(exchange_b, proto.period,
+                                           round0, off=4.0)
+        return RM.record(
+            m, newly=newly, msgs=msgs,
+            dup=RM.dup_estimate(offered_per_msg * msgs, newly),
+            bytes=b,
+            front=RM.front_packed(s1.seen, alive_pad, n_shards)), count
+
+    return rec
+
+
 def simulate_curve_topo_sparse(proto: ProtocolConfig, topo, run: RunConfig,
                                mesh: Mesh,
                                fault: Optional[FaultConfig] = None,
@@ -707,9 +738,12 @@ def simulate_curve_topo_sparse(proto: ProtocolConfig, topo, run: RunConfig,
     """lax.scan over rounds on the explicit-topology sparse pull path.
     Returns (coverage[T], msgs[T], final, SparseMeta, overflow[T]).
     ``timing``: optional compile/steady AOT-split dict
-    (parallel/sharded.simulate_curve_sharded contract)."""
+    (parallel/sharded.simulate_curve_sharded contract).  With an active
+    run ledger the scan carries a round-metrics buffer stack, flushed
+    once by the chokepoint (ops/round_metrics)."""
     import numpy as np
 
+    from gossip_tpu.ops import round_metrics as RM
     from gossip_tpu.utils.trace import maybe_aot_timed
     p = mesh.shape[axis_name]
     cap_used = resolve_topo_cap(topo, p, proto.fanout, cap)
@@ -719,22 +753,30 @@ def simulate_curve_topo_sparse(proto: ProtocolConfig, topo, run: RunConfig,
     n_pad = pad_to_mesh(topo.n, mesh, axis_name)
     init = init_sparse_state(run, proto, topo.n, mesh, axis_name)
     r = proto.rumors
+    meta = sparse_topo_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
+                            cap_used,
+                            bidirectional=proto.mode == C.ANTI_ENTROPY)
+    rec = _sparse_recorder(proto, p, meta) if RM.wanted() else None
 
     @jax.jit
     def scan(state, *tbl):
         alive_pad = sharded_alive(fault, topo.n, n_pad, run.origin)
+        m0 = (RM.init(run.max_rounds, p, "simulate_curve_topo_sparse")
+              if rec else None)
+        c0 = RM.count_packed(state.seen, alive_pad) if rec else None
         def body(carry, _):
-            s, ovf = step(*carry, *tbl)
-            return (s, ovf), (coverage_packed(s.seen, r, alive_pad),
-                              s.msgs, ovf)
-        return jax.lax.scan(body, (state, jnp.float32(0.0)), None,
-                            length=run.max_rounds)
+            s0, ovf0, m, cnt = carry
+            round0, msgs0 = s0.round, s0.msgs
+            s, ovf = step(s0, ovf0, *tbl)
+            if m is not None:
+                m, cnt = rec(m, cnt, round0, msgs0, s, alive_pad)
+            return ((s, ovf, m, cnt),
+                    (coverage_packed(s.seen, r, alive_pad), s.msgs, ovf))
+        return jax.lax.scan(body, (state, jnp.float32(0.0), m0, c0),
+                            None, length=run.max_rounds)
 
-    (final, _), (covs, msgs, ovfs) = maybe_aot_timed(scan, timing,
-                                                     init, *tables)
-    meta = sparse_topo_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
-                            cap_used,
-                            bidirectional=proto.mode == C.ANTI_ENTROPY)
+    ((final, _, _, _),
+     (covs, msgs, ovfs)) = maybe_aot_timed(scan, timing, init, *tables)
     return (np.asarray(covs), np.asarray(msgs), final, meta,
             np.asarray(ovfs))
 
@@ -746,7 +788,10 @@ def simulate_until_topo_sparse(proto: ProtocolConfig, topo, run: RunConfig,
                                cap: Optional[int] = None, timing=None):
     """while_loop to target coverage on the explicit-topology sparse pull
     path.  Returns (rounds, coverage, msgs, final, SparseMeta, overflow).
-    ``timing``: optional compile/steady AOT-split dict."""
+    ``timing``: optional compile/steady AOT-split dict.  With an active
+    run ledger the loop carries a round-metrics buffer stack
+    (ops/round_metrics)."""
+    from gossip_tpu.ops import round_metrics as RM
     from gossip_tpu.utils.trace import maybe_aot_timed
     p = mesh.shape[axis_name]
     cap_used = resolve_topo_cap(topo, p, proto.fanout, cap)
@@ -758,25 +803,34 @@ def simulate_until_topo_sparse(proto: ProtocolConfig, topo, run: RunConfig,
     init = init_sparse_state(run, proto, topo.n, mesh, axis_name)
     target = jnp.float32(run.target_coverage)
     r = proto.rumors
+    meta = sparse_topo_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
+                            cap_used,
+                            bidirectional=proto.mode == C.ANTI_ENTROPY)
+    rec = _sparse_recorder(proto, p, meta) if RM.wanted() else None
 
     @jax.jit
     def loop(state, *tbl):
         # liveness in-trace: no O(N) closed-over constant in the compile
         # request (bind_tables doc)
         alive_t = sharded_alive(fault, topo.n, n_pad, run.origin)
+        m0 = (RM.init(run.max_rounds, p, "simulate_until_topo_sparse")
+              if rec else None)
+        c0 = RM.count_packed(state.seen, alive_t) if rec else None
         def cond(carry):
-            s, _ = carry
+            s, _, _, _ = carry
             return ((coverage_packed(s.seen, r, alive_t) < target)
                     & (s.round < run.max_rounds))
         def body(carry):
-            return step(*carry, *tbl)
+            s0, ovf0, m, cnt = carry
+            round0, msgs0 = s0.round, s0.msgs
+            s, ovf = step(s0, ovf0, *tbl)
+            if m is not None:
+                m, cnt = rec(m, cnt, round0, msgs0, s, alive_t)
+            return s, ovf, m, cnt
         return jax.lax.while_loop(cond, body,
-                                  (state, jnp.float32(0.0)))
+                                  (state, jnp.float32(0.0), m0, c0))
 
-    final, ovf = maybe_aot_timed(loop, timing, init, *tables)
-    meta = sparse_topo_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
-                            cap_used,
-                            bidirectional=proto.mode == C.ANTI_ENTROPY)
+    final, ovf, _, _ = maybe_aot_timed(loop, timing, init, *tables)
     return (int(final.round),
             float(coverage_packed(final.seen, r, alive_pad)),
             float(final.msgs), final, meta, float(ovf))
@@ -787,9 +841,12 @@ def simulate_curve_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
                           axis_name: str = "nodes", timing=None):
     """lax.scan over rounds recording (coverage, msgs) on the sparse
     exchange path.  Returns (coverage[T], msgs[T], final, SparseMeta).
-    ``timing``: optional compile/steady AOT-split dict."""
+    ``timing``: optional compile/steady AOT-split dict.  With an active
+    run ledger the scan carries a round-metrics buffer stack
+    (ops/round_metrics)."""
     import numpy as np
 
+    from gossip_tpu.ops import round_metrics as RM
     from gossip_tpu.utils.trace import maybe_aot_timed
     step = make_sparse_pull_round(proto, n, mesh, fault, run.origin,
                                   axis_name)
@@ -797,18 +854,28 @@ def simulate_curve_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
     n_pad = pad_to_mesh(n, mesh, axis_name)
     init = init_sparse_state(run, proto, n, mesh, axis_name)
     r = proto.rumors
+    meta = sparse_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
+                       bidirectional=proto.mode == C.ANTI_ENTROPY)
+    rec = _sparse_recorder(proto, p, meta) if RM.wanted() else None
 
     @jax.jit
     def scan(state):
         alive_pad = sharded_alive(fault, n, n_pad, run.origin)
-        def body(s, _):
-            s = step(s)
-            return s, (coverage_packed(s.seen, r, alive_pad), s.msgs)
-        return jax.lax.scan(body, state, None, length=run.max_rounds)
+        m0 = (RM.init(run.max_rounds, p, "simulate_curve_sparse")
+              if rec else None)
+        c0 = RM.count_packed(state.seen, alive_pad) if rec else None
+        def body(carry, _):
+            s0, m, cnt = carry
+            round0, msgs0 = s0.round, s0.msgs
+            s = step(s0)
+            if m is not None:
+                m, cnt = rec(m, cnt, round0, msgs0, s, alive_pad)
+            return (s, m, cnt), (coverage_packed(s.seen, r, alive_pad),
+                                 s.msgs)
+        return jax.lax.scan(body, (state, m0, c0), None,
+                            length=run.max_rounds)
 
-    final, (covs, msgs) = maybe_aot_timed(scan, timing, init)
-    meta = sparse_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
-                       bidirectional=proto.mode == C.ANTI_ENTROPY)
+    (final, _, _), (covs, msgs) = maybe_aot_timed(scan, timing, init)
     return np.asarray(covs), np.asarray(msgs), final, meta
 
 
@@ -817,7 +884,10 @@ def simulate_until_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
                           axis_name: str = "nodes", timing=None):
     """while_loop to target coverage on the sparse exchange path.
     Returns (rounds, coverage, msgs, final_state, SparseMeta).
-    ``timing``: optional compile/steady AOT-split dict."""
+    ``timing``: optional compile/steady AOT-split dict.  With an active
+    run ledger the loop carries a round-metrics buffer stack
+    (ops/round_metrics)."""
+    from gossip_tpu.ops import round_metrics as RM
     from gossip_tpu.utils.trace import maybe_aot_timed
     step = make_sparse_pull_round(proto, n, mesh, fault, run.origin,
                                   axis_name)
@@ -827,20 +897,32 @@ def simulate_until_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
     init = init_sparse_state(run, proto, n, mesh, axis_name)
     target = jnp.float32(run.target_coverage)
     r = proto.rumors
+    meta = sparse_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
+                       bidirectional=proto.mode == C.ANTI_ENTROPY)
+    rec = _sparse_recorder(proto, p, meta) if RM.wanted() else None
 
     @jax.jit
     def loop(state):
         # liveness in-trace: no O(N) closed-over constant (bind_tables
         # doc) — same hardening as simulate_until_topo_sparse
         alive_t = sharded_alive(fault, n, n_pad, run.origin)
-        def cond(s):
+        m0 = (RM.init(run.max_rounds, p, "simulate_until_sparse")
+              if rec else None)
+        c0 = RM.count_packed(state.seen, alive_t) if rec else None
+        def cond(carry):
+            s, _, _ = carry
             return ((coverage_packed(s.seen, r, alive_t) < target)
                     & (s.round < run.max_rounds))
-        return jax.lax.while_loop(cond, step, state)
+        def body(carry):
+            s0, m, cnt = carry
+            round0, msgs0 = s0.round, s0.msgs
+            s = step(s0)
+            if m is not None:
+                m, cnt = rec(m, cnt, round0, msgs0, s, alive_t)
+            return s, m, cnt
+        return jax.lax.while_loop(cond, body, (state, m0, c0))
 
-    final = maybe_aot_timed(loop, timing, init)
-    meta = sparse_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
-                       bidirectional=proto.mode == C.ANTI_ENTROPY)
+    final, _, _ = maybe_aot_timed(loop, timing, init)
     return (int(final.round),
             float(coverage_packed(final.seen, r, alive_pad)),
             float(final.msgs), final, meta)

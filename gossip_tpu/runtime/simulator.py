@@ -116,14 +116,63 @@ def simulate_until(proto: ProtocolConfig, topo: Topology, run: RunConfig,
     )
 
 
+def _swim_recorder(proto: ProtocolConfig, n: int, n_pad: int,
+                   n_shards: int):
+    """In-loop metrics row for the SWIM drivers (ops/round_metrics,
+    failure-detection reading of the counters): ``newly`` is newly
+    CONFIRMED-DEAD (subject, observer) wire entries — the detection
+    front's growth; ``front`` the per-shard fraction of observers
+    holding any confirmed death; ``offered`` the dissemination upper
+    bound fanout*n*S (every diss message carries the full S-subject
+    wire row); ``bytes`` the pmax contribution table's per-device
+    egress (``4*n_pad*S``; 0 on a single device — SWIM's only
+    collective is the wire merge).  The previous confirmed count rides
+    the carry as one scalar (parallel/sharded._dense_recorder
+    liveness rationale)."""
+    from gossip_tpu.models.swim import DEAD_WIRE
+    from gossip_tpu.ops import round_metrics as RM
+    s_subj = proto.swim_subjects
+    offered = float(proto.fanout * n * s_subj)
+    per_round_bytes = (0.0 if n_shards == 1
+                       else 4.0 * n_pad * s_subj + 4.0)
+
+    def rec(m, prev, msgs0, s1, obs_pad):
+        dead_tbl = s1.wire == DEAD_WIRE
+        confirmed = jnp.sum(dead_tbl & obs_pad[:, None],
+                            dtype=jnp.float32)
+        newly = confirmed - prev
+        return RM.record(
+            m, newly=newly, msgs=s1.msgs - msgs0,
+            dup=RM.dup_estimate(offered, newly),
+            bytes=per_round_bytes,
+            front=RM.front_bool(dead_tbl, obs_pad, n_shards)), confirmed
+
+    def init_prev(state, obs_pad):
+        return jnp.sum((state.wire == DEAD_WIRE) & obs_pad[:, None],
+                       dtype=jnp.float32)
+
+    return rec, init_prev
+
+
+def _swim_obs_pad(alive_obs, n: int, n_pad: int):
+    """The observer mask padded to the sharded row count (padding rows
+    never observe; a no-op when unsharded)."""
+    if n_pad == n:
+        return alive_obs
+    return jnp.zeros((n_pad,), jnp.bool_).at[:n].set(alive_obs)
+
+
 def simulate_swim_curve(proto: ProtocolConfig, n: int, rounds: int,
                         dead_nodes=(), fail_round: int = 0,
                         fault: Optional[FaultConfig] = None,
                         topo: Optional[Topology] = None,
-                        seed: int = 0, mesh=None):
+                        seed: int = 0, mesh=None, timing=None):
     """SWIM detection-fraction curve over ``rounds`` (lax.scan, one XLA
     program).  With ``mesh`` the sharded twin runs instead.  Returns
-    (detection[T] as numpy, final SwimState)."""
+    (detection[T] as numpy, final SwimState).  ``timing``: optional
+    compile/steady AOT-split dict (utils/trace.maybe_aot_timed); with
+    an active run ledger the scan carries a round-metrics buffer stack
+    (ops/round_metrics)."""
     from gossip_tpu.models import swim as SW
     # tabled=True: topology arrays enter the jitted scan as ARGUMENTS, not
     # closure constants — a closed-over 1M-row neighbor table would be
@@ -145,6 +194,13 @@ def simulate_swim_curve(proto: ProtocolConfig, n: int, rounds: int,
     dead = tuple(dead_nodes)
     rotate = proto.swim_rotate
     epoch_rounds = SW.resolve_epoch_rounds(proto, n)
+    from gossip_tpu.ops import round_metrics as RM
+    from gossip_tpu.utils.trace import maybe_aot_timed
+    n_pad = int(init.wire.shape[0])
+    n_shards = int(np.prod(list(mesh.shape.values()))) if mesh else 1
+    rec, init_prev = (_swim_recorder(proto, n, n_pad, n_shards)
+                      if RM.wanted() else (None, None))
+
     @jax.jit
     def scan(state, *tbl):
         # Observer population: nodes that stay alive after fail_round.
@@ -152,9 +208,17 @@ def simulate_swim_curve(proto: ProtocolConfig, n: int, rounds: int,
         # and the detection fraction plateaus at the alive fraction, never
         # reaching the target.  Built in-trace: no O(N) inline constant.
         alive_obs = SW.base_alive(n, tuple(dead_nodes), fault)
+        obs_pad = _swim_obs_pad(alive_obs, n, n_pad)
+        m0 = (RM.init(rounds, n_shards, "simulate_swim_curve")
+              if rec else None)
+        p0 = init_prev(state, obs_pad) if rec else None
 
-        def body(s, _):
-            s = step(s, *tbl)
+        def body(carry, _):
+            s0, m, prev = carry
+            msgs0 = s0.msgs
+            s = step(s0, *tbl)
+            if m is not None:
+                m, prev = rec(m, prev, msgs0, s, obs_pad)
             # observers: rows [0, n) — drops the mesh padding rows (a no-op
             # slice in the unsharded case); detection over the dead subjects
             # in the window of the round just executed (s.round - 1)
@@ -164,10 +228,10 @@ def simulate_swim_curve(proto: ProtocolConfig, n: int, rounds: int,
                 SW.SwimState(s.wire[:n], s.timer[:n], s.round,
                              s.base_key, s.msgs), dead,
                 alive_obs, subj_gids=window) if dead else 0.0
-            return s, frac
-        return jax.lax.scan(body, state, None, length=rounds)
+            return (s, m, prev), frac
+        return jax.lax.scan(body, (state, m0, p0), None, length=rounds)
 
-    final, fracs = scan(init, *tables)
+    (final, _, _), fracs = maybe_aot_timed(scan, timing, init, *tables)
     return np.asarray(fracs), final
 
 
@@ -206,10 +270,19 @@ def simulate_swim_until(proto: ProtocolConfig, n: int, max_rounds: int,
     rotate = proto.swim_rotate
     epoch_rounds = SW.resolve_epoch_rounds(proto, n)
     tgt = jnp.float32(target)
+    from gossip_tpu.ops import round_metrics as RM
+    n_pad = int(init.wire.shape[0])
+    n_shards = int(np.prod(list(mesh.shape.values()))) if mesh else 1
+    rec, init_prev = (_swim_recorder(proto, n, n_pad, n_shards)
+                      if RM.wanted() else (None, None))
 
     @jax.jit
     def loop(state, *tbl):
         alive_obs = SW.base_alive(n, tuple(dead_nodes), fault)
+        obs_pad = _swim_obs_pad(alive_obs, n, n_pad)
+        m0 = (RM.init(max_rounds, n_shards, "simulate_swim_until")
+              if rec else None)
+        p0 = init_prev(state, obs_pad) if rec else None
 
         def detection(s):
             window = SW.subject_window(s.round - 1, proto.swim_subjects, n,
@@ -220,20 +293,24 @@ def simulate_swim_until(proto: ProtocolConfig, n: int, max_rounds: int,
                 alive_obs, subj_gids=window) if dead else jnp.float32(0.0)
 
         def cond(carry):
-            s, det, _ = carry
+            s, det, _, _, _ = carry
             return (det < tgt) & (s.round < max_rounds)
 
         def body(carry):
-            s, _, peak = carry
-            s = step(s, *tbl)
+            s0, _, peak, m, prev = carry
+            msgs0 = s0.msgs
+            s = step(s0, *tbl)
+            if m is not None:
+                m, prev = rec(m, prev, msgs0, s, obs_pad)
             det = detection(s)
-            return s, det, jnp.maximum(peak, det)
+            return s, det, jnp.maximum(peak, det), m, prev
 
         return jax.lax.while_loop(
-            cond, body, (state, jnp.float32(0.0), jnp.float32(0.0)))
+            cond, body,
+            (state, jnp.float32(0.0), jnp.float32(0.0), m0, p0))
 
     from gossip_tpu.utils.trace import maybe_aot_timed
-    final, det, peak = maybe_aot_timed(loop, timing, init, *tables)
+    final, det, peak, _, _ = maybe_aot_timed(loop, timing, init, *tables)
     return int(final.round), float(det), float(peak), final
 
 

@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import math
 import os
 import subprocess
 import sys
@@ -110,6 +111,33 @@ def provenance(argv=None) -> dict:
     }
 
 
+def _finite(x):
+    """Non-finite floats replaced by their reprs ('nan'/'inf'/'-inf'),
+    recursively — the ledger must stay STRICT JSON (jq and every
+    non-Python consumer reject the NaN/Infinity literals Python's json
+    would otherwise emit), and a poisoned gauge must record the fact of
+    the poisoning, not corrupt the file."""
+    if isinstance(x, float) and not math.isfinite(x):
+        return repr(x)
+    if isinstance(x, dict):
+        return {k: _finite(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_finite(v) for v in x]
+    return x
+
+
+def _dumps(obj) -> str:
+    """json.dumps that never emits non-strict NaN/Infinity literals:
+    the cheap strict attempt first, the :func:`_finite` rewrite only
+    when a non-finite value is actually present.  ``default=str``
+    catches numpy scalars — a numpy nan stringifies to "nan" there,
+    consistent with the rewrite."""
+    try:
+        return json.dumps(obj, default=str, allow_nan=False)
+    except ValueError:
+        return json.dumps(_finite(obj), default=str, allow_nan=False)
+
+
 class Ledger:
     """Append-only JSONL flight recorder; one instance per run.
 
@@ -125,6 +153,11 @@ class Ledger:
     can be disabled for high-rate callers that only need flush
     semantics; the default is the flight-recorder contract.
     """
+
+    # a recording ledger: surfaces that would pay real work to PREPARE
+    # an emission (round-metric device transfers — ops/round_metrics)
+    # check this instead of emitting into a void
+    active = True
 
     def __init__(self, path: str, argv=None, echo: bool = False,
                  fsync: bool = True):
@@ -157,7 +190,7 @@ class Ledger:
             if k in fields:
                 fields[f"x_{k}"] = fields.pop(k)
         obj.update(fields)
-        line = json.dumps(obj, default=str)
+        line = _dumps(obj)
         try:
             # leading newline: every write SELF-HEALS a torn tail left
             # by any sibling writer killed mid-write on a shared file
@@ -301,6 +334,7 @@ class NullLedger:
 
     path = None
     run_id = None
+    active = False
 
     def event(self, kind, sync=True, **fields):
         pass
@@ -337,6 +371,8 @@ class EchoLedger(NullLedger):
     the file with GOSSIP_TELEMETRY="": the flight-recorder FILE is
     off, but wedge/fallback diagnostics must never go silent (the
     dark-window lesson this layer exists for)."""
+
+    active = True
 
     def event(self, kind, sync=True, **fields):
         obj = {"ev": kind, "ts": round(time.time(), 3)}

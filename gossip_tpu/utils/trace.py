@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import contextlib
 import math
+import os
 import time
 from typing import Iterator, Optional
 
@@ -17,6 +18,15 @@ from typing import Iterator, Optional
 # module unconditionally, and the go-native/native-router paths must
 # stay runnable without ever touching jax (deferred-import pattern of
 # backend.py/cli.py).
+
+PROFILE_ENV = "GOSSIP_PROFILE"
+
+
+def profile_dir() -> Optional[str]:
+    """$GOSSIP_PROFILE — the ambient profiler capture directory, or
+    None (unset/empty = profiling off, the GOSSIP_TELEMETRY
+    convention)."""
+    return os.environ.get(PROFILE_ENV) or None
 
 
 @contextlib.contextmanager
@@ -38,10 +48,42 @@ def trace(logdir: Optional[str]) -> Iterator[None]:
 
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
-    """Named region inside an active trace (host + device timeline)."""
-    import jax
-    with jax.profiler.TraceAnnotation(name):
+    """Named region inside an active trace (host + device timeline);
+    probed via compat so a jax without TraceAnnotation degrades to a
+    plain block instead of crashing the run it was meant to observe."""
+    from gossip_tpu import compat
+    with compat.trace_annotation(name):
         yield
+
+
+@contextlib.contextmanager
+def profile(tag: Optional[str] = None) -> Iterator[None]:
+    """The $GOSSIP_PROFILE hook: capture a jax.profiler trace of the
+    enclosed block into the ambient directory, with an optional named
+    annotation around the whole block.  A no-op (zero jax import) when
+    GOSSIP_PROFILE is unset, and a plain block when this jax lacks the
+    profiler API (compat.profiler_trace_fns probe) — the profiled
+    surfaces (dry-run families, bench legs) wrap unconditionally.
+
+    One capture per ``profile()`` block: jax traces do not nest, so the
+    callers wrap the OUTER program (the dry-run body, one bench leg)
+    and mark inner phases with :func:`annotate`."""
+    logdir = profile_dir()
+    if not logdir:
+        yield
+        return
+    from gossip_tpu import compat
+    fns = compat.profiler_trace_fns()
+    if fns is None:
+        yield
+        return
+    start, stop = fns
+    start(logdir)
+    try:
+        with annotate(tag) if tag else contextlib.nullcontext():
+            yield
+    finally:
+        stop()
 
 
 def aot_timed(jitted, *args):
@@ -102,8 +144,11 @@ def maybe_aot_timed(jitted, timing, *args):
     this is the chokepoint every sharded driver's compile goes
     through, so enabling GOSSIP_COMPILE_CACHE warms them all with no
     per-driver plumbing."""
+    fn_name = getattr(jitted, "__name__", None) or type(jitted).__name__
     if timing is None:
-        return jitted(*args)
+        out = jitted(*args)
+        _emit_round_metrics(out, fn_name)
+        return out
     if timing.get("aot", True) is False:
         out, timing["steady_s"] = steady_timed(jitted, *args)
         timing.setdefault("compile_s", 0.0)
@@ -119,13 +164,31 @@ def maybe_aot_timed(jitted, timing, *args):
     from gossip_tpu.utils import telemetry
     telemetry.current().event(
         "driver_timing", sync=False,
-        fn=getattr(jitted, "__name__", None) or type(jitted).__name__,
+        fn=fn_name,
         cache=timing.get("compile_cache"),
         # walls only: the bool "aot" control flag is an int subclass
         # and must not masquerade as a timing field
         **{k: v for k, v in timing.items()
            if isinstance(v, (int, float)) and not isinstance(v, bool)})
+    _emit_round_metrics(out, fn_name)
     return out
+
+
+def _emit_round_metrics(out, fn_name: str):
+    """The round-metrics flush half of the chokepoint: any
+    :class:`~gossip_tpu.ops.round_metrics.RoundMetrics` stacks an
+    instrumented driver carried through its loop are transferred to the
+    host ONCE here — after the timed region, outside the compiled
+    program — and ledgered as ``round_metrics`` events.  Gated on an
+    ACTIVE ambient ledger so un-ledgered callers pay neither the
+    device-to-host copy nor the ops import (and the go-native paths
+    never touch jax)."""
+    from gossip_tpu.utils import telemetry
+    led = telemetry.current()
+    if not getattr(led, "active", False):
+        return
+    from gossip_tpu.ops import round_metrics
+    round_metrics.emit(out, led, fn=fn_name)
 
 
 class RoundTimer:

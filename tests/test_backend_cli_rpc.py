@@ -407,6 +407,9 @@ def test_cli_sweep_smoke():
         assert abs(line["row_wall_s"] - parts) < 0.05
 
 
+# slow tier (tier-1 wall budget): the diss-override CLI leg;
+# sweep-CLI stays gated via test_cli_grid_ns_one_program
+@pytest.mark.slow
 def test_cli_sweep_swim_diss_override():
     """`sweep --swim-diss` re-measures the SWIM row under an A/B-
     arbitrated lowering without a code change (hw_refresh contract);

@@ -181,3 +181,28 @@ def test_conftest_disarms_tunnel_plugin_for_children():
         capture_output=True, text=True, timeout=60)
     assert p.returncode == 0, p.stderr
     assert p.stdout.split() == ["None", "cpu"], (p.stdout, p.stderr)
+
+
+def test_bench_trend_renders_full_trajectory(capsys):
+    """tools/bench_trend.py: one row per committed BENCH_rNN record,
+    backend recovered even for the pre-backend-field lines (round 2's
+    CPU fallback must NOT render as a TPU number — the masquerade the
+    backend field was added to kill), and a fallback round shows the
+    last committed TPU proof it carried."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_trend", os.path.join(_REPO, "tools", "bench_trend.py"))
+    bt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bt)
+    assert bt.main([]) == 0
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if ln.startswith("| r0")]
+    records = [n for n in os.listdir(_REPO)
+               if n.startswith("BENCH_r") and n.endswith(".json")]
+    assert len(lines) == len(records) >= 5
+    r01 = next(ln for ln in lines if ln.startswith("| r01"))
+    r02 = next(ln for ln in lines if ln.startswith("| r02"))
+    assert "| tpu |" in r01
+    assert "| cpu |" in r02            # the wedged-tunnel fallback
+    r05 = next(ln for ln in lines if ln.startswith("| r05"))
+    assert "hw_refresh_r04.json" in r05   # the last_tpu proof pointer

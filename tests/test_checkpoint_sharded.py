@@ -232,6 +232,9 @@ def test_cli_single_device_checkpoint_curve(tmp_path):
     assert rep["curve"][:3] == first["curve"] and len(rep["curve"]) == 6
 
 
+# slow tier (tier-1 wall budget): legacy-fingerprint depth; resume
+# stays gated via test_cli_sharded_checkpoint_resume_and_curve
+@pytest.mark.slow
 def test_cli_resume_accepts_pre_round4_fingerprint(tmp_path):
     # checkpoints written before the devices/exchange/engine keys existed
     # (all single-device XLA) must still resume: missing keys default
@@ -278,6 +281,9 @@ def _swim_cfg():
     return proto, run, (1,), 2        # dead subjects, fail_round
 
 
+# slow tier (tier-1 wall budget): the rumor twin keeps streaming-
+# vs-checkpointed resume gated
+@pytest.mark.slow
 def test_checkpointed_swim_matches_streaming_and_resumes(tmp_path):
     from gossip_tpu.runtime.simulator import (checkpointed_swim,
                                               simulate_swim_curve)
@@ -431,6 +437,9 @@ def test_cli_swim_checkpoint_resume(tmp_path):
     assert out["msgs"] == ref_out["msgs"]
 
 
+# slow tier (tier-1 wall budget): rumor CLI checkpointing stays
+# gated via test_checkpointed_rumor_matches_streaming_and_resumes
+@pytest.mark.slow
 def test_cli_rumor_checkpoint_carries_extinction(tmp_path):
     ck = str(tmp_path / "ru.npz")
     args = ("run", "--n", "400", "--mode", "rumor", "--family",

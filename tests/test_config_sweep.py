@@ -240,6 +240,9 @@ def test_2d_pod_sweep_with_topology_axis_matches_1d():
 # Mode-partitioned execution (VERDICT r2 item 7).
 
 
+# slow tier (tier-1 wall budget): partitioned-vs-batch stays gated
+# via test_batch_composition_invariance
+@pytest.mark.slow
 def test_partitioned_matches_single_batch_bitwise():
     """Bucketed execution returns the exact trajectories of the one-batch
     run, in the caller's point order (shared k_max, disjoint RNG tags)."""
@@ -384,6 +387,9 @@ def test_n_axis_validation():
             RunConfig(max_rounds=4, origin=255), rumors=2)
 
 
+# slow tier (tier-1 wall budget): the rumor axis stays gated via
+# test_mixed_rumor_batch_composes_with_mixed_n
+@pytest.mark.slow
 def test_mixed_rumor_batch_matches_solo_bitwise():
     """The rumor axis (round 4): points with DIFFERENT rumor counts batch
     into one program by padding R to the max with all-false phantom

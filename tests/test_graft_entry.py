@@ -62,28 +62,10 @@ def test_budget_file_parses_and_covers_every_family():
     assert all(v > 0 for v in warm.values())
 
 
-@pytest.fixture(scope="module")
-def dryrun_pair(tmp_path_factory):
-    """(cold, warm) 4-device dry runs sharing ONE fresh compile-cache
-    dir — the cross-process warm-start proof: process A populates the
-    cache, process B (expect_warm=True: the body ENFORCES the
-    first_warm_ms budgets) must hit it.  4 devices for tier-1 wall
-    budget; the full 8-device shape with the >= 3x acceptance ratio is
-    pinned on the committed r08 record below (a 4-device pair
-    under-reports the win — cold compile grows with the mesh, warm
-    trace cost does not — which is why the LIVE ratio threshold is
-    softer).  Module-scoped so tier-1 pays the pair exactly once; each
-    run keeps its own ledger."""
-    tmp = tmp_path_factory.mktemp("dryrun_cc")
-    cache = str(tmp / "compile_cache")
-    cold_ledger = str(tmp / "cold_ledger.jsonl")
-    warm_ledger = str(tmp / "warm_ledger.jsonl")
-    cold = graft_entry.dryrun_multichip(4, ledger_path=cold_ledger,
-                                        compile_cache_dir=cache)
-    warm = graft_entry.dryrun_multichip(4, ledger_path=warm_ledger,
-                                        compile_cache_dir=cache,
-                                        expect_warm=True)
-    return {"cold": cold, "warm": warm, "cache": cache}
+# The (cold, warm) 4-device dry-run pair is the SESSION-scoped
+# ``dryrun_pair`` fixture in tests/conftest.py since the observability
+# PR: one pair now serves both this module's contract tests and the
+# ledger_diff regression gate (tests/test_ledger_diff.py).
 
 
 def test_dryrun_carries_all_families_and_wall_decomposition(dryrun_pair):
@@ -279,3 +261,76 @@ def test_committed_warmstart_ledger_renders_cache_table():
     for fam in FAMILIES:
         assert fam in table
     assert "**total**" in table
+
+
+def test_committed_r09_record_budgets_hold_with_round_metrics_on():
+    """The observability-PR record (artifacts/ledger_dryrun_r09.jsonl):
+    two 8-device runs captured WITH the device-resident round-metrics
+    plane active.  Pins that (a) the steady budgets and the warm-start
+    acceptance (warm first-call aggregate >= 3x under cold) still hold
+    with metrics on — the committed zero-cost proof — and (b) the
+    driver-level families ledgered their ``round_metrics`` stacks, and
+    the report renders them as the Protocol metrics section."""
+    path = os.path.join(_REPO, "artifacts", "ledger_dryrun_r09.jsonl")
+    all_events = telemetry.load_ledger(path)
+    run_ids = telemetry_report.runs(all_events)
+    assert len(run_ids) == 2
+    cold = [e for e in all_events if e.get("run") == run_ids[0]]
+    warm = [e for e in all_events if e.get("run") == run_ids[1]]
+    for events in (cold, warm):
+        assert events[0]["ev"] == "provenance"
+        assert any(e["ev"] == "runtime" and e["device_count"] == 8
+                   for e in events)
+        assert set(telemetry_report.family_table(events)) == FAMILIES
+        guard = [e for e in events if e["ev"] == "budget_guard"
+                 and "phase" not in e][-1]
+        assert guard["ok"] is True
+        # the driver-level families flushed their round-metric stacks
+        drivers = {e.get("driver") for e in events
+                   if e.get("ev") == "round_metrics"}
+        assert {"simulate_until_sharded_fused",
+                "simulate_curve_sharded_fused"} <= drivers
+        for e in events:
+            if e.get("ev") != "round_metrics":
+                continue
+            assert e["rounds"] == 2 and e["shards"] == 8
+            for series in ("newly", "dup", "msgs", "bytes"):
+                assert len(e[series]) == 2
+            # the zero-ICI claim, checkable per round: the fused plane
+            # drivers' only cross-device traffic is the scalar
+            # coverage reduction
+            assert all(b <= 8.0 for b in e["bytes"])
+    cold_fam = telemetry_report.family_table(cold)
+    warm_fam = telemetry_report.family_table(warm)
+    cold_total = sum(r["first_ms"] for r in cold_fam.values())
+    warm_total = sum(r["first_ms"] for r in warm_fam.values())
+    assert warm_total * 3 <= cold_total
+    wbudgets = graft_entry.dryrun_first_warm_budgets()
+    assert all(warm_fam[f]["first_ms"] <= wbudgets[f] for f in warm_fam)
+    md = telemetry_report.render_markdown(warm)
+    assert "## Protocol metrics" in md
+    assert "simulate_until_sharded_fused" in md
+    # ledger health: the CI --check gate passes on the committed record
+    assert telemetry_report.check_health(cold) == []
+    assert telemetry_report.check_health(warm) == []
+
+
+def test_committed_r09_4dev_record_matches_live_pair_shape(dryrun_pair):
+    """The 4-device committed record exists for ledger_diff's
+    like-for-like tier-1 gate (tests/test_ledger_diff.py): same family
+    set and device count as the live dryrun_pair, warm run all-hit."""
+    path = os.path.join(_REPO, "artifacts",
+                        "ledger_dryrun_r09_4dev.jsonl")
+    all_events = telemetry.load_ledger(path)
+    run_ids = telemetry_report.runs(all_events)
+    assert len(run_ids) == 2
+    warm = [e for e in all_events if e.get("run") == run_ids[1]]
+    assert any(e["ev"] == "runtime" and e["device_count"] == 4
+               for e in warm)
+    assert set(telemetry_report.family_table(warm)) == FAMILIES
+    assert all(e["cache"] == "hit" for e in warm
+               if e.get("ev") == "compile"
+               and e.get("phase") == "first_ms")
+    live = telemetry.load_ledger(dryrun_pair["warm"]["ledger_path"],
+                                 run="last")
+    assert set(telemetry_report.family_table(live)) == FAMILIES

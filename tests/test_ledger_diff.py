@@ -1,0 +1,260 @@
+"""tools/ledger_diff: the cross-run regression gate — verdict-aware
+wall joins, threshold+floor flagging, protocol-total drift detection,
+and the tier-1 gate runs: the committed 4-device record vs the live
+dryrun_pair, plus an artificially injected 2x wall regression that
+MUST be flagged."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from gossip_tpu.utils import telemetry
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "ledger_diff", os.path.join(_REPO, "tools", "ledger_diff.py"))
+ledger_diff = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(ledger_diff)
+
+R09_4DEV = os.path.join(_REPO, "artifacts",
+                        "ledger_dryrun_r09_4dev.jsonl")
+R09_8DEV = os.path.join(_REPO, "artifacts", "ledger_dryrun_r09.jsonl")
+
+
+def _write_run(path, families, device_count=4, metrics=None,
+               verdict="hit"):
+    """A minimal synthetic dry-run ledger run: provenance, runtime,
+    family + first_ms compile events, optional round_metrics."""
+    with telemetry.Ledger(path) as led:
+        led.event("runtime", backend="cpu", device_count=device_count)
+        for fam, row in families.items():
+            led.event("family", family=fam, **row)
+            led.event("compile", family=fam, phase="first_ms",
+                      cache=verdict)
+        for drv, totals in (metrics or {}).items():
+            led.event("round_metrics", driver=drv, fn="scan", rounds=4,
+                      shards=device_count, newly=[1.0], dup=[0.0],
+                      msgs=[10.0], bytes=[64.0], front=[[1.0]],
+                      totals=totals, front_final=[1.0])
+
+
+BASE = {"dense_pushpull": {"first_ms": 600.0, "steady_ms": 4.0},
+        "sparse_antientropy": {"first_ms": 900.0, "steady_ms": 7.0}}
+MET = {"simulate_until_sharded_fused":
+       {"newly": 254.0, "dup": 1000.0, "msgs": 4096.0, "bytes": 8.0}}
+
+
+def test_identical_runs_diff_clean(tmp_path, capsys):
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    _write_run(a, BASE, metrics=MET)
+    _write_run(b, BASE, metrics=MET)
+    rc = ledger_diff.main([a, b])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Verdict: clean" in out
+    assert "REGRESSED" not in out
+
+
+def test_injected_2x_wall_regression_is_flagged(tmp_path, capsys):
+    """The acceptance case: ONE family's walls doubled against a
+    steady pack must trip the gate.  A code regression is
+    family-shaped, so the pair's median drift stays 1.0 and the full
+    2x survives calibration; the first_ms delta clears the absolute
+    floor."""
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    pack = {f"fam{i}": {"first_ms": 500.0 + 40 * i, "steady_ms": 4.0}
+            for i in range(4)}
+    pack["dense_pushpull"] = {"first_ms": 600.0, "steady_ms": 4.0}
+    _write_run(a, pack, metrics=MET)
+    injected = {f: dict(row) for f, row in pack.items()}
+    injected["dense_pushpull"] = {
+        k: 2 * v for k, v in pack["dense_pushpull"].items()}
+    _write_run(b, injected, metrics=MET)
+    rc = ledger_diff.main([a, b])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "dense_pushpull first_ms regressed" in out
+    # the small steady walls (4 -> 8 ms) stay under the 50 ms floor:
+    # CPU-noise-sized deltas never gate, whatever their ratio
+    assert "steady_ms regressed" not in out
+
+
+def test_uniform_host_drift_is_calibrated_out(tmp_path, capsys):
+    """The flake that motivated calibration: EVERY wall inflated 2x
+    uniformly (a dry run at the tail of a loaded CI session) must NOT
+    gate — the pair's median drift absorbs it — and the report states
+    the drift it divided out."""
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    _write_run(a, BASE, metrics=MET)
+    doubled = {f: {k: 2 * v for k, v in row.items()}
+               for f, row in BASE.items()}
+    _write_run(b, doubled, metrics=MET)
+    rc = ledger_diff.main([a, b])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Verdict: clean" in out
+    assert "median drift" in out and "2.00x" in out
+
+
+def test_verdict_mismatch_skips_first_ms(tmp_path, capsys):
+    """Cold-vs-warm must not read as a regression: a verdict mismatch
+    reports a join note instead of comparing first_ms."""
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    _write_run(a, BASE, verdict="hit")
+    slow = {f: {"first_ms": 10 * row["first_ms"],
+                "steady_ms": row["steady_ms"]}
+            for f, row in BASE.items()}
+    _write_run(b, slow, verdict="miss")
+    rc = ledger_diff.main([a, b])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "first_ms not compared" in out
+
+
+def test_metric_drift_flags_only_at_same_device_count(tmp_path,
+                                                      capsys):
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    c = str(tmp_path / "c.jsonl")
+    drifted = {"simulate_until_sharded_fused":
+               {**MET["simulate_until_sharded_fused"], "msgs": 5000.0}}
+    _write_run(a, BASE, metrics=MET, device_count=4)
+    _write_run(b, BASE, metrics=drifted, device_count=4)
+    rc = ledger_diff.main([a, b])
+    assert rc == 1
+    assert "msgs drifted" in capsys.readouterr().out
+    # same drift across DIFFERENT device counts: informational only
+    _write_run(c, BASE, metrics=drifted, device_count=8)
+    rc = ledger_diff.main([a, c])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "device counts differ" in out
+
+
+def test_over_budget_new_run_is_flagged(tmp_path, capsys):
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    _write_run(a, BASE)
+    over = dict(BASE)
+    over["dense_pushpull"] = {"first_ms": 600.0, "steady_ms": 151.0}
+    _write_run(b, over)
+    rc = ledger_diff.main([a, b])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "over budget" in out
+
+
+def test_lone_family_regression_cannot_self_calibrate(tmp_path,
+                                                      capsys):
+    """Leave-one-out drift: a family is judged against its PEERS'
+    median, so a regression with no (or few) comparable peers cannot
+    absorb its own signal — one family regressing 10x must flag even
+    though the pair-wide median ratio IS 10x (and even without a
+    budget-table backstop: the family name is off the budget table)."""
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    solo = {"solo_fam": {"first_ms": 600.0, "steady_ms": 4.0}}
+    _write_run(a, solo)
+    _write_run(b, {"solo_fam": {"first_ms": 6000.0, "steady_ms": 4.0}})
+    rc = ledger_diff.main([a, b])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "solo_fam first_ms regressed" in out
+
+
+def test_unknown_run_id_errors_instead_of_clean(tmp_path):
+    """A typo'd --run-new id must ERROR, never diff an empty run and
+    exit 0 — this tool is a CI gate."""
+    a = str(tmp_path / "a.jsonl")
+    _write_run(a, BASE)
+    with pytest.raises(SystemExit, match="not in"):
+        ledger_diff.main([a, a, "--run-new", "no_such_run"])
+
+
+def test_repeated_driver_labels_keep_every_invocation(tmp_path,
+                                                      capsys):
+    """Two dry-run families share one driver label (the fused plain and
+    fault-curve families both flush ``simulate_*_sharded_fused``): the
+    join keys them by invocation order (``#k``), so a drift in the
+    FIRST invocation's totals is flagged, not silently overwritten by
+    the second."""
+    def write(path, first_msgs):
+        with telemetry.Ledger(path) as led:
+            led.event("runtime", backend="cpu", device_count=4)
+            led.event("family", family="f", steady_ms=4.0)
+            led.event("compile", family="f", phase="first_ms",
+                      cache="hit")
+            for msgs in (first_msgs, 4096.0):
+                led.event("round_metrics", driver="shared_drv",
+                          fn="scan", rounds=2, shards=4, newly=[1.0],
+                          dup=[0.0], msgs=[msgs], bytes=[8.0],
+                          front=[[1.0]], totals={"msgs": msgs},
+                          front_final=[1.0])
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    write(a, 1000.0)
+    write(b, 2000.0)                       # only invocation #0 drifts
+    rc = ledger_diff.main([a, b])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "shared_drv#0" in out and "shared_drv#1" in out
+    assert "round_metrics[shared_drv#0].msgs drifted" in out
+
+
+# -- the committed-record gates (tier-1 acceptance) -------------------
+
+def test_committed_4dev_record_vs_fresh_dryrun_is_clean(dryrun_pair,
+                                                        capsys):
+    """THE regression gate: the committed 4-device warm record diffed
+    against this session's live warm dry run (same device count, same
+    machine class) must come back clean — walls within threshold+floor,
+    budgets held, protocol totals compared at equal device count."""
+    rc = ledger_diff.main([R09_4DEV,
+                           dryrun_pair["warm"]["ledger_path"]])
+    out = capsys.readouterr().out
+    assert rc == 0, f"ledger_diff flagged a fresh dry run:\n{out}"
+    assert "Verdict: clean" in out
+    # the metric join actually engaged (same device count, fused
+    # drivers instrumented in both)
+    assert "simulate_until_sharded_fused" in out
+
+
+def test_committed_record_with_injected_2x_wall_is_flagged(tmp_path,
+                                                           capsys):
+    """The committed record with ONE family's walls doubled (a
+    faithful in-place edit of its own `family` events) must trip the
+    gate — a family-shaped 2x on real data survives the median-drift
+    calibration that forgives uniform host load, proving the
+    thresholds catch a real regression, not just synthetic
+    fixtures."""
+    events = telemetry.load_ledger(R09_4DEV)
+    runs = [e["run"] for e in events if e.get("ev") == "provenance"]
+    warm = runs[-1]
+    doubled = str(tmp_path / "doubled.jsonl")
+    with open(R09_4DEV) as f, open(doubled, "w") as g:
+        for line in f:
+            if not line.strip():
+                continue
+            e = json.loads(line)
+            if (e.get("ev") == "family" and e.get("run") == warm
+                    and e.get("family") == "swim_rotating"):
+                for k in ("first_ms", "steady_ms"):
+                    if isinstance(e.get(k), (int, float)):
+                        e[k] = 2 * e[k]
+            g.write(json.dumps(e) + "\n")
+    rc = ledger_diff.main([R09_4DEV, doubled])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "swim_rotating first_ms regressed" in out
+
+
+def test_committed_r09_cold_vs_warm_self_diff_is_clean(capsys):
+    """Within the committed 8-device record, cold run vs warm run:
+    the verdict-aware join refuses the cold-vs-warm first_ms
+    comparison (miss vs hit) and the steady walls agree — the
+    committed artifact demonstrates the join semantics by itself."""
+    rc = ledger_diff.main([R09_8DEV, R09_8DEV, "--run-old", "first",
+                           "--run-new", "last"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "first_ms not compared" in out
+    assert "Verdict: clean" in out

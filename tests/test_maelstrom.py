@@ -143,6 +143,9 @@ def test_partition_tolerance_retry_heals():
     run(main())
 
 
+# slow tier (tier-1 wall budget): the broadcast invariant stays
+# gated via test_grid_topology_propagation + interval batching
+@pytest.mark.slow
 def test_broadcast_workload_stats_and_invariant():
     """The in-repo Maelstrom 'broadcast' workload: random-node ops at a
     rate, quiesce, per-node reads — the checker invariant plus the

@@ -35,6 +35,9 @@ def test_hybrid_mesh_shapes_and_errors():
         make_hybrid_mesh(0, 4)
 
 
+# slow tier (tier-1 wall budget): hybrid-mesh 2-D equivalence also
+# runs in test_config_sweep's gated 2d_pod_sweep[complete] path
+@pytest.mark.slow
 def test_hybrid_mesh_runs_2d_sweep_identically():
     # the 2-D pod sweep on a hybrid mesh must reproduce the unsharded
     # batch exactly (config_sweep_curves_2d's mesh-invariance contract)

@@ -1,0 +1,196 @@
+"""Device-resident round metrics (ops/round_metrics): buffer contract,
+chokepoint flush, and the two load-bearing invariants — metrics change
+NO trajectory bit, and a while_loop that exits early reports exactly
+the rounds it ran."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gossip_tpu import config as C
+from gossip_tpu.config import ProtocolConfig, RunConfig
+from gossip_tpu.ops import round_metrics as RM
+from gossip_tpu.topology import generators as G
+from gossip_tpu.utils import telemetry
+
+
+def test_record_under_jit_and_cursor_clamp():
+    m = RM.init(3, 2, "unit")
+
+    @jax.jit
+    def f(m):
+        for r in range(5):      # two writes past the end: clamped
+            m = RM.record(m, newly=r, dup=0, msgs=1, bytes=8,
+                          front=jnp.array([0.1, 0.2]))
+        return m
+    out = f(m)
+    assert int(out.cursor) == 5
+    # rows 0..2 written in order, the overflow writes land on the last
+    # row (never out of bounds)
+    assert np.asarray(out.newly).tolist() == [0.0, 1.0, 4.0]
+
+
+def test_init_validates():
+    with pytest.raises(ValueError):
+        RM.init(0, 1, "x")
+    with pytest.raises(ValueError):
+        RM.init(4, 0, "x")
+
+
+def test_counter_helpers_match_numpy():
+    rng = np.random.RandomState(0)
+    seen = rng.rand(16, 3) < 0.4
+    alive = rng.rand(16) < 0.8
+    got = float(RM.count_bool(jnp.asarray(seen), jnp.asarray(alive)))
+    assert got == float((seen & alive[:, None]).sum())
+    front = np.asarray(RM.front_bool(jnp.asarray(seen),
+                                     jnp.asarray(alive), 4))
+    for s in range(4):
+        rows = slice(4 * s, 4 * s + 4)
+        cov = (seen[rows].any(1) & alive[rows]).sum()
+        tot = max(alive[rows].sum(), 1)
+        assert front[s] == pytest.approx(cov / tot)
+
+
+def test_gate_on_exchange_rounds_matches_kernel_predicate():
+    """The ONE quiescent-round gate every recorder shares: full value
+    on exchange rounds (round % period == 0), ``off`` otherwise,
+    untouched at period <= 1."""
+    g = RM.gate_on_exchange_rounds
+    assert float(g(10.0, 1, jnp.int32(1))) == 10.0
+    assert float(g(10.0, 3, jnp.int32(0))) == 10.0
+    assert float(g(10.0, 3, jnp.int32(3))) == 10.0
+    assert float(g(10.0, 3, jnp.int32(1))) == 0.0
+    assert float(g(10.0, 3, jnp.int32(2), off=4.0)) == 4.0
+
+
+def test_payload_factor_covers_every_si_mode():
+    assert RM.payload_factor(C.PUSH) == 1.0
+    assert RM.payload_factor(C.PULL) == 0.5
+    assert RM.payload_factor(C.PUSH_PULL) == pytest.approx(2 / 3)
+    assert RM.payload_factor(C.ANTI_ENTROPY) == pytest.approx(2 / 3)
+    assert RM.payload_factor(C.FLOOD) == 1.0
+    # dup can never go negative, whatever the estimator feeds it
+    assert float(RM.dup_estimate(3.0, 10.0)) == 0.0
+
+
+def test_wanted_requires_env_and_active_ledger(tmp_path, monkeypatch):
+    monkeypatch.delenv(RM.ENV_VAR, raising=False)
+    assert RM.enabled()                      # default on
+    monkeypatch.setenv(RM.ENV_VAR, "0")
+    assert not RM.enabled() and not RM.wanted()
+    monkeypatch.delenv(RM.ENV_VAR, raising=False)
+    # env on but no active ledger: buffers would be dead weight
+    assert not RM.wanted()
+    led = telemetry.Ledger(str(tmp_path / "l.jsonl"))
+    prev = telemetry.activate(led)
+    try:
+        assert RM.wanted()
+    finally:
+        telemetry.activate(prev)
+        led.close()
+
+
+@pytest.fixture
+def mesh8():
+    from gossip_tpu.parallel.sharded import make_mesh
+    return make_mesh(8)
+
+
+def _dense_curve(mesh, max_rounds=6):
+    from gossip_tpu.parallel.sharded import simulate_curve_sharded
+    topo = G.complete(64)
+    proto = ProtocolConfig(mode=C.PUSH_PULL, fanout=2, rumors=2)
+    run = RunConfig(seed=0, max_rounds=max_rounds, target_coverage=0.99)
+    return proto, simulate_curve_sharded(proto, topo, run, mesh)
+
+
+def test_metrics_change_no_trajectory_bit_and_flush_once(tmp_path,
+                                                         mesh8):
+    """THE invariant: the instrumented loop's public outputs are
+    bitwise the un-instrumented loop's (metrics consume no RNG and
+    mask nothing), and the flush is one ledger event per driver call
+    with internally consistent series."""
+    proto, (covs0, msgs0, _) = _dense_curve(mesh8)
+
+    led = telemetry.Ledger(str(tmp_path / "led.jsonl"))
+    prev = telemetry.activate(led)
+    try:
+        _, (covs1, msgs1, final) = proto, _dense_curve(mesh8)[1]
+    finally:
+        telemetry.activate(prev)
+        led.close()
+    assert np.array_equal(covs0, covs1)
+    assert np.array_equal(msgs0, msgs1)
+
+    events = telemetry.load_ledger(led.path)
+    rms = [e for e in events if e["ev"] == "round_metrics"]
+    assert len(rms) == 1                    # once per driver call
+    e = rms[0]
+    assert e["driver"] == "simulate_curve_sharded"
+    assert e["rounds"] == 6 and e["shards"] == 8
+    for series in ("newly", "dup", "msgs", "bytes"):
+        assert len(e[series]) == 6
+    assert len(e["front"]) == 6 and len(e["front"][0]) == 8
+    # conservation: newly sums to the entries the run actually set
+    # (n=64 divides the mesh, no fault -> every row alive; the run
+    # starts with exactly R origin entries)
+    final_entries = int(np.asarray(final.seen).sum())
+    assert e["totals"]["newly"] == final_entries - proto.rumors
+    # msgs series telescopes to the driver's own cumulative counter
+    assert e["totals"]["msgs"] == pytest.approx(float(msgs1[-1]))
+    # the coverage front ends where the coverage curve ends
+    assert e["front_final"] == [pytest.approx(1.0)] * 8
+
+
+def test_until_driver_truncates_to_rounds_run(tmp_path, mesh8):
+    """A while_loop that converges early reports exactly the rounds it
+    executed — the preallocated tail rows stay unreported."""
+    from gossip_tpu.parallel.sharded import simulate_until_sharded
+    topo = G.complete(64)
+    proto = ProtocolConfig(mode=C.PUSH_PULL, fanout=2, rumors=2)
+    run = RunConfig(seed=0, max_rounds=50, target_coverage=0.99)
+    led = telemetry.Ledger(str(tmp_path / "led.jsonl"))
+    prev = telemetry.activate(led)
+    try:
+        rounds, cov, msgs, _ = simulate_until_sharded(proto, topo, run,
+                                                      mesh8)
+    finally:
+        telemetry.activate(prev)
+        led.close()
+    assert rounds < 50 and cov >= 0.99
+    e = [x for x in telemetry.load_ledger(led.path)
+         if x["ev"] == "round_metrics"][0]
+    assert e["driver"] == "simulate_until_sharded"
+    assert e["rounds"] == rounds
+    assert len(e["newly"]) == rounds
+    assert e["totals"]["msgs"] == pytest.approx(msgs)
+
+
+def test_aot_path_emits_metrics_with_fn_name(tmp_path, mesh8):
+    """The timing= (AOT chokepoint) path flushes the same stack and
+    names the jitted fn — the dry run's fused rows rely on exactly
+    this wiring."""
+    from gossip_tpu.parallel.sharded import simulate_curve_sharded
+    topo = G.complete(64)
+    proto = ProtocolConfig(mode=C.PULL, fanout=1, rumors=2)
+    run = RunConfig(seed=0, max_rounds=4)
+    led = telemetry.Ledger(str(tmp_path / "led.jsonl"))
+    prev = telemetry.activate(led)
+    timing = {}
+    try:
+        simulate_curve_sharded(proto, topo, run, mesh8, timing=timing)
+    finally:
+        telemetry.activate(prev)
+        led.close()
+    assert "steady_s" in timing             # the AOT split still fills
+    events = telemetry.load_ledger(led.path)
+    e = [x for x in events if x["ev"] == "round_metrics"][0]
+    assert e["fn"] == "scan"
+    # pull: 2 messages per request, half carry payload — dup plus
+    # newly accounts for every offered entry (estimator arithmetic)
+    for dup, newly, msgs in zip(e["dup"], e["newly"], e["msgs"]):
+        offered = proto.rumors * RM.payload_factor(C.PULL) * msgs
+        assert dup == pytest.approx(max(offered - newly, 0.0), abs=0.1)

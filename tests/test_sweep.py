@@ -49,6 +49,9 @@ def test_ensemble_with_faults_some_may_stall():
     assert set(ens.rounds_to_target) <= {-1} | set(range(1, 9))
 
 
+# slow tier (tier-1 wall budget): ensemble parity stays gated via
+# test_ensemble_matches_individual_runs
+@pytest.mark.slow
 def test_ensemble_swim_matches_solo_curves_bitwise():
     """Round 4: the SWIM seed ensemble (detection-latency distribution
     for one failure scenario).  Every lane must equal the solo curve
@@ -76,6 +79,9 @@ def test_ensemble_swim_matches_solo_curves_bitwise():
     assert (ens.rounds_to_target > 0).all()     # every seed detected
 
 
+# slow tier (tier-1 wall budget): seed-axis sharding invariance
+# stays gated via test_sweep_axis_sharding_is_value_invariant
+@pytest.mark.slow
 def test_ensemble_seed_axis_mesh_is_value_invariant():
     """Round 4: the ensembles shard their SEED axis over a 1-D mesh —
     values never change (embarrassingly parallel), for SI, SWIM, and

@@ -307,3 +307,115 @@ def test_device_memory_stats_shape():
     stats = telemetry.device_memory_stats()
     assert stats is None or (isinstance(stats, list) and stats
                              and "device" in stats[0])
+
+
+def test_shared_writer_midfile_tear_is_dropped_not_fatal(tmp_path):
+    """The SHARED-file crash shape end to end: writer A is killed
+    mid-write (its fragment has no newline), writer B then appends a
+    whole run.  B's leading-newline self-heal keeps the fragment its
+    own line; the default loader drops exactly that line and keeps
+    EVERY event on both sides of it — a mid-file tear, unlike the
+    single-writer tail tear, so strict mode refuses the file."""
+    p = str(tmp_path / "shared.jsonl")
+    with telemetry.Ledger(p) as a:
+        a.event("step", n=1)
+    with open(p, "a") as f:
+        f.write('{"ev": "step", "n": 2, "half_writ')   # killed writer
+    with telemetry.Ledger(p) as b:
+        b.event("step", n=3)
+        b.event("step", n=4)
+    events = telemetry.load_ledger(p)
+    assert [e["n"] for e in events if e["ev"] == "step"] == [1, 3, 4]
+    # both runs' provenance survived around the tear
+    assert [e["ev"] for e in events].count("provenance") == 2
+    with pytest.raises(ValueError, match="corrupt"):
+        telemetry.load_ledger(p, strict=True)
+
+
+def test_non_finite_values_stay_strict_json(tmp_path):
+    """A poisoned gauge/counter value (nan/inf — a diverged measurement
+    upstream) must record the poisoning WITHOUT breaking the file for
+    strict-JSON consumers: Python's json would happily write NaN
+    literals that jq and every non-Python reader reject."""
+    import json as _json
+    import math
+    p = str(tmp_path / "led.jsonl")
+    with telemetry.Ledger(p) as led:
+        led.gauge("bad_rate", float("nan"))
+        led.gauge("worse_rate", float("inf"))
+        led.event("probe", wall_s=float("-inf"),
+                  nested={"deep": float("nan"), "fine": 1.5})
+        led.gauge("fine", 0.25)
+    # every line parses under STRICT json (NaN/Infinity literals raise)
+    def no_constants(s):
+        raise ValueError(f"non-strict JSON constant {s!r}")
+    with open(p) as f:
+        rows = [_json.loads(ln, parse_constant=no_constants)
+                for ln in f if ln.strip()]
+    gauges = {r["name"]: r["value"] for r in rows if r["ev"] == "gauge"}
+    assert gauges == {"bad_rate": "nan", "worse_rate": "inf",
+                      "fine": 0.25}
+    probe = next(r for r in rows if r["ev"] == "probe")
+    assert probe["wall_s"] == "-inf"
+    assert probe["nested"] == {"deep": "nan", "fine": 1.5}
+    # and the crash-contract loader reads them back the same way
+    evs = telemetry.load_ledger(p)
+    assert any(e.get("value") == "nan" for e in evs)
+    assert not any(isinstance(e.get("value"), float)
+                   and math.isnan(e["value"]) for e in evs)
+
+
+def _load_report_tool():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report", os.path.join(_REPO, "tools",
+                                         "telemetry_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_report_check_gate_health(tmp_path, capsys):
+    """telemetry_report --check: exit 0 on a healthy ledger, exit 1
+    naming the problem on an unclosed span or a missing provenance
+    line — the CI hook for ledger health."""
+    report = _load_report_tool()
+    good = str(tmp_path / "good.jsonl")
+    with telemetry.Ledger(good) as led:
+        with led.span("fine"):
+            pass
+    assert report.main([good, "--check"]) == 0
+
+    # a run killed inside a span: span_start durable, no span_end
+    wedged = str(tmp_path / "wedged.jsonl")
+    led = telemetry.Ledger(wedged)
+    cm = led.span("doomed_family")
+    cm.__enter__()                         # never exited: the kill
+    led.close()
+    assert report.main([wedged, "--check"]) == 1
+    err = capsys.readouterr().err
+    assert "unclosed span" in err and "doomed_family" in err
+
+    # an unknown explicit --run id is an ERROR, not an empty selection
+    # misdiagnosed as "no provenance" (the ledger_diff convention)
+    with pytest.raises(SystemExit, match="not in"):
+        report.main([good, "--check", "--run", "no_such_run"])
+
+    # no provenance at all (hand-rolled pre-ledger file)
+    bare = str(tmp_path / "bare.jsonl")
+    with open(bare, "w") as f:
+        f.write('{"ev": "probe", "outcome": "ok"}\n')
+    assert report.main([bare, "--check"]) == 1
+    assert "no provenance" in capsys.readouterr().err
+
+    # --all-runs checks every run in a shared file
+    shared = str(tmp_path / "shared.jsonl")
+    with telemetry.Ledger(shared) as led:
+        with led.span("ok_span"):
+            pass
+    led2 = telemetry.Ledger(shared)
+    cm = led2.span("dead_run_span")
+    cm.__enter__()
+    led2.close()
+    assert report.main([shared, "--all-runs", "--check"]) == 1
+    assert "dead_run_span" in capsys.readouterr().err

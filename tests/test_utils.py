@@ -172,12 +172,33 @@ def test_round_timer_percentiles():
         t.percentile_ms(1.5)
 
 
-def test_trace_smoke(tmp_path):
-    with trace(str(tmp_path / "prof")):
+def test_trace_smoke(tmp_path, monkeypatch):
+    """One real profiler capture smokes the whole observability
+    surface: the $GOSSIP_PROFILE ambient hook (trace.profile — what
+    the dry run and bench wrap), a named annotation inside it, and the
+    compat probes it degrades through.  trace(logdir) shares the same
+    jax.profiler machinery (its CLI path runs under `-m slow`)."""
+    from gossip_tpu import compat
+    from gossip_tpu.utils.trace import profile, profile_dir
+    prof = str(tmp_path / "prof")
+    monkeypatch.setenv("GOSSIP_PROFILE", prof)
+    assert profile_dir() == prof
+    assert compat.profiler_trace_fns() is not None   # this jax has it
+    with profile("smoke"):
         with annotate("round"):
             jax.block_until_ready(jax.numpy.arange(8) * 2)
-    # trace files land under the logdir
-    assert any(os.scandir(str(tmp_path / "prof")))
+    # trace files land under the ambient dir
+    assert any(os.scandir(prof))
+    # unset/empty = strictly off (the GOSSIP_TELEMETRY convention):
+    # the profiler probe must never even be consulted
+    monkeypatch.setenv("GOSSIP_PROFILE", "")
+    assert profile_dir() is None
+
+    def _probed():
+        raise AssertionError("profiler probed while GOSSIP_PROFILE off")
+    monkeypatch.setattr(compat, "profiler_trace_fns", _probed)
+    with profile("dark"):
+        pass
     t = RoundTimer()
     for _ in range(2):
         with t:
@@ -235,3 +256,16 @@ def test_run_with_checkpoints_named_curve_channels(tmp_path):
     assert set(curve3) == {"coverage", "msgs"}
     assert curve3 == {"coverage": [], "msgs": []}
 
+
+
+def test_tier1_wall_warning_predicate():
+    """tests/conftest.py's 90%-of-gate warning threshold, unit-tested
+    without an 800 s session (the sweep_cache_eviction pattern)."""
+    import conftest
+    assert conftest.tier1_wall_warning(700.0) is None
+    assert conftest.tier1_wall_warning(783.0 - 1e-6) is None
+    msg = conftest.tier1_wall_warning(800.0)
+    assert msg and "rebalance" in msg and "870" in msg
+    # scales with the gate, not hardcoded to it
+    assert conftest.tier1_wall_warning(80.0, gate_s=100.0,
+                                       frac=0.5) is not None

@@ -97,3 +97,27 @@ def test_validate_dir_and_main(tmp_path):
     assert va.main([str(tmp_path)]) == 1
     os.remove(tmp_path / "bad_r99.json")
     assert va.main([str(tmp_path)]) == 0
+
+
+def test_round_metrics_artifacts_must_be_attributable(tmp_path):
+    """A jsonl carrying ``round_metrics`` events (ops/round_metrics)
+    without provenance fails EVEN under a legacy-allowlisted name —
+    round metrics post-date the ledger, so the allowlist can never
+    grandfather one in."""
+    rm_line = json.dumps({"ev": "round_metrics", "driver": "x",
+                          "rounds": 2, "totals": {"msgs": 4.0}})
+    # legacy-NAMED file smuggling round metrics: still flagged
+    legacy_name = sorted(va.LEGACY)[0].replace(".json", ".jsonl") \
+        if not sorted(va.LEGACY)[0].endswith(".jsonl") \
+        else sorted(va.LEGACY)[0]
+    smuggled = tmp_path / legacy_name
+    smuggled.write_text(rm_line + "\n")
+    problems = va.validate_file(str(smuggled))
+    assert any("round_metrics" in p for p in problems), problems
+
+    # a proper ledger-written file with metrics passes
+    good = tmp_path / "ledger_metrics_r99.jsonl"
+    with telemetry.Ledger(str(good)) as led:
+        led.event("round_metrics", driver="x", rounds=2,
+                  totals={"msgs": 4.0})
+    assert va.validate_file(str(good)) == []

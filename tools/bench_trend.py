@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Render the BENCH_r01..rNN scoreboard trajectory as a markdown table.
+
+The per-round bench records (``BENCH_rNN.json`` at the repo root: the
+driver's capture of ``python bench.py`` — cmd, rc, tail, parsed line)
+are the only longitudinal record of the headline metric, and until this
+tool the trajectory lived ONLY in unrendered JSON: reading how the
+number moved across rounds meant opening five files and mentally
+joining five schemas (the measurement line grew ``backend``,
+``last_tpu`` and ``compile_split`` fields over time).
+
+    python tools/bench_trend.py            # repo-root BENCH_r*.json
+    python tools/bench_trend.py DIR        # any directory
+
+One row per record, lexicographic round order.  A CPU-fallback round
+renders its own (honest, fallback-tagged) number AND the ``last_tpu``
+pointer it carried, so the table shows both what ran and what the
+newest committed TPU proof was at that time — the scoreboard-integrity
+rule of bench.py's measurement_line: a fallback can hide the live
+number but never the proof.  Paste the output into docs/PERF.md
+("Bench trajectory").
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_records(root=REPO):
+    """[(round_tag, parsed_line)] for every BENCH_r*.json in ``root``,
+    lexicographic (r01 < r02 < ...) order.  Records whose ``parsed``
+    line is missing render as failed rounds rather than vanishing —
+    a dark round must stay visible in the trajectory."""
+    rows = []
+    for name in sorted(os.listdir(root)):
+        if not (name.startswith("BENCH_r") and name.endswith(".json")):
+            continue
+        tag = name[len("BENCH_"):-len(".json")]
+        try:
+            with open(os.path.join(root, name)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            rows.append((tag, None))
+            continue
+        rows.append((tag, rec.get("parsed")))
+    return rows
+
+
+def _human_rate(v):
+    if v is None:
+        return "—"
+    if v >= 1e9:
+        return f"{v / 1e9:.2f}B"
+    if v >= 1e6:
+        return f"{v / 1e6:.1f}M"
+    return f"{v:,.0f}"
+
+
+def render(rows):
+    """The trajectory as markdown lines."""
+    out = ["| round | backend | node-rounds/s/chip | vs_baseline "
+           "| compile cold/warm (s) | last committed TPU proof |",
+           "|---|---|---|---|---|---|"]
+    for tag, line in rows:
+        if not line:
+            out.append(f"| {tag} | — | *(record unparsable)* | — | — "
+                       "| — |")
+            continue
+        backend = line.get("backend")
+        if backend is None:
+            # the r01/r02-era line had no backend FIELD, but the unit
+            # string always carried "backend=..." — recover it from
+            # there, never from vs_baseline (round 2's wedged-tunnel
+            # CPU fallback published vs_baseline 0.21x, the exact
+            # masquerade the backend field was added to kill)
+            unit = line.get("unit", "")
+            if "backend=" in unit:
+                backend = unit.split("backend=")[-1].rstrip(")")
+        vsb = line.get("vs_baseline")
+        split = line.get("compile_split") or {}
+        cold, warm = split.get("cold_s"), split.get("warm_s")
+        split_s = (f"{cold:.1f} / {warm:.1f}"
+                   if cold is not None and warm is not None
+                   else f"{cold:.1f} / —" if cold is not None else "—")
+        lt = line.get("last_tpu") or {}
+        proof = (f"{_human_rate(lt.get('value'))} "
+                 f"({lt.get('vs_baseline')}x, `{lt.get('artifact')}`)"
+                 if lt.get("value") is not None else "—")
+        out.append(
+            f"| {tag} | {backend or '—'} "
+            f"| {_human_rate(line.get('value'))} "
+            f"| {vsb if vsb is not None else '—'} "
+            f"| {split_s} | {proof} |")
+    return out
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else REPO
+    rows = load_records(root)
+    if not rows:
+        print(f"no BENCH_r*.json records in {root}", file=sys.stderr)
+        return 1
+    print("\n".join(render(rows)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

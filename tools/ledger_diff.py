@@ -1,0 +1,439 @@
+#!/usr/bin/env python
+"""Join two run ledgers and report wall / budget / round-metric deltas
+— the mechanical cross-run regression gate.
+
+Before this tool, cross-run regressions were caught only by the
+hand-tuned absolute budget tables (tools/dryrun_budgets.json): a family
+could triple its wall and still sit under a generous budget, and two
+committed records could only be compared by eyeballing two markdown
+renders.  This tool joins two ledgers the way the data says they join —
+by FAMILY, PHASE, and COMPILE VERDICT — and flags what actually moved:
+
+  * **walls** — per-family ``steady_ms`` and ``first_ms`` ratios,
+    CALIBRATED by the run-pair's median drift: the per-kind
+    leave-one-out median of the comparable families' new/old ratios
+    (each family is judged against its PEERS' median, clamped to
+    >= 1, so its own regression never calibrates itself away — even
+    with one comparable family) is divided out before thresholding,
+    so a loaded host that inflates EVERY wall ~2x uniformly — exactly
+    what a dry run at the tail of a 12-minute CI session measures —
+    never gates, while one family that moves 1.8x beyond the pack
+    always does (a code regression is family-shaped; host load is
+    uniform).  A wall is then flagged only
+    when BOTH the calibrated ratio threshold and an absolute floor are
+    exceeded (small CPU walls are noisy; a 3 ms -> 7 ms jitter must not
+    gate a PR, a 2x jump on a half-second compile must).  ``first_ms``
+    is compared ONLY between runs with the SAME compile verdict (hit
+    vs hit, miss vs miss): a cold run "regressing" against a warm one
+    is the cache working, not a regression — the verdict join is what
+    makes the committed cold+warm records directly diffable against
+    any fresh run.
+  * **budgets** — the new run's steady walls against the current
+    tools/dryrun_budgets.json (the absolute backstop, re-checked here
+    so a diff against an old record can't bless an over-budget run).
+  * **round metrics** — per-driver protocol totals (ops/round_metrics:
+    newly/dup/msgs/bytes).  Trajectories are seeded and deterministic,
+    so AT THE SAME DEVICE COUNT the totals must match almost exactly —
+    a drifted ``msgs`` total is a protocol change, not noise.  Across
+    different device counts the join is reported informationally and
+    never flagged (sparse stratification and padding are
+    mesh-dependent by design).
+
+Exit code: 0 when nothing is flagged, 1 otherwise — wire it straight
+into CI.  ``python tools/ledger_diff.py OLD.jsonl NEW.jsonl`` (each
+defaults to its file's newest run; ``--run-old/--run-new`` take a run
+id, ``first``, or ``last``).  Thresholds: ``--ratio`` (default 1.8),
+``--steady-floor-ms`` (50), ``--first-floor-ms`` (250),
+``--metrics-ratio`` (1.05).
+
+Also home to the "Protocol metrics" renderer
+(:func:`render_protocol_metrics`) that tools/telemetry_report.py embeds
+— one implementation of the round-metric table for both tools.
+"""
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _telemetry():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from _telemetry import telemetry
+    finally:
+        sys.path.pop(0)
+    return telemetry()
+
+
+def _load_budgets():
+    """tools/dryrun_budgets.json steady table via the report tool's one
+    parser of the two-table format (never a second drifting copy)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from telemetry_report import load_budgets
+    finally:
+        sys.path.pop(0)
+    return load_budgets()
+
+
+def resolve_run_id(runs, which, path, tool="ledger_diff"):
+    """``last``/``first``/explicit-id resolved against a run-id list.
+    An unknown explicit id must ERROR, not silently select an empty
+    run and exit clean — both CI gates (this tool and
+    telemetry_report --check) share that contract by sharing this
+    code."""
+    if which == "last":
+        return runs[-1]
+    if which == "first":
+        return runs[0]
+    if which not in runs:
+        raise SystemExit(
+            f"{tool}: run {which!r} not in {path} "
+            f"(runs: {', '.join(runs)})")
+    return which
+
+
+def select_run(path, which="last"):
+    """Events of one run of a ledger file: ``last`` (default),
+    ``first``, or an explicit run id."""
+    t = _telemetry()
+    events = t.load_ledger(path)
+    runs = []
+    for e in events:
+        r = e.get("run")
+        if r is not None and r not in runs:
+            runs.append(r)
+    if not runs:
+        return events
+    which = resolve_run_id(runs, which, path)
+    return [e for e in events if e.get("run") == which]
+
+
+def extract(events):
+    """The diffable view of one run: provenance, device count, the
+    per-family wall rows joined with their first-call compile verdict,
+    and the last round-metrics totals per driver."""
+    prov = next((e for e in events if e.get("ev") == "provenance"), {})
+    rt = next((e for e in events if e.get("ev") == "runtime"), {})
+    families = {}
+    for e in events:
+        if e.get("ev") == "family":
+            families[e["family"]] = {
+                k: v for k, v in e.items()
+                if k not in ("ev", "ts", "run", "family")}
+    for e in events:
+        if e.get("ev") == "compile" and e.get("phase") == "first_ms" \
+                and e.get("family") in families:
+            families[e["family"]]["verdict"] = e.get("cache")
+    metrics = {}
+    for drv, e in _indexed_metric_events(events):
+        metrics[drv] = {"rounds": e.get("rounds"),
+                        "shards": e.get("shards"),
+                        **(e.get("totals") or {})}
+    return {"run_id": prov.get("run_id"),
+            "captured": prov.get("captured"),
+            "git_commit": prov.get("git_commit"),
+            "device_count": rt.get("device_count"),
+            "families": families, "metrics": metrics}
+
+
+def _indexed_metric_events(events):
+    """``[(key, event)]`` for a run's round_metrics events, where key
+    is the driver label — suffixed ``#k`` by invocation order when a
+    label repeats (the fused dry-run families SHARE driver labels:
+    plain and fault-curve both flush ``simulate_*_sharded_fused``).
+    Keeping only the last event per label would silently drop the
+    earlier invocation's totals from both the diff and the report;
+    invocation order is deterministic (seeded runs, one program
+    order), so the suffix is a stable join key."""
+    rms = [e for e in events if e.get("ev") == "round_metrics"]
+    counts = {}
+    for e in rms:
+        d = e.get("driver")
+        counts[d] = counts.get(d, 0) + 1
+    seen, out = {}, []
+    for e in rms:
+        d = e.get("driver")
+        k = seen.get(d, 0)
+        seen[d] = k + 1
+        out.append((d if counts[d] == 1 else f"{d}#{k}", e))
+    return out
+
+
+def _median(xs):
+    xs = sorted(xs)
+    mid = len(xs) // 2
+    return (xs[mid] if len(xs) % 2
+            else 0.5 * (xs[mid - 1] + xs[mid]))
+
+
+def _wall_ratios(old, new, kind, verdict_matched=False):
+    """{family: new/old wall ratio} over the comparable families."""
+    ratios = {}
+    for fam, o in old["families"].items():
+        n = new["families"].get(fam)
+        if n is None:
+            continue
+        if verdict_matched and o.get("verdict") != n.get("verdict"):
+            continue
+        a, b = o.get(kind), n.get(kind)
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)) \
+                and a > 0:
+            ratios[fam] = b / a
+    return ratios
+
+
+def _drift(ratios, exclude=None):
+    """max(1, median) of the OTHER families' wall ratios — the uniform
+    host-load factor divided out before thresholding a family (clamped
+    at 1: a faster new environment must not mask an absolute
+    regression).  Leave-one-out: a family's own ratio never calibrates
+    itself, else a regression with few comparable peers — one family:
+    ANY regression — would absorb its own signal and pass clean."""
+    xs = [r for f, r in ratios.items() if f != exclude]
+    return max(1.0, _median(xs)) if xs else 1.0
+
+
+def diff(old, new, ratio=1.8, steady_floor_ms=50.0,
+         first_floor_ms=250.0, metrics_ratio=1.05, budgets=None):
+    """{"rows", "metric_rows", "flags", "notes", "drift"} — the joined
+    deltas.  ``flags`` are regression verdicts (nonzero exit);
+    ``notes`` are join caveats (verdict mismatches, device-count
+    mismatches) that explain why something was NOT compared; ``drift``
+    is the per-kind median calibration divided out of the wall ratios
+    (module doc)."""
+    budgets = _load_budgets() if budgets is None else budgets
+    flags, notes, rows = [], [], []
+    ratios = {"steady_ms": _wall_ratios(old, new, "steady_ms"),
+              "first_ms": _wall_ratios(old, new, "first_ms",
+                                       verdict_matched=True)}
+    # the pair-wide medians, for the report header (thresholding uses
+    # the per-family leave-one-out variant)
+    drift = {k: _drift(r) for k, r in ratios.items()}
+
+    def wall_flag(fam, kind, a, b, floor):
+        if a is None or b is None:
+            return None
+        cal = _drift(ratios[kind], exclude=fam)
+        if b >= ratio * cal * a and (b - cal * a) >= floor:
+            flags.append(f"{fam} {kind} regressed {a:.1f} -> {b:.1f} ms "
+                         f"({b / max(a, 1e-9):.2f}x raw, "
+                         f"{b / max(cal * a, 1e-9):.2f}x beyond the "
+                         f"peers' median drift {cal:.2f}x >= {ratio}x, "
+                         f"delta >= {floor:.0f} ms)")
+            return True
+        return False
+
+    fams = sorted(set(old["families"]) | set(new["families"]))
+    for fam in fams:
+        o = old["families"].get(fam)
+        n = new["families"].get(fam)
+        if o is None or n is None:
+            notes.append(f"{fam}: only in "
+                         f"{'new' if o is None else 'old'} run")
+            continue
+        row = {"family": fam,
+               "steady_old": o.get("steady_ms"),
+               "steady_new": n.get("steady_ms"),
+               "first_old": o.get("first_ms"),
+               "first_new": n.get("first_ms"),
+               "verdict_old": o.get("verdict"),
+               "verdict_new": n.get("verdict"),
+               "budget_ms": budgets.get(fam)}
+        row["steady_flag"] = wall_flag(fam, "steady_ms",
+                                       o.get("steady_ms"),
+                                       n.get("steady_ms"),
+                                       steady_floor_ms)
+        if o.get("verdict") == n.get("verdict"):
+            row["first_flag"] = wall_flag(fam, "first_ms",
+                                          o.get("first_ms"),
+                                          n.get("first_ms"),
+                                          first_floor_ms)
+        else:
+            row["first_flag"] = None
+            notes.append(
+                f"{fam}: compile verdict {o.get('verdict')} vs "
+                f"{n.get('verdict')} — first_ms not compared (a warm "
+                "run against a cold one measures the cache, not the "
+                "code)")
+        b = budgets.get(fam)
+        if b is not None and n.get("steady_ms") is not None \
+                and n["steady_ms"] > b:
+            row["budget_flag"] = True
+            flags.append(f"{fam} steady_ms {n['steady_ms']:.1f} over "
+                         f"budget {b} (tools/dryrun_budgets.json)")
+        rows.append(row)
+
+    same_mesh = (old.get("device_count") is not None
+                 and old.get("device_count") == new.get("device_count"))
+    metric_rows = []
+    for drv in sorted(set(old["metrics"]) | set(new["metrics"])):
+        o = old["metrics"].get(drv)
+        n = new["metrics"].get(drv)
+        if o is None or n is None:
+            notes.append(f"round_metrics[{drv}]: only in "
+                         f"{'new' if o is None else 'old'} run")
+            continue
+        row = {"driver": drv, "old": o, "new": n, "flagged": []}
+        if same_mesh:
+            for key in ("newly", "dup", "msgs", "bytes"):
+                a, b = o.get(key), n.get(key)
+                if not isinstance(a, (int, float)) \
+                        or not isinstance(b, (int, float)):
+                    continue
+                lo, hi = sorted([abs(a), abs(b)])
+                if hi > 0 and (lo == 0 or hi / max(lo, 1e-9)
+                               > metrics_ratio):
+                    row["flagged"].append(key)
+                    flags.append(
+                        f"round_metrics[{drv}].{key} drifted "
+                        f"{a} -> {b} at the same device count "
+                        f"({old['device_count']}) — seeded protocol "
+                        "totals must be stable; this is a semantic "
+                        "change, not noise")
+        else:
+            notes.append(
+                f"round_metrics[{drv}]: device counts differ "
+                f"({old.get('device_count')} vs "
+                f"{new.get('device_count')}) — protocol totals "
+                "reported, not gated (stratification and padding are "
+                "mesh-dependent)")
+        metric_rows.append(row)
+
+    return {"rows": rows, "metric_rows": metric_rows, "flags": flags,
+            "notes": notes, "drift": drift}
+
+
+def _fmt(v):
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:.1f}"
+    return str(v)
+
+
+def render(old, new, d):
+    """The diff as doc-ready markdown."""
+    out = ["# Ledger diff", ""]
+    for tag, run in (("old", old), ("new", new)):
+        out.append(f"- {tag}: run `{run.get('run_id')}` captured "
+                   f"{run.get('captured')} at commit "
+                   f"`{(run.get('git_commit') or 'unknown')[:12]}`, "
+                   f"{run.get('device_count')} device(s)")
+    dr = d["drift"]
+    out.append(f"- median drift divided out of the wall ratios: "
+               f"steady_ms {dr['steady_ms']:.2f}x, "
+               f"first_ms {dr['first_ms']:.2f}x")
+    out.append("")
+    if d["rows"]:
+        out.append("| family | steady old→new (ms) | first old→new (ms)"
+                   " | verdict | budget_ms | flag |")
+        out.append("|---|---|---|---|---|---|")
+        for r in d["rows"]:
+            verdict = (r["verdict_old"] if r["verdict_old"]
+                       == r["verdict_new"]
+                       else f"{r['verdict_old']}→{r['verdict_new']}")
+            flag = ("REGRESSED" if (r.get("steady_flag")
+                                    or r.get("first_flag")
+                                    or r.get("budget_flag")) else "ok")
+            out.append(
+                f"| {r['family']} "
+                f"| {_fmt(r['steady_old'])} → {_fmt(r['steady_new'])} "
+                f"| {_fmt(r['first_old'])} → {_fmt(r['first_new'])} "
+                f"| {verdict or '—'} | {_fmt(r['budget_ms'])} "
+                f"| {flag} |")
+        out.append("")
+    if d["metric_rows"]:
+        out.append("## Round-metric totals")
+        out.append("")
+        out.append("| driver | rounds old→new | newly old→new "
+                   "| dup old→new | msgs old→new | bytes old→new "
+                   "| flagged |")
+        out.append("|---|---|---|---|---|---|---|")
+        for r in d["metric_rows"]:
+            o, n = r["old"], r["new"]
+            cells = [f"{_fmt(o.get(k))} → {_fmt(n.get(k))}"
+                     for k in ("rounds", "newly", "dup", "msgs",
+                               "bytes")]
+            out.append(f"| {r['driver']} | " + " | ".join(cells)
+                       + f" | {', '.join(r['flagged']) or '—'} |")
+        out.append("")
+    if d["flags"]:
+        out.append("## Regressions flagged")
+        out.append("")
+        out.extend(f"- **{f}**" for f in d["flags"])
+        out.append("")
+    if d["notes"]:
+        out.append("## Join notes")
+        out.append("")
+        out.extend(f"- {nt}" for nt in d["notes"])
+        out.append("")
+    out.append(f"Verdict: {'REGRESSED (' + str(len(d['flags'])) + ')' if d['flags'] else 'clean'}.")
+    return "\n".join(out)
+
+
+def render_protocol_metrics(events):
+    """The "Protocol metrics" markdown section for a single run's
+    ``round_metrics`` events (embedded by tools/telemetry_report.py) —
+    the per-driver epidemic read-out: rounds, newly/dup/msgs/bytes
+    totals, and the final per-shard coverage-front spread.  Returns []
+    when the run carries no round metrics (pre-round-metrics
+    ledgers)."""
+    last = dict(_indexed_metric_events(events))
+    if not last:
+        return []
+    out = ["## Protocol metrics (per-driver round totals)", ""]
+    out.append("| driver | rounds | shards | newly | dup (est) | msgs "
+               "| bytes/dev | front min..max |")
+    out.append("|---|---|---|---|---|---|---|---|")
+    for drv in sorted(last):
+        e = last[drv]
+        t = e.get("totals") or {}
+        ff = e.get("front_final") or []
+        spread = (f"{min(ff):.3f}..{max(ff):.3f}" if ff else "—")
+        out.append(f"| {drv} | {e.get('rounds')} | {e.get('shards')} "
+                   f"| {_fmt(t.get('newly'))} | {_fmt(t.get('dup'))} "
+                   f"| {_fmt(t.get('msgs'))} | {_fmt(t.get('bytes'))} "
+                   f"| {spread} |")
+    out.append("")
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old", help="baseline ledger (e.g. the committed "
+                                "artifacts/ledger_dryrun_*.jsonl)")
+    ap.add_argument("new", help="candidate ledger (a fresh run)")
+    ap.add_argument("--run-old", default="last",
+                    help="run of OLD to use: run id, 'first' or 'last'")
+    ap.add_argument("--run-new", default="last",
+                    help="run of NEW to use: run id, 'first' or 'last'")
+    ap.add_argument("--ratio", type=float, default=1.8,
+                    help="wall ratio that flags (with the abs floor)")
+    ap.add_argument("--steady-floor-ms", type=float, default=50.0)
+    ap.add_argument("--first-floor-ms", type=float, default=250.0)
+    ap.add_argument("--metrics-ratio", type=float, default=1.05,
+                    help="protocol-total ratio that flags at equal "
+                         "device counts")
+    ap.add_argument("-o", "--out", default=None,
+                    help="write the markdown report here too")
+    args = ap.parse_args(argv)
+
+    old = extract(select_run(args.old, args.run_old))
+    new = extract(select_run(args.new, args.run_new))
+    d = diff(old, new, ratio=args.ratio,
+             steady_floor_ms=args.steady_floor_ms,
+             first_floor_ms=args.first_floor_ms,
+             metrics_ratio=args.metrics_ratio)
+    doc = render(old, new, d)
+    print(doc)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(doc + "\n")
+    return 1 if d["flags"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

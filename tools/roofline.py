@@ -298,11 +298,16 @@ def main():
     mr_floor_mat = (mr["hbm_bytes_materialized_rot"]
                     / hbm["bytes_per_s"] * 1e3)
 
+    from gossip_tpu.utils import telemetry
     doc = {
         "what": ("first-principles per-round floors vs measured actuals "
                  "for both fused layouts; primitive rates calibrated "
                  "on-chip this session (see module doc for the count "
                  "derivations)"),
+        # the one artifact schema (run_id/git_commit/captured —
+        # tools/validate_artifacts.py): floors are claims about a
+        # commit and a toolchain, so they carry their attribution
+        "provenance": telemetry.provenance(),
         "backend": backend,
         "smoke": smoke,
         "n": n,

@@ -159,11 +159,14 @@ def main():
             for r in merged.values():
                 r["delta_vs_full_ms"] = round(
                     r["ms_per_round"] - full["ms_per_round"], 2)
+        from gossip_tpu.utils import telemetry
         doc = {"what": ("steady-state ms/round decomposition of the "
                         "BASELINE SWIM shape by component stubbing "
                         "(runtime twin of swim_compile_ablation); "
                         "negative delta = that component's steady "
                         "cost"),
+               # the one artifact schema (tools/validate_artifacts.py)
+               "provenance": telemetry.provenance(),
                "n": n, "proto": PROTO_KW, "rounds_timed": a.rounds,
                "rows": list(merged.values())}
         with open(art, "w") as f:

@@ -190,6 +190,38 @@ def _fmt(v):
     return str(v)
 
 
+def _protocol_metrics_section(events):
+    """The "Protocol metrics" lines, rendered by the diff tool's ONE
+    implementation (tools/ledger_diff.render_protocol_metrics) so the
+    report and the cross-run gate can never disagree about what a
+    ``round_metrics`` event means."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from ledger_diff import render_protocol_metrics
+    finally:
+        sys.path.pop(0)
+    return render_protocol_metrics(events)
+
+
+def check_health(events):
+    """Ledger-health problems for the ``--check`` CI gate: a run whose
+    evidence cannot be trusted mechanically.  Flags (a) a missing
+    provenance line — numbers with no commit/toolchain attribution —
+    and (b) unclosed spans: the writer died or wedged inside them
+    (exactly what the flight recorder exists to show, and exactly what
+    a green CI artifact must not contain)."""
+    problems = []
+    if not any(e.get("ev") == "provenance" for e in events):
+        problems.append("no provenance line (run_id/git_commit/"
+                        "captured) — pre-ledger file or torn before "
+                        "first fsync")
+    unclosed = [n["name"] for _, n in span_tree(events) if n["unclosed"]]
+    for name in unclosed:
+        problems.append(f"unclosed span {name!r} — the run was killed "
+                        "or wedged inside it")
+    return problems
+
+
 def render_markdown(events, budgets=None, title=None):
     budgets = load_budgets() if budgets is None else budgets
     out = []
@@ -269,6 +301,8 @@ def render_markdown(events, budgets=None, title=None):
                            f"| {_fmt(r['ms']) if r.get('ms') is not None else '-'} |")
         out.append("")
 
+    out.extend(_protocol_metrics_section(events))
+
     tree = span_tree(events)
     if tree:
         out.append("## Span tree")
@@ -335,10 +369,51 @@ def main(argv=None):
                          "column (default: tools/dryrun_budgets.json)")
     ap.add_argument("-o", "--out", default=None,
                     help="write markdown here instead of stdout")
+    ap.add_argument("--check", action="store_true",
+                    help="ledger-health gate: exit 1 (no render) on "
+                         "unclosed spans or missing provenance — for "
+                         "CI (checks every run with --all-runs, else "
+                         "the selected one)")
     args = ap.parse_args(argv)
 
-    budgets = load_budgets(args.budgets)
     all_events = load_ledger(args.ledger)
+
+    def run_events(r):
+        return [e for e in all_events if e.get("run") == r]
+
+    def selected_run(rs):
+        """args.run resolved against the one parse (the load_ledger
+        run= semantics, without a second full read of the file) via
+        the diff tool's ONE resolver, so an unknown explicit id is an
+        ERROR here too — never an empty selection that --check would
+        misdiagnose as a torn/pre-ledger file."""
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        try:
+            from ledger_diff import resolve_run_id
+        finally:
+            sys.path.pop(0)
+        return resolve_run_id(rs, args.run, args.ledger,
+                              tool="telemetry_report")
+
+    if args.check:
+        problems = []
+        rs = runs(all_events)
+        if not rs:
+            problems += check_health(all_events)
+        elif args.all_runs:
+            for r in rs:
+                problems += [f"run {r}: {p}"
+                             for p in check_health(run_events(r))]
+        else:
+            problems = check_health(run_events(selected_run(rs)))
+        name = os.path.basename(args.ledger)
+        if problems:
+            for p in problems:
+                print(f"FAIL {name}: {p}", file=sys.stderr)
+            return 1
+        print(f"{name}: ledger health OK")
+        return 0
+    budgets = load_budgets(args.budgets)
     name = os.path.basename(args.ledger)
     if args.all_runs:
         parts = [render_markdown(
@@ -346,7 +421,8 @@ def main(argv=None):
             title=f"{name} — run {r}") for r in runs(all_events)]
         doc = "\n\n".join(parts)
     else:
-        events = load_ledger(args.ledger, run=args.run)
+        rs = runs(all_events)
+        events = run_events(selected_run(rs)) if rs else all_events
         if not events:
             print(f"no events for run {args.run!r} in {args.ledger}",
                   file=sys.stderr)

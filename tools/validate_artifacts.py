@@ -59,7 +59,9 @@ LEGACY = frozenset({
     "swim_compile_ablation_r04.json",
     "swim_diss_ab_r04.smoke.json",
     "swim_diss_ab_r05.smoke.json",
-    "swim_steady_ablation_r05.smoke.json",
+    # swim_steady_ablation_r05.smoke.json left this list in the
+    # observability PR: the tool now embeds provenance and the
+    # committed smoke artifact was regenerated with it
     "tunnel_health_r04.jsonl",
     "tunnel_health_r05.jsonl",
 })
@@ -102,13 +104,25 @@ def validate_file(path):
                 # surviving lines — that is destruction, not a crash
                 problems.append("does not parse: no parseable lines "
                                 f"among {nonblank}")
-            if name not in LEGACY:
-                if not any(_has_provenance_keys(r) for r in rows
-                           if isinstance(r, dict)):
-                    problems.append(
-                        "new-format jsonl without a provenance line "
-                        f"carrying {PROVENANCE_KEYS} "
-                        "(utils/telemetry.provenance)")
+            has_prov = any(_has_provenance_keys(r) for r in rows
+                           if isinstance(r, dict))
+            if name not in LEGACY and not has_prov:
+                problems.append(
+                    "new-format jsonl without a provenance line "
+                    f"carrying {PROVENANCE_KEYS} "
+                    "(utils/telemetry.provenance)")
+            # round-metric series are protocol-semantics evidence
+            # (ops/round_metrics) and post-date the ledger by two
+            # rounds: an artifact carrying them MUST be attributable,
+            # allowlist or not — the legacy list can never grandfather
+            # a metrics-bearing file in
+            if not has_prov and any(
+                    isinstance(r, dict)
+                    and r.get("ev") == "round_metrics" for r in rows):
+                problems.append(
+                    "carries round_metrics events but no provenance "
+                    "line — round-metric artifacts must be "
+                    "attributable (utils/telemetry.provenance)")
         else:
             with open(path) as f:
                 doc = json.load(f)
