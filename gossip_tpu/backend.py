@@ -349,6 +349,14 @@ def _fused_ineligible_reason(proto: ProtocolConfig, tc: TopologyConfig,
         return ("engine='fused' does not implement scripted dead_nodes/"
                 "fail_round; use engine='auto' (or node_death_rate for "
                 "random static deaths)")
+    if fault is not None and fault.churn is not None:
+        # the plane-sharded fused drivers run churn EVENTS when called
+        # directly (parallel/sharded_fused), but this routing's
+        # single-device fused paths predate the churn denominator —
+        # auto falls back to the XLA kernels, which run every schedule
+        return ("engine='fused' routing does not run churn schedules; "
+                "use engine='auto' (XLA kernels run the full nemesis "
+                "scenario catalog — docs/ROBUSTNESS.md)")
     # node_death_rate / drop_prob: in-kernel static fault masks cover
     # every fused layout since round 4 (node-packed, one-word-per-node,
     # staged big path, plane-sharded) — no restriction to return
@@ -380,24 +388,34 @@ def swim_scenario(proto: ProtocolConfig, n: int,
     default_scenario)``.  From the FaultConfig (CLI --dead-nodes /
     --fail-round, RPC fault.dead_nodes); default: node ``1 % S`` fails
     at round 2 (recorded in run meta so the scenario is discoverable).
-    Validates the subjects against ``n`` and — without rotation —
-    against the fixed subject window."""
-    default_scenario = fault is None or not fault.dead_nodes
+    Scripted CHURN events are a scenario too — a churn-only run gets no
+    default death injected on top of its schedule, and the detection
+    metric targets the permanent churn crashes
+    (models/swim.detection_targets).  Validates the metric targets
+    against ``n`` and — without rotation — against the fixed subject
+    window."""
+    from gossip_tpu.models.swim import detection_targets
+    from gossip_tpu.ops import nemesis as NE
+    churn = NE.get(fault)
+    scripted = fault is not None and (
+        bool(fault.dead_nodes) or (churn is not None and churn.events))
+    default_scenario = not scripted
     if default_scenario:
         dead = (1 % proto.swim_subjects,)
         fail_round = 2
     else:
         dead = fault.dead_nodes
         fail_round = fault.fail_round
-    bad = [d for d in dead if d >= n]
+    targets = detection_targets(dead, fault)
+    bad = [d for d in targets if d >= n]
     if bad:
         raise ValueError(f"dead_nodes {bad} out of range for n={n}")
     if not proto.swim_rotate:
-        outside = [d for d in dead if d >= proto.swim_subjects]
+        outside = [d for d in targets if d >= proto.swim_subjects]
         if outside:
             raise ValueError(
-                f"dead_nodes {outside} are outside the fixed subject "
-                f"window 0..{proto.swim_subjects - 1}; enable "
+                f"dead/churn-dead nodes {outside} are outside the fixed "
+                f"subject window 0..{proto.swim_subjects - 1}; enable "
                 "--swim-rotate for full-membership detection")
     return dead, fail_round, default_scenario
 
@@ -408,7 +426,11 @@ def swim_scenario_meta(proto: ProtocolConfig, n: int,
     meta keys EVERY swim driver reports (streaming, checkpointed,
     ensemble), so the three surfaces cannot drift."""
     dead, fail_round, default_scenario = swim_scenario(proto, n, fault)
-    meta = {"metric": "detection_fraction", "dead_subjects": list(dead),
+    from gossip_tpu.models.swim import detection_targets
+    meta = {"metric": "detection_fraction",
+            # what the metric actually measures: static scripted deaths
+            # + permanent churn deaths (== dead for no-churn configs)
+            "dead_subjects": list(detection_targets(dead, fault)),
             "fail_round": fail_round, "default_scenario": default_scenario}
     return dead, fail_round, meta
 

@@ -220,6 +220,54 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
                    help="node ids that fail at --fail-round (swim scenario; "
                         "default: node 1%%S fails at round 2)")
     p.add_argument("--fail-round", type=int, default=0)
+    # time-varying nemesis schedule (ChurnConfig -> ops/nemesis,
+    # compiled into the round loops; docs/ROBUSTNESS.md)
+    p.add_argument("--churn-event", action="append", default=None,
+                   metavar="NODE:DIE[:REC]",
+                   help="scripted crash/recover churn: NODE dies at round "
+                        "DIE and recovers at round REC (omit REC or pass "
+                        "-1 for a permanent crash); repeatable")
+    p.add_argument("--partition", action="append", default=None,
+                   metavar="START:END:CUT",
+                   help="network partition window: for rounds [START, END) "
+                        "every message crossing node-id CUT is lost; "
+                        "repeatable, windows must not overlap")
+    p.add_argument("--drop-ramp", default=None, metavar="START:END:P0:P1",
+                   help="drop-rate ramp: link drop probability moves "
+                        "linearly P0 -> P1 over rounds [START, END), then "
+                        "holds P1")
+
+
+def _parse_churn(a):
+    """--churn-event/--partition/--drop-ramp -> ChurnConfig or None.
+    Field validation (ranges, overlap) lives in ChurnConfig itself —
+    this only parses the colon syntax."""
+    def ints(s, what, lens):
+        parts = s.split(":")
+        if len(parts) not in lens:
+            raise ValueError(
+                f"--{what} takes {'|'.join(map(str, sorted(lens)))} "
+                f"colon-separated fields, got {s!r}")
+        return parts
+
+    events = []
+    for s in (getattr(a, "churn_event", None) or ()):
+        parts = ints(s, "churn-event", {2, 3})
+        if len(parts) == 2:
+            parts.append("-1")
+        events.append(tuple(int(x) for x in parts))
+    partitions = []
+    for s in (getattr(a, "partition", None) or ()):
+        partitions.append(tuple(int(x) for x in ints(s, "partition", {3})))
+    ramp = None
+    if getattr(a, "drop_ramp", None):
+        f = ints(a.drop_ramp, "drop-ramp", {4})
+        ramp = (int(f[0]), int(f[1]), float(f[2]), float(f[3]))
+    if not (events or partitions or ramp):
+        return None
+    from gossip_tpu.config import ChurnConfig
+    return ChurnConfig(events=tuple(events),
+                       partitions=tuple(partitions), ramp=ramp)
 
 
 def _args_to_configs(a):
@@ -244,11 +292,12 @@ def _args_to_configs(a):
                     seed=a.seed, origin=a.origin,
                     engine=getattr(a, "engine", "auto"))
     fault = None
-    if a.drop > 0 or a.death > 0 or a.dead_nodes:
+    churn = _parse_churn(a)
+    if a.drop > 0 or a.death > 0 or a.dead_nodes or churn is not None:
         fault = FaultConfig(node_death_rate=a.death, drop_prob=a.drop,
                             seed=a.seed,
                             dead_nodes=tuple(a.dead_nodes or ()),
-                            fail_round=a.fail_round)
+                            fail_round=a.fail_round, churn=churn)
     mesh = (MeshConfig(n_devices=a.devices, exchange=a.exchange)
             if a.devices > 1 else None)
     return proto, tc, run, fault, mesh
@@ -583,7 +632,13 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
     else:
         from gossip_tpu.models.si import coverage, make_si_round
         from gossip_tpu.models.state import alive_mask, init_state
+        from gossip_tpu.ops import nemesis as NE
         from gossip_tpu.utils.checkpoint import run_with_checkpoints
+        # churn changes the step's return shape mid-segment; reject
+        # rather than corrupt the segment runner (the other
+        # checkpointed engines guard identically)
+        NE.check_supported(fault, engine="checkpointed-si", events=False,
+                           partitions=False, ramp=False)
         topo = G.build(tc)
         step, tables = make_si_round(proto, topo, fault, run.origin,
                                      tabled=True)

@@ -155,6 +155,154 @@ class ProtocolConfig:
                              f"{RUMOR_VARIANTS}")
 
 
+# Ceiling on the schedule horizon (ops/nemesis table length T): the
+# lowering materializes [T]-sized device tables plus host lists per
+# trace, so an absurd partition/ramp end must error loudly instead of
+# hanging and OOMing.  100k rounds x 4 bytes = 400 KB per table —
+# orders of magnitude past any real run ("partitioned forever" just
+# needs end >= the run's max_rounds: beyond the horizon the schedule
+# holds its final row, i.e. partitions closed, drop at the ramp's
+# final value).
+MAX_CHURN_HORIZON = 100_000
+
+
+@dataclasses.dataclass(frozen=True)
+class ChurnConfig:
+    """A fault *program over rounds* — the compiled nemesis schedule.
+
+    Maelstrom's nemesis partitions the network MID-RUN and heals it
+    (reference main.go:77-87 survives via at-least-once retry); the
+    static masks of :class:`FaultConfig` cannot express that.  This
+    config scripts time-varying faults, lowered by
+    :mod:`gossip_tpu.ops.nemesis` into small round-indexed schedule
+    tables consumed INSIDE the compiled round loops:
+
+    * ``events`` — crash/recover churn: ``(node, die_round,
+      recover_round)`` triples.  The node is down for rounds
+      ``die_round <= r < recover_round`` (it neither sends, responds,
+      nor receives); ``recover_round < 0`` means it never comes back.
+      Scripted events override nothing else — they stack on top of the
+      static ``node_death_rate`` mask (and a scripted death of the
+      rumor origin is honored: explicit scripts are the user's call,
+      unlike the random mask, which pins the origin alive).
+    * ``partitions`` — network partition windows: ``(start, end,
+      cut)``.  For rounds ``start <= r < end`` every message crossing
+      the node-id cut (one side is ``id < cut``, the other
+      ``id >= cut``) is lost; both sides keep gossiping internally.
+      Applied to the dense, sparse, and halo exchanges (the
+      plane-sharded fused engine has no per-pair messages to cut —
+      it rejects partition windows rather than silently ignoring
+      them).  A cut at a multiple of ``n_pad / n_devices`` is
+      shard-group aligned (no shard straddles the cut).  Windows must
+      not overlap.
+    * ``ramp`` — a drop-rate ramp ``(start, end, from_p, to_p)``:
+      ``drop_prob`` is ``FaultConfig.drop_prob`` before ``start``,
+      moves linearly from ``from_p`` to ``to_p`` over
+      ``[start, end)``, and holds ``to_p`` after.
+
+    All fields JSON-friendly (the RPC ``fault.churn`` object delivers
+    lists, coerced here).  An all-default ChurnConfig is normalized to
+    ``None`` by :class:`FaultConfig` so the fault-free/static-only hot
+    paths stay untouched.
+    """
+
+    events: Tuple[Tuple[int, int, int], ...] = ()
+    partitions: Tuple[Tuple[int, int, int], ...] = ()
+    ramp: Optional[Tuple[int, int, float, float]] = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "events", tuple(
+            tuple(int(x) for x in e) for e in self.events))
+        object.__setattr__(self, "partitions", tuple(
+            tuple(int(x) for x in w) for w in self.partitions))
+        if self.ramp is not None:
+            r = tuple(self.ramp)
+            if len(r) != 4:
+                raise ValueError(f"drop ramp {r} must be "
+                                 "(start, end, from_p, to_p)")
+            object.__setattr__(
+                self, "ramp",
+                (int(r[0]), int(r[1]), float(r[2]), float(r[3])))
+        for e in self.events:
+            if len(e) != 3:
+                raise ValueError(f"churn event {e} must be "
+                                 "(node, die_round, recover_round)")
+            node, die, rec = e
+            if node < 0:
+                raise ValueError(f"churn event node {node} must be >= 0")
+            if die < 0:
+                raise ValueError(f"churn event die_round {die} must be "
+                                 ">= 0")
+            if rec >= 0 and rec <= die:
+                raise ValueError(
+                    f"churn event {e}: recover_round must be > die_round "
+                    "(or < 0 for a permanent crash)")
+            if die > MAX_CHURN_HORIZON or rec > MAX_CHURN_HORIZON:
+                raise ValueError(
+                    f"churn event {e}: rounds exceed the schedule "
+                    f"horizon cap {MAX_CHURN_HORIZON} (rec < 0 already "
+                    "means 'down forever'; larger values would collide "
+                    "with the kernels' int32 NEVER sentinel)")
+        nodes = [e[0] for e in self.events]
+        if len(set(nodes)) != len(nodes):
+            raise ValueError("churn events must script each node at most "
+                             "once (one die/recover pair per node)")
+        spans = []
+        for w in self.partitions:
+            if len(w) != 3:
+                raise ValueError(f"partition window {w} must be "
+                                 "(start, end, cut)")
+            start, end, cut = w
+            if start < 0 or end <= start:
+                raise ValueError(f"partition window {w}: need "
+                                 "0 <= start < end")
+            if cut <= 0:
+                raise ValueError(f"partition window {w}: cut must be a "
+                                 "positive node id (both sides non-empty)")
+            if end > MAX_CHURN_HORIZON:
+                raise ValueError(
+                    f"partition window {w}: end {end} exceeds the "
+                    f"schedule horizon cap {MAX_CHURN_HORIZON} (any end "
+                    ">= the run's max_rounds already means 'open for "
+                    "the whole run' — the lowered tables are sized by "
+                    "the largest end)")
+            spans.append((start, end))
+        spans.sort()
+        for (s0, e0), (s1, _) in zip(spans, spans[1:]):
+            if s1 < e0:
+                raise ValueError("partition windows overlap: "
+                                 f"[{s0}, {e0}) and [{s1}, ...)")
+        if self.ramp is not None:
+            start, end, p0, p1 = self.ramp
+            if start < 0 or end <= start:
+                raise ValueError(f"drop ramp {self.ramp}: need "
+                                 "0 <= start < end")
+            if end > MAX_CHURN_HORIZON:
+                raise ValueError(
+                    f"drop ramp {self.ramp}: end {end} exceeds the "
+                    f"schedule horizon cap {MAX_CHURN_HORIZON} (the "
+                    "ramp holds its final value beyond end, so a "
+                    "shorter ramp expresses the same steady state)")
+            for p in (p0, p1):
+                if not 0.0 <= p <= 1.0:
+                    raise ValueError(
+                        f"drop ramp probability {p} outside [0, 1]")
+
+    @property
+    def empty(self) -> bool:
+        return not (self.events or self.partitions or self.ramp)
+
+    def horizon(self) -> int:
+        """Rounds after which the schedule is constant: the table
+        length T of the ops/nemesis lowering.  Beyond it, partitions
+        are closed and the drop rate holds its final value."""
+        ends = [1]
+        ends += [end for _, end, _ in self.partitions]
+        if self.ramp is not None:
+            ends.append(self.ramp[1])
+        return max(ends) + 1
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultConfig:
     """In-kernel fault injection.
@@ -165,6 +313,13 @@ class FaultConfig:
     the round kernel: a dead node neither sends nor receives; a dropped edge
     loses this round's message (retried implicitly next round, which mirrors
     at-least-once delivery + idempotent receipt, main.go:80-87 + 113).
+
+    ``churn`` scripts TIME-VARYING faults (crash/recover churn,
+    partition windows, drop-rate ramps — :class:`ChurnConfig`), lowered
+    into round-indexed schedule tables by :mod:`gossip_tpu.ops.nemesis`
+    and consumed inside the compiled round loops; ``None`` (or an
+    all-default ChurnConfig, normalized to None here) keeps every
+    kernel on its static-fault path, bitwise unchanged.
     """
 
     node_death_rate: float = 0.0   # fraction of nodes dead (static mask)
@@ -176,6 +331,9 @@ class FaultConfig:
     # (--dead-nodes/--fail-round) and the RPC `fault` object.
     dead_nodes: Tuple[int, ...] = ()
     fail_round: int = 0
+    # Time-varying fault schedule (CLI --churn-event/--partition/
+    # --drop-ramp, RPC fault.churn object).
+    churn: Optional["ChurnConfig"] = None
 
     def __post_init__(self):
         # JSON/RPC delivers lists; coerce so the config stays hashable.
@@ -185,6 +343,21 @@ class FaultConfig:
             raise ValueError("dead_nodes must be non-negative node ids")
         if self.fail_round < 0:
             raise ValueError("fail_round must be >= 0")
+        # probabilities are probabilities: an out-of-range rate would
+        # silently skew the bernoulli mask draws instead of failing
+        if not 0.0 <= self.node_death_rate <= 1.0:
+            raise ValueError(
+                f"node_death_rate={self.node_death_rate} outside [0, 1]")
+        if not 0.0 <= self.drop_prob <= 1.0:
+            raise ValueError(
+                f"drop_prob={self.drop_prob} outside [0, 1]")
+        if isinstance(self.churn, dict):      # RPC: nested JSON object
+            object.__setattr__(self, "churn", ChurnConfig(**self.churn))
+        if self.churn is not None and self.churn.empty:
+            # all-default schedule == no schedule: keep the static hot
+            # path (and its bitwise pins) for configs that carry a
+            # vacuous churn object
+            object.__setattr__(self, "churn", None)
 
 
 ENGINES = ("auto", "fused", "xla", "native")

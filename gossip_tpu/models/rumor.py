@@ -87,23 +87,42 @@ def make_rumor_round(proto: ProtocolConfig, topo: Topology,
     feedback = proto.rumor_variant == "feedback"
     drop_prob = 0.0 if fault is None else fault.drop_prob
     tables = () if topo.implicit else (topo.nbrs, topo.deg)
+    from gossip_tpu.ops import nemesis as NE
+    ch = NE.get(fault)
+    if ch is not None:
+        NE.validate_events(fault, n)
 
-    def step_tabled(state: RumorState, *tbl) -> RumorState:
+    def step_tabled(state: RumorState, *tbl):
         nbrs_t, deg_t = tbl if tbl else (None, None)
-        alive = alive_mask(fault, n, origin)
         ids = jnp.arange(n, dtype=jnp.int32)
         rkey = jax.random.fold_in(state.base_key, state.round)
         seen, hot, cnt = state.seen, state.hot, state.cnt
+        if ch is not None:
+            # churn path: per-round liveness / drop prob / cut from the
+            # schedule tables (ops/nemesis).  A churn-down node loses
+            # its hot (forwarding) state like a process crash; its seen
+            # set persists (the durable dedup store, main.go:22-26).
+            sched = NE.build(fault, n)
+            alive = NE.alive_rows(sched, NE.base_alive_or_ones(
+                fault, n, origin), state.round)
+            dp = NE.drop_at(sched, state.round)
+            cut = NE.cut_at(sched, state.round)
+        else:
+            alive = alive_mask(fault, n, origin)
+            dp, cut = drop_prob, None
+        lost = jnp.float32(0.0)
 
         # What this node forwards this round: its hot rumors (dead nodes
         # go dark — neither send nor count).
         payload = hot if alive is None else hot & alive[:, None]   # [N, R]
 
         pkey = jax.random.fold_in(rkey, RUMOR_PUSH_TAG)
-        targets = sample_peers(pkey, ids, topo, k, proto.exclude_self,
-                               local_nbrs=nbrs_t, local_deg=deg_t)
-        targets = apply_drop(rkey, RUMOR_DROP_TAG, ids, targets,
-                             drop_prob, n)                         # [N, k]
+        targets0 = sample_peers(pkey, ids, topo, k, proto.exclude_self,
+                                local_nbrs=nbrs_t, local_deg=deg_t)
+        targets = apply_drop(rkey, RUMOR_DROP_TAG, ids, targets0,
+                             dp, n, force=ch is not None)         # [N, k]
+        if ch is not None:
+            targets = NE.partition_targets(cut, ids, targets, n)
         sender_active = jnp.any(payload, axis=1)                   # [N]
         valid = (targets < n) & sender_active[:, None]             # [N, k]
         safe_t = jnp.where(valid, targets, 0)
@@ -134,9 +153,13 @@ def make_rumor_round(proto: ProtocolConfig, topo: Topology,
         if alive is not None:
             hot = hot & alive[:, None]
         msgs = state.msgs + jnp.sum(valid).astype(jnp.float32)
-        return RumorState(seen=seen | delta, hot=hot, cnt=cnt,
-                          round=state.round + 1,
-                          base_key=state.base_key, msgs=msgs)
+        if ch is not None:
+            lost = lost + NE.lost_count(targets0, targets,
+                                        sender_active, n)
+        out = RumorState(seen=seen | delta, hot=hot, cnt=cnt,
+                         round=state.round + 1,
+                         base_key=state.base_key, msgs=msgs)
+        return (out, lost) if ch is not None else out
 
     return bind_tables(step_tabled, tables, tabled)
 
@@ -158,7 +181,9 @@ def simulate_until_rumor(proto: ProtocolConfig, topo: Topology,
     while_loop.  Returns (rounds, coverage, residue, msgs, final_state):
     ``residue`` is the never-informed fraction at termination — the
     rumor-mongering quality metric (worst rumor)."""
+    from gossip_tpu.ops import nemesis as NE
     step, tbl = make_rumor_round(proto, topo, fault, run.origin, tabled=True)
+    step = NE.drop_lost(step, NE.get(fault))
     init = init_rumor_state(run, proto, topo.n)
 
     @jax.jit
@@ -174,8 +199,9 @@ def simulate_until_rumor(proto: ProtocolConfig, topo: Topology,
     final = loop(init, *tbl)
     # alive_mask, NOT static_death_draw: the kernel pins the origin alive,
     # so the metric weighting must too (matches the sharded twin and
-    # every SI curve path)
-    alive = alive_mask(fault, topo.n, run.origin)
+    # every SI curve path); under churn the eventual alive set
+    # (ops/nemesis.metric_alive — heal-convergence denominator)
+    alive = NE.metric_alive(fault, topo.n, run.origin)
     cov = float(rumor_coverage(final.seen, alive))
     return (int(final.round), cov, 1.0 - cov, float(final.msgs), final)
 
@@ -201,7 +227,12 @@ def checkpointed_rumor(proto: ProtocolConfig, topo: Topology,
     node-sharded twin runs.  Returns ``(final_state, coverage,
     residue, curve-dict-or-None)``.
     """
+    from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.utils.checkpoint import run_with_checkpoints
+    # churn would change the step's return shape mid-segment and the
+    # resume fingerprint cannot carry the schedule yet: reject loudly
+    NE.check_supported(fault, engine="checkpointed-rumor", events=False,
+                       partitions=False, ramp=False)
     if mesh is None:
         step, tables = make_rumor_round(proto, topo, fault, run.origin,
                                         tabled=True)
@@ -254,14 +285,16 @@ def simulate_curve_rumor(proto: ProtocolConfig, topo: Topology,
                          fault: Optional[FaultConfig] = None):
     """Fixed-length scan: per-round (coverage, hot_fraction, msgs) curves
     — hot_fraction shows the infective wave rise and die out."""
+    from gossip_tpu.ops import nemesis as NE
     step, tbl = make_rumor_round(proto, topo, fault, run.origin, tabled=True)
+    step = NE.drop_lost(step, NE.get(fault))
     init = init_rumor_state(run, proto, topo.n)
 
     @jax.jit
     def scan(state, *tables):
         # alive-weighted coverage, consistent with the until-driver and
         # the SI curve paths (dead nodes are unreachable, not uninformed)
-        alive = alive_mask(fault, topo.n, run.origin)
+        alive = NE.metric_alive(fault, topo.n, run.origin)
         hot_w = (jnp.float32(1.0) if alive is None
                  else alive.astype(jnp.float32))
 

@@ -67,13 +67,28 @@ def make_si_round(proto: ProtocolConfig, topo: Topology,
         raise ValueError("flood mode needs an explicit neighbor table")
     drop_prob = 0.0 if fault is None else fault.drop_prob
     tables = () if topo.implicit else (topo.nbrs, topo.deg)
+    from gossip_tpu.ops import nemesis as NE
+    ch = NE.get(fault)
+    if ch is not None:
+        NE.validate_events(fault, n)
 
-    def step_tabled(state: SimState, *tbl) -> SimState:
+    def step_tabled(state: SimState, *tbl):
         nbrs_t, deg_t = tbl if tbl else (None, None)
-        alive = alive_mask(fault, n, origin)      # in-trace, None-free path
         ids = jnp.arange(n, dtype=jnp.int32)
         rkey = jax.random.fold_in(state.base_key, state.round)
         seen = state.seen
+        if ch is not None:
+            # churn path: per-round liveness / drop prob / cut from the
+            # schedule tables, indexed by the loop counter (ops/nemesis)
+            sched = NE.build(fault, n)
+            alive = NE.alive_rows(sched, NE.base_alive_or_ones(
+                fault, n, origin), state.round)
+            dp = NE.drop_at(sched, state.round)
+            cut = NE.cut_at(sched, state.round)
+        else:
+            alive = alive_mask(fault, n, origin)  # in-trace, None-free path
+            dp, cut = drop_prob, None
+        lost = jnp.float32(0.0)
         # What peers can observe of node i: dead nodes go dark.
         visible = seen if alive is None else seen & alive[:, None]
         delta = jnp.zeros_like(seen)
@@ -81,27 +96,44 @@ def make_si_round(proto: ProtocolConfig, topo: Topology,
 
         if mode in (C.PUSH, C.PUSH_PULL):
             pkey = jax.random.fold_in(rkey, PUSH_TAG)
-            targets = sample_peers(pkey, ids, topo, k, proto.exclude_self,
-                                   local_nbrs=nbrs_t, local_deg=deg_t)
+            targets0 = sample_peers(pkey, ids, topo, k, proto.exclude_self,
+                                    local_nbrs=nbrs_t, local_deg=deg_t)
             targets = apply_drop(rkey, PUSH_DROP_TAG, ids,
-                                 targets, drop_prob, n)
+                                 targets0, dp, n, force=ch is not None)
+            if ch is not None:
+                targets = NE.partition_targets(cut, ids, targets, n)
             sender_active = jnp.any(visible, axis=1)          # [N]
             valid = (targets < n) & sender_active[:, None]    # [N, k]
             delta = delta | push_delta(n, jnp.where(valid, targets, n),
                                        visible)
             msgs = msgs + jnp.sum(valid).astype(jnp.float32)
+            if ch is not None:
+                lost = lost + NE.lost_count(targets0, targets,
+                                            sender_active, n)
 
         if mode in (C.PULL, C.PUSH_PULL) or mode == C.ANTI_ENTROPY:
             qkey = jax.random.fold_in(rkey, PULL_TAG)
-            partners = sample_peers(qkey, ids, topo, k, proto.exclude_self,
-                                    local_nbrs=nbrs_t, local_deg=deg_t)
+            partners0 = sample_peers(qkey, ids, topo, k, proto.exclude_self,
+                                     local_nbrs=nbrs_t, local_deg=deg_t)
             partners = apply_drop(rkey, PULL_DROP_TAG, ids,
-                                  partners, drop_prob, n)
+                                  partners0, dp, n, force=ch is not None)
+            if ch is not None:
+                partners = NE.partition_targets(cut, ids, partners, n)
             pulled = pull_merge(visible, partners, n)
             # dead nodes neither request nor receive (alive-mask contract)
             if alive is not None:
                 partners = jnp.where(alive[:, None], partners, n)
             n_req = jnp.sum(partners < n).astype(jnp.float32)
+            if ch is not None:
+                req_active = (jnp.ones((n,), jnp.bool_) if alive is None
+                              else alive)
+                lost_pull = NE.lost_count(partners0, partners,
+                                          req_active, n)
+                if mode == C.ANTI_ENTROPY and proto.period > 1:
+                    # quiescent rounds send nothing, so nothing is lost
+                    lost_pull = jnp.where(
+                        (state.round % proto.period) == 0, lost_pull, 0.0)
+                lost = lost + lost_pull
             if mode == C.ANTI_ENTROPY:
                 # Classic anti-entropy (Demers et al. §1.2 "anti-entropy"):
                 # the periodic exchange reconciles BOTH directions — the
@@ -128,7 +160,21 @@ def make_si_round(proto: ProtocolConfig, topo: Topology,
 
         if mode == C.FLOOD:
             nbrs = nbrs_t
-            if drop_prob > 0.0:
+            if ch is not None:
+                # churn path: always draw (traced p), then cut the
+                # cross-partition edges; a destroyed edge is retried
+                # next round (at-least-once, main.go:80-87)
+                dropped = drop_mask(rkey, FLOOD_DROP_TAG, ids,
+                                    nbrs.shape[1], dp)
+                nbrs = jnp.where(dropped, jnp.int32(n), nbrs)
+                nbrs = NE.partition_targets(cut, ids, nbrs, n)
+                # lost edge uses whose SENDER (the neighbor the gather
+                # reads from) had something to say
+                act = jnp.any(visible, axis=1)
+                edge_live = (nbrs_t < n) & act[jnp.clip(nbrs_t, 0, n - 1)]
+                lost = lost + jnp.sum(edge_live & (nbrs >= n),
+                                      dtype=jnp.float32)
+            elif drop_prob > 0.0:
                 # lossy links drop individual edge uses this round; the edge
                 # is retried next round (at-least-once, main.go:80-87)
                 dropped = drop_mask(rkey, FLOOD_DROP_TAG, ids,
@@ -141,8 +187,9 @@ def make_si_round(proto: ProtocolConfig, topo: Topology,
 
         if alive is not None:
             delta = delta & alive[:, None]  # dead nodes receive nothing
-        return SimState(seen=seen | delta, round=state.round + 1,
-                        base_key=state.base_key, msgs=msgs)
+        out = SimState(seen=seen | delta, round=state.round + 1,
+                       base_key=state.base_key, msgs=msgs)
+        return (out, lost) if ch is not None else out
 
     return bind_tables(step_tabled, tables, tabled)
 

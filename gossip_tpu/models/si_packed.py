@@ -96,13 +96,28 @@ def make_packed_round(proto: ProtocolConfig, topo: Topology,
                          "complete graph only")
     drop_prob = 0.0 if fault is None else fault.drop_prob
     tables = () if topo.implicit else (topo.nbrs, topo.deg)
+    from gossip_tpu.ops import nemesis as NE
+    ch = NE.get(fault)
+    if ch is not None:
+        NE.validate_events(fault, n)
 
-    def step_tabled(state: SimState, *tbl) -> SimState:
+    def step_tabled(state: SimState, *tbl):
         nbrs_t, deg_t = tbl if tbl else (None, None)
-        alive = alive_mask(fault, n, origin)      # in-trace
         ids = jnp.arange(n, dtype=jnp.int32)
         rkey = jax.random.fold_in(state.base_key, state.round)
         packed = state.seen
+        if ch is not None:
+            # churn path: per-round liveness / drop prob / cut from the
+            # schedule tables (ops/nemesis; models/si.py twin)
+            sched = NE.build(fault, n)
+            alive = NE.alive_rows(sched, NE.base_alive_or_ones(
+                fault, n, origin), state.round)
+            dp = NE.drop_at(sched, state.round)
+            cut = NE.cut_at(sched, state.round)
+        else:
+            alive = alive_mask(fault, n, origin)  # in-trace
+            dp, cut = drop_prob, None
+        lost = jnp.float32(0.0)
         visible = packed if alive is None else jnp.where(
             alive[:, None], packed, jnp.uint32(0))
         if sampler == "pallas":
@@ -113,12 +128,22 @@ def make_packed_round(proto: ProtocolConfig, topo: Topology,
             qkey = jax.random.fold_in(rkey, si_mod.PULL_TAG)
             partners = sample_peers(qkey, ids, topo, k, proto.exclude_self,
                                     local_nbrs=nbrs_t, local_deg=deg_t)
+        partners0 = partners
         partners = apply_drop(rkey, si_mod.PULL_DROP_TAG, ids,
-                              partners, drop_prob, n)
+                              partners0, dp, n, force=ch is not None)
+        if ch is not None:
+            partners = NE.partition_targets(cut, ids, partners, n)
         pulled = pull_merge_packed(visible, partners, n)
         if alive is not None:
             partners = jnp.where(alive[:, None], partners, n)
         n_req = jnp.sum(partners < n).astype(jnp.float32)
+        if ch is not None:
+            lost_pull = NE.lost_count(partners0, partners, alive, n)
+            if mode == C.ANTI_ENTROPY and proto.period > 1:
+                # quiescent rounds send nothing, so nothing is lost
+                lost_pull = jnp.where(
+                    (state.round % proto.period) == 0, lost_pull, 0.0)
+            lost = lost + lost_pull
         if mode == C.ANTI_ENTROPY:
             # Bidirectional reconciliation (twin of models/si.py): the
             # initiator's digest also scatters back into the partner's row.
@@ -144,9 +169,10 @@ def make_packed_round(proto: ProtocolConfig, topo: Topology,
             mfac = 2.0    # request + digest response
         if alive is not None:
             pulled = jnp.where(alive[:, None], pulled, jnp.uint32(0))
-        return SimState(seen=packed | pulled, round=state.round + 1,
-                        base_key=state.base_key,
-                        msgs=state.msgs + mfac * n_req)
+        out = SimState(seen=packed | pulled, round=state.round + 1,
+                       base_key=state.base_key,
+                       msgs=state.msgs + mfac * n_req)
+        return (out, lost) if ch is not None else out
 
     return bind_tables(step_tabled, tables, tabled)
 
@@ -158,16 +184,18 @@ def simulate_until_packed(proto: ProtocolConfig, topo: Topology,
     """while_loop to target coverage on packed state — the bench fast path.
     Returns (rounds, coverage, msgs, final_state).  ``timing``: pass a
     dict for the compile/steady AOT split (utils.trace.aot_timed)."""
+    from gossip_tpu.ops import nemesis as NE
     step, tables = make_packed_round(proto, topo, fault, run.origin,
                                      tabled=True)
-    alive = alive_mask(fault, topo.n, run.origin)
+    step = NE.drop_lost(step, NE.get(fault))
+    alive = NE.metric_alive(fault, topo.n, run.origin)
     init = init_packed_state(run, proto, topo.n)
     target = jnp.float32(run.target_coverage)
     r = proto.rumors
 
     @jax.jit
     def loop(state, *tbl):
-        alive_t = alive_mask(fault, topo.n, run.origin)
+        alive_t = NE.metric_alive(fault, topo.n, run.origin)
         def cond(s):
             return ((coverage_packed(s.seen, r, alive_t) < target)
                     & (s.round < run.max_rounds))
@@ -189,15 +217,18 @@ def compiled_until_packed(proto: ProtocolConfig, topo: Topology,
     """Compiled packed while-loop + fresh init (bench: compile/run split).
     Returns (loop, init, tables); call ``loop(state, *tables)``."""
     from functools import partial
+
+    from gossip_tpu.ops import nemesis as NE
     step, tables = make_packed_round(proto, topo, fault, run.origin,
                                      sampler, run.seed, tabled=True)
+    step = NE.drop_lost(step, NE.get(fault))
     init = init_packed_state(run, proto, topo.n)
     target = jnp.float32(run.target_coverage)
     r = proto.rumors
 
     @partial(jax.jit, donate_argnums=0)
     def loop(state, *tbl):
-        alive = alive_mask(fault, topo.n, run.origin)
+        alive = NE.metric_alive(fault, topo.n, run.origin)
         def cond(s):
             return ((coverage_packed(s.seen, r, alive) < target)
                     & (s.round < run.max_rounds))

@@ -158,7 +158,10 @@ def base_alive(n: int, dead_nodes: Tuple[int, ...],
                fault: Optional[FaultConfig]) -> jax.Array:
     """Static post-``fail_round`` liveness (True = stays alive).  Uses the
     canonical draw from models/state so one FaultConfig kills the same node
-    set in SI and SWIM kernels alike."""
+    set in SI and SWIM kernels alike.  Scripted churn events are NOT in
+    this mask — their die/recover windows are applied per round by the
+    kernels (ops/nemesis; a churn death before its die_round would
+    corrupt the timeline)."""
     from gossip_tpu.models.state import static_death_draw
     alive = jnp.ones((n,), jnp.bool_)
     if dead_nodes:
@@ -167,6 +170,36 @@ def base_alive(n: int, dead_nodes: Tuple[int, ...],
     if drawn is not None:
         alive = alive & drawn
     return alive
+
+
+def observer_alive(n: int, dead_nodes: Tuple[int, ...],
+                   fault: Optional[FaultConfig]) -> jax.Array:
+    """The detection-metric OBSERVER population: :func:`base_alive`
+    minus PERMANENT churn deaths (recover_round < 0) — a forever-down
+    node cannot observe; a node that recovers stays in the denominator
+    (it must re-learn the confirmed deaths via dissemination, which is
+    part of what the heal gate tests)."""
+    from gossip_tpu.ops import nemesis as NE
+    alive = base_alive(n, dead_nodes, fault)
+    dead = NE.permanent_dead_ids(NE.get(fault))
+    if dead:
+        alive = alive.at[jnp.asarray(dead, jnp.int32)].set(False)
+    return alive
+
+
+def detection_targets(dead_nodes: Tuple[int, ...],
+                      fault: Optional[FaultConfig]) -> Tuple[int, ...]:
+    """GLOBAL ids the detection metric must confirm: the scripted static
+    deaths plus PERMANENT churn deaths (recover_round < 0).  A churn
+    crash is exactly the event SWIM exists to detect (Das et al., DSN
+    2002), so a churn-only scenario has real targets; a node that
+    RECOVERS is never a target — the heal gate asserts it is refuted,
+    not confirmed.  Every detection_fraction caller builds its target
+    set here so the four drivers (curve/until/checkpointed/ensemble)
+    cannot disagree."""
+    from gossip_tpu.ops import nemesis as NE
+    return tuple(sorted(set(tuple(dead_nodes))
+                        | set(NE.permanent_dead_ids(NE.get(fault)))))
 
 
 def pack_width(max_rounds) -> int:
@@ -424,6 +457,15 @@ def make_swim_round(proto: ProtocolConfig, n: int,
     rotate = proto.swim_rotate
     epoch_rounds = resolve_epoch_rounds(proto, n)
     drop_prob = 0.0 if fault is None else fault.drop_prob
+    from gossip_tpu.ops import nemesis as NE
+    # SWIM probes ride the complete membership overlay (no per-pair
+    # messages a link cut models) and its drop streams are baked static:
+    # churn EVENTS are the supported schedule — exactly the scenario
+    # SWIM exists to detect (Das et al., DSN 2002)
+    NE.check_supported(fault, engine="swim", partitions=False, ramp=False)
+    ch = NE.get(fault)
+    if ch is not None:
+        NE.validate_events(fault, n)
     if topo is None:
         topo = Topology(nbrs=None, deg=None, n=n, family="complete")
     slots = jnp.arange(s_count, dtype=jnp.int32)
@@ -437,6 +479,13 @@ def make_swim_round(proto: ProtocolConfig, n: int,
         alive_base = base_alive(n, dead_nodes, fault)
         rkey = jax.random.fold_in(state.base_key, state.round)
         alive_now = jnp.where(state.round >= fail_round, alive_base, True)
+        if ch is not None:
+            # scripted crash/recover churn: down for die <= r < rec
+            # (ops/nemesis) — a recovered subject refutes its own
+            # suspicion (step 4) unless the timer already confirmed it
+            sched = NE.build(fault, n)
+            alive_now = alive_now & ~((sched.die <= state.round)
+                                      & (state.round < sched.rec))
         subj_gids = subject_window(state.round, s_count, n, rotate,
                                    epoch_rounds)
         subj_alive = alive_now[subj_gids]

@@ -1,0 +1,394 @@
+"""Compiled nemesis: time-varying fault schedules inside the round loops.
+
+Maelstrom's whole robustness story is dynamic — the nemesis partitions
+the network *mid-run* and the reference converges after heal via
+at-least-once retry (main.go:77-87).  The batched simulator's
+:class:`~gossip_tpu.config.FaultConfig` could only express STATIC
+faults (a fixed death mask, a constant drop rate, one scripted SWIM
+``fail_round``).  This module lowers a
+:class:`~gossip_tpu.config.ChurnConfig` — crash/recover churn,
+partition windows, drop-rate ramps — into a tiny device-resident
+:class:`Schedule` consumed by the loop counter INSIDE every compiled
+round loop (`lax.scan` / `lax.while_loop`), the way the literature's
+scenarios actually run: Demers et al.'s anti-entropy was designed to
+ride out transient link failure, and SWIM (Das et al., DSN 2002) is
+meaningless without churn to detect.
+
+The lowering
+------------
+``Schedule`` is a registered pytree (the RoundMetrics pattern) holding
+
+  * ``die`` / ``rec`` — ``int32[n_pad]``: the round each node goes down
+    / comes back (:data:`NEVER` sentinels).  Node ``i`` is churn-down
+    during ``die[i] <= r < rec[i]``.
+  * ``cut_tbl`` — ``int32[T]``: the partition cut per round (-1 = no
+    window open).  Messages whose endpoints straddle the cut are lost
+    while a window is open.
+  * ``drop_tbl`` — ``f32[T]``: the per-round link drop probability
+    (FaultConfig.drop_prob outside the ramp, linear inside, final
+    value held after).
+
+``T = ChurnConfig.horizon()`` is the round after which the schedule is
+constant by construction (every window closed, ramp finished), so the
+clamped lookup ``tbl[min(r, T-1)]`` is EXACT for every round — the
+tables are config-sized, not run-length-sized, and the same schedule
+serves a 6-round curve and a 10k-round flagship run.  Everything is
+built in-trace from scalars (:func:`build` is called inside the
+drivers' jitted loops — no O(N) inline constants in the compile
+request, the models/swim.py rule), and the arrays can equally ride a
+memoized loop as runtime OPERANDS (parallel/sharded_fused keys its
+lru_cache on ``churn: bool`` only — a churn sweep over schedules
+shares one compiled loop, the alive-mask runtime-operand trick).
+
+Semantics (shared by every kernel — the heal-convergence tests pin
+them):
+
+  * a churn-down node neither sends, responds, nor receives; its
+    digest goes dark (exactly the static-mask contract, per round);
+  * a cross-cut message is lost for that round only — the sender
+    retries implicitly next round (at-least-once, main.go:80-87), so
+    coverage STALLS at the cut while a window is open and converges
+    after heal;
+  * the drop coin for round ``r`` is drawn from the same per-(round,
+    node) streams as the static path (ops/sampling tags), with
+    ``drop_tbl[r]`` as the probability — trajectories are mesh-shape
+    invariant for the same reason peer sampling is.
+
+Observables (wired into ops/round_metrics by the drivers' recorders):
+per-round ``alive`` count, ``cut_pairs`` (alive node pairs separated
+by the open cut — 0 when closed), and ``dropped`` (messages lost to
+drop coins + the cut, counted exactly by the kernels).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from gossip_tpu.config import ChurnConfig, FaultConfig
+
+# Sentinel round for "never": far beyond any realistic max_rounds but
+# safely below int32 overflow under the +1 arithmetic of round counters.
+NEVER = 1 << 29
+
+
+def get(fault: Optional[FaultConfig]) -> Optional[ChurnConfig]:
+    """The schedule carried by a fault config, or None — the ONE probe
+    every kernel factory branches on (FaultConfig normalizes an empty
+    ChurnConfig to None, so `get(fault) is None` == static hot path)."""
+    return fault.churn if fault is not None else None
+
+
+class Schedule:
+    """Device-resident nemesis schedule (module doc).  A registered
+    pytree so it can ride loop carries and jit boundaries; all leaves,
+    no static aux (cut-side observables count over the padded alive
+    mask, whose padding rows are already False)."""
+
+    __slots__ = ("die", "rec", "cut_tbl", "drop_tbl")
+
+    def __init__(self, die, rec, cut_tbl, drop_tbl):
+        self.die = die
+        self.rec = rec
+        self.cut_tbl = cut_tbl
+        self.drop_tbl = drop_tbl
+
+
+def _sched_flatten(s):
+    return ((s.die, s.rec, s.cut_tbl, s.drop_tbl), None)
+
+
+def _sched_unflatten(_, children):
+    return Schedule(*children)
+
+
+jax.tree_util.register_pytree_node(Schedule, _sched_flatten,
+                                   _sched_unflatten)
+
+
+def _event_tables(ch: ChurnConfig, size: int):
+    """die/rec int32[size] round tables from the event list (rec < 0 ->
+    NEVER; unscripted rows NEVER) — the ONE event-scatter lowering,
+    shared by :func:`build` and :func:`fused_word_tables` so the flat
+    and word-rendered engines' churn timelines cannot drift.  In-trace
+    safe (small scatters)."""
+    die = jnp.full((size,), NEVER, jnp.int32)
+    rec = jnp.full((size,), NEVER, jnp.int32)
+    if ch.events:
+        nodes = jnp.asarray([e[0] for e in ch.events], jnp.int32)
+        die = die.at[nodes].set(jnp.asarray(
+            [e[1] for e in ch.events], jnp.int32))
+        rec = rec.at[nodes].set(jnp.asarray(
+            [e[2] if e[2] >= 0 else NEVER for e in ch.events], jnp.int32))
+    return die, rec
+
+
+def build(fault: FaultConfig, n: int, n_pad: Optional[int] = None
+          ) -> Schedule:
+    """Lower ``fault.churn`` to the device tables (in-trace safe: small
+    scatters + static-slice sets only).  ``n_pad`` sizes the die/rec
+    vectors for mesh-padded kernels; padding rows carry NEVER (their
+    deadness comes from the base alive mask, as always)."""
+    ch = fault.churn
+    if ch is None:
+        raise ValueError("build() needs a FaultConfig with a churn "
+                         "schedule (gate on nemesis.get(fault) first)")
+    validate_events(fault, n)
+    n_pad = n if n_pad is None else n_pad
+    die, rec = _event_tables(ch, n_pad)
+    t = ch.horizon()
+    cut_np = [-1] * t
+    for start, end, cut in ch.partitions:
+        for r in range(start, min(end, t)):
+            cut_np[r] = cut
+    drop_np = [float(fault.drop_prob)] * t
+    if ch.ramp is not None:
+        start, end, p0, p1 = ch.ramp
+        for r in range(start, t):
+            frac = min((r - start) / max(end - start, 1), 1.0)
+            drop_np[r] = p0 + (p1 - p0) * frac
+    return Schedule(die=die, rec=rec,
+                    cut_tbl=jnp.asarray(cut_np, jnp.int32),
+                    drop_tbl=jnp.asarray(drop_np, jnp.float32))
+
+
+def validate_events(fault: FaultConfig, n: int) -> None:
+    """Host-side guard: scripted churn must reference real node ids —
+    an out-of-range event would silently scatter-drop (kill nobody)."""
+    ch = fault.churn
+    if ch is None:
+        return
+    bad = [e for e in ch.events if e[0] >= n]
+    if bad:
+        raise ValueError(f"churn events reference node ids >= n={n}: "
+                         f"{bad}")
+    badc = [w for w in ch.partitions if w[2] >= n]
+    if badc:
+        raise ValueError(f"partition cuts >= n={n} leave one side "
+                         f"empty: {badc}")
+
+
+def _idx(tbl, round_):
+    """Clamped schedule lookup — exact beyond the horizon (module doc:
+    the last row is the steady state by construction)."""
+    r = jnp.asarray(round_, jnp.int32)
+    return tbl[jnp.minimum(jnp.maximum(r, 0), tbl.shape[0] - 1)]
+
+
+def alive_rows(sched: Schedule, base_alive: jax.Array,
+               round_) -> jax.Array:
+    """bool[n_pad] liveness at ``round_``: the static base mask minus
+    churn-down nodes (die <= r < rec)."""
+    r = jnp.asarray(round_, jnp.int32)
+    down = (sched.die <= r) & (r < sched.rec)
+    return base_alive & ~down
+
+
+def drop_at(sched: Schedule, round_) -> jax.Array:
+    """f32 scalar drop probability for ``round_`` (traced — kernels on
+    the churn path always draw their drop coins; p=0 rounds draw
+    all-False masks, bitwise a no-op on the trajectory)."""
+    return _idx(sched.drop_tbl, round_)
+
+
+def cut_at(sched: Schedule, round_) -> jax.Array:
+    """int32 scalar partition cut for ``round_`` (-1 = closed)."""
+    return _idx(sched.cut_tbl, round_)
+
+
+def same_side(cut, a, b) -> jax.Array:
+    """True where a message a -> b is allowed by the cut: the window is
+    closed (cut < 0) or both endpoints sit on the same side.  Shapes
+    broadcast; sentinel targets (>= n) land on the high side and are
+    dropped by the kernels' own validity masks either way."""
+    cut = jnp.asarray(cut, jnp.int32)
+    return (cut < 0) | ((jnp.asarray(a, jnp.int32) >= cut)
+                        == (jnp.asarray(b, jnp.int32) >= cut))
+
+
+def partition_targets(cut, src_gids: jax.Array, targets: jax.Array,
+                      sentinel: int) -> jax.Array:
+    """Cross-cut targets -> the kernel's drop sentinel (the same
+    lost-for-this-round-only semantics as ops/sampling.apply_drop:
+    re-sampled next round, at-least-once).  ``src_gids`` broadcasts
+    against ``targets`` ([m] vs [m, k])."""
+    allowed = same_side(cut, src_gids[:, None]
+                        if targets.ndim == src_gids.ndim + 1
+                        else src_gids, targets)
+    return jnp.where(allowed, targets, jnp.asarray(sentinel,
+                                                   targets.dtype))
+
+
+def lost_count(pre: jax.Array, post: jax.Array, active: jax.Array,
+               n: int) -> jax.Array:
+    """f32 messages the nemesis destroyed this round: edge uses that
+    were real targets (< n) from ``active`` senders before the drop
+    coins + cut, minus those still real after.  ``pre``/``post`` are
+    [m, k] target tables around the apply_drop/partition pair;
+    ``active`` is the [m] sender-activity mask (an inactive sender's
+    slot carried no message to lose)."""
+    a = active[:, None]
+    return (jnp.sum((pre < n) & a, dtype=jnp.float32)
+            - jnp.sum((post < n) & a, dtype=jnp.float32))
+
+
+def base_alive_or_ones(fault, n: int, origin: int) -> jax.Array:
+    """The static alive mask as a real array (churn kernels always mask
+    — the None fast path is the static kernels' optimization)."""
+    from gossip_tpu.models.state import alive_mask
+    alive = alive_mask(fault, n, origin)
+    return jnp.ones((n,), jnp.bool_) if alive is None else alive
+
+
+def eventual_alive(fault: FaultConfig, n: int, origin: int) -> jax.Array:
+    """bool[n] steady-state liveness: the static mask minus PERMANENT
+    churn deaths (recover_round < 0).  This is the coverage/convergence
+    denominator under churn — a temporarily-down node stays in it (it
+    will recover and must converge: the heal-convergence contract),
+    while a forever-dead node is unreachable like a static death.
+    Static (config-only), so drivers can use it for while_loop targets
+    without per-round machinery."""
+    alive = base_alive_or_ones(fault, n, origin)
+    dead = permanent_dead_ids(fault.churn)
+    if dead:
+        alive = alive.at[jnp.asarray(dead, jnp.int32)].set(False)
+    return alive
+
+
+def eventual_alive_pad(fault: FaultConfig, n: int, n_pad: int,
+                       origin: int) -> jax.Array:
+    """:func:`eventual_alive` over mesh-padded rows (padding rows dead,
+    the parallel/sharded.sharded_alive contract)."""
+    alive = eventual_alive(fault, n, origin)
+    if n_pad == n:
+        return alive
+    return jnp.concatenate(
+        [alive, jnp.zeros((n_pad - n,), jnp.bool_)], axis=0)
+
+
+def metric_alive(fault: Optional[FaultConfig], n: int, origin: int):
+    """The single-device coverage denominator: the static mask (None
+    when fault-free — the hot-path contract of models/state.alive_mask)
+    or, under a churn schedule, the EVENTUAL alive set
+    (:func:`eventual_alive`): a temporarily-down node stays in the
+    denominator because it recovers and must converge — the
+    heal-convergence contract."""
+    from gossip_tpu.models.state import alive_mask
+    if get(fault) is not None:
+        return eventual_alive(fault, n, origin)
+    return alive_mask(fault, n, origin)
+
+
+def drop_lost(step, ch: Optional[ChurnConfig]):
+    """Normalize a round step to ``state -> state``: a churn-path step
+    returns ``(state, lost)`` (models/si.py contract) — drivers that do
+    not record the lost observable drop it here."""
+    if ch is None:
+        return step
+
+    def wrapped(*args):
+        out, _lost = step(*args)
+        return out
+
+    return wrapped
+
+
+def permanent_dead_ids(ch: Optional[ChurnConfig]):
+    """Node ids the schedule kills forever (recover_round < 0) — the
+    metric-dead set SWIM detection should converge on (host-side,
+    from the config)."""
+    if ch is None:
+        return ()
+    return tuple(e[0] for e in ch.events if e[2] < 0)
+
+
+def fused_base_words(fault: FaultConfig, n: int, origin: int) -> jax.Array:
+    """The STATIC alive mask rendered in the fused engine's
+    one-word-per-node [mr_rows(n), 128] layout (0xFFFFFFFF alive, 0
+    dead/phantom) — always a real array, unlike
+    ops/pallas_round.fault_masks_word's None fast path: churn kernels
+    always mask.  In-trace safe."""
+    from gossip_tpu.ops.pallas_round import render_alive_words
+    return render_alive_words(base_alive_or_ones(fault, n, origin), n)
+
+
+def fused_word_tables(fault: FaultConfig, n: int):
+    """(die_words, rec_words): the die/rec round tables rendered in the
+    fused engine's one-word-per-node [mr_rows(n), 128] layout
+    (ops/pallas_round.fault_masks_word geometry) — int32 rounds, NEVER
+    on padding rows.  In-trace safe (iota + small scatters)."""
+    from gossip_tpu.ops.pallas_round import LANES, mr_rows
+    ch = fault.churn
+    if ch is None:
+        raise ValueError("fused_word_tables needs a churn schedule")
+    # same guard as build(): an out-of-range event id would land on a
+    # phantom lane (or scatter-drop) and silently kill nobody
+    validate_events(fault, n)
+    rows = mr_rows(n)
+    die, rec = _event_tables(ch, rows * LANES)
+    return die.reshape(rows, LANES), rec.reshape(rows, LANES)
+
+
+def fused_alive_words_at(base_words: jax.Array, die_w: jax.Array,
+                         rec_w: jax.Array, round_) -> jax.Array:
+    """Per-round alive word mask for the plane-sharded fused engine:
+    the static 0xFFFFFFFF/0 mask minus churn-down nodes — the runtime
+    OPERAND the compiled fused loops index by their round counter."""
+    r = jnp.asarray(round_, jnp.int32)
+    down = (die_w <= r) & (r < rec_w)
+    return jnp.where(down, jnp.uint32(0), base_words)
+
+
+def fused_eventual_words(base_words: jax.Array, die_w: jax.Array,
+                         rec_w: jax.Array) -> jax.Array:
+    """Steady-state alive words: the base mask minus PERMANENT churn
+    deaths — the fused engine's coverage/convergence denominator under
+    churn (:func:`eventual_alive` rationale, word-rendered)."""
+    forever = (die_w < NEVER) & (rec_w >= NEVER)
+    return jnp.where(forever, jnp.uint32(0), base_words)
+
+
+def check_supported(fault: Optional[FaultConfig], *, engine: str,
+                    partitions: bool = True, ramp: bool = True,
+                    events: bool = True) -> None:
+    """Reject schedule features an engine cannot honor — loudly, never
+    silently (the no-silent-substitution policy).  The plane-sharded
+    fused engine has no per-pair messages to cut and bakes its drop
+    threshold into the kernel; SWIM probes ride the complete membership
+    overlay, which a link cut does not model; ``events=False`` marks an
+    engine with no churn support at all (checkpointed segment drivers,
+    the topo-sparse exchange)."""
+    ch = get(fault)
+    if ch is None:
+        return
+    if not events and ch.events:
+        raise ValueError(
+            f"the {engine} engine does not run churn schedules; use "
+            "the dense/sparse exchanges (docs/ROBUSTNESS.md scenario "
+            "catalog)")
+    if not partitions and ch.partitions:
+        raise ValueError(
+            f"the {engine} engine cannot honor partition windows "
+            "(no per-pair messages to cut); run the dense/sparse/halo "
+            "exchanges for partition scenarios")
+    if not ramp and ch.ramp is not None:
+        raise ValueError(
+            f"the {engine} engine bakes its drop threshold into the "
+            "kernel and cannot honor a drop-rate ramp")
+
+
+def observables(sched: Schedule, alive: jax.Array, round_):
+    """(alive_count, cut_pairs) at ``round_`` — the round_metrics
+    observables the recorders stamp per round.  ``alive`` is the
+    CURRENT padded liveness row mask (padding rows already False);
+    ``cut_pairs`` counts alive pairs separated by the open cut
+    (|A| * |B|), 0 while no window is open."""
+    cut = cut_at(sched, round_)
+    a = jnp.sum(alive, dtype=jnp.float32)
+    ids = jnp.arange(alive.shape[0], dtype=jnp.int32)
+    hi = jnp.sum(alive & (ids >= cut), dtype=jnp.float32)
+    lo = a - hi
+    pairs = jnp.where(cut >= 0, lo * hi, 0.0)
+    return a, pairs

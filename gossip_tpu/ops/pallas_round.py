@@ -763,6 +763,16 @@ def _mr_wants_big(table_bytes: int, fanout: int) -> bool:
     return TABLE_COPIES * table_bytes > _VMEM_LIMIT_BYTES
 
 
+def render_alive_words(alive: jax.Array, n: int) -> jax.Array:
+    """bool[n] -> the fused engines' one-word-per-NODE [mr_rows(n), 128]
+    mask (0xFFFFFFFF alive, 0 dead/phantom) — the ONE rendering of this
+    geometry (ops/nemesis.fused_base_words shares it).  In-trace safe."""
+    rows = mr_rows(n)
+    flat = jnp.zeros((rows * LANES,), jnp.uint32).at[:n].set(
+        jnp.where(alive, jnp.uint32(0xFFFFFFFF), jnp.uint32(0)))
+    return flat.reshape(rows, LANES)
+
+
 def fault_masks_word(fault, n: int, origin: int = 0):
     """(alive_words-or-None, drop_threshold) for the multi-rumor fused
     fault path: the one-word-per-NODE rendering of
@@ -770,13 +780,7 @@ def fault_masks_word(fault, n: int, origin: int = 0):
     and phantom rows.  In-trace safe, like fault_masks_node_packed."""
     from gossip_tpu.models.state import alive_mask
     alive = alive_mask(fault, n, origin)
-    if alive is None:
-        alive_words = None
-    else:
-        rows = mr_rows(n)
-        flat = jnp.zeros((rows * LANES,), jnp.uint32).at[:n].set(
-            jnp.where(alive, jnp.uint32(0xFFFFFFFF), jnp.uint32(0)))
-        alive_words = flat.reshape(rows, LANES)
+    alive_words = None if alive is None else render_alive_words(alive, n)
     return alive_words, drop_threshold_for(fault)
 
 

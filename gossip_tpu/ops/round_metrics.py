@@ -55,6 +55,17 @@ Counter semantics (each a per-round f32; exact unless marked)
             convergence front: a shard whose column lags shows a
             placement/topology pathology no global mean exposes.
 
+Nemesis observables (present when the stack is built with
+``nemesis=True`` — drivers running a :class:`ChurnConfig` schedule,
+ops/nemesis):
+
+``alive``     alive node count after the round's churn events — exact.
+``cut_pairs`` alive node pairs separated by the open partition cut
+              (|A| * |B|; 0 while no window is open) — exact.
+``dropped``   messages lost this round to drop coins + the open cut —
+              counted exactly by the kernels (the ``lost`` output of
+              the churn-aware round steps), never in ``msgs``.
+
 ``GOSSIP_ROUND_METRICS=0`` (or empty) is the kill switch; metrics are
 also skipped when no run ledger is active (:func:`wanted`) — the
 buffers exist to be ledgered, and dark buffers would tax every test
@@ -102,17 +113,22 @@ class RoundMetrics:
     next write row == rounds recorded so far."""
 
     __slots__ = ("cursor", "newly", "dup", "msgs", "bytes", "front",
-                 "label")
+                 "alive", "cut_pairs", "dropped", "label", "nemesis")
 
     def __init__(self, cursor, newly, dup, msgs, bytes, front,
-                 label: str):
+                 alive, cut_pairs, dropped, label: str,
+                 nemesis: bool = False):
         self.cursor = cursor
         self.newly = newly
         self.dup = dup
         self.msgs = msgs
         self.bytes = bytes
         self.front = front
+        self.alive = alive
+        self.cut_pairs = cut_pairs
+        self.dropped = dropped
         self.label = label
+        self.nemesis = nemesis
 
     def _replace(self, **kw):
         fields = {k: getattr(self, k) for k in self.__slots__}
@@ -121,22 +137,26 @@ class RoundMetrics:
 
 
 def _rm_flatten(m):
-    return ((m.cursor, m.newly, m.dup, m.msgs, m.bytes, m.front),
-            m.label)
+    return ((m.cursor, m.newly, m.dup, m.msgs, m.bytes, m.front,
+             m.alive, m.cut_pairs, m.dropped), (m.label, m.nemesis))
 
 
-def _rm_unflatten(label, children):
-    return RoundMetrics(*children, label=label)
+def _rm_unflatten(aux, children):
+    label, nemesis = aux
+    return RoundMetrics(*children, label=label, nemesis=nemesis)
 
 
 jax.tree_util.register_pytree_node(RoundMetrics, _rm_flatten,
                                    _rm_unflatten)
 
 
-def init(max_rounds: int, n_shards: int, label: str) -> RoundMetrics:
+def init(max_rounds: int, n_shards: int, label: str,
+         nemesis: bool = False) -> RoundMetrics:
     """Zeroed buffer stack for up to ``max_rounds`` rounds over
-    ``n_shards`` shards (1 for single-device drivers).  Tiny: 4 T + T*S
-    floats — at the flagship's T=128, S=8 that is 2.5 KB."""
+    ``n_shards`` shards (1 for single-device drivers).  Tiny: 7 T + T*S
+    floats — at the flagship's T=128, S=8 that is 3.5 KB.  ``nemesis``
+    marks a stack that carries the churn observables (alive/cut_pairs/
+    dropped are recorded and ledgered; zeros otherwise)."""
     if max_rounds < 1:
         raise ValueError(f"max_rounds={max_rounds} must be >= 1")
     if n_shards < 1:
@@ -146,24 +166,35 @@ def init(max_rounds: int, n_shards: int, label: str) -> RoundMetrics:
                         bytes=z,
                         front=jnp.zeros((max_rounds, n_shards),
                                         jnp.float32),
-                        label=label)
+                        alive=z, cut_pairs=z, dropped=z,
+                        label=label, nemesis=nemesis)
 
 
 def record(m: RoundMetrics, *, newly, dup, msgs, bytes,
-           front) -> RoundMetrics:
+           front, alive=None, cut_pairs=None,
+           dropped=None) -> RoundMetrics:
     """Write one round's row at the cursor (in-trace; scatter writes
     only).  The cursor is clamped to the last row so an over-long loop
     can never write out of bounds — by contract the drivers size the
-    buffers with ``run.max_rounds``, which also bounds their loops."""
+    buffers with ``run.max_rounds``, which also bounds their loops.
+    The nemesis columns (alive/cut_pairs/dropped) are only written when
+    passed — the static-fault recorders never touch them."""
     i = jnp.minimum(m.cursor, m.newly.shape[0] - 1)
     f32 = lambda v: jnp.asarray(v, jnp.float32)       # noqa: E731
+    kw = {}
+    if alive is not None:
+        kw["alive"] = m.alive.at[i].set(f32(alive))
+    if cut_pairs is not None:
+        kw["cut_pairs"] = m.cut_pairs.at[i].set(f32(cut_pairs))
+    if dropped is not None:
+        kw["dropped"] = m.dropped.at[i].set(f32(dropped))
     return m._replace(
         cursor=m.cursor + 1,
         newly=m.newly.at[i].set(f32(newly)),
         dup=m.dup.at[i].set(f32(dup)),
         msgs=m.msgs.at[i].set(f32(msgs)),
         bytes=m.bytes.at[i].set(f32(bytes)),
-        front=m.front.at[i].set(jnp.asarray(front, jnp.float32)))
+        front=m.front.at[i].set(jnp.asarray(front, jnp.float32)), **kw)
 
 
 # -- per-round counter helpers (all pure in-trace arithmetic) ---------
@@ -282,24 +313,35 @@ def emit(out, ledger, fn=None):
         return
     import numpy as np
     for m in stacks:
-        cursor, newly, dup, msgs, bytes_, front = jax.device_get(
-            (m.cursor, m.newly, m.dup, m.msgs, m.bytes, m.front))
+        (cursor, newly, dup, msgs, bytes_, front, alive, cut_pairs,
+         dropped) = jax.device_get(
+            (m.cursor, m.newly, m.dup, m.msgs, m.bytes, m.front,
+             m.alive, m.cut_pairs, m.dropped))
         r = min(int(cursor), int(newly.shape[0]))
 
         def ser(a, nd=3):
             return [round(float(v), nd) for v in np.asarray(a)[:r]]
 
         front = np.asarray(front)
+        extra = {}
+        if m.nemesis:
+            # the churn observables ride the same event; total dropped
+            # joins the totals so ledger_diff can gate it like msgs
+            extra = {"alive": ser(alive), "cut_pairs": ser(cut_pairs),
+                     "dropped": ser(dropped)}
+        totals = {"newly": round(float(np.sum(newly[:r])), 3),
+                  "dup": round(float(np.sum(dup[:r])), 3),
+                  "msgs": round(float(np.sum(msgs[:r])), 3),
+                  "bytes": round(float(np.sum(bytes_[:r])), 3)}
+        if m.nemesis:
+            totals["dropped"] = round(float(np.sum(dropped[:r])), 3)
         ledger.event(
             "round_metrics", sync=False, driver=m.label, fn=fn,
             rounds=r, shards=int(front.shape[1]),
             newly=ser(newly), dup=ser(dup), msgs=ser(msgs),
-            bytes=ser(bytes_),
+            bytes=ser(bytes_), **extra,
             front=[[round(float(v), 4) for v in row]
                    for row in front[:r]],
-            totals={"newly": round(float(np.sum(newly[:r])), 3),
-                    "dup": round(float(np.sum(dup[:r])), 3),
-                    "msgs": round(float(np.sum(msgs[:r])), 3),
-                    "bytes": round(float(np.sum(bytes_[:r])), 3)},
+            totals=totals,
             front_final=([round(float(v), 4) for v in front[r - 1]]
                          if r else None))

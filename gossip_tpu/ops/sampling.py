@@ -41,11 +41,16 @@ def drop_mask(round_key: jax.Array, tag: int, global_ids: jax.Array,
 
 def apply_drop(round_key: jax.Array, tag: int, global_ids: jax.Array,
                targets: jax.Array, drop_prob: float,
-               sentinel: int) -> jax.Array:
+               sentinel: int, force: bool = False) -> jax.Array:
     """Lossy links: turn dropped targets into the sentinel (scatter-dropped,
     gather-masked).  A dropped push/pull is simply retried in a later round —
-    the batched analog of at-least-once delivery (reference main.go:80-87)."""
-    if drop_prob <= 0.0:
+    the batched analog of at-least-once delivery (reference main.go:80-87).
+
+    ``force=True`` skips the static zero-rate early-out so ``drop_prob``
+    may be a TRACED per-round scalar (the ops/nemesis drop-ramp path —
+    bernoulli takes a traced p; a p=0 round draws an all-False mask,
+    bitwise a no-op on the trajectory)."""
+    if not force and drop_prob <= 0.0:
         return targets
     dropped = drop_mask(round_key, tag, global_ids, targets.shape[1],
                         drop_prob)

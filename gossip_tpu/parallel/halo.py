@@ -118,14 +118,28 @@ def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
             "shards — use the all_gather kernels (parallel/sharded.py)")
     band = max(band, 1)            # ppermute of 0 rows is degenerate
     drop_prob = 0.0 if fault is None else fault.drop_prob
+    from gossip_tpu.ops import nemesis as NE
+    ch = NE.get(fault)
+    if ch is not None:
+        NE.validate_events(fault, n)
 
     def local_round(seen_l, round_, base_key, msgs, nbrs_l, deg_l):
         shard = jax.lax.axis_index(axis_name)
         gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
         rkey = jax.random.fold_in(base_key, round_)
         # liveness in-trace (replicated compute, no O(N) inline constant)
-        alive = alive_mask(fault, n, origin)
-        alive_full = (jnp.ones((n,), jnp.bool_) if alive is None else alive)
+        if ch is not None:
+            sched = NE.build(fault, n)
+            alive_full = NE.alive_rows(
+                sched, NE.base_alive_or_ones(fault, n, origin), round_)
+            dp = NE.drop_at(sched, round_)
+            cut = NE.cut_at(sched, round_)
+        else:
+            alive = alive_mask(fault, n, origin)
+            alive_full = (jnp.ones((n,), jnp.bool_) if alive is None
+                          else alive)
+            dp, cut = drop_prob, None
+        lost = jnp.float32(0.0)
         alive_l = alive_full[gids]
         visible = seen_l & alive_l[:, None]
         ext = _exchange_halos(visible, band, axis_name)   # [nl+2B, R]
@@ -140,7 +154,20 @@ def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
         delta = jnp.zeros_like(seen_l)
         if mode == C.FLOOD:
             nbrs_use = nbrs_l
-            if drop_prob > 0.0:
+            if ch is not None:
+                # churn path: always draw (traced p), then cut the
+                # cross-partition edges (models/si.py flood twin)
+                dropped = drop_mask(rkey, si_mod.FLOOD_DROP_TAG, gids,
+                                    nbrs_use.shape[1], dp)
+                nbrs_use = jnp.where(dropped, jnp.int32(n), nbrs_use)
+                nbrs_use = NE.partition_targets(cut, gids, nbrs_use, n)
+                valid0 = nbrs_l < n
+                act_ext = jnp.any(ext, axis=1)
+                sender_up = act_ext[jnp.where(valid0, to_ext(nbrs_l), 0)]
+                lost = lost + jnp.sum(valid0 & sender_up
+                                      & (nbrs_use >= n),
+                                      dtype=jnp.float32)
+            elif drop_prob > 0.0:
                 dropped = drop_mask(rkey, si_mod.FLOOD_DROP_TAG, gids,
                                     nbrs_use.shape[1], drop_prob)
                 nbrs_use = jnp.where(dropped, jnp.int32(n), nbrs_use)
@@ -157,11 +184,16 @@ def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
             # reverse ppermute (O(band) bytes, the push twin of the halo
             # read)
             pkey = jax.random.fold_in(rkey, si_mod.PUSH_TAG)
-            targets = sample_peers(pkey, gids, topo, k, proto.exclude_self,
-                                   local_nbrs=nbrs_l, local_deg=deg_l)
+            targets0 = sample_peers(pkey, gids, topo, k, proto.exclude_self,
+                                    local_nbrs=nbrs_l, local_deg=deg_l)
             targets = apply_drop(rkey, si_mod.PUSH_DROP_TAG, gids,
-                                 targets, drop_prob, n)
+                                 targets0, dp, n, force=ch is not None)
+            if ch is not None:
+                targets = NE.partition_targets(cut, gids, targets, n)
             sender_active = jnp.any(visible, axis=1)
+            if ch is not None:
+                lost = lost + NE.lost_count(targets0, targets,
+                                            sender_active, n)
             valid = (targets < n) & sender_active[:, None]
             ext_rows = nl + 2 * band
             tloc = jnp.where(valid, to_ext(targets), ext_rows)  # drop
@@ -185,10 +217,14 @@ def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
 
         if mode in (C.PULL, C.PUSH_PULL):
             qkey = jax.random.fold_in(rkey, si_mod.PULL_TAG)
-            partners = sample_peers(qkey, gids, topo, k, proto.exclude_self,
-                                    local_nbrs=nbrs_l, local_deg=deg_l)
+            partners0 = sample_peers(qkey, gids, topo, k, proto.exclude_self,
+                                     local_nbrs=nbrs_l, local_deg=deg_l)
             partners = apply_drop(rkey, si_mod.PULL_DROP_TAG, gids,
-                                  partners, drop_prob, n)
+                                  partners0, dp, n, force=ch is not None)
+            if ch is not None:
+                partners = NE.partition_targets(cut, gids, partners, n)
+                lost = lost + NE.lost_count(partners0, partners,
+                                            alive_l, n)
             valid = partners < n
             got = ext[jnp.where(valid, to_ext(partners), 0)]
             delta = delta | jnp.any(got & valid[:, :, None], axis=1)
@@ -198,20 +234,26 @@ def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
 
         delta = delta & alive_l[:, None]
         msgs_new = msgs + jax.lax.psum(msgs_local, axis_name)
+        if ch is not None:
+            return (seen_l | delta, msgs_new,
+                    jax.lax.psum(lost, axis_name))
         return seen_l | delta, msgs_new
 
     sh2 = P(axis_name, None)
     rep = P()
+    out_specs = (sh2, rep, rep) if ch is not None else (sh2, rep)
     mapped = shard_map(
         local_round, mesh=mesh,
         in_specs=(sh2, rep, rep, rep, sh2, P(axis_name)),
-        out_specs=(sh2, rep))
+        out_specs=out_specs)
 
-    def step_tabled(state: SimState, *tbl) -> SimState:
-        seen, msgs = mapped(state.seen, state.round, state.base_key,
-                            state.msgs, *tbl)
-        return SimState(seen=seen, round=state.round + 1,
-                        base_key=state.base_key, msgs=msgs)
+    def step_tabled(state: SimState, *tbl):
+        out = mapped(state.seen, state.round, state.base_key,
+                     state.msgs, *tbl)
+        new = SimState(seen=out[0], round=state.round + 1,
+                       base_key=state.base_key, msgs=out[1])
+        # churn path returns (state, lost) — the models/si.py contract
+        return (new, out[2]) if ch is not None else new
 
     return bind_tables(step_tabled, (topo.nbrs, topo.deg), tabled)
 
@@ -223,18 +265,20 @@ def simulate_until_halo(proto: ProtocolConfig, topo: Topology,
     """lax.while_loop to target coverage on the O(band) halo path.
     Returns (rounds, coverage, msgs, final_state, band).
     ``timing``: optional compile/steady AOT-split dict."""
+    from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.utils.trace import maybe_aot_timed
     from gossip_tpu.models.si import coverage
     from gossip_tpu.parallel.sharded import init_sharded_state
     step, tables = make_halo_round(proto, topo, mesh, fault, run.origin,
                                    axis_name, tabled=True)
+    step = NE.drop_lost(step, NE.get(fault))
     init = init_sharded_state(run, proto, topo, mesh, axis_name)
     target = jnp.float32(run.target_coverage)
     n = topo.n
 
     @jax.jit
     def loop(state, *tbl):
-        alive = alive_mask(fault, n, run.origin)
+        alive = NE.metric_alive(fault, n, run.origin)
         def cond(s):
             return ((coverage(s.seen, alive) < target)
                     & (s.round < run.max_rounds))
@@ -243,7 +287,7 @@ def simulate_until_halo(proto: ProtocolConfig, topo: Topology,
         return jax.lax.while_loop(cond, body, state)
 
     final = maybe_aot_timed(loop, timing, init, *tables)
-    alive = alive_mask(fault, n, run.origin)
+    alive = NE.metric_alive(fault, n, run.origin)
     return (int(final.round), float(coverage(final.seen, alive)),
             float(final.msgs), final, band_of(topo))
 
@@ -255,17 +299,19 @@ def simulate_curve_halo(proto: ProtocolConfig, topo: Topology,
     """lax.scan over rounds recording (coverage, msgs) on the halo path.
     Returns (coverage[T], msgs[T], final_state, band).
     ``timing``: optional compile/steady AOT-split dict."""
+    from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.utils.trace import maybe_aot_timed
     from gossip_tpu.models.si import coverage
     from gossip_tpu.parallel.sharded import init_sharded_state
     step, tables = make_halo_round(proto, topo, mesh, fault, run.origin,
                                    axis_name, tabled=True)
+    step = NE.drop_lost(step, NE.get(fault))
     init = init_sharded_state(run, proto, topo, mesh, axis_name)
     n = topo.n
 
     @jax.jit
     def scan(state, *tbl):
-        alive = alive_mask(fault, n, run.origin)
+        alive = NE.metric_alive(fault, n, run.origin)
         def body(s, _):
             s = step(s, *tbl)
             return s, (coverage(s.seen, alive), s.msgs)

@@ -109,6 +109,10 @@ def make_sharded_si_round(
     n_pad = pad_to_mesh(n, mesh, axis_name)
     nl = n_pad // mesh.shape[axis_name]
     drop_prob = 0.0 if fault is None else fault.drop_prob
+    from gossip_tpu.ops import nemesis as NE
+    ch = NE.get(fault)
+    if ch is not None:
+        NE.validate_events(fault, n)
 
     have_table = not topo.implicit
     if have_table:
@@ -122,7 +126,19 @@ def make_sharded_si_round(
         gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
         rkey = jax.random.fold_in(base_key, round_)
         # liveness in-trace (replicated compute, no O(N) inline constant)
-        alive_l = sharded_alive(fault, n, n_pad, origin)[gids]
+        if ch is not None:
+            # churn path: per-round liveness / drop prob / cut from the
+            # schedule tables, indexed by the loop counter (ops/nemesis)
+            sched = NE.build(fault, n, n_pad)
+            base_pad = _pad_rows(
+                NE.base_alive_or_ones(fault, n, origin), n_pad, False)
+            alive_l = NE.alive_rows(sched, base_pad, round_)[gids]
+            dp = NE.drop_at(sched, round_)
+            cut = NE.cut_at(sched, round_)
+        else:
+            alive_l = sharded_alive(fault, n, n_pad, origin)[gids]
+            dp, cut = drop_prob, None
+        lost = jnp.float32(0.0)
         visible = seen_l & alive_l[:, None]
         delta = jnp.zeros_like(seen_l)
         msgs_local = jnp.float32(0.0)
@@ -133,10 +149,12 @@ def make_sharded_si_round(
 
         if mode in (C.PUSH, C.PUSH_PULL):
             pkey = jax.random.fold_in(rkey, si_mod.PUSH_TAG)
-            targets = sample_peers(pkey, gids, topo, k, proto.exclude_self,
-                                   local_nbrs=nbrs_l, local_deg=deg_l)
+            targets0 = sample_peers(pkey, gids, topo, k, proto.exclude_self,
+                                    local_nbrs=nbrs_l, local_deg=deg_l)
             targets = apply_drop(rkey, si_mod.PUSH_DROP_TAG, gids,
-                                 targets, drop_prob, n)
+                                 targets0, dp, n, force=ch is not None)
+            if ch is not None:
+                targets = NE.partition_targets(cut, gids, targets, n)
             sender_active = jnp.any(visible, axis=1)
             valid = (targets < n) & sender_active[:, None]
             # invalid -> n_pad so scatter mode='drop' really drops them
@@ -147,17 +165,29 @@ def make_sharded_si_round(
                                             scatter_dimension=0, tiled=True)
             delta = delta | (counts_l > 0)
             msgs_local = msgs_local + jnp.sum(valid).astype(jnp.float32)
+            if ch is not None:
+                lost = lost + NE.lost_count(targets0, targets,
+                                            sender_active, n)
 
         if mode in (C.PULL, C.PUSH_PULL, C.ANTI_ENTROPY):
             seen_all = jax.lax.all_gather(visible, axis_name, tiled=True)
             qkey = jax.random.fold_in(rkey, si_mod.PULL_TAG)
-            partners = sample_peers(qkey, gids, topo, k, proto.exclude_self,
-                                    local_nbrs=nbrs_l, local_deg=deg_l)
+            partners0 = sample_peers(qkey, gids, topo, k, proto.exclude_self,
+                                     local_nbrs=nbrs_l, local_deg=deg_l)
             partners = apply_drop(rkey, si_mod.PULL_DROP_TAG, gids,
-                                  partners, drop_prob, n)
+                                  partners0, dp, n, force=ch is not None)
+            if ch is not None:
+                partners = NE.partition_targets(cut, gids, partners, n)
             pulled = pull_merge(seen_all, partners, n)
             partners = jnp.where(alive_l[:, None], partners, n)
             n_req = jnp.sum(partners < n).astype(jnp.float32)
+            if ch is not None:
+                lost_pull = NE.lost_count(partners0, partners, alive_l, n)
+                if mode == C.ANTI_ENTROPY and proto.period > 1:
+                    # quiescent rounds send nothing, so nothing is lost
+                    lost_pull = jnp.where((round_ % proto.period) == 0,
+                                          lost_pull, 0.0)
+                lost = lost + lost_pull
             if mode == C.ANTI_ENTROPY:
                 # bidirectional reconciliation (twin of models/si.py): the
                 # initiator's state scatters back into the partner's row
@@ -190,7 +220,19 @@ def make_sharded_si_round(
         if mode == C.FLOOD:
             seen_all = jax.lax.all_gather(visible, axis_name, tiled=True)
             nbrs_use = nbrs_l
-            if drop_prob > 0.0:
+            if ch is not None:
+                # churn path: always draw (traced p), then cut the
+                # cross-partition edges (models/si.py flood twin)
+                dropped = drop_mask(rkey, si_mod.FLOOD_DROP_TAG, gids,
+                                    nbrs_use.shape[1], dp)
+                nbrs_use = jnp.where(dropped, jnp.int32(n), nbrs_use)
+                nbrs_use = NE.partition_targets(cut, gids, nbrs_use, n)
+                act_full = jnp.any(seen_all, axis=1)
+                edge_live = ((nbrs_l < n)
+                             & act_full[jnp.clip(nbrs_l, 0, n - 1)])
+                lost = lost + jnp.sum(edge_live & (nbrs_use >= n),
+                                      dtype=jnp.float32)
+            elif drop_prob > 0.0:
                 dropped = drop_mask(rkey, si_mod.FLOOD_DROP_TAG, gids,
                                     nbrs_use.shape[1], drop_prob)
                 nbrs_use = jnp.where(dropped, jnp.int32(n), nbrs_use)
@@ -201,6 +243,9 @@ def make_sharded_si_round(
 
         delta = delta & alive_l[:, None]
         msgs_new = msgs + jax.lax.psum(msgs_local, axis_name)
+        if ch is not None:
+            return (seen_l | delta, msgs_new,
+                    jax.lax.psum(lost, axis_name))
         return seen_l | delta, msgs_new
 
     sh = P(axis_name)          # rows sharded
@@ -212,15 +257,19 @@ def make_sharded_si_round(
         in_specs += [sh2, sh]
         tables = (nbrs_pad, deg_pad)
 
+    out_specs = (sh2, rep, rep) if ch is not None else (sh2, rep)
     mapped = shard_map(local_round, mesh=mesh,
                            in_specs=tuple(in_specs),
-                           out_specs=(sh2, rep))
+                           out_specs=out_specs)
 
-    def step_tabled(state: SimState, *tbl) -> SimState:
-        seen, msgs = mapped(state.seen, state.round, state.base_key,
-                            state.msgs, *tbl)
-        return SimState(seen=seen, round=state.round + 1,
-                        base_key=state.base_key, msgs=msgs)
+    def step_tabled(state: SimState, *tbl):
+        out = mapped(state.seen, state.round, state.base_key,
+                     state.msgs, *tbl)
+        seen, msgs = out[0], out[1]
+        new = SimState(seen=seen, round=state.round + 1,
+                       base_key=state.base_key, msgs=msgs)
+        # churn path returns (state, lost) — the models/si.py contract
+        return (new, out[2]) if ch is not None else new
 
     return bind_tables(step_tabled, tables, tabled)
 
@@ -277,17 +326,39 @@ def _dense_recorder(proto: ProtocolConfig, n_pad: int, n_shards: int):
     bytes_of = _dense_round_bytes(proto, n_pad, n_pad // n_shards)
     offered_per_msg = proto.rumors * RM.payload_factor(proto.mode)
 
-    def rec(m, prev_count, round0, msgs0, s1, alive_pad):
+    def rec(m, prev_count, round0, msgs0, s1, alive_pad, nem=None):
         count = RM.count_bool(s1.seen, alive_pad)
         newly = count - prev_count
         msgs = s1.msgs - msgs0
+        kw = ({} if nem is None
+              else dict(alive=nem[0], cut_pairs=nem[1], dropped=nem[2]))
         return RM.record(
             m, newly=newly, msgs=msgs,
             dup=RM.dup_estimate(offered_per_msg * msgs, newly),
             bytes=bytes_of(round0),
-            front=RM.front_bool(s1.seen, alive_pad, n_shards)), count
+            front=RM.front_bool(s1.seen, alive_pad, n_shards), **kw), count
 
     return rec
+
+
+def _churn_observables(fault, n: int, n_pad: int, origin: int):
+    """``(round0, lost) -> (alive, cut_pairs, dropped)`` for the
+    recorders, or None without a churn schedule — the in-trace nemesis
+    observable row (ops/nemesis.observables + the kernel's exact lost
+    count), shared by every sharded driver family."""
+    from gossip_tpu.ops import nemesis as NE
+    if NE.get(fault) is None:
+        return None
+
+    def obs(round0, lost):
+        sched = NE.build(fault, n, n_pad)
+        base_pad = _pad_rows(NE.base_alive_or_ones(fault, n, origin),
+                             n_pad, False)
+        alive_now = NE.alive_rows(sched, base_pad, round0)
+        a, pairs = NE.observables(sched, alive_now, round0)
+        return a, pairs, lost
+
+    return obs
 
 
 def simulate_curve_sharded(proto: ProtocolConfig, topo: Topology,
@@ -306,25 +377,34 @@ def simulate_curve_sharded(proto: ProtocolConfig, topo: Topology,
 
     from gossip_tpu.ops import round_metrics as RM
     from gossip_tpu.utils.trace import maybe_aot_timed
+    from gossip_tpu.ops import nemesis as NE
     step, tables = make_sharded_si_round(proto, topo, mesh, fault,
                                          run.origin, axis_name, tabled=True)
     n_pad = pad_to_mesh(topo.n, mesh, axis_name)
     init = init_sharded_state(run, proto, topo, mesh, axis_name)
     n_shards = mesh.shape[axis_name]
     rec = _dense_recorder(proto, n_pad, n_shards) if RM.wanted() else None
+    ch = NE.get(fault)
+    obs = _churn_observables(fault, topo.n, n_pad, run.origin)
 
     @jax.jit
     def scan(state, *tbl):
-        alive_pad = sharded_alive(fault, topo.n, n_pad, run.origin)
-        m0 = (RM.init(run.max_rounds, n_shards, "simulate_curve_sharded")
-              if rec else None)
+        alive_pad = (NE.eventual_alive_pad(fault, topo.n, n_pad,
+                                           run.origin) if ch is not None
+                     else sharded_alive(fault, topo.n, n_pad, run.origin))
+        m0 = (RM.init(run.max_rounds, n_shards, "simulate_curve_sharded",
+                      nemesis=ch is not None) if rec else None)
         c0 = RM.count_bool(state.seen, alive_pad) if rec else None
         def body(carry, _):
             s0, m, cnt = carry
             round0, msgs0 = s0.round, s0.msgs
-            s = step(s0, *tbl)
+            if ch is not None:
+                s, lost = step(s0, *tbl)
+            else:
+                s, lost = step(s0, *tbl), None
             if m is not None:
-                m, cnt = rec(m, cnt, round0, msgs0, s, alive_pad)
+                m, cnt = rec(m, cnt, round0, msgs0, s, alive_pad,
+                             nem=obs(round0, lost) if obs else None)
             return (s, m, cnt), (coverage(s.seen, alive_pad), s.msgs)
         return jax.lax.scan(body, (state, m0, c0), None,
                             length=run.max_rounds)
@@ -346,20 +426,27 @@ def simulate_until_sharded(proto: ProtocolConfig, topo: Topology,
     (ops/round_metrics)."""
     from gossip_tpu.ops import round_metrics as RM
     from gossip_tpu.utils.trace import maybe_aot_timed
+    from gossip_tpu.ops import nemesis as NE
     step, tables = make_sharded_si_round(proto, topo, mesh, fault,
                                          run.origin, axis_name, tabled=True)
     n_pad = pad_to_mesh(topo.n, mesh, axis_name)
-    alive_pad = sharded_alive(fault, topo.n, n_pad, run.origin)
+    ch = NE.get(fault)
+    alive_pad = (NE.eventual_alive_pad(fault, topo.n, n_pad, run.origin)
+                 if ch is not None
+                 else sharded_alive(fault, topo.n, n_pad, run.origin))
     init = init_sharded_state(run, proto, topo, mesh, axis_name)
     target = jnp.float32(run.target_coverage)
     n_shards = mesh.shape[axis_name]
     rec = _dense_recorder(proto, n_pad, n_shards) if RM.wanted() else None
+    obs = _churn_observables(fault, topo.n, n_pad, run.origin)
 
     @jax.jit
     def loop(state, *tbl):
-        alive_t = sharded_alive(fault, topo.n, n_pad, run.origin)
-        m0 = (RM.init(run.max_rounds, n_shards, "simulate_until_sharded")
-              if rec else None)
+        alive_t = (NE.eventual_alive_pad(fault, topo.n, n_pad, run.origin)
+                   if ch is not None
+                   else sharded_alive(fault, topo.n, n_pad, run.origin))
+        m0 = (RM.init(run.max_rounds, n_shards, "simulate_until_sharded",
+                      nemesis=ch is not None) if rec else None)
         c0 = RM.count_bool(state.seen, alive_t) if rec else None
         def cond(carry):
             s, _, _ = carry
@@ -368,9 +455,13 @@ def simulate_until_sharded(proto: ProtocolConfig, topo: Topology,
         def body(carry):
             s0, m, cnt = carry
             round0, msgs0 = s0.round, s0.msgs
-            s = step(s0, *tbl)
+            if ch is not None:
+                s, lost = step(s0, *tbl)
+            else:
+                s, lost = step(s0, *tbl), None
             if m is not None:
-                m, cnt = rec(m, cnt, round0, msgs0, s, alive_t)
+                m, cnt = rec(m, cnt, round0, msgs0, s, alive_t,
+                             nem=obs(round0, lost) if obs else None)
             return s, m, cnt
         return jax.lax.while_loop(cond, body, (state, m0, c0))
 

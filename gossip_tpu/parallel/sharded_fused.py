@@ -148,6 +148,27 @@ def _cached_alive_words(fault, n: int, origin: int):
     return jax.jit(lambda: fault_masks_word(fault, n, origin)[0])
 
 
+@functools.lru_cache(maxsize=32)
+def _cached_churn_masks(fault, n: int, origin: int):
+    """Jitted builder of the churn-path mask operands: ``(cov_words,
+    base_words, die_words, rec_words)`` — the EVENTUAL alive words the
+    cond/coverage compare against (ops/nemesis.fused_eventual_words:
+    permanent churn deaths out of the denominator, transient ones in —
+    the heal-convergence contract), the static base mask, and the
+    die/recover round tables the compiled loop indexes by its round
+    counter.  All runtime OPERANDS: a churn sweep over schedules shares
+    one compiled loop (the alive-mask runtime-operand trick)."""
+    from gossip_tpu.ops import nemesis as NE
+
+    def build():
+        base = NE.fused_base_words(fault, n, origin)
+        die_w, rec_w = NE.fused_word_tables(fault, n)
+        return (NE.fused_eventual_words(base, die_w, rec_w), base,
+                die_w, rec_w)
+
+    return jax.jit(build)
+
+
 def fused_planes_cov_fn(n: int, fault=None, origin: int = 0):
     """``planes -> coverage`` — alive-weighted iff the fault draws
     deaths (cf. ops/pallas_round.fused_cov_fn); a fault-binding wrapper
@@ -226,16 +247,31 @@ def make_sharded_fused_round(n: int, mesh: Mesh, fanout: int = 1,
     ``fault`` (round 4) threads the static fault masks into every
     plane's kernel call — a fault-binding wrapper around
     :func:`make_sharded_fused_round_masked` that rebuilds the alive mask
-    in-trace per call (loop-invariant, hoisted by jitted callers)."""
+    in-trace per call (loop-invariant, hoisted by jitted callers).
+    Churn EVENTS render the mask per round from the die/recover word
+    tables (ops/nemesis); partitions and ramps are rejected — no
+    per-pair messages to cut, drop threshold baked static."""
+    from gossip_tpu.ops import nemesis as NE
+    NE.check_supported(fault, engine="fused-planes", partitions=False,
+                       ramp=False)
     drop_threshold = drop_threshold_for(fault)
-    has_alive = fault is not None and bool(fault.node_death_rate)
+    has_churn = NE.get(fault) is not None
+    has_alive = (fault is not None
+                 and bool(fault.node_death_rate)) or has_churn
     core = make_sharded_fused_round_masked(
         n, mesh, fanout, interpret, inject_bits=inject_bits,
         drop_threshold=drop_threshold, has_alive=has_alive)
 
     def round_fn(planes, seed, round_):
-        alive_words = (fault_masks_word(fault, n, origin)[0]
-                       if has_alive else None)
+        if has_churn:
+            base = NE.fused_base_words(fault, n, origin)
+            die_w, rec_w = NE.fused_word_tables(fault, n)
+            alive_words = NE.fused_alive_words_at(base, die_w, rec_w,
+                                                  round_)
+        elif has_alive:
+            alive_words = fault_masks_word(fault, n, origin)[0]
+        else:
+            alive_words = None
         return core(planes, seed, round_, alive_words)
 
     return round_fn
@@ -330,8 +366,13 @@ def checkpointed_fused_planes(n: int, rumors: int, run: RunConfig,
 
     Returns ``(final_state, coverage, curve-or-None)``.
     """
+    from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.ops.pallas_round import FusedState
     from gossip_tpu.utils.checkpoint import run_with_checkpoints
+    # the checkpointed coverage chooser predates the churn denominator;
+    # reject rather than report a wrong convergence metric
+    NE.check_supported(fault, engine="checkpointed-fused", events=False,
+                       partitions=False, ramp=False)
     round_fn = make_sharded_fused_round(n, mesh, fanout, interpret,
                                         fault=fault, origin=run.origin)
     cov_planes = fused_planes_cov_fn(n, fault, run.origin)
@@ -396,7 +437,8 @@ def _plane_recorder(n: int, fanout: int, mesh: Mesh):
 @functools.lru_cache(maxsize=32)
 def _cached_curve_scan(n: int, seed: int, max_rounds: int, mesh: Mesh,
                        fanout: int, interpret: bool, drop_threshold: int,
-                       has_alive: bool, metrics: bool = False):
+                       has_alive: bool, metrics: bool = False,
+                       has_churn: bool = False):
     """The compiled curve-scan driver, memoized by EXACTLY the statics
     its trace bakes in (seed and max_rounds are closed-over literals) —
     not the whole RunConfig, whose unused fields (engine, checkpoint
@@ -416,27 +458,39 @@ def _cached_curve_scan(n: int, seed: int, max_rounds: int, mesh: Mesh,
     steady path does no per-round host round-trip.  ``metrics`` bakes
     the round-metrics buffer carry into the program (ops/round_metrics
     — part of the memo key: the instrumented and bare loops are
-    different executables)."""
+    different executables).  ``has_churn`` switches the mask operands
+    to the ``(cov_words, base, die, rec)`` quadruple of
+    :func:`_cached_churn_masks`: the loop indexes the die/recover round
+    tables by its own counter (churn schedules ride the memoized loop
+    as runtime OPERANDS — one compiled loop per shape serves every
+    schedule, the alive-mask trick), while the cond/coverage compare
+    against the EVENTUAL alive words."""
+    from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.ops import round_metrics as RM
     step = make_sharded_fused_round_masked(
         n, mesh, fanout, interpret, drop_threshold=drop_threshold,
-        has_alive=has_alive)
+        has_alive=has_alive or has_churn)
     rec = _plane_recorder(n, fanout, mesh) if metrics else None
 
     @functools.partial(jax.jit, donate_argnums=0)
     def scan(planes, *masks):
-        alive_words = masks[0] if has_alive else None
+        if has_churn:
+            cov_words, base_w, die_w, rec_w = masks
+        else:
+            cov_words = masks[0] if has_alive else None
         m0 = (RM.init(max_rounds, mesh.shape[AXIS],
                       "simulate_curve_sharded_fused") if rec else None)
         c0 = RM.count_planes(planes) if rec else None
 
         def body(c, _):
             planes_c, round_c, m, cnt = c
-            planes_n = step(planes_c, seed, round_c, alive_words)
+            aw = (NE.fused_alive_words_at(base_w, die_w, rec_w, round_c)
+                  if has_churn else cov_words)
+            planes_n = step(planes_c, seed, round_c, aw)
             if m is not None:
                 m, cnt = rec(m, cnt, planes_n)
             return ((planes_n, round_c + 1, m, cnt),
-                    coverage_planes_masked(planes_n, n, alive_words))
+                    coverage_planes_masked(planes_n, n, cov_words))
         (final, _, m, _), covs = jax.lax.scan(
             body, (planes, jnp.int32(0), m0, c0), None,
             length=max_rounds)
@@ -446,16 +500,23 @@ def _cached_curve_scan(n: int, seed: int, max_rounds: int, mesh: Mesh,
 
 
 def _init_and_masks(n: int, rumors: int, run: RunConfig, mesh: Mesh,
-                    fault, has_alive: bool, timing):
+                    fault, has_alive: bool, timing,
+                    has_churn: bool = False):
     """(init_planes, masks): the cached-jitted state/mask builders shared
     by both simulate drivers.  With a ``timing`` dict the build is
     blocked-on and recorded as ``init_build_s`` — the driver-side
     component of the wall decomposition (backend._timing_meta folds it
-    into ``driver_overhead_s``; the dry run reports it per family)."""
+    into ``driver_overhead_s``; the dry run reports it per family).
+    ``has_churn`` builds the churn mask quadruple instead
+    (:func:`_cached_churn_masks`)."""
     t0 = time.perf_counter()
     init = init_plane_state(n, rumors, mesh, run.origin)
-    masks = ((_cached_alive_words(fault, n, run.origin)(),)
-             if has_alive else ())
+    if has_churn:
+        masks = tuple(_cached_churn_masks(fault, n, run.origin)())
+    elif has_alive:
+        masks = (_cached_alive_words(fault, n, run.origin)(),)
+    else:
+        masks = ()
     if timing is not None:
         jax.block_until_ready((init,) + masks)
         timing["init_build_s"] = time.perf_counter() - t0
@@ -474,14 +535,18 @@ def simulate_curve_sharded_fused(n: int, rumors: int, run: RunConfig,
     maybe_aot_timed contract — AOT compile/steady split by default,
     ``{"aot": False}`` for a steady-only probe on the cached
     executable; plus ``init_build_s``, see :func:`_init_and_masks`)."""
+    from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.ops import round_metrics as RM
     from gossip_tpu.utils.trace import maybe_aot_timed
+    NE.check_supported(fault, engine="fused-planes", partitions=False,
+                       ramp=False)
     has_alive = fault is not None and bool(fault.node_death_rate)
+    has_churn = NE.get(fault) is not None
     scan = _cached_curve_scan(n, run.seed, run.max_rounds, mesh, fanout,
                               interpret, drop_threshold_for(fault),
-                              has_alive, RM.wanted())
+                              has_alive, RM.wanted(), has_churn)
     init, masks = _init_and_masks(n, rumors, run, mesh, fault, has_alive,
-                                  timing)
+                                  timing, has_churn)
     final, covs, _ = maybe_aot_timed(scan, timing, init, *masks)
     return covs, final
 
@@ -490,7 +555,8 @@ def simulate_curve_sharded_fused(n: int, rumors: int, run: RunConfig,
 def _cached_until_loop(n: int, seed: int, max_rounds: int,
                        target_coverage: float, mesh: Mesh,
                        fanout: int, interpret: bool, drop_threshold: int,
-                       has_alive: bool, metrics: bool = False):
+                       has_alive: bool, metrics: bool = False,
+                       has_churn: bool = False):
     """The compiled until-target driver, memoized like
     :func:`_cached_curve_scan` (same key contract and rationale, plus
     the target the cond compares against).  Returns ``loop(planes,
@@ -501,30 +567,37 @@ def _cached_until_loop(n: int, seed: int, max_rounds: int,
     convergence check runs on device inside the while_loop cond; steady
     state does no per-round host round-trip.  ``metrics`` bakes the
     round-metrics buffer carry into the program (part of the memo
-    key, as in :func:`_cached_curve_scan`)."""
+    key, as in :func:`_cached_curve_scan`, which also documents
+    ``has_churn``)."""
+    from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.ops import round_metrics as RM
     step = make_sharded_fused_round_masked(
         n, mesh, fanout, interpret, drop_threshold=drop_threshold,
-        has_alive=has_alive)
+        has_alive=has_alive or has_churn)
     target = jnp.float32(target_coverage)
     rec = _plane_recorder(n, fanout, mesh) if metrics else None
 
     @functools.partial(jax.jit, donate_argnums=0)
     def loop(planes, *masks):
-        alive_words = masks[0] if has_alive else None
+        if has_churn:
+            cov_words, base_w, die_w, rec_w = masks
+        else:
+            cov_words = masks[0] if has_alive else None
         m0 = (RM.init(max_rounds, mesh.shape[AXIS],
                       "simulate_until_sharded_fused") if rec else None)
         c0 = RM.count_planes(planes) if rec else None
 
         def cond(c):
             planes_c, round_c, _, _ = c
-            return ((coverage_planes_masked(planes_c, n, alive_words)
+            return ((coverage_planes_masked(planes_c, n, cov_words)
                      < target)
                     & (round_c < max_rounds))
 
         def body(c):
             planes_c, round_c, m, cnt = c
-            planes_n = step(planes_c, seed, round_c, alive_words)
+            aw = (NE.fused_alive_words_at(base_w, die_w, rec_w, round_c)
+                  if has_churn else cov_words)
+            planes_n = step(planes_c, seed, round_c, aw)
             if m is not None:
                 m, cnt = rec(m, cnt, planes_n)
             return planes_n, round_c + 1, m, cnt
@@ -532,7 +605,7 @@ def _cached_until_loop(n: int, seed: int, max_rounds: int,
         final, rounds, m, _ = jax.lax.while_loop(
             cond, body, (planes, jnp.int32(0), m0, c0))
         return (final, rounds,
-                coverage_planes_masked(final, n, alive_words), m)
+                coverage_planes_masked(final, n, cov_words), m)
 
     return loop
 
@@ -550,15 +623,19 @@ def simulate_until_sharded_fused(n: int, rumors: int, run: RunConfig,
     the cond and the reported coverage switch to the alive-weighted
     metric (coverage_planes_masked — one chooser for both).  ``timing``:
     optional wall-decomposition dict (see the curve twin)."""
+    from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.ops import round_metrics as RM
     from gossip_tpu.utils.trace import maybe_aot_timed
+    NE.check_supported(fault, engine="fused-planes", partitions=False,
+                       ramp=False)
     has_alive = fault is not None and bool(fault.node_death_rate)
+    has_churn = NE.get(fault) is not None
     loop = _cached_until_loop(n, run.seed, run.max_rounds,
                               run.target_coverage, mesh, fanout,
                               interpret, drop_threshold_for(fault),
-                              has_alive, RM.wanted())
+                              has_alive, RM.wanted(), has_churn)
     init, masks = _init_and_masks(n, rumors, run, mesh, fault, has_alive,
-                                  timing)
+                                  timing, has_churn)
     final, rounds, cov, _ = maybe_aot_timed(loop, timing, init, *masks)
     rounds = int(rounds)
     cov = float(cov)

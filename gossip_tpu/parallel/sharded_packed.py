@@ -44,6 +44,10 @@ def make_sharded_packed_round(
     n_pad = pad_to_mesh(n, mesh, axis_name)
     nl = n_pad // mesh.shape[axis_name]
     drop_prob = 0.0 if fault is None else fault.drop_prob
+    from gossip_tpu.ops import nemesis as NE
+    ch = NE.get(fault)
+    if ch is not None:
+        NE.validate_events(fault, n)
 
     have_table = not topo.implicit
     if have_table:
@@ -55,19 +59,38 @@ def make_sharded_packed_round(
         gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
         rkey = jax.random.fold_in(base_key, round_)
         # liveness in-trace (replicated compute, no O(N) inline constant)
-        alive_l = sharded_alive(fault, n, n_pad, origin)[gids]
+        if ch is not None:
+            sched = NE.build(fault, n, n_pad)
+            base_pad = _pad_rows(
+                NE.base_alive_or_ones(fault, n, origin), n_pad, False)
+            alive_l = NE.alive_rows(sched, base_pad, round_)[gids]
+            dp = NE.drop_at(sched, round_)
+            cut = NE.cut_at(sched, round_)
+        else:
+            alive_l = sharded_alive(fault, n, n_pad, origin)[gids]
+            dp, cut = drop_prob, None
+        lost = jnp.float32(0.0)
         visible = jnp.where(alive_l[:, None], packed_l, jnp.uint32(0))
         packed_all = jax.lax.all_gather(visible, axis_name, tiled=True)
         nbrs_l, deg_l = table if have_table else (None, None)
 
         qkey = jax.random.fold_in(rkey, si_mod.PULL_TAG)
-        partners = sample_peers(qkey, gids, topo, k, proto.exclude_self,
-                                local_nbrs=nbrs_l, local_deg=deg_l)
+        partners0 = sample_peers(qkey, gids, topo, k, proto.exclude_self,
+                                 local_nbrs=nbrs_l, local_deg=deg_l)
         partners = apply_drop(rkey, si_mod.PULL_DROP_TAG, gids,
-                              partners, drop_prob, n)
+                              partners0, dp, n, force=ch is not None)
+        if ch is not None:
+            partners = NE.partition_targets(cut, gids, partners, n)
         pulled = pull_merge_packed(packed_all, partners, n)
         partners = jnp.where(alive_l[:, None], partners, n)
         n_req = jnp.sum(partners < n).astype(jnp.float32)
+        if ch is not None:
+            lost_pull = NE.lost_count(partners0, partners, alive_l, n)
+            if mode == C.ANTI_ENTROPY and proto.period > 1:
+                # quiescent rounds send nothing, so nothing is lost
+                lost_pull = jnp.where((round_ % proto.period) == 0,
+                                      lost_pull, 0.0)
+            lost = lost + lost_pull
         if mode == C.ANTI_ENTROPY:
             # Bidirectional reconciliation (twin of models/si_packed.py):
             # the reverse delta scatters bool contributions and reduces
@@ -98,6 +121,9 @@ def make_sharded_packed_round(
             mfac = 2.0
         pulled = jnp.where(alive_l[:, None], pulled, jnp.uint32(0))
         msgs_new = msgs + jax.lax.psum(mfac * n_req, axis_name)
+        if ch is not None:
+            return (packed_l | pulled, msgs_new,
+                    jax.lax.psum(lost, axis_name))
         return packed_l | pulled, msgs_new
 
     sh2 = P(axis_name, None)
@@ -108,14 +134,17 @@ def make_sharded_packed_round(
         in_specs += [sh2, P(axis_name)]
         tables = (nbrs_pad, deg_pad)
 
+    out_specs = (sh2, rep, rep) if ch is not None else (sh2, rep)
     mapped = shard_map(local_round, mesh=mesh, in_specs=tuple(in_specs),
-                           out_specs=(sh2, rep))
+                           out_specs=out_specs)
 
-    def step_tabled(state: SimState, *tbl) -> SimState:
-        seen, msgs = mapped(state.seen, state.round, state.base_key,
-                            state.msgs, *tbl)
-        return SimState(seen=seen, round=state.round + 1,
-                        base_key=state.base_key, msgs=msgs)
+    def step_tabled(state: SimState, *tbl):
+        out = mapped(state.seen, state.round, state.base_key,
+                     state.msgs, *tbl)
+        new = SimState(seen=out[0], round=state.round + 1,
+                       base_key=state.base_key, msgs=out[1])
+        # churn path returns (state, lost) — the models/si.py contract
+        return (new, out[2]) if ch is not None else new
 
     return bind_tables(step_tabled, tables, tabled)
 
@@ -176,7 +205,12 @@ def checkpointed_packed_sharded(proto: ProtocolConfig, topo: Topology,
     Returns ``(final_state, coverage, curve-or-None)``; bitwise equal to
     an uninterrupted segmented run (tests/test_checkpoint_sharded.py).
     """
+    from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.utils.checkpoint import run_with_checkpoints
+    # churn would change the step's return shape mid-segment and the
+    # resume fingerprint cannot carry the schedule yet: reject loudly
+    NE.check_supported(fault, engine="checkpointed-packed", events=False,
+                       partitions=False, ramp=False)
     step, tables = make_sharded_packed_round(proto, topo, mesh, fault,
                                              run.origin, axis_name,
                                              tabled=True)
@@ -221,7 +255,7 @@ def _packed_recorder(proto: ProtocolConfig, n_pad: int, n_shards: int):
     base = 4.0 + 4.0 * nl * n_words(r)
     offered_per_msg = r * RM.payload_factor(proto.mode)
 
-    def rec(m, prev_count, round0, msgs0, s1, alive_pad):
+    def rec(m, prev_count, round0, msgs0, s1, alive_pad, nem=None):
         count = RM.count_packed(s1.seen, alive_pad)
         newly = count - prev_count
         msgs = s1.msgs - msgs0
@@ -229,11 +263,14 @@ def _packed_recorder(proto: ProtocolConfig, n_pad: int, n_shards: int):
         if proto.mode == C.ANTI_ENTROPY:
             b = b + RM.gate_on_exchange_rounds(4.0 * n_pad * r,
                                                proto.period, round0)
+        kw = ({} if nem is None
+              else dict(alive=nem[0], cut_pairs=nem[1], dropped=nem[2]))
         return RM.record(
             m, newly=newly, msgs=msgs,
             dup=RM.dup_estimate(offered_per_msg * msgs, newly),
             bytes=b,
-            front=RM.front_packed(s1.seen, alive_pad, n_shards)), count
+            front=RM.front_packed(s1.seen, alive_pad, n_shards),
+            **kw), count
 
     return rec
 
@@ -246,25 +283,34 @@ def simulate_until_packed_sharded(proto: ProtocolConfig, topo: Topology,
     (parallel/sharded.simulate_until_sharded contract).  With an active
     run ledger the loop carries a round-metrics buffer stack, flushed
     once by the chokepoint (ops/round_metrics)."""
+    from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.ops import round_metrics as RM
+    from gossip_tpu.parallel.sharded import _churn_observables
     from gossip_tpu.utils.trace import maybe_aot_timed
     step, tables = make_sharded_packed_round(proto, topo, mesh, fault,
                                              run.origin, axis_name,
                                              tabled=True)
     n_pad = pad_to_mesh(topo.n, mesh, axis_name)
-    alive_pad = sharded_alive(fault, topo.n, n_pad, run.origin)
+    ch = NE.get(fault)
+    alive_pad = (NE.eventual_alive_pad(fault, topo.n, n_pad, run.origin)
+                 if ch is not None
+                 else sharded_alive(fault, topo.n, n_pad, run.origin))
     init = init_sharded_packed_state(run, proto, topo, mesh, axis_name)
     target = jnp.float32(run.target_coverage)
     r = proto.rumors
     n_shards = mesh.shape[axis_name]
     rec = (_packed_recorder(proto, n_pad, n_shards)
            if RM.wanted() else None)
+    obs = _churn_observables(fault, topo.n, n_pad, run.origin)
 
     @jax.jit
     def loop(state, *tbl):
-        alive_t = sharded_alive(fault, topo.n, n_pad, run.origin)
+        alive_t = (NE.eventual_alive_pad(fault, topo.n, n_pad,
+                                         run.origin) if ch is not None
+                   else sharded_alive(fault, topo.n, n_pad, run.origin))
         m0 = (RM.init(run.max_rounds, n_shards,
-                      "simulate_until_packed_sharded") if rec else None)
+                      "simulate_until_packed_sharded",
+                      nemesis=ch is not None) if rec else None)
         c0 = RM.count_packed(state.seen, alive_t) if rec else None
         def cond(carry):
             s, _, _ = carry
@@ -273,9 +319,13 @@ def simulate_until_packed_sharded(proto: ProtocolConfig, topo: Topology,
         def body(carry):
             s0, m, cnt = carry
             round0, msgs0 = s0.round, s0.msgs
-            s = step(s0, *tbl)
+            if ch is not None:
+                s, lost = step(s0, *tbl)
+            else:
+                s, lost = step(s0, *tbl), None
             if m is not None:
-                m, cnt = rec(m, cnt, round0, msgs0, s, alive_t)
+                m, cnt = rec(m, cnt, round0, msgs0, s, alive_t,
+                             nem=obs(round0, lost) if obs else None)
             return s, m, cnt
         return jax.lax.while_loop(cond, body, (state, m0, c0))
 

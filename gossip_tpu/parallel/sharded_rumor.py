@@ -50,6 +50,10 @@ def make_sharded_rumor_round(proto: ProtocolConfig, topo: Topology,
     drop_prob = 0.0 if fault is None else fault.drop_prob
     n_pad = pad_to_mesh(n, mesh, axis_name)
     nl = n_pad // mesh.shape[axis_name]
+    from gossip_tpu.ops import nemesis as NE
+    ch = NE.get(fault)
+    if ch is not None:
+        NE.validate_events(fault, n)
 
     have_table = not topo.implicit
     if have_table:
@@ -60,15 +64,27 @@ def make_sharded_rumor_round(proto: ProtocolConfig, topo: Topology,
         shard = jax.lax.axis_index(axis_name)
         gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
         rkey = jax.random.fold_in(base_key, round_)
-        alive_l = sharded_alive(fault, n, n_pad, origin)[gids]
+        if ch is not None:
+            sched = NE.build(fault, n, n_pad)
+            base_pad = _pad_rows(
+                NE.base_alive_or_ones(fault, n, origin), n_pad, False)
+            alive_l = NE.alive_rows(sched, base_pad, round_)[gids]
+            dp = NE.drop_at(sched, round_)
+            cut = NE.cut_at(sched, round_)
+        else:
+            alive_l = sharded_alive(fault, n, n_pad, origin)[gids]
+            dp, cut = drop_prob, None
+        lost = jnp.float32(0.0)
         nbrs_l, deg_l = table if have_table else (None, None)
 
         payload = hot_l & alive_l[:, None]                     # [nl, R]
         pkey = jax.random.fold_in(rkey, RUMOR_PUSH_TAG)
-        targets = sample_peers(pkey, gids, topo, k, proto.exclude_self,
-                               local_nbrs=nbrs_l, local_deg=deg_l)
-        targets = apply_drop(rkey, RUMOR_DROP_TAG, gids, targets,
-                             drop_prob, n)                     # [nl, k]
+        targets0 = sample_peers(pkey, gids, topo, k, proto.exclude_self,
+                                local_nbrs=nbrs_l, local_deg=deg_l)
+        targets = apply_drop(rkey, RUMOR_DROP_TAG, gids, targets0,
+                             dp, n, force=ch is not None)      # [nl, k]
+        if ch is not None:
+            targets = NE.partition_targets(cut, gids, targets, n)
         sender_active = jnp.any(payload, axis=1)
         valid = (targets < n) & sender_active[:, None]
 
@@ -96,6 +112,11 @@ def make_sharded_rumor_round(proto: ProtocolConfig, topo: Topology,
         hot_l = ((hot_l & (cnt_l < kk)) | new) & alive_l[:, None]
         msgs_new = msgs + jax.lax.psum(
             jnp.sum(valid).astype(jnp.float32), axis_name)
+        if ch is not None:
+            lost = lost + NE.lost_count(targets0, targets,
+                                        sender_active, n)
+            return (seen_l | delta, hot_l, cnt_l, msgs_new,
+                    jax.lax.psum(lost, axis_name))
         return seen_l | delta, hot_l, cnt_l, msgs_new
 
     sh2 = P(axis_name, None)
@@ -106,17 +127,20 @@ def make_sharded_rumor_round(proto: ProtocolConfig, topo: Topology,
         in_specs += [sh2, P(axis_name)]
         tables = (nbrs_pad, deg_pad)
 
+    out_specs = ((sh2, sh2, sh2, rep, rep) if ch is not None
+                 else (sh2, sh2, sh2, rep))
     mapped = shard_map(local_round, mesh=mesh,
                            in_specs=tuple(in_specs),
-                           out_specs=(sh2, sh2, sh2, rep))
+                           out_specs=out_specs)
 
-    def step_tabled(state: RumorState, *tbl) -> RumorState:
-        seen, hot, cnt, msgs = mapped(state.seen, state.hot, state.cnt,
-                                      state.round, state.base_key,
-                                      state.msgs, *tbl)
-        return RumorState(seen=seen, hot=hot, cnt=cnt,
-                          round=state.round + 1,
-                          base_key=state.base_key, msgs=msgs)
+    def step_tabled(state: RumorState, *tbl):
+        out = mapped(state.seen, state.hot, state.cnt,
+                     state.round, state.base_key, state.msgs, *tbl)
+        new = RumorState(seen=out[0], hot=out[1], cnt=out[2],
+                         round=state.round + 1,
+                         base_key=state.base_key, msgs=out[3])
+        # churn path returns (state, lost) — the models/si.py contract
+        return (new, out[4]) if ch is not None else new
 
     return bind_tables(step_tabled, tables, tabled)
 
@@ -153,18 +177,20 @@ def _rumor_recorder(proto: ProtocolConfig, n_pad: int,
     base_bytes = 4.0 * n_pad * r + (1.0 * nl * r if feedback else 0.0) \
         + 4.0
 
-    def rec(m, prev, msgs0, s1, alive):
+    def rec(m, prev, msgs0, s1, alive, nem=None):
         count = RM.count_bool(s1.seen, alive)
         cntsum = jnp.sum(jnp.where(alive[:, None], s1.cnt, 0),
                          dtype=jnp.float32)
         newly = count - prev[0]
         contacts = cntsum - prev[1]
+        kw = ({} if nem is None
+              else dict(alive=nem[0], cut_pairs=nem[1], dropped=nem[2]))
         return RM.record(
             m, newly=newly, msgs=s1.msgs - msgs0,
             dup=(contacts if feedback
                  else RM.dup_estimate(contacts, newly)),
             bytes=base_bytes,
-            front=RM.front_bool(s1.seen, alive, n_shards)), \
+            front=RM.front_bool(s1.seen, alive, n_shards), **kw), \
             (count, cntsum)
 
     def init_prev(state, alive):
@@ -187,7 +213,9 @@ def simulate_curve_rumor_sharded(proto: ProtocolConfig, topo: Topology,
     only.  ``timing``: optional compile/steady AOT-split dict
     (utils/trace.maybe_aot_timed contract); with an active run ledger
     the scan carries a round-metrics buffer stack (ops/round_metrics)."""
+    from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.ops import round_metrics as RM
+    from gossip_tpu.parallel.sharded import _churn_observables
     from gossip_tpu.utils.trace import maybe_aot_timed
     step, tables = make_sharded_rumor_round(proto, topo, mesh, fault,
                                             run.origin, axis_name,
@@ -197,21 +225,30 @@ def simulate_curve_rumor_sharded(proto: ProtocolConfig, topo: Topology,
     n_shards = mesh.shape[axis_name]
     rec, init_prev = (_rumor_recorder(proto, n_pad, n_shards)
                       if RM.wanted() else (None, None))
+    ch = NE.get(fault)
+    obs = _churn_observables(fault, topo.n, n_pad, run.origin)
 
     @jax.jit
     def scan(state, *tbl):
-        alive = sharded_alive(fault, topo.n, n_pad, run.origin)
+        alive = (NE.eventual_alive_pad(fault, topo.n, n_pad, run.origin)
+                 if ch is not None
+                 else sharded_alive(fault, topo.n, n_pad, run.origin))
         w = alive.astype(jnp.float32)
         m0 = (RM.init(run.max_rounds, n_shards,
-                      "simulate_curve_rumor_sharded") if rec else None)
+                      "simulate_curve_rumor_sharded",
+                      nemesis=ch is not None) if rec else None)
         p0 = init_prev(state, alive) if rec else None
 
         def body(carry, _):
             s0, m, prev = carry
-            msgs0 = s0.msgs
-            s = step(s0, *tbl)
+            round0, msgs0 = s0.round, s0.msgs
+            if ch is not None:
+                s, lost = step(s0, *tbl)
+            else:
+                s, lost = step(s0, *tbl), None
             if m is not None:
-                m, prev = rec(m, prev, msgs0, s, alive)
+                m, prev = rec(m, prev, msgs0, s, alive,
+                              nem=obs(round0, lost) if obs else None)
             hot_any = jnp.any(s.hot, axis=1).astype(jnp.float32)
             hot_frac = jnp.sum(hot_any * w) / jnp.sum(w)
             return ((s, m, prev),
@@ -245,7 +282,9 @@ def simulate_until_rumor_sharded(proto: ProtocolConfig, topo: Topology,
     ``timing``: optional compile/steady AOT-split dict; with an active
     run ledger the loop carries a round-metrics buffer stack
     (ops/round_metrics)."""
+    from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.ops import round_metrics as RM
+    from gossip_tpu.parallel.sharded import _churn_observables
     from gossip_tpu.utils.trace import maybe_aot_timed
     step, tables = make_sharded_rumor_round(proto, topo, mesh, fault,
                                             run.origin, axis_name,
@@ -255,12 +294,21 @@ def simulate_until_rumor_sharded(proto: ProtocolConfig, topo: Topology,
     n_shards = mesh.shape[axis_name]
     rec, init_prev = (_rumor_recorder(proto, n_pad_m, n_shards)
                       if RM.wanted() else (None, None))
+    ch = NE.get(fault)
+    obs = _churn_observables(fault, topo.n, n_pad_m, run.origin)
+
+    def alive_of(n_rows):
+        if ch is not None:
+            return NE.eventual_alive_pad(fault, topo.n, n_rows,
+                                         run.origin)
+        return sharded_alive(fault, topo.n, n_rows, run.origin)
 
     @jax.jit
     def loop(state, *tbl):
-        alive = sharded_alive(fault, topo.n, n_pad_m, run.origin)
+        alive = alive_of(n_pad_m)
         m0 = (RM.init(run.max_rounds, n_shards,
-                      "simulate_until_rumor_sharded") if rec else None)
+                      "simulate_until_rumor_sharded",
+                      nemesis=ch is not None) if rec else None)
         p0 = init_prev(state, alive) if rec else None
 
         def cond(carry):
@@ -269,10 +317,14 @@ def simulate_until_rumor_sharded(proto: ProtocolConfig, topo: Topology,
 
         def body(carry):
             s0, m, prev = carry
-            msgs0 = s0.msgs
-            s = step(s0, *tbl)
+            round0, msgs0 = s0.round, s0.msgs
+            if ch is not None:
+                s, lost = step(s0, *tbl)
+            else:
+                s, lost = step(s0, *tbl), None
             if m is not None:
-                m, prev = rec(m, prev, msgs0, s, alive)
+                m, prev = rec(m, prev, msgs0, s, alive,
+                              nem=obs(round0, lost) if obs else None)
             return s, m, prev
 
         return jax.lax.while_loop(cond, body, (state, m0, p0))
@@ -281,6 +333,6 @@ def simulate_until_rumor_sharded(proto: ProtocolConfig, topo: Topology,
     # always weight by the padded alive mask: padding rows must not
     # deflate coverage (sharded_alive marks them dead even fault-free)
     n_pad = pad_to_mesh(topo.n, mesh, axis_name)
-    alive = sharded_alive(fault, topo.n, n_pad, run.origin)
+    alive = alive_of(n_pad)
     cov = float(rumor_coverage(final.seen, alive))
     return (int(final.round), cov, 1.0 - cov, float(final.msgs), final)

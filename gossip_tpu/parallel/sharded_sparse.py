@@ -136,11 +136,15 @@ def _slot_rows(rkey: jax.Array, slot_gids: jax.Array, nl: int) -> jax.Array:
         lambda kk: jax.random.randint(kk, (), 0, nl, dtype=jnp.int32))(keys)
 
 
-def _slot_valid(rkey: jax.Array, slot_gids: jax.Array, drop_prob: float,
-                alive_rows: jax.Array, k: int) -> jax.Array:
-    """Which slots issue a request: requester alive and link not dropped."""
+def _slot_valid(rkey: jax.Array, slot_gids: jax.Array, drop_prob,
+                alive_rows: jax.Array, k: int,
+                force: bool = False) -> jax.Array:
+    """Which slots issue a request: requester alive and link not dropped.
+    ``force=True`` always draws the drop coins so ``drop_prob`` may be a
+    TRACED per-round scalar (the ops/nemesis drop-ramp path; a p=0
+    round draws all-False, bitwise a no-op on the trajectory)."""
     valid = jnp.repeat(alive_rows, k)
-    if drop_prob > 0.0:
+    if force or drop_prob > 0.0:
         base = jax.random.fold_in(rkey, SPARSE_DROP_TAG)
         keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(base,
                                                                slot_gids)
@@ -201,10 +205,25 @@ def make_sparse_pull_round(
     w = n_words(proto.rumors)
     drop_prob = 0.0 if fault is None else fault.drop_prob
     alive_pad = sharded_alive(fault, n, n_pad, origin)
+    from gossip_tpu.ops import nemesis as NE
+    ch = NE.get(fault)
+    if ch is not None:
+        NE.validate_events(fault, n)
 
     def local_round(seen_l, round_, base_key, msgs, alive_l):
         shard = jax.lax.axis_index(axis_name)
         rkey = jax.random.fold_in(base_key, round_)
+        row_gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
+        if ch is not None:
+            # churn path: the operand stays the STATIC mask; the
+            # schedule's down-window subtracts per round (ops/nemesis)
+            sched = NE.build(fault, n, n_pad)
+            alive_l = alive_l & ~((sched.die[row_gids] <= round_)
+                                  & (round_ < sched.rec[row_gids]))
+            dp = NE.drop_at(sched, round_)
+            cut = NE.cut_at(sched, round_)
+        else:
+            dp, cut = drop_prob, None
         visible = jnp.where(alive_l[:, None], seen_l, jnp.uint32(0))
 
         def exchange(_):
@@ -220,7 +239,23 @@ def make_sparse_pull_round(
             slot_gids = shard * (nl * k) + jnp.arange(nl * k,
                                                       dtype=jnp.int32)
             rows_req = _slot_rows(rkey, slot_gids, nl)        # [nl*k]
-            valid = _slot_valid(rkey, slot_gids, drop_prob, alive_l, k)
+            valid = _slot_valid(rkey, slot_gids, dp, alive_l, k,
+                                force=ch is not None)
+            if ch is not None:
+                # cross-cut requests are lost for this round only (the
+                # dense kernels' partition_targets semantics, slot form)
+                local_slot = jnp.arange(nl * k, dtype=jnp.int32)
+                partner_shard = jnp.take(pi, (local_slot + o) % p)
+                partner_gid = partner_shard * nl + rows_req
+                req_gid = slot_gids // k
+                would = jnp.repeat(alive_l, k)
+                valid = valid & NE.same_side(cut, req_gid, partner_gid)
+                lost = jnp.sum(would & ~valid, dtype=jnp.float32)
+            else:
+                # must carry the varying-manual-axes type: this is a
+                # cond-branch output matched against the quiescent
+                # branch's pvary'd zf when period > 1
+                lost = pvary(jnp.float32(0.0), (axis_name,))
             rows_req = jnp.where(valid, rows_req, jnp.int32(-1))
 
             # Column c of the [cap, p] slot view holds group (c + o) % p;
@@ -263,33 +298,39 @@ def make_sparse_pull_round(
                                             tiled=False)
                 pulled = pulled | _scatter_merge_digests(
                     ok, recv, recv_d, nl, proto.rumors, w)
-            return pulled, jnp.sum(valid).astype(jnp.float32)
+            return pulled, jnp.sum(valid).astype(jnp.float32), lost
 
         if proto.mode == C.ANTI_ENTROPY and proto.period > 1:
             on = (round_ % proto.period) == 0
             # the quiescent branch's constants must carry the same
             # varying-manual-axes type as the exchange outputs
             zf = pvary(jnp.float32(0.0), (axis_name,))
-            quiet = (jnp.zeros_like(seen_l), zf)
-            pulled, n_req = jax.lax.cond(on, exchange,
-                                         lambda _: quiet, None)
+            quiet = (jnp.zeros_like(seen_l), zf, zf)
+            pulled, n_req, lost_r = jax.lax.cond(on, exchange,
+                                                 lambda _: quiet, None)
         else:
-            pulled, n_req = exchange(None)
+            pulled, n_req, lost_r = exchange(None)
         mfac = 3.0 if proto.mode == C.ANTI_ENTROPY else 2.0
         pulled = jnp.where(alive_l[:, None], pulled, jnp.uint32(0))
         msgs_new = msgs + jax.lax.psum(mfac * n_req, axis_name)
+        if ch is not None:
+            return (seen_l | pulled, msgs_new,
+                    jax.lax.psum(lost_r, axis_name))
         return seen_l | pulled, msgs_new
 
     sh, sh2, rep = P(axis_name), P(axis_name, None), P()
+    out_specs = (sh2, rep, rep) if ch is not None else (sh2, rep)
     mapped = shard_map(local_round, mesh=mesh,
                            in_specs=(sh2, rep, rep, rep, sh),
-                           out_specs=(sh2, rep))
+                           out_specs=out_specs)
 
-    def step(state: SimState) -> SimState:
-        seen, msgs = mapped(state.seen, state.round, state.base_key,
-                            state.msgs, alive_pad)
-        return SimState(seen=seen, round=state.round + 1,
-                        base_key=state.base_key, msgs=msgs)
+    def step(state: SimState):
+        out = mapped(state.seen, state.round, state.base_key,
+                     state.msgs, alive_pad)
+        new = SimState(seen=out[0], round=state.round + 1,
+                       base_key=state.base_key, msgs=out[1])
+        # churn path returns (state, lost) — the models/si.py contract
+        return (new, out[2]) if ch is not None else new
 
     return step
 
@@ -306,8 +347,12 @@ def sparse_pull_round_reference(
     nl = _validate(n_pad, p, k)
     drop_prob = 0.0 if fault is None else fault.drop_prob
     alive_pad = sharded_alive(fault, n, n_pad, origin)
+    from gossip_tpu.ops import nemesis as NE
+    ch = NE.get(fault)
+    if ch is not None:
+        NE.validate_events(fault, n)
 
-    def step(state: SimState) -> SimState:
+    def step(state: SimState):
         seen, round_ = state.seen, state.round
         rkey = jax.random.fold_in(state.base_key, round_)
         pi, o = _round_draws(rkey, p)
@@ -317,10 +362,23 @@ def sparse_pull_round_reference(
         group = (local_slot + o) % p
         partner_shard = jnp.take(pi, group)
         rows = _slot_rows(rkey, slot_gids, nl)
-        valid = _slot_valid(rkey, slot_gids, drop_prob, alive_pad, k)
         gids = partner_shard * nl + rows
+        if ch is not None:
+            sched = NE.build(fault, n, n_pad)
+            alive_now = NE.alive_rows(sched, alive_pad, round_)
+            dp = NE.drop_at(sched, round_)
+            cut = NE.cut_at(sched, round_)
+            valid = _slot_valid(rkey, slot_gids, dp, alive_now, k,
+                                force=True)
+            valid = valid & NE.same_side(cut, slot_gids // k, gids)
+            lost = jnp.sum(jnp.repeat(alive_now, k) & ~valid,
+                           dtype=jnp.float32)
+        else:
+            alive_now = alive_pad
+            valid = _slot_valid(rkey, slot_gids, drop_prob, alive_pad, k)
+            lost = jnp.float32(0.0)
 
-        visible = jnp.where(alive_pad[:, None], seen, jnp.uint32(0))
+        visible = jnp.where(alive_now[:, None], seen, jnp.uint32(0))
         got = visible[gids]                                   # [n_pad*k, W]
         got = jnp.where(valid[:, None], got, jnp.uint32(0))
         pulled = _or_reduce_k(got, n_pad, k)
@@ -344,13 +402,18 @@ def sparse_pull_round_reference(
             pulled = jnp.where(on, pulled, jnp.uint32(0))
             back = jnp.where(on, back, jnp.uint32(0))
             n_req = jnp.where(on, n_req, 0.0)
+        if proto.period > 1 and proto.mode == C.ANTI_ENTROPY:
+            # quiescent rounds send nothing, so nothing is lost (the
+            # mesh kernel cond-skips the whole exchange)
+            lost = jnp.where((round_ % proto.period) == 0, lost, 0.0)
         if back is not None:
             pulled = pulled | back
         mfac = 3.0 if proto.mode == C.ANTI_ENTROPY else 2.0
-        pulled = jnp.where(alive_pad[:, None], pulled, jnp.uint32(0))
-        return SimState(seen=seen | pulled, round=round_ + 1,
-                        base_key=state.base_key,
-                        msgs=state.msgs + mfac * n_req)
+        pulled = jnp.where(alive_now[:, None], pulled, jnp.uint32(0))
+        new = SimState(seen=seen | pulled, round=round_ + 1,
+                       base_key=state.base_key,
+                       msgs=state.msgs + mfac * n_req)
+        return (new, lost) if ch is not None else new
 
     return step
 
@@ -516,6 +579,9 @@ def make_sparse_topo_pull_round(
     if topo.implicit:
         raise ValueError("implicit complete topology routes to "
                          "make_sparse_pull_round (stratified draw)")
+    from gossip_tpu.ops import nemesis as NE
+    NE.check_supported(fault, engine="topo-sparse", events=False,
+                       partitions=False, ramp=False)
     p = mesh.shape[axis_name]
     k = proto.fanout
     n = topo.n
@@ -635,6 +701,9 @@ def sparse_topo_pull_round_reference(
     if proto.mode not in (C.PULL, C.ANTI_ENTROPY):
         raise ValueError("sparse topology exchange covers pull and "
                          f"anti-entropy (got mode {proto.mode!r})")
+    from gossip_tpu.ops import nemesis as NE
+    NE.check_supported(fault, engine="topo-sparse", events=False,
+                       partitions=False, ramp=False)
     k = proto.fanout
     n = topo.n
     n_pad = math.ceil(n / p) * p
@@ -713,7 +782,7 @@ def _sparse_recorder(proto: ProtocolConfig, n_shards: int,
     offered_per_msg = proto.rumors * RM.payload_factor(proto.mode)
     exchange_b = float(meta.sparse_bytes) + 4.0
 
-    def rec(m, prev_count, round0, msgs0, s1, alive_pad):
+    def rec(m, prev_count, round0, msgs0, s1, alive_pad, nem=None):
         count = RM.count_packed(s1.seen, alive_pad)
         newly = count - prev_count
         msgs = s1.msgs - msgs0
@@ -721,11 +790,14 @@ def _sparse_recorder(proto: ProtocolConfig, n_shards: int,
         if proto.mode == C.ANTI_ENTROPY:
             b = RM.gate_on_exchange_rounds(exchange_b, proto.period,
                                            round0, off=4.0)
+        kw = ({} if nem is None
+              else dict(alive=nem[0], cut_pairs=nem[1], dropped=nem[2]))
         return RM.record(
             m, newly=newly, msgs=msgs,
             dup=RM.dup_estimate(offered_per_msg * msgs, newly),
             bytes=b,
-            front=RM.front_packed(s1.seen, alive_pad, n_shards)), count
+            front=RM.front_packed(s1.seen, alive_pad, n_shards),
+            **kw), count
 
     return rec
 
@@ -848,6 +920,8 @@ def simulate_curve_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
 
     from gossip_tpu.ops import round_metrics as RM
     from gossip_tpu.utils.trace import maybe_aot_timed
+    from gossip_tpu.ops import nemesis as NE
+    from gossip_tpu.parallel.sharded import _churn_observables
     step = make_sparse_pull_round(proto, n, mesh, fault, run.origin,
                                   axis_name)
     p = mesh.shape[axis_name]
@@ -857,19 +931,27 @@ def simulate_curve_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
     meta = sparse_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
                        bidirectional=proto.mode == C.ANTI_ENTROPY)
     rec = _sparse_recorder(proto, p, meta) if RM.wanted() else None
+    ch = NE.get(fault)
+    obs = _churn_observables(fault, n, n_pad, run.origin)
 
     @jax.jit
     def scan(state):
-        alive_pad = sharded_alive(fault, n, n_pad, run.origin)
-        m0 = (RM.init(run.max_rounds, p, "simulate_curve_sparse")
-              if rec else None)
+        alive_pad = (NE.eventual_alive_pad(fault, n, n_pad, run.origin)
+                     if ch is not None
+                     else sharded_alive(fault, n, n_pad, run.origin))
+        m0 = (RM.init(run.max_rounds, p, "simulate_curve_sparse",
+                      nemesis=ch is not None) if rec else None)
         c0 = RM.count_packed(state.seen, alive_pad) if rec else None
         def body(carry, _):
             s0, m, cnt = carry
             round0, msgs0 = s0.round, s0.msgs
-            s = step(s0)
+            if ch is not None:
+                s, lost = step(s0)
+            else:
+                s, lost = step(s0), None
             if m is not None:
-                m, cnt = rec(m, cnt, round0, msgs0, s, alive_pad)
+                m, cnt = rec(m, cnt, round0, msgs0, s, alive_pad,
+                             nem=obs(round0, lost) if obs else None)
             return (s, m, cnt), (coverage_packed(s.seen, r, alive_pad),
                                  s.msgs)
         return jax.lax.scan(body, (state, m0, c0), None,
@@ -889,25 +971,33 @@ def simulate_until_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
     (ops/round_metrics)."""
     from gossip_tpu.ops import round_metrics as RM
     from gossip_tpu.utils.trace import maybe_aot_timed
+    from gossip_tpu.ops import nemesis as NE
+    from gossip_tpu.parallel.sharded import _churn_observables
     step = make_sparse_pull_round(proto, n, mesh, fault, run.origin,
                                   axis_name)
     p = mesh.shape[axis_name]
     n_pad = pad_to_mesh(n, mesh, axis_name)
-    alive_pad = sharded_alive(fault, n, n_pad, run.origin)
+    ch = NE.get(fault)
+    alive_pad = (NE.eventual_alive_pad(fault, n, n_pad, run.origin)
+                 if ch is not None
+                 else sharded_alive(fault, n, n_pad, run.origin))
     init = init_sparse_state(run, proto, n, mesh, axis_name)
     target = jnp.float32(run.target_coverage)
     r = proto.rumors
     meta = sparse_meta(n_pad, p, proto.fanout, n_words(proto.rumors),
                        bidirectional=proto.mode == C.ANTI_ENTROPY)
     rec = _sparse_recorder(proto, p, meta) if RM.wanted() else None
+    obs = _churn_observables(fault, n, n_pad, run.origin)
 
     @jax.jit
     def loop(state):
         # liveness in-trace: no O(N) closed-over constant (bind_tables
         # doc) — same hardening as simulate_until_topo_sparse
-        alive_t = sharded_alive(fault, n, n_pad, run.origin)
-        m0 = (RM.init(run.max_rounds, p, "simulate_until_sparse")
-              if rec else None)
+        alive_t = (NE.eventual_alive_pad(fault, n, n_pad, run.origin)
+                   if ch is not None
+                   else sharded_alive(fault, n, n_pad, run.origin))
+        m0 = (RM.init(run.max_rounds, p, "simulate_until_sparse",
+                      nemesis=ch is not None) if rec else None)
         c0 = RM.count_packed(state.seen, alive_t) if rec else None
         def cond(carry):
             s, _, _ = carry
@@ -916,9 +1006,13 @@ def simulate_until_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
         def body(carry):
             s0, m, cnt = carry
             round0, msgs0 = s0.round, s0.msgs
-            s = step(s0)
+            if ch is not None:
+                s, lost = step(s0)
+            else:
+                s, lost = step(s0), None
             if m is not None:
-                m, cnt = rec(m, cnt, round0, msgs0, s, alive_t)
+                m, cnt = rec(m, cnt, round0, msgs0, s, alive_t,
+                             nem=obs(round0, lost) if obs else None)
             return s, m, cnt
         return jax.lax.while_loop(cond, body, (state, m0, c0))
 

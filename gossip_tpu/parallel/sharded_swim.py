@@ -56,6 +56,11 @@ def make_sharded_swim_round(
     rotate = proto.swim_rotate
     epoch_rounds = SW.resolve_epoch_rounds(proto, n)
     drop_prob = 0.0 if fault is None else fault.drop_prob
+    from gossip_tpu.ops import nemesis as NE
+    NE.check_supported(fault, engine="swim", partitions=False, ramp=False)
+    ch = NE.get(fault)
+    if ch is not None:
+        NE.validate_events(fault, n)
     n_pad = pad_to_mesh(n, mesh, axis_name)
     nl = n_pad // mesh.shape[axis_name]
     if topo is None:
@@ -76,6 +81,11 @@ def make_sharded_swim_round(
                                     n_pad, False)
         alive_full = jnp.where(round_ >= fail_round, alive_base_full,
                                True) & valid
+        if ch is not None:
+            # scripted crash/recover churn (models/swim.py twin)
+            sched = NE.build(fault, n, n_pad)
+            alive_full = alive_full & ~((sched.die <= round_)
+                                        & (round_ < sched.rec))
         alive_l = alive_full[gids]
         subj_gids = SW.subject_window(round_, s_count, n, rotate,
                                       epoch_rounds)

@@ -69,6 +69,7 @@ from gossip_tpu.config import FaultConfig, ProtocolConfig, RunConfig
 from gossip_tpu.models import si as si_mod
 from gossip_tpu.models.si import coverage, make_si_round
 from gossip_tpu.models.state import SimState, alive_mask, init_state
+from gossip_tpu.ops import nemesis as NE
 from gossip_tpu.ops.propagate import pull_merge, push_counts
 from gossip_tpu.ops.sampling import (drop_mask, sample_peers,
                                      sample_peers_complete)
@@ -131,6 +132,9 @@ def ensemble_curves(proto: ProtocolConfig, topo: Topology, run: RunConfig,
     # tables as jit ARGUMENTS + liveness in-trace: no O(N) closure
     # constants in the compile request (models/swim.py doc)
     step, tables = make_si_round(proto, topo, fault, run.origin, tabled=True)
+    # churn-path steps return (state, lost); the ensemble records no
+    # per-round observables, so drop the lost count (ops/nemesis)
+    step = NE.drop_lost(step, NE.get(fault))
     base = init_state(run, proto, topo.n)
     keys = jax.vmap(jax.random.key)(jnp.asarray(list(seeds), jnp.uint32))
     s = len(seeds)
@@ -144,7 +148,8 @@ def ensemble_curves(proto: ProtocolConfig, topo: Topology, run: RunConfig,
 
     @jax.jit
     def scan(states, *tbl):
-        alive = alive_mask(fault, topo.n, run.origin)
+        # eventual alive set under churn (heal-convergence denominator)
+        alive = NE.metric_alive(fault, topo.n, run.origin)
         def body(st, _):
             st = jax.vmap(lambda x: step(x, *tbl))(st)
             covs = jax.vmap(lambda x: coverage(x.seen, alive))(st)
@@ -353,6 +358,10 @@ def config_sweep_curves_2d(points, topo, run: RunConfig,
     if fault is not None and fault.drop_prob > 0.0:
         raise ValueError("per-config loss goes through SweepPoint.drop_prob;"
                          " FaultConfig.drop_prob would be ambiguous here")
+    # the grid round body is its own lowering (no churn path yet):
+    # reject a schedule loudly rather than silently running static-only
+    NE.check_supported(fault, engine="config-sweep", events=False,
+                       partitions=False, ramp=False)
     topos, multi, topo0 = _normalize_topos(topo, points)
     if multi and any(t.n != topo0.n for t in topos):
         raise ValueError(
@@ -684,6 +693,10 @@ def config_sweep_curves(points, topo, run: RunConfig,
     if fault is not None and fault.drop_prob > 0.0:
         raise ValueError("per-config loss goes through SweepPoint.drop_prob;"
                          " FaultConfig.drop_prob would be ambiguous here")
+    # the grid round body is its own lowering (no churn path yet):
+    # reject a schedule loudly rather than silently running static-only
+    NE.check_supported(fault, engine="config-sweep", events=False,
+                       partitions=False, ramp=False)
     if mesh is not None and len(points) % mesh.shape[axis_name] != 0:
         raise ValueError(
             f"{len(points)} configs do not divide over the {axis_name} "
@@ -1007,15 +1020,23 @@ def ensemble_swim_curves(proto: ProtocolConfig, n: int, run: RunConfig,
 
     @jax.jit
     def scan(states, *tbl):
-        alive_obs = SW.base_alive(n, dead, fault)
+        # observer denominator: base mask minus PERMANENT churn deaths
+        # (matches simulate_swim_curve/until — a forever-down node
+        # cannot observe; a recovering node stays in the denominator)
+        alive_obs = SW.observer_alive(n, dead, fault)
+
+        # metric targets: static scripted deaths + permanent churn
+        # deaths (`dead` stays static-only for the kernel factory)
+        targets = SW.detection_targets(dead, fault)
 
         def detection(st):
             window = SW.subject_window(st.round - 1, proto.swim_subjects,
                                        n, rotate, epoch_rounds)
             return SW.detection_fraction(
                 SW.SwimState(st.wire[:n], st.timer[:n], st.round,
-                             st.base_key, st.msgs), dead,
-                alive_obs, subj_gids=window) if dead else jnp.float32(0.0)
+                             st.base_key, st.msgs), targets,
+                alive_obs, subj_gids=window
+            ) if targets else jnp.float32(0.0)
 
         def body(st, _):
             st = jax.vmap(lambda x: step(x, *tbl))(st)
@@ -1042,6 +1063,7 @@ def ensemble_rumor_curves(proto: ProtocolConfig, topo: Topology,
                                          make_rumor_round, rumor_coverage)
     step, tables = make_rumor_round(proto, topo, fault, run.origin,
                                     tabled=True)
+    step = NE.drop_lost(step, NE.get(fault))
     base = init_rumor_state(run, proto, topo.n)
     keys = jax.vmap(jax.random.key)(jnp.asarray(list(seeds), jnp.uint32))
     s = len(seeds)
@@ -1057,7 +1079,9 @@ def ensemble_rumor_curves(proto: ProtocolConfig, topo: Topology,
 
     @jax.jit
     def scan(states, *tbl):
-        alive = alive_mask(fault, topo.n, run.origin)
+        # eventual alive set under churn — matches the solo
+        # simulate_curve_rumor weighting (bitwise-parity contract)
+        alive = NE.metric_alive(fault, topo.n, run.origin)
         hot_w = (None if alive is None else alive.astype(jnp.float32))
 
         def one_metrics(st):

@@ -116,12 +116,38 @@ def serve(port: int = 50051, max_workers: int = 4,
     return server, bound
 
 
+# Status codes that mark a TRANSIENT transport failure — the server
+# was unreachable or the connection died, so nothing was processed and
+# a retry is safe.  A WELL-FORMED error reply (INVALID_ARGUMENT from
+# the handlers' context.abort, INTERNAL, etc.) means the server DID
+# process the call and said no: retrying it is never correct, exactly
+# as maelstrom_node treats an error reply as a failed delivery rather
+# than a lost one (runtime/maelstrom_node.gossip).
+_TRANSIENT_CODES = frozenset({grpc.StatusCode.UNAVAILABLE})
+
+
 class SidecarClient:
     """Typed client over the JSON-bytes wire (usable from any grpc client
-    in any language the same way)."""
+    in any language the same way).
 
-    def __init__(self, address: str):
+    Transient transport failures (UNAVAILABLE — server starting up,
+    connection reset; plus DEADLINE_EXCEEDED for the cheap idempotent
+    ``health`` probe only, whose timeout is not workload-dependent)
+    are retried with capped jittered exponential backoff, the
+    runtime/maelstrom_node retry shape (fresh deadline per attempt,
+    ``max_attempts`` overflow guard, no sleep after the last try).
+    Each retry emits an ``rpc_retry`` event on the ambient run ledger
+    (utils/telemetry.current) so a flaky transport is flight-recorded,
+    never silent.  Well-formed error replies are raised immediately."""
+
+    def __init__(self, address: str, max_attempts: int = 4,
+                 backoff_base: float = 0.1, backoff_cap: float = 2.0):
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts={max_attempts} must be >= 1")
         self._channel = grpc.insecure_channel(address)
+        self.max_attempts = max_attempts
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
         self._run = self._channel.unary_unary(
             f"/{SERVICE}/Run", request_serializer=_identity,
             response_deserializer=_identity)
@@ -132,21 +158,55 @@ class SidecarClient:
             f"/{SERVICE}/Health", request_serializer=_identity,
             response_deserializer=_identity)
 
+    def _call_with_retry(self, call, payload: bytes, timeout,
+                         method: str, retryable=_TRANSIENT_CODES):
+        """One RPC with the retry contract above.  ``retryable`` is the
+        status-code set that marks a transport (not application)
+        failure."""
+        import random
+        import time as _time
+
+        from gossip_tpu.utils import telemetry
+        for attempt in range(self.max_attempts):
+            try:
+                return call(payload, timeout=timeout)
+            except grpc.RpcError as e:
+                code = e.code() if callable(getattr(e, "code", None)) \
+                    else None
+                if code not in retryable \
+                        or attempt + 1 >= self.max_attempts:
+                    raise
+                # full jitter on the capped exponential step: herds of
+                # clients racing a restarting sidecar must not resync
+                sleep = (min(self.backoff_base * (2 ** attempt),
+                             self.backoff_cap)
+                         * (0.5 + random.random()))
+                telemetry.current().event(
+                    "rpc_retry", sync=False, method=method,
+                    attempt=attempt + 1, code=str(code),
+                    sleep_s=round(sleep, 3))
+                _time.sleep(sleep)
+        raise AssertionError("unreachable: loop returns or raises")
+
     def run(self, timeout: Optional[float] = 600.0, **request) -> dict:
         """One simulation.  kwargs mirror the JSON request fields:
         backend, proto, topology, run, fault, mesh, curve."""
-        return json.loads(self._run(json.dumps(request).encode(),
-                                    timeout=timeout))
+        return json.loads(self._call_with_retry(
+            self._run, json.dumps(request).encode(), timeout, "run"))
 
     def ensemble(self, timeout: Optional[float] = 600.0,
                  **request) -> dict:
         """Seed-ensemble statistics; kwargs mirror the Run fields plus
         seeds=[...] or ensemble=count."""
-        return json.loads(self._ensemble(json.dumps(request).encode(),
-                                         timeout=timeout))
+        return json.loads(self._call_with_retry(
+            self._ensemble, json.dumps(request).encode(), timeout,
+            "ensemble"))
 
     def health(self, timeout: float = 10.0) -> dict:
-        return json.loads(self._health(b"{}", timeout=timeout))
+        return json.loads(self._call_with_retry(
+            self._health, b"{}", timeout, "health",
+            retryable=_TRANSIENT_CODES
+            | {grpc.StatusCode.DEADLINE_EXCEEDED}))
 
     def close(self) -> None:
         self._channel.close()

@@ -62,11 +62,13 @@ def _build(proto: ProtocolConfig, topo: Topology, run: RunConfig,
 
 def simulate_curve(proto: ProtocolConfig, topo: Topology, run: RunConfig,
                    fault: Optional[FaultConfig] = None) -> CurveResult:
+    from gossip_tpu.ops import nemesis as NE
     step, tables, init = _build(proto, topo, run, fault)
+    step = NE.drop_lost(step, NE.get(fault))
 
     @jax.jit
     def scan(init_state_, *tbl):
-        alive = alive_mask(fault, topo.n, run.origin)
+        alive = NE.metric_alive(fault, topo.n, run.origin)
         def body(state, _):
             state = step(state, *tbl)
             return state, (coverage(state.seen, alive), state.msgs)
@@ -92,13 +94,15 @@ def simulate_until(proto: ProtocolConfig, topo: Topology, run: RunConfig,
     via the AOT split (utils.trace.aot_timed) instead of one fused call —
     the hardware-table contract that walls never mix compile with
     steady state."""
+    from gossip_tpu.ops import nemesis as NE
     step, tables, init = _build(proto, topo, run, fault)
+    step = NE.drop_lost(step, NE.get(fault))
     target = jnp.float32(run.target_coverage)
-    alive = alive_mask(fault, topo.n, run.origin)   # host-side final metric
+    alive = NE.metric_alive(fault, topo.n, run.origin)  # host final metric
 
     @jax.jit
     def loop(init_state_, *tbl):
-        alive_t = alive_mask(fault, topo.n, run.origin)
+        alive_t = NE.metric_alive(fault, topo.n, run.origin)
         def cond(state):
             return ((coverage(state.seen, alive_t) < target)
                     & (state.round < run.max_rounds))
@@ -191,7 +195,10 @@ def simulate_swim_curve(proto: ProtocolConfig, n: int, rounds: int,
                                                tabled=True,
                                                max_rounds=rounds)
         init = init_sharded_swim_state(n, proto, mesh, seed)
-    dead = tuple(dead_nodes)
+    # metric targets: static scripted deaths + permanent churn deaths
+    # (the kernels got the static dead_nodes only — churn die/recover
+    # timing lives in the schedule, not the fail_round mask)
+    dead = SW.detection_targets(dead_nodes, fault)
     rotate = proto.swim_rotate
     epoch_rounds = SW.resolve_epoch_rounds(proto, n)
     from gossip_tpu.ops import round_metrics as RM
@@ -207,7 +214,7 @@ def simulate_swim_curve(proto: ProtocolConfig, n: int, rounds: int,
         # Without this mask, fault-dead observers sit in the denominator
         # and the detection fraction plateaus at the alive fraction, never
         # reaching the target.  Built in-trace: no O(N) inline constant.
-        alive_obs = SW.base_alive(n, tuple(dead_nodes), fault)
+        alive_obs = SW.observer_alive(n, tuple(dead_nodes), fault)
         obs_pad = _swim_obs_pad(alive_obs, n, n_pad)
         m0 = (RM.init(rounds, n_shards, "simulate_swim_curve")
               if rec else None)
@@ -266,7 +273,8 @@ def simulate_swim_until(proto: ProtocolConfig, n: int, max_rounds: int,
                                                tabled=True,
                                                max_rounds=max_rounds)
         init = init_sharded_swim_state(n, proto, mesh, seed)
-    dead = tuple(dead_nodes)
+    # metric targets: static scripted deaths + permanent churn deaths
+    dead = SW.detection_targets(dead_nodes, fault)
     rotate = proto.swim_rotate
     epoch_rounds = SW.resolve_epoch_rounds(proto, n)
     tgt = jnp.float32(target)
@@ -278,7 +286,7 @@ def simulate_swim_until(proto: ProtocolConfig, n: int, max_rounds: int,
 
     @jax.jit
     def loop(state, *tbl):
-        alive_obs = SW.base_alive(n, tuple(dead_nodes), fault)
+        alive_obs = SW.observer_alive(n, tuple(dead_nodes), fault)
         obs_pad = _swim_obs_pad(alive_obs, n, n_pad)
         m0 = (RM.init(max_rounds, n_shards, "simulate_swim_until")
               if rec else None)
@@ -358,17 +366,21 @@ def checkpointed_swim(proto: ProtocolConfig, n: int, run: RunConfig,
                  if resume_state is not None
                  else init_sharded_swim_state(n, proto, mesh, run.seed))
 
+    # metric targets: static scripted deaths + permanent churn deaths
+    # (`dead` stays static-only — it scripts the kernels' fail_round mask)
+    targets = SW.detection_targets(dead, fault)
+
     def detection(s):
         # same in-trace construction as simulate_swim_curve's body:
         # detection of the round just executed (window at s.round - 1),
         # observers sliced to the real rows
-        alive_obs = SW.base_alive(n, dead, fault)
+        alive_obs = SW.observer_alive(n, dead, fault)
         window = SW.subject_window(s.round - 1, proto.swim_subjects, n,
                                    rotate, epoch_rounds)
         return SW.detection_fraction(
             SW.SwimState(s.wire[:n], s.timer[:n], s.round,
-                         s.base_key, s.msgs), dead,
-            alive_obs, subj_gids=window) if dead else jnp.float32(0.0)
+                         s.base_key, s.msgs), targets,
+            alive_obs, subj_gids=window) if targets else jnp.float32(0.0)
 
     curve_fn = detection if want_curve else None
     remaining = max(0, run.max_rounds - int(state.round))
@@ -391,12 +403,14 @@ def compiled_until(proto: ProtocolConfig, topo: Topology, run: RunConfig,
     """Lowered/compiled while-loop runner + fresh init state, for benchmarks
     that must separate compile time from run time.  The returned loop takes
     (state, *tables); pass the returned tables through."""
+    from gossip_tpu.ops import nemesis as NE
     step, tables, init = _build(proto, topo, run, fault)
+    step = NE.drop_lost(step, NE.get(fault))
     target = jnp.float32(run.target_coverage)
 
     @partial(jax.jit, donate_argnums=0)
     def loop(state, *tbl):
-        alive = alive_mask(fault, topo.n, run.origin)
+        alive = NE.metric_alive(fault, topo.n, run.origin)
         def cond(s):
             return ((coverage(s.seen, alive) < target)
                     & (s.round < run.max_rounds))

@@ -1,0 +1,683 @@
+"""Compiled nemesis (ops/nemesis): schedule validation + lowering,
+partition-heal acceptance on the dense AND sparse exchanges, churn
+parity across mesh shapes, SWIM churn timelines, engine rejection
+paths, the nemesis round-metrics observables, and the sidecar's
+transport-retry contract.
+
+The heal bounds asserted here are the docs/ROBUSTNESS.md ones:
+coverage provably stalls at the cut while a window is open (the far
+side starts clean and nothing crosses), then reaches target within
+~2 epidemic legs + slack after heal; SWIM confirms a permanent crash
+and never permanently confirms a node that recovers inside the
+suggested suspicion timeout.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gossip_tpu import config as C
+from gossip_tpu.config import (ChurnConfig, FaultConfig, ProtocolConfig,
+                               RunConfig)
+from gossip_tpu.topology import generators as G
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- config validation (satellite: FaultConfig probability guards) ----
+
+def test_fault_config_rejects_out_of_range_probabilities():
+    with pytest.raises(ValueError, match="node_death_rate"):
+        FaultConfig(node_death_rate=1.5)
+    with pytest.raises(ValueError, match="node_death_rate"):
+        FaultConfig(node_death_rate=-0.1)
+    with pytest.raises(ValueError, match="drop_prob"):
+        FaultConfig(drop_prob=1.5)
+    with pytest.raises(ValueError, match="drop_prob"):
+        FaultConfig(drop_prob=-0.2)
+    # the boundary values stay legal
+    FaultConfig(node_death_rate=1.0, drop_prob=1.0)
+
+
+def test_churn_config_validation():
+    ChurnConfig(events=((3, 2, 5), (7, 1, -1)),
+                partitions=((0, 4, 8), (6, 9, 16)),
+                ramp=(0, 3, 0.0, 1.0))
+    with pytest.raises(ValueError, match="recover_round"):
+        ChurnConfig(events=((3, 5, 5),))          # rec must be > die
+    with pytest.raises(ValueError, match="at most once"):
+        ChurnConfig(events=((3, 1, 2), (3, 5, -1)))
+    with pytest.raises(ValueError, match="die_round"):
+        ChurnConfig(events=((3, -1, 2),))
+    with pytest.raises(ValueError, match="overlap"):
+        ChurnConfig(partitions=((0, 5, 8), (4, 9, 16)))
+    with pytest.raises(ValueError, match="cut"):
+        ChurnConfig(partitions=((0, 5, 0),))
+    with pytest.raises(ValueError, match="start < end"):
+        ChurnConfig(partitions=((5, 5, 8),))
+    with pytest.raises(ValueError, match="outside"):
+        ChurnConfig(ramp=(0, 3, 0.0, 1.5))
+    with pytest.raises(ValueError, match="start < end"):
+        ChurnConfig(ramp=(3, 3, 0.0, 0.5))
+    # the horizon cap: an absurd end would materialize a giant [T]
+    # table (and host list) per trace — reject at config time
+    with pytest.raises(ValueError, match="horizon cap"):
+        ChurnConfig(partitions=((0, 1_000_000_000, 8),))
+    with pytest.raises(ValueError, match="horizon cap"):
+        ChurnConfig(ramp=(0, 1_000_000_000, 0.0, 0.5))
+    # the cap itself stays legal
+    from gossip_tpu.config import MAX_CHURN_HORIZON
+    ChurnConfig(partitions=((0, MAX_CHURN_HORIZON, 8),))
+    # wrong-arity ramp: the clean ValueError every other malformed
+    # churn field gets, not a raw IndexError from the coercion
+    with pytest.raises(ValueError, match="start, end, from_p, to_p"):
+        ChurnConfig(ramp=(0, 5))
+    # event rounds are capped too: a die/rec at ~2**29 would collide
+    # with the kernels' int32 NEVER sentinel (a rec >= NEVER would read
+    # as 'permanent' to the fused denominator but 'recovers' to
+    # eventual_alive) — rec < 0 is the one way to say forever
+    with pytest.raises(ValueError, match="horizon cap"):
+        ChurnConfig(events=((5, 0, 1 << 29),))
+    with pytest.raises(ValueError, match="horizon cap"):
+        ChurnConfig(events=((5, 1 << 31, -1),))
+
+
+def test_vacuous_churn_normalizes_to_none_and_rpc_dict_coerces():
+    # an all-default schedule keeps the static hot path (and its pins)
+    assert FaultConfig(drop_prob=0.1, churn=ChurnConfig()).churn is None
+    # the RPC fault object delivers churn as a nested JSON dict
+    f = FaultConfig(drop_prob=0.1, churn={
+        "events": [[3, 2, 5]], "partitions": [[0, 4, 8]],
+        "ramp": [1, 3, 0.0, 0.5]})
+    assert isinstance(f.churn, ChurnConfig)
+    assert f.churn.events == ((3, 2, 5),)
+    assert f.churn.ramp == (1, 3, 0.0, 0.5)
+    # horizon: the round after which the schedule is constant
+    assert ChurnConfig(partitions=((0, 6, 8),)).horizon() == 7
+    assert ChurnConfig(events=((1, 2, 4),)).horizon() == 2
+
+
+def test_schedule_lowering_tables():
+    from gossip_tpu.ops import nemesis as NE
+    f = FaultConfig(drop_prob=0.1, seed=0, churn=ChurnConfig(
+        events=((3, 2, 5), (7, 1, -1)),
+        partitions=((2, 4, 8),), ramp=(1, 3, 0.0, 0.4)))
+    s = NE.build(f, 16)
+    assert int(s.die[3]) == 2 and int(s.rec[3]) == 5
+    assert int(s.die[7]) == 1 and int(s.rec[7]) == NE.NEVER
+    # cut table: open exactly for [2, 4), clamped lookup exact after T
+    for r, want in ((0, -1), (2, 8), (3, 8), (4, -1), (100, -1)):
+        assert int(NE.cut_at(s, r)) == want, r
+    # drop ramp: base before start, linear inside, held after (exactly
+    # — the clamped last row IS the steady state)
+    assert float(NE.drop_at(s, 0)) == pytest.approx(0.1)
+    assert float(NE.drop_at(s, 2)) == pytest.approx(0.2)
+    assert float(NE.drop_at(s, 3)) == pytest.approx(0.4)
+    assert float(NE.drop_at(s, 1000)) == pytest.approx(0.4)
+    # per-round liveness: down during [die, rec)
+    import jax.numpy as jnp
+    base = jnp.ones((16,), bool)
+    for r, alive3, alive7 in ((1, True, False), (2, False, False),
+                              (4, False, False), (5, True, False)):
+        a = NE.alive_rows(s, base, r)
+        assert bool(a[3]) == alive3 and bool(a[7]) == alive7, r
+    # out-of-range scripted ids are a loud error, not a silent no-op
+    with pytest.raises(ValueError, match="node ids"):
+        NE.validate_events(FaultConfig(churn=ChurnConfig(
+            events=((99, 0, -1),))), 16)
+
+
+# -- partition-heal acceptance (dense + sparse, the ISSUE gate) -------
+
+_HEAL_N = 64
+_HEAL_END = 6
+
+
+def _heal_bound(fanout):
+    # ~2 epidemic legs + slack after the window closes (ROBUSTNESS.md)
+    import math
+    leg = math.ceil(math.log(_HEAL_N) / math.log(1 + fanout))
+    return _HEAL_END + 2 * leg + 4
+
+
+def test_partition_heal_dense():
+    """Coverage provably stalls across the open cut (the far side
+    starts clean, push cannot cross), then converges to target within
+    the documented bound after heal."""
+    from gossip_tpu.runtime.simulator import simulate_curve
+    topo = G.complete(_HEAL_N)
+    proto = ProtocolConfig(mode=C.PUSH, fanout=2, rumors=1)
+    fault = FaultConfig(seed=0, churn=ChurnConfig(
+        partitions=((0, _HEAL_END, 48),)))
+    run = RunConfig(seed=0, max_rounds=24, target_coverage=1.0)
+    res = simulate_curve(proto, topo, run, fault)
+    # stalled: nothing reaches ids >= 48 while the window is open
+    assert all(c <= 48 / _HEAL_N + 1e-6
+               for c in res.coverage[:_HEAL_END]), res.coverage
+    # healed: full coverage within the bound
+    assert res.rounds_to_target != -1
+    assert res.rounds_to_target <= _heal_bound(2), (
+        res.rounds_to_target, list(res.coverage))
+    # and the no-churn control crosses the "cut" early — the stall was
+    # the schedule, not the protocol
+    free = simulate_curve(proto, topo, run, None)
+    assert any(c > 48 / _HEAL_N for c in free.coverage[:_HEAL_END])
+
+
+def test_partition_heal_sparse():
+    """The same stall/heal invariant on the sparse all_to_all exchange
+    (complete-graph stratified pull), mesh-sharded."""
+    from gossip_tpu.parallel.sharded import make_mesh
+    from gossip_tpu.parallel.sharded_sparse import simulate_curve_sparse
+    mesh = make_mesh(4)
+    proto = ProtocolConfig(mode=C.PULL, fanout=1, rumors=1)
+    fault = FaultConfig(seed=0, churn=ChurnConfig(
+        partitions=((0, _HEAL_END, 32),)))
+    run = RunConfig(seed=0, max_rounds=24, target_coverage=1.0)
+    covs, msgs, fin, meta = simulate_curve_sparse(proto, _HEAL_N, run,
+                                                  mesh, fault)
+    assert all(c <= 32 / _HEAL_N + 1e-6 for c in covs[:_HEAL_END]), covs
+    hit = np.nonzero(np.asarray(covs) >= 1.0)[0]
+    assert len(hit), f"sparse never healed: {list(covs)}"
+    assert int(hit[0]) + 1 <= _heal_bound(1) + 6, list(covs)
+
+
+# -- churn parity across mesh shapes ----------------------------------
+
+_CHURN = ChurnConfig(events=((3, 2, 5), (7, 1, -1)),
+                     partitions=((2, 6, 32),), ramp=(1, 4, 0.0, 0.3))
+_CFAULT = FaultConfig(node_death_rate=0.1, drop_prob=0.05, seed=1,
+                      churn=_CHURN)
+
+
+def test_churn_parity_single_vs_sharded_dense():
+    """The full schedule (events + window + ramp) stacked on static
+    faults: bitwise-identical trajectory at 1 and 4 devices — the
+    cross-mesh twin of the static bitwise-parity pins (drop coins and
+    peer draws are keyed by GLOBAL node id; the schedule tables are
+    mesh-shape free)."""
+    from gossip_tpu.parallel.sharded import make_mesh, \
+        simulate_curve_sharded
+    from gossip_tpu.runtime.simulator import simulate_curve
+    topo = G.complete(64)
+    proto = ProtocolConfig(mode=C.PUSH_PULL, fanout=2, rumors=2)
+    run = RunConfig(seed=0, max_rounds=12)
+    res = simulate_curve(proto, topo, run, _CFAULT)
+    covs, msgs, fin = simulate_curve_sharded(proto, topo, run,
+                                             make_mesh(4), _CFAULT)
+    assert np.array_equal(np.asarray(res.coverage), np.asarray(covs))
+    assert np.array_equal(np.asarray(res.msgs), np.asarray(msgs))
+    assert np.array_equal(np.asarray(res.state.seen),
+                          np.asarray(fin.seen)[:64])
+
+
+def test_sparse_mesh_vs_reference_churn_parity():
+    import jax
+    from gossip_tpu.parallel.sharded import make_mesh
+    from gossip_tpu.parallel.sharded_sparse import (
+        init_sparse_state, make_sparse_pull_round,
+        sparse_pull_round_reference)
+    n = 64
+    proto = ProtocolConfig(mode=C.ANTI_ENTROPY, fanout=2, rumors=3,
+                           period=2)
+    run = RunConfig(seed=0, max_rounds=6)
+    sm = init_sparse_state(run, proto, n, make_mesh(4))
+    sr = init_sparse_state(run, proto, n, p=4)
+    jm = jax.jit(make_sparse_pull_round(proto, n, make_mesh(4),
+                                        _CFAULT, 0))
+    jr = jax.jit(sparse_pull_round_reference(proto, n, 4, _CFAULT, 0))
+    for r in range(4):
+        sm, lm = jm(sm)
+        sr, lr = jr(sr)
+        assert np.array_equal(np.asarray(sm.seen), np.asarray(sr.seen))
+        assert float(lm) == float(lr), r
+
+
+def test_packed_matches_unpacked_bitwise_under_churn():
+    import jax
+    from gossip_tpu.models.si import make_si_round
+    from gossip_tpu.models.si_packed import (init_packed_state,
+                                             make_packed_round)
+    from gossip_tpu.models.state import init_state
+    from gossip_tpu.ops.bitpack import unpack
+    n = 64
+    topo = G.complete(n)
+    proto = ProtocolConfig(mode=C.PULL, fanout=2, rumors=3)
+    run = RunConfig(seed=0, max_rounds=6)
+    sp = init_packed_state(run, proto, n)
+    su = init_state(run, proto, n)
+    stp = jax.jit(make_packed_round(proto, topo, _CFAULT, 0))
+    stu = jax.jit(make_si_round(proto, topo, _CFAULT, 0))
+    for r in range(4):
+        sp, lp = stp(sp)
+        su, lu = stu(su)
+        assert np.array_equal(np.asarray(unpack(sp.seen, proto.rumors)),
+                              np.asarray(su.seen)), r
+        assert float(lp) == float(lu), r
+
+
+def test_fault_mask_cross_mesh_determinism():
+    """The same FaultConfig draw kills the same node ids at 1 and 4
+    devices — sharded_alive's real rows ARE the single-device mask,
+    including when padding rows exist (n not divisible)."""
+    from gossip_tpu.models.state import alive_mask
+    from gossip_tpu.parallel.sharded import make_mesh, pad_to_mesh, \
+        sharded_alive
+    n = 61                                       # pads to 64 on 4 dev
+    fault = FaultConfig(node_death_rate=0.3, seed=7)
+    mesh = make_mesh(4)
+    n_pad = pad_to_mesh(n, mesh, "nodes")
+    assert n_pad == 64
+    single = np.asarray(alive_mask(fault, n, 0))
+    padded = np.asarray(sharded_alive(fault, n, n_pad, 0))
+    assert np.array_equal(single, padded[:n])
+    assert not padded[n:].any()                  # padding rows dead
+    # and the draw is seed-deterministic: same ids on a re-draw
+    assert np.array_equal(single, np.asarray(alive_mask(fault, n, 0)))
+    dead_ids = np.nonzero(~single)[0]
+    assert len(dead_ids) > 0                     # 0.3 of 61 draws some
+
+
+# -- seed ensembles under churn (sweep.py) ----------------------------
+
+def test_ensemble_churn_matches_solo_curves():
+    """ensemble_curves under the full schedule: each seed's batched
+    trajectory equals the solo simulate_curve run — the drop_lost
+    wrapper discards the lost count without touching the state, and
+    the coverage denominator is the same eventual alive set."""
+    from gossip_tpu.parallel.sweep import ensemble_curves
+    from gossip_tpu.runtime.simulator import simulate_curve
+    topo = G.complete(64)
+    proto = ProtocolConfig(mode=C.PUSH_PULL, fanout=2, rumors=2)
+    seeds = [0, 3]
+    ens = ensemble_curves(proto, topo, RunConfig(max_rounds=10), seeds,
+                          _CFAULT)
+    for i, seed in enumerate(seeds):
+        solo = simulate_curve(proto, topo,
+                              RunConfig(max_rounds=10, seed=seed),
+                              _CFAULT)
+        np.testing.assert_array_equal(ens.curves[i],
+                                      np.asarray(solo.coverage))
+        np.testing.assert_array_equal(ens.msgs[i],
+                                      np.asarray(solo.msgs))
+
+
+def test_ensemble_rumor_churn_matches_solo():
+    """The rumor ensemble's churn twin: bitwise per-seed parity with
+    simulate_curve_rumor (same metric_alive denominator and hot
+    weighting)."""
+    from gossip_tpu.models.rumor import simulate_curve_rumor
+    from gossip_tpu.parallel.sweep import ensemble_rumor_curves
+    proto = ProtocolConfig(mode="rumor", fanout=1, rumor_k=2)
+    topo = G.complete(64)
+    fault = FaultConfig(seed=1, churn=ChurnConfig(
+        events=((3, 2, 5), (7, 1, -1)), partitions=((2, 5, 32),)))
+    run = RunConfig(max_rounds=24, seed=3)
+    ens = ensemble_rumor_curves(proto, topo, run, [3, 4], fault)
+    solo_covs, solo_hots, solo_msgs, _ = simulate_curve_rumor(
+        proto, topo, RunConfig(max_rounds=24, seed=4), fault)
+    np.testing.assert_array_equal(ens.curves[1], np.asarray(solo_covs))
+    np.testing.assert_array_equal(ens.hot[1], np.asarray(solo_hots))
+    np.testing.assert_array_equal(ens.msgs[1], np.asarray(solo_msgs))
+
+
+def test_ensemble_swim_churn_observer_denominator():
+    """ensemble_swim_curves excludes PERMANENT churn deaths from the
+    observer denominator (matching simulate_swim_curve): detection of
+    a scripted crash reaches 1.0 even though the churn-dead node can
+    never confirm it."""
+    from gossip_tpu.models import swim as SW
+    from gossip_tpu.parallel.sweep import ensemble_swim_curves
+    n = 64
+    t = SW.suggested_suspect_rounds(n, 2)
+    proto = ProtocolConfig(mode=C.SWIM, fanout=2, swim_subjects=8,
+                           swim_proxies=2, swim_suspect_rounds=t)
+    fault = FaultConfig(seed=1, churn=ChurnConfig(events=((5, 2, -1),)))
+    ens = ensemble_swim_curves(
+        proto, n, RunConfig(max_rounds=36, target_coverage=1.0),
+        seeds=[0, 1], dead_nodes=(1,), fail_round=0, fault=fault)
+    assert (ens.curves[:, -1] == 1.0).all()
+    assert (ens.rounds_to_target >= 0).all()
+
+
+def test_config_sweep_rejects_churn():
+    """The grid sweeps have no churn lowering — a schedule must reject
+    loudly, never run static-only (the no-silent-substitution policy)."""
+    from gossip_tpu.parallel.sweep import SweepPoint, config_sweep_curves
+    with pytest.raises(ValueError, match="churn"):
+        config_sweep_curves((SweepPoint(mode=C.PUSH, fanout=1),),
+                            G.complete(64), RunConfig(max_rounds=4),
+                            fault=FaultConfig(seed=1, churn=ChurnConfig(
+                                events=((3, 1, -1),))))
+
+
+# -- engine rejection paths (no silent substitution) ------------------
+
+def test_unsupported_engines_reject_loudly():
+    from gossip_tpu.parallel.sharded import make_mesh
+    mesh = make_mesh(4)
+    part = FaultConfig(seed=0, churn=ChurnConfig(
+        partitions=((0, 4, 32),)))
+    ramp = FaultConfig(seed=0, churn=ChurnConfig(ramp=(0, 2, 0.0, 0.5)))
+    ev = FaultConfig(seed=0, churn=ChurnConfig(events=((1, 0, -1),)))
+    # topo-sparse: no churn at all
+    from gossip_tpu.parallel.sharded_sparse import \
+        make_sparse_topo_pull_round
+    with pytest.raises(ValueError, match="churn"):
+        make_sparse_topo_pull_round(
+            ProtocolConfig(mode=C.PULL, fanout=1, rumors=1),
+            G.erdos_renyi(64, 0.2, seed=0), mesh, ev)
+    # swim: events only
+    from gossip_tpu.models.swim import make_swim_round
+    wproto = ProtocolConfig(mode=C.SWIM, fanout=2, swim_subjects=4,
+                            swim_proxies=2, swim_suspect_rounds=3)
+    with pytest.raises(ValueError, match="partition"):
+        make_swim_round(wproto, 64, fault=part)
+    with pytest.raises(ValueError, match="ramp"):
+        make_swim_round(wproto, 64, fault=ramp)
+    # fused planes: events only (driver entry raises pre-compile)
+    from gossip_tpu.parallel.sharded_fused import (
+        make_plane_mesh, simulate_until_sharded_fused)
+    with pytest.raises(ValueError, match="partition"):
+        simulate_until_sharded_fused(
+            128 * 8, 40, RunConfig(seed=0, max_rounds=2),
+            make_plane_mesh(4), interpret=True, fault=part)
+    # checkpointed drivers: no churn (the segment contract)
+    from gossip_tpu.models.rumor import checkpointed_rumor
+    with pytest.raises(ValueError, match="churn"):
+        checkpointed_rumor(
+            ProtocolConfig(mode=C.RUMOR, fanout=2, rumors=1),
+            G.complete(64), RunConfig(seed=0, max_rounds=4),
+            "/tmp/never-written.npz", fault=ev)
+    # the fused ENGINE routing sends churn back to the XLA kernels
+    from gossip_tpu.backend import _fused_ineligible_reason
+    from gossip_tpu.config import TopologyConfig
+    reason = _fused_ineligible_reason(
+        ProtocolConfig(mode=C.PULL, fanout=1, rumors=1),
+        TopologyConfig(family="complete", n=64), ev, 1)
+    assert reason and "churn" in reason
+
+
+# -- SWIM churn timeline ----------------------------------------------
+
+def test_swim_churn_confirms_crash_never_recovered_node():
+    """The heal gate for failure detection: a permanent churn crash is
+    confirmed DEAD by every alive observer; a node that recovers
+    within the suggested suspicion timeout refutes and is NEVER
+    permanently confirmed.  Sharded twin bitwise-identical."""
+    from gossip_tpu.models import swim as SW
+    from gossip_tpu.parallel.sharded import make_mesh
+    from gossip_tpu.runtime.simulator import simulate_swim_curve
+    n, rounds = 64, 36
+    t = SW.suggested_suspect_rounds(n, 2)
+    proto = ProtocolConfig(mode=C.SWIM, fanout=2, swim_subjects=8,
+                           swim_proxies=2, swim_suspect_rounds=t)
+    fault = FaultConfig(seed=1, churn=ChurnConfig(
+        events=((5, 2, -1), (3, 4, 6))))
+    fr, fin = simulate_swim_curve(proto, n, rounds, dead_nodes=(),
+                                  fail_round=0, fault=fault)
+    status = np.asarray(SW.decode_status(fin.wire))
+    obs = np.asarray(SW.observer_alive(n, (), fault))
+    assert not obs[5]                 # permanent churn death observes not
+    assert (status[obs, 5] == SW.DEAD).all(), "true crash not confirmed"
+    assert (status[obs, 3] != SW.DEAD).all(), \
+        "recovered node permanently confirmed"
+    # sharded twin: bitwise wire parity under churn
+    fr2, fin2 = simulate_swim_curve(proto, n, rounds, dead_nodes=(),
+                                    fail_round=0, fault=fault,
+                                    mesh=make_mesh(4))
+    assert np.array_equal(np.asarray(fin.wire),
+                          np.asarray(fin2.wire)[:n])
+
+
+def test_swim_churn_only_scenario_targets_churn_deaths():
+    """A churn-only SWIM run is a SCRIPTED scenario: no default static
+    death is injected on top of the schedule, the detection metric
+    targets the permanent churn crashes (models/swim.detection_targets
+    wires nemesis.permanent_dead_ids in), and the run converges to
+    detection 1.0 on them."""
+    from gossip_tpu import backend
+    from gossip_tpu.models import swim as SW
+    from gossip_tpu.runtime.simulator import (simulate_swim_curve,
+                                              simulate_swim_until)
+    n = 64
+    t = SW.suggested_suspect_rounds(n, 2)
+    proto = ProtocolConfig(mode=C.SWIM, fanout=2, swim_subjects=8,
+                           swim_proxies=2, swim_suspect_rounds=t)
+    fault = FaultConfig(seed=1, churn=ChurnConfig(events=((5, 2, -1),)))
+    dead, fail_round, meta = backend.swim_scenario_meta(proto, n, fault)
+    assert dead == ()                    # nothing statically scripted
+    assert meta["default_scenario"] is False
+    assert meta["dead_subjects"] == [5]  # the metric's real target set
+    fr, _ = simulate_swim_curve(proto, n, 30, dead_nodes=dead,
+                                fail_round=fail_round, fault=fault)
+    assert fr[-1] == 1.0                 # the churn crash IS detected
+    rounds, det, _, _ = simulate_swim_until(proto, n, 40, 1.0,
+                                            dead_nodes=dead,
+                                            fail_round=fail_round,
+                                            fault=fault)
+    assert det == 1.0 and rounds < 40
+    # recover-only churn: still scripted (no default injection), but no
+    # permanent deaths -> no targets, detection stays 0 (refutation)
+    fault2 = FaultConfig(seed=1, churn=ChurnConfig(events=((5, 2, 4),)))
+    dead2, fr2_, meta2 = backend.swim_scenario_meta(proto, n, fault2)
+    assert dead2 == () and meta2["dead_subjects"] == []
+    fr2, _ = simulate_swim_curve(proto, n, 20, dead_nodes=dead2,
+                                 fail_round=fr2_, fault=fault2)
+    assert fr2[-1] == 0.0
+
+
+def test_fused_rejects_out_of_range_churn_event():
+    """The fused word tables validate event ids like every other engine
+    — an id >= n would land on a phantom lane and silently kill nobody
+    (the no-silent-substitution policy)."""
+    from gossip_tpu.ops import nemesis as NE
+    bad = FaultConfig(seed=1, churn=ChurnConfig(events=((70, 2, -1),)))
+    with pytest.raises(ValueError, match="node ids >= n"):
+        NE.fused_word_tables(bad, 64)
+    with pytest.raises(ValueError, match="node ids >= n"):
+        NE.build(bad, 64)
+
+
+# -- nemesis observables in the round-metrics plane -------------------
+
+def test_round_metrics_carry_nemesis_observables(tmp_path):
+    from gossip_tpu.parallel.sharded import make_mesh, \
+        simulate_curve_sharded
+    from gossip_tpu.utils import telemetry
+    path = str(tmp_path / "churn.jsonl")
+    proto = ProtocolConfig(mode=C.PUSH_PULL, fanout=2, rumors=2)
+    fault = FaultConfig(drop_prob=0.05, seed=1, churn=ChurnConfig(
+        events=((3, 2, 5),), partitions=((0, 4, 32),)))
+    run = RunConfig(seed=0, max_rounds=8)
+    led = telemetry.Ledger(path)
+    prev = telemetry.activate(led)
+    try:
+        simulate_curve_sharded(proto, G.complete(64), run, make_mesh(4),
+                               fault)
+    finally:
+        telemetry.activate(prev)
+        led.close()
+    evs = telemetry.load_ledger(path)
+    rms = [e for e in evs if e.get("ev") == "round_metrics"]
+    assert rms, "no round_metrics event ledgered"
+    e = rms[-1]
+    assert e["rounds"] == 8
+    for series in ("alive", "cut_pairs", "dropped"):
+        assert len(e[series]) == 8, series
+    # the window [0, 4) separates alive pairs; closed after
+    assert all(p > 0 for p in e["cut_pairs"][:4])
+    assert all(p == 0 for p in e["cut_pairs"][4:])
+    # node 3 down during rounds [2, 5): alive count dips by exactly 1
+    assert e["alive"][0] == 64 and e["alive"][2] == 63
+    assert e["alive"][5] == 64
+    # dropped totals join the gated totals and match the series
+    assert e["totals"]["dropped"] == pytest.approx(
+        sum(e["dropped"]), abs=0.01)
+    # the report renders the dropped column from this ledger
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "ledger_diff", os.path.join(_REPO, "tools", "ledger_diff.py"))
+    ledger_diff = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ledger_diff)
+    md = "\n".join(ledger_diff.render_protocol_metrics(evs))
+    assert "dropped" in md and "simulate_curve_sharded" in md
+
+
+def test_committed_churn_artifact_renders():
+    """The committed churn-scenario record
+    (artifacts/ledger_churn_r10.jsonl): provenance-carrying, nemesis
+    totals present on BOTH exchanges (dense + sparse), heal reached."""
+    from gossip_tpu.utils import telemetry
+    path = os.path.join(_REPO, "artifacts", "ledger_churn_r10.jsonl")
+    evs = telemetry.load_ledger(path, run="last")
+    assert evs[0]["ev"] == "provenance"
+    assert len(evs[0]["git_commit"]) == 40
+    rms = {e["driver"]: e for e in evs
+           if e.get("ev") == "round_metrics"}
+    assert {"simulate_curve_sharded", "simulate_curve_sparse"} \
+        <= set(rms)
+    for e in rms.values():
+        assert e["totals"]["dropped"] > 0
+        assert any(p > 0 for p in e["cut_pairs"])
+    curves = {e["family"]: e for e in evs
+              if e.get("ev") == "churn_curve"}
+    assert curves["dense_pushpull"]["final"] == 1.0
+    assert curves["sparse_pull"]["final"] == 1.0
+
+
+def test_validate_artifacts_requires_provenance_on_nemesis(tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "validate_artifacts",
+        os.path.join(_REPO, "tools", "validate_artifacts.py"))
+    va = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(va)
+    bad = tmp_path / "churn_scenario_rXX.jsonl"
+    bad.write_text(json.dumps({"ev": "round_metrics_free_rider"}) + "\n")
+    problems = va.validate_file(str(bad))
+    assert problems and any("nemesis" in p or "churn" in p
+                            for p in problems)
+    badj = tmp_path / "nemesis_sweep.json"
+    badj.write_text(json.dumps({"coverage": [1.0]}))
+    assert va.validate_file(str(badj))
+
+
+# -- no-churn pins ----------------------------------------------------
+
+def test_no_churn_configs_stay_bitwise_unchanged():
+    """A fault carrying a VACUOUS churn object runs the static hot path
+    bitwise (the FaultConfig normalization) — the cheap in-gate twin of
+    the full no-churn fingerprint the parity suites pin."""
+    from gossip_tpu.runtime.simulator import simulate_curve
+    topo = G.complete(64)
+    proto = ProtocolConfig(mode=C.PUSH_PULL, fanout=2, rumors=2)
+    run = RunConfig(seed=0, max_rounds=6)
+    f0 = FaultConfig(node_death_rate=0.1, drop_prob=0.1, seed=1)
+    f1 = FaultConfig(node_death_rate=0.1, drop_prob=0.1, seed=1,
+                     churn=ChurnConfig())
+    a = simulate_curve(proto, topo, run, f0)
+    b = simulate_curve(proto, topo, run, f1)
+    assert np.array_equal(np.asarray(a.state.seen),
+                          np.asarray(b.state.seen))
+    assert np.array_equal(a.msgs, b.msgs)
+
+
+# -- CLI parse --------------------------------------------------------
+
+def test_cli_churn_parse():
+    import argparse
+
+    from gossip_tpu.cli import _parse_churn
+    ns = argparse.Namespace(churn_event=["3:2:5", "7:1"],
+                            partition=["0:4:32"],
+                            drop_ramp="1:4:0.0:0.3")
+    ch = _parse_churn(ns)
+    assert ch.events == ((3, 2, 5), (7, 1, -1))
+    assert ch.partitions == ((0, 4, 32),)
+    assert ch.ramp == (1, 4, 0.0, 0.3)
+    assert _parse_churn(argparse.Namespace(
+        churn_event=None, partition=None, drop_ramp=None)) is None
+    with pytest.raises(ValueError, match="churn-event"):
+        _parse_churn(argparse.Namespace(churn_event=["3"],
+                                        partition=None, drop_ramp=None))
+    with pytest.raises(ValueError, match="partition"):
+        _parse_churn(argparse.Namespace(churn_event=None,
+                                        partition=["0:4"],
+                                        drop_ramp=None))
+
+
+# -- sidecar transport retry (satellite) ------------------------------
+
+def _fake_rpc_error(code):
+    import grpc
+
+    class E(grpc.RpcError):
+        def code(self):
+            return code
+
+    return E()
+
+
+def test_sidecar_retries_transient_then_succeeds(tmp_path):
+    import grpc
+
+    from gossip_tpu.rpc.sidecar import SidecarClient
+    from gossip_tpu.utils import telemetry
+    client = SidecarClient("127.0.0.1:1", max_attempts=4,
+                           backoff_base=0.001, backoff_cap=0.002)
+    calls = []
+
+    def flaky(payload, timeout):
+        calls.append(timeout)
+        if len(calls) < 3:
+            raise _fake_rpc_error(grpc.StatusCode.UNAVAILABLE)
+        return b'{"ok": true}'
+
+    path = str(tmp_path / "rpc.jsonl")
+    led = telemetry.Ledger(path)
+    prev = telemetry.activate(led)
+    try:
+        out = client._call_with_retry(flaky, b"{}", 1.0, "health")
+    finally:
+        telemetry.activate(prev)
+        led.close()
+    assert out == b'{"ok": true}'
+    assert len(calls) == 3                      # 2 retries, fresh deadline each
+    retries = [e for e in telemetry.load_ledger(path)
+               if e.get("ev") == "rpc_retry"]
+    assert [e["attempt"] for e in retries] == [1, 2]
+    assert all(e["method"] == "health" and "UNAVAILABLE" in e["code"]
+               for e in retries)
+    client.close()
+
+
+def test_sidecar_never_retries_well_formed_error_reply():
+    import grpc
+
+    from gossip_tpu.rpc.sidecar import SidecarClient
+    client = SidecarClient("127.0.0.1:1", max_attempts=4,
+                           backoff_base=0.001)
+    calls = []
+
+    def invalid(payload, timeout):
+        calls.append(1)
+        raise _fake_rpc_error(grpc.StatusCode.INVALID_ARGUMENT)
+
+    with pytest.raises(grpc.RpcError):
+        client._call_with_retry(invalid, b"{}", 1.0, "run")
+    assert len(calls) == 1                       # raised immediately
+
+    # and the attempt cap bounds a dead transport
+    dead_calls = []
+
+    def dead(payload, timeout):
+        dead_calls.append(1)
+        raise _fake_rpc_error(grpc.StatusCode.UNAVAILABLE)
+
+    with pytest.raises(grpc.RpcError):
+        client._call_with_retry(dead, b"{}", 1.0, "run")
+    assert len(dead_calls) == client.max_attempts
+    client.close()

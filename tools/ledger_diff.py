@@ -278,7 +278,10 @@ def diff(old, new, ratio=1.8, steady_floor_ms=50.0,
             continue
         row = {"driver": drv, "old": o, "new": n, "flagged": []}
         if same_mesh:
-            for key in ("newly", "dup", "msgs", "bytes"):
+            # "dropped" joins the gated totals when the run carries the
+            # nemesis observables (ops/round_metrics churn columns) —
+            # absent keys fail the isinstance guard and are skipped
+            for key in ("newly", "dup", "msgs", "bytes", "dropped"):
                 a, b = o.get(key), n.get(key)
                 if not isinstance(a, (int, float)) \
                         or not isinstance(b, (int, float)):
@@ -348,15 +351,20 @@ def render(old, new, d):
     if d["metric_rows"]:
         out.append("## Round-metric totals")
         out.append("")
-        out.append("| driver | rounds old→new | newly old→new "
-                   "| dup old→new | msgs old→new | bytes old→new "
-                   "| flagged |")
-        out.append("|---|---|---|---|---|---|---|")
+        # the dropped column only renders when some run carries the
+        # nemesis observables (churn schedules, ops/nemesis)
+        nem = any(r["old"].get("dropped") is not None
+                  or r["new"].get("dropped") is not None
+                  for r in d["metric_rows"])
+        keys = ("rounds", "newly", "dup", "msgs", "bytes") \
+            + (("dropped",) if nem else ())
+        out.append("| driver | " + " | ".join(
+            f"{k} old→new" for k in keys) + " | flagged |")
+        out.append("|---" * (len(keys) + 2) + "|")
         for r in d["metric_rows"]:
             o, n = r["old"], r["new"]
             cells = [f"{_fmt(o.get(k))} → {_fmt(n.get(k))}"
-                     for k in ("rounds", "newly", "dup", "msgs",
-                               "bytes")]
+                     for k in keys]
             out.append(f"| {r['driver']} | " + " | ".join(cells)
                        + f" | {', '.join(r['flagged']) or '—'} |")
         out.append("")
@@ -384,19 +392,25 @@ def render_protocol_metrics(events):
     last = dict(_indexed_metric_events(events))
     if not last:
         return []
+    # the dropped column renders only when some driver ran a nemesis
+    # schedule (ops/round_metrics churn observables)
+    nem = any((e.get("totals") or {}).get("dropped") is not None
+              for e in last.values())
     out = ["## Protocol metrics (per-driver round totals)", ""]
     out.append("| driver | rounds | shards | newly | dup (est) | msgs "
-               "| bytes/dev | front min..max |")
-    out.append("|---|---|---|---|---|---|---|---|")
+               "| bytes/dev" + (" | dropped" if nem else "")
+               + " | front min..max |")
+    out.append("|---" * (8 + (1 if nem else 0)) + "|")
     for drv in sorted(last):
         e = last[drv]
         t = e.get("totals") or {}
         ff = e.get("front_final") or []
         spread = (f"{min(ff):.3f}..{max(ff):.3f}" if ff else "—")
+        dropped = f"| {_fmt(t.get('dropped'))} " if nem else ""
         out.append(f"| {drv} | {e.get('rounds')} | {e.get('shards')} "
                    f"| {_fmt(t.get('newly'))} | {_fmt(t.get('dup'))} "
                    f"| {_fmt(t.get('msgs'))} | {_fmt(t.get('bytes'))} "
-                   f"| {spread} |")
+                   f"{dropped}| {spread} |")
     out.append("")
     return out
 

@@ -90,6 +90,14 @@ def _has_provenance_keys(obj) -> bool:
                                           for k in PROVENANCE_KEYS)
 
 
+def _is_nemesis_name(name: str) -> bool:
+    """Churn/nemesis scenario artifacts by name — robustness evidence
+    (heal convergence, fault observables) must always be attributable;
+    the legacy allowlist can never grandfather one in (the whole
+    nemesis layer post-dates the provenance schema)."""
+    return "churn" in name or "nemesis" in name
+
+
 def validate_file(path):
     """[] when valid, else a list of human-readable problems."""
     name = os.path.basename(path)
@@ -123,10 +131,20 @@ def validate_file(path):
                     "carries round_metrics events but no provenance "
                     "line — round-metric artifacts must be "
                     "attributable (utils/telemetry.provenance)")
+            if not has_prov and _is_nemesis_name(name):
+                problems.append(
+                    "nemesis/churn artifact without a provenance line "
+                    "— robustness evidence must be attributable, "
+                    "allowlist or not (utils/telemetry.provenance)")
         else:
             with open(path) as f:
                 doc = json.load(f)
-            if name not in LEGACY and not _has_provenance_keys(doc):
+            if _is_nemesis_name(name) and not _has_provenance_keys(doc):
+                problems.append(
+                    "nemesis/churn artifact without provenance keys "
+                    f"{PROVENANCE_KEYS} — robustness evidence must be "
+                    "attributable, allowlist or not")
+            elif name not in LEGACY and not _has_provenance_keys(doc):
                 problems.append(
                     "new-format json without provenance keys "
                     f"{PROVENANCE_KEYS} (embed utils/telemetry."
