@@ -139,6 +139,75 @@ def run_xla_packed(n):
     return rounds, dt, "bit-packed pull SI (XLA fallback)", split
 
 
+def run_churn_families(on_tpu):
+    """The nemesis families on the scoreboard line (the traced-operand
+    PR): per-family walls so the BENCH trajectory carries the fault
+    path, not just the fault-free flagship.
+
+    * ``churn_heal`` — the flagship pull config under a FULL nemesis
+      program (crash/recover churn + partition window + drop ramp) run
+      to target through the XLA kernels; rate is node-rounds/s on this
+      backend (schedules are runtime operands, so this is the same
+      compiled shape every scenario shares).
+    * ``churn_sweep`` — K=8 mixed scenarios through ONE compiled loop
+      (parallel/sweep.churn_sweep_curves); ``first_ms`` pays the one
+      compile, ``warm_ms`` re-runs a DIFFERENT scenario family of the
+      same shapes (pure executable reuse — the amortization this PR
+      exists for; committed deep record:
+      artifacts/ledger_churn_sweep_r11.jsonl, 8-scenario warm path vs
+      solo recompiles)."""
+    from gossip_tpu.config import (ChurnConfig, FaultConfig,
+                                   ProtocolConfig, RunConfig)
+    from gossip_tpu.models.si_packed import simulate_until_packed
+    from gossip_tpu.parallel.sweep import churn_sweep_curves
+    from gossip_tpu.topology import generators as G
+
+    n = 1_000_000 if on_tpu else 100_000
+    heal_end = 6
+    topo = G.complete(n)
+    proto = ProtocolConfig(mode="pull", fanout=1, rumors=1)
+    run = RunConfig(target_coverage=TARGET, max_rounds=128, seed=0)
+    fault = FaultConfig(drop_prob=0.02, seed=0, churn=ChurnConfig(
+        events=((1, 1, 4), (2, 2, -1)),
+        partitions=((0, heal_end, n // 2),),
+        ramp=(0, 4, 0.0, 0.1)))
+    t0 = time.perf_counter()
+    rounds, cov, _msgs, _ = simulate_until_packed(proto, topo, run,
+                                                  fault)
+    heal_s = time.perf_counter() - t0
+    heal = {"n": n, "rounds": rounds, "coverage": round(cov, 6),
+            "wall_ms": round(heal_s * 1e3, 1),
+            "node_rounds_per_sec": round(n * rounds / heal_s, 1),
+            "scenario": "2 churn events + partition [0,6) at n/2 + "
+                        "ramp 0->0.1"}
+
+    kn = 65_536 if on_tpu else 8_192
+    ktopo = G.complete(kn)
+    kproto = ProtocolConfig(mode="pull", fanout=1, rumors=1)
+    krun = RunConfig(target_coverage=TARGET, max_rounds=32, seed=0)
+
+    def family(salt):
+        # the ONE shared scenario-family generator (the dry run's
+        # churn_sweep family and tools/churn_sweep_capture.py use it
+        # too — same shape coverage on every surface)
+        from gossip_tpu.ops import nemesis as NE
+        return NE.mixed_scenarios(8, kn, salt=salt, drop_prob=0.01,
+                                  seed=0, ramp_to=0.09)
+
+    t0 = time.perf_counter()
+    res = churn_sweep_curves(kproto, ktopo, krun, family(0))
+    first_ms = (time.perf_counter() - t0) * 1e3
+    t0 = time.perf_counter()
+    res = churn_sweep_curves(kproto, ktopo, krun, family(9))
+    warm_ms = (time.perf_counter() - t0) * 1e3
+    sweep = {"k": 8, "n": kn,
+             "first_ms": round(first_ms, 1),
+             "warm_ms": round(warm_ms, 1),
+             "amortization": round(first_ms / max(warm_ms, 1e-9), 1),
+             "converged": int((res.rounds_to_target >= 0).sum())}
+    return {"churn_heal": heal, "churn_sweep": sweep}
+
+
 def body():
     """The measurement itself — runs in a subprocess whose platform the
     parent has already probed (or forced to CPU)."""
@@ -165,8 +234,12 @@ def body():
     # mesh in tests/test_packed.py).
     n_chips = 1
     rate = n * rounds / dt / n_chips
+    # the nemesis families ride the same line (run AFTER the flagship
+    # measurement so they can never perturb it)
+    families = run_churn_families(on_tpu)
     print(json.dumps(measurement_line(rate, backend, n, variant, rounds, dt,
-                                      compile_split=split)))
+                                      compile_split=split,
+                                      families=families)))
     return 0
 
 
@@ -223,7 +296,7 @@ def last_tpu_capture():
 
 
 def measurement_line(rate, backend, n, variant, rounds, dt,
-                     compile_split=None):
+                     compile_split=None, families=None):
     """The one-JSON-line scoreboard contract (tests/test_bench_contract.py).
 
     ``vs_baseline`` compares against a TPU-derived north-star rate, so it
@@ -240,7 +313,13 @@ def measurement_line(rate, backend, n, variant, rounds, dt,
     a fresh one-shot executable store (_bench_compile_split).  The
     machine-readable warm-start proof on boxes where the rate itself
     cannot move; the parent re-emits the whole line into the run
-    ledger, so the split lands there too."""
+    ledger, so the split lands there too.
+
+    ``families`` (the traced-operand PR): per-family nemesis walls —
+    ``churn_heal`` (the flagship config under a full fault program)
+    and ``churn_sweep`` (K scenarios, one executable, with the
+    first/warm amortization split) — ride the line the same optional
+    way, honestly tagged by the line's own ``backend``."""
     on_tpu = backend == "tpu"
     line = {
         "metric": "node_rounds_per_sec_per_chip",
@@ -253,6 +332,8 @@ def measurement_line(rate, backend, n, variant, rounds, dt,
     }
     if compile_split is not None:
         line["compile_split"] = compile_split
+    if families is not None:
+        line["families"] = families
     if not on_tpu:
         line["last_tpu"] = last_tpu_capture()
     return line

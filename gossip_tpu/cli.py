@@ -850,6 +850,73 @@ def cmd_grid(a) -> int:
     return 0
 
 
+def _parse_scenario(spec: str):
+    """One ``--scenario`` spec -> ChurnConfig: ';'-separated
+    ``event=NODE:DIE[:REC]`` / ``partition=START:END:CUT`` /
+    ``ramp=START:END:P0:P1`` items (the colon syntax of the run
+    command's --churn-event/--partition/--drop-ramp, reused via
+    _parse_churn so the two surfaces cannot drift)."""
+    events, partitions, ramp = [], [], None
+    for item in filter(None, (s.strip() for s in spec.split(";"))):
+        key, _, val = item.partition("=")
+        if key == "event":
+            events.append(val)
+        elif key == "partition":
+            partitions.append(val)
+        elif key == "ramp":
+            if ramp is not None:
+                raise ValueError(
+                    f"scenario {spec!r} has more than one ramp")
+            ramp = val
+        else:
+            raise ValueError(
+                f"unknown scenario field {key!r} in {spec!r} "
+                "(use event= / partition= / ramp=)")
+    ch = _parse_churn(argparse.Namespace(
+        churn_event=events or None, partition=partitions or None,
+        drop_ramp=ramp))
+    if ch is None:
+        raise ValueError(f"scenario {spec!r} scripts no faults")
+    return ch
+
+
+def cmd_churn_sweep(a) -> int:
+    """K nemesis scenarios — distinct churn/partition/drop-ramp fault
+    programs over ONE protocol config — as ONE compiled XLA program:
+    the schedule stack rides the compiled round loop as a runtime
+    operand (parallel/sweep.churn_sweep_curves), so the whole scenario
+    family costs one compile and a re-run with new scenarios of the
+    same shapes costs none.  Per-scenario trajectories are bitwise the
+    solo ``run`` command's.  --devices shards the scenario axis."""
+    from gossip_tpu.parallel.sweep import churn_sweep_curves
+    from gossip_tpu.topology import generators as G
+    scens = [_parse_scenario(s) for s in a.scenario]
+    proto = ProtocolConfig(mode=a.mode, fanout=a.fanout, rumors=a.rumors,
+                           period=a.period)
+    tc = TopologyConfig(family=a.family, n=a.n, k=a.k, p=a.p,
+                        seed=a.seed)
+    run = RunConfig(target_coverage=a.target, max_rounds=a.max_rounds,
+                    seed=a.seed)
+    faults = [FaultConfig(node_death_rate=a.death, drop_prob=a.drop,
+                          seed=a.seed, churn=ch) for ch in scens]
+    mesh = None
+    if a.devices > 1:
+        if len(faults) % a.devices:
+            print(f"error: {len(faults)} scenarios do not divide over "
+                  f"{a.devices} devices", file=sys.stderr)
+            return 2
+        from gossip_tpu.parallel.sharded import make_mesh
+        mesh = make_mesh(a.devices, axis_name="scenario")
+    res = churn_sweep_curves(proto, G.build(tc), run, faults, mesh=mesh)
+    out = {"churn_sweep": res.summaries(), "n": tc.n, "mode": a.mode,
+           "scenarios": len(faults), "target": run.target_coverage}
+    if a.curve:
+        out["curves"] = [[round(float(c), 6) for c in row]
+                         for row in res.curves]
+    print(json.dumps(out))
+    return 0
+
+
 def cmd_serve(a) -> int:
     from gossip_tpu.rpc.sidecar import serve
     server, port = serve(a.port, a.workers)
@@ -988,6 +1055,45 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_cache_flags(p)
     p.set_defaults(fn=cmd_grid)
 
+    p = sub.add_parser("churn-sweep",
+                       help="run K nemesis scenarios (churn/partition/"
+                            "drop-ramp fault programs) through ONE "
+                            "compiled loop and report per-scenario "
+                            "convergence + exact dropped totals")
+    p.add_argument("--scenario", action="append", required=True,
+                   metavar="SPEC",
+                   help="one fault program: ';'-separated "
+                        "event=NODE:DIE[:REC] / partition=START:END:CUT "
+                        "/ ramp=START:END:P0:P1 items; repeat the flag "
+                        "per scenario")
+    p.add_argument("--n", type=int, default=4096)
+    p.add_argument("--family", default="complete",
+                   choices=("complete", "ring", "grid", "erdos_renyi",
+                            "watts_strogatz", "power_law"))
+    p.add_argument("--k", type=int, default=4)
+    p.add_argument("--p", type=float, default=0.01)
+    p.add_argument("--mode", default="pushpull",
+                   choices=("push", "pull", "pushpull", "flood",
+                            "antientropy"))
+    p.add_argument("--fanout", type=int, default=2)
+    p.add_argument("--rumors", type=int, default=1)
+    p.add_argument("--period", type=int, default=1)
+    p.add_argument("--target", type=float, default=0.99)
+    p.add_argument("--max-rounds", type=int, default=64)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--drop", type=float, default=0.0,
+                   help="base link drop probability (the drop table "
+                        "outside any ramp; may differ per run, not per "
+                        "scenario)")
+    p.add_argument("--death", type=float, default=0.0,
+                   help="static death rate (shared by every scenario — "
+                        "the one compiled step bakes the static mask)")
+    p.add_argument("--curve", action="store_true")
+    p.add_argument("--devices", type=int, default=1,
+                   help="shard the scenario axis over this many devices")
+    _add_cache_flags(p)
+    p.set_defaults(fn=cmd_churn_sweep)
+
     p = sub.add_parser("serve", help="start the gRPC sidecar")
     p.add_argument("--port", type=int, default=50051)
     p.add_argument("--workers", type=int, default=4)
@@ -1038,7 +1144,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     a = ap.parse_args(argv)
     try:
-        if a.cmd in ("run", "sweep", "grid", "serve"):
+        if a.cmd in ("run", "sweep", "grid", "churn-sweep", "serve"):
             # multi-host pods: one jax.distributed.initialize() per host
             # before any jax API (no-op without the coordinator env vars)
             from gossip_tpu.parallel.multislice import maybe_init_distributed

@@ -90,19 +90,21 @@ def make_rumor_round(proto: ProtocolConfig, topo: Topology,
     from gossip_tpu.ops import nemesis as NE
     ch = NE.get(fault)
     if ch is not None:
-        NE.validate_events(fault, n)
+        # schedule as runtime operands on the table tail (models/si.py
+        # twin; ops/nemesis module doc)
+        tables = tables + NE.sched_args(NE.build(fault, n))
 
     def step_tabled(state: RumorState, *tbl):
+        tbl, sched = NE.split_tables(ch, tbl)
         nbrs_t, deg_t = tbl if tbl else (None, None)
         ids = jnp.arange(n, dtype=jnp.int32)
         rkey = jax.random.fold_in(state.base_key, state.round)
         seen, hot, cnt = state.seen, state.hot, state.cnt
         if ch is not None:
             # churn path: per-round liveness / drop prob / cut from the
-            # schedule tables (ops/nemesis).  A churn-down node loses
-            # its hot (forwarding) state like a process crash; its seen
-            # set persists (the durable dedup store, main.go:22-26).
-            sched = NE.build(fault, n)
+            # schedule operands.  A churn-down node loses its hot
+            # (forwarding) state like a process crash; its seen set
+            # persists (the durable dedup store, main.go:22-26).
             alive = NE.alive_rows(sched, NE.base_alive_or_ones(
                 fault, n, origin), state.round)
             dp = NE.drop_at(sched, state.round)

@@ -70,17 +70,21 @@ def make_si_round(proto: ProtocolConfig, topo: Topology,
     from gossip_tpu.ops import nemesis as NE
     ch = NE.get(fault)
     if ch is not None:
-        NE.validate_events(fault, n)
+        # schedule as runtime OPERANDS: built ONCE on the host and
+        # appended to the step's table arguments, so the compiled loop
+        # carries schedule shapes but no schedule content (ops/nemesis
+        # module doc — one executable serves a whole scenario family)
+        tables = tables + NE.sched_args(NE.build(fault, n))
 
     def step_tabled(state: SimState, *tbl):
+        tbl, sched = NE.split_tables(ch, tbl)
         nbrs_t, deg_t = tbl if tbl else (None, None)
         ids = jnp.arange(n, dtype=jnp.int32)
         rkey = jax.random.fold_in(state.base_key, state.round)
         seen = state.seen
         if ch is not None:
             # churn path: per-round liveness / drop prob / cut from the
-            # schedule tables, indexed by the loop counter (ops/nemesis)
-            sched = NE.build(fault, n)
+            # schedule operands, indexed by the loop counter
             alive = NE.alive_rows(sched, NE.base_alive_or_ones(
                 fault, n, origin), state.round)
             dp = NE.drop_at(sched, state.round)

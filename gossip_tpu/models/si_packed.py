@@ -99,17 +99,19 @@ def make_packed_round(proto: ProtocolConfig, topo: Topology,
     from gossip_tpu.ops import nemesis as NE
     ch = NE.get(fault)
     if ch is not None:
-        NE.validate_events(fault, n)
+        # schedule as runtime operands on the table tail (models/si.py
+        # twin; ops/nemesis module doc)
+        tables = tables + NE.sched_args(NE.build(fault, n))
 
     def step_tabled(state: SimState, *tbl):
+        tbl, sched = NE.split_tables(ch, tbl)
         nbrs_t, deg_t = tbl if tbl else (None, None)
         ids = jnp.arange(n, dtype=jnp.int32)
         rkey = jax.random.fold_in(state.base_key, state.round)
         packed = state.seen
         if ch is not None:
             # churn path: per-round liveness / drop prob / cut from the
-            # schedule tables (ops/nemesis; models/si.py twin)
-            sched = NE.build(fault, n)
+            # schedule operands (models/si.py twin)
             alive = NE.alive_rows(sched, NE.base_alive_or_ones(
                 fault, n, origin), state.round)
             dp = NE.drop_at(sched, state.round)

@@ -315,12 +315,15 @@ def disseminate_max(targets: jax.Array, wire: jax.Array, num_rows: int,
 
 
 def probe_draws(rkey, gids, s_count: int, n: int, proxies: int,
-                drop_prob: float):
+                drop_prob, force: bool = False):
     """Steps 1-2 random draws: each node's probed subject, direct-probe drop,
     proxy ids, and the two per-proxy hop drops.  All keyed by *global* node
     id so the sharded kernel reproduces them bitwise (ops/sampling
-    contract).  Returns (subj[Nl], d_drop[Nl], proxy_ids[Nl,K],
-    to_p[Nl,K], p_to_s[Nl,K])."""
+    contract).  ``force=True`` skips the static zero-rate early-out so
+    ``drop_prob`` may be a TRACED per-round scalar (the ops/nemesis
+    drop-ramp path — bernoulli takes a traced p, and a p == the static
+    value draws the identical coins).  Returns (subj[Nl], d_drop[Nl],
+    proxy_ids[Nl,K], to_p[Nl,K], p_to_s[Nl,K])."""
     keys = node_keys(jax.random.fold_in(rkey, _SUBJ_TAG), gids)
     subj = jax.vmap(
         lambda k: jax.random.randint(k, (), 0, s_count, dtype=jnp.int32)
@@ -330,7 +333,7 @@ def probe_draws(rkey, gids, s_count: int, n: int, proxies: int,
         lambda k: jax.random.randint(k, (proxies,), 0, n, dtype=jnp.int32)
     )(pkeys)
     m = len(gids)
-    if drop_prob > 0.0:
+    if force or drop_prob > 0.0:
         d_drop = drop_mask(rkey, _DIRECT_DROP_TAG, gids, 1, drop_prob)[:, 0]
         to_p = drop_mask(rkey, _TO_PROXY_DROP_TAG, gids, proxies, drop_prob)
         p_to_s = drop_mask(rkey, _PROXY_SUBJ_DROP_TAG, gids, proxies,
@@ -345,8 +348,9 @@ _PACKED_TAG = 16          # the packed-rng lowering's one fold_in tag
 
 
 def packed_round_draws(rkey, gids, s_count: int, n: int, proxies: int,
-                       fanout: int, drop_prob: float,
-                       nbrs=None, deg=None, sentinel: Optional[int] = None):
+                       fanout: int, drop_prob,
+                       nbrs=None, deg=None, sentinel: Optional[int] = None,
+                       force: bool = False):
     """ALL of a SWIM round's per-node randomness from ONE key chain and
     ONE multi-word draw (``ProtocolConfig.swim_rng='packed'``).
 
@@ -379,9 +383,16 @@ def packed_round_draws(rkey, gids, s_count: int, n: int, proxies: int,
     'split' (different streams) — this is an engine-level contract
     like fused-SI-vs-threefry, not a relowering.
 
+    ``force=True`` (the ops/nemesis drop-ramp path) always draws the
+    coin words with ``drop_prob`` as a TRACED threshold — computed in
+    float32, so the effective threshold quantizes within one f32 ulp of
+    the static path's exact ``int(p * 2**32)`` (the same documented
+    tolerance class as the modulo bias above; ramp configs have no
+    static twin to match bitwise).
+
     Returns ``(subj, d_drop, proxy_ids, to_p, p_to_s, targets)`` —
     probe_draws' tuple plus the dissemination targets."""
-    have_drop = drop_prob > 0.0
+    have_drop = force or drop_prob > 0.0
     w = 1 + proxies + fanout + (1 + 2 * proxies if have_drop else 0)
     keys = node_keys(jax.random.fold_in(rkey, _PACKED_TAG), gids)
     words = jax.vmap(
@@ -410,7 +421,17 @@ def packed_round_draws(rkey, gids, s_count: int, n: int, proxies: int,
 
     m = len(gids)
     if have_drop:
-        thresh = jnp.uint32(min(int(drop_prob * 2**32), 2**32 - 1))
+        if force:
+            # traced p -> uint32 threshold in f32 (clamped below 2**32:
+            # 4294967040 is the largest f32 under it, so the convert
+            # can never overflow; p >= 1 saturates to all-ones)
+            dp = jnp.asarray(drop_prob, jnp.float32)
+            thresh = jnp.where(
+                dp >= 1.0, jnp.uint32(0xFFFFFFFF),
+                jnp.minimum(dp * jnp.float32(4294967296.0),
+                            jnp.float32(4294967040.0)).astype(jnp.uint32))
+        else:
+            thresh = jnp.uint32(min(int(drop_prob * 2**32), 2**32 - 1))
         base = 1 + proxies + fanout
         d_drop = words[:, base] < thresh
         to_p = words[:, base + 1:base + 1 + proxies] < thresh
@@ -459,19 +480,27 @@ def make_swim_round(proto: ProtocolConfig, n: int,
     drop_prob = 0.0 if fault is None else fault.drop_prob
     from gossip_tpu.ops import nemesis as NE
     # SWIM probes ride the complete membership overlay (no per-pair
-    # messages a link cut models) and its drop streams are baked static:
-    # churn EVENTS are the supported schedule — exactly the scenario
-    # SWIM exists to detect (Das et al., DSN 2002)
-    NE.check_supported(fault, engine="swim", partitions=False, ramp=False)
+    # messages a link cut models): churn EVENTS — exactly the scenario
+    # SWIM exists to detect (Das et al., DSN 2002) — and drop-rate
+    # RAMPS (the coin streams read drop_tbl[r] as a traced operand)
+    # are the supported schedule; partitions stay rejected
+    NE.check_supported(fault, engine="swim", partitions=False)
     ch = NE.get(fault)
-    if ch is not None:
-        NE.validate_events(fault, n)
+    # traced per-round drop only when the schedule actually ramps: a
+    # static-p churn run keeps the exact PR 5 coin streams (bitwise
+    # pins in tests/data/churn_fingerprints_r06.json)
+    ramped = ch is not None and ch.ramp is not None
     if topo is None:
         topo = Topology(nbrs=None, deg=None, n=n, family="complete")
     slots = jnp.arange(s_count, dtype=jnp.int32)
     tables = () if topo.implicit else (topo.nbrs, topo.deg)
+    if ch is not None:
+        # schedule as runtime operands on the table tail (models/si.py
+        # twin; ops/nemesis module doc)
+        tables = tables + NE.sched_args(NE.build(fault, n))
 
     def step_tabled(state: SwimState, *tbl) -> SwimState:
+        tbl, sched = NE.split_tables(ch, tbl)
         nbrs, deg = tbl if tbl else (None, None)
         # O(N) buffers built in-trace (iota + small scatters), so the
         # compile request carries no big inline constants
@@ -479,13 +508,15 @@ def make_swim_round(proto: ProtocolConfig, n: int,
         alive_base = base_alive(n, dead_nodes, fault)
         rkey = jax.random.fold_in(state.base_key, state.round)
         alive_now = jnp.where(state.round >= fail_round, alive_base, True)
+        dp = drop_prob
         if ch is not None:
             # scripted crash/recover churn: down for die <= r < rec
             # (ops/nemesis) — a recovered subject refutes its own
             # suspicion (step 4) unless the timer already confirmed it
-            sched = NE.build(fault, n)
             alive_now = alive_now & ~((sched.die <= state.round)
                                       & (state.round < sched.rec))
+            if ramped:
+                dp = NE.drop_at(sched, state.round)
         subj_gids = subject_window(state.round, s_count, n, rotate,
                                    epoch_rounds)
         subj_alive = alive_now[subj_gids]
@@ -501,11 +532,11 @@ def make_swim_round(proto: ProtocolConfig, n: int,
         if proto.swim_rng == "packed":
             (subj, d_drop, proxy_ids, to_p, p_to_s,
              diss_targets) = packed_round_draws(
-                rkey, ids, s_count, n, proxies, fanout, drop_prob,
-                nbrs=nbrs, deg=deg, sentinel=n)
+                rkey, ids, s_count, n, proxies, fanout, dp,
+                nbrs=nbrs, deg=deg, sentinel=n, force=ramped)
         else:
             subj, d_drop, proxy_ids, to_p, p_to_s = probe_draws(
-                rkey, ids, s_count, n, proxies, drop_prob)
+                rkey, ids, s_count, n, proxies, dp, force=ramped)
             diss_targets = None
         direct_ok = subj_alive[subj] & ~d_drop
         proxy_ok = (alive_now[proxy_ids] & ~to_p & ~p_to_s

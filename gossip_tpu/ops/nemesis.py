@@ -28,17 +28,38 @@ The lowering
     (FaultConfig.drop_prob outside the ramp, linear inside, final
     value held after).
 
-``T = ChurnConfig.horizon()`` is the round after which the schedule is
-constant by construction (every window closed, ramp finished), so the
-clamped lookup ``tbl[min(r, T-1)]`` is EXACT for every round — the
+``T`` is :func:`canonical_horizon`: the round after which the schedule
+is constant by construction (every window closed, ramp finished —
+``ChurnConfig.horizon()``), rounded UP to a power-of-two bucket by
+repeating the final row.  The final row IS the steady state, so the
+clamped lookup ``tbl[min(r, T-1)]`` stays EXACT for every round — the
 tables are config-sized, not run-length-sized, and the same schedule
-serves a 6-round curve and a 10k-round flagship run.  Everything is
-built in-trace from scalars (:func:`build` is called inside the
-drivers' jitted loops — no O(N) inline constants in the compile
-request, the models/swim.py rule), and the arrays can equally ride a
-memoized loop as runtime OPERANDS (parallel/sharded_fused keys its
-lru_cache on ``churn: bool`` only — a churn sweep over schedules
-shares one compiled loop, the alive-mask runtime-operand trick).
+serves a 6-round curve and a 10k-round flagship run.
+
+Schedules are runtime OPERANDS (this PR), not in-trace constants: the
+round-step factories call :func:`build` ON THE HOST, append the four
+arrays to their ``tables`` tuple (:func:`sched_args`), and the step
+peels them back off (:func:`split_tables`) — so the schedule flows
+through every driver's existing ``step(state, *tables)`` plumbing as
+jit arguments, exactly like the topology tables.  Consequences, which
+are the whole point:
+
+  * the lowered HLO carries schedule SHAPES but no schedule CONTENT,
+    so two different ChurnConfigs with the same canonical bucket
+    produce byte-identical programs — the compile cache / AOT store
+    (utils/compile_cache) serves a whole nemesis sweep from one entry;
+  * driver-level loop memos (parallel/sharded._cached_dense_loop,
+    parallel/sweep._cached_churn_sweep_scan) key on the bucket, never
+    the content — K scenarios re-enter ONE compiled loop in-process
+    (the fused engine's ``_cached_churn_masks`` alive-word trick,
+    generalized to every XLA path);
+  * a STACK of K schedules (:func:`build_stack`) vmaps through the one
+    compiled loop as a ``[K, ...]`` operand — the scenario-batched
+    churn sweep (parallel/sweep.churn_sweep_curves).
+
+:func:`build` stays in-trace safe (small scatters + static sets), so
+closure-baking it remains CORRECT — just slow — and the bitwise pins
+in tests/data/churn_fingerprints_r06.json hold either way.
 
 Semantics (shared by every kernel — the heal-convergence tests pin
 them):
@@ -125,12 +146,39 @@ def _event_tables(ch: ChurnConfig, size: int):
     return die, rec
 
 
-def build(fault: FaultConfig, n: int, n_pad: Optional[int] = None
-          ) -> Schedule:
-    """Lower ``fault.churn`` to the device tables (in-trace safe: small
-    scatters + static-slice sets only).  ``n_pad`` sizes the die/rec
-    vectors for mesh-padded kernels; padding rows carry NEVER (their
-    deadness comes from the base alive mask, as always)."""
+# Minimum canonical [T] table length.  Bucketing trades a few padded
+# rows (repeats of the steady final row — exact under the clamped
+# lookup) for SHAPE-stable schedules: every horizon <= 32 shares one
+# bucket, so a whole scenario family compiles once (module doc).
+SCHED_T_MIN = 32
+
+# How many trailing step arguments a schedule occupies when it rides a
+# factory's ``tables`` tuple (sched_args / split_tables).
+N_SCHED_OPERANDS = 4
+
+
+def canonical_horizon(ch: ChurnConfig) -> int:
+    """The canonical table length T for a schedule: ``horizon()``
+    rounded up to a power-of-two bucket (>= SCHED_T_MIN).  Shape-only
+    memo keys and the HLO fingerprint see this bucket, never the
+    schedule content."""
+    t = ch.horizon()
+    return max(SCHED_T_MIN, 1 << (t - 1).bit_length())
+
+
+def build(fault: FaultConfig, n: int, n_pad: Optional[int] = None,
+          t_pad: Optional[int] = None) -> Schedule:
+    """Lower ``fault.churn`` to the device tables (host-side in the
+    factories — the operand contract, module doc — but in-trace safe:
+    small scatters + static-slice sets only).  ``n_pad`` sizes the
+    die/rec vectors for mesh-padded kernels; padding rows carry NEVER
+    (their deadness comes from the base alive mask, as always).
+
+    The [T] tables pad to :func:`canonical_horizon` (or an explicit
+    ``t_pad >= horizon()``, the build_stack alignment hook) by
+    REPEATING the final row — the steady state by construction, so the
+    clamped lookup is exact at every length and trajectories are
+    T-padding-invariant (pinned in tests/test_nemesis.py)."""
     ch = fault.churn
     if ch is None:
         raise ValueError("build() needs a FaultConfig with a churn "
@@ -149,9 +197,127 @@ def build(fault: FaultConfig, n: int, n_pad: Optional[int] = None
         for r in range(start, t):
             frac = min((r - start) / max(end - start, 1), 1.0)
             drop_np[r] = p0 + (p1 - p0) * frac
+    t_pad = canonical_horizon(ch) if t_pad is None else t_pad
+    if t_pad < t:
+        raise ValueError(f"t_pad={t_pad} below the schedule horizon {t}")
+    cut_np += [cut_np[-1]] * (t_pad - t)
+    drop_np += [drop_np[-1]] * (t_pad - t)
     return Schedule(die=die, rec=rec,
                     cut_tbl=jnp.asarray(cut_np, jnp.int32),
                     drop_tbl=jnp.asarray(drop_np, jnp.float32))
+
+
+def build_stack(faults, n: int, n_pad: Optional[int] = None) -> Schedule:
+    """K churn-carrying FaultConfigs -> ONE stacked Schedule with a
+    leading scenario axis (die/rec ``int32[K, n_pad]``, cut/drop
+    ``[K, T]``) — the operand of the scenario-batched churn sweep
+    (parallel/sweep.churn_sweep_curves): vmap maps the K axis through
+    one compiled loop.  All schedules align to the stack's largest
+    canonical bucket (exact: final-row padding is the steady state).
+
+    Only the SCHEDULES stack here; the static fault structure the step
+    bakes (death mask, scripted dead_nodes) must match across the
+    stack — the sweep driver enforces that, since it owns the step."""
+    faults = tuple(faults)
+    if not faults:
+        raise ValueError("build_stack needs at least one FaultConfig")
+    missing = [i for i, f in enumerate(faults) if get(f) is None]
+    if missing:
+        raise ValueError(
+            f"scenario stack entries {missing} carry no churn schedule; "
+            "a churn sweep batches fault PROGRAMS (static-only points "
+            "belong in the plain ensemble/config sweeps)")
+    t_pad = max(canonical_horizon(f.churn) for f in faults)
+    scheds = [build(f, n, n_pad, t_pad=t_pad) for f in faults]
+    return Schedule(
+        die=jnp.stack([s.die for s in scheds]),
+        rec=jnp.stack([s.rec for s in scheds]),
+        cut_tbl=jnp.stack([s.cut_tbl for s in scheds]),
+        drop_tbl=jnp.stack([s.drop_tbl for s in scheds]))
+
+
+def placeholder_trace_inputs(fault_static: FaultConfig, n: int,
+                             have_table: bool):
+    """(rep_fault, topo_placeholder) for the shape-only memoized loop
+    builders (parallel/sharded._cached_dense_loop, parallel/sweep
+    ._cached_churn_sweep_scan): a representative one-event schedule —
+    the step's trace reads only ``ch is not None`` and operand SHAPES
+    from it — and a topology whose trace-visible facts are exactly
+    (n, implicit-vs-table); table ROWS always arrive as runtime
+    arguments (the _cached_pod_sweep_scan placeholder pattern).  ONE
+    definition so the builders cannot drift on what the trace bakes."""
+    import dataclasses
+    from gossip_tpu.topology.generators import Topology
+    if fault_static.churn is not None:
+        raise ValueError("memo key must strip the schedule: pass "
+                         "dataclasses.replace(fault, churn=None)")
+    rep_fault = dataclasses.replace(
+        fault_static, churn=ChurnConfig(events=((0, 1, 2),)))
+    if have_table:
+        topo_ph = Topology(nbrs=jnp.zeros((0, 2), jnp.int32),
+                           deg=jnp.zeros((0,), jnp.int32), n=n,
+                           family="placeholder")
+    else:
+        topo_ph = Topology(nbrs=None, deg=None, n=n, family="complete")
+    return rep_fault, topo_ph
+
+
+def mixed_scenarios(k: int, n: int, *, salt: int = 0,
+                    drop_prob: float = 0.0, seed: int = 0,
+                    ramp_to: float = 0.15, window_end: int = 4):
+    """K mixed nemesis fault programs cycling the four shape classes —
+    crash/recover event, partition window, drop-rate ramp, and a
+    permanent-crash + window combination — the ONE scenario-family
+    generator shared by the dry-run ``churn_sweep`` family, bench.py's
+    families leg, and tools/churn_sweep_capture.py, so the three
+    surfaces exercise the same scenario shapes by construction.
+    ``salt`` varies the CONTENT (node ids, window lengths, ramp levels)
+    without changing any array shape: a salted family re-enters the
+    same compiled loop (the whole point of schedules-as-operands)."""
+    from gossip_tpu.config import ChurnConfig, FaultConfig
+    out = []
+    for i in range(k):
+        kind = i % 4
+        if kind == 0:
+            ch = ChurnConfig(events=(((3 + i + salt) % n, 1, 4),))
+        elif kind == 1:
+            ch = ChurnConfig(
+                partitions=((0, 2 + (i + salt) % 3, n // 2),))
+        elif kind == 2:
+            ch = ChurnConfig(ramp=(0, window_end, 0.0,
+                                   ramp_to * (1 + i % 3) / 3))
+        else:
+            ch = ChurnConfig(events=(((11 + i + salt) % n, 1, -1),),
+                             partitions=((1, window_end, n // 4),))
+        out.append(FaultConfig(drop_prob=drop_prob, seed=seed,
+                               churn=ch))
+    return out
+
+
+def sched_args(sched: Schedule) -> tuple:
+    """The schedule as a flat tail of step arguments — appended to a
+    factory's ``tables`` tuple so it rides every driver's existing
+    ``step(state, *tables)`` plumbing (and shard_map in_specs stay
+    plain per-array PartitionSpecs, all replicated)."""
+    return (sched.die, sched.rec, sched.cut_tbl, sched.drop_tbl)
+
+
+def sched_of_tables(tbl) -> Schedule:
+    """The Schedule riding a factory's table tail (:func:`sched_args`
+    layout) — for drivers that need the TRACED schedule besides the
+    step (the recorders' nemesis observables)."""
+    return Schedule(*tbl[-N_SCHED_OPERANDS:])
+
+
+def split_tables(ch: Optional[ChurnConfig], tbl: tuple):
+    """(topology_tables, Schedule-or-None): peel the schedule operands
+    :func:`sched_args` appended back off a step's ``*tables`` tail —
+    the ONE inverse, so factories and drivers cannot disagree on the
+    layout."""
+    if ch is None:
+        return tbl, None
+    return (tbl[:-N_SCHED_OPERANDS],
+            Schedule(*tbl[-N_SCHED_OPERANDS:]))
 
 
 def validate_events(fault: FaultConfig, n: int) -> None:
@@ -354,29 +520,48 @@ def check_supported(fault: Optional[FaultConfig], *, engine: str,
                     partitions: bool = True, ramp: bool = True,
                     events: bool = True) -> None:
     """Reject schedule features an engine cannot honor — loudly, never
-    silently (the no-silent-substitution policy).  The plane-sharded
-    fused engine has no per-pair messages to cut and bakes its drop
-    threshold into the kernel; SWIM probes ride the complete membership
-    overlay, which a link cut does not model; ``events=False`` marks an
-    engine with no churn support at all (checkpointed segment drivers,
-    the topo-sparse exchange)."""
+    silently (the no-silent-substitution policy).  Since the XLA paths
+    consume schedules as runtime operands, the remaining rejections are
+    the genuinely-impossible combinations:
+
+      * ``partitions=False`` — the plane-sharded fused engine has no
+        per-pair message table a node-id cut could destroy, and SWIM
+        probes ride the complete membership overlay, which a link cut
+        does not model;
+      * ``ramp=False`` — ONLY the fused Pallas kernels: their drop
+        coin is a hardware-PRNG threshold compare compiled into the
+        kernel body, not a traced probability (every XLA engine,
+        SWIM included, reads ``drop_tbl[r]`` as an operand);
+      * ``events=False`` — an engine with no churn support at all
+        (the checkpointed segment drivers, whose resume fingerprint
+        cannot carry a schedule yet; the topo-sparse exchange; the
+        grid config sweeps)."""
     ch = get(fault)
     if ch is None:
         return
-    if not events and ch.events:
+    if not events:
+        # no churn support at all: ANY schedule (a vacuous one already
+        # normalized to None) rejects with the one generic message —
+        # never the feature-specific ones below, whose reasons describe
+        # engines that DO run schedules
         raise ValueError(
             f"the {engine} engine does not run churn schedules; use "
             "the dense/sparse exchanges (docs/ROBUSTNESS.md scenario "
             "catalog)")
     if not partitions and ch.partitions:
         raise ValueError(
-            f"the {engine} engine cannot honor partition windows "
-            "(no per-pair messages to cut); run the dense/sparse/halo "
+            f"the {engine} engine cannot honor partition windows (no "
+            "per-pair messages a node-id cut could destroy — fused "
+            "planes have no message table; SWIM probes ride the "
+            "complete membership overlay); run the dense/sparse/halo "
             "exchanges for partition scenarios")
     if not ramp and ch.ramp is not None:
         raise ValueError(
-            f"the {engine} engine bakes its drop threshold into the "
-            "kernel and cannot honor a drop-rate ramp")
+            f"the {engine} engine draws its drop coins inside the "
+            "fused Pallas kernel against a threshold fixed at compile "
+            "time and cannot honor a drop-rate ramp; the XLA engines "
+            "consume the drop table as a runtime operand — use "
+            "engine='xla' or any dense/sparse/halo/SWIM driver")
 
 
 def observables(sched: Schedule, alive: jax.Array, round_):

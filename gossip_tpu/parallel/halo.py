@@ -120,16 +120,16 @@ def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
     drop_prob = 0.0 if fault is None else fault.drop_prob
     from gossip_tpu.ops import nemesis as NE
     ch = NE.get(fault)
-    if ch is not None:
-        NE.validate_events(fault, n)
 
-    def local_round(seen_l, round_, base_key, msgs, nbrs_l, deg_l):
+    def local_round(seen_l, round_, base_key, msgs, nbrs_l, deg_l,
+                    *sched_tail):
+        _, sched = NE.split_tables(ch, sched_tail)
         shard = jax.lax.axis_index(axis_name)
         gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
         rkey = jax.random.fold_in(base_key, round_)
         # liveness in-trace (replicated compute, no O(N) inline constant)
         if ch is not None:
-            sched = NE.build(fault, n)
+            # schedule operands from the argument tail (ops/nemesis doc)
             alive_full = NE.alive_rows(
                 sched, NE.base_alive_or_ones(fault, n, origin), round_)
             dp = NE.drop_at(sched, round_)
@@ -242,9 +242,14 @@ def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
     sh2 = P(axis_name, None)
     rep = P()
     out_specs = (sh2, rep, rep) if ch is not None else (sh2, rep)
+    in_specs = (sh2, rep, rep, rep, sh2, P(axis_name))
+    tables = (topo.nbrs, topo.deg)
+    if ch is not None:
+        in_specs += (rep,) * NE.N_SCHED_OPERANDS
+        tables = tables + NE.sched_args(NE.build(fault, n))
     mapped = shard_map(
         local_round, mesh=mesh,
-        in_specs=(sh2, rep, rep, rep, sh2, P(axis_name)),
+        in_specs=in_specs,
         out_specs=out_specs)
 
     def step_tabled(state: SimState, *tbl):
@@ -255,7 +260,7 @@ def make_halo_round(proto: ProtocolConfig, topo: Topology, mesh: Mesh,
         # churn path returns (state, lost) — the models/si.py contract
         return (new, out[2]) if ch is not None else new
 
-    return bind_tables(step_tabled, (topo.nbrs, topo.deg), tabled)
+    return bind_tables(step_tabled, tables, tabled)
 
 
 def simulate_until_halo(proto: ProtocolConfig, topo: Topology,

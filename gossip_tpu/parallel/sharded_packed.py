@@ -46,8 +46,6 @@ def make_sharded_packed_round(
     drop_prob = 0.0 if fault is None else fault.drop_prob
     from gossip_tpu.ops import nemesis as NE
     ch = NE.get(fault)
-    if ch is not None:
-        NE.validate_events(fault, n)
 
     have_table = not topo.implicit
     if have_table:
@@ -55,12 +53,13 @@ def make_sharded_packed_round(
         deg_pad = _pad_rows(topo.deg, n_pad, 0)
 
     def local_round(packed_l, round_, base_key, msgs, *table):
+        table, sched = NE.split_tables(ch, table)
         shard = jax.lax.axis_index(axis_name)
         gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
         rkey = jax.random.fold_in(base_key, round_)
         # liveness in-trace (replicated compute, no O(N) inline constant)
         if ch is not None:
-            sched = NE.build(fault, n, n_pad)
+            # schedule operands from the table tail (ops/nemesis doc)
             base_pad = _pad_rows(
                 NE.base_alive_or_ones(fault, n, origin), n_pad, False)
             alive_l = NE.alive_rows(sched, base_pad, round_)[gids]
@@ -133,6 +132,9 @@ def make_sharded_packed_round(
     if have_table:
         in_specs += [sh2, P(axis_name)]
         tables = (nbrs_pad, deg_pad)
+    if ch is not None:
+        in_specs += [rep] * NE.N_SCHED_OPERANDS
+        tables = tables + NE.sched_args(NE.build(fault, n, n_pad))
 
     out_specs = (sh2, rep, rep) if ch is not None else (sh2, rep)
     mapped = shard_map(local_round, mesh=mesh, in_specs=tuple(in_specs),
@@ -325,7 +327,9 @@ def simulate_until_packed_sharded(proto: ProtocolConfig, topo: Topology,
                 s, lost = step(s0, *tbl), None
             if m is not None:
                 m, cnt = rec(m, cnt, round0, msgs0, s, alive_t,
-                             nem=obs(round0, lost) if obs else None)
+                             nem=(obs(round0, lost,
+                                      NE.sched_of_tables(tbl))
+                                  if obs else None))
             return s, m, cnt
         return jax.lax.while_loop(cond, body, (state, m0, c0))
 

@@ -52,8 +52,6 @@ def make_sharded_rumor_round(proto: ProtocolConfig, topo: Topology,
     nl = n_pad // mesh.shape[axis_name]
     from gossip_tpu.ops import nemesis as NE
     ch = NE.get(fault)
-    if ch is not None:
-        NE.validate_events(fault, n)
 
     have_table = not topo.implicit
     if have_table:
@@ -61,11 +59,12 @@ def make_sharded_rumor_round(proto: ProtocolConfig, topo: Topology,
         deg_pad = _pad_rows(topo.deg, n_pad, 0)
 
     def local_round(seen_l, hot_l, cnt_l, round_, base_key, msgs, *table):
+        table, sched = NE.split_tables(ch, table)
         shard = jax.lax.axis_index(axis_name)
         gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
         rkey = jax.random.fold_in(base_key, round_)
         if ch is not None:
-            sched = NE.build(fault, n, n_pad)
+            # schedule operands from the table tail (ops/nemesis doc)
             base_pad = _pad_rows(
                 NE.base_alive_or_ones(fault, n, origin), n_pad, False)
             alive_l = NE.alive_rows(sched, base_pad, round_)[gids]
@@ -126,6 +125,9 @@ def make_sharded_rumor_round(proto: ProtocolConfig, topo: Topology,
     if have_table:
         in_specs += [sh2, P(axis_name)]
         tables = (nbrs_pad, deg_pad)
+    if ch is not None:
+        in_specs += [rep] * NE.N_SCHED_OPERANDS
+        tables = tables + NE.sched_args(NE.build(fault, n, n_pad))
 
     out_specs = ((sh2, sh2, sh2, rep, rep) if ch is not None
                  else (sh2, sh2, sh2, rep))
@@ -248,7 +250,9 @@ def simulate_curve_rumor_sharded(proto: ProtocolConfig, topo: Topology,
                 s, lost = step(s0, *tbl), None
             if m is not None:
                 m, prev = rec(m, prev, msgs0, s, alive,
-                              nem=obs(round0, lost) if obs else None)
+                              nem=(obs(round0, lost,
+                                       NE.sched_of_tables(tbl))
+                                   if obs else None))
             hot_any = jnp.any(s.hot, axis=1).astype(jnp.float32)
             hot_frac = jnp.sum(hot_any * w) / jnp.sum(w)
             return ((s, m, prev),
@@ -324,7 +328,9 @@ def simulate_until_rumor_sharded(proto: ProtocolConfig, topo: Topology,
                 s, lost = step(s0, *tbl), None
             if m is not None:
                 m, prev = rec(m, prev, msgs0, s, alive,
-                              nem=obs(round0, lost) if obs else None)
+                              nem=(obs(round0, lost,
+                                       NE.sched_of_tables(tbl))
+                                   if obs else None))
             return s, m, prev
 
         return jax.lax.while_loop(cond, body, (state, m0, p0))

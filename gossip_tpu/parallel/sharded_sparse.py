@@ -180,7 +180,7 @@ def _scatter_merge_digests(ok: jax.Array, recv: jax.Array,
 def make_sparse_pull_round(
         proto: ProtocolConfig, n: int, mesh: Mesh,
         fault: Optional[FaultConfig] = None, origin: int = 0,
-        axis_name: str = "nodes") -> Callable[[SimState], SimState]:
+        axis_name: str = "nodes", tabled: bool = False):
     """Sharded packed pull round with sparse all_to_all digest exchange.
 
     Implicit complete topology only (the 10M-node scale path — explicit
@@ -193,6 +193,13 @@ def make_sparse_pull_round(
     treatment as the fused kernel's phantom pulls (ops/pallas_round.py).
     Exact self-exclusion would make the within-shard row distribution
     non-uniform across shards; not worth the bias for a 1/n effect.
+
+    ``tabled=True`` returns ``(step, tables)`` where ``tables`` is the
+    schedule-operand tail (``NE.sched_args``; empty without churn) and
+    ``step(state, *tables)`` takes it as ARGUMENTS — the churn drivers
+    thread it through their jitted loops so the compiled program holds
+    no schedule content (ops/nemesis module doc).  The default closure
+    form stays for small callers (content closure-baked, still exact).
     """
     if proto.mode not in (C.PULL, C.ANTI_ENTROPY):
         raise ValueError("sparse exchange is a pull/anti-entropy path; "
@@ -207,17 +214,16 @@ def make_sparse_pull_round(
     alive_pad = sharded_alive(fault, n, n_pad, origin)
     from gossip_tpu.ops import nemesis as NE
     ch = NE.get(fault)
-    if ch is not None:
-        NE.validate_events(fault, n)
 
-    def local_round(seen_l, round_, base_key, msgs, alive_l):
+    def local_round(seen_l, round_, base_key, msgs, alive_l,
+                    *sched_tail):
+        _, sched = NE.split_tables(ch, sched_tail)
         shard = jax.lax.axis_index(axis_name)
         rkey = jax.random.fold_in(base_key, round_)
         row_gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
         if ch is not None:
-            # churn path: the operand stays the STATIC mask; the
-            # schedule's down-window subtracts per round (ops/nemesis)
-            sched = NE.build(fault, n, n_pad)
+            # churn path: the alive operand stays the STATIC mask; the
+            # schedule OPERANDS' down-window subtracts per round
             alive_l = alive_l & ~((sched.die[row_gids] <= round_)
                                   & (round_ < sched.rec[row_gids]))
             dp = NE.drop_at(sched, round_)
@@ -320,17 +326,28 @@ def make_sparse_pull_round(
 
     sh, sh2, rep = P(axis_name), P(axis_name, None), P()
     out_specs = (sh2, rep, rep) if ch is not None else (sh2, rep)
+    in_specs = (sh2, rep, rep, rep, sh)
+    tables = ()
+    if ch is not None:
+        in_specs += (rep,) * NE.N_SCHED_OPERANDS
+        tables = NE.sched_args(NE.build(fault, n, n_pad))
     mapped = shard_map(local_round, mesh=mesh,
-                           in_specs=(sh2, rep, rep, rep, sh),
+                           in_specs=in_specs,
                            out_specs=out_specs)
 
-    def step(state: SimState):
+    def step_tabled(state: SimState, *tbl):
         out = mapped(state.seen, state.round, state.base_key,
-                     state.msgs, alive_pad)
+                     state.msgs, alive_pad, *tbl)
         new = SimState(seen=out[0], round=state.round + 1,
                        base_key=state.base_key, msgs=out[1])
         # churn path returns (state, lost) — the models/si.py contract
         return (new, out[2]) if ch is not None else new
+
+    if tabled:
+        return step_tabled, tables
+
+    def step(state: SimState):
+        return step_tabled(state, *tables)
 
     return step
 
@@ -338,10 +355,11 @@ def make_sparse_pull_round(
 def sparse_pull_round_reference(
         proto: ProtocolConfig, n: int, p: int,
         fault: Optional[FaultConfig] = None,
-        origin: int = 0) -> Callable[[SimState], SimState]:
+        origin: int = 0, tabled: bool = False):
     """Single-device twin of :func:`make_sparse_pull_round` — identical
     trajectory for the same stratification parameter ``p`` (the parity
-    oracle; collectives only move data)."""
+    oracle; collectives only move data).  ``tabled=True`` returns the
+    ``(step, schedule-operand-tables)`` pair like the mesh kernel."""
     k = proto.fanout
     n_pad = math.ceil(n / p) * p
     nl = _validate(n_pad, p, k)
@@ -349,10 +367,11 @@ def sparse_pull_round_reference(
     alive_pad = sharded_alive(fault, n, n_pad, origin)
     from gossip_tpu.ops import nemesis as NE
     ch = NE.get(fault)
-    if ch is not None:
-        NE.validate_events(fault, n)
+    tables = (() if ch is None
+              else NE.sched_args(NE.build(fault, n, n_pad)))
 
-    def step(state: SimState):
+    def step_tabled(state: SimState, *tbl):
+        _, sched = NE.split_tables(ch, tbl)
         seen, round_ = state.seen, state.round
         rkey = jax.random.fold_in(state.base_key, round_)
         pi, o = _round_draws(rkey, p)
@@ -364,7 +383,6 @@ def sparse_pull_round_reference(
         rows = _slot_rows(rkey, slot_gids, nl)
         gids = partner_shard * nl + rows
         if ch is not None:
-            sched = NE.build(fault, n, n_pad)
             alive_now = NE.alive_rows(sched, alive_pad, round_)
             dp = NE.drop_at(sched, round_)
             cut = NE.cut_at(sched, round_)
@@ -414,6 +432,12 @@ def sparse_pull_round_reference(
                        base_key=state.base_key,
                        msgs=state.msgs + mfac * n_req)
         return (new, lost) if ch is not None else new
+
+    if tabled:
+        return step_tabled, tables
+
+    def step(state: SimState):
+        return step_tabled(state, *tables)
 
     return step
 
@@ -922,8 +946,9 @@ def simulate_curve_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
     from gossip_tpu.utils.trace import maybe_aot_timed
     from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.parallel.sharded import _churn_observables
-    step = make_sparse_pull_round(proto, n, mesh, fault, run.origin,
-                                  axis_name)
+    step, tables = make_sparse_pull_round(proto, n, mesh, fault,
+                                          run.origin, axis_name,
+                                          tabled=True)
     p = mesh.shape[axis_name]
     n_pad = pad_to_mesh(n, mesh, axis_name)
     init = init_sparse_state(run, proto, n, mesh, axis_name)
@@ -935,7 +960,7 @@ def simulate_curve_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
     obs = _churn_observables(fault, n, n_pad, run.origin)
 
     @jax.jit
-    def scan(state):
+    def scan(state, *tbl):
         alive_pad = (NE.eventual_alive_pad(fault, n, n_pad, run.origin)
                      if ch is not None
                      else sharded_alive(fault, n, n_pad, run.origin))
@@ -946,18 +971,21 @@ def simulate_curve_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
             s0, m, cnt = carry
             round0, msgs0 = s0.round, s0.msgs
             if ch is not None:
-                s, lost = step(s0)
+                s, lost = step(s0, *tbl)
             else:
-                s, lost = step(s0), None
+                s, lost = step(s0, *tbl), None
             if m is not None:
                 m, cnt = rec(m, cnt, round0, msgs0, s, alive_pad,
-                             nem=obs(round0, lost) if obs else None)
+                             nem=(obs(round0, lost,
+                                      NE.sched_of_tables(tbl))
+                                  if obs else None))
             return (s, m, cnt), (coverage_packed(s.seen, r, alive_pad),
                                  s.msgs)
         return jax.lax.scan(body, (state, m0, c0), None,
                             length=run.max_rounds)
 
-    (final, _, _), (covs, msgs) = maybe_aot_timed(scan, timing, init)
+    (final, _, _), (covs, msgs) = maybe_aot_timed(scan, timing, init,
+                                                  *tables)
     return np.asarray(covs), np.asarray(msgs), final, meta
 
 
@@ -973,8 +1001,9 @@ def simulate_until_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
     from gossip_tpu.utils.trace import maybe_aot_timed
     from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.parallel.sharded import _churn_observables
-    step = make_sparse_pull_round(proto, n, mesh, fault, run.origin,
-                                  axis_name)
+    step, tables = make_sparse_pull_round(proto, n, mesh, fault,
+                                          run.origin, axis_name,
+                                          tabled=True)
     p = mesh.shape[axis_name]
     n_pad = pad_to_mesh(n, mesh, axis_name)
     ch = NE.get(fault)
@@ -990,7 +1019,7 @@ def simulate_until_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
     obs = _churn_observables(fault, n, n_pad, run.origin)
 
     @jax.jit
-    def loop(state):
+    def loop(state, *tbl):
         # liveness in-trace: no O(N) closed-over constant (bind_tables
         # doc) — same hardening as simulate_until_topo_sparse
         alive_t = (NE.eventual_alive_pad(fault, n, n_pad, run.origin)
@@ -1007,16 +1036,18 @@ def simulate_until_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
             s0, m, cnt = carry
             round0, msgs0 = s0.round, s0.msgs
             if ch is not None:
-                s, lost = step(s0)
+                s, lost = step(s0, *tbl)
             else:
-                s, lost = step(s0), None
+                s, lost = step(s0, *tbl), None
             if m is not None:
                 m, cnt = rec(m, cnt, round0, msgs0, s, alive_t,
-                             nem=obs(round0, lost) if obs else None)
+                             nem=(obs(round0, lost,
+                                      NE.sched_of_tables(tbl))
+                                  if obs else None))
             return s, m, cnt
         return jax.lax.while_loop(cond, body, (state, m0, c0))
 
-    final, _, _ = maybe_aot_timed(loop, timing, init)
+    final, _, _ = maybe_aot_timed(loop, timing, init, *tables)
     return (int(final.round),
             float(coverage_packed(final.seen, r, alive_pad)),
             float(final.msgs), final, meta)

@@ -57,10 +57,11 @@ def make_sharded_swim_round(
     epoch_rounds = SW.resolve_epoch_rounds(proto, n)
     drop_prob = 0.0 if fault is None else fault.drop_prob
     from gossip_tpu.ops import nemesis as NE
-    NE.check_supported(fault, engine="swim", partitions=False, ramp=False)
+    # events + drop-rate ramps supported (the schedule rides as traced
+    # operands — models/swim.py twin); partitions stay rejected
+    NE.check_supported(fault, engine="swim", partitions=False)
     ch = NE.get(fault)
-    if ch is not None:
-        NE.validate_events(fault, n)
+    ramped = ch is not None and ch.ramp is not None
     n_pad = pad_to_mesh(n, mesh, axis_name)
     nl = n_pad // mesh.shape[axis_name]
     if topo is None:
@@ -71,6 +72,7 @@ def make_sharded_swim_round(
         deg_pad = _pad_rows(topo.deg, n_pad, 0)
 
     def local_round(wire_l, timer_l, round_, base_key, msgs, *table):
+        table, sched = NE.split_tables(ch, table)
         shard = jax.lax.axis_index(axis_name)
         gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
         rkey = jax.random.fold_in(base_key, round_)
@@ -81,11 +83,14 @@ def make_sharded_swim_round(
                                     n_pad, False)
         alive_full = jnp.where(round_ >= fail_round, alive_base_full,
                                True) & valid
+        dp = drop_prob
         if ch is not None:
-            # scripted crash/recover churn (models/swim.py twin)
-            sched = NE.build(fault, n, n_pad)
+            # scripted crash/recover churn from the schedule OPERANDS
+            # (models/swim.py twin; ops/nemesis module doc)
             alive_full = alive_full & ~((sched.die <= round_)
                                         & (round_ < sched.rec))
+            if ramped:
+                dp = NE.drop_at(sched, round_)
         alive_l = alive_full[gids]
         subj_gids = SW.subject_window(round_, s_count, n, rotate,
                                       epoch_rounds)
@@ -101,11 +106,11 @@ def make_sharded_swim_round(
         if proto.swim_rng == "packed":
             (subj, d_drop, proxy_ids, to_p, p_to_s,
              diss_targets) = SW.packed_round_draws(
-                rkey, gids, s_count, n, proxies, fanout, drop_prob,
-                nbrs=nbrs_l, deg=deg_l, sentinel=n)
+                rkey, gids, s_count, n, proxies, fanout, dp,
+                nbrs=nbrs_l, deg=deg_l, sentinel=n, force=ramped)
         else:
             subj, d_drop, proxy_ids, to_p, p_to_s = SW.probe_draws(
-                rkey, gids, s_count, n, proxies, drop_prob)
+                rkey, gids, s_count, n, proxies, dp, force=ramped)
             diss_targets = None
         direct_ok = subj_alive[subj] & ~d_drop
         proxy_ok = (alive_full[proxy_ids] & ~to_p & ~p_to_s
@@ -159,12 +164,15 @@ def make_sharded_swim_round(
     sh2 = P(axis_name, None)
     rep = P()
     in_specs = [sh2, sh2, rep, rep, rep]
+    tables = (nbrs_pad, deg_pad) if have_table else ()
     if have_table:
         in_specs += [sh2, P(axis_name)]
+    if ch is not None:
+        in_specs += [rep] * NE.N_SCHED_OPERANDS
+        tables = tables + NE.sched_args(NE.build(fault, n, n_pad))
 
     mapped = shard_map(local_round, mesh=mesh, in_specs=tuple(in_specs),
                            out_specs=(sh2, sh2, rep))
-    tables = (nbrs_pad, deg_pad) if have_table else ()
 
     def step_tabled(state: SwimState, *tbl) -> SwimState:
         wire, timer, msgs = mapped(state.wire, state.timer, state.round,

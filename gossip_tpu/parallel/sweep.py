@@ -128,7 +128,9 @@ def ensemble_curves(proto: ProtocolConfig, topo: Topology, run: RunConfig,
                     axis_name: str = "seed") -> EnsembleResult:
     """Run |seeds| independent trajectories as ONE batched XLA program.
     ``mesh``: a 1-D device mesh shards the SEED axis (value-invariant,
-    embarrassingly parallel — _shard_ensemble)."""
+    embarrassingly parallel — _shard_ensemble).  The SCENARIO-batched
+    twin — one seed, K nemesis schedules vmapped through one compiled
+    loop — is :func:`churn_sweep_curves`."""
     # tables as jit ARGUMENTS + liveness in-trace: no O(N) closure
     # constants in the compile request (models/swim.py doc)
     step, tables = make_si_round(proto, topo, fault, run.origin, tabled=True)
@@ -162,6 +164,175 @@ def ensemble_curves(proto: ProtocolConfig, topo: Topology, run: RunConfig,
                           rounds_to_target=_rounds_to_target(
                               curves, run.target_coverage),
                           target=run.target_coverage)
+
+
+@dataclasses.dataclass
+class ChurnSweepResult:
+    """K nemesis scenarios through ONE compiled loop
+    (:func:`churn_sweep_curves`).  ``curves``/``msgs`` are per-scenario
+    per-round; ``dropped`` is the kernels' EXACT per-round destroyed-
+    message count (drop coins + open cut) — the per-scenario nemesis
+    observable the ledger records."""
+    faults: tuple                 # the FaultConfigs, batch order
+    curves: np.ndarray            # float32[K, T]
+    msgs: np.ndarray              # float32[K, T]
+    dropped: np.ndarray           # float32[K, T]
+    rounds_to_target: np.ndarray  # int[K], -1 where never reached
+    target: float
+
+    def summaries(self):
+        out = []
+        for i, f in enumerate(self.faults):
+            ch = f.churn
+            out.append({
+                "scenario": {"events": list(map(list, ch.events)),
+                             "partitions": list(map(list,
+                                                    ch.partitions)),
+                             "ramp": (list(ch.ramp)
+                                      if ch.ramp else None),
+                             "drop_prob": f.drop_prob},
+                "rounds_to_target": int(self.rounds_to_target[i]),
+                "converged": bool(self.rounds_to_target[i] >= 0),
+                "final_coverage": float(self.curves[i, -1]),
+                "msgs_total": float(self.msgs[i, -1]),
+                "dropped_total": float(self.dropped[i].sum()),
+            })
+        return out
+
+
+@functools.lru_cache(maxsize=16)
+def _cached_churn_sweep_scan(proto: ProtocolConfig, n: int,
+                             have_table: bool,
+                             fault_static: FaultConfig, origin: int,
+                             max_rounds: int):
+    """The scenario-batched churn sweep's compiled scan, memoized by
+    EXACTLY the statics its trace bakes — schedule CONTENT is a runtime
+    operand (ops/nemesis module doc), so every K-scenario family with
+    the same static structure re-enters ONE compiled program, and even
+    a DIFFERENT scenario stack of the same shapes is an in-process
+    executable-cache hit (the _cached_pod_sweep_scan memo discipline).
+
+    The returned callable takes ``(states, alive_stack, *tables)``:
+    K-stacked SimState, the per-scenario EVENTUAL-alive coverage
+    denominators ``bool[K, n]`` (a function of which churn deaths are
+    permanent — content, so an operand), the (unstacked) topology
+    tables, and the four stacked schedule operands of
+    ``nemesis.build_stack``.  vmap maps the scenario axis through the
+    one step; per-scenario trajectories are BITWISE the solo runs
+    (same keys — pinned in tests/test_nemesis.py)."""
+    rep_fault, topo_ph = NE.placeholder_trace_inputs(fault_static, n,
+                                                     have_table)
+    step, _ = make_si_round(proto, topo_ph, rep_fault, origin,
+                            tabled=True)
+    n_topo = 0 if topo_ph.implicit else 2
+
+    def one(st, die, rec_, cut, drop, topo_tbl):
+        return step(st, *topo_tbl, die, rec_, cut, drop)
+
+    @jax.jit
+    def scan(states, alive_stack, *tbl):
+        topo_tbl, sched_tail = tbl[:n_topo], tbl[n_topo:]
+
+        def body(sts, _):
+            sts, lost = jax.vmap(
+                lambda st, d, r, c, p: one(st, d, r, c, p, topo_tbl)
+            )(sts, *sched_tail)
+            # the coverage READOUT leaves the device as an EXACT
+            # integer: min-over-rumors alive-entry count per scenario
+            # (integer sums are order-exact in any lowering, unlike the
+            # final division, which XLA fuses to a recip-mul in some
+            # contexts and true division in others — a 1-ulp lottery).
+            # The driver divides ONCE on the host in float32, which is
+            # IEEE true division — bitwise the solo coverage() path.
+            cnt = jax.vmap(
+                lambda x, al: jnp.min(jnp.sum(
+                    x & al[:, None], axis=0, dtype=jnp.int32)))(
+                sts.seen, alive_stack)
+            return sts, (cnt, sts.msgs, lost)
+        return jax.lax.scan(body, states, None, length=max_rounds)
+    return scan
+
+
+def churn_sweep_curves(proto: ProtocolConfig, topo: Topology,
+                       run: RunConfig, faults, mesh=None,
+                       axis_name: str = "scenario",
+                       timing=None) -> ChurnSweepResult:
+    """Run K nemesis SCENARIOS — distinct churn/partition/ramp fault
+    programs over one protocol config — as ONE batched XLA program:
+    the schedule stack (ops/nemesis.build_stack) vmaps through the one
+    compiled round loop as a ``[K, ...]`` runtime operand, so the whole
+    scenario family costs one compile (and re-entering with a NEW
+    family of the same shapes costs none: _cached_churn_sweep_scan).
+    This is the Maelstrom move — one binary, every nemesis — for the
+    batched simulator.
+
+    Every fault must carry a churn schedule; the STATIC fault structure
+    (death mask draw, scripted dead_nodes) must match across the stack
+    because the step bakes it — ``drop_prob`` may vary freely (it only
+    feeds the per-scenario drop table).  Scenario k's curve equals the
+    solo ``simulate_curve(..., fault=faults[k])`` run BITWISE (same
+    threefry keys; coverage over the scenario's own eventual-alive
+    denominator).
+
+    ``mesh``: a 1-D device mesh shards the SCENARIO axis (value-
+    invariant, embarrassingly parallel — _shard_ensemble).  ``timing``:
+    optional compile/steady AOT-split dict (utils/trace contract).
+    Returns :class:`ChurnSweepResult` (curves / msgs / exact per-round
+    ``dropped`` per scenario)."""
+    faults = tuple(faults)
+    if not faults:
+        raise ValueError("need at least one churn FaultConfig")
+    statics = {dataclasses.replace(f, churn=None, drop_prob=0.0)
+               for f in faults}
+    if len(statics) > 1:
+        raise ValueError(
+            "churn sweep scenarios must share the STATIC fault "
+            "structure (node_death_rate/seed/dead_nodes are baked into "
+            "the one compiled step); vary the churn schedule and "
+            "drop_prob only")
+    stack = NE.build_stack(faults, topo.n)       # validates churn too
+    k = len(faults)
+    # drop_prob is stripped from the memo key like the schedule: it
+    # only feeds the per-scenario drop_tbl operand, never the trace
+    scan = _cached_churn_sweep_scan(
+        proto, topo.n, not topo.implicit,
+        dataclasses.replace(faults[0], churn=None, drop_prob=0.0),
+        run.origin, run.max_rounds)
+    alive_stack = jnp.stack(
+        [NE.eventual_alive(f, topo.n, run.origin) for f in faults])
+    base = init_state(run, proto, topo.n)
+    keys = jax.vmap(jax.random.key)(
+        jnp.full((k,), run.seed, jnp.uint32))
+    init = SimState(
+        seen=jnp.broadcast_to(base.seen, (k,) + base.seen.shape),
+        round=jnp.zeros((k,), jnp.int32),
+        base_key=keys,
+        msgs=jnp.zeros((k,), jnp.float32),
+    )
+    init = _shard_ensemble(init, mesh, axis_name, k)
+    sched_ops = NE.sched_args(stack)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        put = lambda x: jax.device_put(                   # noqa: E731
+            x, NamedSharding(mesh, P(axis_name,
+                                     *([None] * (x.ndim - 1)))))
+        alive_stack = put(alive_stack)
+        sched_ops = tuple(put(x) for x in sched_ops)
+    topo_tbl = () if topo.implicit else (topo.nbrs, topo.deg)
+    from gossip_tpu.utils.trace import maybe_aot_timed
+    _, (cnts, msgs, lost) = maybe_aot_timed(
+        scan, timing, init, alive_stack, *topo_tbl, *sched_ops)
+    # one true f32 division per cell (the scan emits exact integer
+    # counts — see _cached_churn_sweep_scan's readout comment)
+    denom = np.asarray(alive_stack.sum(axis=1)).astype(np.float32)
+    curves = (np.asarray(cnts).T.astype(np.float32)
+              / np.maximum(denom, 1.0)[:, None])
+    return ChurnSweepResult(faults=faults, curves=curves,
+                            msgs=np.asarray(msgs).T,
+                            dropped=np.asarray(lost).T,
+                            rounds_to_target=_rounds_to_target(
+                                curves, run.target_coverage),
+                            target=run.target_coverage)
 
 
 @functools.lru_cache(maxsize=16)

@@ -298,17 +298,27 @@ class JitCompileMonitor:
     dry run can ledger a ``compile`` event per family with the same
     ``cache: hit|miss|disabled`` vocabulary as the chokepoint.
 
+    Since the traced-operand PR the monitor also counts REAL backend
+    compiles (``backend_compiles``: jax's per-compile
+    ``/jax/core/compile/backend_compile_duration`` event, which fires
+    whether or not a persistent cache is configured) — the delta probe
+    behind the ``assert_compiles`` test fixture (tests/conftest.py):
+    "K nemesis scenarios, ONE compile" is an assertion on this counter.
+
     Listener registration is process-global and permanent (jax offers
     no unregister on this line) — instantiate once per process, as the
     dry-run body does."""
 
     HIT = "/jax/compilation_cache/cache_hits"
     MISS = "/jax/compilation_cache/cache_misses"
+    BACKEND = "/jax/core/compile/backend_compile_duration"
 
     def __init__(self):
         self.hits = 0
         self.misses = 0
+        self.backend_compiles = 0
         self.available = False
+        self.durations_available = False
         try:
             from jax import monitoring
             monitoring.register_event_listener(self._on_event)
@@ -317,12 +327,23 @@ class JitCompileMonitor:
             sys.stderr.write("compile_cache: jax.monitoring unavailable "
                              f"({type(e).__name__}: {e}); plain-jit "
                              "cache accounting disabled\n")
+        try:
+            from jax import monitoring
+            monitoring.register_event_duration_secs_listener(
+                self._on_duration)
+            self.durations_available = True
+        except Exception:
+            pass        # older jax: backend-compile counting degrades
 
     def _on_event(self, name, **kw):
         if name == self.HIT:
             self.hits += 1
         elif name == self.MISS:
             self.misses += 1
+
+    def _on_duration(self, name, dur, **kw):
+        if name == self.BACKEND:
+            self.backend_compiles += 1
 
     def snapshot(self) -> Tuple[int, int]:
         return self.hits, self.misses

@@ -1,0 +1,283 @@
+"""The churn-trajectory fingerprint surfaces — shared by the golden
+capture (run against the PR 5 baked-schedule code) and the tier-1 pin
+test (run against the traced-operand code).
+
+Promoting the nemesis schedule tables from in-trace constants to
+runtime operands must be a pure re-plumbing: every converted surface's
+trajectory — dense single + sharded, packed, sparse (mesh + reference
+twin), rumor, halo, SWIM — must stay BITWISE what the baked lowering
+produced.  Each surface below runs a small fixed config and digests
+its outputs (sha256 over the raw array bytes) so the whole matrix pins
+in one JSON file, tests/data/churn_fingerprints_r06.json, captured
+once from the pre-refactor tree.  A no-churn twin per family rides
+along: the static hot path must not move either.
+
+Configs are tiny (n=64, <= 12 rounds) and the digests depend only on
+the threefry streams + kernel arithmetic, which are platform-stable on
+the CPU tier the fingerprints were captured on.
+"""
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "data", "churn_fingerprints_r06.json")
+
+_N = 64
+_ROUNDS = 10
+
+
+def _digest(*arrays) -> str:
+    h = hashlib.sha256()
+    for a in arrays:
+        a = np.asarray(a)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+def _churn_fault():
+    from gossip_tpu.config import ChurnConfig, FaultConfig
+    return FaultConfig(node_death_rate=0.1, drop_prob=0.05, seed=1,
+                       churn=ChurnConfig(
+                           events=((3, 2, 5), (7, 1, -1)),
+                           partitions=((2, 6, 32),),
+                           ramp=(1, 4, 0.0, 0.3)))
+
+
+def _swim_fault():
+    # SWIM supported events only at capture time (PR 5): the golden
+    # timeline is events + static drop, no ramp/partitions
+    from gossip_tpu.config import ChurnConfig, FaultConfig
+    return FaultConfig(drop_prob=0.05, seed=1, churn=ChurnConfig(
+        events=((5, 2, -1), (3, 4, 6))))
+
+
+def _static_fault():
+    from gossip_tpu.config import FaultConfig
+    return FaultConfig(node_death_rate=0.1, drop_prob=0.05, seed=1)
+
+
+def _mesh(k=4):
+    from gossip_tpu.parallel.sharded import make_mesh
+    return make_mesh(k)
+
+
+def _run(max_rounds=_ROUNDS):
+    from gossip_tpu.config import RunConfig
+    return RunConfig(seed=0, max_rounds=max_rounds)
+
+
+def _dense_single(fault):
+    from gossip_tpu import config as C
+    from gossip_tpu.config import ProtocolConfig
+    from gossip_tpu.runtime.simulator import simulate_curve
+    from gossip_tpu.topology import generators as G
+    proto = ProtocolConfig(mode=C.PUSH_PULL, fanout=2, rumors=2)
+    res = simulate_curve(proto, G.complete(_N), _run(), fault)
+    return _digest(res.coverage, res.msgs, res.state.seen)
+
+
+def _dense_flood_single(fault):
+    from gossip_tpu import config as C
+    from gossip_tpu.config import ProtocolConfig
+    from gossip_tpu.runtime.simulator import simulate_curve
+    from gossip_tpu.topology import generators as G
+    proto = ProtocolConfig(mode=C.FLOOD, fanout=1, rumors=2)
+    res = simulate_curve(proto, G.ring(_N, k=4), _run(), fault)
+    return _digest(res.coverage, res.msgs, res.state.seen)
+
+
+def _dense_sharded(fault):
+    from gossip_tpu import config as C
+    from gossip_tpu.config import ProtocolConfig
+    from gossip_tpu.parallel.sharded import simulate_curve_sharded
+    from gossip_tpu.topology import generators as G
+    proto = ProtocolConfig(mode=C.PUSH_PULL, fanout=2, rumors=2)
+    covs, msgs, fin = simulate_curve_sharded(proto, G.complete(_N),
+                                             _run(), _mesh(), fault)
+    return _digest(covs, msgs, fin.seen)
+
+
+def _packed_single(fault):
+    import jax
+    from gossip_tpu import config as C
+    from gossip_tpu.config import ProtocolConfig
+    from gossip_tpu.models.si_packed import (init_packed_state,
+                                             make_packed_round)
+    from gossip_tpu.ops import nemesis as NE
+    from gossip_tpu.topology import generators as G
+    proto = ProtocolConfig(mode=C.ANTI_ENTROPY, fanout=2, rumors=3,
+                           period=2)
+    step = jax.jit(NE.drop_lost(
+        make_packed_round(proto, G.complete(_N), fault, 0),
+        NE.get(fault)))
+    s = init_packed_state(_run(), proto, _N)
+    for _ in range(6):
+        s = step(s)
+    return _digest(s.seen, np.float32(float(s.msgs)))
+
+
+def _packed_sharded(fault):
+    from gossip_tpu import config as C
+    from gossip_tpu.config import ProtocolConfig
+    from gossip_tpu.parallel.sharded_packed import (
+        simulate_until_packed_sharded)
+    from gossip_tpu.topology import generators as G
+    proto = ProtocolConfig(mode=C.PULL, fanout=1, rumors=3)
+    rounds, cov, msgs, fin = simulate_until_packed_sharded(
+        proto, G.complete(_N), _run(), _mesh(), fault)
+    return _digest(fin.seen, np.int32(rounds), np.float32(cov),
+                   np.float32(msgs))
+
+
+def _sparse_mesh(fault):
+    import jax
+    from gossip_tpu import config as C
+    from gossip_tpu.config import ProtocolConfig
+    from gossip_tpu.parallel.sharded_sparse import (
+        init_sparse_state, make_sparse_pull_round)
+    proto = ProtocolConfig(mode=C.ANTI_ENTROPY, fanout=2, rumors=3,
+                           period=2)
+    step = jax.jit(make_sparse_pull_round(proto, _N, _mesh(), fault, 0))
+    s = init_sparse_state(_run(), proto, _N, _mesh())
+    lost = []
+    for _ in range(4):
+        out = step(s)
+        s, lo = out if type(out) is tuple else (out, 0.0)
+        lost.append(float(lo))
+    return _digest(s.seen, np.asarray(lost, np.float32),
+                   np.float32(float(s.msgs)))
+
+
+def _sparse_reference(fault):
+    import jax
+    from gossip_tpu import config as C
+    from gossip_tpu.config import ProtocolConfig
+    from gossip_tpu.parallel.sharded_sparse import (
+        init_sparse_state, sparse_pull_round_reference)
+    proto = ProtocolConfig(mode=C.ANTI_ENTROPY, fanout=2, rumors=3,
+                           period=2)
+    step = jax.jit(sparse_pull_round_reference(proto, _N, 4, fault, 0))
+    s = init_sparse_state(_run(), proto, _N, p=4)
+    lost = []
+    for _ in range(4):
+        out = step(s)
+        s, lo = out if type(out) is tuple else (out, 0.0)
+        lost.append(float(lo))
+    return _digest(s.seen, np.asarray(lost, np.float32),
+                   np.float32(float(s.msgs)))
+
+
+def _rumor_single(fault):
+    from gossip_tpu import config as C
+    from gossip_tpu.config import ProtocolConfig
+    from gossip_tpu.models.rumor import simulate_curve_rumor
+    from gossip_tpu.topology import generators as G
+    proto = ProtocolConfig(mode=C.RUMOR, fanout=2, rumor_k=2, rumors=2)
+    covs, hots, msgs, fin = simulate_curve_rumor(
+        proto, G.complete(_N), _run(), fault)
+    return _digest(covs, hots, msgs, fin.seen, fin.hot, fin.cnt)
+
+
+def _rumor_sharded(fault):
+    from gossip_tpu import config as C
+    from gossip_tpu.config import ProtocolConfig
+    from gossip_tpu.parallel.sharded_rumor import (
+        simulate_curve_rumor_sharded)
+    from gossip_tpu.topology import generators as G
+    proto = ProtocolConfig(mode=C.RUMOR, fanout=2, rumor_k=2, rumors=2)
+    covs, hots, msgs, fin = simulate_curve_rumor_sharded(
+        proto, G.complete(_N), _run(), _mesh(), fault)
+    return _digest(covs, hots, msgs, fin.seen, fin.hot, fin.cnt)
+
+
+def _halo_sharded(fault):
+    from gossip_tpu import config as C
+    from gossip_tpu.config import ProtocolConfig
+    from gossip_tpu.parallel.halo import simulate_curve_halo
+    from gossip_tpu.topology import generators as G
+    proto = ProtocolConfig(mode=C.PUSH_PULL, fanout=2, rumors=2)
+    covs, msgs, fin, band = simulate_curve_halo(
+        proto, G.ring(_N, k=4), _run(), _mesh(), fault)
+    return _digest(covs, msgs, fin.seen, np.int32(band))
+
+
+def _swim_single(fault):
+    from gossip_tpu import config as C
+    from gossip_tpu.config import ProtocolConfig
+    from gossip_tpu.runtime.simulator import simulate_swim_curve
+    proto = ProtocolConfig(mode=C.SWIM, fanout=2, swim_subjects=8,
+                           swim_proxies=2, swim_suspect_rounds=4)
+    fr, fin = simulate_swim_curve(proto, _N, 12, dead_nodes=(),
+                                  fail_round=0, fault=fault)
+    return _digest(fr, fin.wire, fin.timer, np.float32(float(fin.msgs)))
+
+
+def _swim_sharded(fault):
+    from gossip_tpu import config as C
+    from gossip_tpu.config import ProtocolConfig
+    from gossip_tpu.runtime.simulator import simulate_swim_curve
+    proto = ProtocolConfig(mode=C.SWIM, fanout=2, swim_subjects=8,
+                           swim_proxies=2, swim_suspect_rounds=4)
+    fr, fin = simulate_swim_curve(proto, _N, 12, dead_nodes=(),
+                                  fail_round=0, fault=fault,
+                                  mesh=_mesh())
+    return _digest(fr, fin.wire, fin.timer, np.float32(float(fin.msgs)))
+
+
+# name -> (runner, fault builder).  SWIM takes its events-only schedule
+# (ramps were rejected at capture time); every other churn surface runs
+# the full events + partition + ramp program.
+SURFACES = {
+    "dense_single": (_dense_single, _churn_fault),
+    "dense_flood_single": (_dense_flood_single, _churn_fault),
+    "dense_sharded": (_dense_sharded, _churn_fault),
+    "packed_single": (_packed_single, _churn_fault),
+    "packed_sharded": (_packed_sharded, _churn_fault),
+    "sparse_mesh": (_sparse_mesh, _churn_fault),
+    "sparse_reference": (_sparse_reference, _churn_fault),
+    "rumor_single": (_rumor_single, _churn_fault),
+    "rumor_sharded": (_rumor_sharded, _churn_fault),
+    "halo_sharded": (_halo_sharded, _churn_fault),
+    "swim_single": (_swim_single, _swim_fault),
+    "swim_sharded": (_swim_sharded, _swim_fault),
+}
+
+# the static-fault (no churn) twins: the untouched hot path, re-pinned
+NO_CHURN = {
+    "dense_single", "dense_sharded", "packed_single", "packed_sharded",
+    "sparse_mesh", "sparse_reference", "rumor_single", "rumor_sharded",
+    "halo_sharded", "swim_single", "swim_sharded",
+}
+
+
+def compute_all() -> dict:
+    out = {}
+    for name, (runner, fault_of) in SURFACES.items():
+        out[f"churn:{name}"] = runner(fault_of())
+    for name in sorted(NO_CHURN):
+        runner, _ = SURFACES[name]
+        out[f"static:{name}"] = runner(_static_fault())
+    return out
+
+
+def main():
+    os.makedirs(os.path.dirname(DATA), exist_ok=True)
+    digests = compute_all()
+    with open(DATA, "w") as f:
+        json.dump({"note": "captured from the PR 5 baked-schedule tree; "
+                           "the traced-operand lowering must reproduce "
+                           "every digest bitwise",
+                   "n": _N, "digests": digests}, f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+    print(f"wrote {len(digests)} fingerprints to {DATA}")
+
+
+if __name__ == "__main__":
+    main()

@@ -199,6 +199,51 @@ def _load_graft_entry():
     return mod
 
 
+# ---------------------------------------------------------------------
+# Compile-count delta probe (the traced-operand PR): PR 3's
+# JitCompileMonitor, wrapped as a reusable context so tests can pin
+# "K scenarios, ONE compile" without copy-pasting monitoring plumbing.
+
+@pytest.fixture(scope="session")
+def _compile_monitor():
+    # one per process: jax's listener registration is permanent
+    from gossip_tpu.utils.compile_cache import JitCompileMonitor
+    return JitCompileMonitor()
+
+
+@pytest.fixture
+def assert_compiles(_compile_monitor):
+    """``with assert_compiles(n):`` — assert the block triggered exactly
+    ``n`` REAL XLA backend compiles (jax.monitoring's per-compile
+    duration event; in-memory executable reuse triggers none).  Pass
+    ``at_most=True`` for an upper bound — the right form for "the
+    first call may compile auxiliaries, later calls must compile
+    NOTHING" pins.  Skips when this jax cannot report backend-compile
+    events (the monitor's degrade path)."""
+    import contextlib
+
+    mon = _compile_monitor
+    if not mon.durations_available:
+        pytest.skip("jax.monitoring has no duration listener on this "
+                    "toolchain; compile-count pins unavailable")
+
+    @contextlib.contextmanager
+    def _ctx(expected: int, at_most: bool = False):
+        before = mon.backend_compiles
+        yield
+        got = mon.backend_compiles - before
+        if at_most:
+            assert got <= expected, (
+                f"block compiled {got} XLA programs, expected at most "
+                f"{expected} — a memoized loop lost its cache hit "
+                "(schedule content leaked back into a trace?)")
+        else:
+            assert got == expected, (
+                f"block compiled {got} XLA programs, expected exactly "
+                f"{expected}")
+    return _ctx
+
+
 @pytest.fixture(scope="session")
 def dryrun_pair(tmp_path_factory):
     """(cold, warm) 4-device dry runs sharing ONE fresh compile-cache

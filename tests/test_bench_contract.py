@@ -91,6 +91,25 @@ def test_bench_compile_split_measures_store_roundtrip():
     assert float(compiled(jnp.arange(256, dtype=jnp.float32))[-1]) > 0
 
 
+def test_line_carries_churn_families():
+    """Traced-operand PR: the nemesis families (churn_heal +
+    churn_sweep with its first/warm amortization split) ride the
+    scoreboard line as an optional ``families`` object and survive the
+    JSON trip; absent when the body did not measure them (old
+    artifacts replay)."""
+    fam = {"churn_heal": {"n": 100_000, "rounds": 23,
+                          "wall_ms": 4200.0,
+                          "node_rounds_per_sec": 5.4e5},
+           "churn_sweep": {"k": 8, "n": 8192, "first_ms": 3000.0,
+                           "warm_ms": 500.0, "amortization": 6.0,
+                           "converged": 8}}
+    line = bench.measurement_line(1.0, "cpu", 10, "x", 1, 1.0,
+                                  families=fam)
+    assert json.loads(json.dumps(line))["families"] == fam
+    assert "families" not in bench.measurement_line(
+        1.0, "cpu", 10, "x", 1, 1.0)
+
+
 def test_fallback_carries_last_tpu_pointer():
     """VERDICT r4 task 2: a wedged-tunnel fallback line must point at
     the newest COMMITTED TPU capture so the scoreboard survives a

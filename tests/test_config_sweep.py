@@ -345,7 +345,12 @@ def test_n_axis_matches_solo_bitwise():
         np.testing.assert_array_equal(full.msgs[i], solo.msgs[0])
 
 
+@pytest.mark.slow
 def test_n_axis_antientropy_and_drop_match_solo():
+    # slow tier (tier-1 wall rebalance, traced-operand PR): depth
+    # variant of the phantom-n contract — the in-gate surface keeps
+    # test_eight_configs_one_program_all_converge, the 2-D pod-sweep
+    # parity, and the sharding-invariance pins
     # the AE reverse delta and per-point loss survive phantom padding
     topos = [G.ring(256, 4), G.ring(512, 4)]
     run = RunConfig(seed=0, max_rounds=24)
@@ -422,9 +427,12 @@ def test_mixed_rumor_batch_matches_solo_bitwise():
     np.testing.assert_array_equal(meshed.msgs, batch.msgs)
 
 
+@pytest.mark.slow
 def test_mixed_rumor_batch_composes_with_mixed_n():
     """Both phantom axes at once: a (sizes x rumor-counts) grid in one
-    program, each cell bitwise equal to its solo run."""
+    program, each cell bitwise equal to its solo run.  Slow tier
+    (tier-1 wall rebalance, traced-operand PR): the single-axis pins
+    for both phantom mechanisms stay in-gate."""
     topos = [G.ring(96, k=4), G.ring(160, k=4)]
     run = RunConfig(seed=2, max_rounds=24, target_coverage=0.999)
     pts = [SweepPoint(mode=C.PUSH, fanout=1, seed=1, topo_idx=t, rumors=r)

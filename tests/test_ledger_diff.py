@@ -22,6 +22,11 @@ _spec.loader.exec_module(ledger_diff)
 R09_4DEV = os.path.join(_REPO, "artifacts",
                         "ledger_dryrun_r09_4dev.jsonl")
 R09_8DEV = os.path.join(_REPO, "artifacts", "ledger_dryrun_r09.jsonl")
+# the traced-operand PR's 4-device record: same family set as the live
+# dry run (churn_heal AND churn_sweep included), so the tier-1 gate
+# compares every family like-for-like
+R11_4DEV = os.path.join(_REPO, "artifacts",
+                        "ledger_dryrun_r11_4dev.jsonl")
 
 
 def _write_run(path, families, device_count=4, metrics=None,
@@ -207,12 +212,17 @@ def test_committed_4dev_record_vs_fresh_dryrun_is_clean(dryrun_pair,
     """THE regression gate: the committed 4-device warm record diffed
     against this session's live warm dry run (same device count, same
     machine class) must come back clean — walls within threshold+floor,
-    budgets held, protocol totals compared at equal device count."""
-    rc = ledger_diff.main([R09_4DEV,
+    budgets held, protocol totals compared at equal device count.
+    Since the traced-operand PR the committed record is r11, whose
+    family set includes churn_heal AND churn_sweep, so the new sweep
+    family's walls gate like every other family."""
+    rc = ledger_diff.main([R11_4DEV,
                            dryrun_pair["warm"]["ledger_path"]])
     out = capsys.readouterr().out
     assert rc == 0, f"ledger_diff flagged a fresh dry run:\n{out}"
     assert "Verdict: clean" in out
+    # every family joined — nothing fell out as an only-in-one note
+    assert "churn_sweep" in out and "only in" not in out
     # the metric join actually engaged (same device count, fused
     # drivers instrumented in both)
     assert "simulate_until_sharded_fused" in out
@@ -226,11 +236,11 @@ def test_committed_record_with_injected_2x_wall_is_flagged(tmp_path,
     calibration that forgives uniform host load, proving the
     thresholds catch a real regression, not just synthetic
     fixtures."""
-    events = telemetry.load_ledger(R09_4DEV)
+    events = telemetry.load_ledger(R11_4DEV)
     runs = [e["run"] for e in events if e.get("ev") == "provenance"]
     warm = runs[-1]
     doubled = str(tmp_path / "doubled.jsonl")
-    with open(R09_4DEV) as f, open(doubled, "w") as g:
+    with open(R11_4DEV) as f, open(doubled, "w") as g:
         for line in f:
             if not line.strip():
                 continue
@@ -241,10 +251,33 @@ def test_committed_record_with_injected_2x_wall_is_flagged(tmp_path,
                     if isinstance(e.get(k), (int, float)):
                         e[k] = 2 * e[k]
             g.write(json.dumps(e) + "\n")
-    rc = ledger_diff.main([R09_4DEV, doubled])
+    rc = ledger_diff.main([R11_4DEV, doubled])
     out = capsys.readouterr().out
     assert rc == 1
     assert "swim_rotating first_ms regressed" in out
+
+
+def test_churn_sweep_family_gates_like_every_other(tmp_path, capsys):
+    """The new churn_sweep dry-run family rides the same gates: a
+    family-shaped wall regression against a steady pack is flagged,
+    and a steady wall past its tools/dryrun_budgets.json row trips the
+    budget check — no special-casing anywhere (the gate is generic by
+    family name; this pins that the budget row exists and engages)."""
+    pack = {f"fam{i}": {"first_ms": 500.0 + 40 * i, "steady_ms": 4.0}
+            for i in range(4)}
+    pack["churn_sweep"] = {"first_ms": 900.0, "steady_ms": 40.0}
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    _write_run(a, pack)
+    regressed = {f: dict(row) for f, row in pack.items()}
+    regressed["churn_sweep"] = {"first_ms": 2700.0, "steady_ms": 350.0}
+    _write_run(b, regressed)
+    rc = ledger_diff.main([a, b])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "churn_sweep first_ms regressed" in out
+    assert "churn_sweep steady_ms regressed" in out
+    # 350 ms also breaches the committed budget row (300 ms)
+    assert "over budget 300" in out
 
 
 def test_committed_r09_cold_vs_warm_self_diff_is_clean(capsys):

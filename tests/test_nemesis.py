@@ -369,21 +369,30 @@ def test_unsupported_engines_reject_loudly():
         make_sparse_topo_pull_round(
             ProtocolConfig(mode=C.PULL, fanout=1, rumors=1),
             G.erdos_renyi(64, 0.2, seed=0), mesh, ev)
-    # swim: events only
+    # swim: events + drop ramps (the schedule rides as operands since
+    # the traced-operand PR — the old "bakes its drop threshold"
+    # rejection is gone); partitions stay impossible (probes ride the
+    # complete membership overlay)
     from gossip_tpu.models.swim import make_swim_round
     wproto = ProtocolConfig(mode=C.SWIM, fanout=2, swim_subjects=4,
                             swim_proxies=2, swim_suspect_rounds=3)
     with pytest.raises(ValueError, match="partition"):
         make_swim_round(wproto, 64, fault=part)
-    with pytest.raises(ValueError, match="ramp"):
-        make_swim_round(wproto, 64, fault=ramp)
-    # fused planes: events only (driver entry raises pre-compile)
+    make_swim_round(wproto, 64, fault=ramp)       # accepted now
+    # fused planes: events only (driver entry raises pre-compile); the
+    # ramp message must name the REMAINING genuinely-impossible reason
+    # — the Pallas kernel's compile-time threshold — not the stale
+    # claim that the XLA paths bake
     from gossip_tpu.parallel.sharded_fused import (
         make_plane_mesh, simulate_until_sharded_fused)
     with pytest.raises(ValueError, match="partition"):
         simulate_until_sharded_fused(
             128 * 8, 40, RunConfig(seed=0, max_rounds=2),
             make_plane_mesh(4), interpret=True, fault=part)
+    with pytest.raises(ValueError, match="Pallas kernel"):
+        simulate_until_sharded_fused(
+            128 * 8, 40, RunConfig(seed=0, max_rounds=2),
+            make_plane_mesh(4), interpret=True, fault=ramp)
     # checkpointed drivers: no churn (the segment contract)
     from gossip_tpu.models.rumor import checkpointed_rumor
     with pytest.raises(ValueError, match="churn"):
@@ -565,6 +574,244 @@ def test_validate_artifacts_requires_provenance_on_nemesis(tmp_path):
     assert va.validate_file(str(badj))
 
 
+# -- traced-operand schedule contract (the one-executable PR) ---------
+
+def test_schedule_canonical_padding_is_exact():
+    """The [T] tables pad to a power-of-two bucket by repeating the
+    final row — the steady state by construction — so the clamped
+    lookup is EXACT at every length and every padding choice, which is
+    what lets memo keys (and the HLO fingerprint) carry only the
+    bucket, never the content."""
+    from gossip_tpu.ops import nemesis as NE
+    ch = ChurnConfig(partitions=((2, 4, 8),), ramp=(1, 3, 0.0, 0.4))
+    f = FaultConfig(drop_prob=0.1, churn=ch)
+    assert NE.canonical_horizon(ch) == 32          # horizon 5 -> bucket
+    long = ChurnConfig(partitions=((0, 40, 8),))
+    assert NE.canonical_horizon(long) == 64
+    s32 = NE.build(f, 16)
+    s128 = NE.build(f, 16, t_pad=128)
+    assert s32.cut_tbl.shape == (32,) and s128.cut_tbl.shape == (128,)
+    for r in (0, 2, 3, 4, 31, 500):
+        assert int(NE.cut_at(s32, r)) == int(NE.cut_at(s128, r)), r
+        assert float(NE.drop_at(s32, r)) == float(NE.drop_at(s128, r)), r
+    with pytest.raises(ValueError, match="below the schedule horizon"):
+        NE.build(f, 16, t_pad=3)
+    # the stack aligns mixed horizons to one bucket and keeps content
+    st = NE.build_stack([f, FaultConfig(churn=long)], 16)
+    assert st.cut_tbl.shape == (2, 64)
+    assert int(st.cut_tbl[0, 2]) == 8 and int(st.cut_tbl[1, 20]) == 8
+    # split_tables is the exact inverse of the sched_args layout
+    tbl, sched = NE.split_tables(ch, ("nbrs", "deg")
+                                 + NE.sched_args(s32))
+    assert tbl == ("nbrs", "deg")
+    assert sched.cut_tbl.shape == (32,)
+    # a static-only stack entry rejects loudly
+    with pytest.raises(ValueError, match="no churn"):
+        NE.build_stack([f, FaultConfig(drop_prob=0.5)], 16)
+
+
+def _fingerprint_surfaces(names):
+    import json
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import _churn_surfaces as CS
+    finally:
+        sys.path.pop(0)
+    with open(CS.DATA) as f:
+        golden = json.load(f)["digests"]
+    for name in names:
+        runner, fault_of = CS.SURFACES[name]
+        assert runner(fault_of()) == golden[f"churn:{name}"], (
+            f"churn:{name} trajectory diverged from the PR 5 "
+            "baked-schedule capture (tests/data/"
+            "churn_fingerprints_r06.json)")
+        if name in CS.NO_CHURN:
+            assert runner(CS._static_fault()) == golden[
+                f"static:{name}"], f"static:{name} moved"
+
+
+def test_traced_operand_trajectories_match_pr5_bake():
+    """Schedules as runtime operands must be a pure re-plumbing: the
+    churn trajectories (and the static-fault hot path) on the core
+    surfaces are BITWISE the golden digests captured from the PR 5
+    baked-schedule tree.  The full 12-surface matrix runs in the slow
+    tier; the in-gate digest is dense_sharded — the one surface that
+    exercises ALL the new plumbing at once (host-side build, table-tail
+    operands through shard_map replicated specs, the shape-keyed
+    memoized loop, the eventual-alive operand) — because the other
+    surfaces are already pinned in-gate against IT and each other by
+    the cross-surface churn parity tests above (tier-1 wall budget:
+    every extra surface here costs a compile)."""
+    _fingerprint_surfaces(["dense_sharded"])
+
+
+@pytest.mark.slow
+def test_traced_operand_trajectories_full_matrix():
+    """Every converted surface vs the PR 5 golden digests (in-gate
+    subset above; rationale in tests/_churn_surfaces.py)."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import _churn_surfaces as CS
+    finally:
+        sys.path.pop(0)
+    _fingerprint_surfaces(sorted(CS.SURFACES))
+
+
+def test_dense_sharded_k_scenarios_compile_once(assert_compiles):
+    """THE amortization acceptance: K=8 mixed nemesis scenarios
+    (churn events, partition windows, drop ramps) through the dense
+    sharded driver compile EXACTLY once — the shape-keyed memoized
+    loop (_cached_dense_loop) takes schedule content and the
+    eventual-alive denominator as operands, so scenarios 2..8 are pure
+    in-memory executable reuses (zero backend compiles, pinned via the
+    JitCompileMonitor fixture)."""
+    from gossip_tpu.parallel import sharded
+    topo = G.complete(64)
+    proto = ProtocolConfig(mode=C.PUSH_PULL, fanout=2, rumors=2)
+    run = RunConfig(seed=0, max_rounds=4)
+    mesh = sharded.make_mesh(4)
+    scens = [
+        ChurnConfig(events=((3, 1, 3),)),
+        ChurnConfig(events=((5, 2, -1),)),
+        ChurnConfig(partitions=((0, 3, 32),)),
+        ChurnConfig(partitions=((1, 3, 16),)),
+        ChurnConfig(ramp=(0, 3, 0.0, 0.2)),
+        ChurnConfig(ramp=(1, 3, 0.1, 0.4)),
+        ChurnConfig(events=((7, 1, -1),), partitions=((0, 2, 48),)),
+        ChurnConfig(events=((9, 1, 2),), ramp=(0, 2, 0.0, 0.1)),
+    ]
+    faults = [FaultConfig(drop_prob=0.05, seed=2, churn=ch)
+              for ch in scens]
+    sharded._cached_dense_loop.cache_clear()
+    covs0, _, _ = sharded.simulate_curve_sharded(
+        proto, topo, run, mesh, faults[0])       # the only compile
+    with assert_compiles(0):
+        for f in faults[1:]:
+            covs, _, _ = sharded.simulate_curve_sharded(
+                proto, topo, run, mesh, f)
+            assert covs.shape == (4,)
+
+
+def test_churn_sweep_matches_solo_bitwise():
+    """Scenario-batched sweep (sweep.churn_sweep_curves): each
+    scenario's curve/msgs equal the solo simulate_curve run BITWISE
+    (same threefry keys; integer-exact coverage readout), mixed
+    events + windows + ramps in one vmapped program."""
+    from gossip_tpu.parallel.sweep import churn_sweep_curves
+    from gossip_tpu.runtime.simulator import simulate_curve
+    topo = G.complete(64)
+    proto = ProtocolConfig(mode=C.PUSH_PULL, fanout=2, rumors=2)
+    run = RunConfig(seed=0, max_rounds=10)
+    faults = [
+        FaultConfig(node_death_rate=0.1, seed=1, drop_prob=0.1,
+                    churn=ChurnConfig(partitions=((1, 5, 32),),
+                                      ramp=(0, 4, 0.0, 0.3))),
+        FaultConfig(node_death_rate=0.1, seed=1,
+                    churn=ChurnConfig(events=((7, 1, -1),),
+                                      partitions=((2, 6, 16),))),
+    ]
+    res = churn_sweep_curves(proto, topo, run, faults)
+    for i, f in enumerate(faults):
+        solo = simulate_curve(proto, topo, run, f)
+        np.testing.assert_array_equal(res.curves[i],
+                                      np.asarray(solo.coverage))
+        np.testing.assert_array_equal(res.msgs[i],
+                                      np.asarray(solo.msgs))
+    # mixed static structure rejects loudly (the step bakes the mask)
+    with pytest.raises(ValueError, match="STATIC fault structure"):
+        churn_sweep_curves(proto, topo, run, faults + [
+            FaultConfig(node_death_rate=0.3, seed=1,
+                        churn=ChurnConfig(events=((3, 1, 2),)))])
+
+
+def test_churn_sweep_new_family_costs_no_compile(assert_compiles):
+    """A SECOND scenario family of the same shapes re-enters the
+    memoized vmapped scan with new schedule operands: zero backend
+    compiles (the one-executable-every-scenario contract)."""
+    from gossip_tpu.parallel.sweep import (_cached_churn_sweep_scan,
+                                           churn_sweep_curves)
+    topo = G.complete(64)
+    proto = ProtocolConfig(mode=C.PUSH_PULL, fanout=2, rumors=2)
+    run = RunConfig(seed=0, max_rounds=4)
+
+    def family(salt, drop=0.0):
+        return [FaultConfig(seed=1, drop_prob=drop, churn=ChurnConfig(
+            events=(((3 * i + salt) % 64, 1, 3),))) for i in range(8)]
+
+    _cached_churn_sweep_scan.cache_clear()
+    churn_sweep_curves(proto, topo, run, family(0))   # the one compile
+    with assert_compiles(0):
+        res = churn_sweep_curves(proto, topo, run, family(7))
+        # drop_prob only feeds the drop_tbl OPERAND — a family
+        # differing in the base rate shares the loop too
+        churn_sweep_curves(proto, topo, run, family(7, drop=0.1))
+    assert res.curves.shape == (8, 4)
+
+
+def test_swim_honors_drop_ramp():
+    """The rejection list shrank: SWIM consumes drop_tbl[r] as a
+    traced operand, so a drop-rate ramp is a legal SWIM schedule.  A
+    ramp to heavy loss slows/pauses detection while it holds, the
+    permanent crash is still confirmed, and the sharded twin stays
+    bitwise identical."""
+    from gossip_tpu.models import swim as SW
+    from gossip_tpu.parallel.sharded import make_mesh
+    from gossip_tpu.runtime.simulator import simulate_swim_curve
+    n, rounds = 64, 36
+    t = SW.suggested_suspect_rounds(n, 2)
+    proto = ProtocolConfig(mode=C.SWIM, fanout=2, swim_subjects=8,
+                           swim_proxies=2, swim_suspect_rounds=t)
+    fault = FaultConfig(seed=1, churn=ChurnConfig(
+        events=((5, 2, -1),), ramp=(0, 6, 0.0, 0.3)))
+    fr, fin = simulate_swim_curve(proto, n, rounds, dead_nodes=(),
+                                  fail_round=0, fault=fault)
+    status = np.asarray(SW.decode_status(fin.wire))
+    obs = np.asarray(SW.observer_alive(n, (), fault))
+    assert (status[obs, 5] == SW.DEAD).all(), "crash not confirmed"
+    fr2, fin2 = simulate_swim_curve(proto, n, rounds, dead_nodes=(),
+                                    fail_round=0, fault=fault,
+                                    mesh=make_mesh(4))
+    assert np.array_equal(np.asarray(fin.wire),
+                          np.asarray(fin2.wire)[:n])
+    # ... and the packed-rng lowering accepts the traced threshold too
+    pproto = ProtocolConfig(mode=C.SWIM, fanout=2, swim_subjects=8,
+                            swim_proxies=2, swim_suspect_rounds=t,
+                            swim_rng="packed")
+    fr3, fin3 = simulate_swim_curve(pproto, n, 12, dead_nodes=(),
+                                    fail_round=0, fault=fault)
+    assert np.isfinite(fr3).all()
+
+
+def test_committed_churn_sweep_record():
+    """The committed amortization artifact
+    (artifacts/ledger_churn_sweep_r11.jsonl): provenance-carrying; the
+    K>=8-scenario dense-sharded warm path beat K solo (fresh-compile)
+    reruns by >= 3x; per-scenario round_metrics stacks carry the
+    nemesis columns; the batched vmapped sweep ran the same family."""
+    from gossip_tpu.utils import telemetry
+    path = os.path.join(_REPO, "artifacts",
+                        "ledger_churn_sweep_r11.jsonl")
+    evs = telemetry.load_ledger(path, run="last")
+    assert evs[0]["ev"] == "provenance"
+    assert len(evs[0]["git_commit"]) == 40
+    rec = [e for e in evs if e.get("ev") == "churn_sweep_record"][-1]
+    assert rec["k"] >= 8 and rec["driver"] == "dense_sharded"
+    assert rec["accept_3x"] is True
+    assert rec["solo_total_ms"] >= 3 * rec["warm_total_ms"]
+    assert rec["speedup"] >= 3
+    assert rec["batched_warm_ms"] > 0
+    # per-scenario nemesis observables rode the drivers' own flushes
+    rms = [e for e in evs if e.get("ev") == "round_metrics"]
+    assert len(rms) >= rec["k"]
+    assert all("alive" in e and "dropped" in e for e in rms)
+    scen = [e for e in evs if e.get("ev") == "churn_sweep_scenario"]
+    assert len(scen) == rec["k"]
+    assert all(s["final_coverage"] == 1.0 for s in scen)
+    assert any(s["dropped_total"] > 0 for s in scen)
+
+
 # -- no-churn pins ----------------------------------------------------
 
 def test_no_churn_configs_stay_bitwise_unchanged():
@@ -586,6 +833,36 @@ def test_no_churn_configs_stay_bitwise_unchanged():
 
 
 # -- CLI parse --------------------------------------------------------
+
+def test_cli_churn_sweep_command(capsys):
+    """The churn-sweep subcommand end to end (in-process main): K
+    scenarios through one compiled loop, JSON summaries per scenario,
+    and the spec parser's error paths."""
+    from gossip_tpu import cli
+    rc = cli.main([
+        "churn-sweep", "--n", "64", "--max-rounds", "8",
+        "--target", "1.0", "--compile-cache", "",
+        "--scenario", "event=3:2:5",
+        "--scenario", "partition=0:4:32;ramp=0:3:0.0:0.2"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["scenarios"] == 2 and out["n"] == 64
+    rows = out["churn_sweep"]
+    assert rows[0]["scenario"]["events"] == [[3, 2, 5]]
+    assert rows[1]["scenario"]["partitions"] == [[0, 4, 32]]
+    assert rows[1]["scenario"]["ramp"] == [0, 3, 0.0, 0.2]
+    assert all("dropped_total" in r for r in rows)
+    # error paths: unknown field, empty scenario, bad device split
+    assert cli.main(["churn-sweep", "--n", "64",
+                     "--scenario", "bogus=1:2"]) == 2
+    assert "unknown scenario field" in capsys.readouterr().err
+    assert cli.main(["churn-sweep", "--n", "64",
+                     "--scenario", " ; "]) == 2
+    assert "scripts no faults" in capsys.readouterr().err
+    assert cli.main(["churn-sweep", "--n", "64", "--devices", "3",
+                     "--scenario", "event=3:2:5"]) == 2
+    assert "do not divide" in capsys.readouterr().err
+
 
 def test_cli_churn_parse():
     import argparse

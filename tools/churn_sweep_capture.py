@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Capture the compile-amortized churn-sweep record (the traced-operand
+PR's acceptance artifact).
+
+Two legs over the SAME K nemesis scenarios on the dense sharded driver
+(parallel/sharded.simulate_curve_sharded):
+
+  * ``solo`` — K reruns, each forced through a fresh trace + XLA
+    compile (the shape-keyed loop memo and jax's in-memory caches are
+    cleared between scenarios, and the persistent compile cache is
+    suspended) — the pre-PR cost model, where every ChurnConfig baked
+    its schedule into the program and no cache could serve a sibling
+    scenario;
+  * ``warm`` — the same K scenarios through the ONE memoized compiled
+    loop (schedules as runtime operands): scenario 1 pays the only
+    compile (reported separately as ``compile_ms``), scenarios 2..K are
+    in-memory executable reuses.  The acceptance line is
+    ``solo_total_ms >= 3 * warm_total_ms``.
+
+A third leg runs the scenario-BATCHED sweep
+(parallel/sweep.churn_sweep_curves): all K scenarios as one vmapped XLA
+program, with per-scenario summaries (convergence, exact dropped
+totals) ledgered as ``churn_sweep_scenario`` events.
+
+Everything lands in ONE run ledger (utils/telemetry — provenance first
+line, per-scenario ``round_metrics`` events with the nemesis columns
+flushed by the drivers themselves), so the committed artifact passes
+tools/validate_artifacts.py's churn-artifact provenance gate.
+
+    python tools/churn_sweep_capture.py [OUT.jsonl]   # default
+        artifacts/ledger_churn_sweep_r11.jsonl
+
+Runs on the hermetic CPU tier by design (the amortization ratio is a
+compile-vs-reuse structure, not a chip rate; the TPU rate story lives
+in BENCH/hw_refresh).
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+K = 8
+N = 64 * 4
+DEVICES = 4
+MAX_ROUNDS = 16
+
+
+def scenarios():
+    """K mixed fault programs — the ONE shared scenario-family
+    generator (ops/nemesis.mixed_scenarios; the dry-run churn_sweep
+    family and bench.py's families leg draw from it too)."""
+    from gossip_tpu.ops import nemesis as NE
+    return NE.mixed_scenarios(K, N, drop_prob=0.02, seed=2)
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    out_path = (argv[0] if argv else
+                os.path.join(REPO, "artifacts",
+                             "ledger_churn_sweep_r11.jsonl"))
+    # hermetic: the persistent/AOT cache must not serve the solo leg
+    os.environ["GOSSIP_COMPILE_CACHE"] = ""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={DEVICES}"
+        ).strip()
+
+    import jax
+    import numpy as np
+    from gossip_tpu import config as C
+    from gossip_tpu.config import ProtocolConfig, RunConfig
+    from gossip_tpu.parallel import sharded
+    from gossip_tpu.parallel.sweep import churn_sweep_curves
+    from gossip_tpu.topology import generators as G
+    from gossip_tpu.utils import telemetry
+
+    topo = G.complete(N)
+    proto = ProtocolConfig(mode=C.PUSH_PULL, fanout=2, rumors=2)
+    run = RunConfig(seed=0, max_rounds=MAX_ROUNDS, target_coverage=1.0)
+    mesh = sharded.make_mesh(DEVICES)
+    faults = scenarios()
+
+    led = telemetry.Ledger(out_path)
+    prev = telemetry.activate(led)
+    try:
+        led.record_runtime()
+
+        def one(fault):
+            t0 = time.perf_counter()
+            covs, msgs, _ = sharded.simulate_curve_sharded(
+                proto, topo, run, mesh, fault)
+            return (time.perf_counter() - t0) * 1e3, covs, msgs
+
+        # -- solo leg: every scenario pays trace + compile ------------
+        solo_ms = []
+        for i, f in enumerate(faults):
+            sharded._cached_dense_loop.cache_clear()
+            jax.clear_caches()
+            ms, covs, _ = one(f)
+            solo_ms.append(ms)
+            led.event("churn_sweep_solo", scenario=i,
+                      wall_ms=round(ms, 1),
+                      final_coverage=round(float(covs[-1]), 6))
+
+        # -- warm leg: one compile, K reuses --------------------------
+        sharded._cached_dense_loop.cache_clear()
+        jax.clear_caches()
+        t0 = time.perf_counter()
+        one(faults[0])                      # the only compile
+        compile_ms = (time.perf_counter() - t0) * 1e3
+        warm_ms = []
+        for i, f in enumerate(faults):
+            ms, covs, _ = one(f)
+            warm_ms.append(ms)
+            led.event("churn_sweep_warm", scenario=i,
+                      wall_ms=round(ms, 1),
+                      final_coverage=round(float(covs[-1]), 6))
+
+        solo_total, warm_total = sum(solo_ms), sum(warm_ms)
+        speedup = solo_total / max(warm_total, 1e-9)
+
+        # -- batched leg: all K as one vmapped program ----------------
+        t0 = time.perf_counter()
+        res = churn_sweep_curves(proto, topo, run, faults)
+        batched_first_ms = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        res = churn_sweep_curves(proto, topo, run, faults)
+        batched_warm_ms = (time.perf_counter() - t0) * 1e3
+        for i, s in enumerate(res.summaries()):
+            led.event("churn_sweep_scenario", idx=i, **s)
+
+        led.event("churn_sweep_record",
+                  k=K, n=N, devices=DEVICES, driver="dense_sharded",
+                  max_rounds=MAX_ROUNDS,
+                  solo_total_ms=round(solo_total, 1),
+                  warm_total_ms=round(warm_total, 1),
+                  compile_ms=round(compile_ms, 1),
+                  speedup=round(speedup, 2),
+                  batched_first_ms=round(batched_first_ms, 1),
+                  batched_warm_ms=round(batched_warm_ms, 1),
+                  accept_3x=bool(solo_total >= 3 * warm_total))
+        line = {"k": K, "solo_total_ms": round(solo_total, 1),
+                "warm_total_ms": round(warm_total, 1),
+                "speedup": round(speedup, 2),
+                "batched_warm_ms": round(batched_warm_ms, 1),
+                "ledger": out_path}
+        print(json.dumps(line))
+        return 0 if solo_total >= 3 * warm_total else 1
+    finally:
+        telemetry.activate(prev)
+        led.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
