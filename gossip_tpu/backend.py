@@ -327,13 +327,17 @@ def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
 
 def _fused_ineligible_reason(proto: ProtocolConfig, tc: TopologyConfig,
                              fault: Optional[FaultConfig],
-                             n_dev: int) -> Optional[str]:
+                             n_dev: int,
+                             plane_stack: bool = False) -> Optional[str]:
     """Why this run cannot use the fused Pallas engine, or None if it can.
 
     The ONE list of preconditions: engine='fused' raises it verbatim,
     engine='auto' checks it quietly — so the two can never drift apart.
     Config reasons come before the platform probe so forced-fused config
-    errors surface identically on any backend."""
+    errors surface identically on any backend.  ``plane_stack``: the
+    caller routes to the plane-sharded drivers regardless of n_dev (the
+    checkpointed CLI path), which run churn EVENTS as alive-word
+    operands — only that combination relaxes the churn rejection."""
     from gossip_tpu.ops.pallas_round import BITS, check_fused_fits
     import jax as _jax
     if proto.mode != "pull":
@@ -350,13 +354,25 @@ def _fused_ineligible_reason(proto: ProtocolConfig, tc: TopologyConfig,
                 "fail_round; use engine='auto' (or node_death_rate for "
                 "random static deaths)")
     if fault is not None and fault.churn is not None:
-        # the plane-sharded fused drivers run churn EVENTS when called
-        # directly (parallel/sharded_fused), but this routing's
-        # single-device fused paths predate the churn denominator —
-        # auto falls back to the XLA kernels, which run every schedule
-        return ("engine='fused' routing does not run churn schedules; "
-                "use engine='auto' (XLA kernels run the full nemesis "
-                "scenario catalog — docs/ROBUSTNESS.md)")
+        if not plane_stack:
+            # the plane-sharded fused drivers run churn EVENTS when
+            # called directly (parallel/sharded_fused — the checkpointed
+            # CLI path routes there, plane_stack=True), but this
+            # routing's single-device fused paths predate the churn
+            # denominator — auto falls back to the XLA kernels, which
+            # run every schedule
+            return ("engine='fused' routing does not run churn "
+                    "schedules; use engine='auto' (XLA kernels run the "
+                    "full nemesis scenario catalog — "
+                    "docs/ROBUSTNESS.md)")
+        if fault.churn.partitions or fault.churn.ramp is not None:
+            # mirror ops/nemesis.check_supported as a clean CLI reason:
+            # the factory would raise the same refusal mid-driver
+            return ("the fused plane stack runs churn EVENTS only — it "
+                    "has no per-pair message table a partition cut "
+                    "could destroy, and its drop coin is an in-kernel "
+                    "compile-time threshold no ramp can move; use the "
+                    "XLA engines for partition/ramp fault programs")
     # node_death_rate / drop_prob: in-kernel static fault masks cover
     # every fused layout since round 4 (node-packed, one-word-per-node,
     # staged big path, plane-sharded) — no restriction to return

@@ -474,7 +474,14 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
     compiled scan recording per-round coverage (SWIM: detection
     fraction; rumor: coverage + hot-fraction channels, extinction being
     recoverable only from the hot channel), and the curve-so-far is
-    persisted in the checkpoint so --resume continues it seamlessly."""
+    persisted in the checkpoint so --resume continues it seamlessly.
+
+    Nemesis fault programs compose too (crash-safety round): each
+    engine runs every schedule feature its straight twin honors, the
+    checkpoint stamps the fault-program fingerprint + absolute round
+    cursor + exact dropped total, and --resume continues the SAME
+    program bitwise or refuses loudly (docs/ROBUSTNESS.md "Crash
+    safety"; tools/crashloop.py is the live SIGKILL harness)."""
     import os
 
     n_dev = 1 if mesh is None else mesh.n_devices
@@ -487,7 +494,11 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
     fused = run.engine == "fused"
     if fused:
         from gossip_tpu.backend import _fused_ineligible_reason
-        reason = _fused_ineligible_reason(proto, tc, fault, n_dev)
+        # plane_stack: the checkpointed fused driver is ALWAYS the
+        # plane-sharded engine (make_plane_mesh, any n_dev), which runs
+        # churn events as alive-word operands
+        reason = _fused_ineligible_reason(proto, tc, fault, n_dev,
+                                          plane_stack=True)
         if reason is not None:
             print(f"error: {reason}", file=sys.stderr)
             return 2
@@ -502,6 +513,7 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
             return 2
     import dataclasses
 
+    from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.topology import generators as G
     from gossip_tpu.utils.checkpoint import load_meta, load_state
 
@@ -516,15 +528,35 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
                    "seed": run.seed, "origin": run.origin,
                    "devices": n_dev, "exchange": exchange,
                    "engine": "fused" if fused else "xla"}
+    # Fault-program fingerprint: a digest of the BUILT nemesis schedule
+    # content + the eventual-alive denominator (ops/nemesis
+    # .schedule_fingerprint) — semantic, where the config fingerprint
+    # above is syntactic.  Resume refuses a missing fingerprint loudly
+    # (a checkpoint that cannot prove which schedule produced it — e.g.
+    # a pre-crash-safety build's — must not be continued under one);
+    # the digest-mismatch branch below is today shadowed by the config
+    # fingerprint (churn is inside it) and stands as the semantic
+    # backstop should a refactor ever move the schedule out of the
+    # syntactic fingerprint.
+    fault_fp = NE.schedule_fingerprint(fault, tc.n, run.origin)
+    ch = NE.get(fault)
     resumed = False
     resume_state = None
     curve_prefix = ()
+    lost_prefix = 0.0
     if a.resume:
         if not os.path.exists(a.checkpoint):
             print(f"error: --resume: no checkpoint at {a.checkpoint}",
                   file=sys.stderr)
             return 2
-        meta = load_meta(a.checkpoint)
+        try:
+            meta = load_meta(a.checkpoint)
+        except ValueError as e:
+            # corrupt/truncated/foreign file: the module crash contract
+            # (utils/checkpoint) turns it into one ValueError naming the
+            # file — surface it as a clean CLI error, never a traceback
+            print(f"error: --resume: {e}", file=sys.stderr)
+            return 2
         saved = meta.get("extra", {}).get("config")
         if saved is not None:
             # pre-round-4 checkpoints lack the devices/exchange/engine
@@ -542,6 +574,30 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
                   "flags the checkpoint was written with",
                   file=sys.stderr)
             return 2
+        saved_fp = meta.get("extra", {}).get("fault_program")
+        if fault_fp is not None and saved_fp is None:
+            print("error: --resume under a fault program, but the "
+                  "checkpoint carries no fault-program fingerprint (it "
+                  "was written without a churn schedule, or by a "
+                  "pre-crash-safety build); a resumed run cannot prove "
+                  "it continues the SAME schedule — restart without "
+                  "--resume or drop the churn flags", file=sys.stderr)
+            return 2
+        if saved_fp is not None and fault_fp is None:
+            print("error: the checkpoint was written under a fault "
+                  "program but this resume scripts none; rerun with "
+                  "the churn flags the checkpoint was written with",
+                  file=sys.stderr)
+            return 2
+        if fault_fp is not None and saved_fp != fault_fp:
+            print("error: --resume fault-program mismatch vs the "
+                  "checkpoint (schedule digest "
+                  f"{saved_fp[:12]}... != {fault_fp[:12]}...); a "
+                  "different churn/partition/ramp program would fork "
+                  "the trajectory — rerun with the schedule the "
+                  "checkpoint was written with", file=sys.stderr)
+            return 2
+        lost_prefix = float(meta.get("extra", {}).get("dropped", 0.0))
         saved_curve = meta.get("extra", {}).get("curve")
         # curve history must match the request, both ways — a silently
         # truncated or silently dropped curve is worse than an error
@@ -561,10 +617,18 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
         # scalar engines carry one flat list
         curve_prefix = (saved_curve if isinstance(saved_curve, dict)
                         else tuple(saved_curve or ()))
-        resume_state = load_state(a.checkpoint)
+        try:
+            resume_state = load_state(a.checkpoint)
+        except ValueError as e:
+            # meta parsed but the arrays are torn/missing (module crash
+            # contract): same clean refusal as the load_meta path above
+            print(f"error: --resume: {e}", file=sys.stderr)
+            return 2
         resumed = True
 
     extra = {"config": fingerprint}
+    if fault_fp is not None:
+        extra["fault_program"] = fault_fp
     out_extra = {}
     if a.mode == "swim":
         from gossip_tpu.backend import swim_scenario
@@ -602,7 +666,8 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
             proto, G.build(tc), run, a.checkpoint,
             every=a.checkpoint_every, fault=fault, mesh=mesh_obj,
             resume_state=resume_state, want_curve=want_curve,
-            curve_prefix=curve_prefix, extra_meta=extra)
+            curve_prefix=curve_prefix, extra_meta=extra,
+            lost_prefix=lost_prefix)
         out_extra["residue"] = residue
         out_extra["extinct"] = not bool(_np.any(_np.asarray(final.hot)))
         if curve:
@@ -627,27 +692,28 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
             proto, G.build(tc), run, make_mesh(n_dev), a.checkpoint,
             every=a.checkpoint_every, fault=fault,
             resume_state=resume_state, want_curve=want_curve,
-            curve_prefix=curve_prefix, extra_meta=extra)
+            curve_prefix=curve_prefix, extra_meta=extra,
+            lost_prefix=lost_prefix)
         engine_label = "sharded-packed"
     else:
         from gossip_tpu.models.si import coverage, make_si_round
-        from gossip_tpu.models.state import alive_mask, init_state
-        from gossip_tpu.ops import nemesis as NE
+        from gossip_tpu.models.state import init_state
         from gossip_tpu.utils.checkpoint import run_with_checkpoints
-        # churn changes the step's return shape mid-segment; reject
-        # rather than corrupt the segment runner (the other
-        # checkpointed engines guard identically)
-        NE.check_supported(fault, engine="checkpointed-si", events=False,
-                           partitions=False, ramp=False)
         topo = G.build(tc)
+        # churn runs in the segments exactly as in the straight driver:
+        # the step indexes its ABSOLUTE state.round, which the
+        # checkpoint persists, so resume == straight run bitwise under
+        # the fault program (utils/checkpoint crash contract); the
+        # metric denominator is the eventual alive set (metric_alive
+        # falls back to the static mask without churn)
         step, tables = make_si_round(proto, topo, fault, run.origin,
                                      tabled=True)
         state = resume_state if resumed else init_state(run, proto, tc.n)
         curve_fn = None
         if want_curve:
             def curve_fn(s):
-                return coverage(s.seen, alive_mask(fault, tc.n,
-                                                   run.origin))
+                return coverage(s.seen, NE.metric_alive(fault, tc.n,
+                                                        run.origin))
         remaining = max(0, run.max_rounds - int(state.round))
         out_state = run_with_checkpoints(step, state, remaining,
                                          a.checkpoint,
@@ -655,10 +721,12 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
                                          step_args=tables,
                                          curve_fn=curve_fn,
                                          curve_prefix=curve_prefix,
-                                         extra_meta=extra)
+                                         extra_meta=extra,
+                                         track_lost=ch is not None,
+                                         lost_prefix=lost_prefix)
         final, curve = (out_state if want_curve else (out_state, None))
         cov = float(coverage(final.seen,
-                             alive_mask(fault, tc.n, run.origin)))
+                             NE.metric_alive(fault, tc.n, run.origin)))
         engine_label = "si-xla"
     out = {"backend": a.backend, "mode": a.mode, "n": tc.n,
            "rounds": int(final.round), "coverage": cov,
@@ -666,6 +734,16 @@ def _cmd_run_checkpointed(a, proto, tc, run, fault, mesh) -> int:
            "checkpoint_every": a.checkpoint_every, "resumed": resumed,
            "engine": engine_label, "devices": n_dev,
            "compile_cache": _cache_stamp(a)}
+    if ch is not None:
+        # the nemesis observables of the run as persisted: the exact
+        # destroyed-message total accumulated across every segment AND
+        # every kill/resume (engines that track it — run_with_checkpoints
+        # track_lost), and the fault-program fingerprint the checkpoint
+        # refuses mismatched resumes against
+        final_meta = load_meta(a.checkpoint).get("extra", {})
+        if "dropped" in final_meta:
+            out["dropped"] = final_meta["dropped"]
+        out["fault_program"] = fault_fp
     out.update(out_extra)
     if a.profile:
         out["profile_logdir"] = a.profile

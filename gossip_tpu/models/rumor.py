@@ -212,7 +212,8 @@ def checkpointed_rumor(proto: ProtocolConfig, topo: Topology,
                        run: RunConfig, path: str, every: int = 50,
                        fault: Optional[FaultConfig] = None, mesh=None,
                        resume_state=None, want_curve: bool = False,
-                       curve_prefix=(), extra_meta=None):
+                       curve_prefix=(), extra_meta=None,
+                       lost_prefix: float = 0.0):
     """Fixed-budget rumor-mongering run in compiled segments with atomic
     npz checkpoints (utils/checkpoint.run_with_checkpoints) — the SIR
     twin of the SI/SWIM ``--checkpoint`` engines.  Unlike
@@ -228,13 +229,19 @@ def checkpointed_rumor(proto: ProtocolConfig, topo: Topology,
     pushes keep flowing between informed pairs).  With ``mesh`` the
     node-sharded twin runs.  Returns ``(final_state, coverage,
     residue, curve-dict-or-None)``.
+
+    Under a churn schedule the segments run the fault program exactly
+    as the straight drivers do (the step reads its ABSOLUTE
+    ``state.round``, which the checkpoint persists — resume == straight
+    run bitwise, utils/checkpoint crash contract), the destroyed-
+    message total accumulates across kills (``track_lost``; seed a
+    resume with the checkpoint's ``extra['dropped']`` via
+    ``lost_prefix``), and the metric denominator is the EVENTUAL alive
+    set (heal-convergence contract, ops/nemesis.metric_alive).
     """
     from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.utils.checkpoint import run_with_checkpoints
-    # churn would change the step's return shape mid-segment and the
-    # resume fingerprint cannot carry the schedule yet: reject loudly
-    NE.check_supported(fault, engine="checkpointed-rumor", events=False,
-                       partitions=False, ramp=False)
+    ch = NE.get(fault)
     if mesh is None:
         step, tables = make_rumor_round(proto, topo, fault, run.origin,
                                         tabled=True)
@@ -242,7 +249,9 @@ def checkpointed_rumor(proto: ProtocolConfig, topo: Topology,
                  else init_rumor_state(run, proto, topo.n))
 
         def alive_now():
-            return alive_mask(fault, topo.n, run.origin)
+            # static mask without churn, eventual-alive set under it —
+            # metric_alive is the one dispatch
+            return NE.metric_alive(fault, topo.n, run.origin)
     else:
         from gossip_tpu.parallel.sharded import pad_to_mesh, sharded_alive
         from gossip_tpu.parallel.sharded_rumor import (
@@ -257,6 +266,9 @@ def checkpointed_rumor(proto: ProtocolConfig, topo: Topology,
 
         def alive_now():
             # padded alive mask: padding rows must not deflate coverage
+            if ch is not None:
+                return NE.eventual_alive_pad(fault, topo.n, n_rows,
+                                             run.origin)
             return sharded_alive(fault, topo.n, n_rows, run.origin)
 
     curve_fn = None
@@ -276,7 +288,9 @@ def checkpointed_rumor(proto: ProtocolConfig, topo: Topology,
     out = run_with_checkpoints(step, state, remaining, path, every=every,
                                step_args=tables, curve_fn=curve_fn,
                                curve_prefix=curve_prefix,
-                               extra_meta=extra_meta)
+                               extra_meta=extra_meta,
+                               track_lost=ch is not None,
+                               lost_prefix=lost_prefix)
     final, curve = out if want_curve else (out, None)
     cov = float(rumor_coverage(final.seen, alive_now()))
     return final, cov, 1.0 - cov, curve

@@ -294,6 +294,34 @@ def mixed_scenarios(k: int, n: int, *, salt: int = 0,
     return out
 
 
+def schedule_fingerprint(fault: Optional[FaultConfig], n: int,
+                         origin: int = 0):
+    """sha256 hex digest of the BUILT fault program — the four Schedule
+    tables (canonical-horizon padded, so two configs that lower to the
+    same program share a digest) plus the eventual-alive denominator —
+    or None without a churn schedule.  This is the SEMANTIC twin of the
+    CLI's syntactic config fingerprint: a checkpoint stamps it under
+    ``extra['fault_program']`` and ``--resume`` refuses a mismatched or
+    missing one, because resuming under a different churn/partition/
+    ramp program (or a different convergence denominator) would fork
+    the trajectory while claiming bitwise continuation.  Host-side and
+    cheap: tables are config-sized, never run-length- or n-quadratic."""
+    if get(fault) is None:
+        return None
+    import hashlib
+
+    import numpy as np
+    sched = build(fault, n)
+    h = hashlib.sha256()
+    for arr in (sched.die, sched.rec, sched.cut_tbl, sched.drop_tbl,
+                eventual_alive(fault, n, origin)):
+        a = np.asarray(arr)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
 def sched_args(sched: Schedule) -> tuple:
     """The schedule as a flat tail of step arguments — appended to a
     factory's ``tables`` tuple so it rides every driver's existing
@@ -532,10 +560,11 @@ def check_supported(fault: Optional[FaultConfig], *, engine: str,
         coin is a hardware-PRNG threshold compare compiled into the
         kernel body, not a traced probability (every XLA engine,
         SWIM included, reads ``drop_tbl[r]`` as an operand);
-      * ``events=False`` — an engine with no churn support at all
-        (the checkpointed segment drivers, whose resume fingerprint
-        cannot carry a schedule yet; the topo-sparse exchange; the
-        grid config sweeps)."""
+      * ``events=False`` — an engine with no churn support at all:
+        ONLY the topo-sparse exchange and the grid config sweeps
+        remain (the checkpointed segment drivers came off this list
+        when resume grew the fault-program fingerprint +
+        absolute-round contract — utils/checkpoint module doc)."""
     ch = get(fault)
     if ch is None:
         return

@@ -173,7 +173,16 @@ def fused_planes_cov_fn(n: int, fault=None, origin: int = 0):
     """``planes -> coverage`` — alive-weighted iff the fault draws
     deaths (cf. ops/pallas_round.fused_cov_fn); a fault-binding wrapper
     around :func:`coverage_planes_masked`, which the compiled drivers
-    call directly with the mask as an operand."""
+    call directly with the mask as an operand.  Under a churn schedule
+    the denominator is the EVENTUAL alive words (permanent churn deaths
+    out, transient ones in — the heal-convergence contract the compiled
+    churn loops already apply via :func:`_cached_churn_masks`)."""
+    from gossip_tpu.ops import nemesis as NE
+    if NE.get(fault) is not None:
+        def cov_churn(p):
+            eventual = _cached_churn_masks(fault, n, origin)()[0]
+            return coverage_planes_masked(p, n, eventual)
+        return cov_churn
     if fault is None or not fault.node_death_rate:
         return lambda p: coverage_planes_masked(p, n)
 
@@ -366,13 +375,16 @@ def checkpointed_fused_planes(n: int, rumors: int, run: RunConfig,
 
     Returns ``(final_state, coverage, curve-or-None)``.
     """
-    from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.ops.pallas_round import FusedState
     from gossip_tpu.utils.checkpoint import run_with_checkpoints
-    # the checkpointed coverage chooser predates the churn denominator;
-    # reject rather than report a wrong convergence metric
-    NE.check_supported(fault, engine="checkpointed-fused", events=False,
-                       partitions=False, ramp=False)
+    # churn EVENTS run in the segments exactly as in the straight fused
+    # drivers — the round closure renders the alive words from the
+    # state's ABSOLUTE round counter, which the checkpoint persists, so
+    # resume == straight run bitwise (utils/checkpoint crash contract);
+    # partitions/ramps stay rejected by make_sharded_fused_round itself
+    # (genuinely impossible on this engine — ops/nemesis.check_supported)
+    # and the coverage denominator under churn is the eventual alive
+    # words (fused_planes_cov_fn)
     round_fn = make_sharded_fused_round(n, mesh, fanout, interpret,
                                         fault=fault, origin=run.origin)
     cov_planes = fused_planes_cov_fn(n, fault, run.origin)

@@ -196,7 +196,8 @@ def checkpointed_packed_sharded(proto: ProtocolConfig, topo: Topology,
                                 resume_state: Optional[SimState] = None,
                                 want_curve: bool = False,
                                 axis_name: str = "nodes",
-                                curve_prefix=(), extra_meta=None):
+                                curve_prefix=(), extra_meta=None,
+                                lost_prefix: float = 0.0):
     """Fixed-budget sharded run in compiled segments with atomic npz
     checkpoints — the multi-device twin of the single-device
     ``--checkpoint`` driver (utils/checkpoint.run_with_checkpoints):
@@ -206,17 +207,30 @@ def checkpointed_packed_sharded(proto: ProtocolConfig, topo: Topology,
 
     Returns ``(final_state, coverage, curve-or-None)``; bitwise equal to
     an uninterrupted segmented run (tests/test_checkpoint_sharded.py).
-    """
+
+    Churn schedules run in the segments exactly as in the straight
+    sharded drivers (the step indexes its ABSOLUTE ``state.round``;
+    resume == straight run bitwise — utils/checkpoint crash contract);
+    the destroyed-message total persists across kills via
+    ``track_lost``/``lost_prefix`` and the coverage denominator is the
+    EVENTUAL alive set (ops/nemesis.eventual_alive_pad)."""
     from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.utils.checkpoint import run_with_checkpoints
-    # churn would change the step's return shape mid-segment and the
-    # resume fingerprint cannot carry the schedule yet: reject loudly
-    NE.check_supported(fault, engine="checkpointed-packed", events=False,
-                       partitions=False, ramp=False)
+    ch = NE.get(fault)
     step, tables = make_sharded_packed_round(proto, topo, mesh, fault,
                                              run.origin, axis_name,
                                              tabled=True)
     n_pad = pad_to_mesh(topo.n, mesh, axis_name)
+
+    def alive_now():
+        # built IN-TRACE when called from curve_fn (no O(N) host
+        # constant in the compile request — models/swim.py doc); under
+        # churn the eventual set: the heal-convergence denominator
+        if ch is not None:
+            return NE.eventual_alive_pad(fault, topo.n, n_pad,
+                                         run.origin)
+        return sharded_alive(fault, topo.n, n_pad, run.origin)
+
     if resume_state is None:
         state = init_sharded_packed_state(run, proto, topo, mesh, axis_name)
     else:
@@ -226,20 +240,17 @@ def checkpointed_packed_sharded(proto: ProtocolConfig, topo: Topology,
     curve_fn = None
     if want_curve:
         def curve_fn(s):
-            # built IN-TRACE (no O(N) host constant in the compile
-            # request — models/swim.py doc); it is loop-invariant, so
-            # XLA hoists the rebuild out of the scan body
-            alive_t = sharded_alive(fault, topo.n, n_pad, run.origin)
-            return coverage_packed(s.seen, r, alive_t)
+            return coverage_packed(s.seen, r, alive_now())
 
     remaining = max(0, run.max_rounds - int(state.round))
     out = run_with_checkpoints(step, state, remaining, path, every=every,
                                step_args=tables, curve_fn=curve_fn,
                                curve_prefix=curve_prefix,
-                               extra_meta=extra_meta)
+                               extra_meta=extra_meta,
+                               track_lost=ch is not None,
+                               lost_prefix=lost_prefix)
     final, curve = out if want_curve else (out, None)
-    alive_pad = sharded_alive(fault, topo.n, n_pad, run.origin)
-    cov = float(coverage_packed(final.seen, r, alive_pad))
+    cov = float(coverage_packed(final.seen, r, alive_now()))
     return final, cov, curve
 
 

@@ -342,6 +342,16 @@ def checkpointed_swim(proto: ProtocolConfig, n: int, run: RunConfig,
     ``mesh`` the node-sharded twin runs (resume re-places the padded
     rows via restore_sharded_swim_state).  Returns
     ``(final_state, detection, curve-or-None)``.
+
+    Churn schedules (events + drop ramps; the SWIM factories reject
+    partitions — membership overlay) run in the segments exactly as in
+    the straight drivers: the step indexes its ABSOLUTE ``state.round``,
+    which the checkpoint persists, so resume == straight run bitwise
+    under an active fault program (utils/checkpoint crash contract;
+    tests/test_crash_safety.py pins detection 1.0 on the scheduled
+    permanent crash across a kill).  ``detection_targets`` already
+    folds permanent churn deaths into the metric target set, and
+    ``observer_alive`` drops them from the observer denominator.
     """
     from gossip_tpu.models import swim as SW
     from gossip_tpu.utils.checkpoint import run_with_checkpoints

@@ -12,6 +12,32 @@ run, bitwise).
 Orbax exists in the environment but would be a dependency for no gain at
 this state size; the format here is a plain ``np.savez`` with a JSON
 metadata entry (state class name + field names + key dtype impl).
+
+Crash contract (tests/test_crash_safety.py):
+
+* Writes are atomic — ``np.savez`` lands in ``path + ".tmp"`` and
+  ``os.replace`` publishes it, so a SIGKILL at any point leaves either
+  the previous complete checkpoint or the new one, never a torn file.
+  A kill BETWEEN the tmp write and the replace can strand the ``.tmp``
+  sibling; :func:`save_state` deletes a stale one before every write
+  and loads never look at it, so a stranded partial can neither grow
+  forever nor be mistaken for a checkpoint.
+* A checkpoint that is nonetheless unreadable (truncated by the
+  filesystem, wrong format, unknown state class) raises ``ValueError``
+  NAMING THE FILE from :func:`load_meta`/:func:`load_state` — never a
+  raw ``KeyError``/``zipfile`` traceback — so ``--resume`` can refuse
+  it with a one-line error.
+* Nemesis fault programs (ops/nemesis schedules riding ``step_args``)
+  are resume-safe: every round step indexes its schedule by the
+  ABSOLUTE ``state.round`` its state class carries — which the
+  checkpoint persists and the PRNG streams already key on — so the
+  schedule lookup ``tbl[min(r, T-1)]`` lines up across segments and
+  across kills; :func:`run_with_checkpoints` cross-checks its
+  ``base_round`` cursor against the state's own counter so a driver
+  that re-zeroed it cannot silently restart the fault program.
+  Resume == straight run bitwise even when the kill lands inside an
+  open partition window or mid-ramp (tools/crashloop.py is the live
+  SIGKILL harness).
 """
 
 from __future__ import annotations
@@ -22,6 +48,7 @@ import weakref
 from typing import Union
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from gossip_tpu.models.rumor import RumorState
@@ -65,47 +92,127 @@ def save_state(path: str, state: State, extra_meta=None) -> None:
     if extra_meta is not None:
         meta["extra"] = extra_meta
     tmp = path + ".tmp"
+    if os.path.exists(tmp):
+        # stale partial write: a crash between the tmp write below and
+        # os.replace strands the sibling; it is never a valid checkpoint
+        # (loads read ``path`` only) and must not survive forever
+        os.remove(tmp)
     with open(tmp, "wb") as f:
         np.savez(f, __meta__=json.dumps(meta), **arrays)
     os.replace(tmp, path)          # atomic: no torn checkpoints on crash
 
 
+def _open_npz(path: str):
+    """np.load with the crash contract: anything short of a readable
+    zip archive (a file truncated by the filesystem under a crash, a
+    non-npz imposter) is a ``ValueError`` naming the file, never a raw
+    ``zipfile``/``OSError`` traceback.  A missing file stays
+    ``FileNotFoundError`` — absent and corrupt are different failures
+    and the CLI messages differ."""
+    try:
+        return np.load(path, allow_pickle=False)
+    except FileNotFoundError:
+        raise
+    except Exception as e:
+        raise ValueError(
+            f"checkpoint {path} is not a readable .npz archive "
+            f"(truncated or corrupted — e.g. by a crash of the "
+            f"filesystem, not of the simulator: writes are atomic): "
+            f"{type(e).__name__}: {e}") from e
+
+
+def _meta_of(z, path: str) -> dict:
+    if "__meta__" not in getattr(z, "files", ()):
+        raise ValueError(
+            f"checkpoint {path} has no __meta__ entry — not a "
+            "gossip_tpu checkpoint (save_state writes one always)")
+    try:
+        return json.loads(str(z["__meta__"]))
+    except Exception as e:
+        raise ValueError(
+            f"checkpoint {path} has an unparseable __meta__ entry: "
+            f"{type(e).__name__}: {e}") from e
+
+
 def load_meta(path: str) -> dict:
     """The metadata entry of a checkpoint (incl. any ``extra_meta`` under
-    'extra') without loading the arrays."""
-    with np.load(path, allow_pickle=False) as z:
-        return json.loads(str(z["__meta__"]))
+    'extra') without loading the arrays.  Raises ``ValueError`` naming
+    the file when it is not a readable checkpoint (module crash
+    contract)."""
+    with _open_npz(path) as z:
+        return _meta_of(z, path)
 
 
 def load_state(path: str) -> State:
-    """Load a checkpoint written by :func:`save_state`."""
-    with np.load(path, allow_pickle=False) as z:
-        meta = json.loads(str(z["__meta__"]))
-        cls = _STATE_TYPES[meta["cls"]]
+    """Load a checkpoint written by :func:`save_state`.  Raises
+    ``ValueError`` naming the file on a truncated/invalid archive or an
+    unknown state class (module crash contract)."""
+    with _open_npz(path) as z:
+        meta = _meta_of(z, path)
+        cls = _STATE_TYPES.get(meta.get("cls"))
+        if cls is None:
+            raise ValueError(
+                f"checkpoint {path} carries unknown state class "
+                f"{meta.get('cls')!r} (known: "
+                f"{sorted(_STATE_TYPES)}) — written by an incompatible "
+                "version?")
+        # metadata keys first, with their own diagnosis — a foreign or
+        # incomplete metadata dict must not be misreported as a
+        # truncated ARRAY write by the member-read handler below
+        fields = meta.get("fields")
+        key_field = meta.get("key_field")
+        key_impl = meta.get("key_impl")
+        if fields is None or (key_field is not None and key_impl is None):
+            raise ValueError(
+                f"checkpoint {path} metadata is incomplete (needs "
+                "'fields' and, for a keyed state, 'key_impl') — "
+                "written by an incompatible version?")
         kwargs = {}
-        for name in meta["fields"]:
-            if name == meta["key_field"]:
-                # rewrap under the impl the checkpoint was SAVED with — the
-                # loading process may default to a different PRNG impl
-                # (e.g. rbg on TPU), which would silently change the
-                # resumed trajectory
-                kwargs[name] = jax.random.wrap_key_data(
-                    jax.numpy.asarray(z[name]), impl=meta["key_impl"])
-            else:
-                kwargs[name] = jax.numpy.asarray(z[name])
+        try:
+            for name in fields:
+                if name == key_field:
+                    # rewrap under the impl the checkpoint was SAVED with
+                    # — the loading process may default to a different
+                    # PRNG impl (e.g. rbg on TPU), which would silently
+                    # change the resumed trajectory
+                    kwargs[name] = jax.random.wrap_key_data(
+                        jax.numpy.asarray(z[name]), impl=key_impl)
+                else:
+                    kwargs[name] = jax.numpy.asarray(z[name])
+        except KeyError as e:
+            raise ValueError(
+                f"checkpoint {path} is missing array entry {e} named "
+                "by its own metadata — truncated write?") from e
+        except Exception as e:
+            # mid-archive corruption with an intact central directory
+            # (bad CRC, zlib error): np.load opened fine and __meta__
+            # parsed, but a member read blew up — still the crash
+            # contract's ValueError, never a raw zipfile/zlib traceback
+            raise ValueError(
+                f"checkpoint {path} has a corrupted array entry "
+                f"({type(e).__name__}: {e}) — damaged in place after "
+                "the atomic write?") from e
     return cls(**kwargs)
 
 
-# One jitted fori_loop runner per step function, so repeated
-# run_with_checkpoints calls (resume loops) reuse the executable.  Weak
-# keys: a dropped step closure (and the topology arrays it captures) must
-# not be pinned in memory by this cache.
+# One jitted fori_loop runner per (step function, lost-tracking mode),
+# so repeated run_with_checkpoints calls (resume loops) reuse the
+# executable.  Weak keys: a dropped step closure (and the topology
+# arrays it captures) must not be pinned in memory by this cache.
+# The loop counter is ignored by every body: round absoluteness lives
+# in ``state.round`` (each step advances and reads its own counter —
+# the module crash contract), so segment 7 of a resumed run re-enters
+# the executable segment 1 compiled with nothing to rebase.
 _segment_runners: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 _curve_runners: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
-def _segment_runner(step):
-    runner = _segment_runners.get(step)
+def _segment_runner(step, track_lost: bool = False):
+    per_step = _segment_runners.get(step)
+    if per_step is None:
+        per_step = {}
+        _segment_runners[step] = per_step
+    runner = per_step.get(track_lost)
     if runner is None:
         # the runner must NOT strongly capture ``step``: the cache value
         # referencing its own weak key would make eviction impossible and
@@ -115,22 +222,40 @@ def _segment_runner(step):
         # the step — is still alive.
         step_ref = weakref.ref(step)
 
-        @jax.jit
-        def runner(s, n_steps, *args):
-            return jax.lax.fori_loop(0, n_steps,
-                                     lambda _, st: step_ref()(st, *args), s)
-        _segment_runners[step] = runner
+        if track_lost:
+            # churn-path steps return (state, lost) — models/si.py
+            # contract; the destroyed-message count accumulates as one
+            # scalar carry so the cumulative ``dropped`` observable
+            # survives checkpoints (and hence kills) exactly
+            @jax.jit
+            def runner(s, n_steps, acc, *args):
+                def body(_, carry):
+                    st, a = carry
+                    st2, lo = step_ref()(st, *args)
+                    return st2, a + lo
+                return jax.lax.fori_loop(0, n_steps, body, (s, acc))
+        else:
+            @jax.jit
+            def runner(s, n_steps, *args):
+                return jax.lax.fori_loop(
+                    0, n_steps,
+                    lambda _, st: step_ref()(st, *args), s)
+        per_step[track_lost] = runner
     return runner
 
 
-def _curve_segment_runner(step, curve_fn):
+def _curve_segment_runner(step, curve_fn, track_lost: bool = False):
     """Segment runner that also records ``curve_fn(state)`` after every
     round, as one compiled ``lax.scan``.  Scan lengths are static, so a
     run compiles at most two executables per (step, curve_fn): the
     ``every``-long body and the tail.  Identical step sequence to the
     fori_loop runner — the bitwise-trajectory promise is unchanged."""
     per_step = _curve_runners.setdefault(step, weakref.WeakKeyDictionary())
-    runner = per_step.get(curve_fn)
+    variants = per_step.get(curve_fn)
+    if variants is None:
+        variants = {}
+        per_step[curve_fn] = variants
+    runner = variants.get(track_lost)
     if runner is None:
         import functools
 
@@ -139,20 +264,32 @@ def _curve_segment_runner(step, curve_fn):
         step_ref = weakref.ref(step)
         curve_ref = weakref.ref(curve_fn)
 
-        @functools.partial(jax.jit, static_argnums=1)
-        def runner(s, n_steps, *args):
-            def body(st, _):
-                st2 = step_ref()(st, *args)
-                return st2, curve_ref()(st2)
-            return jax.lax.scan(body, s, None, length=n_steps)
-        per_step[curve_fn] = runner
+        if track_lost:
+            @functools.partial(jax.jit, static_argnums=1)
+            def runner(s, n_steps, acc, *args):
+                def body(carry, _):
+                    st, a = carry
+                    st2, lo = step_ref()(st, *args)
+                    return (st2, a + lo), curve_ref()(st2)
+                return jax.lax.scan(body, (s, acc), None,
+                                    length=n_steps)
+        else:
+            @functools.partial(jax.jit, static_argnums=1)
+            def runner(s, n_steps, *args):
+                def body(st, _):
+                    st2 = step_ref()(st, *args)
+                    return st2, curve_ref()(st2)
+                return jax.lax.scan(body, s, None, length=n_steps)
+        variants[track_lost] = runner
     return runner
 
 
 def run_with_checkpoints(step, state: State, rounds: int, path: str,
                          every: int = 50, step_args=(),
                          extra_meta=None, curve_fn=None,
-                         curve_prefix=()):
+                         curve_prefix=(), base_round=None,
+                         track_lost: bool = False,
+                         lost_prefix: float = 0.0):
     """Drive ``step`` for ``rounds`` rounds, checkpointing every ``every``
     rounds (and at the end).  Resume by loading the file and calling again
     with the remaining round budget — long sweeps survive preemption.
@@ -181,30 +318,95 @@ def run_with_checkpoints(step, state: State, rounds: int, path: str,
     the dict form — so a resumed run continues it seamlessly (pass the
     saved value as ``curve_prefix``).  Returns ``state`` without
     ``curve_fn``, ``(state, curve)`` with it.
+
+    Fault programs (module crash contract): a nemesis schedule passed
+    through ``step_args`` (ops/nemesis.sched_args on the factory's
+    table tail) is indexed by the step's own ABSOLUTE ``state.round``
+    — which this checkpoint format persists — so a resume sees the
+    same lookups as a straight run with no rebasing.  ``base_round``
+    is the host-side round cursor: derived from ``state.round`` and
+    cross-checked against an explicit value, so a driver that rebuilt
+    its state with a re-zeroed counter (which would silently restart
+    the fault program while the trajectory continues) is refused; it
+    also stamps ``extra['round']``.  ``track_lost=True`` declares the
+    churn-step contract
+    (``step(state, *args) -> (state, lost)``): the runners accumulate
+    the per-round destroyed-message count on device and the cumulative
+    total persists in the checkpoint metadata under
+    ``extra['dropped']`` (seed a resume with the saved value via
+    ``lost_prefix`` — the nemesis ``dropped`` observable then matches
+    the uninterrupted run BITWISE across kills).  "Exact" here means
+    exactly the straight driver's number: the carry is the same
+    sequential f32 accumulation every in-loop nemesis total uses
+    (ops/nemesis.lost_count, the msgs counters), so like them it
+    inherits f32 integer range — totals beyond 2**24 round like any
+    other f32 protocol counter.  Every checkpoint's metadata also
+    records the absolute round cursor under ``extra['round']``.
     """
     if every < 1:
         raise ValueError(f"every must be >= 1, got {every}")
+    state_round = getattr(state, "round", None)
+    if state_round is not None:
+        sr = int(state_round)
+        if base_round is None:
+            base_round = sr
+        elif int(base_round) != sr:
+            # a driver that rebuilt its state with a re-zeroed round
+            # would silently restart the fault program from round 0
+            # while the trajectory continues — refuse before corrupting
+            raise ValueError(
+                f"base_round={base_round} disagrees with the state's "
+                f"own round counter {sr}; a resumed fault program must "
+                "continue at the absolute round the checkpoint stopped "
+                "at")
+    else:
+        base_round = 0 if base_round is None else int(base_round)
     curve = ({k: list(v) for k, v in curve_prefix.items()}
              if isinstance(curve_prefix, dict) else list(curve_prefix))
+    dropped = float(lost_prefix)
+    acc = jnp.float32(dropped) if track_lost else None
 
     def meta_now():
-        if curve_fn is None:
-            return extra_meta
         m = dict(extra_meta or {})
-        m["curve"] = curve
+        m["round"] = base_round + done
+        if track_lost:
+            m["dropped"] = dropped
+        if curve_fn is not None:
+            m["curve"] = curve
         return m
 
+    def flight_record():
+        # one ambient-ledger event per published checkpoint (fsync'd by
+        # the telemetry contract): a SIGKILLed run's ledger shows the
+        # exact round cursor — and under churn the exact destroyed-
+        # message total — of its last durable state, which is what the
+        # crashloop harness (tools/crashloop.py) stamps at every kill
+        from gossip_tpu.utils import telemetry
+        led = telemetry.current()
+        if led.active:
+            fields = {"path": path, "round": int(base_round + done)}
+            if track_lost:
+                fields["dropped"] = dropped
+            led.event("checkpoint", **fields)
+
     if curve_fn is None:
-        run_segment = _segment_runner(step)
+        run_segment = _segment_runner(step, track_lost)
     else:
-        run_segment = _curve_segment_runner(step, curve_fn)
+        run_segment = _curve_segment_runner(step, curve_fn, track_lost)
     done = 0
     while done < rounds:
         todo = min(every, rounds - done)
         if curve_fn is None:
-            state = run_segment(state, todo, *step_args)
+            if track_lost:
+                state, acc = run_segment(state, todo, acc, *step_args)
+            else:
+                state = run_segment(state, todo, *step_args)
         else:
-            state, seg = run_segment(state, todo, *step_args)
+            if track_lost:
+                (state, acc), seg = run_segment(state, todo, acc,
+                                                *step_args)
+            else:
+                state, seg = run_segment(state, todo, *step_args)
             if isinstance(seg, dict):
                 if not isinstance(curve, dict):
                     if curve:      # scalar prefix + dict curve_fn
@@ -225,7 +427,13 @@ def run_with_checkpoints(step, state: State, rounds: int, path: str,
                 curve.extend(float(x) for x in np.asarray(seg))
         done += todo
         jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+        if track_lost:
+            # one scalar sync per checkpoint (we already sync the state
+            # above); float64(float32) and its JSON repr round-trip
+            # exactly, so the resumed accumulator is the bitwise carry
+            dropped = float(acc)
         save_state(path, state, meta_now())
+        flight_record()
     if rounds <= 0:
         if curve_fn is not None and not isinstance(curve, dict) and not curve:
             # zero segments ran, so the dict-vs-scalar branch above never
@@ -238,6 +446,7 @@ def run_with_checkpoints(step, state: State, rounds: int, path: str,
             if isinstance(shape, dict):
                 curve = {k: [] for k in shape}
         save_state(path, state, meta_now())
+        flight_record()
     if curve_fn is None:
         return state
     return state, curve

@@ -230,6 +230,109 @@ def _swim_sharded(fault):
     return _digest(fr, fin.wire, fin.timer, np.float32(float(fin.msgs)))
 
 
+def _ckpt_path(name):
+    """A throwaway checkpoint path whose directory is removed at
+    process exit — the fingerprint runs must not litter the temp dir
+    with one npz per surface per run."""
+    import atexit
+    import shutil
+    import tempfile
+    d = tempfile.mkdtemp(prefix="ckpt_fp_")
+    atexit.register(shutil.rmtree, d, ignore_errors=True)
+    return os.path.join(d, name + ".npz")
+
+
+def _ckpt_si_static(fault):
+    from gossip_tpu import config as C
+    from gossip_tpu.config import ProtocolConfig
+    from gossip_tpu.models.si import coverage, make_si_round
+    from gossip_tpu.models.state import alive_mask, init_state
+    from gossip_tpu.topology import generators as G
+    from gossip_tpu.utils.checkpoint import run_with_checkpoints
+    proto = ProtocolConfig(mode=C.PUSH_PULL, fanout=2, rumors=2)
+    topo = G.complete(_N)
+    run = _run(8)
+    step, tables = make_si_round(proto, topo, fault, 0, tabled=True)
+
+    def curve_fn(s):
+        return coverage(s.seen, alive_mask(fault, _N, 0))
+
+    fin, curve = run_with_checkpoints(
+        step, init_state(run, proto, _N), 8, _ckpt_path("si"), every=3,
+        step_args=tables, curve_fn=curve_fn)
+    return _digest(fin.seen, np.float32(float(fin.msgs)),
+                   np.int32(int(fin.round)), np.float64(curve))
+
+
+def _ckpt_packed_static(fault):
+    from gossip_tpu import config as C
+    from gossip_tpu.config import ProtocolConfig
+    from gossip_tpu.parallel.sharded_packed import (
+        checkpointed_packed_sharded)
+    from gossip_tpu.topology import generators as G
+    proto = ProtocolConfig(mode=C.PULL, fanout=1, rumors=3)
+    fin, cov, curve = checkpointed_packed_sharded(
+        proto, G.complete(_N), _run(8), _mesh(), _ckpt_path("packed"),
+        every=3, fault=fault, want_curve=True)
+    return _digest(fin.seen, np.float32(float(fin.msgs)),
+                   np.float64(cov), np.float64(curve))
+
+
+def _ckpt_rumor_static(fault):
+    from gossip_tpu import config as C
+    from gossip_tpu.config import ProtocolConfig
+    from gossip_tpu.models.rumor import checkpointed_rumor
+    from gossip_tpu.topology import generators as G
+    proto = ProtocolConfig(mode=C.RUMOR, fanout=2, rumors=2, rumor_k=3)
+    fin, cov, residue, curve = checkpointed_rumor(
+        proto, G.complete(_N), _run(8), _ckpt_path("rumor"), every=3,
+        fault=fault, want_curve=True)
+    return _digest(fin.seen, fin.hot, fin.cnt,
+                   np.float32(float(fin.msgs)), np.float64(cov),
+                   np.float64(curve["coverage"]),
+                   np.float64(curve["hot"]))
+
+
+def _ckpt_swim_static(fault):
+    from gossip_tpu import config as C
+    from gossip_tpu.config import ProtocolConfig
+    from gossip_tpu.runtime.simulator import checkpointed_swim
+    proto = ProtocolConfig(mode=C.SWIM, fanout=2, swim_subjects=8,
+                           swim_proxies=2, swim_suspect_rounds=4)
+    fin, det, curve = checkpointed_swim(
+        proto, _N, _run(10), _ckpt_path("swim"), every=4,
+        dead_nodes=(5,), fail_round=2, fault=fault, want_curve=True)
+    return _digest(fin.wire, fin.timer, np.float32(float(fin.msgs)),
+                   np.float64(det), np.float64(curve))
+
+
+def _ckpt_fused_static(fault):
+    from gossip_tpu.config import RunConfig
+    from gossip_tpu.parallel.sharded_fused import (
+        checkpointed_fused_planes, make_plane_mesh)
+    fin, cov, curve = checkpointed_fused_planes(
+        _N, 2, RunConfig(seed=0, max_rounds=8), make_plane_mesh(2),
+        _ckpt_path("fused"), every=3, interpret=True, fault=fault,
+        want_curve=True)
+    return _digest(fin.table, np.float32(float(fin.msgs)),
+                   np.float64(cov), np.float64(curve))
+
+
+# The no-churn checkpointed drivers, digested straight through their
+# public entry points (PR 7): lifting the nemesis rejection off the
+# checkpointed segment drivers must leave every EXISTING checkpointed
+# trajectory — state, message accounting, curve capture — bitwise
+# untouched.  Captured from the pre-lift tree (git HEAD at PR 7 start),
+# appended to the same data file under "ckpt-static:*" keys.
+CHECKPOINTED_SURFACES = {
+    "ckpt_si": _ckpt_si_static,
+    "ckpt_packed": _ckpt_packed_static,
+    "ckpt_rumor": _ckpt_rumor_static,
+    "ckpt_swim": _ckpt_swim_static,
+    "ckpt_fused": _ckpt_fused_static,
+}
+
+
 # name -> (runner, fault builder).  SWIM takes its events-only schedule
 # (ramps were rejected at capture time); every other churn surface runs
 # the full events + partition + ramp program.

@@ -192,6 +192,10 @@ _CFAULT = FaultConfig(node_death_rate=0.1, drop_prob=0.05, seed=1,
                       churn=_CHURN)
 
 
+# depth tier (tier-1 wall budget, PR 7 rebalance): churn mesh-
+# invariance stays in-gate via the traced-operand fingerprint subset
+# (sharded churn surfaces); this exhaustive twin runs under -m slow
+@pytest.mark.slow
 def test_churn_parity_single_vs_sharded_dense():
     """The full schedule (events + window + ramp) stacked on static
     faults: bitwise-identical trajectory at 1 and 4 devices — the
@@ -282,6 +286,10 @@ def test_fault_mask_cross_mesh_determinism():
 
 # -- seed ensembles under churn (sweep.py) ----------------------------
 
+# depth tier (tier-1 wall budget, PR 7 rebalance): base ensemble-vs-
+# solo parity stays in-gate (tests/test_sweep.py); the churn-schedule
+# ensemble twin runs under -m slow
+@pytest.mark.slow
 def test_ensemble_churn_matches_solo_curves():
     """ensemble_curves under the full schedule: each seed's batched
     trajectory equals the solo simulate_curve run — the drop_lost
@@ -393,20 +401,31 @@ def test_unsupported_engines_reject_loudly():
         simulate_until_sharded_fused(
             128 * 8, 40, RunConfig(seed=0, max_rounds=2),
             make_plane_mesh(4), interpret=True, fault=ramp)
-    # checkpointed drivers: no churn (the segment contract)
-    from gossip_tpu.models.rumor import checkpointed_rumor
-    with pytest.raises(ValueError, match="churn"):
-        checkpointed_rumor(
-            ProtocolConfig(mode=C.RUMOR, fanout=2, rumors=1),
-            G.complete(64), RunConfig(seed=0, max_rounds=4),
-            "/tmp/never-written.npz", fault=ev)
+    # checkpointed drivers came OFF the rejection list (crash-safety
+    # PR): churn runs in the segments with bitwise resume
+    # (tests/test_crash_safety.py pins every surface); only the engines
+    # above remain on events=False
     # the fused ENGINE routing sends churn back to the XLA kernels
+    # (its single-device paths predate the churn denominator) — EXCEPT
+    # the plane-stack checkpointed route, which runs events and
+    # refuses partitions/ramps with the genuinely-impossible reason
     from gossip_tpu.backend import _fused_ineligible_reason
     from gossip_tpu.config import TopologyConfig
-    reason = _fused_ineligible_reason(
-        ProtocolConfig(mode=C.PULL, fanout=1, rumors=1),
-        TopologyConfig(family="complete", n=64), ev, 1)
+    fproto = ProtocolConfig(mode=C.PULL, fanout=1, rumors=1)
+    ftc = TopologyConfig(family="complete", n=64)
+    reason = _fused_ineligible_reason(fproto, ftc, ev, 1)
     assert reason and "churn" in reason
+    # events pass the plane-stack churn gate: any remaining reason is
+    # a later precondition (on CPU, the platform probe), never churn
+    reason = _fused_ineligible_reason(fproto, ftc, ev, 1,
+                                      plane_stack=True)
+    assert reason is None or "churn" not in reason
+    reason = _fused_ineligible_reason(fproto, ftc, part, 1,
+                                      plane_stack=True)
+    assert reason and "partition" in reason
+    reason = _fused_ineligible_reason(fproto, ftc, ramp, 1,
+                                      plane_stack=True)
+    assert reason and "ramp" in reason
 
 
 # -- SWIM churn timeline ----------------------------------------------
@@ -441,6 +460,11 @@ def test_swim_churn_confirms_crash_never_recovered_node():
                           np.asarray(fin2.wire)[:n])
 
 
+# depth tier (tier-1 wall budget, PR 7 rebalance): the churn-only SWIM
+# scenario keeps in-gate coverage via test_swim_honors_drop_ramp and
+# the crash-safety pin (detection 1.0 on a scheduled crash across a
+# kill); the full scenario-semantics check runs under -m slow
+@pytest.mark.slow
 def test_swim_churn_only_scenario_targets_churn_deaths():
     """A churn-only SWIM run is a SCRIPTED scenario: no default static
     death is injected on top of the schedule, the detection metric
@@ -694,6 +718,11 @@ def test_dense_sharded_k_scenarios_compile_once(assert_compiles):
             assert covs.shape == (4,)
 
 
+# depth tier (tier-1 wall budget, PR 7 rebalance): churn_sweep keeps
+# in-gate coverage via the dry-run churn_sweep family budgets + the
+# compile-count pin; the K-scenario bitwise solo-parity sweep runs
+# under -m slow
+@pytest.mark.slow
 def test_churn_sweep_matches_solo_bitwise():
     """Scenario-batched sweep (sweep.churn_sweep_curves): each
     scenario's curve/msgs equal the solo simulate_curve run BITWISE

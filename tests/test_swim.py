@@ -408,6 +408,10 @@ def test_sharded_swim_detects_on_powerlaw():
     assert frac > 0.95
 
 
+# depth tier (tier-1 wall budget, PR 7 rebalance): the until driver
+# keeps in-gate smokes (backend/CLI runs + detects_on_powerlaw); the
+# until-vs-curve round-for-round cross-check runs under -m slow
+@pytest.mark.slow
 def test_swim_until_driver_matches_curve_rounds():
     """The early-exit while_loop driver stops at exactly the round the
     scan driver's curve first hits the target, single-device and

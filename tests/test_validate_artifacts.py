@@ -121,3 +121,23 @@ def test_round_metrics_artifacts_must_be_attributable(tmp_path):
         led.event("round_metrics", driver="x", rounds=2,
                   totals={"msgs": 4.0})
     assert va.validate_file(str(good)) == []
+
+
+def test_crashloop_artifacts_must_be_attributable(tmp_path):
+    """A ``*crashloop*`` artifact without provenance fails — the
+    SIGKILL/resume record (tools/crashloop.py) is robustness evidence
+    and can never be grandfathered, jsonl or json alike."""
+    bad = tmp_path / "ledger_crashloop_r99.jsonl"
+    bad.write_text(json.dumps({"ev": "verdict", "ok": True}) + "\n")
+    problems = va.validate_file(str(bad))
+    assert any("provenance" in p for p in problems), problems
+
+    badj = tmp_path / "crashloop_summary_r99.json"
+    badj.write_text(json.dumps({"ok": True}))
+    problems = va.validate_file(str(badj))
+    assert any("provenance" in p for p in problems), problems
+
+    good = tmp_path / "ledger_crashloop_r98.jsonl"
+    with telemetry.Ledger(str(good)) as led:
+        led.event("verdict", ok=True, kills=3)
+    assert va.validate_file(str(good)) == []
