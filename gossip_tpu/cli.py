@@ -995,6 +995,95 @@ def cmd_churn_sweep(a) -> int:
     return 0
 
 
+def _parse_crdt_injections(a):
+    """--add NODE:ROUND:AMOUNT / --set-add ELEM:ROUND / --set-remove
+    ELEM:ROUND -> CrdtConfig kwargs (field validation lives in
+    CrdtConfig itself — this only parses the colon syntax, the
+    _parse_churn discipline)."""
+    def parts(s, what, arity):
+        p = s.split(":")
+        if len(p) != arity:
+            raise ValueError(f"--{what} takes {arity} colon-separated "
+                             f"fields, got {s!r}")
+        return tuple(int(x) for x in p)
+
+    return dict(
+        adds=tuple(parts(s, "add", 3) for s in (a.add or ())),
+        set_adds=tuple(parts(s, "set-add", 2)
+                       for s in (a.set_add or ())),
+        set_removes=tuple(parts(s, "set-remove", 2)
+                          for s in (a.set_remove or ())))
+
+
+def cmd_crdt(a) -> int:
+    """CRDT gossip run: a commutative-merge payload (Gossip Glomers
+    counter/set workloads) on the pull exchange fabric, value
+    convergence judged integer-exact against the ground-truth merge on
+    the eventual-alive set (docs/WORKLOADS.md)."""
+    from gossip_tpu.config import CrdtConfig
+    from gossip_tpu.topology import generators as G
+    cfg = CrdtConfig(kind=a.type, elements=a.elements,
+                     **_parse_crdt_injections(a))
+    proto = ProtocolConfig(mode="pull", fanout=a.fanout)
+    tc = TopologyConfig(family=a.family, n=a.n, k=a.k, p=a.p,
+                        seed=a.seed)
+    run = RunConfig(target_coverage=a.target, max_rounds=a.max_rounds,
+                    seed=a.seed, origin=a.origin)
+    churn = _parse_churn(a)
+    fault = None
+    if a.drop > 0 or a.death > 0 or churn is not None:
+        fault = FaultConfig(node_death_rate=a.death, drop_prob=a.drop,
+                            seed=a.seed, churn=churn)
+    topo = G.build(tc)
+    want_curve = a.curve or bool(a.save_curve)
+    import time as _time
+    t0 = _time.perf_counter()
+    if a.devices > 1:
+        from gossip_tpu.parallel.sharded import make_mesh
+        from gossip_tpu.parallel.sharded_crdt import (
+            simulate_curve_crdt_sharded, simulate_until_crdt_sharded)
+        mesh = make_mesh(a.devices)
+        if want_curve:
+            conv, msgs, final, truth = simulate_curve_crdt_sharded(
+                cfg, proto, topo, run, mesh, fault)
+        else:
+            rounds, vc, msgs_f, final, truth = (
+                simulate_until_crdt_sharded(cfg, proto, topo, run,
+                                            mesh, fault))
+        engine = "crdt-sharded"
+    else:
+        from gossip_tpu.models.crdt import (simulate_curve_crdt,
+                                            simulate_until_crdt)
+        if want_curve:
+            conv, msgs, final, truth = simulate_curve_crdt(
+                cfg, proto, topo, run, fault)
+        else:
+            rounds, vc, msgs_f, final, truth = simulate_until_crdt(
+                cfg, proto, topo, run, fault)
+        engine = "crdt-xla"
+    wall = _time.perf_counter() - t0
+    if want_curve:
+        hit = [i for i, c in enumerate(conv) if c >= a.target]
+        rounds = (hit[0] + 1) if hit else -1
+        vc, msgs_f = float(conv[-1]), float(msgs[-1])
+    out = {"backend": "jax-tpu", "mode": "crdt", "type": a.type,
+           "n": a.n, "rounds": rounds, "value_conv": vc,
+           "converged": vc >= a.target, "truth_value": truth,
+           "msgs": msgs_f, "wall_s": round(wall, 4),
+           "devices": a.devices, "engine": engine,
+           "compile_cache": _cache_stamp(a)}
+    if churn is not None:
+        out["fault_program"] = True
+    if a.save_curve:
+        from gossip_tpu.utils.metrics import dump_curve_jsonl
+        dump_curve_jsonl(a.save_curve, [float(c) for c in conv],
+                         meta=dict(out))
+    if a.curve:
+        out["curve"] = [float(c) for c in conv]
+    print(json.dumps(out))
+    return 0
+
+
 def cmd_serve(a) -> int:
     from gossip_tpu.rpc.sidecar import serve
     server, port = serve(a.port, a.workers)
@@ -1005,23 +1094,42 @@ def cmd_serve(a) -> int:
 
 def cmd_maelstrom(a) -> int:
     from gossip_tpu.runtime.maelstrom_node import main as node_main
-    node_main(["--gossip-interval", str(a.gossip_interval)])
+    node_main(["--gossip-interval", str(a.gossip_interval),
+               "--workload", a.workload])
     return 0
 
 
-def _node_argv(gossip_interval: float):
+def _node_argv(gossip_interval: float, workload: str = "broadcast"):
     """Node command for the harnesses; None keeps their default (the
-    immediate-relay node) so the reference-shaped path stays the
-    default."""
-    if gossip_interval <= 0:
+    immediate-relay broadcast node) so the reference-shaped path stays
+    the default."""
+    if gossip_interval <= 0 and workload == "broadcast":
         return None
-    return [sys.executable, "-u", "-m", "gossip_tpu.runtime.maelstrom_node",
-            "--gossip-interval", str(gossip_interval)]
+    argv = [sys.executable, "-u", "-m",
+            "gossip_tpu.runtime.maelstrom_node",
+            "--workload", workload]
+    if gossip_interval > 0:
+        argv += ["--gossip-interval", str(gossip_interval)]
+    return argv
 
 
 def cmd_maelstrom_check(a) -> int:
-    argv = _node_argv(a.gossip_interval)
-    if a.router == "native":
+    argv = _node_argv(a.gossip_interval, a.workload)
+    if a.workload == "counter":
+        if a.router == "native":
+            print("error: the counter workload runs on the python "
+                  "router (the C++ router speaks the broadcast "
+                  "envelope set only)", file=sys.stderr)
+            return 2
+        import asyncio
+
+        from gossip_tpu.runtime.maelstrom_harness import (
+            run_counter_workload)
+        stats = asyncio.run(run_counter_workload(
+            a.n, a.ops, rate=a.rate, latency=a.latency,
+            topology=a.topology, partition_mid=a.partition, seed=a.seed,
+            argv=argv))
+    elif a.router == "native":
         from gossip_tpu.runtime.native_router import run_native_workload
         stats = run_native_workload(
             a.n, a.ops, rate=a.rate, latency=a.latency,
@@ -1036,6 +1144,7 @@ def cmd_maelstrom_check(a) -> int:
             a.n, a.ops, rate=a.rate, latency=a.latency,
             topology=a.topology, partition_mid=a.partition, seed=a.seed,
             argv=argv))
+    stats["workload"] = a.workload
     stats["gossip_interval"] = a.gossip_interval
     ok = stats["invariant_ok"]
     if a.assert_msgs_per_op is not None:
@@ -1172,6 +1281,71 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_cache_flags(p)
     p.set_defaults(fn=cmd_churn_sweep)
 
+    p = sub.add_parser("crdt",
+                       help="run a commutative-merge CRDT payload "
+                            "(Gossip Glomers counter/set workloads) on "
+                            "the pull exchange fabric with optional "
+                            "nemesis fault programs; value convergence "
+                            "is integer-exact against the ground-truth "
+                            "merge on the eventual-alive set")
+    p.add_argument("--type", default="gcounter",
+                   choices=("gcounter", "pncounter", "gset", "orset"),
+                   help="payload kind (ops/crdt.py): grow-only / PN "
+                        "counter shards (merge = per-column max) or "
+                        "packed set bit-planes (merge = OR)")
+    p.add_argument("--n", type=int, default=1024)
+    p.add_argument("--fanout", type=int, default=2)
+    p.add_argument("--family", default="complete",
+                   choices=("complete", "ring", "grid", "erdos_renyi",
+                            "watts_strogatz", "power_law"))
+    p.add_argument("--k", type=int, default=4)
+    p.add_argument("--p", type=float, default=0.01)
+    p.add_argument("--target", type=float, default=1.0,
+                   help="value-convergence target (default 1.0: EVERY "
+                        "eventual-alive node equals the ground truth "
+                        "exactly — the Gossip Glomers invariant)")
+    p.add_argument("--max-rounds", type=int, default=64)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--origin", type=int, default=0,
+                   help="set-element owner rotation origin (element e "
+                        "injects at node (origin + e) %% n)")
+    p.add_argument("--devices", type=int, default=1,
+                   help="node-dim mesh size (sharded pull exchange)")
+    p.add_argument("--drop", type=float, default=0.0)
+    p.add_argument("--death", type=float, default=0.0)
+    p.add_argument("--add", action="append", default=None,
+                   metavar="NODE:ROUND:AMOUNT",
+                   help="scripted counter add (repeatable; negative "
+                        "amounts decrement a pncounter; default "
+                        "program: node j adds 1 + j%%7 at round 0)")
+    p.add_argument("--set-add", action="append", default=None,
+                   metavar="ELEM:ROUND",
+                   help="scripted set add at the element's owner node "
+                        "(repeatable; default: every element at "
+                        "round 0)")
+    p.add_argument("--set-remove", action="append", default=None,
+                   metavar="ELEM:ROUND",
+                   help="scripted orset remove (tombstone; repeatable)")
+    p.add_argument("--elements", type=int, default=64,
+                   help="set element universe size E (packed to "
+                        "ceil(E/32) uint32 words per plane)")
+    p.add_argument("--churn-event", action="append", default=None,
+                   metavar="NODE:DIE[:REC]",
+                   help="nemesis crash/recover churn (the run "
+                        "command's syntax; repeatable)")
+    p.add_argument("--partition", action="append", default=None,
+                   metavar="START:END:CUT",
+                   help="nemesis partition window (repeatable)")
+    p.add_argument("--drop-ramp", default=None,
+                   metavar="START:END:P0:P1",
+                   help="nemesis drop-rate ramp")
+    p.add_argument("--curve", action="store_true",
+                   help="include the per-round value-convergence curve")
+    p.add_argument("--save-curve", default=None, metavar="PATH",
+                   help="write the value-convergence curve as JSONL")
+    _add_cache_flags(p)
+    p.set_defaults(fn=cmd_crdt)
+
     p = sub.add_parser("serve", help="start the gRPC sidecar")
     p.add_argument("--port", type=int, default=50051)
     p.add_argument("--workers", type=int, default=4)
@@ -1183,6 +1357,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--gossip-interval", type=float, default=0.0,
                    help="batch relays per neighbor every INTERVAL "
                         "seconds (0 = immediate per-message fan-out)")
+    p.add_argument("--workload", default="broadcast",
+                   choices=("broadcast", "counter"),
+                   help="node personality: broadcast log (the "
+                        "reference) or Gossip Glomers counter (CRDT "
+                        "shards, merge = per-key max)")
     p.set_defaults(fn=cmd_maelstrom)
 
     p = sub.add_parser("maelstrom-check",
@@ -1206,6 +1385,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="harness engine: the asyncio router or the C++ "
                         "poll()-loop router (native/router.cpp, built on "
                         "demand)")
+    p.add_argument("--workload", default="broadcast",
+                   choices=("broadcast", "counter"),
+                   help="broadcast (every value in every read) or the "
+                        "Gossip Glomers counter (every node's final "
+                        "read == the sum of acked adds, through a "
+                        "--partition)")
     p.add_argument("--gossip-interval", type=float, default=0.0,
                    help="run the nodes with interval-batched relays "
                         "(seconds; 0 = the reference's immediate "
@@ -1222,7 +1407,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     a = ap.parse_args(argv)
     try:
-        if a.cmd in ("run", "sweep", "grid", "churn-sweep", "serve"):
+        if a.cmd in ("run", "sweep", "grid", "churn-sweep", "crdt",
+                     "serve"):
             # multi-host pods: one jax.distributed.initialize() per host
             # before any jax API (no-op without the coordinator env vars)
             from gossip_tpu.parallel.multislice import maybe_init_distributed

@@ -303,6 +303,150 @@ class ChurnConfig:
         return max(ends) + 1
 
 
+# CRDT payload kinds (ops/crdt.py).  The Gossip Glomers sibling
+# workloads of the reference's broadcast: same epidemic exchange, a
+# commutative-merge payload instead of the infected bit.
+GCOUNTER = "gcounter"      # grow-only counter: per-node shards, merge=max
+PNCOUNTER = "pncounter"    # inc/dec counter: P and N shard planes
+GSET = "gset"              # grow-only set: packed add bit-planes, merge=OR
+ORSET = "orset"            # add/remove set: add + tombstone planes, merge=OR
+VCLOCK = "vclock"          # per-node vector clocks, merge=elementwise max
+
+CRDT_KINDS = (GCOUNTER, PNCOUNTER, GSET, ORSET, VCLOCK)
+CRDT_COUNTER_KINDS = (GCOUNTER, PNCOUNTER)
+CRDT_SET_KINDS = (GSET, ORSET)
+
+
+@dataclasses.dataclass(frozen=True)
+class CrdtConfig:
+    """A commutative-merge payload workload (ops/crdt.py, models/crdt.py).
+
+    The injections are a *program over rounds*, exactly like the nemesis
+    schedule: counter ``adds`` are ``(node, round, amount)`` triples
+    (node adds ``amount`` to its own shard at ``round``; for
+    ``pncounter`` a negative amount lands in the N plane, for
+    ``gcounter`` amounts must be positive), set ``set_adds`` /
+    ``set_removes`` are ``(element, round)`` pairs injected at the
+    element's owner node ``(origin + element) % n`` (the rumor-origin
+    convention).  Empty ``adds`` on a counter kind means the default
+    program: node ``j`` adds ``1 + j % 7`` at round 0 (closed form, so
+    no O(N) config is ever materialized); empty ``set_adds`` means
+    every element is added at round 0 at its owner.
+
+    Ground truth is the merge of all *applied* injections — an
+    injection is applied iff its owner is alive at the injection round
+    AND eventually alive under the fault program (ops/crdt.ground
+    truth doc: the batched analog of the Maelstrom counter checker
+    counting only ACKED adds — a node destined for permanent death
+    contributes nothing, which is what makes exact value convergence
+    on the eventual-alive set a guaranteed invariant).
+    """
+
+    kind: str = GCOUNTER
+    adds: Tuple[Tuple[int, int, int], ...] = ()
+    set_adds: Tuple[Tuple[int, int], ...] = ()
+    set_removes: Tuple[Tuple[int, int], ...] = ()
+    elements: int = 64          # set element universe E (W = ceil(E/32))
+
+    def __post_init__(self):
+        object.__setattr__(self, "adds", tuple(
+            tuple(int(x) for x in a) for a in self.adds))
+        object.__setattr__(self, "set_adds", tuple(
+            tuple(int(x) for x in a) for a in self.set_adds))
+        object.__setattr__(self, "set_removes", tuple(
+            tuple(int(x) for x in a) for a in self.set_removes))
+        if self.kind not in CRDT_KINDS:
+            raise ValueError(f"unknown CRDT kind {self.kind!r}; choose "
+                             f"from {CRDT_KINDS}")
+        if self.elements < 1:
+            raise ValueError("elements must be >= 1")
+        if self.kind in CRDT_SET_KINDS:
+            if self.adds:
+                raise ValueError(f"{self.kind} takes set_adds/"
+                                 "set_removes, not counter adds")
+        else:
+            if self.set_adds or self.set_removes:
+                raise ValueError(f"{self.kind} takes counter adds, not "
+                                 "set_adds/set_removes")
+        if self.kind == VCLOCK and self.adds:
+            # vclock carries no injection program at all (owner ticks
+            # only) — silently dropping a scripted one would violate
+            # the reject-loudly policy every other kind mismatch obeys
+            raise ValueError("vclock takes no injection program (the "
+                             "owner tick is the only local event); "
+                             "drop the adds")
+        if self.kind == GSET and self.set_removes:
+            raise ValueError("gset is grow-only; removes need kind="
+                             "'orset'")
+        for a in self.adds:
+            if len(a) != 3:
+                raise ValueError(f"counter add {a} must be "
+                                 "(node, round, amount)")
+            node, rnd, amt = a
+            if node < 0:
+                raise ValueError(f"add node {node} must be >= 0")
+            if rnd < 0 or rnd > MAX_CHURN_HORIZON:
+                raise ValueError(
+                    f"add round {rnd} outside [0, {MAX_CHURN_HORIZON}] "
+                    "(the schedule horizon cap, shared with ChurnConfig)")
+            if self.kind == GCOUNTER and amt <= 0:
+                raise ValueError(
+                    f"gcounter add {a}: amounts must be positive "
+                    "(grow-only; use pncounter for decrements)")
+            if self.kind == PNCOUNTER and amt == 0:
+                raise ValueError(f"pncounter add {a}: amount must be "
+                                 "nonzero")
+        for name, pairs in (("set_add", self.set_adds),
+                            ("set_remove", self.set_removes)):
+            for p in pairs:
+                if len(p) != 2:
+                    raise ValueError(f"{name} {p} must be "
+                                     "(element, round)")
+                elem, rnd = p
+                if not 0 <= elem < self.elements:
+                    raise ValueError(
+                        f"{name} element {elem} outside the universe "
+                        f"[0, {self.elements})")
+                if rnd < 0 or rnd > MAX_CHURN_HORIZON:
+                    raise ValueError(
+                        f"{name} round {rnd} outside "
+                        f"[0, {MAX_CHURN_HORIZON}]")
+        seen_elems = [e for e, _ in self.set_adds]
+        if len(set(seen_elems)) != len(seen_elems):
+            raise ValueError("set_adds must script each element at most "
+                             "once (the packed-plane OR-set models one "
+                             "unique add tag per element — "
+                             "docs/WORKLOADS.md)")
+        seen_rems = [e for e, _ in self.set_removes]
+        if len(set(seen_rems)) != len(seen_rems):
+            raise ValueError("set_removes must script each element at "
+                             "most once")
+        # A remove at-or-before its element's add would make the
+        # packed tombstone plane remove-wins where the documented
+        # contract is add-wins == 2P (the remove must happen-after the
+        # observed add tag) — reject the silent semantic fork.  An
+        # unscripted add means the default program's round 0; a remove
+        # of a never-added element is a harmless no-op and allowed.
+        add_round = {e: r for e, r in self.set_adds}
+        for e, rr in self.set_removes:
+            ra = add_round.get(e, 0 if not self.set_adds else None)
+            if ra is not None and rr <= ra:
+                raise ValueError(
+                    f"set_remove ({e}, {rr}) fires at or before the "
+                    f"element's add (round {ra}): a remove must "
+                    "happen-after the add it tombstones, or add-wins "
+                    "and 2P semantics diverge (docs/WORKLOADS.md)")
+
+    def horizon(self) -> int:
+        """Rounds after which no further injection fires (the zero-row
+        steady state of the lowered injection tables)."""
+        rounds = [0]
+        rounds += [r for _, r, _ in self.adds]
+        rounds += [r for _, r in self.set_adds]
+        rounds += [r for _, r in self.set_removes]
+        return max(rounds) + 1
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultConfig:
     """In-kernel fault injection.

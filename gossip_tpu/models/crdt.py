@@ -1,0 +1,299 @@
+"""CRDT gossip rounds: the exchange fabric with a commutative-merge
+payload.
+
+The payload replaces the infected bit; the gossip mechanics — peer
+sampling streams, drop coins, partition cuts, churn liveness — are the
+EXISTING fabric, untouched: the step below is models/si_packed
+.make_packed_round with ``uint32 | ``/`` max`` merge in place of the
+bool OR and the injection program applied before the exchange.  Pull
+only, by design: state-based CRDT dissemination IS the pull/digest
+exchange (each round a node fetches k peers' full states and joins
+them — Shapiro et al. §3.2 state-based replication), and the push half
+would need a scatter-max/scatter-OR collective XLA does not have —
+exactly the reason models/si_packed.py rejects push modes.
+
+Semantics under a nemesis schedule (docs/WORKLOADS.md):
+
+  * a churn-down node neither serves pulls, requests, nor receives —
+    but its state PERSISTS across downtime (the durable-store
+    convention of the rumor kernels' ``seen``), so a recovered node
+    re-disseminates everything it ever merged;
+  * an injection fires iff its owner is alive at the injection round
+    and eventually alive (ops/crdt module doc — the acked-adds
+    semantics), which makes exact convergence to
+    :func:`~gossip_tpu.ops.crdt.ground_truth` on the eventual-alive
+    set a guaranteed invariant under any fault program;
+  * value convergence is judged INTEGER-exact: the drivers move a
+    converged-node COUNT off device and divide by the eventual-alive
+    total once on the host (the bitwise-curve convention).
+
+Schedules AND injections ride the step's ``tables`` tuple as runtime
+operands (ops/nemesis + ops/crdt.inject_args), so one compiled loop
+serves a whole scenario family.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from gossip_tpu import config as C
+from gossip_tpu.config import (CrdtConfig, FaultConfig, ProtocolConfig,
+                               RunConfig)
+from gossip_tpu.models import si as si_mod
+from gossip_tpu.models.state import alive_mask, bind_tables
+from gossip_tpu.ops import crdt as CR
+from gossip_tpu.ops.sampling import apply_drop, sample_peers
+from gossip_tpu.topology.generators import Topology
+
+
+class CrdtState(NamedTuple):
+    """Carried through ``lax.scan`` / ``lax.while_loop`` rounds — the
+    CRDT twin of models/state.SimState (NamedTuple == registered
+    pytree).  ``val`` is ``int32[N, S]`` counter shards or
+    ``uint32[N, 2W]`` packed set planes (ops/crdt layout)."""
+
+    val: jax.Array
+    round: jax.Array
+    base_key: jax.Array
+    msgs: jax.Array
+
+
+def init_crdt_state(run: RunConfig, cfg: CrdtConfig, n: int) -> CrdtState:
+    """All-zero state: injections are applied IN the round loop at
+    their scripted rounds (a round-0 add lands in the first step,
+    before its exchange), so resume-from-checkpoint and scripted-add
+    programs index the same absolute clock as the nemesis schedule."""
+    return CrdtState(
+        val=jnp.zeros((n, CR.state_width(cfg, n)), CR.state_dtype(cfg)),
+        round=jnp.int32(0),
+        base_key=jax.random.key(run.seed),
+        msgs=jnp.float32(0.0),
+    )
+
+
+def check_injections_reachable(cfg: CrdtConfig, run: RunConfig) -> None:
+    """Every scripted injection must fire inside the run: an add at a
+    round >= max_rounds would be counted by ground_truth (the owner IS
+    alive there) but never applied by the loop, so the run could never
+    converge — reported as a quiet converged:false instead of the loud
+    error the no-silent-failure policy demands.  Called by every
+    driver (the factories do not see RunConfig)."""
+    last = cfg.horizon() - 1
+    if last >= run.max_rounds:
+        raise ValueError(
+            f"injection at round {last} can never fire: the run stops "
+            f"after max_rounds={run.max_rounds} rounds, so ground "
+            "truth would be unreachable by construction — raise "
+            "--max-rounds past the last scripted round")
+
+
+def check_crdt_mode(proto: ProtocolConfig) -> None:
+    """Pull only (module doc) — one loud reason, shared by every
+    driver and the CLI."""
+    if proto.mode != C.PULL:
+        raise ValueError(
+            "CRDT rounds run the pull exchange only (state-based merge "
+            f"IS the digest pull; got mode {proto.mode!r} — the push "
+            "half would need a scatter-max/scatter-OR collective XLA "
+            "does not have, the models/si_packed.py precedent)")
+
+
+def make_crdt_round(cfg: CrdtConfig, proto: ProtocolConfig,
+                    topo: Topology, fault: Optional[FaultConfig] = None,
+                    origin: int = 0, tabled: bool = False):
+    """Single-device CRDT round step; the sharded twin lives in
+    parallel/sharded_crdt.py and must stay bitwise identical (pinned
+    in tests/test_crdt.py).  Returns ``step: CrdtState -> CrdtState``
+    (or ``(state, lost)`` on the churn path — the models/si.py
+    contract); ``tabled=True`` returns ``(step, tables)`` with
+    topology + injection (+ schedule) arrays as step ARGUMENTS."""
+    check_crdt_mode(proto)
+    n, k = topo.n, proto.fanout
+    if cfg.kind == C.VCLOCK:
+        raise ValueError("vclock has no exchange driver (merge kernel "
+                         "+ tick only — ops/crdt); run gcounter/"
+                         "pncounter/gset/orset")
+    drop_prob = 0.0 if fault is None else fault.drop_prob
+    tables = () if topo.implicit else (topo.nbrs, topo.deg)
+    from gossip_tpu.ops import nemesis as NE
+    ch = NE.get(fault)
+    # capability row: the CRDT pull exchange rides the dense/packed
+    # fabric and honors the FULL schedule feature set — events,
+    # partition windows, drop ramps (docs/ROBUSTNESS.md catalog)
+    NE.check_supported(fault, engine="crdt-pull")
+    # injections then (on the churn path) the schedule: both runtime
+    # operands on the table tail, shapes-only in the compiled loop
+    tables = tables + CR.inject_args(cfg, n)
+    if ch is not None:
+        tables = tables + NE.sched_args(NE.build(fault, n))
+    zero = jnp.zeros((), CR.state_dtype(cfg))
+
+    def step_tabled(state: CrdtState, *tbl):
+        tbl, sched = NE.split_tables(ch, tbl)
+        tbl, inj = CR.split_inject(cfg, tbl)
+        nbrs_t, deg_t = tbl if tbl else (None, None)
+        ids = jnp.arange(n, dtype=jnp.int32)
+        rkey = jax.random.fold_in(state.base_key, state.round)
+        alive_fn = CR.alive_at_fn(fault, n, origin)
+        eventual = CR.eventual_alive_crdt(fault, n, origin)
+        if ch is not None:
+            alive = NE.alive_rows(sched, NE.base_alive_or_ones(
+                fault, n, origin), state.round)
+            dp = NE.drop_at(sched, state.round)
+            cut = NE.cut_at(sched, state.round)
+        else:
+            alive = alive_mask(fault, n, origin)  # None on the hot path
+            dp, cut = drop_prob, None
+        lost = jnp.float32(0.0)
+        # local injections land BEFORE the exchange (an add gossips in
+        # its own round); the apply mask is the shared alive_at
+        # predicate, so the trajectory and ground truth cannot drift.
+        # Own columns add (increments accumulate), set planes OR.
+        inj_rows = CR.inject_rows(cfg, inj, ids, state.round, n,
+                                  origin, alive_fn, eventual)
+        if cfg.kind in C.CRDT_COUNTER_KINDS:
+            val = state.val + inj_rows
+        else:
+            val = state.val | inj_rows
+        visible = val if alive is None else jnp.where(
+            alive[:, None], val, zero)
+        qkey = jax.random.fold_in(rkey, si_mod.PULL_TAG)
+        partners0 = sample_peers(qkey, ids, topo, k, proto.exclude_self,
+                                 local_nbrs=nbrs_t, local_deg=deg_t)
+        partners = apply_drop(rkey, si_mod.PULL_DROP_TAG, ids,
+                              partners0, dp, n, force=ch is not None)
+        if ch is not None:
+            partners = NE.partition_targets(cut, ids, partners, n)
+        pulled = CR.pull_merge_crdt(cfg.kind, visible, partners, n)
+        if alive is not None:
+            partners = jnp.where(alive[:, None], partners, n)
+        n_req = jnp.sum(partners < n).astype(jnp.float32)
+        if ch is not None:
+            req_active = (jnp.ones((n,), jnp.bool_) if alive is None
+                          else alive)
+            lost = lost + NE.lost_count(partners0, partners,
+                                        req_active, n)
+        if alive is not None:
+            pulled = jnp.where(alive[:, None], pulled, zero)
+        out = CrdtState(val=CR.merge(cfg.kind, val, pulled),
+                        round=state.round + 1,
+                        base_key=state.base_key,
+                        msgs=state.msgs + 2.0 * n_req)
+        return (out, lost) if ch is not None else out
+
+    return bind_tables(step_tabled, tables, tabled)
+
+
+def _conv_target_count(run: RunConfig, eventual_total: int) -> int:
+    """The integer while_loop target: converged-node count that meets
+    ``run.target_coverage`` of the eventual-alive total — computed ONCE
+    on the host so the loop cond is an exact integer compare (no f32
+    division anywhere near control flow)."""
+    import math
+    return min(eventual_total,
+               math.ceil(run.target_coverage * eventual_total - 1e-9))
+
+
+def simulate_curve_crdt(cfg: CrdtConfig, proto: ProtocolConfig,
+                        topo: Topology, run: RunConfig,
+                        fault: Optional[FaultConfig] = None,
+                        timing=None):
+    """``lax.scan`` over rounds recording the per-round CONVERGED-NODE
+    COUNT (int32) and msgs; returns ``(value_conv f64[T], msgs f32[T],
+    final_state, truth_value)`` with value_conv divided once on the
+    host (ops/crdt module doc).  ``truth_value``: the scalar counter
+    ground-truth value, or the member-element count for sets."""
+    import numpy as np
+
+    from gossip_tpu.ops import nemesis as NE
+    from gossip_tpu.utils.trace import maybe_aot_timed
+    check_injections_reachable(cfg, run)
+    step, tables = make_crdt_round(cfg, proto, topo, fault, run.origin,
+                                   tabled=True)
+    ch = NE.get(fault)
+    n = topo.n
+    init = init_crdt_state(run, cfg, n)
+
+    @jax.jit
+    def scan(state, *tbl):
+        _, inj0 = CR.split_inject(cfg, NE.split_tables(ch, tbl)[0])
+        truth = CR.ground_truth(cfg, inj0, fault, n, run.origin)
+        eventual = CR.eventual_alive_crdt(fault, n, run.origin)
+
+        def body(s, _):
+            out = step(s, *tbl)
+            s1 = out[0] if ch is not None else out
+            return s1, (CR.converged_count(s1.val, truth, eventual),
+                        s1.msgs)
+
+        final, (convs, msgs) = jax.lax.scan(body, state, None,
+                                            length=run.max_rounds)
+        return final, convs, msgs, truth
+
+    final, convs, msgs, truth = maybe_aot_timed(scan, timing, init,
+                                                *tables)
+    eventual = np.asarray(CR.eventual_alive_crdt(fault, n, run.origin))
+    denom = max(1, int(eventual.sum()))
+    conv = np.asarray(convs, np.int64) / denom
+    return conv, np.asarray(msgs), final, truth_scalar(cfg, truth, n)
+
+
+def truth_scalar(cfg: CrdtConfig, truth, n: int):
+    """The human-readable ground truth: counter value (int) or member
+    count (int) — integer-exact, for reports and the CLI."""
+    import numpy as np
+    truth = np.asarray(truth)
+    if cfg.kind in C.CRDT_COUNTER_KINDS:
+        if cfg.kind == C.PNCOUNTER:
+            return int(truth[:n].sum() - truth[n:].sum())
+        return int(truth.sum())
+    w = truth.shape[0] // 2
+    members = truth[:w] & ~truth[w:]
+    return int(sum(bin(int(x)).count("1") for x in members))
+
+
+def simulate_until_crdt(cfg: CrdtConfig, proto: ProtocolConfig,
+                        topo: Topology, run: RunConfig,
+                        fault: Optional[FaultConfig] = None,
+                        timing=None):
+    """``lax.while_loop`` until the converged-node count reaches the
+    integer target (``target_coverage`` of the eventual-alive set);
+    returns ``(rounds, value_conv, msgs, final_state, truth_value)``."""
+    import numpy as np
+
+    from gossip_tpu.ops import nemesis as NE
+    from gossip_tpu.utils.trace import maybe_aot_timed
+    check_injections_reachable(cfg, run)
+    step, tables = make_crdt_round(cfg, proto, topo, fault, run.origin,
+                                   tabled=True)
+    step = NE.drop_lost(step, NE.get(fault))
+    ch = NE.get(fault)
+    n = topo.n
+    init = init_crdt_state(run, cfg, n)
+    eventual_np = np.asarray(CR.eventual_alive_crdt(fault, n,
+                                                    run.origin))
+    denom = max(1, int(eventual_np.sum()))
+    target = _conv_target_count(run, denom)
+
+    @jax.jit
+    def loop(state, *tbl):
+        _, inj0 = CR.split_inject(cfg, NE.split_tables(ch, tbl)[0])
+        truth = CR.ground_truth(cfg, inj0, fault, n, run.origin)
+        eventual = CR.eventual_alive_crdt(fault, n, run.origin)
+
+        def cond(s):
+            return ((CR.converged_count(s.val, truth, eventual)
+                     < target) & (s.round < run.max_rounds))
+
+        return jax.lax.while_loop(cond, lambda s: step(s, *tbl),
+                                  state), truth
+
+    final, truth = maybe_aot_timed(loop, timing, init, *tables)
+    conv = int(CR.converged_count(
+        final.val, truth,
+        CR.eventual_alive_crdt(fault, n, run.origin))) / denom
+    return (int(final.round), conv, float(final.msgs), final,
+            truth_scalar(cfg, truth, n))

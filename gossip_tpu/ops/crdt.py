@@ -1,0 +1,405 @@
+"""CRDT merge kernels: commutative-merge payloads on the gossip fabric.
+
+PAPER.md's reference solves exactly one Maelstrom / Gossip Glomers
+workload — broadcast with a dedup set — but the sibling challenges
+(grow-only / PN counters, OR-sets) are the *same* epidemic exchange
+with a different payload: instead of an infected bit that merges by
+OR, each node carries a state whose merge is commutative, associative,
+and idempotent (a join-semilattice), so gossip order, duplication, and
+loss never corrupt the value — partitions are exactly what CRDTs exist
+for (Shapiro et al., "Conflict-free Replicated Data Types", SSS 2011).
+
+Array forms (one row per node, the ``seen[N, R]`` convention):
+
+  * **G-Counter / PN-Counter** — per-node counter shards
+    ``int32[N, S]``: column ``j`` is node-``owner(j)``'s contribution;
+    only the owner increments its own column, everyone else learns it
+    by gossip, and merge is **elementwise max per shard column** (the
+    owner's value is monotone, so max is exact).  ``gcounter``: S = n,
+    owner(j) = j; ``pncounter``: S = 2n — columns 0..n-1 are the P
+    (increment) plane, n..2n-1 the N (decrement) plane, both grow-only,
+    value = sum(P) - sum(N).
+  * **G-Set / OR-Set** — packed bit-planes ``uint32[N, 2W]``
+    (ops/bitpack layout, 32 elements per word): columns 0..W-1 are the
+    add plane, W..2W-1 the tombstone plane, merge is **bitwise OR** on
+    both, membership = add & ~tombstone.  This is the array form of an
+    OR-set where each element carries one unique add tag per run
+    (CrdtConfig enforces at-most-one scripted add per element), so
+    add-wins and 2P semantics coincide — documented in
+    docs/WORKLOADS.md.
+  * **Vector clocks** — ``int32[N, n]``: node i's causal clock; the
+    owner ticks its own entry per local event, merge is elementwise
+    max (the classic vector-clock join).
+
+Injections are runtime OPERANDS, like the nemesis schedule tables
+(ops/nemesis module doc): :func:`inject_args` lowers a CrdtConfig to a
+tiny tuple of padded arrays the step factories append to their
+``tables`` tuple, so the compiled loops carry injection SHAPES but no
+CONTENT — two add programs of the same padded arity re-enter one
+executable.
+
+Ground truth and the value-convergence metric
+---------------------------------------------
+An injection is **applied** iff its owner is alive at the injection
+round AND eventually alive under the fault program — the batched
+analog of the Maelstrom counter checker counting only ACKED adds: a
+node destined for permanent death contributes nothing, which is what
+makes exact convergence on the eventual-alive set a guaranteed
+invariant (every applied contribution's owner eventually recovers and
+re-disseminates its full shard).  :func:`ground_truth` computes the
+merged truth row from the same operands IN-TRACE (integer-exact — no
+float readout anywhere), and :func:`converged_count` counts alive
+nodes whose full state row equals it bitwise.  The drivers divide the
+integer count by the eventual-alive total ONCE on the host
+(value_conv), the repo's bitwise-curve convention.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from gossip_tpu.config import (CRDT_COUNTER_KINDS, CRDT_SET_KINDS,
+                               GCOUNTER, GSET, ORSET, PNCOUNTER, VCLOCK,
+                               CrdtConfig, FaultConfig)
+from gossip_tpu.ops.bitpack import n_words, pack
+
+# How many trailing step arguments an injection program occupies on a
+# factory's ``tables`` tuple (inject_args / split_inject): counters
+# lower to (col, round, amount), sets to (add_elem, add_round,
+# rem_elem, rem_round).  Vector clocks inject nothing (self-tick).
+N_INJECT_OPERANDS = {GCOUNTER: 3, PNCOUNTER: 3, GSET: 4, ORSET: 4,
+                     VCLOCK: 0}
+
+# Minimum padded injection-list length: like the nemesis tables'
+# SCHED_T_MIN, a power-of-two bucket makes same-bucket programs
+# shape-identical so they share one compiled loop.
+INJECT_A_MIN = 8
+
+# Sentinel round for "no injection" on padding rows — far beyond any
+# real round (the ChurnConfig horizon cap is 100k), so the in-loop
+# ``round == r`` compare never fires for them.
+NO_ROUND = 1 << 29
+
+
+def shard_columns(kind: str, n: int) -> int:
+    """S: the state's column count for ``n`` nodes (module doc)."""
+    if kind == GCOUNTER or kind == VCLOCK:
+        return n
+    if kind == PNCOUNTER:
+        return 2 * n
+    raise ValueError(f"{kind!r} is not a counter-shard kind")
+
+
+def set_words(cfg: CrdtConfig) -> int:
+    """2W: the packed set state's word count (add + tombstone planes)."""
+    return 2 * n_words(cfg.elements)
+
+
+def state_width(cfg: CrdtConfig, n: int) -> int:
+    """Columns of the ``val`` row for this config (counter shards or
+    packed set words)."""
+    if cfg.kind in CRDT_SET_KINDS:
+        return set_words(cfg)
+    return shard_columns(cfg.kind, n)
+
+
+def state_dtype(cfg: CrdtConfig):
+    return jnp.uint32 if cfg.kind in CRDT_SET_KINDS else jnp.int32
+
+
+# -- merge kernels (the join-semilattice operations) -------------------
+
+def merge_max(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Counter-shard / vector-clock join: elementwise max.  Exact
+    because each column is written only by its monotone owner."""
+    return jnp.maximum(a, b)
+
+
+def merge_or(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Packed-set join: bitwise OR on the add + tombstone planes."""
+    return a | b
+
+
+def merge(kind: str, a: jax.Array, b: jax.Array) -> jax.Array:
+    """The ONE kind dispatcher — every exchange path and every
+    algebraic pin goes through it, so a driver can never ship a merge
+    the tests did not pin."""
+    if kind in CRDT_SET_KINDS:
+        return merge_or(a, b)
+    return merge_max(a, b)
+
+
+def pull_merge_crdt(kind: str, rows_all: jax.Array, partners: jax.Array,
+                    sentinel: int) -> jax.Array:
+    """Merge of k sampled peers' state rows -> ``[N_local, S]``.
+
+    The CRDT twin of ops/propagate.pull_merge / si_packed
+    .pull_merge_packed: gather k rows, mask invalid partners to the
+    merge identity (0 — the identity of both OR and max-on-nonnegative,
+    which the counter planes are by construction), reduce with
+    :func:`merge`.  One uint32/int32 gather moves 32 set elements or
+    one counter shard per lane.
+    """
+    valid = partners < sentinel
+    safe = jnp.minimum(partners, sentinel - 1)
+    got = rows_all[safe]                              # [Nl, k, S]
+    got = jnp.where(valid[:, :, None], got,
+                    jnp.zeros((), rows_all.dtype))
+    out = got[:, 0, :]
+    for j in range(1, got.shape[1]):
+        out = merge(kind, out, got[:, j, :])
+    return out
+
+
+# -- injection lowering (runtime operands, the nemesis pattern) --------
+
+def _pad_pow2(length: int) -> int:
+    return max(INJECT_A_MIN, 1 << max(0, (length - 1).bit_length()))
+
+
+def counter_adds(cfg: CrdtConfig, n: int):
+    """The effective add list ``[(node, round, amount), ...]`` —
+    scripted, or the default program's closed form: node j adds
+    ``1 + j % 7`` at round 0, pncounter alternating sign by parity
+    (odd nodes decrement).  A formula, not a config table, so no O(N)
+    config object is ever materialized (CrdtConfig doc); this is the
+    ONE definition of the defaults, shared by the lowering and ground
+    truth through :func:`inject_args`."""
+    if cfg.adds:
+        return list(cfg.adds)
+    sign = -1 if cfg.kind == PNCOUNTER else 1
+    return [(j, 0, int(1 + j % 7) * (sign if j % 2 else 1))
+            for j in range(n)]
+
+
+def inject_args(cfg: CrdtConfig, n: int) -> tuple:
+    """Lower the injection program to its operand tuple (module doc):
+    counters -> ``(col int32[A], round int32[A], amount int32[A])``
+    with the pncounter N-plane offset already folded into ``col``;
+    sets -> ``(add_elem, add_round, rem_elem, rem_round)`` (int32,
+    padded with NO_ROUND sentinels).  Padded to a power-of-two bucket
+    so same-arity programs are shape-identical."""
+    kind = cfg.kind
+    if kind == VCLOCK:
+        return ()
+    if kind in CRDT_COUNTER_KINDS:
+        adds = counter_adds(cfg, n)
+        bad = [a for a in adds if a[0] >= n]
+        if bad:
+            raise ValueError(f"counter adds reference node ids >= "
+                             f"n={n}: {bad}")
+        a_pad = _pad_pow2(len(adds))
+        col = [(node if amt >= 0 else n + node)
+               if kind == PNCOUNTER else node
+               for node, _, amt in adds]
+        col += [0] * (a_pad - len(adds))
+        rnd = [r for _, r, _ in adds] + [NO_ROUND] * (a_pad - len(adds))
+        amt = [abs(a) for _, _, a in adds] + [0] * (a_pad - len(adds))
+        return (jnp.asarray(col, jnp.int32), jnp.asarray(rnd, jnp.int32),
+                jnp.asarray(amt, jnp.int32))
+    # sets: default add program = every element at round 0
+    set_adds = (list(cfg.set_adds) if cfg.set_adds
+                else [(e, 0) for e in range(cfg.elements)])
+
+    def elem_rounds(pairs):
+        a_pad = _pad_pow2(len(pairs)) if pairs else INJECT_A_MIN
+        elem = [e for e, _ in pairs] + [0] * (a_pad - len(pairs))
+        rnd = ([r for _, r in pairs]
+               + [NO_ROUND] * (a_pad - len(pairs)))
+        return (jnp.asarray(elem, jnp.int32),
+                jnp.asarray(rnd, jnp.int32))
+
+    return elem_rounds(set_adds) + elem_rounds(list(cfg.set_removes))
+
+
+def split_inject(cfg: CrdtConfig, tbl: tuple):
+    """(head_tables, inject_operands): peel the injection operands
+    :func:`inject_args` appended back off a step's ``*tables`` tail —
+    the ONE inverse (the nemesis split_tables discipline)."""
+    k = N_INJECT_OPERANDS[cfg.kind]
+    if k == 0:
+        return tbl, ()
+    return tbl[:-k], tbl[-k:]
+
+
+def _applied_mask(rounds: jax.Array, owners: jax.Array,
+                  alive_at_fn, eventual: jax.Array) -> jax.Array:
+    """bool[A]: which injections are APPLIED under the fault program —
+    owner alive at the injection round and eventually alive (module
+    doc: the acked-adds semantics).  ``alive_at_fn(node, round) ->
+    bool`` broadcasts; padding rows carry NO_ROUND and an in-range
+    dummy owner, and die out on the alive_at compare below only if the
+    schedule said so — so they are excluded explicitly here."""
+    real = rounds < NO_ROUND
+    return real & alive_at_fn(owners, rounds) & eventual[owners]
+
+
+def alive_at_fn(fault: Optional[FaultConfig], n: int, origin: int):
+    """``(nodes int32[...], rounds int32[...]) -> bool[...]`` liveness
+    of ``nodes`` at ``rounds`` under the static mask + churn windows —
+    in-trace safe, shared by the step's apply mask and ground truth so
+    the two can never disagree on which injections fired."""
+    from gossip_tpu.ops import nemesis as NE
+    base = NE.base_alive_or_ones(fault, n, origin) \
+        if fault is not None else jnp.ones((n,), jnp.bool_)
+    ch = NE.get(fault)
+    if ch is not None:
+        sched_die, sched_rec = NE._event_tables(ch, n)
+    else:
+        sched_die = jnp.full((n,), NE.NEVER, jnp.int32)
+        sched_rec = jnp.full((n,), NE.NEVER, jnp.int32)
+
+    def fn(nodes, rounds):
+        nodes = jnp.asarray(nodes, jnp.int32)
+        rounds = jnp.asarray(rounds, jnp.int32)
+        down = (sched_die[nodes] <= rounds) & (rounds < sched_rec[nodes])
+        return base[nodes] & ~down
+
+    return fn
+
+
+def eventual_alive_crdt(fault: Optional[FaultConfig], n: int,
+                        origin: int) -> jax.Array:
+    """bool[n] eventual-alive set as a real array (the CRDT value-
+    convergence denominator; ops/nemesis.eventual_alive, None-free)."""
+    from gossip_tpu.ops import nemesis as NE
+    if fault is None:
+        return jnp.ones((n,), jnp.bool_)
+    return NE.eventual_alive(fault, n, origin)
+
+
+def inject_rows(cfg: CrdtConfig, inj: tuple, gids: jax.Array, round_,
+                n: int, origin: int, alive_fn, eventual: jax.Array
+                ) -> jax.Array:
+    """The rows each node merges into its OWN state at ``round_`` —
+    ``[len(gids), S]`` in the state dtype, zero except where this
+    round's applied injections land on a ``gids`` row.  In-trace; the
+    injections arrive as the :func:`inject_args` operands."""
+    r = jnp.asarray(round_, jnp.int32)
+    kind = cfg.kind
+    if kind == VCLOCK:
+        raise ValueError("vclock rows tick via vclock_tick, not "
+                         "injections")
+    if kind in CRDT_COUNTER_KINDS:
+        col, rnd, amt = inj
+        owner = col % n                                   # N-plane folds
+        fire = (rnd == r) & _applied_mask(rnd, owner, alive_fn,
+                                          eventual)
+        s = shard_columns(kind, n)
+        row = jnp.zeros((s,), jnp.int32).at[col].add(
+            jnp.where(fire, amt, 0), mode="drop")
+        col_owner = jnp.arange(s, dtype=jnp.int32) % n
+        own = col_owner[None, :] == gids[:, None]         # [Nl, S]
+        return jnp.where(own, row[None, :], 0)
+    add_elem, add_rnd, rem_elem, rem_rnd = inj
+    owners = (origin + jnp.arange(cfg.elements, dtype=jnp.int32)) % n
+
+    def plane(elem, rnd):
+        fire = (rnd == r) & _applied_mask(rnd, owners[elem], alive_fn,
+                                          eventual)
+        bits = jnp.zeros((cfg.elements,), jnp.bool_).at[elem].max(
+            fire, mode="drop")
+        # element e lands on its owner's row only
+        mine = owners[None, :] == gids[:, None]           # [Nl, E]
+        return pack(bits[None, :] & mine)                 # [Nl, W]
+
+    return jnp.concatenate([plane(add_elem, add_rnd),
+                            plane(rem_elem, rem_rnd)], axis=1)
+
+
+def vclock_tick(vc: jax.Array, gids: jax.Array, alive: jax.Array,
+                n: int) -> jax.Array:
+    """One local event per alive node: owner entries increment
+    (``vc[i, gids[i]] += alive[i]``) — the classic tick, the only
+    write a non-owner never makes."""
+    rows = jnp.arange(vc.shape[0], dtype=jnp.int32)
+    return vc.at[rows, gids].add(
+        jnp.where(alive, 1, 0).astype(vc.dtype), mode="drop")
+
+
+# -- ground truth + value convergence (integer-exact) ------------------
+
+def ground_truth(cfg: CrdtConfig, inj: tuple, fault, n: int,
+                 origin: int) -> jax.Array:
+    """The merged row ``[S]`` every eventually-alive node must reach:
+    the merge of all APPLIED injections (module doc).  Built from the
+    SAME operands and liveness predicate as the in-loop injection, so
+    the target and the trajectory cannot drift.  In-trace safe and
+    integer-exact."""
+    alive_fn = alive_at_fn(fault, n, origin)
+    eventual = eventual_alive_crdt(fault, n, origin)
+    kind = cfg.kind
+    if kind in CRDT_COUNTER_KINDS:
+        col, rnd, amt = inj
+        fire = _applied_mask(rnd, col % n, alive_fn, eventual)
+        s = shard_columns(kind, n)
+        return jnp.zeros((s,), jnp.int32).at[col].add(
+            jnp.where(fire, amt, 0), mode="drop")
+    add_elem, add_rnd, rem_elem, rem_rnd = inj
+    owners = (origin + jnp.arange(cfg.elements, dtype=jnp.int32)) % n
+
+    def plane(elem, rnd):
+        fire = _applied_mask(rnd, owners[elem], alive_fn, eventual)
+        bits = jnp.zeros((cfg.elements,), jnp.bool_).at[elem].max(
+            fire, mode="drop")
+        return pack(bits[None, :])[0]                     # [W]
+
+    return jnp.concatenate([plane(add_elem, add_rnd),
+                            plane(rem_elem, rem_rnd)])
+
+
+def counter_value(kind: str, rows: jax.Array, n: int) -> jax.Array:
+    """int32[...]: the merged counter value of each state row — sum of
+    shards (gcounter), sum(P) - sum(N) (pncounter).  Integer-exact."""
+    if kind == GCOUNTER:
+        return jnp.sum(rows, axis=-1, dtype=jnp.int32)
+    if kind == PNCOUNTER:
+        return (jnp.sum(rows[..., :n], axis=-1, dtype=jnp.int32)
+                - jnp.sum(rows[..., n:], axis=-1, dtype=jnp.int32))
+    raise ValueError(f"{kind!r} has no scalar counter value")
+
+
+def set_members(rows: jax.Array) -> jax.Array:
+    """Membership planes of a packed set state: add & ~tombstone
+    (``[..., W]`` from the ``[..., 2W]`` planes)."""
+    w = rows.shape[-1] // 2
+    return rows[..., :w] & ~rows[..., w:]
+
+
+def converged_count(rows: jax.Array, truth: jax.Array,
+                    alive: jax.Array) -> jax.Array:
+    """int32 count of alive nodes whose state row equals the ground
+    truth BITWISE (full-row equality: for sets that is both planes, so
+    a node holding the member set but missing a tombstone has not
+    converged — it could still un-remove on a later merge).  Divide by
+    the eventual-alive total ONCE on the host for value_conv (module
+    doc: integer counts cross the device boundary, never fractions)."""
+    eq = jnp.all(rows == truth[None, :], axis=-1)
+    return jnp.sum(eq & alive, dtype=jnp.int32)
+
+
+def value_conv_frac(rows: jax.Array, truth: jax.Array,
+                    alive: jax.Array) -> jax.Array:
+    """f32 in-trace convergence fraction — for the RoundMetrics
+    ``value_conv`` column and while_loop conds ONLY (observability and
+    control flow); every pinned readout uses :func:`converged_count`
+    and divides on the host."""
+    c = converged_count(rows, truth, alive).astype(jnp.float32)
+    return c / jnp.maximum(jnp.sum(alive, dtype=jnp.float32), 1.0)
+
+
+def payload_count(cfg: CrdtConfig, rows: jax.Array,
+                  alive: jax.Array) -> jax.Array:
+    """f32 total payload mass over alive rows — counter mass (shard
+    sums) or set bit count — the CRDT ``newly`` counter's integrand
+    (ops/round_metrics: ``newly`` = per-round delta of this, exact
+    because both mass measures are monotone under merge)."""
+    if cfg.kind in CRDT_SET_KINDS:
+        pc = jnp.where(alive[:, None],
+                       jax.lax.population_count(rows), 0)
+        return jnp.sum(pc, dtype=jnp.float32)
+    return jnp.sum(jnp.where(alive[:, None], rows, 0),
+                   dtype=jnp.float32)

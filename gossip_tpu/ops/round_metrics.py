@@ -66,6 +66,15 @@ ops/nemesis):
               counted exactly by the kernels (the ``lost`` output of
               the churn-aware round steps), never in ``msgs``.
 
+CRDT observables (present when the stack is built with ``crdt=True``
+— drivers running a commutative-merge payload, ops/crdt):
+
+``value_conv`` fraction of eventual-alive nodes whose merged state
+              equals the global ground truth after the round — the
+              eventual-consistency-of-VALUES metric (in-loop f32 for
+              observability; the drivers' pinned readout stays the
+              integer converged count divided once on host).
+
 ``GOSSIP_ROUND_METRICS=0`` (or empty) is the kill switch; metrics are
 also skipped when no run ledger is active (:func:`wanted`) — the
 buffers exist to be ledgered, and dark buffers would tax every test
@@ -113,11 +122,12 @@ class RoundMetrics:
     next write row == rounds recorded so far."""
 
     __slots__ = ("cursor", "newly", "dup", "msgs", "bytes", "front",
-                 "alive", "cut_pairs", "dropped", "label", "nemesis")
+                 "alive", "cut_pairs", "dropped", "value_conv",
+                 "label", "nemesis", "crdt")
 
     def __init__(self, cursor, newly, dup, msgs, bytes, front,
-                 alive, cut_pairs, dropped, label: str,
-                 nemesis: bool = False):
+                 alive, cut_pairs, dropped, value_conv, label: str,
+                 nemesis: bool = False, crdt: bool = False):
         self.cursor = cursor
         self.newly = newly
         self.dup = dup
@@ -127,8 +137,10 @@ class RoundMetrics:
         self.alive = alive
         self.cut_pairs = cut_pairs
         self.dropped = dropped
+        self.value_conv = value_conv
         self.label = label
         self.nemesis = nemesis
+        self.crdt = crdt
 
     def _replace(self, **kw):
         fields = {k: getattr(self, k) for k in self.__slots__}
@@ -138,12 +150,14 @@ class RoundMetrics:
 
 def _rm_flatten(m):
     return ((m.cursor, m.newly, m.dup, m.msgs, m.bytes, m.front,
-             m.alive, m.cut_pairs, m.dropped), (m.label, m.nemesis))
+             m.alive, m.cut_pairs, m.dropped, m.value_conv),
+            (m.label, m.nemesis, m.crdt))
 
 
 def _rm_unflatten(aux, children):
-    label, nemesis = aux
-    return RoundMetrics(*children, label=label, nemesis=nemesis)
+    label, nemesis, crdt = aux
+    return RoundMetrics(*children, label=label, nemesis=nemesis,
+                        crdt=crdt)
 
 
 jax.tree_util.register_pytree_node(RoundMetrics, _rm_flatten,
@@ -151,12 +165,13 @@ jax.tree_util.register_pytree_node(RoundMetrics, _rm_flatten,
 
 
 def init(max_rounds: int, n_shards: int, label: str,
-         nemesis: bool = False) -> RoundMetrics:
+         nemesis: bool = False, crdt: bool = False) -> RoundMetrics:
     """Zeroed buffer stack for up to ``max_rounds`` rounds over
-    ``n_shards`` shards (1 for single-device drivers).  Tiny: 7 T + T*S
-    floats — at the flagship's T=128, S=8 that is 3.5 KB.  ``nemesis``
+    ``n_shards`` shards (1 for single-device drivers).  Tiny: 8 T + T*S
+    floats — at the flagship's T=128, S=8 that is 3.6 KB.  ``nemesis``
     marks a stack that carries the churn observables (alive/cut_pairs/
-    dropped are recorded and ledgered; zeros otherwise)."""
+    dropped are recorded and ledgered; zeros otherwise); ``crdt`` marks
+    one carrying the value-convergence column (module doc)."""
     if max_rounds < 1:
         raise ValueError(f"max_rounds={max_rounds} must be >= 1")
     if n_shards < 1:
@@ -166,19 +181,20 @@ def init(max_rounds: int, n_shards: int, label: str,
                         bytes=z,
                         front=jnp.zeros((max_rounds, n_shards),
                                         jnp.float32),
-                        alive=z, cut_pairs=z, dropped=z,
-                        label=label, nemesis=nemesis)
+                        alive=z, cut_pairs=z, dropped=z, value_conv=z,
+                        label=label, nemesis=nemesis, crdt=crdt)
 
 
 def record(m: RoundMetrics, *, newly, dup, msgs, bytes,
            front, alive=None, cut_pairs=None,
-           dropped=None) -> RoundMetrics:
+           dropped=None, value_conv=None) -> RoundMetrics:
     """Write one round's row at the cursor (in-trace; scatter writes
     only).  The cursor is clamped to the last row so an over-long loop
     can never write out of bounds — by contract the drivers size the
     buffers with ``run.max_rounds``, which also bounds their loops.
-    The nemesis columns (alive/cut_pairs/dropped) are only written when
-    passed — the static-fault recorders never touch them."""
+    The nemesis columns (alive/cut_pairs/dropped) and the CRDT
+    ``value_conv`` column are only written when passed — the
+    static-fault / non-CRDT recorders never touch them."""
     i = jnp.minimum(m.cursor, m.newly.shape[0] - 1)
     f32 = lambda v: jnp.asarray(v, jnp.float32)       # noqa: E731
     kw = {}
@@ -188,6 +204,8 @@ def record(m: RoundMetrics, *, newly, dup, msgs, bytes,
         kw["cut_pairs"] = m.cut_pairs.at[i].set(f32(cut_pairs))
     if dropped is not None:
         kw["dropped"] = m.dropped.at[i].set(f32(dropped))
+    if value_conv is not None:
+        kw["value_conv"] = m.value_conv.at[i].set(f32(value_conv))
     return m._replace(
         cursor=m.cursor + 1,
         newly=m.newly.at[i].set(f32(newly)),
@@ -314,9 +332,9 @@ def emit(out, ledger, fn=None):
     import numpy as np
     for m in stacks:
         (cursor, newly, dup, msgs, bytes_, front, alive, cut_pairs,
-         dropped) = jax.device_get(
+         dropped, value_conv) = jax.device_get(
             (m.cursor, m.newly, m.dup, m.msgs, m.bytes, m.front,
-             m.alive, m.cut_pairs, m.dropped))
+             m.alive, m.cut_pairs, m.dropped, m.value_conv))
         r = min(int(cursor), int(newly.shape[0]))
 
         def ser(a, nd=3):
@@ -329,12 +347,19 @@ def emit(out, ledger, fn=None):
             # joins the totals so ledger_diff can gate it like msgs
             extra = {"alive": ser(alive), "cut_pairs": ser(cut_pairs),
                      "dropped": ser(dropped)}
+        if m.crdt:
+            # value convergence per round + the final fraction (the
+            # eventual-consistency headline an artifact pin asserts)
+            extra["value_conv"] = ser(value_conv, nd=4)
         totals = {"newly": round(float(np.sum(newly[:r])), 3),
                   "dup": round(float(np.sum(dup[:r])), 3),
                   "msgs": round(float(np.sum(msgs[:r])), 3),
                   "bytes": round(float(np.sum(bytes_[:r])), 3)}
         if m.nemesis:
             totals["dropped"] = round(float(np.sum(dropped[:r])), 3)
+        if m.crdt:
+            totals["value_conv_final"] = (
+                round(float(value_conv[r - 1]), 4) if r else 0.0)
         ledger.event(
             "round_metrics", sync=False, driver=m.label, fn=fn,
             rounds=r, shards=int(front.shape[1]),

@@ -57,6 +57,7 @@ class MaelstromHarness:
         self._last_activity = 0.0
         self.op_latencies: List[float] = []   # client RPC round trips (s)
         self.broadcast_ops = 0
+        self.client_ops = 0          # all workload-generator ops
 
     # -- lifecycle -------------------------------------------------------
 
@@ -192,18 +193,38 @@ class MaelstromHarness:
             for nid in self.ids])
         assert all(r["body"]["type"] == "topology_ok" for r in replies)
 
-    async def broadcast(self, node: str, value: int) -> dict:
+    async def _timed_op(self, node: str, body: dict) -> dict:
+        """One workload-generator op: latency-recorded, op-counted —
+        the shared accounting of every workload's write path, so
+        ``stats()`` means the same thing for all of them."""
         t0 = self._now()
-        r = await self._client_rpc(node,
-                                   {"type": "broadcast", "message": value})
+        r = await self._client_rpc(node, body)
         self.op_latencies.append(self._now() - t0)
+        self.client_ops += 1
+        return r
+
+    async def broadcast(self, node: str, value: int) -> dict:
+        r = await self._timed_op(node, {"type": "broadcast",
+                                        "message": value})
         self.broadcast_ops += 1
         return r
+
+    async def add(self, node: str, delta: int) -> dict:
+        """Counter-workload ``add`` op (Gossip Glomers challenge #4);
+        the caller checks the reply type — only an ``add_ok`` counts
+        toward the acked-sum invariant."""
+        return await self._timed_op(node, {"type": "add",
+                                           "delta": delta})
 
     async def read(self, node: str) -> List[int]:
         r = await self._client_rpc(node, {"type": "read"})
         assert r["body"]["type"] == "read_ok"
         return r["body"]["messages"]
+
+    async def read_counter(self, node: str) -> int:
+        r = await self._client_rpc(node, {"type": "read"})
+        assert r["body"]["type"] == "read_ok"
+        return int(r["body"]["value"])
 
     async def send_raw(self, dest: str, body: dict, timeout: float = 15.0
                        ) -> dict:
@@ -222,23 +243,91 @@ class MaelstromHarness:
 
     def stats(self) -> dict:
         """Maelstrom-checker-style workload stats (SURVEY.md §4: the real
-        harness reports messages-per-op and op latencies externally)."""
+        harness reports messages-per-op and op latencies externally).
+        ``ops``/``msgs_per_op`` count every workload-generator op (the
+        counter workload's adds included); ``broadcast_ops`` stays the
+        broadcast-specific count for the batching artifacts."""
         lats = sorted(self.op_latencies)
 
         def pct(p):
             return lats[min(len(lats) - 1, int(p * len(lats)))] if lats else 0.0
         return {
             "nodes": self.n,
+            "ops": self.client_ops,
             "broadcast_ops": self.broadcast_ops,
             "msgs_routed": self.routed,
-            "msgs_per_op": (self.routed / self.broadcast_ops
-                            if self.broadcast_ops else 0.0),
+            "msgs_per_op": (self.routed / self.client_ops
+                            if self.client_ops else 0.0),
             "op_latency_ms": {
                 "mean": 1e3 * sum(lats) / len(lats) if lats else 0.0,
                 "p50": 1e3 * pct(0.50), "p99": 1e3 * pct(0.99),
                 "max": 1e3 * (lats[-1] if lats else 0.0)},
             "link_latency_ms": 1e3 * self.latency,
         }
+
+
+async def _start_workload(n: int, ops: int, rate: float, latency: float,
+                          topology: str, partition_mid: bool,
+                          argv: Optional[List[str]]) -> MaelstromHarness:
+    """The spawn/topology/partition scaffolding EVERY workload runner
+    shares — one definition, so :func:`run_broadcast_workload` and
+    :func:`run_counter_workload` cannot drift on how a cluster is
+    brought up or how the fault-tolerance variant cuts it."""
+    h = MaelstromHarness(n, latency=latency, argv=argv)
+    await h.start()
+    try:
+        topo = (line_topology(h.ids) if topology == "line"
+                else grid_topology(h.ids, max(1, int(n ** 0.5))))
+        await h.set_topology(topo)
+        if partition_mid and n >= 2:
+            # cut a REAL edge near the middle of the cluster —
+            # consecutive ids are only adjacent in the line topology;
+            # on a grid an arbitrary pair is usually not an edge and
+            # the cut would drop nothing while still reporting
+            # partitioned=true (both built families give every middle
+            # node a neighbor at n >= 2)
+            a = h.ids[n // 2]
+            b = topo[a][0]
+            # cut the middle third of the send window, anchored NOW
+            # (the send loop starts now) — anchoring at loop start
+            # would let process-spawn/init time expire the window
+            # before the first broadcast and make the fault variant
+            # vacuous
+            span = ops / rate
+            h.partition(a, b, duration=span / 3,
+                        start=h._now() + span / 3)
+    except BaseException:
+        # the callers' try/finally h.stop() only guards AFTER this
+        # returns: a topology failure here (a node that crashed on
+        # spawn, a never-answered topology_ok) must not strand n
+        # stdin-blocked node processes
+        await h.stop()
+        raise
+    return h
+
+
+async def _finish_workload(h: MaelstromHarness, check,
+                           poll_deadline: float = 30.0) -> dict:
+    """The quiesce + eventual-invariant polling every workload runner
+    shares: quiesce (reported, never fatal — a retry loop can look
+    idle mid-backoff), then poll ``check()`` (an async predicate) until
+    it holds or the deadline passes.  Returns the stats dict with
+    ``invariant_ok`` / ``quiesce_timeout`` filled."""
+    timed_out = False
+    try:
+        await h.quiesce(timeout=60.0)
+    except TimeoutError:
+        timed_out = True           # report, don't crash: reads still run
+    deadline = h._now() + poll_deadline
+    while True:
+        ok = await check()
+        if ok or h._now() > deadline:
+            break
+        await asyncio.sleep(0.5)
+    out = h.stats()
+    out["invariant_ok"] = ok
+    out["quiesce_timeout"] = timed_out
+    return out
 
 
 async def run_broadcast_workload(n: int, ops: int, rate: float = 50.0,
@@ -255,52 +344,74 @@ async def run_broadcast_workload(n: int, ops: int, rate: float = 50.0,
     Returns the stats dict (+ ``invariant_ok``, ``values``)."""
     import random
     rng = random.Random(seed)
-    h = MaelstromHarness(n, latency=latency, argv=argv)
-    await h.start()
+    h = await _start_workload(n, ops, rate, latency, topology,
+                              partition_mid, argv)
     try:
-        topo = (line_topology(h.ids) if topology == "line"
-                else grid_topology(h.ids, max(1, int(n ** 0.5))))
-        await h.set_topology(topo)
-        if partition_mid and n >= 2:
-            # cut a REAL edge near the middle of the cluster — consecutive
-            # ids are only adjacent in the line topology; on a grid an
-            # arbitrary pair is usually not an edge and the cut would drop
-            # nothing while still reporting partitioned=true (both built
-            # families give every middle node a neighbor at n >= 2)
-            a = h.ids[n // 2]
-            b = topo[a][0]
-            # cut the middle third of the send window, anchored NOW (the
-            # send loop starts now) — anchoring at loop start would let
-            # process-spawn/init time expire the window before the first
-            # broadcast and make the fault variant vacuous
-            span = ops / rate
-            h.partition(a, b, duration=span / 3,
-                        start=h._now() + span / 3)
         for v in range(ops):
             await h.broadcast(rng.choice(h.ids), v)
             await asyncio.sleep(1.0 / rate)
-        timed_out = False
-        try:
-            await h.quiesce(timeout=60.0)
-        except TimeoutError:
-            timed_out = True       # report, don't crash: reads still run
         # The checker invariant is EVENTUAL delivery: a quiesce can look
         # idle while a node's partition-dropped push sits in its ~2 s
         # RPC-timeout retry loop, so poll the reads until every value is
         # everywhere or the deadline passes (nodes retry with capped
         # backoff — runtime/maelstrom_node.py).
         want = set(range(ops))
-        deadline = h._now() + 30.0
-        while True:
-            reads = await asyncio.gather(*[h.read(nid) for nid in h.ids])
-            ok = all(want <= set(r) for r in reads)
-            if ok or h._now() > deadline:
-                break
-            await asyncio.sleep(0.5)
-        out = h.stats()
-        out["invariant_ok"] = ok
-        out["quiesce_timeout"] = timed_out
+
+        async def check():
+            reads = await asyncio.gather(*[h.read(nid)
+                                           for nid in h.ids])
+            return all(want <= set(r) for r in reads)
+
+        out = await _finish_workload(h, check)
         out["values"] = ops
+        out["partitioned"] = bool(partition_mid)
+        return out
+    finally:
+        await h.stop()
+
+
+async def run_counter_workload(n: int, ops: int, rate: float = 50.0,
+                               latency: float = 0.002,
+                               topology: str = "line",
+                               partition_mid: bool = False,
+                               seed: int = 0,
+                               max_delta: int = 10,
+                               argv: Optional[List[str]] = None) -> dict:
+    """The Gossip Glomers ``g-counter`` workload: spawn ``n`` counter
+    nodes (runtime/maelstrom_node.CounterServer — per-node CRDT shards,
+    merge = per-key max), send ``ops`` random-delta ``add`` ops at
+    ``rate`` ops/s to random nodes, optionally cut a mid-cluster link
+    mid-run, quiesce, then check the checker's invariant: the final
+    ``read`` on EVERY node equals the **sum of acked adds** — exact
+    integer equality, through the partition.  Returns the stats dict
+    (+ ``invariant_ok``, ``expected``, ``final_values``)."""
+    import random
+    rng = random.Random(seed)
+    if argv is None:
+        argv = [sys.executable, "-u", "-m",
+                "gossip_tpu.runtime.maelstrom_node",
+                "--workload", "counter"]
+    h = await _start_workload(n, ops, rate, latency, topology,
+                              partition_mid, argv)
+    try:
+        acked_sum = 0
+        for _ in range(ops):
+            delta = rng.randint(1, max_delta)
+            r = await h.add(rng.choice(h.ids), delta)
+            if r["body"]["type"] == "add_ok":   # only acked adds count
+                acked_sum += delta
+            await asyncio.sleep(1.0 / rate)
+
+        finals: List[int] = []
+
+        async def check():
+            finals[:] = await asyncio.gather(*[h.read_counter(nid)
+                                               for nid in h.ids])
+            return all(v == acked_sum for v in finals)
+
+        out = await _finish_workload(h, check)
+        out["expected"] = acked_sum
+        out["final_values"] = list(finals)
         out["partitioned"] = bool(partition_mid)
         return out
     finally:

@@ -295,9 +295,139 @@ class BroadcastServer:
         pass                                       # sink (main.go:151-153)
 
 
-async def amain(gossip_interval: float = 0.0) -> None:
+class CounterServer:
+    """The Gossip Glomers grow-only / PN counter workload node: the
+    SAME epidemic machinery as :class:`BroadcastServer` with a
+    commutative-merge payload instead of the dedup set (the batched
+    twin is gossip_tpu/models/crdt.py; docs/WORKLOADS.md).
+
+    State is the classic per-node counter shards — ``pos``/``neg`` maps
+    ``node_id -> contribution`` where only the owner ever raises its
+    own entry — so merge is **per-key max** and gossip order,
+    duplication, and loss cannot corrupt the value.  Client ops:
+
+      * ``add {delta}`` — ack ``add_ok`` FIRST (the reference's
+        ack-before-process shape, main.go:109), then bump the own
+        shard (negative deltas land in the ``neg`` plane — the PN
+        variant; a grow-only workload simply never sends one);
+      * ``read`` — ``read_ok {value}``, value = sum(pos) - sum(neg).
+
+    Dissemination is interval-ticked full-state gossip: every
+    ``interval`` seconds each neighbor that has not acked the CURRENT
+    shard maps gets one ``counter_gossip`` RPC carrying them; an ack
+    records the acked snapshot, a timeout/partition leaves the
+    neighbor dirty for the next tick — at-least-once with idempotent
+    merge, so a healed partition converges with no special casing
+    (the BroadcastServer batching layer's retry shape)."""
+
+    def __init__(self, node: MaelstromNode, rpc_timeout: float = 2.0,
+                 gossip_interval: float = 0.05):
+        self.node = node
+        self.rpc_timeout = rpc_timeout
+        self.gossip_interval = gossip_interval
+        self.pos: Dict[str, int] = {}
+        self.neg: Dict[str, int] = {}
+        self.topology: Dict[str, List[str]] = {}
+        self.acked: Dict[str, tuple] = {}   # nbr -> last acked snapshot
+        self._in_flight: set = set()
+        self._flusher: Optional[asyncio.Task] = None
+        node.handle("add", self.on_add)
+        node.handle("read", self.on_read)
+        node.handle("topology", self.on_topology)
+        node.handle("counter_gossip", self.on_gossip)
+        node.handle("counter_gossip_ok", self.on_sink)
+        node.handle("add_ok", self.on_sink)
+
+    def _value(self) -> int:
+        return sum(self.pos.values()) - sum(self.neg.values())
+
+    def _snapshot(self) -> tuple:
+        return (tuple(sorted(self.pos.items())),
+                tuple(sorted(self.neg.items())))
+
+    def _merge(self, pos: Dict[str, int], neg: Dict[str, int]) -> bool:
+        """Per-key max join; True when anything changed (a change means
+        neighbors may be stale, which the snapshot compare picks up)."""
+        changed = False
+        for mine, theirs in ((self.pos, pos), (self.neg, neg)):
+            for nid, v in theirs.items():
+                if int(v) > mine.get(nid, 0):
+                    mine[nid] = int(v)
+                    changed = True
+        return changed
+
+    def _ensure_flusher(self) -> None:
+        if self._flusher is None:
+            self._flusher = asyncio.ensure_future(self._flush_loop())
+
+    async def on_add(self, msg) -> None:
+        body = msg["body"]
+        delta = int(body.get("delta", 0))
+        await self.node.reply(msg, {"type": "add_ok"})   # ack FIRST
+        me = self.node.node_id
+        if delta >= 0:
+            self.pos[me] = self.pos.get(me, 0) + delta
+        else:
+            self.neg[me] = self.neg.get(me, 0) - delta
+        self._ensure_flusher()
+
+    async def on_read(self, msg) -> None:
+        await self.node.reply(msg, {"type": "read_ok",
+                                    "value": self._value()})
+
+    async def on_topology(self, msg) -> None:
+        self.topology = {k: list(v)
+                         for k, v in msg["body"]["topology"].items()}
+        await self.node.reply(msg, {"type": "topology_ok"})
+
+    async def on_gossip(self, msg) -> None:
+        body = msg["body"]
+        await self.node.reply(msg, {"type": "counter_gossip_ok"})
+        if self._merge(body.get("pos", {}), body.get("neg", {})):
+            self._ensure_flusher()
+
+    async def on_sink(self, msg) -> None:
+        pass
+
+    async def _flush_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.gossip_interval)
+            try:
+                snap = self._snapshot()
+                for nb in self.topology.get(self.node.node_id, []):
+                    if (self.acked.get(nb) != snap
+                            and nb not in self._in_flight):
+                        self._in_flight.add(nb)
+                        asyncio.ensure_future(self._flush_one(nb, snap))
+            except Exception as e:    # never kill the only flusher
+                print(f"counter flush loop error (continuing): {e!r}",
+                      file=sys.stderr)
+
+    async def _flush_one(self, nb: str, snap: tuple) -> None:
+        try:
+            reply = await self.node.rpc(
+                nb, {"type": "counter_gossip",
+                     "pos": dict(self.pos), "neg": dict(self.neg)},
+                timeout=self.rpc_timeout)
+            if reply.get("body", {}).get("type") != "error":
+                self.acked[nb] = snap
+        except asyncio.TimeoutError:
+            pass                      # partitioned/lost: retry next tick
+        finally:
+            self._in_flight.discard(nb)
+
+
+WORKLOADS = ("broadcast", "counter")
+
+
+async def amain(gossip_interval: float = 0.0,
+                workload: str = "broadcast") -> None:
     node = MaelstromNode()
-    BroadcastServer(node, gossip_interval=gossip_interval)
+    if workload == "counter":
+        CounterServer(node,
+                      gossip_interval=gossip_interval or 0.05)
+    else:
+        BroadcastServer(node, gossip_interval=gossip_interval)
     await node.run()
 
 
@@ -307,9 +437,16 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--gossip-interval", type=float, default=0.0,
                     help="batch relays per neighbor every INTERVAL "
                          "seconds (0 = the reference's immediate "
-                         "per-message fan-out)")
+                         "per-message fan-out; the counter workload "
+                         "always ticks, default 0.05)")
+    ap.add_argument("--workload", default="broadcast",
+                    choices=WORKLOADS,
+                    help="protocol personality: the reference's "
+                         "broadcast log, or the Gossip Glomers "
+                         "counter (per-node CRDT shards, merge = "
+                         "per-key max)")
     args = ap.parse_args(argv)
-    asyncio.run(amain(args.gossip_interval))
+    asyncio.run(amain(args.gossip_interval, args.workload))
 
 
 if __name__ == "__main__":

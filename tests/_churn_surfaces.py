@@ -15,6 +15,14 @@ along: the static hot path must not move either.
 Configs are tiny (n=64, <= 12 rounds) and the digests depend only on
 the threefry streams + kernel arithmetic, which are platform-stable on
 the CPU tier the fingerprints were captured on.
+
+These digests also serve as the no-CRDT regression guard (the CRDT
+payload PR): the CRDT subsystem rides the exchange fabric — same
+sampling streams, drop coins, partition cuts — without moving any
+existing broadcast/rumor/SWIM trajectory.  tests/test_crdt.py
+re-verifies packed_sharded IN-GATE (on top of test_nemesis's
+dense_sharded pin; rumor_single + packed_single in its ``-m slow``
+twin), and the full matrix runs under test_nemesis's slow-tier pin.
 """
 
 import hashlib

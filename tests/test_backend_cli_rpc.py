@@ -351,6 +351,12 @@ def test_cli_run_jax_and_error_paths():
     assert "single-run only" in p.stderr
 
 
+# depth tier (tier-1 wall budget, CRDT-PR rebalance): 2 CLI children;
+# the compile-cache contracts keep in-gate coverage via
+# tests/test_compile_cache.py (cross-process populate-then-hit +
+# per-driver warm-vs-cold), and every CLI child in the gate already
+# runs through _enable_compile_cache with the session cache dir
+@pytest.mark.slow
 def test_cli_compile_cache_flags(tmp_path):
     """--compile-cache creates the cache dir and the run still works
     (whether entries land depends on the 2 s min-compile threshold);
@@ -658,6 +664,11 @@ def test_cli_parity_check_rejects_non_flood():
     assert "flood" in p.stderr
 
 
+# depth tier (tier-1 wall budget, CRDT-PR rebalance): 3 CLI children
+# of pure flag-validation; the parity-check surface keeps its in-gate
+# smokes via test_cli_parity_check_race_free_ring (happy path) and
+# test_cli_parity_check_rejects_non_flood (rejection path)
+@pytest.mark.slow
 def test_cli_parity_check_flag_conflicts_and_truncation():
     # insufficient --max-rounds must error, not report a bogus gap
     p = _cli("run", "--mode", "flood", "--family", "ring", "--n", "256",
@@ -701,6 +712,12 @@ def test_until_reports_split_compile_and_steady_wall():
     assert "compile_s" not in r.meta
 
 
+# depth tier (tier-1 wall budget, CRDT-PR rebalance): the sidecar
+# surface keeps test_rpc_sidecar_round_trip in-gate, and the shared
+# ensemble dispatch (backend.run_ensemble) stays gated via
+# tests/test_sweep.py's ensemble pins — this RPC-transport twin of the
+# same dispatch runs under -m slow
+@pytest.mark.slow
 def test_rpc_sidecar_ensemble():
     """Round 4: the Ensemble RPC — seed-ensemble statistics in one
     coarse call, mode-dispatched through backend.run_ensemble (shared

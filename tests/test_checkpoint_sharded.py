@@ -159,6 +159,13 @@ def test_fused_planes_checkpoint_curve(tmp_path):
     assert curve_res == curve_full
 
 
+# depth tier (tier-1 wall budget, CRDT-PR rebalance): 3 CLI children
+# (~32 s warm).  The surface keeps in-gate coverage twice over: the
+# CLI checkpoint+curve path via test_cli_save_curve_with_checkpoint
+# below, and the sharded-packed resume bitwise contract via
+# tests/test_crash_safety.py::test_packed_sharded_resume_under_fault_
+# bitwise (which additionally runs it under a fault program).
+@pytest.mark.slow
 def test_cli_sharded_checkpoint_resume_and_curve(tmp_path):
     ck = str(tmp_path / "cli.npz")
     args = ("run", "--mode", "pull", "--family", "erdos_renyi",

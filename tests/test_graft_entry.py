@@ -45,14 +45,16 @@ readme_table = importlib.util.module_from_spec(_tspec)
 _tspec.loader.exec_module(readme_table)
 
 FAMILIES = frozenset({
-    "dense_pushpull", "churn_heal", "churn_sweep", "packed_pull",
-    "sparse_antientropy", "topo_sparse_antientropy", "swim_rotating",
-    "halo_banded", "fused_planes", "fused_planes_fault_curve",
-    "rumor_sir", "hybrid_2d_sweep"})
-# the committed r07/r08/r09 records predate the compiled-nemesis PR's
-# churn_heal family AND the traced-operand PR's churn_sweep family;
-# their pins stay on the historical set
-FAMILIES_PRE_CHURN = FAMILIES - {"churn_heal", "churn_sweep"}
+    "dense_pushpull", "churn_heal", "churn_sweep", "crdt_counter",
+    "packed_pull", "sparse_antientropy", "topo_sparse_antientropy",
+    "swim_rotating", "halo_banded", "fused_planes",
+    "fused_planes_fault_curve", "rumor_sir", "hybrid_2d_sweep"})
+# the committed r11 record predates the CRDT PR's crdt_counter family;
+# the committed r07/r08/r09 records additionally predate the
+# compiled-nemesis PR's churn_heal family and the traced-operand PR's
+# churn_sweep family — each pin stays on its historical set
+FAMILIES_PRE_CRDT = FAMILIES - {"crdt_counter"}
+FAMILIES_PRE_CHURN = FAMILIES_PRE_CRDT - {"churn_heal", "churn_sweep"}
 DECOMPOSED = ("fused_planes", "fused_planes_fault_curve")
 DECOMP_KEYS = ("steady_exec_ms", "init_build_ms", "driver_overhead_ms")
 
@@ -323,12 +325,49 @@ def test_committed_r09_record_budgets_hold_with_round_metrics_on():
 
 def test_committed_r11_4dev_record_carries_churn_sweep():
     """The traced-operand PR's committed 4-device record
-    (artifacts/ledger_dryrun_r11_4dev.jsonl, the ledger_diff gate
-    baseline since r11): cold+warm pair, FULL current family set —
-    churn_heal and the new churn_sweep included — warm run all-hit,
-    budgets held, provenance present."""
+    (artifacts/ledger_dryrun_r11_4dev.jsonl): cold+warm pair on its
+    historical family set — churn_heal and churn_sweep included,
+    crdt_counter not yet — warm run all-hit, budgets held, provenance
+    present.  (The live ledger_diff gate baseline moved to the r13
+    record below when the CRDT PR grew the family set.)"""
     path = os.path.join(_REPO, "artifacts",
                         "ledger_dryrun_r11_4dev.jsonl")
+    all_events = telemetry.load_ledger(path)
+    run_ids = telemetry_report.runs(all_events)
+    assert len(run_ids) == 2
+    cold = [e for e in all_events if e.get("run") == run_ids[0]]
+    warm = [e for e in all_events if e.get("run") == run_ids[1]]
+    for events in (cold, warm):
+        assert events[0]["ev"] == "provenance"
+        assert len(events[0]["git_commit"]) == 40
+        assert any(e["ev"] == "runtime" and e["device_count"] == 4
+                   for e in events)
+        assert set(telemetry_report.family_table(events)) \
+            == FAMILIES_PRE_CRDT
+    warm_fam = telemetry_report.family_table(warm)
+    budgets = graft_entry.dryrun_steady_budgets()
+    assert all(warm_fam[f]["steady_ms"] <= budgets[f] for f in warm_fam)
+    wbudgets = graft_entry.dryrun_first_warm_budgets()
+    assert all(warm_fam[f]["first_ms"] <= wbudgets[f] for f in warm_fam)
+    assert all(e["cache"] == "hit" for e in warm
+               if e.get("ev") == "compile"
+               and e.get("phase") == "first_ms")
+    # the whole warm family set reuses the cold process's executables:
+    # the warm-start win holds with the sweep family included
+    cold_fam = telemetry_report.family_table(cold)
+    cold_total = sum(r["first_ms"] for r in cold_fam.values())
+    warm_total = sum(r["first_ms"] for r in warm_fam.values())
+    assert warm_total * 3 <= cold_total
+
+
+def test_committed_r13_4dev_record_carries_crdt_counter():
+    """The CRDT PR's committed 4-device record
+    (artifacts/ledger_dryrun_r13_4dev.jsonl, the ledger_diff gate
+    baseline since r13): cold+warm pair, FULL current family set —
+    crdt_counter included — warm run all-hit, steady and warm budgets
+    held, >= 3x warm-start aggregate, provenance present."""
+    path = os.path.join(_REPO, "artifacts",
+                        "ledger_dryrun_r13_4dev.jsonl")
     all_events = telemetry.load_ledger(path)
     run_ids = telemetry_report.runs(all_events)
     assert len(run_ids) == 2
@@ -348,8 +387,6 @@ def test_committed_r11_4dev_record_carries_churn_sweep():
     assert all(e["cache"] == "hit" for e in warm
                if e.get("ev") == "compile"
                and e.get("phase") == "first_ms")
-    # the whole warm family set reuses the cold process's executables:
-    # the warm-start win holds with the sweep family included
     cold_fam = telemetry_report.family_table(cold)
     cold_total = sum(r["first_ms"] for r in cold_fam.values())
     warm_total = sum(r["first_ms"] for r in warm_fam.values())

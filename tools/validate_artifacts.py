@@ -91,14 +91,14 @@ def _has_provenance_keys(obj) -> bool:
 
 
 def _is_nemesis_name(name: str) -> bool:
-    """Churn/nemesis/crashloop scenario artifacts by name — robustness
-    evidence (heal convergence, fault observables, SIGKILL/resume
-    records) must always be attributable; the legacy allowlist can
-    never grandfather one in (the whole nemesis layer, and the
-    crashloop harness on top of it, post-date the provenance
-    schema)."""
+    """Churn/nemesis/crashloop/CRDT scenario artifacts by name —
+    robustness evidence (heal convergence, fault observables,
+    SIGKILL/resume records, value-convergence verdicts) must always be
+    attributable; the legacy allowlist can never grandfather one in
+    (the whole nemesis layer, the crashloop harness, and the CRDT
+    subsystem all post-date the provenance schema)."""
     return ("churn" in name or "nemesis" in name
-            or "crashloop" in name)
+            or "crashloop" in name or "crdt" in name)
 
 
 def validate_file(path):
