@@ -1085,9 +1085,20 @@ def cmd_crdt(a) -> int:
 
 
 def cmd_serve(a) -> int:
+    from gossip_tpu.config import ServingConfig
     from gossip_tpu.rpc.sidecar import serve
-    server, port = serve(a.port, a.workers)
-    print(json.dumps({"serving": True, "port": port}), flush=True)
+    batching = None
+    if not a.no_batching:
+        try:
+            batching = ServingConfig(tick_ms=a.batch_tick_ms,
+                                     max_batch=a.batch_max,
+                                     max_queue=a.batch_queue)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    server, port = serve(a.port, a.workers, batching=batching)
+    print(json.dumps({"serving": True, "port": port,
+                      "batching": batching is not None}), flush=True)
     server.wait_for_termination()
     return 0
 
@@ -1348,7 +1359,18 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     p = sub.add_parser("serve", help="start the gRPC sidecar")
     p.add_argument("--port", type=int, default=50051)
-    p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--workers", type=int, default=16)
+    p.add_argument("--no-batching", action="store_true",
+                   help="disable the admission-batching serving layer "
+                        "(per-request solo dispatch, the pre-serving "
+                        "behavior)")
+    p.add_argument("--batch-tick-ms", type=float, default=20.0,
+                   help="admission collector cadence (docs/SERVING.md)")
+    p.add_argument("--batch-max", type=int, default=64,
+                   help="per-tick per-key megabatch lane cap")
+    p.add_argument("--batch-queue", type=int, default=256,
+                   help="backpressure cap: admissions past this depth "
+                        "get RESOURCE_EXHAUSTED")
     _add_cache_flags(p)
     p.set_defaults(fn=cmd_serve)
 

@@ -558,6 +558,43 @@ class RunConfig:
                              f"choose from {ENGINES}")
 
 
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Admission-batching knobs for the gRPC sidecar (rpc/batcher).
+
+    The serving layer coalesces in-flight ``Run``/``Ensemble`` requests
+    into one device-resident megabatch per collector tick
+    (parallel/sweep.request_sweep_curves); these are the queue-shape
+    parameters — everything about WHICH requests may share an
+    executable lives in the batch key (rpc/batcher.batch_key,
+    docs/SERVING.md memo-key vs operand table), not here.
+
+    * ``tick_ms`` — the collector cadence: every tick the queue drains
+      and each batch-key group runs as one megabatch.  Smaller ticks
+      trade batch size for admission latency.
+    * ``max_batch`` — per-tick per-key cap on coalesced requests
+      (ensemble members count individually); the rest stay queued for
+      the next tick.
+    * ``max_queue`` — the backpressure cap: an admission past this
+      depth is rejected with RESOURCE_EXHAUSTED instead of growing the
+      queue without bound (the reply tells the client to back off —
+      SidecarClient's retry policy treats it as a well-formed error,
+      never a transport failure).
+    """
+
+    tick_ms: float = 20.0
+    max_batch: int = 64
+    max_queue: int = 256
+
+    def __post_init__(self):
+        if self.tick_ms <= 0:
+            raise ValueError("tick_ms must be > 0")
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if self.max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+
+
 EXCHANGES = ("dense", "sparse", "halo")
 
 

@@ -236,6 +236,69 @@ def build_stack(faults, n: int, n_pad: Optional[int] = None) -> Schedule:
         drop_tbl=jnp.stack([s.drop_tbl for s in scheds]))
 
 
+def build_or_static(fault: Optional[FaultConfig], n: int,
+                    n_pad: Optional[int] = None,
+                    t_pad: Optional[int] = None) -> Schedule:
+    """A Schedule for ANY fault — churn-free configs (``fault`` None,
+    or carrying no churn) lower to the trivially-steady tables:
+    die/rec all :data:`NEVER`, every partition window closed (-1), and
+    the drop table flat at the static ``drop_prob``.  Consuming THESE
+    tables is bitwise identical to the static kernels' no-schedule
+    path (a NEVER row never kills, a closed cut destroys nothing, and
+    a constant drop table reproduces the static drop coins exactly —
+    the canonical-padding argument: the tables ARE the steady state).
+    This is what lets a serving megabatch mix churn-carrying and
+    churn-free requests in ONE operand stack (rpc/batcher)."""
+    n_pad = n if n_pad is None else n_pad
+    if get(fault) is not None:
+        return build(fault, n, n_pad=n_pad, t_pad=t_pad)
+    t_pad = SCHED_T_MIN if t_pad is None else t_pad
+    dp = 0.0 if fault is None else float(fault.drop_prob)
+    # numpy, not jnp: a jnp.full per distinct drop_prob VALUE is a
+    # fresh constant program — serving assembles schedule content with
+    # zero compiles (build_request_stack rationale)
+    import numpy as np
+    return Schedule(
+        die=np.full((n_pad,), NEVER, np.int32),
+        rec=np.full((n_pad,), NEVER, np.int32),
+        cut_tbl=np.full((t_pad,), -1, np.int32),
+        drop_tbl=np.full((t_pad,), dp, np.float32))
+
+
+def build_request_stack(faults, ns, n_pad: int) -> Schedule:
+    """K per-request ``(fault, n)`` pairs -> ONE stacked Schedule with
+    a leading request axis — the heterogeneous twin of
+    :func:`build_stack` for the admission-batched serving path
+    (rpc/batcher + parallel/sweep.request_sweep_curves): entries may be
+    churn-free (lowered by :func:`build_or_static`), each request
+    validates its events against its OWN ``n``, and all tables align
+    to the stack's largest canonical bucket.  The batch-key contract:
+    everything here is CONTENT (schedule tables, per-request alive
+    masks) and flows as runtime operands; only the bucket SHAPES
+    (n_pad, the shared horizon) reach the compiled program."""
+    faults = tuple(faults)
+    ns = tuple(ns)
+    if not faults:
+        raise ValueError("build_request_stack needs at least one entry")
+    if len(faults) != len(ns):
+        raise ValueError(f"{len(faults)} faults vs {len(ns)} sizes")
+    t_pad = max([SCHED_T_MIN] + [canonical_horizon(f.churn)
+                                 for f in faults if get(f) is not None])
+    scheds = [build_or_static(f, n, n_pad=n_pad, t_pad=t_pad)
+              for f, n in zip(faults, ns)]
+    # NUMPY stacking on purpose: the stack axis K varies tick to tick
+    # in serving, and a jnp.stack over K inputs is a fresh tiny XLA
+    # program per distinct K — steady-state serving must assemble
+    # operand CONTENT without ever touching the compile path (the
+    # load-harness all-warm gate)
+    import numpy as np
+    return Schedule(
+        die=np.stack([np.asarray(s.die) for s in scheds]),
+        rec=np.stack([np.asarray(s.rec) for s in scheds]),
+        cut_tbl=np.stack([np.asarray(s.cut_tbl) for s in scheds]),
+        drop_tbl=np.stack([np.asarray(s.drop_tbl) for s in scheds]))
+
+
 def placeholder_trace_inputs(fault_static: FaultConfig, n: int,
                              have_table: bool):
     """(rep_fault, topo_placeholder) for the shape-only memoized loop

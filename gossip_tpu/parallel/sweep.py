@@ -335,6 +335,390 @@ def churn_sweep_curves(proto: ProtocolConfig, topo: Topology,
                             target=run.target_coverage)
 
 
+# ---------------------------------------------------------------------------
+# Request-batched serving (the admission batcher's megabatch driver,
+# rpc/batcher): K heterogeneous REQUESTS — distinct (mode, fanout-shared,
+# drop, period, seed, origin, target, n-within-bucket, rumors, static
+# fault, churn schedule) — through ONE compiled scan.  This generalizes
+# churn_sweep_curves (one proto, K schedules) to per-request protocol
+# operands, and config_sweep_curves (K protos, no schedules) to
+# per-request nemesis schedule stacks.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestSpec:
+    """One serving request's simulation config, megabatch-shaped.
+
+    The batch-key contract (rpc/batcher module doc): everything in here
+    EXCEPT ``proto.fanout``, ``proto.exclude_self``, ``run.max_rounds``
+    and the topology/n-bucket is a runtime OPERAND of the one compiled
+    scan — mode flags, period, seed, origin, target, drop probability,
+    the static death mask, and the whole churn schedule all vary freely
+    within a batch without retracing.  ``fanout`` is the shared draw
+    width because trajectories are a function of (config, draw width):
+    only fanout == k reproduces the solo run bitwise (the
+    config_sweep_curves k_max contract), and serving promises bitwise
+    solo parity."""
+    proto: ProtocolConfig
+    run: RunConfig
+    fault: Optional[FaultConfig]
+    n: int
+
+    def __post_init__(self):
+        if self.proto.mode not in _MODE_FLAGS:
+            raise ValueError(
+                f"request batching supports {sorted(_MODE_FLAGS)}; got "
+                f"{self.proto.mode!r} (flood/swim/rumor change the round "
+                "structure — dispatch them solo)")
+        if not self.proto.exclude_self:
+            raise ValueError("request batching samples with the shared "
+                             "exclude_self=True contract")
+        if self.proto.period > 1 and self.proto.mode != C.ANTI_ENTROPY:
+            raise ValueError("period > 1 is the anti-entropy cadence")
+        if self.n < 2:
+            raise ValueError("request batching needs n >= 2 (the traced "
+                             "peer bound's self-exclusion shift)")
+
+
+@dataclasses.dataclass
+class RequestSweepResult:
+    """K requests through one compiled scan: stacked per-round buffers
+    plus the per-request readouts split back out of them
+    (:meth:`metrics_rows`).  ``curves``/``msgs``/``dropped`` are
+    [K, T]; ``state_digests`` are sha256 hexes of each request's final
+    ``seen`` block truncated to its OWN (n, rumors) — bitwise the solo
+    run's final state (pinned in tests/test_serving.py)."""
+    specs: tuple
+    curves: np.ndarray            # float32[K, T]
+    msgs: np.ndarray              # float32[K, T]
+    dropped: np.ndarray           # float32[K, T]
+    rounds_to_target: np.ndarray  # int[K], -1 where never reached
+    state_digests: tuple          # str[K]
+
+    def metrics_rows(self):
+        """Per-request round-metrics rows split out of the stacked
+        buffers — the serving reply's observability payload (coverage
+        curve, cumulative msgs, exact per-round destroyed-message
+        counts) in ledger-friendly plain lists."""
+        out = []
+        for i, spec in enumerate(self.specs):
+            out.append({
+                "mode": spec.proto.mode, "n": spec.n,
+                "rounds": int(self.curves.shape[1]),
+                "coverage": [float(c) for c in self.curves[i]],
+                "msgs": [float(m) for m in self.msgs[i]],
+                "dropped": [float(d) for d in self.dropped[i]],
+                "dropped_total": float(self.dropped[i].sum()),
+                "rounds_to_target": int(self.rounds_to_target[i]),
+            })
+        return out
+
+
+def _pow2_at_least(x: int, lo: int = 1) -> int:
+    """The smallest power of two >= max(x, lo) — the serving bucket
+    function (n-bucket, rumor bucket, batch-lane bucket)."""
+    x = max(int(x), lo)
+    return 1 << (x - 1).bit_length()
+
+
+@functools.lru_cache(maxsize=16)
+def _cached_request_sweep_scan(n_pad: int, k: int, r_max: int,
+                               have_table: bool, need_push: bool,
+                               need_pull: bool, have_ae: bool,
+                               max_rounds: int):
+    """The request megabatch's compiled scan, memoized by EXACTLY the
+    statics its trace bakes: the pow2 n-bucket, the shared draw width
+    ``k``, the rumor bucket, implicit-vs-table, the batch's
+    half-elision switches, and the scan length.  Everything
+    request-specific — mode flags, period, seed keys, per-request n
+    and rumor count, static alive masks, metric denominators, and the
+    four stacked nemesis schedule tables — arrives as runtime
+    operands, so K compatible requests compile ONCE and every later
+    same-bucket batch re-enters the executable (compile-count pinned
+    in tests/test_serving.py, the _cached_churn_sweep_scan memo
+    discipline).
+
+    The callable takes ``(seen0, keys, msgs0, do_push, do_pull, do_ae,
+    period, n_pt, r_pt, base_alive, metric_alive, die, rec, cut_tbl,
+    drop_tbl, *topo_tables)`` — all leading-[K] stacks except the
+    shared topology tables — and returns ``(final_seen, counts, msgs,
+    lost)`` with [T, K] per-round buffers.  The coverage readout
+    leaves the device as an EXACT integer count per request (the
+    _cached_churn_sweep_scan rationale: integer sums are order-exact;
+    the one division happens per request on the host, emulating the
+    solo path's own lowering — see request_sweep_curves)."""
+    if have_table:
+        topo_ph = Topology(nbrs=jnp.zeros((0, 0), jnp.int32),
+                           deg=jnp.zeros((0,), jnp.int32), n=n_pad,
+                           family="placeholder")
+    else:
+        topo_ph = Topology(nbrs=None, deg=None, n=n_pad,
+                           family="complete")
+    colr = jnp.arange(r_max, dtype=jnp.int32)
+
+    def one_req(seen, round_, base_key, msgs, do_push, do_pull, do_ae,
+                period, n_pt, r_pt, base_alive, metric_alive,
+                die, rec_, cut_row, drop_row, topo_tbl):
+        nbrs, deg = topo_tbl if topo_tbl else (None, None)
+        gids = jnp.arange(n_pad, dtype=jnp.int32)
+        r = jnp.asarray(round_, jnp.int32)
+        # per-round liveness / cut / drop from the request's OWN
+        # schedule operands — the clamped steady-row lookup
+        # (ops/nemesis._idx semantics, inlined over the [K, T] stack)
+        down = (die <= r) & (r < rec_)
+        alive = base_alive & ~down
+        idx = jnp.minimum(jnp.maximum(r, 0), cut_row.shape[0] - 1)
+        cut = cut_row[idx]
+        dp = drop_row[idx]
+        rkey = jax.random.fold_in(base_key, r)
+        visible = seen & alive[:, None]
+        delta, msgs_r, lost = _sweep_round_delta(
+            rkey, r, gids, visible, alive, topo_ph, k, nbrs, deg,
+            do_push, do_pull, do_ae, jnp.int32(k), dp, period, have_ae,
+            scatter_n=n_pad, count_reduce=lambda c: c,
+            gather=lambda v: v, need_push=need_push,
+            need_pull=need_pull,
+            peer_bound=(None if have_table else n_pt),
+            cut=cut, want_lost=True)
+        seen = seen | delta
+        # integer coverage count: min over the request's REAL rumor
+        # columns of its metric-alive entry count (phantom columns are
+        # all-false and would win an unmasked min)
+        cnt_r = jnp.sum(seen & metric_alive[:, None], axis=0,
+                        dtype=jnp.int32)
+        cnt = jnp.min(jnp.where(colr < r_pt, cnt_r,
+                                jnp.int32(n_pad + 1)))
+        return seen, msgs + msgs_r, cnt, lost
+
+    @jax.jit
+    def scan(seen0, seeds, msgs0, do_push, do_pull, do_ae, period,
+             n_pt, r_pt, base_alive, metric_alive, die, rec_, cut_tbl,
+             drop_tbl, *table):
+        # key derivation INSIDE the compiled program: a host-side
+        # vmapped jax.random.key over K seeds would be a fresh tiny
+        # XLA program per distinct K — serving ticks vary K, and
+        # steady-state serving must never compile.  Same key values as
+        # the solo init_state (jax.random.key(seed)) by construction.
+        keys = jax.vmap(jax.random.key)(seeds)
+
+        def body(carry, round_):
+            seen, msgs = carry
+            seen, msgs, cnts, lost = jax.vmap(
+                lambda s, key, m, a, b, c, p, npt, rpt, ba, ma, di, re,
+                cu, dr: one_req(s, round_, key, m, a, b, c, p, npt,
+                                rpt, ba, ma, di, re, cu, dr, table)
+            )(seen, keys, msgs, do_push, do_pull,
+              do_ae, period, n_pt, r_pt, base_alive, metric_alive,
+              die, rec_, cut_tbl, drop_tbl)
+            return (seen, msgs), (cnts, msgs, lost)
+        (seen_f, _), out = jax.lax.scan(
+            body, (seen0, msgs0),
+            jnp.arange(max_rounds, dtype=jnp.int32))
+        return (seen_f,) + out
+    return scan
+
+
+def request_sweep_curves(specs, topo: Optional[Topology] = None,
+                         n_pad: Optional[int] = None, mesh=None,
+                         axis_name: str = "request", lanes=None,
+                         full: bool = False,
+                         timing=None) -> RequestSweepResult:
+    """Run K heterogeneous serving REQUESTS as ONE batched XLA program
+    — the megabatch the admission batcher (rpc/batcher) dispatches per
+    tick.  Every request's (mode, drop, period, seed, origin, target,
+    static fault, churn schedule, n-within-bucket, rumors-within-
+    bucket) is a runtime operand; the compiled scan is shared by the
+    whole bucket (see :func:`_cached_request_sweep_scan` for the
+    memo-key vs operand split, and docs/SERVING.md for the table).
+
+    Bitwise contract (pinned in tests/test_serving.py): request i's
+    coverage curve, cumulative msgs, rounds-to-target, and final seen
+    state equal its SOLO ``runtime/simulator.simulate_curve`` dispatch
+    byte for byte — same threefry streams (draws keyed by global id,
+    so pow2 row padding is inert), same drop/cut order, and a host
+    readout that emulates the solo coverage division exactly (the
+    no-fault solo path lowers mean() as a recip-mul; the
+    fault/churn-weighted path as a true division — both measured on
+    this toolchain and reproduced per request below).
+
+    ``topo``: None = the implicit complete family (requests may differ
+    in n within the pow2 ``n_pad`` bucket — phantom rows are inert by
+    the config_sweep ragged contract); a Topology = one shared
+    explicit table (every request's n must equal it).  ``lanes`` pads
+    the batch to a pow2 lane count with inert all-masked dummies so
+    every batch size in a bucket shares one executable.  ``mesh``: an
+    optional 1-D mesh shards the request axis (value-invariant,
+    embarrassingly parallel — _shard_ensemble)."""
+    specs = tuple(specs)
+    if not specs:
+        raise ValueError("need at least one RequestSpec")
+    kset = {sp.proto.fanout for sp in specs}
+    if len(kset) > 1:
+        raise ValueError(
+            f"request batch mixes fanouts {sorted(kset)}: the draw "
+            "width is the one static the solo-bitwise contract pins "
+            "(group by fanout in the batch key)")
+    k = kset.pop()
+    mrset = {sp.run.max_rounds for sp in specs}
+    if len(mrset) > 1:
+        raise ValueError(
+            f"request batch mixes max_rounds {sorted(mrset)}: the scan "
+            "length is static (group by max_rounds in the batch key)")
+    max_rounds = mrset.pop()
+    have_table = topo is not None
+    if have_table:
+        bad = [sp.n for sp in specs if sp.n != topo.n]
+        if bad:
+            raise ValueError(
+                f"explicit-table requests must match the shared "
+                f"topology's n={topo.n}; got {bad}")
+        if n_pad is not None and n_pad != topo.n:
+            raise ValueError("explicit-table batches keep n_pad == n")
+        n_pad = topo.n
+    else:
+        want = _pow2_at_least(max(sp.n for sp in specs), 2)
+        n_pad = want if n_pad is None else n_pad
+        if n_pad < want:
+            raise ValueError(f"n_pad={n_pad} below the batch's pow2 "
+                             f"bucket {want}")
+    r_max = _pow2_at_least(max(sp.proto.rumors for sp in specs))
+    kN = len(specs)
+    lanes = _pow2_at_least(kN) if lanes is None else lanes
+    if lanes < kN:
+        raise ValueError(f"lanes={lanes} below the batch size {kN}")
+    # half-elision switches are batch-COMPOSITION statics; ``full=True``
+    # (the serving batcher) pins all three ON so every tick of a bucket
+    # shares ONE executable regardless of which modes happened to
+    # coalesce — a masked absent half is bitwise inert (the disjoint-
+    # RNG-tag elision contract in _sweep_round_delta), and steady-state
+    # serving must never compile because a mode combination was new
+    need_push = full or any(_MODE_FLAGS[sp.proto.mode][0]
+                            for sp in specs)
+    need_pull = full or any(_MODE_FLAGS[sp.proto.mode][1]
+                            for sp in specs)
+    have_ae = full or any(sp.proto.mode == C.ANTI_ENTROPY
+                          for sp in specs)
+
+    # -- per-request operand stacks (host-side; all CONTENT) ----------
+    seen0 = np.zeros((lanes, n_pad, r_max), np.bool_)
+    base_alive = np.zeros((lanes, n_pad), np.bool_)
+    metric_alive = np.zeros((lanes, n_pad), np.bool_)
+    weighted = []
+    denoms = []
+    from gossip_tpu.models.state import alive_mask
+    for i, sp in enumerate(specs):
+        # models/state.init_state's seeding formula (rumor r starts at
+        # (origin + r) % n) in numpy — a jitted init per distinct
+        # origin would be a tiny compile per request content
+        cols = np.arange(sp.proto.rumors)
+        seen0[i, (sp.run.origin + cols) % sp.n, cols] = True
+        # fault-free requests (the common serving case) assemble their
+        # masks with ZERO jax work — a jnp.ones per new n-within-bucket
+        # would compile inside the serving window.  Fault-bearing masks
+        # stay jax-side on purpose: the bernoulli death draw IS the
+        # value the bitwise contract pins, and its tiny programs are
+        # shape-keyed (warmed by the mix's first occurrence).
+        am = alive_mask(sp.fault, sp.n, sp.run.origin)
+        base_alive[i, :sp.n] = True if am is None else np.asarray(am)
+        ma = NE.metric_alive(sp.fault, sp.n, sp.run.origin)
+        weighted.append(ma is not None)
+        if ma is None:
+            metric_alive[i, :sp.n] = True
+            denoms.append(float(sp.n))
+        else:
+            ma = np.asarray(ma)
+            metric_alive[i, :sp.n] = ma
+            denoms.append(float(ma.sum()))
+    sched = NE.build_request_stack(
+        [sp.fault for sp in specs], [sp.n for sp in specs], n_pad)
+    # all remaining operand assembly is NUMPY by design: the lane
+    # count varies tick to tick in serving, and any jnp op over a
+    # K-sized input is a fresh tiny XLA program per distinct K —
+    # steady-state serving assembles content with ZERO compiles (the
+    # load-harness all-warm gate; only the memoized scan itself is a
+    # compiled program, shared per bucket)
+    pad = lanes - kN
+    if pad:
+        sched = NE.Schedule(
+            die=np.concatenate([sched.die, np.full(
+                (pad, n_pad), NE.NEVER, np.int32)]),
+            rec=np.concatenate([sched.rec, np.full(
+                (pad, n_pad), NE.NEVER, np.int32)]),
+            cut_tbl=np.concatenate([sched.cut_tbl, np.full(
+                (pad, sched.cut_tbl.shape[1]), -1, np.int32)]),
+            drop_tbl=np.concatenate([sched.drop_tbl, np.zeros(
+                (pad, sched.drop_tbl.shape[1]), np.float32)]))
+    seeds = np.asarray([sp.run.seed for sp in specs] + [0] * pad,
+                       np.uint32)
+
+    def vec(fn, dtype, dummy):
+        return np.asarray([fn(sp) for sp in specs] + [dummy] * pad,
+                          dtype)
+
+    # dummy lanes are fully inert: no half enabled, all-dead masks —
+    # their draws exist but their deltas/counts are discarded
+    do_push = vec(lambda sp: _MODE_FLAGS[sp.proto.mode][0], np.bool_,
+                  False)
+    do_pull = vec(lambda sp: _MODE_FLAGS[sp.proto.mode][1], np.bool_,
+                  False)
+    do_ae = vec(lambda sp: sp.proto.mode == C.ANTI_ENTROPY, np.bool_,
+                False)
+    period = vec(lambda sp: sp.proto.period, np.int32, 1)
+    n_pt = vec(lambda sp: sp.n, np.int32, 2)
+    r_pt = vec(lambda sp: sp.proto.rumors, np.int32, 1)
+
+    scan = _cached_request_sweep_scan(n_pad, k, r_max, have_table,
+                                      need_push, need_pull, have_ae,
+                                      max_rounds)
+    ops = [seen0, seeds,
+           np.zeros((lanes,), np.float32), do_push, do_pull, do_ae,
+           period, n_pt, r_pt, base_alive,
+           metric_alive] + list(NE.sched_args(sched))
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if lanes % mesh.shape[axis_name] != 0:
+            raise ValueError(
+                f"{lanes} request lanes do not divide over the "
+                f"{axis_name} mesh axis of size "
+                f"{mesh.shape[axis_name]}")
+        ops = [jax.device_put(x, NamedSharding(
+            mesh, P(axis_name, *([None] * (x.ndim - 1))))) for x in ops]
+    topo_tbl = (topo.nbrs, topo.deg) if have_table else ()
+    from gossip_tpu.utils.trace import maybe_aot_timed
+    seen_f, cnts, msgs, lost = maybe_aot_timed(scan, timing, *ops,
+                                               *topo_tbl)
+
+    # -- per-request readouts split back out of the stacked buffers --
+    cnts = np.asarray(cnts).T[:kN]       # [K, T] exact integers
+    msgs = np.asarray(msgs).T[:kN]
+    lost = np.asarray(lost).T[:kN]
+    seen_f = np.asarray(seen_f)
+    curves = np.empty_like(cnts, dtype=np.float32)
+    rtt = np.full(kN, -1, np.int64)
+    digests = []
+    import hashlib
+    for i, sp in enumerate(specs):
+        c = cnts[i].astype(np.float32)
+        if weighted[i]:
+            # the solo weighted readout is a true f32 division
+            # (coverage()'s sum/w.sum() — measured lowering)
+            curves[i] = c / np.float32(denoms[i])
+        else:
+            # the solo no-fault readout is jnp.mean, which lowers as a
+            # reciprocal MULTIPLY (measured; true division differs by
+            # 1 ulp on some counts) — emulate it exactly
+            curves[i] = c * (np.float32(1.0) / np.float32(denoms[i]))
+        hit = np.nonzero(curves[i] >= sp.run.target_coverage)[0]
+        rtt[i] = int(hit[0]) + 1 if len(hit) else -1
+        block = np.ascontiguousarray(
+            seen_f[i, :sp.n, :sp.proto.rumors])
+        digests.append(hashlib.sha256(block.tobytes()).hexdigest())
+    return RequestSweepResult(specs=specs, curves=curves, msgs=msgs,
+                              dropped=lost, rounds_to_target=rtt,
+                              state_digests=tuple(digests))
+
+
 @functools.lru_cache(maxsize=16)
 def _cached_pod_sweep_scan(n: int, n_pad: int, nl: int, k_max: int,
                            have_ae: bool, need_push: bool, need_pull: bool,
@@ -704,12 +1088,15 @@ def _drop_targets(rkey, tag, gids, targets, drop_prob, sentinel):
 def _sweep_round_delta(rkey, round_, gids, visible, alive_l, topo, k_max,
                        nbrs, deg, do_push, do_pull, do_ae, fanout, dropp,
                        period, have_ae, scatter_n, count_reduce, gather,
-                       need_push=True, need_pull=True, peer_bound=None):
+                       need_push=True, need_pull=True, peer_bound=None,
+                       cut=None, want_lost=False):
     """The ONE per-config sweep round body — shared by the single-device
-    batch and the 2-D pod sweep, which differ only in how scatter counts
-    reduce (``count_reduce``), how the digest table is assembled
-    (``gather``), and the scatter sentinel (``scatter_n``).  Returns
-    (delta, msgs_this_round) for this row block.
+    batch, the 2-D pod sweep, and the request-batched serving driver,
+    which differ only in how scatter counts reduce (``count_reduce``),
+    how the digest table is assembled (``gather``), and the scatter
+    sentinel (``scatter_n``).  Returns (delta, msgs_this_round) for
+    this row block — plus the nemesis ``lost`` count with
+    ``want_lost=True``.
 
     ``need_push``/``need_pull`` are STATIC elision switches (VERDICT r2
     item 7): when no point in the batch pushes (resp. pulls), the whole
@@ -723,11 +1110,20 @@ def _sweep_round_delta(rkey, round_, gids, visible, alive_l, topo, k_max,
     traced scalar, bounding its uniform partner draw on the complete
     graph — randint with a traced bound reproduces the solo static-n
     draw bitwise (sample_peers_complete).  None keeps the static
-    ``topo.n`` path, byte-identical to the pre-round-4 lowering."""
+    ``topo.n`` path, byte-identical to the pre-round-4 lowering.
+
+    ``cut`` (the request-batched serving path): a traced per-round
+    partition cut (ops/nemesis cut_tbl lookup, -1 = closed) applied
+    AFTER the drop coins, in exactly models/si.make_si_round's churn
+    order, so a batched request's trajectory stays bitwise the solo
+    churn run.  ``want_lost=True`` additionally returns the kernels'
+    EXACT destroyed-message count (drop coins + open cut) as a third
+    output, gated per config by the same do_push/on masks as msgs."""
     n = topo.n
     col = jnp.arange(k_max, dtype=jnp.int32)[None, :]
     delta = jnp.zeros_like(visible)
     msgs = jnp.float32(0.0)
+    lost = jnp.float32(0.0)
 
     def _peers(key):
         if peer_bound is not None:
@@ -735,32 +1131,53 @@ def _sweep_round_delta(rkey, round_, gids, visible, alive_l, topo, k_max,
         return sample_peers(key, gids, topo, k_max, True,
                             local_nbrs=nbrs, local_deg=deg)
 
+    def _cut(targets):
+        # closed-cut rounds (cut = -1) are a bitwise no-op, so the
+        # no-churn solo trajectory is reproduced exactly (ops/nemesis
+        # same_side contract)
+        if cut is None:
+            return targets
+        return NE.partition_targets(cut, gids, targets, n)
+
     if need_push:
         # push half (masked by do_push for non-push configs in the batch)
         pkey = jax.random.fold_in(rkey, si_mod.PUSH_TAG)
-        targets = _peers(pkey)
-        targets = jnp.where(col < fanout, targets, jnp.int32(n))
-        targets = _drop_targets(rkey, si_mod.PUSH_DROP_TAG, gids, targets,
+        targets0 = _peers(pkey)
+        targets0 = jnp.where(col < fanout, targets0, jnp.int32(n))
+        targets = _drop_targets(rkey, si_mod.PUSH_DROP_TAG, gids, targets0,
                                 dropp, n)
+        targets = _cut(targets)
         sender_active = jnp.any(visible, axis=1)
         valid = (targets < n) & sender_active[:, None]
         counts = push_counts(scatter_n,
                              jnp.where(valid, targets, scatter_n), visible)
         delta = (count_reduce(counts) > 0) & do_push
         msgs = jnp.where(do_push, jnp.sum(valid).astype(jnp.float32), 0.0)
+        if want_lost:
+            lost = lost + jnp.where(
+                do_push,
+                NE.lost_count(targets0, targets, sender_active, n), 0.0)
 
     if need_pull:
         # pull half (anti-entropy = bidirectional exchange gated by period)
         seen_all = gather(visible)
         qkey = jax.random.fold_in(rkey, si_mod.PULL_TAG)
-        partners = _peers(qkey)
-        partners = jnp.where(col < fanout, partners, jnp.int32(n))
-        partners = _drop_targets(rkey, si_mod.PULL_DROP_TAG, gids, partners,
-                                 dropp, n)
+        partners0 = _peers(qkey)
+        partners0 = jnp.where(col < fanout, partners0, jnp.int32(n))
+        partners = _drop_targets(rkey, si_mod.PULL_DROP_TAG, gids,
+                                 partners0, dropp, n)
+        partners = _cut(partners)
         pulled = pull_merge(seen_all, partners, n)
         partners = jnp.where(alive_l[:, None], partners, n)
         n_req = jnp.sum(partners < n).astype(jnp.float32)
         on = do_pull & ((round_ % period) == 0)
+        if want_lost:
+            # post-alive-mask partners, alive requesters: a dead row's
+            # slot carried no request to lose, and a quiescent AE round
+            # sends nothing (`on` covers both; period == 1 keeps plain
+            # pull always-on) — models/si.py's exact churn accounting
+            lost = lost + jnp.where(
+                on, NE.lost_count(partners0, partners, alive_l, n), 0.0)
         delta = delta | (pulled & on)
         if have_ae:
             # anti-entropy reverse delta: the initiator's state scatters
@@ -772,7 +1189,8 @@ def _sweep_round_delta(rkey, round_, gids, visible, alive_l, topo, k_max,
             delta = delta | ((count_reduce(bcounts) > 0) & (on & do_ae))
         mfac = jnp.where(do_ae, 3.0, 2.0)
         msgs = msgs + jnp.where(on, mfac * n_req, 0.0)
-    return delta & alive_l[:, None], msgs
+    out = delta & alive_l[:, None]
+    return (out, msgs, lost) if want_lost else (out, msgs)
 
 
 def _normalize_topos(topo, points):

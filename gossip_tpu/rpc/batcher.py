@@ -1,0 +1,507 @@
+"""Admission batching: coalesce concurrent sidecar RPCs into one
+device-resident megabatch per tick.
+
+The reference program is a *server* — one node answering an open-ended
+stream of client RPCs under the Maelstrom harness (PAPER.md §1) — yet
+until this layer the gRPC sidecar ran every ``Run``/``Ensemble``
+request as a solo dispatch.  This module is the continuous-batching
+layer LLM inference stacks use, applied to simulation serving: in-flight
+requests enqueue with a deadline, a collector loop drains the queue
+every tick, and requests with compatible static structure run as ONE
+compiled megabatch (parallel/sweep.request_sweep_curves) while
+incompatible requests fall through to the solo path, loudly labeled in
+the reply.
+
+Batch key (memo key) vs operand — the serving analog of the nemesis
+schedule contract (ops/nemesis module doc).  Two requests share an
+executable iff they agree on everything the TRACE bakes:
+
+  ============================  =====================================
+  memo key (static, batch key)  runtime operand (varies per request)
+  ============================  =====================================
+  pow2 n-bucket                 n itself (traced peer bound)
+  topology (explicit families:  —
+    the exact TopologyConfig;
+    implicit complete: family
+    only, n via the bucket)
+  fanout (the shared draw        mode (do_push/do_pull/do_ae flags)
+    width — the solo-bitwise
+    contract, RequestSpec doc)
+  pow2 rumor bucket             rumors itself (phantom-column mask)
+  max_rounds (scan length)      target_coverage (host-side readout)
+  exclude_self                  seed, origin (key + seen operands)
+  mesh (None: single-device)    drop_prob (the drop table)
+  —                             static death mask (alive operands)
+  —                             the whole churn schedule
+                                  (nemesis.build_request_stack)
+  ============================  =====================================
+
+Everything else about the serving queue (tick cadence, per-tick batch
+cap, backpressure depth) lives in :class:`~gossip_tpu.config
+.ServingConfig`.  Deadlines: the client's RPC timeout must bound queue
+wait + run, so a request admitted but expired before its tick is
+rejected with DEADLINE_EXCEEDED (and ledgered) instead of silently run
+late.  Backpressure: an admission past ``max_queue`` lanes is rejected
+with RESOURCE_EXHAUSTED immediately.
+
+Telemetry: one ``batch`` event per executed group on the ambient run
+ledger (utils/telemetry) — queue depth at drain, batch size/lanes,
+wait/run walls, and the compile verdict (backend-compile delta around
+the megabatch: steady-state serving must be ``warm``) — rendered by
+tools/batching_report.py and gated by tools/load_harness.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from functools import lru_cache
+from typing import Optional, Tuple
+
+from gossip_tpu import config as C
+from gossip_tpu.config import ServingConfig, TopologyConfig
+
+BATCHABLE_MODES = (C.PUSH, C.PULL, C.PUSH_PULL, C.ANTI_ENTROPY)
+
+
+class BatchError(Exception):
+    """Base class for serving-layer rejections (the handler maps each
+    subclass to its gRPC status code)."""
+
+
+class QueueFull(BatchError):
+    """Backpressure: the admission queue is at ``max_queue`` lanes."""
+
+
+class TooLarge(BatchError):
+    """The request needs more lanes than ``max_batch`` — it could never
+    be scheduled (it would cycle through the leftover queue forever),
+    so admission refuses it up front; the handler maps this to
+    INVALID_ARGUMENT."""
+
+
+class Closed(BatchError):
+    """The batcher is shut down (``close()``): no collector will ever
+    drain this queue again, so admission refuses instead of stranding
+    the handler thread on an event nobody will set; the handler maps
+    this to UNAVAILABLE (a transient the client may retry against a
+    restarted server)."""
+
+
+class Expired(BatchError):
+    """The request's deadline passed before its batch tick ran."""
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchKey:
+    """The compiled-executable identity of a batchable request — see
+    the module-doc memo-key vs operand table.  Requests coalesce iff
+    their keys are equal."""
+    n_bucket: int
+    rounds: int
+    fanout: int
+    rumor_bucket: int
+    topology: Optional[TopologyConfig]   # None = implicit complete
+
+    def describe(self) -> dict:
+        return {"n_bucket": self.n_bucket, "rounds": self.rounds,
+                "fanout": self.fanout,
+                "rumor_bucket": self.rumor_bucket,
+                "topology": (self.topology.family
+                             if self.topology is not None
+                             else "complete")}
+
+
+def deadline_of(context) -> Optional[float]:
+    """The request's absolute monotonic deadline from its gRPC context
+    (None = no client timeout).  This is what makes the client timeout
+    bound queue wait + run: the collector refuses to run a request
+    whose deadline already passed."""
+    rem = context.time_remaining()
+    if rem is None:
+        return None
+    return time.monotonic() + float(rem)
+
+
+def classify_run(args):
+    """``(key, spec, want_curve)`` for a batchable Run request, or
+    ``(None, reason, None)`` naming the first incompatibility — the
+    reason lands verbatim in the solo reply's ``meta["batch"]`` so a
+    fallthrough is always loudly labeled."""
+    from gossip_tpu.parallel.sweep import RequestSpec, _pow2_at_least
+    if args["backend"] != "jax-tpu":
+        return None, f"backend={args['backend']}", None
+    if args["mesh_cfg"] is not None:
+        return None, "mesh requests dispatch solo", None
+    run, proto, tc = args["run"], args["proto"], args["tc"]
+    if run.engine not in ("auto", "xla"):
+        return None, f"engine={run.engine}", None
+    if proto.mode not in BATCHABLE_MODES:
+        return None, f"mode={proto.mode}", None
+    fault = args["fault"]
+    if fault is not None and (fault.dead_nodes or fault.fail_round):
+        # SWIM-scripted scenario fields: the SI solo path defines their
+        # (no-op) meaning; keep that single source of truth
+        return None, "swim-scripted fault fields", None
+    if run.engine == "auto":
+        # on a TPU the solo auto-route picks the fused Pallas engine
+        # for eligible runs (hardware PRNG — a DIFFERENT trajectory
+        # than the XLA megabatch); batching such a request would
+        # silently break the bitwise solo-dispatch contract, so it
+        # falls through to the solo path (labeled).  On CPU this is
+        # never true and auto requests batch normally.
+        from gossip_tpu.backend import _fused_auto_ok
+        if _fused_auto_ok(proto, tc, fault):
+            return None, "engine=auto routes to the fused engine", None
+    try:
+        spec = RequestSpec(proto, run, fault, tc.n)
+        if fault is not None:
+            from gossip_tpu.ops import nemesis as NE
+            # per-request content validation HERE, not at execution:
+            # an out-of-range churn event must fail ITS request (the
+            # solo path's INVALID_ARGUMENT via the fallthrough), never
+            # poison a whole megabatch with INTERNAL
+            NE.validate_events(fault, tc.n)
+    except ValueError as e:
+        return None, str(e).splitlines()[0], None
+    if tc.family == C.COMPLETE:
+        topo_key = None
+        n_bucket = _pow2_at_least(tc.n, 2)
+    else:
+        topo_key = tc
+        n_bucket = tc.n
+    key = BatchKey(n_bucket=n_bucket, rounds=run.max_rounds,
+                   fanout=proto.fanout,
+                   rumor_bucket=_pow2_at_least(proto.rumors),
+                   topology=topo_key)
+    return key, spec, bool(args["want_curve"])
+
+
+def classify_ensemble(args, seeds, count):
+    """``(key, specs)`` for a batchable Ensemble request (one spec per
+    seed — ensemble members ride the same megabatch lanes as Run
+    requests of the same key), or ``(None, reason)``."""
+    run = args["run"]
+    if seeds is None:
+        seeds = [run.seed + i for i in range(int(count))]
+    seeds = [int(s) for s in seeds]
+    if not seeds:
+        return None, "empty seed list"
+    probe = dict(args)
+    probe["want_curve"] = False
+    key, first, _ = classify_run(probe)
+    if key is None:
+        return None, first
+    specs = [dataclasses.replace(
+        first, run=dataclasses.replace(run, seed=s)) for s in seeds]
+    return key, tuple(specs)
+
+
+@lru_cache(maxsize=8)
+def _topo_for(tc: Optional[TopologyConfig]):
+    """The shared explicit table for a batch key (None for the
+    implicit complete family) — built once per config, reused across
+    ticks."""
+    if tc is None:
+        return None
+    from gossip_tpu.topology import generators as G
+    return G.build(tc)
+
+
+_MONITOR = None
+_MONITOR_LOCK = threading.Lock()
+
+
+def _monitor():
+    """Process-wide JitCompileMonitor (listener registration is
+    permanent — utils/compile_cache doc — so never one per Batcher)."""
+    global _MONITOR
+    with _MONITOR_LOCK:
+        if _MONITOR is None:
+            from gossip_tpu.utils.compile_cache import JitCompileMonitor
+            _MONITOR = JitCompileMonitor()
+        return _MONITOR
+
+
+class _Pending:
+    """One admitted request waiting on its batch tick."""
+
+    __slots__ = ("kind", "key", "specs", "want_curve", "deadline",
+                 "enq_t", "event", "reply", "error")
+
+    def __init__(self, kind, key, specs, want_curve, deadline):
+        self.kind = kind                  # "run" | "ensemble"
+        self.key = key
+        self.specs = specs                # tuple[RequestSpec]
+        self.want_curve = want_curve
+        self.deadline = deadline          # absolute monotonic or None
+        self.enq_t = time.monotonic()
+        self.event = threading.Event()
+        self.reply = None
+        self.error: Optional[BaseException] = None
+
+    def wait(self) -> dict:
+        self.event.wait()
+        if self.error is not None:
+            raise self.error
+        return self.reply
+
+
+class Batcher:
+    """The admission queue + collector loop (module doc).  One
+    instance per serving sidecar; ``close()`` drains and stops the
+    collector thread (it is a daemon, so process exit never hangs on
+    it)."""
+
+    def __init__(self, cfg: Optional[ServingConfig] = None):
+        self.cfg = cfg or ServingConfig()
+        self._lock = threading.Lock()
+        self._queue = []          # [(BatchKey, _Pending)], FIFO
+        self._stop = threading.Event()
+        self._tick = 0
+        self._thread = threading.Thread(target=self._loop,
+                                        name="gossip-admission-batcher",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- admission -----------------------------------------------------
+
+    def _admit(self, pending: _Pending) -> _Pending:
+        if self._stop.is_set():
+            # no collector will ever drain again — refuse instead of
+            # stranding the handler on an event nobody will set
+            raise Closed("sidecar batcher is shut down")
+        if len(pending.specs) > self.cfg.max_batch:
+            # an oversized request can NEVER be scheduled (every tick
+            # would defer it back to the leftovers) — refuse at
+            # admission instead of hanging its handler forever
+            raise TooLarge(
+                f"request needs {len(pending.specs)} megabatch lanes "
+                f"but max_batch is {self.cfg.max_batch}; split the "
+                "ensemble or raise the server's batch cap")
+        with self._lock:
+            depth = sum(len(p.specs) for _, p in self._queue)
+            if depth + len(pending.specs) > self.cfg.max_queue:
+                from gossip_tpu.utils import telemetry
+                telemetry.current().event(
+                    "backpressure", sync=False, queue_depth=depth,
+                    rejected_lanes=len(pending.specs),
+                    max_queue=self.cfg.max_queue)
+                raise QueueFull(
+                    f"admission queue full ({depth}/"
+                    f"{self.cfg.max_queue} lanes); back off and retry")
+            self._queue.append((pending.key, pending))
+        return pending
+
+    def submit_run(self, args, deadline) -> Tuple[Optional[_Pending],
+                                                  Optional[str]]:
+        """Admit a Run request: ``(pending, None)`` when batchable
+        (caller blocks on ``pending.wait()``), ``(None, reason)`` for
+        the solo fallthrough.  Raises :class:`QueueFull` at the
+        backpressure cap."""
+        key, spec, want_curve = classify_run(args)
+        if key is None:
+            return None, spec
+        return self._admit(_Pending("run", key, (spec,), want_curve,
+                                    deadline)), None
+
+    def submit_ensemble(self, args, seeds, count, deadline):
+        """Ensemble twin of :meth:`submit_run` — each seed is one
+        megabatch lane."""
+        key, specs = classify_ensemble(args, seeds, count)
+        if key is None:
+            return None, specs
+        return self._admit(_Pending("ensemble", key, specs, False,
+                                    deadline)), None
+
+    # -- collector -----------------------------------------------------
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=10)
+        # flush any admission that raced the stop flag past the
+        # collector's final drain (its _admit check happened before
+        # the flag was set) — nobody else will ever answer it
+        self._drain_once()
+
+    def _loop(self):
+        tick_s = self.cfg.tick_ms / 1e3
+        while not self._stop.wait(tick_s):
+            self._drain_once()
+        # final drain: submitters racing close() are answered, never
+        # stranded on an event that would no longer be set
+        self._drain_once()
+
+    def _drain_once(self):
+        with self._lock:
+            q, self._queue = self._queue, []
+        if not q:
+            return
+        try:
+            depth = sum(len(p.specs) for _, p in q)
+            now = time.monotonic()
+            groups: dict = {}
+            leftovers = []
+            for key, p in q:
+                if p.deadline is not None and now >= p.deadline:
+                    self._expire(p, now)
+                    continue
+                entries = groups.get(key, [])
+                lanes = sum(len(e.specs) for e in entries)
+                if lanes + len(p.specs) > self.cfg.max_batch:
+                    leftovers.append((key, p))     # next tick
+                    continue
+                # only materialize the group on a real append — a
+                # deferred request must not leave an EMPTY group
+                # behind (it would run a zero-entry megabatch)
+                groups.setdefault(key, entries).append(p)
+            if leftovers:
+                with self._lock:
+                    # keep FIFO: deferred requests go back ahead of
+                    # anything admitted while we drained
+                    self._queue = leftovers + self._queue
+            for key, entries in groups.items():
+                self._run_group(key, entries, depth)
+        except BaseException as e:              # noqa: BLE001
+            # the collector must NEVER die with waiters attached: a
+            # bug escaping the per-group handling fails this tick's
+            # requests LOUDLY (the handler maps it to INTERNAL)
+            # instead of stranding their handler threads forever
+            err = BatchError(
+                "collector tick failed: "
+                f"{type(e).__name__}: "
+                + (str(e).splitlines()[0] if str(e) else ""))
+            from gossip_tpu.utils import telemetry
+            telemetry.current().event("batch_error", sync=False,
+                                      error=str(err)[:300])
+            failed = {id(p) for _, p in q}
+            with self._lock:
+                # leftovers re-queued earlier in this tick are part of
+                # the failure sweep below — purge them, or the next
+                # tick would re-run a megabatch whose handlers already
+                # aborted with INTERNAL
+                self._queue = [(k2, p2) for k2, p2 in self._queue
+                               if id(p2) not in failed]
+            for _, p in q:
+                if not p.event.is_set():
+                    p.error = err
+                    p.event.set()
+
+    def _expire(self, p: _Pending, now: float):
+        from gossip_tpu.utils import telemetry
+        wait_ms = (now - p.enq_t) * 1e3
+        # field is req_kind, not kind: `kind` is Ledger.event's own
+        # positional (the event name) and would collide
+        telemetry.current().event(
+            "deadline_exceeded", sync=False, req_kind=p.kind,
+            wait_ms=round(wait_ms, 1), lanes=len(p.specs))
+        p.error = Expired(
+            "deadline expired before the batch tick ran "
+            f"(waited {wait_ms:.0f} ms; the client timeout bounds "
+            "queue wait + run)")
+        p.event.set()
+
+    def _run_group(self, key: BatchKey, entries, queue_depth: int):
+        from gossip_tpu.parallel.sweep import request_sweep_curves
+        from gossip_tpu.utils import telemetry
+        specs = tuple(s for e in entries for s in e.specs)
+        mon = _monitor()
+        before = mon.backend_compiles
+        t0 = time.monotonic()
+        try:
+            # full=True: one executable per (key, lane bucket)
+            # whatever mode mix this tick coalesced — the half-elision
+            # switches are composition statics and would fragment the
+            # serving cache (request_sweep_curves doc)
+            res = request_sweep_curves(specs,
+                                       topo=_topo_for(key.topology),
+                                       n_pad=(None if key.topology
+                                              is not None
+                                              else key.n_bucket),
+                                       full=True)
+        except Exception as e:          # defensive: classify should
+            err = BatchError(           # have filtered invalid configs
+                f"batch execution failed: {type(e).__name__}: "
+                + (str(e).splitlines()[0] if str(e) else ""))
+            telemetry.current().event("batch_error", sync=False,
+                                      error=str(err)[:300])
+            for p in entries:
+                p.error = err
+                p.event.set()
+            return
+        run_ms = (time.monotonic() - t0) * 1e3
+        compiles = (mon.backend_compiles - before
+                    if mon.durations_available else None)
+        self._tick += 1
+        waits = sorted((t0 - e.enq_t) * 1e3 for e in entries)
+        cache = (None if compiles is None
+                 else ("warm" if compiles == 0 else "compiled"))
+        batch_meta = {
+            "batched": True, "tick": self._tick,
+            "size": len(specs), "requests": len(entries),
+            "run_ms": round(run_ms, 1), "cache": cache,
+            "semantics": "fixed-scan", **key.describe()}
+        telemetry.current().event(
+            "batch", sync=False, tick=self._tick,
+            queue_depth=queue_depth, batch_size=len(specs),
+            requests=len(entries),
+            wait_ms_p50=round(telemetry.percentile(waits, 0.50), 1),
+            wait_ms_max=round(waits[-1], 1) if waits else 0.0,
+            run_ms=round(run_ms, 1), compiles=compiles, cache=cache,
+            **key.describe())
+        off = 0
+        for p in entries:
+            k = len(p.specs)
+            try:
+                p.reply = (self._run_reply(p, res, off, batch_meta)
+                           if p.kind == "run"
+                           else self._ensemble_reply(p, res, off, k,
+                                                     batch_meta))
+            except Exception as e:
+                p.error = BatchError(
+                    f"reply assembly failed: {type(e).__name__}: {e}")
+            off += k
+            p.event.set()
+
+    # -- replies -------------------------------------------------------
+
+    @staticmethod
+    def _run_reply(p: _Pending, res, i: int, batch_meta: dict) -> dict:
+        """A RunReport-shaped dict whose curve/rounds/coverage/msgs
+        equal the request's solo dispatch through the same readout
+        (fixed-length-scan semantics: the ``curve=True`` solo report's
+        numbers — docs/SERVING.md admission contract)."""
+        spec = p.specs[0]
+        curve = [float(c) for c in res.curves[i]]
+        return {
+            "backend": "jax-tpu", "mode": spec.proto.mode, "n": spec.n,
+            "rounds": int(res.rounds_to_target[i]),
+            "coverage": curve[-1],
+            "msgs": float(res.msgs[i][-1]),
+            "wall_s": round(batch_meta["run_ms"] / 1e3, 4),
+            "curve": curve if p.want_curve else None,
+            "meta": {"clock": "rounds", "devices": 1,
+                     "msgs_counts": "transmissions",
+                     "engine": "xla-request-batch",
+                     "state_digest": res.state_digests[i],
+                     "dropped_total": float(res.dropped[i].sum()),
+                     "batch": dict(batch_meta)},
+        }
+
+    @staticmethod
+    def _ensemble_reply(p: _Pending, res, off: int, k: int,
+                        batch_meta: dict) -> dict:
+        """The Ensemble RPC's reply shape from this request's lane
+        slice — per-seed curves are bitwise the solo runs, so the
+        summary equals parallel/sweep.ensemble_curves' by
+        construction."""
+        from gossip_tpu.parallel.sweep import EnsembleResult
+        spec = p.specs[0]
+        ens = EnsembleResult(
+            curves=res.curves[off:off + k],
+            msgs=res.msgs[off:off + k],
+            rounds_to_target=res.rounds_to_target[off:off + k],
+            target=spec.run.target_coverage)
+        return {"ensemble": ens.summary(), "mode": spec.proto.mode,
+                "n": spec.n, "batch": dict(batch_meta)}

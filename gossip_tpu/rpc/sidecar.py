@@ -20,6 +20,18 @@ the config dataclasses, validated strictly) and returns a RunReport dict.
 ``ensemble`` (count) and returns seed-ensemble statistics (round 4 —
 incl. SWIM detection-latency distributions).  ``Health`` returns
 backend/device facts.
+
+Serving under load: ``serve(batching=ServingConfig(...))`` turns on the
+admission-batching layer (rpc/batcher — docs/SERVING.md): concurrent
+compatible requests coalesce into one device-resident megabatch per
+tick, replies carry ``meta["batch"]`` metadata (including the loud
+``batched: false`` label + reason on solo fallthroughs), client
+timeouts bound queue wait + run (DEADLINE_EXCEEDED past them), and the
+queue cap rejects with RESOURCE_EXHAUSTED.  Error hygiene: a malformed
+request — bad JSON, a non-object payload, unknown fields — is always
+INVALID_ARGUMENT with a one-line message, never a stringified
+traceback; ``SidecarClient`` raises such replies immediately (a
+well-formed error is never retried).
 """
 
 from __future__ import annotations
@@ -30,54 +42,151 @@ from typing import Optional, Tuple
 
 import grpc
 
+from gossip_tpu.config import ServingConfig
+
 SERVICE = "gossip.Simulator"
+
+# Exceptions a malformed/invalid request may legitimately raise while
+# being parsed/validated/run — each becomes INVALID_ARGUMENT with a
+# ONE-LINE message (never a stringified traceback: the client sees the
+# first line of the error, the server log keeps the rest).
+_BAD_REQUEST = (ValueError, TypeError, KeyError, AttributeError,
+                json.JSONDecodeError)
+
+
+def _one_line(e: BaseException) -> str:
+    """The first line of an error, bounded — the whole client-visible
+    error contract (tested: a malformed request must never ship a
+    traceback over the wire)."""
+    msg = str(e) or type(e).__name__
+    return msg.splitlines()[0][:400]
+
+
+def _parse_obj(request: bytes) -> dict:
+    """UTF-8 JSON *object* or ValueError — a JSON list/string/number
+    would otherwise hit attribute errors deep in the config layer and
+    surface as a traceback instead of INVALID_ARGUMENT."""
+    req = json.loads(request)
+    if not isinstance(req, dict):
+        raise ValueError("request must be a JSON object, got "
+                         f"{type(req).__name__}")
+    return req
 
 
 def _identity(b: bytes) -> bytes:
     return b
 
 
-def _run(request: bytes, context) -> bytes:
+def _await_batched(pending, context) -> bytes:
+    """Block the handler thread on the megabatch reply; map the
+    serving-layer rejections to their gRPC codes (rpc/batcher):
+    Expired -> DEADLINE_EXCEEDED (admitted but not run in time),
+    anything else -> INTERNAL with a one-line reason."""
+    from gossip_tpu.rpc import batcher as B
+    try:
+        return json.dumps(pending.wait()).encode()
+    except B.Expired as e:
+        context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, _one_line(e))
+    except B.BatchError as e:
+        context.abort(grpc.StatusCode.INTERNAL, _one_line(e))
+
+
+def _run(request: bytes, context, batcher=None) -> bytes:
     from gossip_tpu.backend import request_to_args, run_simulation
     try:
-        req = json.loads(request)
-        args = request_to_args(req)
+        args = request_to_args(_parse_obj(request))
+    except _BAD_REQUEST as e:
+        context.abort(grpc.StatusCode.INVALID_ARGUMENT, _one_line(e))
+    note = None
+    if batcher is not None:
+        from gossip_tpu.rpc import batcher as B
+        try:
+            pending, note = batcher.submit_run(args,
+                                               B.deadline_of(context))
+        except B.QueueFull as e:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                          _one_line(e))
+        except B.TooLarge as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          _one_line(e))
+        except B.Closed as e:
+            context.abort(grpc.StatusCode.UNAVAILABLE, _one_line(e))
+        if pending is not None:
+            return _await_batched(pending, context)
+    try:
         report = run_simulation(**args)
-    except (ValueError, TypeError, json.JSONDecodeError) as e:
-        context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-    return json.dumps(report.to_dict()).encode()
+    except (ValueError, TypeError) as e:
+        context.abort(grpc.StatusCode.INVALID_ARGUMENT, _one_line(e))
+    out = report.to_dict()
+    if batcher is not None:
+        # the solo fallthrough under a batching sidecar is loudly
+        # labeled with WHY it did not coalesce (docs/SERVING.md)
+        out["meta"]["batch"] = {"batched": False, "reason": note}
+    return json.dumps(out).encode()
 
 
-def _ensemble(request: bytes, context) -> bytes:
+def _ensemble(request: bytes, context, batcher=None) -> bytes:
     """Seed-ensemble statistics in one call (still coarse-grained: one
     RPC = one batched XLA program).  Request = the Run fields minus
     ``curve``/``mesh``, plus ``seeds`` (list of ints) or ``ensemble``
     (count, seeded run.seed + i); response = {"ensemble": summary,
-    mode-specific keys...} exactly like the CLI's --ensemble output."""
+    mode-specific keys...} exactly like the CLI's --ensemble output.
+    Under an admission-batching sidecar, each seed rides one megabatch
+    lane next to concurrent Run requests of the same batch key."""
     from gossip_tpu.backend import request_to_args, run_ensemble
     try:
-        req = json.loads(request)
+        req = _parse_obj(request)
         seeds = req.pop("seeds", None)
         count = req.pop("ensemble", None)
         if (seeds is None) == (count is None):
             raise ValueError("pass exactly one of 'seeds' (list) or "
                              "'ensemble' (count)")
+        # coerce HERE, inside the INVALID_ARGUMENT net: a malformed
+        # seed list must get the one-line error on the batched path
+        # too, not an uncaught int() failure deep in the batcher
+        if seeds is not None:
+            seeds = [int(s) for s in seeds]
+        if count is not None:
+            count = int(count)
         args = request_to_args(req)
-        if args.pop("backend") != "jax-tpu":
+        if args["backend"] != "jax-tpu":
             raise ValueError("ensembles need the jax-tpu backend")
-        if args.pop("mesh_cfg", None) is not None:
+        if args["mesh_cfg"] is not None:
             raise ValueError("the Ensemble RPC is single-process "
                              "single-device; shard seed axes via the "
                              "library API")
-        if args.pop("want_curve", None):
+        if args["want_curve"]:
             raise ValueError("the Ensemble RPC returns summary "
                              "statistics, not curves; drop 'curve' "
                              "(bands are a CLI --save-curve feature)")
-        ens, extra = run_ensemble(seeds=seeds, count=count, **args)
+    except _BAD_REQUEST as e:
+        context.abort(grpc.StatusCode.INVALID_ARGUMENT, _one_line(e))
+    note = None
+    if batcher is not None:
+        from gossip_tpu.rpc import batcher as B
+        try:
+            pending, note = batcher.submit_ensemble(
+                args, seeds, count, B.deadline_of(context))
+        except B.QueueFull as e:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                          _one_line(e))
+        except B.TooLarge as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          _one_line(e))
+        except B.Closed as e:
+            context.abort(grpc.StatusCode.UNAVAILABLE, _one_line(e))
+        if pending is not None:
+            return _await_batched(pending, context)
+    try:
+        run_args = {k: v for k, v in args.items()
+                    if k not in ("backend", "mesh_cfg", "want_curve")}
+        ens, extra = run_ensemble(seeds=seeds, count=count, **run_args)
         out = {"ensemble": ens.summary(), "mode": args["proto"].mode,
                "n": args["tc"].n, **extra}
-    except (ValueError, TypeError, json.JSONDecodeError) as e:
-        context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+    except (ValueError, TypeError) as e:
+        context.abort(grpc.StatusCode.INVALID_ARGUMENT, _one_line(e))
+    if batcher is not None:
+        out["batch"] = {"batched": False, "reason": note}
     return json.dumps(out).encode()
 
 
@@ -92,16 +201,36 @@ def _health(request: bytes, context) -> bytes:
 
 
 def serve(port: int = 50051, max_workers: int = 4,
-          host: str = "127.0.0.1") -> Tuple[grpc.Server, int]:
+          host: str = "127.0.0.1",
+          batching: Optional[ServingConfig] = None
+          ) -> Tuple[grpc.Server, int]:
     """Start the sidecar; returns (server, bound_port).  port=0 picks a
-    free port (tests)."""
+    free port (tests).
+
+    ``batching`` enables the admission-batching serving layer
+    (rpc/batcher): concurrent batchable Run/Ensemble requests coalesce
+    into one device-resident megabatch per collector tick, solo
+    fallthroughs are labeled in ``meta["batch"]``, deadlines bound
+    queue wait + run, and admissions past the queue cap get
+    RESOURCE_EXHAUSTED.  ``None`` (the default) keeps today's
+    per-request solo dispatch byte for byte.  With batching on,
+    ``max_workers`` bounds the number of requests that can WAIT on a
+    tick concurrently — size it at least to the expected concurrency.
+    The collector is a daemon thread; ``server.gossip_batcher.close()``
+    drains it (tests, the load harness)."""
+    batcher = None
+    if batching is not None:
+        from gossip_tpu.rpc.batcher import Batcher
+        batcher = Batcher(batching)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     handlers = {
         "Run": grpc.unary_unary_rpc_method_handler(
-            _run, request_deserializer=_identity,
+            lambda req, ctx: _run(req, ctx, batcher),
+            request_deserializer=_identity,
             response_serializer=_identity),
         "Ensemble": grpc.unary_unary_rpc_method_handler(
-            _ensemble, request_deserializer=_identity,
+            lambda req, ctx: _ensemble(req, ctx, batcher),
+            request_deserializer=_identity,
             response_serializer=_identity),
         "Health": grpc.unary_unary_rpc_method_handler(
             _health, request_deserializer=_identity,
@@ -113,6 +242,7 @@ def serve(port: int = 50051, max_workers: int = 4,
     if bound == 0 and port != 0:      # grpc's bind-failure sentinel
         raise OSError(f"could not bind {host}:{port} (port in use?)")
     server.start()
+    server.gossip_batcher = batcher
     return server, bound
 
 

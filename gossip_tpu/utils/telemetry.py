@@ -452,6 +452,23 @@ def from_env(default_path: Optional[str] = None, argv=None,
         return EchoLedger() if echo else NullLedger()
 
 
+def percentile(values, q: float) -> float:
+    """Nearest-rank percentile (q in [0, 1]) of a value sequence, 0.0
+    with no samples — the ONE latency-quantile definition the serving
+    layer shares: the admission batcher's per-tick ``batch`` events
+    (rpc/batcher wait walls) and the load harness's p50/p95/p99 gates
+    (tools/load_harness) must mean the same thing by construction.
+    Same nearest-rank convention as utils/trace.RoundTimer."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"q={q} outside [0, 1]")
+    vals = sorted(values)
+    if not vals:
+        return 0.0
+    # epsilon guards float artifacts like 0.95*20 -> 19.000000000000004
+    rank = math.ceil(q * len(vals) - 1e-9)
+    return float(vals[min(len(vals) - 1, max(0, rank - 1))])
+
+
 # -- reading ----------------------------------------------------------
 
 def parse_dryrun_table(text: str):

@@ -9,7 +9,6 @@ TensorBoard / Perfetto (`trace(...)`) or annotate host-side phases
 from __future__ import annotations
 
 import contextlib
-import math
 import os
 import time
 from typing import Iterator, Optional
@@ -214,15 +213,12 @@ class RoundTimer:
 
     def percentile_ms(self, q: float) -> float:
         """Nearest-rank percentile (q in [0, 1]) of the recorded round
-        walls, in ms; 0.0 with no samples (mean_ms convention)."""
-        if not 0.0 <= q <= 1.0:
-            raise ValueError(f"q={q} outside [0, 1]")
-        if not self.times:
-            return 0.0
-        ordered = sorted(self.times)
-        # epsilon guards float artifacts like 0.95*20 -> 19.000000000000004
-        rank = math.ceil(q * len(ordered) - 1e-9)
-        return 1e3 * ordered[min(len(ordered) - 1, max(0, rank - 1))]
+        walls, in ms; 0.0 with no samples (mean_ms convention).
+        Delegates to the ONE quantile definition
+        (utils/telemetry.percentile — shared with the serving layer's
+        batch events and load-harness gates)."""
+        from gossip_tpu.utils.telemetry import percentile
+        return 1e3 * percentile(self.times, q)
 
     @property
     def p50_ms(self) -> float:

@@ -212,6 +212,8 @@ def test_si_resume_under_fault_bitwise(tmp_path, kill_at):
     assert load_meta(pf)["extra"]["round"] == 10
 
 
+# depth tier: see test_swim_resume_under_churn_bitwise's rationale
+@pytest.mark.slow
 def test_rumor_resume_under_fault_bitwise(tmp_path):
     from gossip_tpu.models.rumor import checkpointed_rumor
     proto = ProtocolConfig(mode="rumor", fanout=2, rumors=2, rumor_k=3)
@@ -304,6 +306,13 @@ def test_fused_planes_resume_under_churn_events_bitwise(tmp_path):
             str(tmp_path / "rej.npz"), interpret=True, fault=leg_fault)
 
 
+# depth tier (tier-1 wall budget, serving-PR rebalance): the churn-
+# resume mechanism (absolute state.round schedule indexing + the lost
+# carry through run_with_checkpoints) is shared by every surface and
+# stays pinned in-gate by the SI, packed-sharded, and fused-planes
+# resumes + the crashloop smoke; the SWIM and rumor per-surface depth
+# re-proves under -m slow
+@pytest.mark.slow
 def test_swim_resume_under_churn_bitwise(tmp_path):
     from gossip_tpu.runtime.simulator import checkpointed_swim
     # events (a permanent crash to detect + a recovering node) + ramp;

@@ -46,14 +46,18 @@ _tspec.loader.exec_module(readme_table)
 
 FAMILIES = frozenset({
     "dense_pushpull", "churn_heal", "churn_sweep", "crdt_counter",
+    "serving_batch",
     "packed_pull", "sparse_antientropy", "topo_sparse_antientropy",
     "swim_rotating", "halo_banded", "fused_planes",
     "fused_planes_fault_curve", "rumor_sir", "hybrid_2d_sweep"})
-# the committed r11 record predates the CRDT PR's crdt_counter family;
-# the committed r07/r08/r09 records additionally predate the
-# compiled-nemesis PR's churn_heal family and the traced-operand PR's
-# churn_sweep family — each pin stays on its historical set
-FAMILIES_PRE_CRDT = FAMILIES - {"crdt_counter"}
+# the committed r13 record predates the serving PR's serving_batch
+# family; the committed r11 record additionally predates the CRDT PR's
+# crdt_counter family; the committed r07/r08/r09 records additionally
+# predate the compiled-nemesis PR's churn_heal family and the
+# traced-operand PR's churn_sweep family — each pin stays on its
+# historical set
+FAMILIES_PRE_SERVING = FAMILIES - {"serving_batch"}
+FAMILIES_PRE_CRDT = FAMILIES_PRE_SERVING - {"crdt_counter"}
 FAMILIES_PRE_CHURN = FAMILIES_PRE_CRDT - {"churn_heal", "churn_sweep"}
 DECOMPOSED = ("fused_planes", "fused_planes_fault_curve")
 DECOMP_KEYS = ("steady_exec_ms", "init_build_ms", "driver_overhead_ms")
@@ -360,14 +364,11 @@ def test_committed_r11_4dev_record_carries_churn_sweep():
     assert warm_total * 3 <= cold_total
 
 
-def test_committed_r13_4dev_record_carries_crdt_counter():
-    """The CRDT PR's committed 4-device record
-    (artifacts/ledger_dryrun_r13_4dev.jsonl, the ledger_diff gate
-    baseline since r13): cold+warm pair, FULL current family set —
-    crdt_counter included — warm run all-hit, steady and warm budgets
-    held, >= 3x warm-start aggregate, provenance present."""
-    path = os.path.join(_REPO, "artifacts",
-                        "ledger_dryrun_r13_4dev.jsonl")
+def _assert_cold_warm_record(path, families):
+    """The committed 4-device cold+warm record contract the r13 and
+    r14 pins share: two provenance-stamped runs, the given family set,
+    warm run all-hit, steady + warm budgets held, >= 3x warm-start
+    aggregate."""
     all_events = telemetry.load_ledger(path)
     run_ids = telemetry_report.runs(all_events)
     assert len(run_ids) == 2
@@ -378,7 +379,7 @@ def test_committed_r13_4dev_record_carries_crdt_counter():
         assert len(events[0]["git_commit"]) == 40
         assert any(e["ev"] == "runtime" and e["device_count"] == 4
                    for e in events)
-        assert set(telemetry_report.family_table(events)) == FAMILIES
+        assert set(telemetry_report.family_table(events)) == families
     warm_fam = telemetry_report.family_table(warm)
     budgets = graft_entry.dryrun_steady_budgets()
     assert all(warm_fam[f]["steady_ms"] <= budgets[f] for f in warm_fam)
@@ -391,6 +392,28 @@ def test_committed_r13_4dev_record_carries_crdt_counter():
     cold_total = sum(r["first_ms"] for r in cold_fam.values())
     warm_total = sum(r["first_ms"] for r in warm_fam.values())
     assert warm_total * 3 <= cold_total
+
+
+def test_committed_r13_4dev_record_carries_crdt_counter():
+    """The CRDT PR's committed 4-device record
+    (artifacts/ledger_dryrun_r13_4dev.jsonl): cold+warm pair on its
+    historical family set — crdt_counter included, serving_batch not
+    yet.  (The live ledger_diff gate baseline moved to the r14 record
+    below when the serving PR grew the family set.)"""
+    _assert_cold_warm_record(
+        os.path.join(_REPO, "artifacts", "ledger_dryrun_r13_4dev.jsonl"),
+        FAMILIES_PRE_SERVING)
+
+
+def test_committed_r14_4dev_record_carries_serving_batch():
+    """The serving PR's committed 4-device record
+    (artifacts/ledger_dryrun_r14_4dev.jsonl, the ledger_diff gate
+    baseline since r14): cold+warm pair, FULL current family set —
+    serving_batch included — warm run all-hit, steady and warm budgets
+    held, >= 3x warm-start aggregate, provenance present."""
+    _assert_cold_warm_record(
+        os.path.join(_REPO, "artifacts", "ledger_dryrun_r14_4dev.jsonl"),
+        FAMILIES)
 
 
 def test_committed_r09_4dev_record_matches_live_pair_shape(dryrun_pair):

@@ -22,11 +22,11 @@ _spec.loader.exec_module(ledger_diff)
 R09_4DEV = os.path.join(_REPO, "artifacts",
                         "ledger_dryrun_r09_4dev.jsonl")
 R09_8DEV = os.path.join(_REPO, "artifacts", "ledger_dryrun_r09.jsonl")
-# the CRDT PR's 4-device record: same family set as the live dry run
-# (churn_heal, churn_sweep AND crdt_counter included), so the tier-1
-# gate compares every family like-for-like
-R13_4DEV = os.path.join(_REPO, "artifacts",
-                        "ledger_dryrun_r13_4dev.jsonl")
+# the serving PR's 4-device record: same family set as the live dry
+# run (churn_heal, churn_sweep, crdt_counter AND serving_batch
+# included), so the tier-1 gate compares every family like-for-like
+R14_4DEV = os.path.join(_REPO, "artifacts",
+                        "ledger_dryrun_r14_4dev.jsonl")
 
 
 def _write_run(path, families, device_count=4, metrics=None,
@@ -213,9 +213,10 @@ def test_committed_4dev_record_vs_fresh_dryrun_is_clean(dryrun_pair,
     against this session's live warm dry run (same device count, same
     machine class) must come back clean — walls within threshold+floor,
     budgets held, protocol totals compared at equal device count.
-    Since the CRDT PR the committed record is r13, whose family set
-    includes churn_heal, churn_sweep AND crdt_counter, so the new
-    CRDT family's walls gate like every other family.
+    Since the serving PR the committed record is r14, whose family set
+    includes churn_heal, churn_sweep, crdt_counter AND serving_batch,
+    so the new serving megabatch family's walls gate like every other
+    family.
 
     Thresholds are calibrated to this container's measured noise: a
     full-suite run swings individual families' warm FIRST-call walls
@@ -233,7 +234,7 @@ def test_committed_4dev_record_vs_fresh_dryrun_is_clean(dryrun_pair,
     own absolute budget check — which never flaked — flags it.  The
     first_ms wall mechanism itself stays pinned on the synthetic
     fixtures above and the injected-regression test below."""
-    rc = ledger_diff.main([R13_4DEV,
+    rc = ledger_diff.main([R14_4DEV,
                            dryrun_pair["warm"]["ledger_path"],
                            "--first-floor-ms", "10000",
                            "--steady-floor-ms", "150"])
@@ -241,7 +242,8 @@ def test_committed_4dev_record_vs_fresh_dryrun_is_clean(dryrun_pair,
     assert rc == 0, f"ledger_diff flagged a fresh dry run:\n{out}"
     assert "Verdict: clean" in out
     # every family joined — nothing fell out as an only-in-one note
-    assert "crdt_counter" in out and "only in" not in out
+    assert "crdt_counter" in out and "serving_batch" in out
+    assert "only in" not in out
     # the metric join actually engaged (same device count, fused
     # drivers instrumented in both)
     assert "simulate_until_sharded_fused" in out
@@ -255,33 +257,33 @@ def test_committed_record_with_injected_2x_wall_is_flagged(tmp_path,
     calibration that forgives uniform host load, proving the
     thresholds catch a real regression, not just synthetic
     fixtures."""
-    events = telemetry.load_ledger(R13_4DEV)
+    events = telemetry.load_ledger(R14_4DEV)
     runs = [e["run"] for e in events if e.get("ev") == "provenance"]
     warm = runs[-1]
     doubled = str(tmp_path / "doubled.jsonl")
-    # churn_sweep carries the record's largest warm first-call wall,
-    # so its doubled delta clears a 1000 ms floor — the injection
-    # proves the wall mechanism fires on REAL committed data at a
-    # noise-hardened floor (the tier-1 like-for-like gate above goes
-    # further and hands first_ms detection to the cache-verdict
+    # serving_batch carries the r14 record's largest warm first-call
+    # wall, so its doubled delta clears a 1000 ms floor — the
+    # injection proves the wall mechanism fires on REAL committed data
+    # at a noise-hardened floor (the tier-1 like-for-like gate above
+    # goes further and hands first_ms detection to the cache-verdict
     # assertions entirely; this pin keeps the wall path honest for
     # manual/CLI use)
-    with open(R13_4DEV) as f, open(doubled, "w") as g:
+    with open(R14_4DEV) as f, open(doubled, "w") as g:
         for line in f:
             if not line.strip():
                 continue
             e = json.loads(line)
             if (e.get("ev") == "family" and e.get("run") == warm
-                    and e.get("family") == "churn_sweep"):
+                    and e.get("family") == "serving_batch"):
                 for k in ("first_ms", "steady_ms"):
                     if isinstance(e.get(k), (int, float)):
                         e[k] = 2 * e[k]
             g.write(json.dumps(e) + "\n")
-    rc = ledger_diff.main([R13_4DEV, doubled, "--first-floor-ms",
+    rc = ledger_diff.main([R14_4DEV, doubled, "--first-floor-ms",
                            "1000", "--steady-floor-ms", "150"])
     out = capsys.readouterr().out
     assert rc == 1
-    assert "churn_sweep first_ms regressed" in out
+    assert "serving_batch first_ms regressed" in out
 
 
 def test_churn_sweep_family_gates_like_every_other(tmp_path, capsys):

@@ -123,6 +123,27 @@ def test_round_metrics_artifacts_must_be_attributable(tmp_path):
     assert va.validate_file(str(good)) == []
 
 
+def test_serving_artifacts_must_be_attributable(tmp_path):
+    """A ``*serving*``/``*load*`` artifact without provenance fails —
+    throughput/latency gate evidence (tools/load_harness) can never be
+    grandfathered, jsonl or json alike."""
+    bad = tmp_path / "ledger_serving_r99.jsonl"
+    bad.write_text(json.dumps({"ev": "serving_gate", "ok": True})
+                   + "\n")
+    problems = va.validate_file(str(bad))
+    assert any("provenance" in p for p in problems), problems
+
+    badj = tmp_path / "load_summary_r99.json"
+    badj.write_text(json.dumps({"ok": True}))
+    problems = va.validate_file(str(badj))
+    assert any("provenance" in p for p in problems), problems
+
+    good = tmp_path / "ledger_serving_r98.jsonl"
+    with telemetry.Ledger(str(good)) as led:
+        led.event("serving_gate", ok=True, throughput_ratio=4.2)
+    assert va.validate_file(str(good)) == []
+
+
 def test_crashloop_artifacts_must_be_attributable(tmp_path):
     """A ``*crashloop*`` artifact without provenance fails — the
     SIGKILL/resume record (tools/crashloop.py) is robustness evidence

@@ -1,18 +1,31 @@
 #!/usr/bin/env python
-"""Record the Maelstrom interval-batching efficiency artifact.
+"""Batching evidence, both layers: render the serving-layer ``batch``
+telemetry, and record the Maelstrom interval-batching artifact.
 
-Runs the broadcast workload twice through `gossip-tpu maelstrom-check`
-— the reference-shaped immediate fan-out and the interval-batched
-variant (VERDICT r3 item 7) — on the same seeded 5-node line at a high
-op rate, and writes ``artifacts/maelstrom_batching_r05.json`` with both
-reports plus the Glomers-style gates the batched run is held to
-(msgs-per-op <= 12 on a 5-node line at 20 values; the checker's
-eventual-delivery invariant on both).  Routing counts are measured from
-real node processes, so exact numbers vary run to run by a message or
-two; the CONTRACT (batched strictly below immediate, both invariants
-green, gates met) is what the exit code enforces.
+**Serving render** (the admission-batching PR): ``--ledger PATH``
+renders a run ledger's per-tick ``batch`` events (rpc/batcher schema —
+queue depth, batch size, wait/run walls, compile verdict) plus the
+load-harness ``load_leg``/``serving_gate`` rows into the markdown
+section tools/telemetry_report.py embeds as "Serving batches"
+(:func:`render_serving_section` is the ONE implementation for both
+tools; contract-tested against the committed
+artifacts/ledger_serving_r14.jsonl record).
 
-    python tools/batching_report.py
+    python tools/batching_report.py --ledger artifacts/ledger_serving_r14.jsonl
+
+**Maelstrom capture** (the legacy default, VERDICT r3 item 7): runs the
+broadcast workload twice through `gossip-tpu maelstrom-check` — the
+reference-shaped immediate fan-out and the interval-batched variant —
+on the same seeded 5-node line at a high op rate, and writes
+``artifacts/maelstrom_batching_r05.json`` with both reports plus the
+Glomers-style gates the batched run is held to (msgs-per-op <= 12 on a
+5-node line at 20 values; the checker's eventual-delivery invariant on
+both).  Routing counts are measured from real node processes, so exact
+numbers vary run to run by a message or two; the CONTRACT (batched
+strictly below immediate, both invariants green, gates met) is what
+the exit code enforces.
+
+    python tools/batching_report.py            # maelstrom capture
 """
 
 import json
@@ -22,6 +35,127 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ART = os.path.join(REPO, "artifacts", "maelstrom_batching_r05.json")
+
+
+# -- serving-layer batch telemetry render -------------------------------
+
+def batch_rows(events):
+    """The run's per-tick ``batch`` events (rpc/batcher schema), in
+    order."""
+    return [e for e in events if e.get("ev") == "batch"]
+
+
+def _hist(values, buckets):
+    """``[(label, count)]`` text histogram rows over inclusive bucket
+    upper bounds (the last bucket is open-ended)."""
+    rows = []
+    lo = None
+    for hi in buckets:
+        n = sum(1 for v in values
+                if (lo is None or v > lo) and v <= hi)
+        rows.append((f"<= {hi:g}" if lo is None else f"{lo:g}..{hi:g}",
+                     n))
+        lo = hi
+    rows.append((f"> {lo:g}", sum(1 for v in values if v > lo)))
+    return rows
+
+
+def _bar(n, total, width=24):
+    return "#" * (0 if total == 0 else max(1, round(width * n / total))
+                  if n else 0)
+
+
+def render_serving_section(events):
+    """The "Serving batches" markdown section for one run's serving
+    telemetry — per-tick batch stats (queue-depth / batch-size / wait
+    and run-wall histograms, compile verdicts), the load-harness leg
+    summaries, and the gate verdict.  Returns [] when the run carries
+    no ``batch`` events (non-serving ledgers) — the embedding report
+    (tools/telemetry_report.py) then omits the section entirely."""
+    rows = batch_rows(events)
+    if not rows:
+        return []
+    sys.path.insert(0, REPO)
+    try:
+        from gossip_tpu.utils.telemetry import percentile
+    finally:
+        sys.path.pop(0)
+    out = ["## Serving batches (admission batcher, rpc/batcher)", ""]
+    sizes = [r.get("batch_size", 0) for r in rows]
+    depths = [r.get("queue_depth", 0) for r in rows]
+    waits = [r.get("wait_ms_p50", 0.0) for r in rows]
+    runs = [r.get("run_ms", 0.0) for r in rows]
+    verdicts = {}
+    for r in rows:
+        verdicts[r.get("cache")] = verdicts.get(r.get("cache"), 0) + 1
+    out.append(f"- {len(rows)} batch tick(s); "
+               f"{sum(sizes)} request lane(s) served; compile "
+               "verdicts: " + ", ".join(
+                   f"{k}={v}" for k, v in sorted(verdicts.items(),
+                                                 key=lambda kv:
+                                                 str(kv[0]))))
+    out.append(f"- batch size p50/max: "
+               f"{percentile(sizes, 0.5):g}/{max(sizes):g}; "
+               f"queue depth p50/max: "
+               f"{percentile(depths, 0.5):g}/{max(depths):g}")
+    out.append(f"- per-tick wait p50 of p50s {percentile(waits, 0.5):.1f}"
+               f" ms; run wall p50/p95 {percentile(runs, 0.5):.1f}/"
+               f"{percentile(runs, 0.95):.1f} ms")
+    out.append("")
+    for title, vals, buckets in (
+            ("batch size", sizes, (1, 2, 4, 8, 16, 32, 64)),
+            ("queue depth at drain", depths, (1, 4, 16, 64, 256)),
+            ("run wall (ms)", runs, (5, 20, 50, 200, 1000))):
+        out.append(f"### {title} histogram")
+        out.append("")
+        out.append("| bucket | ticks | |")
+        out.append("|---|---|---|")
+        total = len(vals)
+        for label, n in _hist(vals, buckets):
+            out.append(f"| {label} | {n} | `{_bar(n, total)}` |")
+        out.append("")
+    legs = [e for e in events if e.get("ev") == "load_leg"]
+    if legs:
+        out.append("### Load-harness legs")
+        out.append("")
+        out.append("| leg | requests | workers | rps | p50 ms | p95 ms "
+                   "| p99 ms | errors |")
+        out.append("|---|---|---|---|---|---|---|---|")
+        for e in legs:
+            out.append(f"| {e.get('leg')} | {e.get('requests')} "
+                       f"| {e.get('workers')} | {e.get('rps')} "
+                       f"| {e.get('p50_ms')} | {e.get('p95_ms')} "
+                       f"| {e.get('p99_ms')} | {e.get('errors')} |")
+        out.append("")
+    gates = [e for e in events if e.get("ev") == "serving_gate"]
+    if gates:
+        g = gates[-1]
+        verdict = "**green**" if g.get("ok") else "**TRIPPED**"
+        out.append(f"Serving gate: {verdict} — throughput ratio "
+                   f"{g.get('throughput_ratio')}x "
+                   f"(>= {g.get('min_ratio')}x), bitwise_equal="
+                   f"{g.get('bitwise_equal')}, steady_all_warm="
+                   f"{g.get('steady_all_warm')} "
+                   f"({g.get('measure_compiles')} compiles in the "
+                   "measured window).")
+        out.append("")
+    return out
+
+
+def render_serving_ledger(path, run="last"):
+    """Standalone render of a serving ledger (--ledger CLI mode)."""
+    sys.path.insert(0, REPO)
+    try:
+        from gossip_tpu.utils.telemetry import load_ledger
+    finally:
+        sys.path.pop(0)
+    events = load_ledger(path, run=run)
+    lines = render_serving_section(events)
+    if not lines:
+        return (f"no `batch` events in {path} (run {run!r}) — not a "
+                "serving ledger?")
+    return "\n".join([f"# Serving report — {os.path.basename(path)}",
+                      ""] + lines)
 
 
 def check(*extra, n=5, ops=20):
@@ -43,7 +177,18 @@ def check(*extra, n=5, ops=20):
     return rep
 
 
-def main():
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ledger", default=None,
+                    help="render a serving ledger's batch telemetry "
+                         "instead of running the Maelstrom capture")
+    ap.add_argument("--run", default="last",
+                    help="run id within --ledger (default newest)")
+    args = ap.parse_args(argv)
+    if args.ledger:
+        print(render_serving_ledger(args.ledger, run=args.run))
+        return 0
     immediate = check()
     batched = check("--gossip-interval", "0.05",
                     "--assert-msgs-per-op", "12",

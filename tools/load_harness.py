@@ -1,0 +1,321 @@
+#!/usr/bin/env python
+"""Serving load harness: replay concurrent RPCs against a live
+admission-batching sidecar and gate latency, throughput, and bitwise
+per-request equality from the run ledger.
+
+Two legs over the SAME request mix (a few distinct configs x distinct
+seeds, ``curve=True``, ``engine="xla"`` so the solo auto-routing cannot
+pick a different kernel family on TPU):
+
+  * **solo** — today's per-request dispatch: ``serve(batching=None)``,
+    every RPC runs ``run_simulation`` individually;
+  * **batched** — ``serve(batching=ServingConfig(...))``: the admission
+    batcher coalesces concurrent requests into per-tick megabatches
+    (rpc/batcher + parallel/sweep.request_sweep_curves).
+
+Both legs are warmed before measurement (per-config solo executables;
+per-(key, lane-bucket) megabatch executables, driven directly so the
+in-process jit cache covers every pow2 batch size the ticks can form),
+so the measured window is steady-state serving: the gate requires every
+measurement-phase ``batch`` event to report ``compiles == 0`` — p50
+never touches the compile path.
+
+Gates (exit 1 on any failure, ledgered as one ``serving_gate`` event):
+
+  * batched requests/sec >= ``--min-ratio`` x solo requests/sec at the
+    equal request mix (the acceptance line is 3x);
+  * per-request BITWISE equality: each batched reply's curve / msgs /
+    coverage / rounds equal its solo reply's bytes exactly;
+  * steady-state all-warm: zero backend compiles inside the batched
+    measurement window.
+
+The ledger (provenance-stamped, utils/telemetry) carries the per-tick
+``batch`` events from the server (same process, ambient ledger), one
+``load_leg`` summary per leg with p50/p95/p99 latency and rps, and the
+final gate verdict — this file IS the committed serving evidence
+(artifacts/ledger_serving_r14.jsonl), re-asserted by a tier-1 pin and
+rendered by tools/batching_report.py.
+
+    python tools/load_harness.py --out artifacts/ledger_serving_r14.jsonl
+    python tools/load_harness.py --smoke     # tiny live batch, no ratio gate
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def request_mix(n=256, rounds=16, fanout=2, repeats=8, seed0=0):
+    """The equal request mix both legs replay: four protocol shapes —
+    push-pull under a churn schedule (a partition window mid-run),
+    pull under a static fault, plain push, and period-2 anti-entropy
+    under link loss — each repeated with distinct seeds.  All four are
+    batchable under ONE batch key (same n-bucket / fanout / rounds),
+    so the megabatch mixes modes, faults, and schedules per tick."""
+    shapes = [
+        ({"mode": "pushpull", "fanout": fanout},
+         {"drop_prob": 0.05, "seed": 3,
+          "churn": {"events": [[3, 1, 4]],
+                    "partitions": [[1, 3, n // 2]]}}),
+        ({"mode": "pull", "fanout": fanout},
+         {"node_death_rate": 0.05, "drop_prob": 0.05, "seed": 5}),
+        ({"mode": "push", "fanout": fanout}, None),
+        ({"mode": "antientropy", "fanout": fanout, "period": 2},
+         {"drop_prob": 0.1, "seed": 7}),
+    ]
+    reqs = []
+    for r in range(repeats):
+        for i, (proto, fault) in enumerate(shapes):
+            req = {"backend": "jax-tpu", "proto": proto,
+                   "topology": {"family": "complete", "n": n},
+                   "run": {"max_rounds": rounds, "engine": "xla",
+                           "seed": seed0 + 31 * r + i},
+                   "curve": True}
+            if fault is not None:
+                req["fault"] = fault
+            reqs.append(req)
+    return reqs
+
+
+def _warm_megabatch(requests, serving_cfg):
+    """Compile every (batch-key, pow2-lane-bucket) megabatch executable
+    the ticks can form, directly through the driver — steady-state
+    serving must never touch the compile path (the gate below)."""
+    from gossip_tpu.backend import request_to_args
+    from gossip_tpu.parallel.sweep import request_sweep_curves
+    from gossip_tpu.rpc.batcher import classify_run, _topo_for
+    by_key = {}
+    for req in requests:
+        key, spec, _ = classify_run(request_to_args(dict(req)))
+        if key is None:
+            raise SystemExit(f"load mix contains an unbatchable "
+                             f"request: {spec}")
+        by_key.setdefault(key, []).append(spec)
+    from gossip_tpu.parallel.sweep import _pow2_at_least
+    for key, specs in by_key.items():
+        max_lanes = _pow2_at_least(min(len(specs),
+                                       serving_cfg.max_batch))
+        lanes = 1
+        while lanes <= max_lanes:
+            batch = (specs * lanes)[:lanes]
+            # full=True matches the batcher's lowering exactly: one
+            # executable per (key, lane bucket), whatever mode mix a
+            # tick forms
+            request_sweep_curves(batch, topo=_topo_for(key.topology),
+                                 n_pad=(None if key.topology is not None
+                                        else key.n_bucket), lanes=lanes,
+                                 full=True)
+            lanes *= 2
+    return sorted(by_key, key=str)
+
+
+def run_leg(label, requests, workers, serving_cfg, timeout_s, led):
+    """One measured leg: serve in-process, replay the mix from
+    ``workers`` concurrent client threads, return (summary, replies)."""
+    from gossip_tpu.rpc.sidecar import SidecarClient, serve
+    from gossip_tpu.utils import telemetry
+    server, port = serve(port=0, max_workers=workers + 4,
+                         batching=serving_cfg)
+    n_req = len(requests)
+    replies = [None] * n_req
+    lat_ms = [None] * n_req
+    errors = []
+    cursor = {"i": 0}
+    lock = threading.Lock()
+
+    def worker():
+        client = SidecarClient(f"127.0.0.1:{port}", max_attempts=1)
+        while True:
+            with lock:
+                i = cursor["i"]
+                if i >= n_req:
+                    break
+                cursor["i"] = i + 1
+            t0 = time.perf_counter()
+            try:
+                replies[i] = client.run(timeout=timeout_s,
+                                        **requests[i])
+            except Exception as e:          # ledgered, gated below
+                errors.append(f"req {i}: {type(e).__name__}: "
+                              f"{str(e).splitlines()[0][:200]}")
+            lat_ms[i] = (time.perf_counter() - t0) * 1e3
+        client.close()
+    led.event("load_phase", leg=label, phase="measure_start")
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker) for _ in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    led.event("load_phase", leg=label, phase="measure_end")
+    if server.gossip_batcher is not None:
+        server.gossip_batcher.close()
+    server.stop(grace=None)
+    lat = [x for x in lat_ms if x is not None]
+    summary = {
+        "leg": label, "requests": n_req, "workers": workers,
+        "errors": len(errors), "wall_s": round(wall, 3),
+        "rps": round(n_req / wall, 2),
+        "p50_ms": round(telemetry.percentile(lat, 0.50), 1),
+        "p95_ms": round(telemetry.percentile(lat, 0.95), 1),
+        "p99_ms": round(telemetry.percentile(lat, 0.99), 1),
+    }
+    led.event("load_leg", **summary)
+    for msg in errors[:10]:
+        led.event("load_error", leg=label, error=msg)
+    return summary, replies
+
+
+def compare_replies(batched, solo):
+    """Per-request bitwise equality of the serving payload: curve (the
+    exact float lists as serialized), msgs, coverage, rounds.  Returns
+    the list of mismatch descriptions (empty == bitwise equal)."""
+    bad = []
+    for i, (b, s) in enumerate(zip(batched, solo)):
+        if b is None or s is None:
+            bad.append(f"req {i}: missing reply "
+                       f"(batched={b is not None}, solo={s is not None})")
+            continue
+        for field in ("curve", "msgs", "coverage", "rounds"):
+            if b.get(field) != s.get(field):
+                bad.append(f"req {i}: {field} differs")
+                break
+    return bad
+
+
+def measure_window_batch_events(path, run_id):
+    """The ``batch`` events inside the batched leg's measurement window
+    (between its load_phase markers) — the steady-all-warm gate's
+    evidence."""
+    from gossip_tpu.utils import telemetry
+    events = telemetry.load_ledger(path, run=run_id)
+    out, active = [], False
+    for e in events:
+        if e.get("ev") == "load_phase" and e.get("leg") == "batched":
+            active = e.get("phase") == "measure_start"
+        elif e.get("ev") == "batch" and active:
+            out.append(e)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int, default=256)
+    ap.add_argument("--rounds", type=int, default=16)
+    ap.add_argument("--fanout", type=int, default=2)
+    ap.add_argument("--repeats", type=int, default=16,
+                    help="repeats of the 4-shape mix (requests = 4x)")
+    ap.add_argument("--workers", type=int, default=24)
+    ap.add_argument("--tick-ms", type=float, default=25.0)
+    ap.add_argument("--max-batch", type=int, default=64)
+    ap.add_argument("--min-ratio", type=float, default=3.0,
+                    help="batched/solo rps acceptance (0 disables)")
+    ap.add_argument("--timeout-s", type=float, default=300.0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny live batch: 2 repeats, 4 workers, no "
+                         "throughput gate (equality + all-warm still "
+                         "gate)")
+    ap.add_argument("--out", default=None,
+                    help="ledger path (default: a temp file; the "
+                         "committed capture passes artifacts/"
+                         "ledger_serving_r14.jsonl)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.repeats = min(args.repeats, 2)
+        args.workers = min(args.workers, 4)
+        args.n = min(args.n, 128)
+        args.rounds = min(args.rounds, 8)
+        args.min_ratio = 0.0
+
+    from gossip_tpu.config import ServingConfig
+    from gossip_tpu.utils import telemetry
+    out_path = args.out
+    if not out_path:
+        import tempfile
+        fd, out_path = tempfile.mkstemp(prefix="gossip_serving_",
+                                        suffix=".jsonl")
+        os.close(fd)
+    led = telemetry.Ledger(out_path)
+    prev = telemetry.activate(led)
+    try:
+        led.record_runtime()
+        requests = request_mix(n=args.n, rounds=args.rounds,
+                               fanout=args.fanout,
+                               repeats=args.repeats)
+        serving = ServingConfig(tick_ms=args.tick_ms,
+                                max_batch=args.max_batch,
+                                max_queue=max(4 * args.max_batch, 256))
+        led.event("load_config", requests=len(requests),
+                  workers=args.workers, n=args.n, rounds=args.rounds,
+                  tick_ms=args.tick_ms, max_batch=args.max_batch,
+                  smoke=bool(args.smoke))
+
+        # -- warmup (unmeasured): solo executables per distinct config,
+        # megabatch executables per (key, lane bucket) ---------------
+        led.event("load_phase", leg="warmup", phase="start")
+        from gossip_tpu.backend import request_to_args, run_simulation
+        seen_cfg = set()
+        for req in requests:
+            sig = json.dumps({k: v for k, v in req.items()
+                              if k != "run"}, sort_keys=True)
+            if sig in seen_cfg:
+                continue
+            seen_cfg.add(sig)
+            run_simulation(**request_to_args(dict(req)))
+        keys = _warm_megabatch(requests, serving)
+        led.event("load_phase", leg="warmup", phase="end",
+                  distinct_configs=len(seen_cfg),
+                  batch_keys=len(keys))
+
+        solo, solo_replies = run_leg("solo", requests, args.workers,
+                                     None, args.timeout_s, led)
+        batched, batched_replies = run_leg("batched", requests,
+                                           args.workers, serving,
+                                           args.timeout_s, led)
+
+        mismatches = compare_replies(batched_replies, solo_replies)
+        for m in mismatches[:10]:
+            led.event("equality_mismatch", detail=m)
+        batch_evs = measure_window_batch_events(out_path, led.run_id)
+        compiles = sum(e.get("compiles") or 0 for e in batch_evs)
+        sizes = [e.get("batch_size", 0) for e in batch_evs]
+        ratio = (batched["rps"] / solo["rps"]) if solo["rps"] else 0.0
+        coalesced = any(s > 1 for s in sizes)
+        ok_ratio = (args.min_ratio <= 0) or (ratio >= args.min_ratio)
+        ok = (ok_ratio and not mismatches and compiles == 0
+              and not solo["errors"] and not batched["errors"]
+              and coalesced)
+        led.event("serving_gate", ok=ok,
+                  throughput_ratio=round(ratio, 2),
+                  min_ratio=args.min_ratio, ratio_ok=ok_ratio,
+                  bitwise_equal=not mismatches,
+                  mismatches=len(mismatches),
+                  steady_all_warm=compiles == 0,
+                  measure_compiles=compiles,
+                  batch_events=len(batch_evs),
+                  max_batch_size=max(sizes) if sizes else 0,
+                  coalesced=coalesced,
+                  solo=solo, batched=batched)
+        print(json.dumps({"ok": ok, "ratio": round(ratio, 2),
+                          "solo_rps": solo["rps"],
+                          "batched_rps": batched["rps"],
+                          "batched_p50_ms": batched["p50_ms"],
+                          "bitwise_equal": not mismatches,
+                          "steady_all_warm": compiles == 0,
+                          "max_batch_size": max(sizes) if sizes else 0,
+                          "ledger": out_path}))
+        return 0 if ok else 1
+    finally:
+        telemetry.activate(prev)
+        led.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

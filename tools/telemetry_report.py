@@ -203,6 +203,19 @@ def _protocol_metrics_section(events):
     return render_protocol_metrics(events)
 
 
+def _serving_section(events):
+    """The "Serving batches" lines, rendered by the batching tool's ONE
+    implementation (tools/batching_report.render_serving_section — the
+    rpc/batcher ``batch`` event schema has exactly one reader).  Empty
+    for runs with no serving telemetry."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from batching_report import render_serving_section
+    finally:
+        sys.path.pop(0)
+    return render_serving_section(events)
+
+
 def check_health(events):
     """Ledger-health problems for the ``--check`` CI gate: a run whose
     evidence cannot be trusted mechanically.  Flags (a) a missing
@@ -302,6 +315,7 @@ def render_markdown(events, budgets=None, title=None):
         out.append("")
 
     out.extend(_protocol_metrics_section(events))
+    out.extend(_serving_section(events))
 
     tree = span_tree(events)
     if tree:

@@ -101,6 +101,16 @@ def _is_nemesis_name(name: str) -> bool:
             or "crashloop" in name or "crdt" in name)
 
 
+def _is_serving_name(name: str) -> bool:
+    """Serving/load artifacts by name — throughput and latency gates
+    (the admission-batching layer's committed evidence: requests/sec,
+    p50/p95/p99, bitwise-equality verdicts — tools/load_harness) must
+    always be attributable; the legacy allowlist can never grandfather
+    one in (the whole serving layer post-dates the provenance
+    schema)."""
+    return "serving" in name or "load" in name
+
+
 def validate_file(path):
     """[] when valid, else a list of human-readable problems."""
     name = os.path.basename(path)
@@ -139,6 +149,11 @@ def validate_file(path):
                     "nemesis/churn artifact without a provenance line "
                     "— robustness evidence must be attributable, "
                     "allowlist or not (utils/telemetry.provenance)")
+            if not has_prov and _is_serving_name(name):
+                problems.append(
+                    "serving/load artifact without a provenance line "
+                    "— throughput/latency gates must be attributable, "
+                    "allowlist or not (utils/telemetry.provenance)")
         else:
             with open(path) as f:
                 doc = json.load(f)
@@ -147,6 +162,12 @@ def validate_file(path):
                     "nemesis/churn artifact without provenance keys "
                     f"{PROVENANCE_KEYS} — robustness evidence must be "
                     "attributable, allowlist or not")
+            elif _is_serving_name(name) \
+                    and not _has_provenance_keys(doc):
+                problems.append(
+                    "serving/load artifact without provenance keys "
+                    f"{PROVENANCE_KEYS} — throughput/latency gates "
+                    "must be attributable, allowlist or not")
             elif name not in LEGACY and not _has_provenance_keys(doc):
                 problems.append(
                     "new-format json without provenance keys "
