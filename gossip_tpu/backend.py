@@ -24,8 +24,9 @@ import dataclasses
 import time
 from typing import Any, Dict, List, Optional
 
-from gossip_tpu.config import (FaultConfig, MeshConfig, ProtocolConfig,
-                               RunConfig, TopologyConfig)
+from gossip_tpu.config import (FaultConfig, LogConfig, MeshConfig,
+                               ProtocolConfig, RunConfig,
+                               TopologyConfig)
 
 BACKENDS = ("jax-tpu", "go-native")
 
@@ -834,11 +835,63 @@ def _run_jax_with_topo(proto: ProtocolConfig, tc: TopologyConfig,
                            **_timing_meta(timing, wall)})
 
 
+def run_log_workload(proto: ProtocolConfig, tc: TopologyConfig,
+                     run: RunConfig, log_cfg: LogConfig,
+                     fault: Optional[FaultConfig] = None,
+                     want_curve: bool = False) -> RunReport:
+    """The replicated-log workload behind the ``Run`` RPC's ``log``
+    field (models/log.py drivers; single-process single-device — the
+    node mesh shards via the library API, the Ensemble RPC rule).
+    ``coverage`` reports the final log_conv; meta carries the
+    acked-appends truth summary."""
+    from gossip_tpu.models.log import (check_log_mode,
+                                       simulate_curve_log,
+                                       simulate_until_log)
+    from gossip_tpu.topology import generators as G
+    check_log_mode(proto)
+    if run.engine not in ("auto", "xla"):
+        raise ValueError(f"engine={run.engine!r} cannot run the log "
+                         "workload (XLA pull kernels only)")
+    topo = G.build(tc)
+    t0 = time.perf_counter()
+    if want_curve:
+        conv, msgs, _, truth = simulate_curve_log(log_cfg, proto, topo,
+                                                  run, fault)
+        hit = [i for i, c in enumerate(conv)
+               if c >= run.target_coverage]
+        rounds = (hit[0] + 1) if hit else -1
+        lc, msgs_f = float(conv[-1]), float(msgs[-1])
+        curve = [float(c) for c in conv]
+    else:
+        rounds, lc, msgs_f, _, truth = simulate_until_log(
+            log_cfg, proto, topo, run, fault)
+        curve = None
+    wall = time.perf_counter() - t0
+    return RunReport(
+        backend="jax-tpu", mode="log", n=tc.n, rounds=rounds,
+        coverage=lc, msgs=msgs_f, wall_s=round(wall, 4), curve=curve,
+        meta={"clock": "rounds", "devices": 1,
+              "msgs_counts": "transmissions", "engine": "log-xla",
+              "workload": "log", "truth": truth})
+
+
 def run_simulation(backend: str, proto: ProtocolConfig, tc: TopologyConfig,
                    run: RunConfig, fault: Optional[FaultConfig] = None,
                    mesh_cfg: Optional[MeshConfig] = None,
-                   want_curve: bool = False) -> RunReport:
+                   want_curve: bool = False,
+                   log_cfg: Optional[LogConfig] = None) -> RunReport:
     """The one entry point both the CLI and the sidecar call."""
+    if log_cfg is not None:
+        if backend != "jax-tpu":
+            raise ValueError("the log workload needs the jax-tpu "
+                             "backend")
+        if mesh_cfg is not None:
+            raise ValueError("the log workload over RPC is "
+                             "single-process single-device; shard the "
+                             "node mesh via the library API "
+                             "(parallel/sharded_log)")
+        return run_log_workload(proto, tc, run, log_cfg, fault,
+                                want_curve)
     if backend == "go-native" and run.engine not in ("auto", "native"):
         raise ValueError(f"engine={run.engine!r} is a jax-tpu kernel "
                          "selection; go-native takes 'auto' (C++ core "
@@ -854,7 +907,8 @@ def run_simulation(backend: str, proto: ProtocolConfig, tc: TopologyConfig,
 # -- (de)serialization for the RPC/CLI boundary --------------------------
 
 _CFG_TYPES = {"proto": ProtocolConfig, "topology": TopologyConfig,
-              "run": RunConfig, "fault": FaultConfig, "mesh": MeshConfig}
+              "run": RunConfig, "fault": FaultConfig,
+              "mesh": MeshConfig, "log": LogConfig}
 
 
 def run_ensemble(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
@@ -929,7 +983,8 @@ def request_to_args(req: Dict[str, Any]) -> Dict[str, Any]:
                 raise ValueError(f"unknown {key} fields: {sorted(bad)}")
             cfg = cls(**val)
         out[{"proto": "proto", "topology": "tc", "run": "run",
-             "fault": "fault", "mesh": "mesh_cfg"}[key]] = cfg
+             "fault": "fault", "mesh": "mesh_cfg",
+             "log": "log_cfg"}[key]] = cfg
     if out["proto"] is None:
         out["proto"] = ProtocolConfig()
     if out["tc"] is None:

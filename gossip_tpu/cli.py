@@ -1084,6 +1084,91 @@ def cmd_crdt(a) -> int:
     return 0
 
 
+def _parse_log_injections(a):
+    """--send NODE:KEY:ROUND:VALUE / --commit NODE:KEY:ROUND:UPTO ->
+    LogConfig kwargs (field validation lives in LogConfig itself —
+    the _parse_crdt_injections discipline)."""
+    def parts(s, what):
+        p = s.split(":")
+        if len(p) != 4:
+            raise ValueError(f"--{what} takes 4 colon-separated "
+                             f"fields, got {s!r}")
+        return tuple(int(x) for x in p)
+
+    return dict(
+        sends=tuple(parts(s, "send") for s in (a.send or ())),
+        commits=tuple(parts(s, "commit") for s in (a.commit or ())))
+
+
+def cmd_log(a) -> int:
+    """Replicated kafka-style log run: ordered per-key offset payloads
+    on the pull exchange fabric, convergence judged integer-exact
+    against the acked-appends ground truth on the eventual-alive set
+    (docs/WORKLOADS.md "Replicated logs")."""
+    from gossip_tpu.config import LogConfig
+    from gossip_tpu.topology import generators as G
+    cfg = LogConfig(keys=a.keys, capacity=a.capacity,
+                    **_parse_log_injections(a))
+    proto = ProtocolConfig(mode="pull", fanout=a.fanout)
+    tc = TopologyConfig(family=a.family, n=a.n, k=a.k, p=a.p,
+                        seed=a.seed)
+    run = RunConfig(target_coverage=a.target, max_rounds=a.max_rounds,
+                    seed=a.seed, origin=a.origin)
+    churn = _parse_churn(a)
+    fault = None
+    if a.drop > 0 or a.death > 0 or churn is not None:
+        fault = FaultConfig(node_death_rate=a.death, drop_prob=a.drop,
+                            seed=a.seed, churn=churn)
+    topo = G.build(tc)
+    want_curve = a.curve or bool(a.save_curve)
+    import time as _time
+    t0 = _time.perf_counter()
+    if a.devices > 1:
+        from gossip_tpu.parallel.sharded import make_mesh
+        from gossip_tpu.parallel.sharded_log import (
+            simulate_curve_log_sharded, simulate_until_log_sharded)
+        mesh = make_mesh(a.devices)
+        if want_curve:
+            conv, msgs, final, truth = simulate_curve_log_sharded(
+                cfg, proto, topo, run, mesh, fault)
+        else:
+            rounds, lc, msgs_f, final, truth = (
+                simulate_until_log_sharded(cfg, proto, topo, run,
+                                           mesh, fault))
+        engine = "log-sharded"
+    else:
+        from gossip_tpu.models.log import (simulate_curve_log,
+                                           simulate_until_log)
+        if want_curve:
+            conv, msgs, final, truth = simulate_curve_log(
+                cfg, proto, topo, run, fault)
+        else:
+            rounds, lc, msgs_f, final, truth = simulate_until_log(
+                cfg, proto, topo, run, fault)
+        engine = "log-xla"
+    wall = _time.perf_counter() - t0
+    if want_curve:
+        hit = [i for i, c in enumerate(conv) if c >= a.target]
+        rounds = (hit[0] + 1) if hit else -1
+        lc, msgs_f = float(conv[-1]), float(msgs[-1])
+    out = {"backend": "jax-tpu", "mode": "log", "n": a.n,
+           "keys": a.keys, "capacity": a.capacity, "rounds": rounds,
+           "log_conv": lc, "converged": lc >= a.target,
+           "truth": truth, "msgs": msgs_f, "wall_s": round(wall, 4),
+           "devices": a.devices, "engine": engine,
+           "compile_cache": _cache_stamp(a)}
+    if churn is not None:
+        out["fault_program"] = True
+    if a.save_curve:
+        from gossip_tpu.utils.metrics import dump_curve_jsonl
+        dump_curve_jsonl(a.save_curve, [float(c) for c in conv],
+                         meta=dict(out))
+    if a.curve:
+        out["curve"] = [float(c) for c in conv]
+    print(json.dumps(out))
+    return 0
+
+
 def cmd_serve(a) -> int:
     from gossip_tpu.config import ServingConfig
     from gossip_tpu.rpc.sidecar import serve
@@ -1126,7 +1211,21 @@ def _node_argv(gossip_interval: float, workload: str = "broadcast"):
 
 def cmd_maelstrom_check(a) -> int:
     argv = _node_argv(a.gossip_interval, a.workload)
-    if a.workload == "counter":
+    if a.workload == "kafka":
+        if a.router == "native":
+            print("error: the kafka workload runs on the python "
+                  "router (the C++ router speaks the broadcast "
+                  "envelope set only)", file=sys.stderr)
+            return 2
+        import asyncio
+
+        from gossip_tpu.runtime.maelstrom_harness import (
+            run_kafka_workload)
+        stats = asyncio.run(run_kafka_workload(
+            a.n, a.ops, rate=a.rate, latency=a.latency,
+            topology=a.topology, partition_mid=a.partition, seed=a.seed,
+            argv=argv))
+    elif a.workload == "counter":
         if a.router == "native":
             print("error: the counter workload runs on the python "
                   "router (the C++ router speaks the broadcast "
@@ -1357,6 +1456,67 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_cache_flags(p)
     p.set_defaults(fn=cmd_crdt)
 
+    p = sub.add_parser("log",
+                       help="run a replicated kafka-style log "
+                            "(ordered per-key offset payloads with "
+                            "committed offsets) on the pull exchange "
+                            "fabric with optional nemesis fault "
+                            "programs; convergence is integer-exact "
+                            "against the acked-appends ground truth "
+                            "on the eventual-alive set")
+    p.add_argument("--n", type=int, default=1024)
+    p.add_argument("--keys", type=int, default=4,
+                   help="number of per-key logs K (ops/logs.py)")
+    p.add_argument("--capacity", type=int, default=16,
+                   help="ring slots per key C (at most C sends per "
+                        "key — a wrap would alias offsets and is "
+                        "rejected loudly)")
+    p.add_argument("--fanout", type=int, default=2)
+    p.add_argument("--family", default="complete",
+                   choices=("complete", "ring", "grid", "erdos_renyi",
+                            "watts_strogatz", "power_law"))
+    p.add_argument("--k", type=int, default=4)
+    p.add_argument("--p", type=float, default=0.01)
+    p.add_argument("--target", type=float, default=1.0,
+                   help="log-convergence target (default 1.0: EVERY "
+                        "eventual-alive node holds the exact acked "
+                        "log + committed offsets — the Gossip "
+                        "Glomers invariant)")
+    p.add_argument("--max-rounds", type=int, default=64)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--origin", type=int, default=0)
+    p.add_argument("--devices", type=int, default=1,
+                   help="node-dim mesh size (sharded pull exchange)")
+    p.add_argument("--drop", type=float, default=0.0)
+    p.add_argument("--death", type=float, default=0.0)
+    p.add_argument("--send", action="append", default=None,
+                   metavar="NODE:KEY:ROUND:VALUE",
+                   help="scripted append (repeatable; values >= 1; "
+                        "per-key rounds must be nondecreasing — "
+                        "offset order is time order; default "
+                        "program: 4 sends per key, rounds 0-3)")
+    p.add_argument("--commit", action="append", default=None,
+                   metavar="NODE:KEY:ROUND:UPTO",
+                   help="scripted commit (repeatable; commits "
+                        "min(upto, acked_len) — clamped to the "
+                        "eventually-acked log length; default: one "
+                        "commit per key at round 4)")
+    p.add_argument("--churn-event", action="append", default=None,
+                   metavar="NODE:DIE[:REC]",
+                   help="nemesis crash/recover churn (repeatable)")
+    p.add_argument("--partition", action="append", default=None,
+                   metavar="START:END:CUT",
+                   help="nemesis partition window (repeatable)")
+    p.add_argument("--drop-ramp", default=None,
+                   metavar="START:END:P0:P1",
+                   help="nemesis drop-rate ramp")
+    p.add_argument("--curve", action="store_true",
+                   help="include the per-round log-convergence curve")
+    p.add_argument("--save-curve", default=None, metavar="PATH",
+                   help="write the log-convergence curve as JSONL")
+    _add_cache_flags(p)
+    p.set_defaults(fn=cmd_log)
+
     p = sub.add_parser("serve", help="start the gRPC sidecar")
     p.add_argument("--port", type=int, default=50051)
     p.add_argument("--workers", type=int, default=16)
@@ -1380,10 +1540,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="batch relays per neighbor every INTERVAL "
                         "seconds (0 = immediate per-message fan-out)")
     p.add_argument("--workload", default="broadcast",
-                   choices=("broadcast", "counter"),
+                   choices=("broadcast", "counter", "kafka"),
                    help="node personality: broadcast log (the "
-                        "reference) or Gossip Glomers counter (CRDT "
-                        "shards, merge = per-key max)")
+                        "reference), Gossip Glomers counter (CRDT "
+                        "shards, merge = per-key max), or the "
+                        "replicated kafka-style log (owner-assigned "
+                        "offsets, committed-offset max merge)")
     p.set_defaults(fn=cmd_maelstrom)
 
     p = sub.add_parser("maelstrom-check",
@@ -1408,11 +1570,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "poll()-loop router (native/router.cpp, built on "
                         "demand)")
     p.add_argument("--workload", default="broadcast",
-                   choices=("broadcast", "counter"),
-                   help="broadcast (every value in every read) or the "
+                   choices=("broadcast", "counter", "kafka"),
+                   help="broadcast (every value in every read), the "
                         "Gossip Glomers counter (every node's final "
                         "read == the sum of acked adds, through a "
-                        "--partition)")
+                        "--partition), or kafka (acked sends exactly "
+                        "once per key in offset order, monotone "
+                        "committed offsets, gapless polls — through "
+                        "a --partition)")
     p.add_argument("--gossip-interval", type=float, default=0.0,
                    help="run the nodes with interval-batched relays "
                         "(seconds; 0 = the reference's immediate "
@@ -1430,7 +1595,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     a = ap.parse_args(argv)
     try:
         if a.cmd in ("run", "sweep", "grid", "churn-sweep", "crdt",
-                     "serve"):
+                     "log", "serve"):
             # multi-host pods: one jax.distributed.initialize() per host
             # before any jax API (no-op without the coordinator env vars)
             from gossip_tpu.parallel.multislice import maybe_init_distributed

@@ -448,6 +448,128 @@ class CrdtConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class LogConfig:
+    """A replicated kafka-style log workload (ops/logs.py,
+    models/log.py) — the last Gossip Glomers sibling of the
+    reference's broadcast: ordered per-key offset payloads with
+    committed offsets, gossiped as fixed-capacity ring buffers whose
+    merge is elementwise max over owner-indexed slot planes.
+
+    ``sends`` are ``(node, key, round, value)`` — node appends
+    ``value`` to key's log at ``round``; ``commits`` are ``(node, key,
+    round, upto)`` — node commits key's offsets below
+    ``min(upto, acked_len(key))`` at ``round``.  Both are *programs
+    over rounds* lowered to runtime operands (the nemesis/CRDT
+    pattern); empty means the closed-form default programs
+    (ops/logs.log_sends / log_commits — no O(K) config object).
+
+    Contracts the validation enforces loudly:
+
+    * values >= 1 (0 is the empty-slot sentinel — a 0 value would be
+      invisible to the merge);
+    * at most ``capacity`` sends per key (the ring position is
+      ``offset % capacity``; more sends would wrap onto an unconsumed
+      slot and silently alias two offsets);
+    * per-key script order is round-nondecreasing (offsets are
+      assigned in script order — ops/logs.send_offsets — so this is
+      what makes offset order equal time order, the ORDERED half of
+      the kafka invariants);
+    * commit ``upto`` >= 1 (committing nothing is the default state).
+    """
+
+    keys: int = 4               # K: number of per-key logs
+    capacity: int = 16          # C: ring slots per key
+    sends: Tuple[Tuple[int, int, int, int], ...] = ()
+    commits: Tuple[Tuple[int, int, int, int], ...] = ()
+
+    def __post_init__(self):
+        object.__setattr__(self, "sends", tuple(
+            tuple(int(x) for x in s) for s in self.sends))
+        object.__setattr__(self, "commits", tuple(
+            tuple(int(x) for x in c) for c in self.commits))
+        if self.keys < 1:
+            raise ValueError("keys must be >= 1")
+        if self.capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        per_key_rounds: dict = {}
+        for s in self.sends:
+            if len(s) != 4:
+                raise ValueError(f"log send {s} must be "
+                                 "(node, key, round, value)")
+            node, key, rnd, val = s
+            if node < 0:
+                raise ValueError(f"send node {node} must be >= 0")
+            if not 0 <= key < self.keys:
+                raise ValueError(f"send key {key} outside "
+                                 f"[0, {self.keys})")
+            if rnd < 0 or rnd > MAX_CHURN_HORIZON:
+                raise ValueError(
+                    f"send round {rnd} outside [0, {MAX_CHURN_HORIZON}]"
+                    " (the schedule horizon cap, shared with "
+                    "ChurnConfig)")
+            if val < 1:
+                raise ValueError(
+                    f"send {s}: values must be >= 1 (0 is the "
+                    "empty-slot sentinel the merge identity rides)")
+            rounds = per_key_rounds.setdefault(key, [])
+            if rounds and rnd < rounds[-1]:
+                raise ValueError(
+                    f"send {s}: key {key}'s sends must be scripted in "
+                    "round-nondecreasing order — offsets are assigned "
+                    "in script order, so out-of-order rounds would "
+                    "break offset-order == time-order (the kafka "
+                    "ordered-append contract, ops/logs module doc)")
+            rounds.append(rnd)
+        for key, rounds in per_key_rounds.items():
+            if len(rounds) > self.capacity:
+                raise ValueError(
+                    f"key {key} scripts {len(rounds)} sends but "
+                    f"capacity is {self.capacity}: the ring would wrap "
+                    "onto an unconsumed slot and alias two offsets — "
+                    "raise capacity or split the program")
+        # the DEFAULT send program appends 4 entries per key
+        # (ops/logs.log_sends) — it must obey the same no-wrap
+        # contract, or an unscripted tiny-capacity config would alias
+        # slots silently where a scripted one errors loudly
+        if not self.sends and self.capacity < 4:
+            raise ValueError(
+                f"capacity={self.capacity} cannot hold the default "
+                "send program (4 sends per key — ops/logs.log_sends): "
+                "the ring would wrap and alias offsets; raise "
+                "capacity to >= 4 or script the sends")
+        for c in self.commits:
+            if len(c) != 4:
+                raise ValueError(f"log commit {c} must be "
+                                 "(node, key, round, upto)")
+            node, key, rnd, upto = c
+            if node < 0:
+                raise ValueError(f"commit node {node} must be >= 0")
+            if not 0 <= key < self.keys:
+                raise ValueError(f"commit key {key} outside "
+                                 f"[0, {self.keys})")
+            if rnd < 0 or rnd > MAX_CHURN_HORIZON:
+                raise ValueError(
+                    f"commit round {rnd} outside "
+                    f"[0, {MAX_CHURN_HORIZON}]")
+            if upto < 1:
+                raise ValueError(f"commit {c}: upto must be >= 1 "
+                                 "(nothing-committed is the default "
+                                 "state, not a scripted op)")
+
+    def horizon(self) -> int:
+        """Rounds after which no further send/commit fires (the
+        zero-row steady state of the lowered injection tables).  The
+        DEFAULT programs end at rounds 3 (sends) / 4 (commits —
+        ops/logs.log_sends / log_commits), so an empty config still
+        needs max_rounds > 4."""
+        rounds = [3 if not self.sends else 0,
+                  4 if not self.commits else 0]
+        rounds += [r for _, _, r, _ in self.sends]
+        rounds += [r for _, _, r, _ in self.commits]
+        return max(rounds) + 1
+
+
+@dataclasses.dataclass(frozen=True)
 class FaultConfig:
     """In-kernel fault injection.
 

@@ -75,6 +75,16 @@ CRDT observables (present when the stack is built with ``crdt=True``
               observability; the drivers' pinned readout stays the
               integer converged count divided once on host).
 
+Replicated-log observables (present when the stack is built with
+``log=True`` — drivers running the ordered per-key offset payload,
+ops/logs):
+
+``log_conv``  fraction of eventual-alive nodes whose full log row
+              (entry planes + committed-offset vector) equals the
+              acked-appends ground truth after the round — the
+              ORDERED eventual-consistency metric (same in-loop-f32 /
+              integer-readout split as ``value_conv``).
+
 ``GOSSIP_ROUND_METRICS=0`` (or empty) is the kill switch; metrics are
 also skipped when no run ledger is active (:func:`wanted`) — the
 buffers exist to be ledgered, and dark buffers would tax every test
@@ -123,11 +133,12 @@ class RoundMetrics:
 
     __slots__ = ("cursor", "newly", "dup", "msgs", "bytes", "front",
                  "alive", "cut_pairs", "dropped", "value_conv",
-                 "label", "nemesis", "crdt")
+                 "log_conv", "label", "nemesis", "crdt", "log")
 
     def __init__(self, cursor, newly, dup, msgs, bytes, front,
-                 alive, cut_pairs, dropped, value_conv, label: str,
-                 nemesis: bool = False, crdt: bool = False):
+                 alive, cut_pairs, dropped, value_conv, log_conv,
+                 label: str, nemesis: bool = False, crdt: bool = False,
+                 log: bool = False):
         self.cursor = cursor
         self.newly = newly
         self.dup = dup
@@ -138,9 +149,11 @@ class RoundMetrics:
         self.cut_pairs = cut_pairs
         self.dropped = dropped
         self.value_conv = value_conv
+        self.log_conv = log_conv
         self.label = label
         self.nemesis = nemesis
         self.crdt = crdt
+        self.log = log
 
     def _replace(self, **kw):
         fields = {k: getattr(self, k) for k in self.__slots__}
@@ -150,14 +163,15 @@ class RoundMetrics:
 
 def _rm_flatten(m):
     return ((m.cursor, m.newly, m.dup, m.msgs, m.bytes, m.front,
-             m.alive, m.cut_pairs, m.dropped, m.value_conv),
-            (m.label, m.nemesis, m.crdt))
+             m.alive, m.cut_pairs, m.dropped, m.value_conv,
+             m.log_conv),
+            (m.label, m.nemesis, m.crdt, m.log))
 
 
 def _rm_unflatten(aux, children):
-    label, nemesis, crdt = aux
+    label, nemesis, crdt, log = aux
     return RoundMetrics(*children, label=label, nemesis=nemesis,
-                        crdt=crdt)
+                        crdt=crdt, log=log)
 
 
 jax.tree_util.register_pytree_node(RoundMetrics, _rm_flatten,
@@ -165,13 +179,15 @@ jax.tree_util.register_pytree_node(RoundMetrics, _rm_flatten,
 
 
 def init(max_rounds: int, n_shards: int, label: str,
-         nemesis: bool = False, crdt: bool = False) -> RoundMetrics:
+         nemesis: bool = False, crdt: bool = False,
+         log: bool = False) -> RoundMetrics:
     """Zeroed buffer stack for up to ``max_rounds`` rounds over
-    ``n_shards`` shards (1 for single-device drivers).  Tiny: 8 T + T*S
-    floats — at the flagship's T=128, S=8 that is 3.6 KB.  ``nemesis``
+    ``n_shards`` shards (1 for single-device drivers).  Tiny: 9 T + T*S
+    floats — at the flagship's T=128, S=8 that is 4 KB.  ``nemesis``
     marks a stack that carries the churn observables (alive/cut_pairs/
     dropped are recorded and ledgered; zeros otherwise); ``crdt`` marks
-    one carrying the value-convergence column (module doc)."""
+    one carrying the value-convergence column, ``log`` one carrying the
+    replicated-log convergence column (module doc)."""
     if max_rounds < 1:
         raise ValueError(f"max_rounds={max_rounds} must be >= 1")
     if n_shards < 1:
@@ -182,19 +198,22 @@ def init(max_rounds: int, n_shards: int, label: str,
                         front=jnp.zeros((max_rounds, n_shards),
                                         jnp.float32),
                         alive=z, cut_pairs=z, dropped=z, value_conv=z,
-                        label=label, nemesis=nemesis, crdt=crdt)
+                        log_conv=z, label=label, nemesis=nemesis,
+                        crdt=crdt, log=log)
 
 
 def record(m: RoundMetrics, *, newly, dup, msgs, bytes,
            front, alive=None, cut_pairs=None,
-           dropped=None, value_conv=None) -> RoundMetrics:
+           dropped=None, value_conv=None,
+           log_conv=None) -> RoundMetrics:
     """Write one round's row at the cursor (in-trace; scatter writes
     only).  The cursor is clamped to the last row so an over-long loop
     can never write out of bounds — by contract the drivers size the
     buffers with ``run.max_rounds``, which also bounds their loops.
-    The nemesis columns (alive/cut_pairs/dropped) and the CRDT
-    ``value_conv`` column are only written when passed — the
-    static-fault / non-CRDT recorders never touch them."""
+    The nemesis columns (alive/cut_pairs/dropped), the CRDT
+    ``value_conv`` column, and the replicated-log ``log_conv`` column
+    are only written when passed — the static-fault / non-payload
+    recorders never touch them."""
     i = jnp.minimum(m.cursor, m.newly.shape[0] - 1)
     f32 = lambda v: jnp.asarray(v, jnp.float32)       # noqa: E731
     kw = {}
@@ -206,6 +225,8 @@ def record(m: RoundMetrics, *, newly, dup, msgs, bytes,
         kw["dropped"] = m.dropped.at[i].set(f32(dropped))
     if value_conv is not None:
         kw["value_conv"] = m.value_conv.at[i].set(f32(value_conv))
+    if log_conv is not None:
+        kw["log_conv"] = m.log_conv.at[i].set(f32(log_conv))
     return m._replace(
         cursor=m.cursor + 1,
         newly=m.newly.at[i].set(f32(newly)),
@@ -332,9 +353,10 @@ def emit(out, ledger, fn=None):
     import numpy as np
     for m in stacks:
         (cursor, newly, dup, msgs, bytes_, front, alive, cut_pairs,
-         dropped, value_conv) = jax.device_get(
+         dropped, value_conv, log_conv) = jax.device_get(
             (m.cursor, m.newly, m.dup, m.msgs, m.bytes, m.front,
-             m.alive, m.cut_pairs, m.dropped, m.value_conv))
+             m.alive, m.cut_pairs, m.dropped, m.value_conv,
+             m.log_conv))
         r = min(int(cursor), int(newly.shape[0]))
 
         def ser(a, nd=3):
@@ -351,6 +373,10 @@ def emit(out, ledger, fn=None):
             # value convergence per round + the final fraction (the
             # eventual-consistency headline an artifact pin asserts)
             extra["value_conv"] = ser(value_conv, nd=4)
+        if m.log:
+            # replicated-log convergence per round (the ORDERED
+            # eventual-consistency headline — ops/logs)
+            extra["log_conv"] = ser(log_conv, nd=4)
         totals = {"newly": round(float(np.sum(newly[:r])), 3),
                   "dup": round(float(np.sum(dup[:r])), 3),
                   "msgs": round(float(np.sum(msgs[:r])), 3),
@@ -360,6 +386,9 @@ def emit(out, ledger, fn=None):
         if m.crdt:
             totals["value_conv_final"] = (
                 round(float(value_conv[r - 1]), 4) if r else 0.0)
+        if m.log:
+            totals["log_conv_final"] = (
+                round(float(log_conv[r - 1]), 4) if r else 0.0)
         ledger.event(
             "round_metrics", sync=False, driver=m.label, fn=fn,
             rounds=r, shards=int(front.shape[1]),

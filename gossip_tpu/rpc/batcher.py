@@ -132,6 +132,12 @@ def classify_run(args):
     from gossip_tpu.parallel.sweep import RequestSpec, _pow2_at_least
     if args["backend"] != "jax-tpu":
         return None, f"backend={args['backend']}", None
+    if args.get("log_cfg") is not None:
+        # the replicated-log workload carries its own payload state +
+        # injection operands (ops/logs) — not a megabatch lane shape
+        # the SI request sweep can host; it dispatches solo, loudly
+        # labeled (the PR 9 fall-through contract)
+        return None, "log workload dispatches solo", None
     if args["mesh_cfg"] is not None:
         return None, "mesh requests dispatch solo", None
     run, proto, tc = args["run"], args["proto"], args["tc"]

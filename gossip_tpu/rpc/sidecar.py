@@ -151,6 +151,10 @@ def _ensemble(request: bytes, context, batcher=None) -> bytes:
         args = request_to_args(req)
         if args["backend"] != "jax-tpu":
             raise ValueError("ensembles need the jax-tpu backend")
+        if args.get("log_cfg") is not None:
+            raise ValueError("the Ensemble RPC does not run the log "
+                             "workload; use Run (one log program per "
+                             "call)")
         if args["mesh_cfg"] is not None:
             raise ValueError("the Ensemble RPC is single-process "
                              "single-device; shard seed axes via the "

@@ -226,6 +226,32 @@ class MaelstromHarness:
         assert r["body"]["type"] == "read_ok"
         return int(r["body"]["value"])
 
+    async def kafka_send(self, node: str, key: str, msg: int) -> dict:
+        """Kafka-workload ``send`` op; the caller checks for
+        ``send_ok`` (only acked sends join the exactly-once-in-order
+        invariant) and reads the assigned ``offset`` off the reply."""
+        return await self._timed_op(node, {"type": "send", "key": key,
+                                           "msg": msg})
+
+    async def kafka_poll(self, node: str, offsets: dict) -> dict:
+        """``poll`` from the given per-key offsets ->
+        ``{key: [[offset, msg], ...]}``."""
+        r = await self._client_rpc(node, {"type": "poll",
+                                          "offsets": offsets})
+        assert r["body"]["type"] == "poll_ok"
+        return r["body"]["msgs"]
+
+    async def kafka_commit(self, node: str, offsets: dict) -> dict:
+        """``commit_offsets`` op (op-counted like every write)."""
+        return await self._timed_op(node, {"type": "commit_offsets",
+                                           "offsets": offsets})
+
+    async def kafka_list_committed(self, node: str, keys: list) -> dict:
+        r = await self._client_rpc(node, {
+            "type": "list_committed_offsets", "keys": keys})
+        assert r["body"]["type"] == "list_committed_offsets_ok"
+        return r["body"]["offsets"]
+
     async def send_raw(self, dest: str, body: dict, timeout: float = 15.0
                        ) -> dict:
         """Arbitrary client RPC (conformance probes, e.g. unknown types)."""
@@ -412,6 +438,170 @@ async def run_counter_workload(n: int, ops: int, rate: float = 50.0,
         out = await _finish_workload(h, check)
         out["expected"] = acked_sum
         out["final_values"] = list(finals)
+        out["partitioned"] = bool(partition_mid)
+        return out
+    finally:
+        await h.stop()
+
+
+async def run_kafka_workload(n: int, ops: int, rate: float = 50.0,
+                             latency: float = 0.002,
+                             topology: str = "line",
+                             partition_mid: bool = False,
+                             seed: int = 0, keys: int = 3,
+                             argv: Optional[List[str]] = None) -> dict:
+    """The Gossip Glomers ``kafka`` (replicated log) workload: spawn
+    ``n`` kafka nodes (runtime/maelstrom_node.KafkaServer), send
+    ``ops`` unique-value ``send`` ops at ``rate`` ops/s to random
+    nodes over ``keys`` keys, interleave polls and commits, optionally
+    cut a mid-cluster link mid-run, then check the three kafka
+    invariants (SURVEY.md §4 checker style):
+
+      1. **exactly-once in offset order** — every ACKED send appears
+         in every node's final ``poll(key, 0)`` at exactly its acked
+         offset, no send (acked or not) appears twice, and offsets
+         are consecutive.  A send whose client RPC timed out or drew
+         an error reply is **indeterminate** (the Maelstrom
+         info-timeout convention: the owner may have applied a
+         forwarded send whose ack was lost) — it MAY appear, but
+         still at most once (the owner dedups retried forwards by
+         value);
+      2. **monotone committed offsets** — every
+         ``list_committed_offsets`` sample taken during the run
+         (including across the partition) never regresses per
+         (node, key), and the final committed map agrees on every
+         node;
+      3. **gapless polls** — every poll reply's offsets are
+         consecutive from the requested offset (checked on every
+         in-run poll, not just the final ones).
+
+    In-run probes that time out across the partition are skipped,
+    never crashed on (the client timeout is a harness budget, not a
+    verdict).  Returns the stats dict (+ ``invariant_ok``,
+    ``monotone_ok``, ``gapless_ok``, ``acked``, ``indeterminate``,
+    ``partitioned``)."""
+    import random
+    rng = random.Random(seed)
+    if argv is None:
+        argv = [sys.executable, "-u", "-m",
+                "gossip_tpu.runtime.maelstrom_node",
+                "--workload", "kafka"]
+    h = await _start_workload(n, ops, rate, latency, topology,
+                              partition_mid, argv)
+    try:
+        key_names = [str(k) for k in range(keys)]
+        acked: Dict[str, Dict[int, int]] = {k: {} for k in key_names}
+        # client-timeout / error-reply sends: the owner MAY have
+        # applied a forwarded send whose ack was lost (at-least-once),
+        # so these values may legitimately appear in polls — but never
+        # twice (docstring invariant 1)
+        indeterminate: Dict[str, set] = {k: set() for k in key_names}
+        committed_seen: Dict[Tuple[str, str], int] = {}
+        monotone_ok = True
+        gapless_ok = True
+        exactly_once_ok = True
+
+        def check_gapless(polled: dict, offsets: dict) -> bool:
+            return all(
+                [int(o) for o, _ in lst]
+                == list(range(int(offsets[k]),
+                              int(offsets[k]) + len(lst)))
+                for k, lst in polled.items())
+
+        async def sample_committed(node: str) -> None:
+            nonlocal monotone_ok
+            got = await h.kafka_list_committed(node, key_names)
+            for k, off in got.items():
+                prev = committed_seen.get((node, k))
+                if prev is not None and int(off) < prev:
+                    monotone_ok = False
+                committed_seen[(node, k)] = int(off)
+
+        for i in range(ops):
+            key = rng.choice(key_names)
+            try:
+                r = await h.kafka_send(rng.choice(h.ids), key, i)
+            except asyncio.TimeoutError:
+                # a long partition can outlast the client RPC budget
+                # while the node's forward retries keep going — the
+                # send is indeterminate, never a harness crash
+                indeterminate[key].add(i)
+            else:
+                if r["body"]["type"] == "send_ok":
+                    off = int(r["body"]["offset"])
+                    if off in acked[key]:        # duplicate offset ack
+                        exactly_once_ok = False
+                    acked[key][off] = i
+                else:                            # error reply: the
+                    indeterminate[key].add(i)    # forward may have
+                                                 # landed at the owner
+            try:
+                if i % 3 == 2:                   # in-run gapless probe
+                    node = rng.choice(h.ids)
+                    offsets = {k: 0 for k in key_names}
+                    polled = await h.kafka_poll(node, offsets)
+                    if not check_gapless(polled, offsets):
+                        gapless_ok = False
+                if i % 4 == 3 and acked[key]:    # commit what we saw
+                    await h.kafka_commit(rng.choice(h.ids),
+                                         {key: max(acked[key])})
+                if i % 5 == 4:                   # monotonicity probe
+                    await sample_committed(rng.choice(h.ids))
+            except asyncio.TimeoutError:
+                pass       # probe across the cut: skip, retry later
+            await asyncio.sleep(1.0 / rate)
+
+        want_committed = {k: max((off for (nd, kk), off
+                                  in committed_seen.items() if kk == k),
+                                 default=None) for k in key_names}
+
+        def key_log_ok(k: str, lst) -> bool:
+            """Invariant 1 on one node's full poll of key ``k``: every
+            acked send at exactly its acked offset, every other entry
+            a known indeterminate value, nothing twice."""
+            got = {int(o): m for o, m in lst}
+            msgs = [m for _, m in lst]
+            if len(set(msgs)) != len(msgs):      # a value twice: the
+                return False                     # owner dedup failed
+            if any(got.get(o) != m for o, m in acked[k].items()):
+                return False
+            return all(m in indeterminate[k] for o, m in got.items()
+                       if acked[k].get(o) != m)
+
+        async def check() -> bool:
+            nonlocal gapless_ok
+            try:
+                for nid in h.ids:
+                    polled = await h.kafka_poll(
+                        nid, {k: 0 for k in key_names})
+                    if not check_gapless(polled,
+                                         {k: 0 for k in key_names}):
+                        gapless_ok = False
+                        return False
+                    if not all(key_log_ok(k, polled.get(k, []))
+                               for k in key_names):
+                        return False
+                    await sample_committed(nid)  # monotone across polls
+                    listed = await h.kafka_list_committed(nid, key_names)
+                    for k, want in want_committed.items():
+                        if want is not None \
+                                and int(listed.get(k, -1)) < want:
+                            return False
+            except asyncio.TimeoutError:
+                return False                     # still healing: poll
+            return True                          # again until deadline
+
+        out = await _finish_workload(h, check)
+        out["invariant_ok"] = bool(out["invariant_ok"]
+                                   and exactly_once_ok and monotone_ok
+                                   and gapless_ok)
+        out["monotone_ok"] = monotone_ok
+        out["gapless_ok"] = gapless_ok
+        out["acked"] = {k: len(v) for k, v in acked.items()}
+        out["indeterminate"] = {k: len(v) for k, v
+                                in indeterminate.items()}
+        out["committed"] = {k: v for k, v in want_committed.items()
+                            if v is not None}
         out["partitioned"] = bool(partition_mid)
         return out
     finally:

@@ -417,7 +417,214 @@ class CounterServer:
             self._in_flight.discard(nb)
 
 
-WORKLOADS = ("broadcast", "counter")
+class KafkaServer:
+    """The Gossip Glomers replicated-log ("kafka") workload node: the
+    last challenge-family sibling (the batched twin is
+    gossip_tpu/models/log.py; docs/WORKLOADS.md "Replicated logs").
+
+    Per-key logs with a single OFFSET-ASSIGNER: key ``k`` is owned by
+    a deterministic node (``crc32(key) % n`` over the init-ordered
+    ``node_ids`` — every node computes the same owner with no
+    coordination), and only the owner assigns offsets, so each key's
+    log is gap-free and append-ordered at the source.  Replicas learn
+    entries by interval-ticked full-state gossip with an idempotent
+    union merge — every gossiped map is a union of owner prefixes of
+    the same sequence, so **every replica always holds a contiguous
+    prefix per key** (gapless polls are structural, not checked-for).
+    Committed offsets merge by per-key max (monotone — they can never
+    regress, the second kafka invariant).  Client ops:
+
+      * ``send {key, msg}`` — owner appends and replies ``send_ok
+        {offset}``; a non-owner FORWARDS to the owner with
+        fresh-deadline retries (the BroadcastServer.gossip shape) and
+        acks the client only with the owner's offset — so an acked
+        send is in the log exactly once at its acked offset.  Retried
+        forwards are deduplicated at the owner BY VALUE per key (the
+        workload sends unique values — the CrdtConfig one-add-tag
+        convention, documented); exhausted retries reply a Maelstrom
+        error (code 11).  An errored or client-timed-out send is
+        INDETERMINATE, not absent: the forward may have landed at the
+        owner with its ack lost (at-least-once), so the workload
+        checker admits such values in polls — but still at most once,
+        which the owner's value dedup guarantees.
+      * ``poll {offsets: {key: off}}`` — ``poll_ok {msgs: {key:
+        [[off, msg], ...]}}``: the contiguous local run from ``off``.
+      * ``commit_offsets {offsets}`` — per-key max into the committed
+        map, ack, gossip.
+      * ``list_committed_offsets {keys}`` — the committed map slice.
+    """
+
+    ERR_TEMP_UNAVAILABLE = 11
+
+    def __init__(self, node: MaelstromNode, rpc_timeout: float = 2.0,
+                 gossip_interval: float = 0.05,
+                 backoff_base: float = 0.1, max_retries: int = 64):
+        self.node = node
+        self.rpc_timeout = rpc_timeout
+        self.gossip_interval = gossip_interval
+        self.backoff_base = backoff_base
+        self.max_retries = max_retries
+        self.entries: Dict[str, Dict[int, Any]] = {}  # key -> off -> msg
+        self.by_val: Dict[str, Dict[Any, int]] = {}   # owner dedup
+        self.committed: Dict[str, int] = {}
+        self.topology: Dict[str, List[str]] = {}
+        self.acked: Dict[str, tuple] = {}   # nbr -> last acked snapshot
+        self._in_flight: set = set()
+        self._flusher: Optional[asyncio.Task] = None
+        node.handle("send", self.on_send)
+        node.handle("poll", self.on_poll)
+        node.handle("commit_offsets", self.on_commit_offsets)
+        node.handle("list_committed_offsets",
+                    self.on_list_committed_offsets)
+        node.handle("topology", self.on_topology)
+        node.handle("kafka_gossip", self.on_gossip)
+        node.handle("kafka_gossip_ok", self.on_sink)
+
+    def _owner(self, key: str) -> str:
+        import zlib
+        ids = self.node.node_ids
+        return ids[zlib.crc32(str(key).encode()) % len(ids)]
+
+    def _ensure_flusher(self) -> None:
+        if self._flusher is None:
+            self._flusher = asyncio.ensure_future(self._flush_loop())
+
+    def _append_as_owner(self, key: str, msg) -> int:
+        """Owner-side append: next offset = local log length (the
+        owner's log is gap-free by construction); a retried forward of
+        an already-appended value returns its existing offset."""
+        vals = self.by_val.setdefault(key, {})
+        if msg in vals:
+            return vals[msg]
+        log = self.entries.setdefault(key, {})
+        off = len(log)
+        log[off] = msg
+        vals[msg] = off
+        self._ensure_flusher()
+        return off
+
+    async def on_send(self, msg) -> None:
+        body = msg["body"]
+        key, value = str(body["key"]), body["msg"]
+        if self._owner(key) == self.node.node_id:
+            off = self._append_as_owner(key, value)
+            await self.node.reply(msg, {"type": "send_ok",
+                                        "offset": off})
+            return
+        # forward to the owner with fresh-deadline retries; ack the
+        # client only with the owner's assigned offset
+        for attempt in range(self.max_retries):
+            try:
+                reply = await self.node.rpc(
+                    self._owner(key), {"type": "send", "key": key,
+                                       "msg": value},
+                    timeout=self.rpc_timeout)
+            except asyncio.TimeoutError:
+                pass                           # lost/partitioned: retry
+            else:
+                rb = reply.get("body", {})
+                if rb.get("type") == "send_ok":
+                    await self.node.reply(msg, {
+                        "type": "send_ok", "offset": rb["offset"]})
+                    return
+            if attempt + 1 < self.max_retries:
+                await asyncio.sleep(
+                    self.backoff_base * (2 ** min(attempt, 12)))
+        await self.node.reply(msg, {
+            "type": "error", "code": self.ERR_TEMP_UNAVAILABLE,
+            "text": f"could not reach owner of key {key!r}"})
+
+    async def on_poll(self, msg) -> None:
+        out: Dict[str, list] = {}
+        for key, off in (msg["body"].get("offsets") or {}).items():
+            log = self.entries.get(str(key), {})
+            o, lst = int(off), []
+            while o in log:                  # contiguous run: gapless
+                lst.append([o, log[o]])
+                o += 1
+            out[key] = lst
+        await self.node.reply(msg, {"type": "poll_ok", "msgs": out})
+
+    async def on_commit_offsets(self, msg) -> None:
+        await self.node.reply(msg, {"type": "commit_offsets_ok"})
+        changed = False
+        for key, off in (msg["body"].get("offsets") or {}).items():
+            key = str(key)
+            if int(off) > self.committed.get(key, -1):
+                self.committed[key] = int(off)
+                changed = True
+        if changed:
+            self._ensure_flusher()
+
+    async def on_list_committed_offsets(self, msg) -> None:
+        keys = [str(k) for k in msg["body"].get("keys") or []]
+        await self.node.reply(msg, {
+            "type": "list_committed_offsets_ok",
+            "offsets": {k: self.committed[k] for k in keys
+                        if k in self.committed}})
+
+    async def on_topology(self, msg) -> None:
+        self.topology = {k: list(v)
+                         for k, v in msg["body"]["topology"].items()}
+        await self.node.reply(msg, {"type": "topology_ok"})
+
+    async def on_gossip(self, msg) -> None:
+        body = msg["body"]
+        await self.node.reply(msg, {"type": "kafka_gossip_ok"})
+        changed = False
+        for key, ent in (body.get("entries") or {}).items():
+            log = self.entries.setdefault(str(key), {})
+            for off_s, value in ent.items():
+                off = int(off_s)             # JSON keys arrive as str
+                if off not in log:
+                    log[off] = value
+                    changed = True
+        for key, off in (body.get("committed") or {}).items():
+            if int(off) > self.committed.get(str(key), -1):
+                self.committed[str(key)] = int(off)
+                changed = True
+        if changed:
+            self._ensure_flusher()
+
+    async def on_sink(self, msg) -> None:
+        pass
+
+    def _snapshot(self) -> tuple:
+        return (tuple(sorted((k, tuple(sorted(v.items())))
+                             for k, v in self.entries.items())),
+                tuple(sorted(self.committed.items())))
+
+    async def _flush_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.gossip_interval)
+            try:
+                snap = self._snapshot()
+                for nb in self.topology.get(self.node.node_id, []):
+                    if (self.acked.get(nb) != snap
+                            and nb not in self._in_flight):
+                        self._in_flight.add(nb)
+                        asyncio.ensure_future(self._flush_one(nb, snap))
+            except Exception as e:    # never kill the only flusher
+                print(f"kafka flush loop error (continuing): {e!r}",
+                      file=sys.stderr)
+
+    async def _flush_one(self, nb: str, snap: tuple) -> None:
+        try:
+            reply = await self.node.rpc(
+                nb, {"type": "kafka_gossip",
+                     "entries": {k: {str(o): m for o, m in v.items()}
+                                 for k, v in self.entries.items()},
+                     "committed": dict(self.committed)},
+                timeout=self.rpc_timeout)
+            if reply.get("body", {}).get("type") != "error":
+                self.acked[nb] = snap
+        except asyncio.TimeoutError:
+            pass                      # partitioned/lost: retry next tick
+        finally:
+            self._in_flight.discard(nb)
+
+
+WORKLOADS = ("broadcast", "counter", "kafka")
 
 
 async def amain(gossip_interval: float = 0.0,
@@ -426,6 +633,8 @@ async def amain(gossip_interval: float = 0.0,
     if workload == "counter":
         CounterServer(node,
                       gossip_interval=gossip_interval or 0.05)
+    elif workload == "kafka":
+        KafkaServer(node, gossip_interval=gossip_interval or 0.05)
     else:
         BroadcastServer(node, gossip_interval=gossip_interval)
     await node.run()
@@ -442,9 +651,11 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--workload", default="broadcast",
                     choices=WORKLOADS,
                     help="protocol personality: the reference's "
-                         "broadcast log, or the Gossip Glomers "
-                         "counter (per-node CRDT shards, merge = "
-                         "per-key max)")
+                         "broadcast log, the Gossip Glomers counter "
+                         "(per-node CRDT shards, merge = per-key "
+                         "max), or the replicated kafka-style log "
+                         "(owner-assigned offsets, committed-offset "
+                         "max merge)")
     args = ap.parse_args(argv)
     asyncio.run(amain(args.gossip_interval, args.workload))
 

@@ -132,6 +132,12 @@ def test_backend_packed_routing_matches_bool_path():
     assert sh.msgs == pytest.approx(fast.msgs)
 
 
+# ~8 s (flight data, the log-PR rebalance): the sparse exchange keeps
+# three in-gate smokes — the dry run's two sparse families and the
+# compile-cache sparse driver leg (the PR 3 rationale) — and full
+# mesh-vs-reference parity already runs under -m slow; this
+# backend-routing depth joins it
+@pytest.mark.slow
 def test_backend_sparse_exchange():
     # the O(messages) all_to_all path as a product surface (--exchange)
     r = run_simulation("jax-tpu", ProtocolConfig(mode="pull", fanout=1),

@@ -84,6 +84,14 @@ def test_eight_configs_one_program_all_converge():
     assert rt[6] > rt[2]        # periodic anti-entropy slower than pull
 
 
+# ~11 s (flight data, the log-PR rebalance): composition invariance
+# keeps TWO in-gate anchors — the per-point solo-parity params below
+# (batch row == make_si_round bitwise, the stronger per-trajectory
+# claim) and the serving PR's live RPC coalesce test (replies vs K=1
+# driver dispatches on the request megabatch, the generalization of
+# this sweep); the batch-of-8-vs-batch-of-1 slice depth runs under
+# -m slow
+@pytest.mark.slow
 def test_batch_composition_invariance():
     """A point's trajectory must not depend on what else is in the batch
     (same k_max): batch-of-8 slice == batch-of-1."""

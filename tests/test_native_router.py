@@ -31,6 +31,12 @@ def test_native_router_partition_heals():
     assert stats["partitioned"] is True
 
 
+# ~8 s (flight data, the log-PR rebalance): the native router keeps
+# its in-gate line-topology workload + partition-heal tests above, and
+# the grid TOPOLOGY surface stays pinned by the python-router grid
+# test (tests/test_maelstrom.py); the native-x-grid cross product runs
+# under -m slow
+@pytest.mark.slow
 @needs_gxx
 def test_native_router_grid_topology():
     stats = run_native_workload(6, ops=6, rate=50.0, latency=0.001,

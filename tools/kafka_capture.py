@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Capture the replicated-log convergence record (the log-subsystem
+PR's acceptance artifact).
+
+Runs the sharded replicated-log driver on the 4-device pull fabric
+under ONE mixed nemesis fault program — a crash/recover event, a
+permanent crash, an open partition window, and a drop-rate ramp — and
+gates:
+
+  * ``log_conv == 1.0``: EVERY eventually-alive node's full log row
+    (entry planes + committed-offset vector) equals the acked-appends
+    ground truth (integer-exact full-row equality, divided once on
+    the host);
+  * the partition STALL is visible: while the committed window is
+    open, nobody holds the global truth (log_conv == 0 for those
+    rounds);
+  * 1-device/4-device trajectory parity BITWISE (the fabric's
+    mesh-invariance contract, re-proven on the committed evidence);
+  * the truth summary (per-key acked lengths + committed counts)
+    agrees between the mesh and single-device drivers.
+
+Everything lands in one run ledger (utils/telemetry — provenance first
+line; the drivers flush their ``round_metrics`` events with the
+``log_conv`` column), so the committed artifact passes
+tools/validate_artifacts.py's ``*kafka*`` provenance gate.
+
+    python tools/kafka_capture.py [OUT.jsonl]    # default
+        artifacts/ledger_kafka_r15.jsonl
+
+Runs on the hermetic CPU tier by design (log convergence is integer
+arithmetic, not a chip rate).
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N = 64
+DEVICES = 4
+MAX_ROUNDS = 24
+PARTITION_END = 6
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    out_path = (argv[0] if argv else
+                os.path.join(REPO, "artifacts",
+                             "ledger_kafka_r15.jsonl"))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={DEVICES}"
+        ).strip()
+
+    import numpy as np
+    from gossip_tpu.config import (ChurnConfig, FaultConfig, LogConfig,
+                                   ProtocolConfig, RunConfig)
+    from gossip_tpu.models.log import simulate_curve_log
+    from gossip_tpu.parallel.sharded import make_mesh
+    from gossip_tpu.parallel.sharded_log import (
+        simulate_curve_log_sharded)
+    from gossip_tpu.topology import generators as G
+    from gossip_tpu.utils import telemetry
+
+    proto = ProtocolConfig(mode="pull", fanout=2)
+    topo = G.complete(N)
+    run = RunConfig(seed=0, max_rounds=MAX_ROUNDS, target_coverage=1.0)
+    mesh = make_mesh(DEVICES)
+    # the mixed fault program: crash/recover, permanent crash, open
+    # partition window, drop ramp — every schedule feature at once
+    fault = FaultConfig(drop_prob=0.05, seed=1, churn=ChurnConfig(
+        events=((3, 2, 5), (7, 1, -1)),
+        partitions=((0, PARTITION_END, N // 2),),
+        ramp=(1, 4, 0.0, 0.3)))
+    cfg = LogConfig(keys=4, capacity=8)
+
+    led = telemetry.Ledger(out_path)
+    prev = telemetry.activate(led)
+    ok = True
+    try:
+        led.record_runtime()
+        led.event("kafka_fault_program",
+                  events=[list(e) for e in fault.churn.events],
+                  partitions=[list(w) for w in fault.churn.partitions],
+                  ramp=list(fault.churn.ramp), drop_prob=fault.drop_prob,
+                  n=N, keys=cfg.keys, capacity=cfg.capacity,
+                  max_rounds=MAX_ROUNDS)
+        with led.span("kafka:log", keys=cfg.keys):
+            conv4, msgs4, fin4, truth4 = simulate_curve_log_sharded(
+                cfg, proto, topo, run, mesh, fault)
+            conv1, msgs1, fin1, truth1 = simulate_curve_log(
+                cfg, proto, topo, run, fault)
+        parity = bool(
+            (np.asarray(conv1) == np.asarray(conv4)).all()
+            and (np.asarray(fin1.val)
+                 == np.asarray(fin4.val)[:N]).all()
+            and truth1 == truth4)
+        stalled = bool(all(c < 1.0 for c in conv4[:PARTITION_END]))
+        ok = bool(conv4[-1] == 1.0) and parity and stalled
+        led.event("kafka_scenario",
+                  log_conv_final=float(conv4[-1]),
+                  log_conv_curve=[round(float(c), 6) for c in conv4],
+                  truth=truth4,
+                  msgs=float(msgs4[-1]),
+                  partition_stall_rounds=PARTITION_END,
+                  partition_stalled=stalled,
+                  mesh_parity_bitwise=parity,
+                  devices=DEVICES, ok=ok)
+        led.event("kafka_verdict", ok=ok)
+    finally:
+        telemetry.activate(prev)
+        led.close()
+    print(json.dumps({"out": out_path, "ok": ok}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,6 +101,16 @@ def _is_nemesis_name(name: str) -> bool:
             or "crashloop" in name or "crdt" in name)
 
 
+def _is_log_name(name: str) -> bool:
+    """Replicated-log ("kafka") artifacts by name — log-convergence
+    verdicts and workload invariant records (the ordered
+    eventual-consistency evidence, ops/logs + the KafkaServer
+    workload) must always be attributable; the legacy allowlist can
+    never grandfather one in (the whole log subsystem post-dates the
+    provenance schema)."""
+    return "kafka" in name or "replog" in name
+
+
 def _is_serving_name(name: str) -> bool:
     """Serving/load artifacts by name — throughput and latency gates
     (the admission-batching layer's committed evidence: requests/sec,
@@ -154,6 +164,12 @@ def validate_file(path):
                     "serving/load artifact without a provenance line "
                     "— throughput/latency gates must be attributable, "
                     "allowlist or not (utils/telemetry.provenance)")
+            if not has_prov and _is_log_name(name):
+                problems.append(
+                    "replicated-log/kafka artifact without a "
+                    "provenance line — log-convergence evidence must "
+                    "be attributable, allowlist or not "
+                    "(utils/telemetry.provenance)")
         else:
             with open(path) as f:
                 doc = json.load(f)
@@ -168,6 +184,11 @@ def validate_file(path):
                     "serving/load artifact without provenance keys "
                     f"{PROVENANCE_KEYS} — throughput/latency gates "
                     "must be attributable, allowlist or not")
+            elif _is_log_name(name) and not _has_provenance_keys(doc):
+                problems.append(
+                    "replicated-log/kafka artifact without provenance "
+                    f"keys {PROVENANCE_KEYS} — log-convergence "
+                    "evidence must be attributable, allowlist or not")
             elif name not in LEGACY and not _has_provenance_keys(doc):
                 problems.append(
                     "new-format json without provenance keys "
