@@ -26,7 +26,7 @@ from typing import Any, Dict, List, Optional
 
 from gossip_tpu.config import (FaultConfig, LogConfig, MeshConfig,
                                ProtocolConfig, RunConfig,
-                               TopologyConfig)
+                               TopologyConfig, TxnConfig)
 
 BACKENDS = ("jax-tpu", "go-native")
 
@@ -875,12 +875,67 @@ def run_log_workload(proto: ProtocolConfig, tc: TopologyConfig,
               "workload": "log", "truth": truth})
 
 
+def run_txn_workload(proto: ProtocolConfig, tc: TopologyConfig,
+                     run: RunConfig, txn_cfg: TxnConfig,
+                     fault: Optional[FaultConfig] = None,
+                     want_curve: bool = False) -> RunReport:
+    """The LWW-register transaction workload behind the ``Run`` RPC's
+    ``txn`` field (models/register.py drivers; single-process
+    single-device — the node mesh shards via the library API, the
+    Ensemble RPC rule).  ``coverage`` reports the final txn_conv; meta
+    carries the acked-writes LWW truth summary."""
+    from gossip_tpu.models.register import (check_txn_mode,
+                                            simulate_curve_txn,
+                                            simulate_until_txn)
+    from gossip_tpu.topology import generators as G
+    check_txn_mode(proto)
+    if run.engine not in ("auto", "xla"):
+        raise ValueError(f"engine={run.engine!r} cannot run the txn "
+                         "workload (XLA pull kernels only)")
+    topo = G.build(tc)
+    t0 = time.perf_counter()
+    if want_curve:
+        conv, msgs, _, truth = simulate_curve_txn(txn_cfg, proto, topo,
+                                                  run, fault)
+        hit = [i for i, c in enumerate(conv)
+               if c >= run.target_coverage]
+        rounds = (hit[0] + 1) if hit else -1
+        tcv, msgs_f = float(conv[-1]), float(msgs[-1])
+        curve = [float(c) for c in conv]
+    else:
+        rounds, tcv, msgs_f, _, truth = simulate_until_txn(
+            txn_cfg, proto, topo, run, fault)
+        curve = None
+    wall = time.perf_counter() - t0
+    return RunReport(
+        backend="jax-tpu", mode="txn", n=tc.n, rounds=rounds,
+        coverage=tcv, msgs=msgs_f, wall_s=round(wall, 4), curve=curve,
+        meta={"clock": "rounds", "devices": 1,
+              "msgs_counts": "transmissions", "engine": "txn-xla",
+              "workload": "txn", "truth": truth})
+
+
 def run_simulation(backend: str, proto: ProtocolConfig, tc: TopologyConfig,
                    run: RunConfig, fault: Optional[FaultConfig] = None,
                    mesh_cfg: Optional[MeshConfig] = None,
                    want_curve: bool = False,
-                   log_cfg: Optional[LogConfig] = None) -> RunReport:
+                   log_cfg: Optional[LogConfig] = None,
+                   txn_cfg: Optional[TxnConfig] = None) -> RunReport:
     """The one entry point both the CLI and the sidecar call."""
+    if log_cfg is not None and txn_cfg is not None:
+        raise ValueError("a request carries at most one payload "
+                         "workload; pick 'log' or 'txn'")
+    if txn_cfg is not None:
+        if backend != "jax-tpu":
+            raise ValueError("the txn workload needs the jax-tpu "
+                             "backend")
+        if mesh_cfg is not None:
+            raise ValueError("the txn workload over RPC is "
+                             "single-process single-device; shard the "
+                             "node mesh via the library API "
+                             "(parallel/sharded_register)")
+        return run_txn_workload(proto, tc, run, txn_cfg, fault,
+                                want_curve)
     if log_cfg is not None:
         if backend != "jax-tpu":
             raise ValueError("the log workload needs the jax-tpu "
@@ -908,7 +963,7 @@ def run_simulation(backend: str, proto: ProtocolConfig, tc: TopologyConfig,
 
 _CFG_TYPES = {"proto": ProtocolConfig, "topology": TopologyConfig,
               "run": RunConfig, "fault": FaultConfig,
-              "mesh": MeshConfig, "log": LogConfig}
+              "mesh": MeshConfig, "log": LogConfig, "txn": TxnConfig}
 
 
 def run_ensemble(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
@@ -984,7 +1039,7 @@ def request_to_args(req: Dict[str, Any]) -> Dict[str, Any]:
             cfg = cls(**val)
         out[{"proto": "proto", "topology": "tc", "run": "run",
              "fault": "fault", "mesh": "mesh_cfg",
-             "log": "log_cfg"}[key]] = cfg
+             "log": "log_cfg", "txn": "txn_cfg"}[key]] = cfg
     if out["proto"] is None:
         out["proto"] = ProtocolConfig()
     if out["tc"] is None:

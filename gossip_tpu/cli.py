@@ -1169,6 +1169,91 @@ def cmd_log(a) -> int:
     return 0
 
 
+def _parse_txn_writes(a):
+    """--write NODE:KEY:ROUND:VALUE -> TxnConfig kwargs (field
+    validation lives in TxnConfig itself — the _parse_log_injections
+    discipline)."""
+    def parts(s):
+        p = s.split(":")
+        if len(p) != 4:
+            raise ValueError("--write takes 4 colon-separated fields, "
+                             f"got {s!r}")
+        return tuple(int(x) for x in p)
+
+    return dict(writes=tuple(parts(s) for s in (a.write or ())))
+
+
+def cmd_txn(a) -> int:
+    """LWW-register transaction run: totally-available multi-key
+    writes on the pull exchange fabric, convergence judged
+    integer-exact against the acked-writes LWW ground truth on the
+    eventual-alive set (docs/WORKLOADS.md "Transactions")."""
+    from gossip_tpu.config import TxnConfig
+    from gossip_tpu.topology import generators as G
+    cfg = TxnConfig(keys=a.keys, txns=a.txns, zipf_alpha=a.zipf_alpha,
+                    hot_key=a.hot_key, load=a.load,
+                    spread_rounds=a.spread, **_parse_txn_writes(a))
+    proto = ProtocolConfig(mode="pull", fanout=a.fanout)
+    tc = TopologyConfig(family=a.family, n=a.n, k=a.k, p=a.p,
+                        seed=a.seed)
+    run = RunConfig(target_coverage=a.target, max_rounds=a.max_rounds,
+                    seed=a.seed, origin=a.origin)
+    churn = _parse_churn(a)
+    fault = None
+    if a.drop > 0 or a.death > 0 or churn is not None:
+        fault = FaultConfig(node_death_rate=a.death, drop_prob=a.drop,
+                            seed=a.seed, churn=churn)
+    topo = G.build(tc)
+    want_curve = a.curve or bool(a.save_curve)
+    import time as _time
+    t0 = _time.perf_counter()
+    if a.devices > 1:
+        from gossip_tpu.parallel.sharded import make_mesh
+        from gossip_tpu.parallel.sharded_register import (
+            simulate_curve_txn_sharded, simulate_until_txn_sharded)
+        mesh = make_mesh(a.devices)
+        if want_curve:
+            conv, msgs, final, truth = simulate_curve_txn_sharded(
+                cfg, proto, topo, run, mesh, fault)
+        else:
+            rounds, tcv, msgs_f, final, truth = (
+                simulate_until_txn_sharded(cfg, proto, topo, run,
+                                           mesh, fault))
+        engine = "txn-sharded"
+    else:
+        from gossip_tpu.models.register import (simulate_curve_txn,
+                                                simulate_until_txn)
+        if want_curve:
+            conv, msgs, final, truth = simulate_curve_txn(
+                cfg, proto, topo, run, fault)
+        else:
+            rounds, tcv, msgs_f, final, truth = simulate_until_txn(
+                cfg, proto, topo, run, fault)
+        engine = "txn-xla"
+    wall = _time.perf_counter() - t0
+    if want_curve:
+        hit = [i for i, c in enumerate(conv) if c >= a.target]
+        rounds = (hit[0] + 1) if hit else -1
+        tcv, msgs_f = float(conv[-1]), float(msgs[-1])
+    out = {"backend": "jax-tpu", "mode": "txn", "n": a.n,
+           "keys": a.keys, "rounds": rounds, "txn_conv": tcv,
+           "converged": tcv >= a.target, "truth": truth,
+           "msgs": msgs_f, "wall_s": round(wall, 4),
+           "devices": a.devices, "engine": engine,
+           "zipf_alpha": a.zipf_alpha, "hot_key": a.hot_key,
+           "load": a.load, "compile_cache": _cache_stamp(a)}
+    if churn is not None:
+        out["fault_program"] = True
+    if a.save_curve:
+        from gossip_tpu.utils.metrics import dump_curve_jsonl
+        dump_curve_jsonl(a.save_curve, [float(c) for c in conv],
+                         meta=dict(out))
+    if a.curve:
+        out["curve"] = [float(c) for c in conv]
+    print(json.dumps(out))
+    return 0
+
+
 def cmd_serve(a) -> int:
     from gossip_tpu.config import ServingConfig
     from gossip_tpu.rpc.sidecar import serve
@@ -1222,6 +1307,20 @@ def cmd_maelstrom_check(a) -> int:
         from gossip_tpu.runtime.maelstrom_harness import (
             run_kafka_workload)
         stats = asyncio.run(run_kafka_workload(
+            a.n, a.ops, rate=a.rate, latency=a.latency,
+            topology=a.topology, partition_mid=a.partition, seed=a.seed,
+            argv=argv))
+    elif a.workload == "txn":
+        if a.router == "native":
+            print("error: the txn workload runs on the python "
+                  "router (the C++ router speaks the broadcast "
+                  "envelope set only)", file=sys.stderr)
+            return 2
+        import asyncio
+
+        from gossip_tpu.runtime.maelstrom_harness import (
+            run_txn_workload)
+        stats = asyncio.run(run_txn_workload(
             a.n, a.ops, rate=a.rate, latency=a.latency,
             topology=a.topology, partition_mid=a.partition, seed=a.seed,
             argv=argv))
@@ -1517,6 +1616,75 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_cache_flags(p)
     p.set_defaults(fn=cmd_log)
 
+    p = sub.add_parser("txn",
+                       help="run totally-available transactions over "
+                            "LWW registers (the Maelstrom "
+                            "txn-rw-register shape) on the pull "
+                            "exchange fabric with optional nemesis "
+                            "fault programs; convergence is "
+                            "integer-exact against the acked-writes "
+                            "LWW ground truth on the eventual-alive "
+                            "set")
+    p.add_argument("--n", type=int, default=1024)
+    p.add_argument("--keys", type=int, default=8,
+                   help="register universe K (ops/registers.py)")
+    p.add_argument("--txns", type=int, default=16,
+                   help="default-program write count T (the skewed "
+                        "closed-form traffic generator)")
+    p.add_argument("--zipf-alpha", type=float, default=1.1,
+                   help="key-popularity skew (> 0; 1.0 = classic "
+                        "zipf, larger = more skewed)")
+    p.add_argument("--hot-key", type=float, default=0.0,
+                   help="hot-key storm: probability mass redirected "
+                        "onto key 0 during the middle third of the "
+                        "write program")
+    p.add_argument("--load", default="uniform",
+                   choices=("uniform", "diurnal"),
+                   help="writes-over-rounds shape: uniform, or "
+                        "diurnal (1 + sin density, one peak "
+                        "mid-window)")
+    p.add_argument("--spread", type=int, default=8,
+                   help="rounds the default write program spans")
+    p.add_argument("--fanout", type=int, default=2)
+    p.add_argument("--family", default="complete",
+                   choices=("complete", "ring", "grid", "erdos_renyi",
+                            "watts_strogatz", "power_law"))
+    p.add_argument("--k", type=int, default=4)
+    p.add_argument("--p", type=float, default=0.01)
+    p.add_argument("--target", type=float, default=1.0,
+                   help="txn-convergence target (default 1.0: EVERY "
+                        "eventual-alive node holds the exact LWW "
+                        "winner + timestamp per key — the "
+                        "total-availability convergence invariant)")
+    p.add_argument("--max-rounds", type=int, default=64)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--origin", type=int, default=0)
+    p.add_argument("--devices", type=int, default=1,
+                   help="node-dim mesh size (sharded pull exchange)")
+    p.add_argument("--drop", type=float, default=0.0)
+    p.add_argument("--death", type=float, default=0.0)
+    p.add_argument("--write", action="append", default=None,
+                   metavar="NODE:KEY:ROUND:VALUE",
+                   help="scripted write micro-op (repeatable; values "
+                        ">= 1; at most one write per (key, round, "
+                        "node) — the unique-timestamp contract; "
+                        "overrides the skewed default program)")
+    p.add_argument("--churn-event", action="append", default=None,
+                   metavar="NODE:DIE[:REC]",
+                   help="nemesis crash/recover churn (repeatable)")
+    p.add_argument("--partition", action="append", default=None,
+                   metavar="START:END:CUT",
+                   help="nemesis partition window (repeatable)")
+    p.add_argument("--drop-ramp", default=None,
+                   metavar="START:END:P0:P1",
+                   help="nemesis drop-rate ramp")
+    p.add_argument("--curve", action="store_true",
+                   help="include the per-round txn-convergence curve")
+    p.add_argument("--save-curve", default=None, metavar="PATH",
+                   help="write the txn-convergence curve as JSONL")
+    _add_cache_flags(p)
+    p.set_defaults(fn=cmd_txn)
+
     p = sub.add_parser("serve", help="start the gRPC sidecar")
     p.add_argument("--port", type=int, default=50051)
     p.add_argument("--workers", type=int, default=16)
@@ -1540,12 +1708,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="batch relays per neighbor every INTERVAL "
                         "seconds (0 = immediate per-message fan-out)")
     p.add_argument("--workload", default="broadcast",
-                   choices=("broadcast", "counter", "kafka"),
+                   choices=("broadcast", "counter", "kafka", "txn"),
                    help="node personality: broadcast log (the "
                         "reference), Gossip Glomers counter (CRDT "
-                        "shards, merge = per-key max), or the "
+                        "shards, merge = per-key max), the "
                         "replicated kafka-style log (owner-assigned "
-                        "offsets, committed-offset max merge)")
+                        "offsets, committed-offset max merge), or "
+                        "txn-rw-register (totally-available "
+                        "transactions over LWW registers)")
     p.set_defaults(fn=cmd_maelstrom)
 
     p = sub.add_parser("maelstrom-check",
@@ -1570,14 +1740,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "poll()-loop router (native/router.cpp, built on "
                         "demand)")
     p.add_argument("--workload", default="broadcast",
-                   choices=("broadcast", "counter", "kafka"),
+                   choices=("broadcast", "counter", "kafka", "txn"),
                    help="broadcast (every value in every read), the "
                         "Gossip Glomers counter (every node's final "
                         "read == the sum of acked adds, through a "
-                        "--partition), or kafka (acked sends exactly "
+                        "--partition), kafka (acked sends exactly "
                         "once per key in offset order, monotone "
                         "committed offsets, gapless polls — through "
-                        "a --partition)")
+                        "a --partition), or txn (txn-rw-register: "
+                        "no G0/G1a weak-isolation anomalies + "
+                        "cross-node LWW convergence — through a "
+                        "--partition)")
     p.add_argument("--gossip-interval", type=float, default=0.0,
                    help="run the nodes with interval-batched relays "
                         "(seconds; 0 = the reference's immediate "
@@ -1595,7 +1768,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     a = ap.parse_args(argv)
     try:
         if a.cmd in ("run", "sweep", "grid", "churn-sweep", "crdt",
-                     "log", "serve"):
+                     "log", "txn", "serve"):
             # multi-host pods: one jax.distributed.initialize() per host
             # before any jax API (no-op without the coordinator env vars)
             from gossip_tpu.parallel.multislice import maybe_init_distributed

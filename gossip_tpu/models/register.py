@@ -1,0 +1,271 @@
+"""LWW-register gossip rounds: the exchange fabric with the
+totally-available transaction payload.
+
+The step below is models/log.make_log_round with the register payload
+(ops/registers): the gossip mechanics — peer sampling streams, drop
+coins, partition cuts, churn liveness — are the EXISTING fabric,
+untouched, and the payload merge is the per-key last-writer-wins join
+on packed (round, owner) timestamps (a lattice join, so order,
+duplication, and loss never fork a winner).  Pull only, by design:
+state-based dissemination IS the pull/digest exchange, and the push
+half would need a scatter-argmax collective XLA does not have (the
+models/si_packed, models/crdt, and models/log precedent).
+
+Semantics under a nemesis schedule (docs/WORKLOADS.md
+"Transactions"):
+
+  * a churn-down node neither serves pulls, requests, nor receives —
+    but its registers PERSIST across downtime (the durable-store
+    convention), so a recovered node re-disseminates every winner it
+    ever merged;
+  * a write fires iff its owner is alive at the scripted round and
+    eventually alive (the acked-writes rule — ops/registers module
+    doc), which makes exact convergence to
+    :func:`~gossip_tpu.ops.registers.ground_truth` on the
+    eventual-alive set a guaranteed invariant under any fault
+    program;
+  * txn convergence (``txn_conv``) is judged INTEGER-exact: the
+    drivers move a converged-node COUNT off device and divide by the
+    eventual-alive total once on the host (the bitwise-curve
+    convention).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from gossip_tpu import config as C
+from gossip_tpu.config import (FaultConfig, ProtocolConfig, RunConfig,
+                               TxnConfig)
+from gossip_tpu.models import si as si_mod
+from gossip_tpu.models.state import alive_mask, bind_tables
+from gossip_tpu.ops import registers as RG
+from gossip_tpu.ops.sampling import apply_drop, sample_peers
+from gossip_tpu.topology.generators import Topology
+
+
+class RegState(NamedTuple):
+    """Carried through ``lax.scan`` / ``lax.while_loop`` rounds —
+    ``val`` is the ``int32[N, 2K]`` value-planes + timestamp-planes
+    row (ops/registers layout)."""
+
+    val: jax.Array
+    round: jax.Array
+    base_key: jax.Array
+    msgs: jax.Array
+
+
+def init_reg_state(run: RunConfig, cfg: TxnConfig, n: int) -> RegState:
+    """All-zero state: writes apply IN the round loop at their
+    scripted rounds, indexed by the absolute ``state.round`` clock the
+    nemesis schedule shares."""
+    return RegState(
+        val=jnp.zeros((n, RG.state_width(cfg)), jnp.int32),
+        round=jnp.int32(0),
+        base_key=jax.random.key(run.seed),
+        msgs=jnp.float32(0.0),
+    )
+
+
+def check_writes_reachable(cfg: TxnConfig, run: RunConfig) -> None:
+    """Every scripted write must fire inside the run (the models/crdt
+    rule: an unreachable write makes ground truth unreachable by
+    construction — a loud error, never a quiet converged:false)."""
+    last = cfg.horizon() - 1
+    if last >= run.max_rounds:
+        raise ValueError(
+            f"txn write at round {last} can never fire: the run "
+            f"stops after max_rounds={run.max_rounds} rounds, so "
+            "ground truth would be unreachable by construction — "
+            "raise --max-rounds past the last scripted round")
+
+
+def check_txn_mode(proto: ProtocolConfig) -> None:
+    """Pull only (module doc) — one loud reason, shared by every
+    driver and the CLI."""
+    if proto.mode != C.PULL:
+        raise ValueError(
+            "LWW-register rounds run the pull exchange only "
+            "(state-based merge IS the digest pull; got mode "
+            f"{proto.mode!r} — the push half would need a "
+            "scatter-argmax collective XLA does not have, the "
+            "models/crdt and models/log precedent)")
+
+
+def make_register_round(cfg: TxnConfig, proto: ProtocolConfig,
+                        topo: Topology,
+                        fault: Optional[FaultConfig] = None,
+                        origin: int = 0, tabled: bool = False):
+    """Single-device LWW-register round step; the sharded twin lives
+    in parallel/sharded_register.py and must stay bitwise identical
+    (pinned in tests/test_txn.py).  Returns ``step: RegState ->
+    RegState`` (or ``(state, lost)`` on the churn path);
+    ``tabled=True`` returns ``(step, tables)`` with topology + write
+    (+ schedule) arrays as step ARGUMENTS."""
+    check_txn_mode(proto)
+    n, k = topo.n, proto.fanout
+    drop_prob = 0.0 if fault is None else fault.drop_prob
+    tables = () if topo.implicit else (topo.nbrs, topo.deg)
+    from gossip_tpu.ops import nemesis as NE
+    ch = NE.get(fault)
+    # capability row: the register pull exchange rides the dense
+    # fabric and honors the FULL schedule feature set — events,
+    # partition windows, drop ramps (docs/ROBUSTNESS.md scenario
+    # catalog)
+    NE.check_supported(fault, engine="txn-pull")
+    tables = tables + RG.inject_args(cfg, n)
+    if ch is not None:
+        tables = tables + NE.sched_args(NE.build(fault, n))
+    zero = jnp.zeros((), jnp.int32)
+
+    def step_tabled(state: RegState, *tbl):
+        tbl, sched = NE.split_tables(ch, tbl)
+        tbl, inj = RG.split_inject(cfg, tbl)
+        nbrs_t, deg_t = tbl if tbl else (None, None)
+        ids = jnp.arange(n, dtype=jnp.int32)
+        rkey = jax.random.fold_in(state.base_key, state.round)
+        if ch is not None:
+            alive = NE.alive_rows(sched, NE.base_alive_or_ones(
+                fault, n, origin), state.round)
+            dp = NE.drop_at(sched, state.round)
+            cut = NE.cut_at(sched, state.round)
+        else:
+            alive = alive_mask(fault, n, origin)  # None on the hot path
+            dp, cut = drop_prob, None
+        lost = jnp.float32(0.0)
+        # local writes land BEFORE the exchange (a write gossips in its
+        # own round); the apply mask is the shared liveness predicate,
+        # so trajectory and ground truth cannot drift.  The injection
+        # merges via the SAME LWW join as the exchange — an own write
+        # always wins locally (its timestamp exceeds anything merged in
+        # earlier rounds) and same-round peers resolve by owner order.
+        inj_rows = RG.inject_rows(cfg, inj, ids, state.round, n,
+                                  origin, fault)
+        val = RG.merge_lww(state.val, inj_rows)
+        visible = val if alive is None else jnp.where(
+            alive[:, None], val, zero)
+        qkey = jax.random.fold_in(rkey, si_mod.PULL_TAG)
+        partners0 = sample_peers(qkey, ids, topo, k, proto.exclude_self,
+                                 local_nbrs=nbrs_t, local_deg=deg_t)
+        partners = apply_drop(rkey, si_mod.PULL_DROP_TAG, ids,
+                              partners0, dp, n, force=ch is not None)
+        if ch is not None:
+            partners = NE.partition_targets(cut, ids, partners, n)
+        pulled = RG.pull_merge_reg(visible, partners, n)
+        if alive is not None:
+            partners = jnp.where(alive[:, None], partners, n)
+        n_req = jnp.sum(partners < n).astype(jnp.float32)
+        if ch is not None:
+            req_active = (jnp.ones((n,), jnp.bool_) if alive is None
+                          else alive)
+            lost = lost + NE.lost_count(partners0, partners,
+                                        req_active, n)
+        if alive is not None:
+            pulled = jnp.where(alive[:, None], pulled, zero)
+        out = RegState(val=RG.merge_lww(val, pulled),
+                       round=state.round + 1,
+                       base_key=state.base_key,
+                       msgs=state.msgs + 2.0 * n_req)
+        return (out, lost) if ch is not None else out
+
+    return bind_tables(step_tabled, tables, tabled)
+
+
+def _conv_target_count(run: RunConfig, eventual_total: int) -> int:
+    """Integer while_loop target (the models/crdt rule: no f32
+    division near control flow)."""
+    import math
+    return min(eventual_total,
+               math.ceil(run.target_coverage * eventual_total - 1e-9))
+
+
+def simulate_curve_txn(cfg: TxnConfig, proto: ProtocolConfig,
+                       topo: Topology, run: RunConfig,
+                       fault: Optional[FaultConfig] = None,
+                       timing=None):
+    """``lax.scan`` over rounds recording the per-round CONVERGED-NODE
+    COUNT (int32) and msgs; returns ``(txn_conv f64[T], msgs f32[T],
+    final_state, truth_summary)`` with txn_conv divided once on the
+    host.  ``truth_summary``: per-key winning values + unpacked
+    (round, owner) timestamps (ops/registers.truth_summary)."""
+    import numpy as np
+
+    from gossip_tpu.ops import nemesis as NE
+    from gossip_tpu.utils.trace import maybe_aot_timed
+    check_writes_reachable(cfg, run)
+    step, tables = make_register_round(cfg, proto, topo, fault,
+                                       run.origin, tabled=True)
+    ch = NE.get(fault)
+    n = topo.n
+    init = init_reg_state(run, cfg, n)
+
+    @jax.jit
+    def scan(state, *tbl):
+        _, inj0 = RG.split_inject(cfg, NE.split_tables(ch, tbl)[0])
+        truth = RG.ground_truth(cfg, inj0, fault, n, run.origin)
+        eventual = RG.eventual_alive_crdt(fault, n, run.origin)
+
+        def body(s, _):
+            out = step(s, *tbl)
+            s1 = out[0] if ch is not None else out
+            return s1, (RG.converged_count(s1.val, truth, eventual),
+                        s1.msgs)
+
+        final, (convs, msgs) = jax.lax.scan(body, state, None,
+                                            length=run.max_rounds)
+        return final, convs, msgs, truth
+
+    final, convs, msgs, truth = maybe_aot_timed(scan, timing, init,
+                                                *tables)
+    eventual = np.asarray(RG.eventual_alive_crdt(fault, n, run.origin))
+    denom = max(1, int(eventual.sum()))
+    conv = np.asarray(convs, np.int64) / denom
+    return conv, np.asarray(msgs), final, RG.truth_summary(cfg, truth,
+                                                           n)
+
+
+def simulate_until_txn(cfg: TxnConfig, proto: ProtocolConfig,
+                       topo: Topology, run: RunConfig,
+                       fault: Optional[FaultConfig] = None,
+                       timing=None):
+    """``lax.while_loop`` until the converged-node count reaches the
+    integer target; returns ``(rounds, txn_conv, msgs, final_state,
+    truth_summary)``."""
+    import numpy as np
+
+    from gossip_tpu.ops import nemesis as NE
+    from gossip_tpu.utils.trace import maybe_aot_timed
+    check_writes_reachable(cfg, run)
+    step, tables = make_register_round(cfg, proto, topo, fault,
+                                       run.origin, tabled=True)
+    step = NE.drop_lost(step, NE.get(fault))
+    ch = NE.get(fault)
+    n = topo.n
+    init = init_reg_state(run, cfg, n)
+    eventual_np = np.asarray(RG.eventual_alive_crdt(fault, n,
+                                                    run.origin))
+    denom = max(1, int(eventual_np.sum()))
+    target = _conv_target_count(run, denom)
+
+    @jax.jit
+    def loop(state, *tbl):
+        _, inj0 = RG.split_inject(cfg, NE.split_tables(ch, tbl)[0])
+        truth = RG.ground_truth(cfg, inj0, fault, n, run.origin)
+        eventual = RG.eventual_alive_crdt(fault, n, run.origin)
+
+        def cond(s):
+            return ((RG.converged_count(s.val, truth, eventual)
+                     < target) & (s.round < run.max_rounds))
+
+        return jax.lax.while_loop(cond, lambda s: step(s, *tbl),
+                                  state), truth
+
+    final, truth = maybe_aot_timed(loop, timing, init, *tables)
+    conv = int(RG.converged_count(
+        final.val, truth,
+        RG.eventual_alive_crdt(fault, n, run.origin))) / denom
+    return (int(final.round), conv, float(final.msgs), final,
+            RG.truth_summary(cfg, truth, n))

@@ -85,6 +85,16 @@ ops/logs):
               ORDERED eventual-consistency metric (same in-loop-f32 /
               integer-readout split as ``value_conv``).
 
+LWW-register observables (present when the stack is built with
+``txn=True`` — drivers running the totally-available transaction
+payload, ops/registers):
+
+``txn_conv``  fraction of eventual-alive nodes whose full register
+              row (value + timestamp planes) equals the acked-writes
+              LWW ground truth after the round — the ISOLATION-layer
+              convergence metric (same in-loop-f32 / integer-readout
+              split as ``value_conv``).
+
 ``GOSSIP_ROUND_METRICS=0`` (or empty) is the kill switch; metrics are
 also skipped when no run ledger is active (:func:`wanted`) — the
 buffers exist to be ledgered, and dark buffers would tax every test
@@ -133,12 +143,14 @@ class RoundMetrics:
 
     __slots__ = ("cursor", "newly", "dup", "msgs", "bytes", "front",
                  "alive", "cut_pairs", "dropped", "value_conv",
-                 "log_conv", "label", "nemesis", "crdt", "log")
+                 "log_conv", "txn_conv", "label", "nemesis", "crdt",
+                 "log", "txn")
 
     def __init__(self, cursor, newly, dup, msgs, bytes, front,
                  alive, cut_pairs, dropped, value_conv, log_conv,
-                 label: str, nemesis: bool = False, crdt: bool = False,
-                 log: bool = False):
+                 txn_conv, label: str, nemesis: bool = False,
+                 crdt: bool = False, log: bool = False,
+                 txn: bool = False):
         self.cursor = cursor
         self.newly = newly
         self.dup = dup
@@ -150,10 +162,12 @@ class RoundMetrics:
         self.dropped = dropped
         self.value_conv = value_conv
         self.log_conv = log_conv
+        self.txn_conv = txn_conv
         self.label = label
         self.nemesis = nemesis
         self.crdt = crdt
         self.log = log
+        self.txn = txn
 
     def _replace(self, **kw):
         fields = {k: getattr(self, k) for k in self.__slots__}
@@ -164,14 +178,14 @@ class RoundMetrics:
 def _rm_flatten(m):
     return ((m.cursor, m.newly, m.dup, m.msgs, m.bytes, m.front,
              m.alive, m.cut_pairs, m.dropped, m.value_conv,
-             m.log_conv),
-            (m.label, m.nemesis, m.crdt, m.log))
+             m.log_conv, m.txn_conv),
+            (m.label, m.nemesis, m.crdt, m.log, m.txn))
 
 
 def _rm_unflatten(aux, children):
-    label, nemesis, crdt, log = aux
+    label, nemesis, crdt, log, txn = aux
     return RoundMetrics(*children, label=label, nemesis=nemesis,
-                        crdt=crdt, log=log)
+                        crdt=crdt, log=log, txn=txn)
 
 
 jax.tree_util.register_pytree_node(RoundMetrics, _rm_flatten,
@@ -180,14 +194,15 @@ jax.tree_util.register_pytree_node(RoundMetrics, _rm_flatten,
 
 def init(max_rounds: int, n_shards: int, label: str,
          nemesis: bool = False, crdt: bool = False,
-         log: bool = False) -> RoundMetrics:
+         log: bool = False, txn: bool = False) -> RoundMetrics:
     """Zeroed buffer stack for up to ``max_rounds`` rounds over
     ``n_shards`` shards (1 for single-device drivers).  Tiny: 9 T + T*S
     floats — at the flagship's T=128, S=8 that is 4 KB.  ``nemesis``
     marks a stack that carries the churn observables (alive/cut_pairs/
     dropped are recorded and ledgered; zeros otherwise); ``crdt`` marks
     one carrying the value-convergence column, ``log`` one carrying the
-    replicated-log convergence column (module doc)."""
+    replicated-log convergence column, ``txn`` one carrying the
+    LWW-register convergence column (module doc)."""
     if max_rounds < 1:
         raise ValueError(f"max_rounds={max_rounds} must be >= 1")
     if n_shards < 1:
@@ -198,22 +213,22 @@ def init(max_rounds: int, n_shards: int, label: str,
                         front=jnp.zeros((max_rounds, n_shards),
                                         jnp.float32),
                         alive=z, cut_pairs=z, dropped=z, value_conv=z,
-                        log_conv=z, label=label, nemesis=nemesis,
-                        crdt=crdt, log=log)
+                        log_conv=z, txn_conv=z, label=label,
+                        nemesis=nemesis, crdt=crdt, log=log, txn=txn)
 
 
 def record(m: RoundMetrics, *, newly, dup, msgs, bytes,
            front, alive=None, cut_pairs=None,
            dropped=None, value_conv=None,
-           log_conv=None) -> RoundMetrics:
+           log_conv=None, txn_conv=None) -> RoundMetrics:
     """Write one round's row at the cursor (in-trace; scatter writes
     only).  The cursor is clamped to the last row so an over-long loop
     can never write out of bounds — by contract the drivers size the
     buffers with ``run.max_rounds``, which also bounds their loops.
     The nemesis columns (alive/cut_pairs/dropped), the CRDT
-    ``value_conv`` column, and the replicated-log ``log_conv`` column
-    are only written when passed — the static-fault / non-payload
-    recorders never touch them."""
+    ``value_conv`` column, the replicated-log ``log_conv`` column, and
+    the LWW-register ``txn_conv`` column are only written when passed
+    — the static-fault / non-payload recorders never touch them."""
     i = jnp.minimum(m.cursor, m.newly.shape[0] - 1)
     f32 = lambda v: jnp.asarray(v, jnp.float32)       # noqa: E731
     kw = {}
@@ -227,6 +242,8 @@ def record(m: RoundMetrics, *, newly, dup, msgs, bytes,
         kw["value_conv"] = m.value_conv.at[i].set(f32(value_conv))
     if log_conv is not None:
         kw["log_conv"] = m.log_conv.at[i].set(f32(log_conv))
+    if txn_conv is not None:
+        kw["txn_conv"] = m.txn_conv.at[i].set(f32(txn_conv))
     return m._replace(
         cursor=m.cursor + 1,
         newly=m.newly.at[i].set(f32(newly)),
@@ -353,10 +370,10 @@ def emit(out, ledger, fn=None):
     import numpy as np
     for m in stacks:
         (cursor, newly, dup, msgs, bytes_, front, alive, cut_pairs,
-         dropped, value_conv, log_conv) = jax.device_get(
+         dropped, value_conv, log_conv, txn_conv) = jax.device_get(
             (m.cursor, m.newly, m.dup, m.msgs, m.bytes, m.front,
              m.alive, m.cut_pairs, m.dropped, m.value_conv,
-             m.log_conv))
+             m.log_conv, m.txn_conv))
         r = min(int(cursor), int(newly.shape[0]))
 
         def ser(a, nd=3):
@@ -377,6 +394,10 @@ def emit(out, ledger, fn=None):
             # replicated-log convergence per round (the ORDERED
             # eventual-consistency headline — ops/logs)
             extra["log_conv"] = ser(log_conv, nd=4)
+        if m.txn:
+            # LWW-register convergence per round (the isolation-layer
+            # headline — ops/registers)
+            extra["txn_conv"] = ser(txn_conv, nd=4)
         totals = {"newly": round(float(np.sum(newly[:r])), 3),
                   "dup": round(float(np.sum(dup[:r])), 3),
                   "msgs": round(float(np.sum(msgs[:r])), 3),
@@ -389,6 +410,9 @@ def emit(out, ledger, fn=None):
         if m.log:
             totals["log_conv_final"] = (
                 round(float(log_conv[r - 1]), 4) if r else 0.0)
+        if m.txn:
+            totals["txn_conv_final"] = (
+                round(float(txn_conv[r - 1]), 4) if r else 0.0)
         ledger.event(
             "round_metrics", sync=False, driver=m.label, fn=fn,
             rounds=r, shards=int(front.shape[1]),

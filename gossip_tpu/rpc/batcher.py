@@ -138,6 +138,10 @@ def classify_run(args):
         # the SI request sweep can host; it dispatches solo, loudly
         # labeled (the PR 9 fall-through contract)
         return None, "log workload dispatches solo", None
+    if args.get("txn_cfg") is not None:
+        # the LWW-register transaction workload carries its own payload
+        # state + write operands (ops/registers) — same solo rule
+        return None, "txn workload dispatches solo", None
     if args["mesh_cfg"] is not None:
         return None, "mesh requests dispatch solo", None
     run, proto, tc = args["run"], args["proto"], args["tc"]

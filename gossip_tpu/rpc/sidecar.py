@@ -155,6 +155,10 @@ def _ensemble(request: bytes, context, batcher=None) -> bytes:
             raise ValueError("the Ensemble RPC does not run the log "
                              "workload; use Run (one log program per "
                              "call)")
+        if args.get("txn_cfg") is not None:
+            raise ValueError("the Ensemble RPC does not run the txn "
+                             "workload; use Run (one write program "
+                             "per call)")
         if args["mesh_cfg"] is not None:
             raise ValueError("the Ensemble RPC is single-process "
                              "single-device; shard seed axes via the "
@@ -182,8 +186,12 @@ def _ensemble(request: bytes, context, batcher=None) -> bytes:
         if pending is not None:
             return _await_batched(pending, context)
     try:
+        # the payload-workload keys are always present in the parsed
+        # args (request_to_args emits them as None when absent) and
+        # were rejected above when set — run_ensemble takes neither
         run_args = {k: v for k, v in args.items()
-                    if k not in ("backend", "mesh_cfg", "want_curve")}
+                    if k not in ("backend", "mesh_cfg", "want_curve",
+                                 "log_cfg", "txn_cfg")}
         ens, extra = run_ensemble(seeds=seeds, count=count, **run_args)
         out = {"ensemble": ens.summary(), "mode": args["proto"].mode,
                "n": args["tc"].n, **extra}

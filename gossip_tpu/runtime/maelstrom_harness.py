@@ -252,6 +252,13 @@ class MaelstromHarness:
         assert r["body"]["type"] == "list_committed_offsets_ok"
         return r["body"]["offsets"]
 
+    async def txn(self, node: str, ops: list) -> dict:
+        """txn-rw-register workload ``txn`` op (op-counted like every
+        write-bearing op); the caller inspects the reply — ``txn_ok``
+        commits, an error reply is a definite abort (the TxnServer
+        validates before applying anything)."""
+        return await self._timed_op(node, {"type": "txn", "txn": ops})
+
     async def send_raw(self, dest: str, body: dict, timeout: float = 15.0
                        ) -> dict:
         """Arbitrary client RPC (conformance probes, e.g. unknown types)."""
@@ -602,6 +609,137 @@ async def run_kafka_workload(n: int, ops: int, rate: float = 50.0,
                                 in indeterminate.items()}
         out["committed"] = {k: v for k, v in want_committed.items()
                             if v is not None}
+        out["partitioned"] = bool(partition_mid)
+        return out
+    finally:
+        await h.stop()
+
+
+async def run_txn_workload(n: int, ops: int, rate: float = 50.0,
+                           latency: float = 0.002,
+                           topology: str = "line",
+                           partition_mid: bool = False,
+                           seed: int = 0, keys: int = 4,
+                           argv: Optional[List[str]] = None) -> dict:
+    """The Maelstrom ``txn-rw-register`` workload: spawn ``n`` txn
+    nodes (runtime/maelstrom_node.TxnServer — LWW registers, Lamport-
+    pair timestamps), run ``ops`` random multi-key read/write
+    transactions at ``rate`` ops/s against random nodes (1-3 micro-ops
+    each, UNIQUE write values — the attribution contract), optionally
+    cut a mid-cluster link mid-run, then hand the trace to the
+    weak-isolation checker (runtime/txn_checker.check_txn_trace):
+
+      * **G0 (dirty write)** — no cycle in the per-key LWW version
+        orders across transactions;
+      * **G1a (aborted read)** — no committed read observes an
+        aborted transaction's write (error replies are definite
+        aborts: the TxnServer validates before applying);
+      * **convergence** — after heal, every node's final read-all
+        transaction returns the SAME state, and each key's final
+        value is its max-timestamp write's (total availability is
+        only meaningful if the replicas agree eventually).
+
+    A transaction whose client RPC times out across the partition is
+    INDETERMINATE (the Maelstrom info-timeout convention): its writes
+    may appear — they are never G1a evidence — and the harness never
+    crashes on it.  Returns the stats dict (+ ``invariant_ok``,
+    ``anomalies`` with the checker verdict, ``partitioned``)."""
+    import random
+    rng = random.Random(seed)
+    if argv is None:
+        argv = [sys.executable, "-u", "-m",
+                "gossip_tpu.runtime.maelstrom_node",
+                "--workload", "txn"]
+    h = await _start_workload(n, ops, rate, latency, topology,
+                              partition_mid, argv)
+    try:
+        key_names = [str(k) for k in range(keys)]
+        trace: List[dict] = []
+        next_value = [1]          # unique write values, monotone
+
+        def gen_ops():
+            out = []
+            for _ in range(rng.randint(1, 3)):
+                k = rng.choice(key_names)
+                if rng.random() < 0.5:
+                    out.append(["r", k, None])
+                else:
+                    out.append(["w", k, next_value[0]])
+                    next_value[0] += 1
+            return out
+
+        for i in range(ops):
+            requested = gen_ops()
+            rec = {"id": i, "node": rng.choice(h.ids),
+                   "reads": [], "writes": []}
+            try:
+                r = await h.txn(rec["node"], requested)
+            except asyncio.TimeoutError:
+                # a long partition can outlast the client RPC budget
+                # while the node would still answer after heal — the
+                # txn is indeterminate, never a harness crash; its
+                # writes (values are in `requested`) may appear later
+                rec["status"] = "indeterminate"
+                rec["writes"] = [{"key": k, "value": v,
+                                  "ts": None}
+                                 for f, k, v in requested if f == "w"]
+            else:
+                body = r["body"]
+                if body.get("type") == "txn_ok":
+                    rec["status"] = "committed"
+                    ts = body.get("ts")
+                    for f, k, v in body.get("txn", []):
+                        if f == "r":
+                            rec["reads"].append([k, v])
+                        else:
+                            rec["writes"].append(
+                                {"key": k, "value": v, "ts": ts})
+                else:
+                    # definite abort: the node validated and refused
+                    # BEFORE applying anything (TxnServer contract)
+                    rec["status"] = "aborted"
+                    rec["writes"] = [{"key": k, "value": v,
+                                      "ts": None}
+                                     for f, k, v in requested
+                                     if f == "w"]
+            trace.append(rec)
+            await asyncio.sleep(1.0 / rate)
+
+        final_reads: Dict[str, dict] = {}
+        read_all = [["r", k, None] for k in key_names]
+
+        async def check() -> bool:
+            try:
+                for nid in h.ids:
+                    r = await h.txn(nid, list(read_all))
+                    if r["body"].get("type") != "txn_ok":
+                        return False
+                    final_reads[nid] = {k: v for _, k, v
+                                        in r["body"]["txn"]}
+            except asyncio.TimeoutError:
+                return False                 # still healing: poll
+            states = list(final_reads.values())
+            return (len(states) == n
+                    and all(s == states[0] for s in states[1:]))
+
+        out = await _finish_workload(h, check)
+        # the RAW trace goes to the checker, aborted writes included:
+        # G1a detection is only real if an aborted transaction's
+        # writes stay attributable (the checker itself skips ts-less
+        # writes where no version order exists — review finding)
+        from gossip_tpu.runtime.txn_checker import check_txn_trace
+        verdict = check_txn_trace(trace, final_reads=final_reads)
+        out["invariant_ok"] = bool(out["invariant_ok"]
+                                   and verdict["ok"])
+        out["anomalies"] = {"g0": len(verdict["g0"]),
+                            "g1a": len(verdict["g1a"]),
+                            "defects": len(verdict["defects"])}
+        out["g0_ok"] = not verdict["g0"]
+        out["g1a_ok"] = not verdict["g1a"]
+        out["converged"] = verdict.get("converged", False)
+        out["committed"] = verdict["committed"]
+        out["aborted"] = verdict["aborted"]
+        out["indeterminate"] = verdict["indeterminate"]
         out["partitioned"] = bool(partition_mid)
         return out
     finally:

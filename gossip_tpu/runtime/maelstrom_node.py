@@ -624,7 +624,171 @@ class KafkaServer:
             self._in_flight.discard(nb)
 
 
-WORKLOADS = ("broadcast", "counter", "kafka")
+class TxnServer:
+    """The Maelstrom ``txn-rw-register`` workload node: totally-
+    available multi-key read/write transactions over gossip-replicated
+    last-writer-wins registers (the batched twin is
+    gossip_tpu/models/register.py; docs/WORKLOADS.md "Transactions").
+
+    State: ``store[key] = (value, ts)`` with ``ts = (counter,
+    node_index)`` — a Lamport pair, totally ordered, merged per key by
+    LWW.  The counter is a Lamport clock: bumped past every counter
+    seen in gossip, so a transaction's timestamp always exceeds every
+    write it could have read — the commit discipline that makes the
+    healthy system read-uncommitted-clean (every write of a txn shares
+    ONE timestamp, so cross-key version orders collapse onto the total
+    timestamp order and G0 cycles are impossible; the checker verifies
+    rather than trusts this, runtime/txn_checker.py).
+
+    Client op: ``txn {txn: [["r", k, null], ["w", k, v], ...]}`` —
+    the micro-op list is VALIDATED first (an error reply is therefore
+    a definite abort: nothing was applied — the G1a contract), then
+    applied atomically on the single event loop: one timestamp for the
+    whole transaction, reads see the local LWW state *as of this
+    transaction* (its own earlier writes included), writes install
+    ``(value, ts)``.  The reply is ``txn_ok {txn: [...completed...],
+    ts: [counter, node_index]}`` — the timestamp rides the reply so
+    the external checker can reconstruct per-key version orders from
+    the trace alone.  Total availability: a partitioned node still
+    answers from local state; convergence resumes with gossip.
+
+    Dissemination is the CounterServer shape: interval-ticked
+    full-state gossip with per-neighbor acked-snapshot dirtiness —
+    at-least-once with idempotent LWW merge, so a healed partition
+    converges with no special casing."""
+
+    ERR_MALFORMED = 13            # Maelstrom "malformed-request"
+
+    def __init__(self, node: MaelstromNode, rpc_timeout: float = 2.0,
+                 gossip_interval: float = 0.05):
+        self.node = node
+        self.rpc_timeout = rpc_timeout
+        self.gossip_interval = gossip_interval
+        self.store: Dict[str, list] = {}   # key -> [value, [c, idx]]
+        self.counter = 0                   # Lamport clock
+        self.topology: Dict[str, List[str]] = {}
+        self.acked: Dict[str, tuple] = {}  # nbr -> last acked snapshot
+        self._in_flight: set = set()
+        self._flusher: Optional[asyncio.Task] = None
+        node.handle("txn", self.on_txn)
+        node.handle("topology", self.on_topology)
+        node.handle("txn_gossip", self.on_gossip)
+        node.handle("txn_gossip_ok", self.on_sink)
+
+    def _my_index(self) -> int:
+        return self.node.node_ids.index(self.node.node_id)
+
+    def _ensure_flusher(self) -> None:
+        if self._flusher is None:
+            self._flusher = asyncio.ensure_future(self._flush_loop())
+
+    async def on_txn(self, msg) -> None:
+        body = msg["body"]
+        ops = body.get("txn")
+        # validate the WHOLE micro-op list before touching state: an
+        # error reply must be a definite abort (nothing applied), or
+        # G1a stops being checkable (runtime/txn_checker.py)
+        if not isinstance(ops, list) or not all(
+                isinstance(op, list) and len(op) == 3
+                and op[0] in ("r", "w")
+                and (op[0] == "r" or op[2] is not None)
+                for op in ops):
+            await self.node.reply(msg, {
+                "type": "error", "code": self.ERR_MALFORMED,
+                "text": "txn must be a list of [\"r\"|\"w\", key, "
+                        "value] micro-ops (write values non-null)"})
+            return
+        # one Lamport timestamp for the whole transaction — every
+        # write shares it, which is what collapses cross-key version
+        # orders onto one total order (class doc)
+        self.counter += 1
+        ts = [self.counter, self._my_index()]
+        done = []
+        wrote = False
+        for f, k, v in ops:
+            k = str(k)
+            if f == "r":
+                cur = self.store.get(k)
+                done.append(["r", k, cur[0] if cur else None])
+            else:
+                cur = self.store.get(k)
+                # >= not >: an equal timestamp can only be this
+                # transaction's OWN earlier write (the counter bumps
+                # per txn and the owner is us), and program order says
+                # the later micro-op wins — a strict compare would
+                # silently drop a txn's second write to the same key
+                # while still acking it (review finding)
+                if cur is None or ts >= cur[1]:
+                    self.store[k] = [v, list(ts)]
+                wrote = True
+                done.append(["w", k, v])
+        await self.node.reply(msg, {"type": "txn_ok", "txn": done,
+                                    "ts": ts})
+        if wrote:
+            self._ensure_flusher()
+
+    async def on_topology(self, msg) -> None:
+        self.topology = {k: list(v)
+                         for k, v in msg["body"]["topology"].items()}
+        await self.node.reply(msg, {"type": "topology_ok"})
+
+    async def on_gossip(self, msg) -> None:
+        body = msg["body"]
+        await self.node.reply(msg, {"type": "txn_gossip_ok"})
+        changed = False
+        for k, (v, ts) in (body.get("store") or {}).items():
+            ts = [int(ts[0]), int(ts[1])]
+            cur = self.store.get(str(k))
+            if cur is None or ts > cur[1]:
+                self.store[str(k)] = [v, ts]
+                changed = True
+        # Lamport merge: local events after this gossip must order
+        # after everything the peer had seen
+        peer_c = int(body.get("counter", 0))
+        if peer_c > self.counter:
+            self.counter = peer_c
+            changed = True
+        if changed:
+            self._ensure_flusher()
+
+    async def on_sink(self, msg) -> None:
+        pass
+
+    def _snapshot(self) -> tuple:
+        return tuple(sorted((k, v[0], tuple(v[1]))
+                            for k, v in self.store.items()))
+
+    async def _flush_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.gossip_interval)
+            try:
+                snap = self._snapshot()
+                for nb in self.topology.get(self.node.node_id, []):
+                    if (self.acked.get(nb) != snap
+                            and nb not in self._in_flight):
+                        self._in_flight.add(nb)
+                        asyncio.ensure_future(self._flush_one(nb, snap))
+            except Exception as e:    # never kill the only flusher
+                print(f"txn flush loop error (continuing): {e!r}",
+                      file=sys.stderr)
+
+    async def _flush_one(self, nb: str, snap: tuple) -> None:
+        try:
+            reply = await self.node.rpc(
+                nb, {"type": "txn_gossip",
+                     "store": {k: [v[0], list(v[1])]
+                               for k, v in self.store.items()},
+                     "counter": self.counter},
+                timeout=self.rpc_timeout)
+            if reply.get("body", {}).get("type") != "error":
+                self.acked[nb] = snap
+        except asyncio.TimeoutError:
+            pass                      # partitioned/lost: retry next tick
+        finally:
+            self._in_flight.discard(nb)
+
+
+WORKLOADS = ("broadcast", "counter", "kafka", "txn")
 
 
 async def amain(gossip_interval: float = 0.0,
@@ -635,6 +799,8 @@ async def amain(gossip_interval: float = 0.0,
                       gossip_interval=gossip_interval or 0.05)
     elif workload == "kafka":
         KafkaServer(node, gossip_interval=gossip_interval or 0.05)
+    elif workload == "txn":
+        TxnServer(node, gossip_interval=gossip_interval or 0.05)
     else:
         BroadcastServer(node, gossip_interval=gossip_interval)
     await node.run()
@@ -653,9 +819,11 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="protocol personality: the reference's "
                          "broadcast log, the Gossip Glomers counter "
                          "(per-node CRDT shards, merge = per-key "
-                         "max), or the replicated kafka-style log "
+                         "max), the replicated kafka-style log "
                          "(owner-assigned offsets, committed-offset "
-                         "max merge)")
+                         "max merge), or txn-rw-register (totally-"
+                         "available transactions over LWW "
+                         "registers, Lamport-pair timestamps)")
     args = ap.parse_args(argv)
     asyncio.run(amain(args.gossip_interval, args.workload))
 

@@ -243,6 +243,12 @@ print(json.dumps({{"cache": timing["compile_cache"],
 """
 
 
+# ~11 s (txn-PR rebalance): the cross-process reuse claim is proven
+# in-gate every session by the dryrun_pair fixture (cold process
+# populates, warm process must be ALL-HIT — asserted on the compile
+# verdicts in tests/test_graft_entry.py); this store-level twin
+# re-proves under -m slow
+@pytest.mark.slow
 def test_cross_process_populate_then_hit(tmp_path):
     """Process A populates the AOT store; process B — a fresh
     interpreter, same program — must HIT it and reproduce A's
@@ -298,6 +304,11 @@ def test_pod_sweep_cache_stats_eviction_predicate():
     assert not ev
 
 
+# ~7 s (txn-PR rebalance): the eviction-warning predicate stays
+# unit-tested above and the 2-D sweep surface stays in-gate via the
+# hybrid_2d_sweep dry-run family; the live gauge emission re-proves
+# under -m slow
+@pytest.mark.slow
 def test_pod_sweep_emits_cache_gauges(tmp_path):
     p = str(tmp_path / "led.jsonl")
     led = telemetry.Ledger(p)

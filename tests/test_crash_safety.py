@@ -240,6 +240,11 @@ def test_rumor_resume_under_fault_bitwise(tmp_path):
             == load_meta(str(tmp_path / "half.npz"))["extra"]["dropped"])
 
 
+# ~11 s (txn-PR rebalance): the shared churn-resume mechanism —
+# absolute round cursor + dropped carry + schedule fingerprint — stays
+# pinned in-gate by the SI resume params and the fused-planes resume;
+# this packed-sharded twin re-proves under -m slow
+@pytest.mark.slow
 @pytest.mark.skipif(len(jax.devices()) < 4,
                     reason="needs the virtual multi-device mesh")
 def test_packed_sharded_resume_under_fault_bitwise(tmp_path):
@@ -462,6 +467,12 @@ def test_checkpointed_static_fingerprints_full(name):
 # is artifacts/ledger_crashloop_r12.jsonl)
 # ---------------------------------------------------------------------
 
+# ~18 s (txn-PR tier-1 rebalance, flight data in
+# artifacts/ledger_tests.jsonl): the crash-safety surface stays
+# in-gate via the committed 3-kill record pin below plus the SI and
+# fused-planes churn resumes; the live SIGKILL loop re-proves under
+# -m slow
+@pytest.mark.slow
 def test_crashloop_single_kill_smoke(tmp_path):
     out = str(tmp_path / "ledger_crashloop_smoke.jsonl")
     # n=4096 + a 2 ms poll: each 4-round segment walls ~15 ms on this

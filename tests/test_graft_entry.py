@@ -46,18 +46,21 @@ _tspec.loader.exec_module(readme_table)
 
 FAMILIES = frozenset({
     "dense_pushpull", "churn_heal", "churn_sweep", "crdt_counter",
-    "kafka_log", "serving_batch",
+    "kafka_log", "txn_register", "serving_batch",
     "packed_pull", "sparse_antientropy", "topo_sparse_antientropy",
     "swim_rotating", "halo_banded", "fused_planes",
     "fused_planes_fault_curve", "rumor_sir", "hybrid_2d_sweep"})
-# the committed r14 record predates the replicated-log PR's kafka_log
-# family; the committed r13 record additionally predates the serving
-# PR's serving_batch family; the committed r11 record additionally
-# predates the CRDT PR's crdt_counter family; the committed
-# r07/r08/r09 records additionally predate the compiled-nemesis PR's
-# churn_heal family and the traced-operand PR's churn_sweep family —
-# each pin stays on its historical set
-FAMILIES_PRE_LOG = FAMILIES - {"kafka_log"}
+# the committed r15 record predates the transactions PR's txn_register
+# family; the committed r14 record additionally predates the
+# replicated-log PR's kafka_log family; the committed r13 record
+# additionally predates the serving PR's serving_batch family; the
+# committed r11 record additionally predates the CRDT PR's
+# crdt_counter family; the committed r07/r08/r09 records additionally
+# predate the compiled-nemesis PR's churn_heal family and the
+# traced-operand PR's churn_sweep family — each pin stays on its
+# historical set
+FAMILIES_PRE_TXN = FAMILIES - {"txn_register"}
+FAMILIES_PRE_LOG = FAMILIES_PRE_TXN - {"kafka_log"}
 FAMILIES_PRE_SERVING = FAMILIES_PRE_LOG - {"serving_batch"}
 FAMILIES_PRE_CRDT = FAMILIES_PRE_SERVING - {"crdt_counter"}
 FAMILIES_PRE_CHURN = FAMILIES_PRE_CRDT - {"churn_heal", "churn_sweep"}
@@ -420,12 +423,23 @@ def test_committed_r14_4dev_record_carries_serving_batch():
 
 def test_committed_r15_4dev_record_carries_kafka_log():
     """The replicated-log PR's committed 4-device record
-    (artifacts/ledger_dryrun_r15_4dev.jsonl, the ledger_diff gate
-    baseline since r15): cold+warm pair, FULL current family set —
-    kafka_log included — warm run all-hit, steady and warm budgets
-    held, >= 3x warm-start aggregate, provenance present."""
+    (artifacts/ledger_dryrun_r15_4dev.jsonl): cold+warm pair on its
+    historical family set — kafka_log included, txn_register not yet.
+    (The live ledger_diff gate baseline moved to the r16 record below
+    when the transactions PR grew the family set.)"""
     _assert_cold_warm_record(
         os.path.join(_REPO, "artifacts", "ledger_dryrun_r15_4dev.jsonl"),
+        FAMILIES_PRE_TXN)
+
+
+def test_committed_r16_4dev_record_carries_txn_register():
+    """The transactions PR's committed 4-device record
+    (artifacts/ledger_dryrun_r16_4dev.jsonl, the ledger_diff gate
+    baseline since r16): cold+warm pair, FULL current family set —
+    txn_register included — warm run all-hit, steady and warm budgets
+    held, >= 3x warm-start aggregate, provenance present."""
+    _assert_cold_warm_record(
+        os.path.join(_REPO, "artifacts", "ledger_dryrun_r16_4dev.jsonl"),
         FAMILIES)
 
 

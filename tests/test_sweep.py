@@ -9,6 +9,11 @@ from gossip_tpu.runtime.simulator import simulate_curve
 from gossip_tpu.topology import generators as G
 
 
+# ~9 s (txn-PR rebalance): the ensemble-vs-solo mechanism stays
+# pinned in-gate by the nemesis ensemble twins (rumor churn solo
+# parity + SWIM observer denominator, tests/test_nemesis.py) and the
+# CLI/RPC ensemble smokes; this SI reference re-proves under -m slow
+@pytest.mark.slow
 def test_ensemble_matches_individual_runs():
     # the vmapped batch must reproduce each seed's solo trajectory exactly
     proto = ProtocolConfig(mode="pushpull", fanout=1)

@@ -281,10 +281,13 @@ def diff(old, new, ratio=1.8, steady_floor_ms=50.0,
             # "dropped" joins the gated totals when the run carries the
             # nemesis observables (ops/round_metrics churn columns),
             # "value_conv_final" when it carries a CRDT payload,
-            # "log_conv_final" when it carries a replicated-log payload
-            # — absent keys fail the isinstance guard and are skipped
+            # "log_conv_final" when it carries a replicated-log
+            # payload, "txn_conv_final" when it carries the
+            # LWW-register payload — absent keys fail the isinstance
+            # guard and are skipped
             for key in ("newly", "dup", "msgs", "bytes", "dropped",
-                        "value_conv_final", "log_conv_final"):
+                        "value_conv_final", "log_conv_final",
+                        "txn_conv_final"):
                 a, b = o.get(key), n.get(key)
                 if not isinstance(a, (int, float)) \
                         or not isinstance(b, (int, float)):

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Capture the txn-register convergence + anomaly-verdict record (the
+transactions PR's acceptance artifact).
+
+Two legs, one provenance-stamped ledger:
+
+1. **Convergence leg** — the sharded LWW-register driver on the
+   4-device pull fabric under ONE mixed nemesis fault program (a
+   crash/recover event, a permanent crash, an open partition window,
+   and a drop-rate ramp), gating:
+
+   * ``txn_conv == 1.0``: EVERY eventually-alive node's full register
+     row (value + timestamp planes) equals the acked-writes LWW
+     ground truth (integer-exact full-row equality, divided once on
+     the host);
+   * the partition STALL is visible: while the window is open, nobody
+     holds the global truth (txn_conv < 1 for those rounds);
+   * 1-device/4-device trajectory parity BITWISE (the fabric's
+     mesh-invariance contract, re-proven on the committed evidence);
+   * the truth summary (per-key winners + unpacked (round, owner)
+     timestamps) agrees between the mesh and single-device drivers.
+
+2. **Anomaly leg** — the Maelstrom ``txn-rw-register`` workload
+   (runtime/maelstrom_harness.run_txn_workload) through a
+   harness-injected mid-run partition, gating the weak-isolation
+   verdicts: **zero G0** (dirty write: no cycle in the per-key LWW
+   version orders), **zero G1a** (aborted read), zero trace defects,
+   and cross-node LWW convergence after heal — the totally-available
+   isolation claim, checked, not asserted.
+
+Everything lands in one run ledger (utils/telemetry — provenance
+first line; the drivers flush their ``round_metrics`` events with the
+``txn_conv`` column), so the committed artifact passes
+tools/validate_artifacts.py's ``*txn*``/``*register*`` provenance
+gate.
+
+    python tools/txn_capture.py [OUT.jsonl]    # default
+        artifacts/ledger_txn_r16.jsonl
+
+Runs on the hermetic CPU tier by design (register convergence is
+integer arithmetic and the anomaly checker is protocol logic, not a
+chip rate).
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N = 64
+DEVICES = 4
+MAX_ROUNDS = 24
+PARTITION_END = 6
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    out_path = (argv[0] if argv else
+                os.path.join(REPO, "artifacts",
+                             "ledger_txn_r16.jsonl"))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={DEVICES}"
+        ).strip()
+
+    import numpy as np
+    from gossip_tpu.config import (ChurnConfig, FaultConfig,
+                                   ProtocolConfig, RunConfig,
+                                   TxnConfig)
+    from gossip_tpu.models.register import simulate_curve_txn
+    from gossip_tpu.parallel.sharded import make_mesh
+    from gossip_tpu.parallel.sharded_register import (
+        simulate_curve_txn_sharded)
+    from gossip_tpu.runtime.maelstrom_harness import run_txn_workload
+    from gossip_tpu.topology import generators as G
+    from gossip_tpu.utils import telemetry
+
+    proto = ProtocolConfig(mode="pull", fanout=2)
+    topo = G.complete(N)
+    run = RunConfig(seed=0, max_rounds=MAX_ROUNDS, target_coverage=1.0)
+    mesh = make_mesh(DEVICES)
+    # the mixed fault program: crash/recover, permanent crash, open
+    # partition window, drop ramp — every schedule feature at once
+    fault = FaultConfig(drop_prob=0.05, seed=1, churn=ChurnConfig(
+        events=((3, 2, 5), (7, 1, -1)),
+        partitions=((0, PARTITION_END, N // 2),),
+        ramp=(1, 4, 0.0, 0.3)))
+    cfg = TxnConfig(keys=8, txns=24, zipf_alpha=1.2, hot_key=0.3)
+
+    led = telemetry.Ledger(out_path)
+    prev = telemetry.activate(led)
+    ok = True
+    try:
+        led.record_runtime()
+        led.event("txn_fault_program",
+                  events=[list(e) for e in fault.churn.events],
+                  partitions=[list(w) for w in fault.churn.partitions],
+                  ramp=list(fault.churn.ramp), drop_prob=fault.drop_prob,
+                  n=N, keys=cfg.keys, txns=cfg.txns,
+                  zipf_alpha=cfg.zipf_alpha, hot_key=cfg.hot_key,
+                  max_rounds=MAX_ROUNDS)
+        with led.span("txn:register", keys=cfg.keys):
+            conv4, msgs4, fin4, truth4 = simulate_curve_txn_sharded(
+                cfg, proto, topo, run, mesh, fault)
+            conv1, msgs1, fin1, truth1 = simulate_curve_txn(
+                cfg, proto, topo, run, fault)
+        parity = bool(
+            (np.asarray(conv1) == np.asarray(conv4)).all()
+            and (np.asarray(fin1.val)
+                 == np.asarray(fin4.val)[:N]).all()
+            and truth1 == truth4)
+        stalled = bool(all(c < 1.0 for c in conv4[:PARTITION_END]))
+        conv_ok = bool(conv4[-1] == 1.0) and parity and stalled
+        led.event("txn_scenario",
+                  txn_conv_final=float(conv4[-1]),
+                  txn_conv_curve=[round(float(c), 6) for c in conv4],
+                  truth=truth4,
+                  msgs=float(msgs4[-1]),
+                  partition_stall_rounds=PARTITION_END,
+                  partition_stalled=stalled,
+                  mesh_parity_bitwise=parity,
+                  devices=DEVICES, ok=conv_ok)
+
+        # anomaly leg: the live workload trace through a mid-run
+        # partition, judged by the weak-isolation checker
+        with led.span("txn:workload"):
+            stats = asyncio.run(run_txn_workload(
+                4, ops=16, rate=25.0, latency=0.001,
+                partition_mid=True, seed=0))
+        anom_ok = bool(stats["invariant_ok"] and stats["g0_ok"]
+                       and stats["g1a_ok"] and stats["converged"]
+                       and stats["partitioned"])
+        led.event("txn_workload",
+                  g0=stats["anomalies"]["g0"],
+                  g1a=stats["anomalies"]["g1a"],
+                  defects=stats["anomalies"]["defects"],
+                  g0_ok=stats["g0_ok"], g1a_ok=stats["g1a_ok"],
+                  converged=stats["converged"],
+                  committed=stats["committed"],
+                  aborted=stats["aborted"],
+                  indeterminate=stats["indeterminate"],
+                  partitioned=stats["partitioned"],
+                  invariant_ok=stats["invariant_ok"], ok=anom_ok)
+        ok = conv_ok and anom_ok
+        led.event("txn_verdict", ok=ok)
+    finally:
+        telemetry.activate(prev)
+        led.close()
+    print(json.dumps({"out": out_path, "ok": ok}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -111,6 +111,16 @@ def _is_log_name(name: str) -> bool:
     return "kafka" in name or "replog" in name
 
 
+def _is_txn_name(name: str) -> bool:
+    """Txn/register artifacts by name — isolation-anomaly verdicts and
+    LWW convergence records (the totally-available-transactions
+    evidence, ops/registers + the TxnServer workload +
+    runtime/txn_checker) must always be attributable; the legacy
+    allowlist can never grandfather one in (the whole register
+    subsystem post-dates the provenance schema)."""
+    return "txn" in name or "register" in name
+
+
 def _is_serving_name(name: str) -> bool:
     """Serving/load artifacts by name — throughput and latency gates
     (the admission-batching layer's committed evidence: requests/sec,
@@ -170,6 +180,12 @@ def validate_file(path):
                     "provenance line — log-convergence evidence must "
                     "be attributable, allowlist or not "
                     "(utils/telemetry.provenance)")
+            if not has_prov and _is_txn_name(name):
+                problems.append(
+                    "txn/register artifact without a provenance line "
+                    "— isolation-anomaly and LWW-convergence "
+                    "evidence must be attributable, allowlist or not "
+                    "(utils/telemetry.provenance)")
         else:
             with open(path) as f:
                 doc = json.load(f)
@@ -189,6 +205,12 @@ def validate_file(path):
                     "replicated-log/kafka artifact without provenance "
                     f"keys {PROVENANCE_KEYS} — log-convergence "
                     "evidence must be attributable, allowlist or not")
+            elif _is_txn_name(name) and not _has_provenance_keys(doc):
+                problems.append(
+                    "txn/register artifact without provenance keys "
+                    f"{PROVENANCE_KEYS} — isolation-anomaly and "
+                    "LWW-convergence evidence must be attributable, "
+                    "allowlist or not")
             elif name not in LEGACY and not _has_provenance_keys(doc):
                 problems.append(
                     "new-format json without provenance keys "
