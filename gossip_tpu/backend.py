@@ -354,26 +354,24 @@ def _fused_ineligible_reason(proto: ProtocolConfig, tc: TopologyConfig,
         return ("engine='fused' does not implement scripted dead_nodes/"
                 "fail_round; use engine='auto' (or node_death_rate for "
                 "random static deaths)")
-    if fault is not None and fault.churn is not None:
-        if not plane_stack:
-            # the plane-sharded fused drivers run churn EVENTS when
-            # called directly (parallel/sharded_fused — the checkpointed
-            # CLI path routes there, plane_stack=True), but this
-            # routing's single-device fused paths predate the churn
-            # denominator — auto falls back to the XLA kernels, which
-            # run every schedule
-            return ("engine='fused' routing does not run churn "
-                    "schedules; use engine='auto' (XLA kernels run the "
-                    "full nemesis scenario catalog — "
-                    "docs/ROBUSTNESS.md)")
-        if fault.churn.partitions or fault.churn.ramp is not None:
-            # mirror ops/nemesis.check_supported as a clean CLI reason:
-            # the factory would raise the same refusal mid-driver
-            return ("the fused plane stack runs churn EVENTS only — it "
-                    "has no per-pair message table a partition cut "
-                    "could destroy, and its drop coin is an in-kernel "
-                    "compile-time threshold no ramp can move; use the "
-                    "XLA engines for partition/ramp fault programs")
+    if (fault is not None and fault.churn is not None
+            and not plane_stack and n_dev == 1):
+        # the plane-sharded fused drivers run the FULL nemesis — churn
+        # events, partition windows (per-round side-word cut masks),
+        # and drop-rate ramps (the threshold table behind the SMEM
+        # scalar operand): every multi-device fused route and the
+        # plane_stack surfaces (--checkpoint, churn-sweep --engine
+        # fused) land there.  Only the SINGLE-device fused routing
+        # rejects churn: its compiled_*_fused paths predate the churn
+        # denominator — auto falls back to the XLA kernels, which run
+        # every schedule
+        return ("engine='fused' routing does not run churn "
+                "schedules single-device; use engine='auto' (XLA "
+                "kernels run the full nemesis scenario catalog — "
+                "docs/ROBUSTNESS.md), or the plane-sharded fused "
+                "surfaces (--devices > 1, --checkpoint, churn-sweep "
+                "--engine fused), which run events + partitions + "
+                "ramps as runtime operands")
     # node_death_rate / drop_prob: in-kernel static fault masks cover
     # every fused layout since round 4 (node-packed, one-word-per-node,
     # staged big path, plane-sharded) — no restriction to return

@@ -960,13 +960,19 @@ def _parse_scenario(spec: str):
 
 def cmd_churn_sweep(a) -> int:
     """K nemesis scenarios — distinct churn/partition/drop-ramp fault
-    programs over ONE protocol config — as ONE compiled XLA program:
-    the schedule stack rides the compiled round loop as a runtime
-    operand (parallel/sweep.churn_sweep_curves), so the whole scenario
-    family costs one compile and a re-run with new scenarios of the
-    same shapes costs none.  Per-scenario trajectories are bitwise the
-    solo ``run`` command's.  --devices shards the scenario axis."""
-    from gossip_tpu.parallel.sweep import churn_sweep_curves
+    programs over ONE protocol config — for the cost of ONE compile.
+    --engine xla (default): the schedule stack rides ONE compiled
+    vmapped loop as a runtime operand (parallel/sweep
+    .churn_sweep_curves); per-scenario trajectories are bitwise the
+    solo ``run`` command's, and --devices shards the scenario axis.
+    --engine fused: the plane-sharded fused Pallas engine runs the K
+    scenarios serially through ONE memoized compiled loop — schedule
+    content (alive words, partition cut masks, the 20-bit drop
+    threshold) is all runtime operands since the fused-operand PR, so
+    scenarios 1..K-1 re-enter scenario 0's executable
+    (parallel/sweep.fused_churn_sweep_curves); --devices shards the
+    rumor-plane axis and per-scenario trajectories are bitwise the
+    solo fused curve driver's."""
     from gossip_tpu.topology import generators as G
     scens = [_parse_scenario(s) for s in a.scenario]
     proto = ProtocolConfig(mode=a.mode, fanout=a.fanout, rumors=a.rumors,
@@ -977,16 +983,32 @@ def cmd_churn_sweep(a) -> int:
                     seed=a.seed)
     faults = [FaultConfig(node_death_rate=a.death, drop_prob=a.drop,
                           seed=a.seed, churn=ch) for ch in scens]
-    mesh = None
-    if a.devices > 1:
-        if len(faults) % a.devices:
-            print(f"error: {len(faults)} scenarios do not divide over "
-                  f"{a.devices} devices", file=sys.stderr)
+    if a.engine == "fused":
+        from gossip_tpu.backend import _fused_ineligible_reason
+        from gossip_tpu.parallel.sharded_fused import make_plane_mesh
+        from gossip_tpu.parallel.sweep import fused_churn_sweep_curves
+        reason = _fused_ineligible_reason(proto, tc, faults[0],
+                                          a.devices, plane_stack=True)
+        if reason is not None:
+            print(f"error: {reason}", file=sys.stderr)
             return 2
-        from gossip_tpu.parallel.sharded import make_mesh
-        mesh = make_mesh(a.devices, axis_name="scenario")
-    res = churn_sweep_curves(proto, G.build(tc), run, faults, mesh=mesh)
+        res = fused_churn_sweep_curves(
+            tc.n, proto.rumors, run, faults,
+            make_plane_mesh(a.devices), fanout=proto.fanout)
+    else:
+        from gossip_tpu.parallel.sweep import churn_sweep_curves
+        mesh = None
+        if a.devices > 1:
+            if len(faults) % a.devices:
+                print(f"error: {len(faults)} scenarios do not divide "
+                      f"over {a.devices} devices", file=sys.stderr)
+                return 2
+            from gossip_tpu.parallel.sharded import make_mesh
+            mesh = make_mesh(a.devices, axis_name="scenario")
+        res = churn_sweep_curves(proto, G.build(tc), run, faults,
+                                 mesh=mesh)
     out = {"churn_sweep": res.summaries(), "n": tc.n, "mode": a.mode,
+           "engine": a.engine,
            "scenarios": len(faults), "target": run.target_coverage}
     if a.curve:
         out["curves"] = [[round(float(c), 6) for c in row]
@@ -1486,7 +1508,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "the one compiled step bakes the static mask)")
     p.add_argument("--curve", action="store_true")
     p.add_argument("--devices", type=int, default=1,
-                   help="shard the scenario axis over this many devices")
+                   help="shard the scenario axis (xla) or the "
+                        "rumor-plane axis (fused) over this many "
+                        "devices")
+    p.add_argument("--engine", default="xla", choices=("xla", "fused"),
+                   help="xla: K scenarios as ONE vmapped program; "
+                        "fused: the plane-sharded Pallas engine, K "
+                        "scenarios re-entering ONE memoized compiled "
+                        "loop (--mode pull, complete family, TPU)")
     _add_cache_flags(p)
     p.set_defaults(fn=cmd_churn_sweep)
 

@@ -186,6 +186,21 @@ def build(fault: FaultConfig, n: int, n_pad: Optional[int] = None,
     validate_events(fault, n)
     n_pad = n if n_pad is None else n_pad
     die, rec = _event_tables(ch, n_pad)
+    cut_np, drop_np = _cut_drop_rows(fault, t_pad)
+    return Schedule(die=die, rec=rec,
+                    cut_tbl=jnp.asarray(cut_np, jnp.int32),
+                    drop_tbl=jnp.asarray(drop_np, jnp.float32))
+
+
+def _cut_drop_rows(fault: FaultConfig, t_pad: Optional[int] = None):
+    """(cut rows, drop-probability rows) as host Python lists, padded to
+    ``t_pad`` (default :func:`canonical_horizon`) by repeating the
+    steady final row — the ONE construction of the per-round cut/drop
+    timelines, shared by :func:`build` (f32 drop table, the XLA
+    engines' operand) and :func:`fused_sched_tables` (20-bit integer
+    thresholds, the fused kernels' operand) so the two lowerings of a
+    schedule can never drift."""
+    ch = fault.churn
     t = ch.horizon()
     cut_np = [-1] * t
     for start, end, cut in ch.partitions:
@@ -202,9 +217,27 @@ def build(fault: FaultConfig, n: int, n_pad: Optional[int] = None,
         raise ValueError(f"t_pad={t_pad} below the schedule horizon {t}")
     cut_np += [cut_np[-1]] * (t_pad - t)
     drop_np += [drop_np[-1]] * (t_pad - t)
-    return Schedule(die=die, rec=rec,
-                    cut_tbl=jnp.asarray(cut_np, jnp.int32),
-                    drop_tbl=jnp.asarray(drop_np, jnp.float32))
+    return cut_np, drop_np
+
+
+def fused_sched_tables(fault: FaultConfig, n: int,
+                       t_pad: Optional[int] = None):
+    """(cut_tbl int32[T], thr_tbl int32[T]) — the fused engines'
+    schedule operands: the per-round partition cut (-1 closed) and the
+    per-round 20-bit drop THRESHOLD (``round(drop_tbl[r] * 2^20)``,
+    computed host-side in f64 exactly like
+    ops/pallas_round.drop_threshold_for, so a flat schedule's
+    thresholds equal the static path's value bit-for-bit and drop-rate
+    RAMPS lower for free).  Both numpy (content — the compiled fused
+    loops consume them as runtime operands indexed by the clamped
+    round lookup, module doc)."""
+    import numpy as np
+    if get(fault) is None:
+        raise ValueError("fused_sched_tables needs a churn schedule")
+    validate_events(fault, n)
+    cut_np, drop_np = _cut_drop_rows(fault, t_pad)
+    thr_np = [int(round(p * (1 << 20))) if p else 0 for p in drop_np]
+    return (np.asarray(cut_np, np.int32), np.asarray(thr_np, np.int32))
 
 
 def build_stack(faults, n: int, n_pad: Optional[int] = None) -> Schedule:
@@ -611,18 +644,19 @@ def check_supported(fault: Optional[FaultConfig], *, engine: str,
                     partitions: bool = True, ramp: bool = True,
                     events: bool = True) -> None:
     """Reject schedule features an engine cannot honor — loudly, never
-    silently (the no-silent-substitution policy).  Since the XLA paths
-    consume schedules as runtime operands, the remaining rejections are
-    the genuinely-impossible combinations:
+    silently (the no-silent-substitution policy).  Since the operand
+    PRs (XLA paths, then the fused Pallas kernels: drop threshold as
+    an SMEM scalar, partition cuts as rotated side-word masks) the
+    remaining rejections are the genuinely-impossible combinations:
 
-      * ``partitions=False`` — the plane-sharded fused engine has no
-        per-pair message table a node-id cut could destroy, and SWIM
-        probes ride the complete membership overlay, which a link cut
-        does not model;
-      * ``ramp=False`` — ONLY the fused Pallas kernels: their drop
-        coin is a hardware-PRNG threshold compare compiled into the
-        kernel body, not a traced probability (every XLA engine,
-        SWIM included, reads ``drop_tbl[r]`` as an operand);
+      * ``partitions=False`` — ONLY SWIM: probes ride the complete
+        membership overlay, which a link cut does not model (the fused
+        engines came off this row when the cut lowered to per-round
+        side masks through the partner rotation);
+      * ``ramp=False`` — NO current engine: kept for future engines
+        whose drop coin cannot follow a traced per-round probability
+        (the fused kernels came off this row when the threshold became
+        a runtime scalar operand indexed from the drop table);
       * ``events=False`` — an engine with no churn support at all:
         ONLY the topo-sparse exchange and the grid config sweeps
         remain (the checkpointed segment drivers came off this list
@@ -643,17 +677,15 @@ def check_supported(fault: Optional[FaultConfig], *, engine: str,
     if not partitions and ch.partitions:
         raise ValueError(
             f"the {engine} engine cannot honor partition windows (no "
-            "per-pair messages a node-id cut could destroy — fused "
-            "planes have no message table; SWIM probes ride the "
-            "complete membership overlay); run the dense/sparse/halo "
-            "exchanges for partition scenarios")
+            "per-pair messages a node-id cut could destroy — SWIM "
+            "probes ride the complete membership overlay); run the "
+            "dense/sparse/halo/fused exchanges for partition scenarios")
     if not ramp and ch.ramp is not None:
         raise ValueError(
-            f"the {engine} engine draws its drop coins inside the "
-            "fused Pallas kernel against a threshold fixed at compile "
-            "time and cannot honor a drop-rate ramp; the XLA engines "
-            "consume the drop table as a runtime operand — use "
-            "engine='xla' or any dense/sparse/halo/SWIM driver")
+            f"the {engine} engine cannot follow a drop-rate ramp (its "
+            "drop coin is not a per-round traced probability); every "
+            "current engine — XLA and fused Pallas alike — consumes "
+            "the drop table as a runtime operand")
 
 
 def observables(sched: Schedule, alive: jax.Array, round_):

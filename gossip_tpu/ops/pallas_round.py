@@ -171,12 +171,14 @@ def _phantom_word_keep(rows: int, n_valid_words: int, tail_mask: int):
 
 
 def _fused_round_ref(table, n: int, fanout: int, inject_bits,
-                     drop_threshold: int, alive_table,
-                     plane_sharing: int) -> jax.Array:
+                     drop_threshold, alive_table,
+                     plane_sharing: int, cut_bits=None) -> jax.Array:
     """Pure-JAX reference of :func:`_fused_round_kernel` (single-rumor,
     node-packed).  Bitwise-equal to the Mosaic interpreter on the same
     operands (tests/test_pallas_round.py); hardware-PRNG draws reproduce
-    the interpreter's off-TPU stub (zeros)."""
+    the interpreter's off-TPU stub (zeros).  ``drop_threshold`` is a
+    plain traced scalar here — the reference twin of the real path's
+    SMEM operand, bitwise-pinned against it like every fused twin."""
     rows = table.shape[0]
     inject = inject_bits is not None
     if inject:
@@ -186,6 +188,9 @@ def _fused_round_ref(table, n: int, fanout: int, inject_bits,
         sbits = jnp.zeros((8, LANES), jnp.uint32)
     src = table & alive_table if alive_table is not None else table
     rot = _rotate_rows_xla(src, sbits, rows)
+    rot_cut = (_rotate_rows_xla(cut_bits, sbits, rows)
+               if cut_bits is not None else None)
+    thr = jnp.asarray(drop_threshold, jnp.int32).astype(jnp.uint32)
 
     acc = table
     for k in range(0, BITS, plane_sharing):
@@ -198,10 +203,13 @@ def _fused_round_ref(table, n: int, fanout: int, inject_bits,
                 c = (rb >> (sh + jnp.uint32(7))) & jnp.uint32(BITS - 1)
                 partner = jnp.take_along_axis(rot, m, axis=1)
                 bit = (partner >> c) & jnp.uint32(1)
-                if drop_threshold:
-                    keep = ((rb >> jnp.uint32(12))
-                            >= jnp.uint32(drop_threshold))
-                    bit = jnp.where(keep, bit, jnp.uint32(0))
+                keep = (rb >> jnp.uint32(12)) >= thr
+                bit = jnp.where(keep, bit, jnp.uint32(0))
+                if cut_bits is not None:
+                    pside = (jnp.take_along_axis(rot_cut, m, axis=1)
+                             >> c) & jnp.uint32(1)
+                    dside = (cut_bits >> jnp.uint32(k + j)) & jnp.uint32(1)
+                    bit = jnp.where(pside == dside, bit, jnp.uint32(0))
                 if alive_table is not None:
                     bit = bit & ((alive_table >> jnp.uint32(k + j))
                                  & jnp.uint32(1))
@@ -214,7 +222,8 @@ def _fused_round_ref(table, n: int, fanout: int, inject_bits,
 
 
 def _fused_mr_round_ref(table, n: int, fanout: int, inject_bits,
-                        drop_threshold: int, alive_words) -> jax.Array:
+                        drop_threshold, alive_words,
+                        cut_words=None) -> jax.Array:
     """Pure-JAX reference of :func:`_fused_mr_kernel` (multi-rumor,
     one-word-per-node).  Same contract as :func:`_fused_round_ref`."""
     rows = table.shape[0]
@@ -223,6 +232,7 @@ def _fused_mr_round_ref(table, n: int, fanout: int, inject_bits,
         sbits_all = jnp.asarray(inject_bits[0], jnp.uint32)
         rbits_all = jnp.asarray(inject_bits[1], jnp.uint32)
     src = table & alive_words if alive_words is not None else table
+    thr = jnp.asarray(drop_threshold, jnp.int32).astype(jnp.uint32)
 
     acc = table
     for f in range(fanout):
@@ -233,9 +243,13 @@ def _fused_mr_round_ref(table, n: int, fanout: int, inject_bits,
               else jnp.zeros((rows, LANES), jnp.uint32))
         m = (rb & jnp.uint32(LANES - 1)).astype(jnp.int32)
         partner = jnp.take_along_axis(rot, m, axis=1)
-        if drop_threshold:
-            keep = (rb >> jnp.uint32(12)) >= jnp.uint32(drop_threshold)
-            partner = jnp.where(keep, partner, jnp.uint32(0))
+        keep = (rb >> jnp.uint32(12)) >= thr
+        partner = jnp.where(keep, partner, jnp.uint32(0))
+        if cut_words is not None:
+            rot_cut = _rotate_rows_xla(cut_words, sbits, rows)
+            pside = jnp.take_along_axis(rot_cut, m, axis=1)
+            partner = jnp.where(pside == cut_words, partner,
+                                jnp.uint32(0))
         if alive_words is not None:
             partner = partner & alive_words
         acc = acc | partner
@@ -246,14 +260,19 @@ def _fused_mr_round_ref(table, n: int, fanout: int, inject_bits,
 
 
 def _fused_call(kernel, rows: int, seed, round_, table, inject_bits,
-                interpret: bool, round_salt: int = 0, alive_table=None):
+                interpret: bool, round_salt: int = 0, alive_table=None,
+                drop_threshold=0, cut_words=None):
     """Shared pallas_call plumbing for the fused kernels: SMEM seed pair,
-    VMEM table aliased into the output, optional injected-bits operands,
-    optional alive-bitmap operand (fault masks — last, after the inject
-    pair, matching the kernels' ``rest`` unpack order).
+    the SMEM fault scalar (the 20-bit drop threshold as a scalar-prefetch
+    operand — a traced RUNTIME value since the operand PR, so a fault
+    sweep over drop rates/ramps re-enters one executable), VMEM table
+    aliased into the output, optional injected-bits operands, optional
+    alive-bitmap operand, optional partition side-mask operand (fault
+    masks — after the inject pair, matching the kernels' ``rest``
+    unpack order).
 
     Donation contract: the whole-table value kernels ALWAYS declare the
-    ``{1: 0}`` table->output alias.  It is safe because nothing after
+    ``{2: 0}`` table->output alias.  It is safe because nothing after
     this call reads the pre-round table — the entry points consume their
     table operand exactly once, and the jit wrappers never donate the
     caller's own buffers — and it is what lets the compiled
@@ -265,9 +284,11 @@ def _fused_call(kernel, rows: int, seed, round_, table, inject_bits,
     seeds = jnp.stack([jnp.asarray(seed, jnp.int32) * jnp.int32(_ROUND_MIX),
                        jnp.asarray(round_, jnp.int32)
                        ^ jnp.int32(round_salt)])
+    fault = jnp.asarray(drop_threshold, jnp.int32).reshape((1,))
     in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
                 pl.BlockSpec(memory_space=pltpu.VMEM)]
-    operands = [seeds, table]
+    operands = [seeds, fault, table]
     if inject_bits is not None:
         sbits, rbits = inject_bits
         in_specs += [pl.BlockSpec(memory_space=pltpu.VMEM),
@@ -277,22 +298,25 @@ def _fused_call(kernel, rows: int, seed, round_, table, inject_bits,
     if alive_table is not None:
         in_specs += [pl.BlockSpec(memory_space=pltpu.VMEM)]
         operands += [jnp.asarray(alive_table, jnp.uint32)]
+    if cut_words is not None:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.VMEM)]
+        operands += [jnp.asarray(cut_words, jnp.uint32)]
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.uint32),
         in_specs=in_specs,
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        input_output_aliases={1: 0},
+        input_output_aliases={2: 0},
         compiler_params=None if interpret else pallas_compiler_params(
             vmem_limit_bytes=_VMEM_LIMIT_BYTES),
         interpret=pallas_interpret_mode(interpret),
     )(*operands)
 
 
-def _fused_round_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
-                        n_valid_words: int, tail_mask: int, inject: bool,
-                        drop_threshold: int = 0, has_alive: bool = False,
-                        plane_sharing: int = 1):
+def _fused_round_kernel(seed_ref, fault_ref, tin_ref, *rest, rows: int,
+                        fanout: int, n_valid_words: int, tail_mask: int,
+                        inject: bool, has_alive: bool = False,
+                        plane_sharing: int = 1, has_cut: bool = False):
     """One pull round, entirely in VMEM.  See module doc for the scheme.
 
     ``inject=True`` replaces the hardware PRNG with caller-supplied bit
@@ -301,40 +325,62 @@ def _fused_round_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
     interpreter stubs ``prng_random_bits`` with zeros (tests/test_pallas.py
     round-1 finding).  The TPU path draws the same shapes from the hw PRNG.
 
-    Fault masks (round 4; static SI semantics, models/state.alive_mask):
-    ``has_alive`` adds an alive-bitmap operand (node-packed like the
-    table) — dead nodes SERVE nothing (their bits are cleared from the
-    rotation source) and ACQUIRE nothing (plane contributions masked by
-    the destination's alive bit); their own initial bits stay put, like
-    the XLA path's dark nodes.  ``drop_threshold`` (static, 20-bit:
-    round(drop_prob * 2^20)) drops an individual pull when the free
-    bits 12..31 of its draw fall below it — bits 0..6 are the lane and
-    7..11 the bit choice, so the drop coin is independent of the
-    partner choice.  Both default OFF, leaving the fault-free lowering
-    byte-identical to round 2's."""
+    Fault operands (static SI semantics round 4, runtime operands since
+    the operand PR): ``has_alive`` adds an alive-bitmap operand
+    (node-packed like the table) — dead nodes SERVE nothing (their bits
+    are cleared from the rotation source) and ACQUIRE nothing (plane
+    contributions masked by the destination's alive bit); their own
+    initial bits stay put, like the XLA path's dark nodes.  The 20-bit
+    drop threshold (round(drop_prob * 2^20)) rides ``fault_ref`` — an
+    SMEM SCALAR, not a compile-time constant — and drops an individual
+    pull when the free bits 12..31 of its draw fall below it; bits 0..6
+    are the lane and 7..11 the bit choice, so the drop coin is
+    independent of the partner choice.  The compare always runs
+    (threshold 0 keeps every pull — bitwise the old elided lowering),
+    which is what lets drop-rate RAMPS move the threshold per round
+    with zero recompiles.  ``has_cut`` adds the partition SIDE mask
+    (render_cut_bits: bit b of word w is 1 iff node 32w+b sits at or
+    above the cut; -1 renders every real node on one side — inert):
+    the mask rotates through the SAME per-lane shifts as the table, so
+    the partner's side comes out of one extra in-row gather, and a
+    pull is kept only when both endpoints share a side — the
+    lost-for-this-round-only semantics of ops/nemesis.same_side."""
     if inject:
-        if has_alive:
+        if has_alive and has_cut:
+            sbits_ref, rbits_ref, alive_ref, cut_ref, tout_ref = rest
+        elif has_alive:
             sbits_ref, rbits_ref, alive_ref, tout_ref = rest
+        elif has_cut:
+            sbits_ref, rbits_ref, cut_ref, tout_ref = rest
         else:
             sbits_ref, rbits_ref, tout_ref = rest
     else:
-        if has_alive:
+        if has_alive and has_cut:
+            alive_ref, cut_ref, tout_ref = rest
+        elif has_alive:
             alive_ref, tout_ref = rest
+        elif has_cut:
+            cut_ref, tout_ref = rest
         else:
             (tout_ref,) = rest
         pltpu.prng_seed(seed_ref[0], seed_ref[1])
     table = tin_ref[:]
     alive = alive_ref[:] if has_alive else None
+    cut_tab = cut_ref[:] if has_cut else None
+    thr = fault_ref[0].astype(jnp.uint32)
 
     # Stage 1: one shared rotation per round (all bit planes and fanout
     # draws reuse it; the MR kernel rotates per fanout draw instead).
     # Dead nodes serve nothing: cleared from the rotation SOURCE only —
-    # their own accumulated bits are untouched.
+    # their own accumulated bits are untouched.  The partition side
+    # mask rides the same rotation so the partner's side is one more
+    # in-row gather.
     if inject:
         sbits = sbits_ref[:]
     else:
         sbits = pltpu.bitcast(pltpu.prng_random_bits((8, LANES)), jnp.uint32)
     rot = _rotate_rows(table & alive if has_alive else table, sbits, rows)
+    rot_cut = _rotate_rows(cut_tab, sbits, rows) if has_cut else None
 
     # Stages 2+3: per destination bit-plane k, draw (lane m, bit c) per
     # word, gather the partner word in-row, pull bit c into plane k.
@@ -361,10 +407,13 @@ def _fused_round_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
                 c = (rb >> (sh + jnp.uint32(7))) & jnp.uint32(BITS - 1)
                 partner = jnp.take_along_axis(rot, m, axis=1)
                 bit = (partner >> c) & jnp.uint32(1)
-                if drop_threshold:
-                    keep = ((rb >> jnp.uint32(12))
-                            >= jnp.uint32(drop_threshold))
-                    bit = jnp.where(keep, bit, jnp.uint32(0))
+                keep = (rb >> jnp.uint32(12)) >= thr
+                bit = jnp.where(keep, bit, jnp.uint32(0))
+                if has_cut:
+                    pside = (jnp.take_along_axis(rot_cut, m, axis=1)
+                             >> c) & jnp.uint32(1)
+                    dside = (cut_tab >> jnp.uint32(k + j)) & jnp.uint32(1)
+                    bit = jnp.where(pside == dside, bit, jnp.uint32(0))
                 if has_alive:
                     bit = bit & ((alive >> jnp.uint32(k + j))
                                  & jnp.uint32(1))
@@ -383,21 +432,51 @@ def _fused_round_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
 
 @functools.partial(jax.jit,
                    static_argnames=("n", "fanout", "interpret",
-                                    "drop_threshold", "plane_sharing"))
+                                    "plane_sharing"))
+def _fused_pull_round_jit(table, seed, round_, drop_threshold, n: int,
+                          fanout: int, interpret, inject_bits,
+                          alive_table, plane_sharing: int,
+                          cut_words) -> jax.Array:
+    if _interpret_impl(interpret) == "reference":
+        return _fused_round_ref(table, n, fanout, inject_bits,
+                                drop_threshold, alive_table,
+                                plane_sharing, cut_words)
+    rows = table.shape[0]
+    n_valid_words = -(-n // BITS)
+    tail = n % BITS
+    tail_mask = ((1 << tail) - 1) if tail else 0
+    kernel = functools.partial(
+        _fused_round_kernel, rows=rows, fanout=fanout,
+        n_valid_words=n_valid_words, tail_mask=tail_mask,
+        inject=inject_bits is not None,
+        has_alive=alive_table is not None,
+        plane_sharing=plane_sharing,
+        has_cut=cut_words is not None)
+    return _fused_call(kernel, rows, seed, round_, table, inject_bits,
+                       interpret, alive_table=alive_table,
+                       drop_threshold=drop_threshold, cut_words=cut_words)
+
+
 def fused_pull_round(table: jax.Array, seed: jax.Array, round_: jax.Array,
                      n: int, fanout: int = 1, interpret: bool = False,
-                     inject_bits=None, drop_threshold: int = 0,
-                     alive_table=None, plane_sharing: int = 1) -> jax.Array:
+                     inject_bits=None, drop_threshold=0,
+                     alive_table=None, plane_sharing: int = 1,
+                     cut_words=None) -> jax.Array:
     """Apply one fused pull round to a node-packed table. Pure; jittable.
 
     ``inject_bits`` (tests only): a ``(sbits uint32[8,128], rbits
     uint32[fanout*32//plane_sharing, rows, 128])`` pair replacing the
-    hardware PRNG — see _fused_round_kernel.  ``drop_threshold``/
-    ``alive_table`` are the static fault masks (same docstring); both
-    default off and leave the fault-free lowering unchanged.
+    hardware PRNG — see _fused_round_kernel.  ``drop_threshold`` is a
+    RUNTIME operand since the operand PR (an SMEM scalar on the real
+    path, a traced scalar in the reference lowering) — pass the 20-bit
+    int OR a traced per-round value from a nemesis drop table;
+    ``alive_table`` is the node-packed alive bitmap and ``cut_words``
+    the partition side mask (:func:`render_cut_bits`); all default off
+    and leave the fault-free trajectory bitwise unchanged.
     ``plane_sharing=2`` halves the PRNG words per round by splitting one
     draw's disjoint bit-fields across an adjacent plane pair — an
-    OPT-IN different stream (kernel docstring); requires no drop coin.
+    OPT-IN different stream (kernel docstring); requires no drop coin
+    and no partition (their bits/side gathers overlap the pair split).
 
     ``interpret`` may be a bool or an impl name: ``True``/'reference'
     is the pure-JAX reference lowering (fast, compiled by XLA — the
@@ -407,27 +486,22 @@ def fused_pull_round(table: jax.Array, seed: jax.Array, round_: jax.Array,
     if plane_sharing not in (1, 2):
         raise ValueError(f"plane_sharing must be 1 or 2, "
                          f"got {plane_sharing}")
-    if plane_sharing > 1 and drop_threshold:
+    # plane sharing requires a provably-ZERO drop coin: a traced
+    # threshold cannot be proven zero at trace time, so it is rejected
+    # outright — silently correlated drops (the coin bits overlap the
+    # pair split) would be worse than the refusal
+    concrete_zero = (isinstance(drop_threshold, (int, float))
+                     and not drop_threshold)
+    if plane_sharing > 1 and (not concrete_zero or cut_words is not None):
         raise ValueError(
             "plane_sharing=2 splits the draw's bit-fields across a "
-            "plane pair and leaves no room for the 20-bit drop coin; "
-            "use plane_sharing=1 with drop_prob faults")
-    if _interpret_impl(interpret) == "reference":
-        return _fused_round_ref(table, n, fanout, inject_bits,
-                                drop_threshold, alive_table, plane_sharing)
-    rows = table.shape[0]
-    n_valid_words = -(-n // BITS)
-    tail = n % BITS
-    tail_mask = ((1 << tail) - 1) if tail else 0
-    kernel = functools.partial(
-        _fused_round_kernel, rows=rows, fanout=fanout,
-        n_valid_words=n_valid_words, tail_mask=tail_mask,
-        inject=inject_bits is not None,
-        drop_threshold=drop_threshold,
-        has_alive=alive_table is not None,
-        plane_sharing=plane_sharing)
-    return _fused_call(kernel, rows, seed, round_, table, inject_bits,
-                       interpret, alive_table=alive_table)
+            "plane pair and leaves no room for the 20-bit drop coin "
+            "(concrete or traced) or the partition side gather; use "
+            "plane_sharing=1 with drop_prob/partition faults")
+    return _fused_pull_round_jit(table, seed, round_,
+                                 jnp.asarray(drop_threshold, jnp.int32),
+                                 n, fanout, interpret, inject_bits,
+                                 alive_table, plane_sharing, cut_words)
 
 
 # ---------------------------------------------------------------------------
@@ -481,33 +555,49 @@ def coverage_words(table: jax.Array, n: int, rumors: int) -> jax.Array:
     return jnp.min(per_rumor) / jnp.float32(n)
 
 
-def _fused_mr_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
-                     n: int, inject: bool, drop_threshold: int = 0,
-                     has_alive: bool = False):
+def _fused_mr_kernel(seed_ref, fault_ref, tin_ref, *rest, rows: int,
+                     fanout: int, n: int, inject: bool,
+                     has_alive: bool = False, has_cut: bool = False):
     """One multi-rumor pull round, table fully VMEM-resident.
 
-    Fault masks (round 4, same contract as _fused_round_kernel, adapted
-    to the one-word-per-NODE layout): the alive operand holds
-    0xFFFFFFFF for alive nodes and 0 for dead ones — dead nodes serve
-    nothing (cleared from the rotation source) and acquire nothing
-    (the gathered partner word is AND-masked), while their own word
-    stays put.  ``drop_threshold`` drops a whole pull (all rumors ride
-    one exchange) on bits 12..31 of its draw; the lane choice uses
-    bits 0..6, so the coin is independent.  Defaults leave the
-    fault-free lowering unchanged."""
+    Fault operands (round 4's static masks, runtime operands since the
+    operand PR; same contract as _fused_round_kernel, adapted to the
+    one-word-per-NODE layout): the alive operand holds 0xFFFFFFFF for
+    alive nodes and 0 for dead ones — dead nodes serve nothing
+    (cleared from the rotation source) and acquire nothing (the
+    gathered partner word is AND-masked), while their own word stays
+    put.  The 20-bit drop threshold rides the ``fault_ref`` SMEM
+    scalar and drops a whole pull (all rumors ride one exchange) on
+    bits 12..31 of its draw; the lane choice uses bits 0..6, so the
+    coin is independent.  The compare always runs (threshold 0 keeps
+    everything — bitwise the old elided lowering).  ``has_cut`` adds
+    the partition side-word mask (render_cut_words: 0xFFFFFFFF at or
+    above the cut): it rotates through the SAME per-lane shifts as the
+    table per fanout draw, the partner's side is one extra in-row
+    gather, and cross-side pulls are destroyed for this round only."""
     if inject:
-        if has_alive:
+        if has_alive and has_cut:
+            sbits_ref, rbits_ref, alive_ref, cut_ref, tout_ref = rest
+        elif has_alive:
             sbits_ref, rbits_ref, alive_ref, tout_ref = rest
+        elif has_cut:
+            sbits_ref, rbits_ref, cut_ref, tout_ref = rest
         else:
             sbits_ref, rbits_ref, tout_ref = rest
     else:
-        if has_alive:
+        if has_alive and has_cut:
+            alive_ref, cut_ref, tout_ref = rest
+        elif has_alive:
             alive_ref, tout_ref = rest
+        elif has_cut:
+            cut_ref, tout_ref = rest
         else:
             (tout_ref,) = rest
         pltpu.prng_seed(seed_ref[0], seed_ref[1])
     table = tin_ref[:]
     alive = alive_ref[:] if has_alive else None
+    cut_w = cut_ref[:] if has_cut else None
+    thr = fault_ref[0].astype(jnp.uint32)
     src = table & alive if has_alive else table
 
     acc = table
@@ -527,9 +617,12 @@ def _fused_mr_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
                                jnp.uint32)
         m = (rb & jnp.uint32(LANES - 1)).astype(jnp.int32)
         partner = jnp.take_along_axis(rot, m, axis=1)
-        if drop_threshold:
-            keep = (rb >> jnp.uint32(12)) >= jnp.uint32(drop_threshold)
-            partner = jnp.where(keep, partner, jnp.uint32(0))
+        keep = (rb >> jnp.uint32(12)) >= thr
+        partner = jnp.where(keep, partner, jnp.uint32(0))
+        if has_cut:
+            rot_cut = _rotate_rows(cut_w, sbits, rows)
+            pside = jnp.take_along_axis(rot_cut, m, axis=1)
+            partner = jnp.where(pside == cut_w, partner, jnp.uint32(0))
         if has_alive:
             partner = partner & alive
         acc = acc | partner
@@ -571,23 +664,35 @@ def _fused_mr_kernel(seed_ref, tin_ref, *rest, rows: int, fanout: int,
 _MR_GATHER_BLOCK = 1024   # rows per grid step (512 KiB windows)
 
 
-def _mr_gather_kernel(seed_ref, tin_ref, rot_ref, *rest, n: int, block: int,
-                      inject: bool, drop_threshold: int = 0,
-                      has_alive: bool = False):
+def _mr_gather_kernel(seed_ref, fault_ref, tin_ref, rot_ref, *rest, n: int,
+                      block: int, inject: bool, has_alive: bool = False,
+                      has_cut: bool = False):
     """Grid step: partner lane-gather from the pre-rotated table + OR.
-    Fault masks as in _fused_mr_kernel — the rotation source is already
-    serve-masked by the caller's XLA stage; this kernel applies the drop
-    coin and the destination's acquire mask."""
+    Fault operands as in _fused_mr_kernel — the rotation source is
+    already serve-masked by the caller's XLA stage (which also rotated
+    the partition side mask when ``has_cut``: sbits live in the XLA
+    stage on this path, so the side rotation happens there and this
+    kernel only lane-gathers the partner's side); this kernel applies
+    the drop coin (the ``fault_ref`` SMEM scalar), the side compare,
+    and the destination's acquire mask."""
     b = pl.program_id(0)
     if inject:
-        if has_alive:
+        if has_alive and has_cut:
+            rbits_ref, alive_ref, rot_cut_ref, cut_ref, tout_ref = rest
+        elif has_alive:
             rbits_ref, alive_ref, tout_ref = rest
+        elif has_cut:
+            rbits_ref, rot_cut_ref, cut_ref, tout_ref = rest
         else:
             rbits_ref, tout_ref = rest
         rb = rbits_ref[0]
     else:
-        if has_alive:
+        if has_alive and has_cut:
+            alive_ref, rot_cut_ref, cut_ref, tout_ref = rest
+        elif has_alive:
             alive_ref, tout_ref = rest
+        elif has_cut:
+            rot_cut_ref, cut_ref, tout_ref = rest
         else:
             (tout_ref,) = rest
         # per-block stream: fold the block id into the round seed word
@@ -598,9 +703,11 @@ def _mr_gather_kernel(seed_ref, tin_ref, rot_ref, *rest, n: int, block: int,
                            jnp.uint32)
     m = (rb & jnp.uint32(LANES - 1)).astype(jnp.int32)
     partner = jnp.take_along_axis(rot_ref[:], m, axis=1)
-    if drop_threshold:
-        keep = (rb >> jnp.uint32(12)) >= jnp.uint32(drop_threshold)
-        partner = jnp.where(keep, partner, jnp.uint32(0))
+    keep = (rb >> jnp.uint32(12)) >= fault_ref[0].astype(jnp.uint32)
+    partner = jnp.where(keep, partner, jnp.uint32(0))
+    if has_cut:
+        pside = jnp.take_along_axis(rot_cut_ref[:], m, axis=1)
+        partner = jnp.where(pside == cut_ref[:], partner, jnp.uint32(0))
     if has_alive:
         partner = partner & alive_ref[:]
     node_id = ((jax.lax.broadcasted_iota(jnp.int32, (block, LANES), 0)
@@ -612,8 +719,9 @@ def _mr_gather_kernel(seed_ref, tin_ref, rot_ref, *rest, n: int, block: int,
 
 def _fused_mr_round_big(table: jax.Array, seed, round_, n: int,
                         interpret: bool, inject_bits,
-                        drop_threshold: int = 0,
-                        alive_words=None, fanout: int = 1) -> jax.Array:
+                        drop_threshold=0,
+                        alive_words=None, fanout: int = 1,
+                        cut_words=None) -> jax.Array:
     """One multi-rumor pull round via the staged big-table path.
     Fault masks as in the value kernel: the serve mask is applied to the
     rotation SOURCE in the XLA stage, the drop coin and acquire mask in
@@ -651,6 +759,9 @@ def _fused_mr_round_big(table: jax.Array, seed, round_, n: int,
 
     src = table if alive_words is None else table & alive_words
     alive_p = None if alive_words is None else _padded(alive_words)
+    cut_p = None if cut_words is None else _padded(cut_words)
+    thr = jnp.asarray(drop_threshold, jnp.int32)
+    thr_u = thr.astype(jnp.uint32)
     # pad the accumulator ONCE and feed it back padded between draws
     # (the kernel zeroes pad rows in its output anyway); re-padding and
     # re-slicing per draw would add two full-table HBM copies per draw
@@ -665,8 +776,13 @@ def _fused_mr_round_big(table: jax.Array, seed, round_, n: int,
             sbits = jax.random.bits(kf, (8, LANES), jnp.uint32)
 
         # Stage 1 (XLA): per-lane row rotation, binary decomposition —
-        # always from the PRE-round serve-masked table.
+        # always from the PRE-round serve-masked table.  The partition
+        # side mask rides the same shifts (the sbits live HERE on the
+        # staged path, so the side rotation is an XLA stage too).
         rot = _padded(_rotate_rows_xla(src, sbits, rows))
+        rot_cut_p = (None if cut_words is None
+                     else _padded(_rotate_rows_xla(cut_words, sbits,
+                                                   rows)))
 
         # Stage 2: lane choice + in-row gather + OR + mask.  Rows pad up
         # to a block multiple (pad rows are phantom nodes — the kernel
@@ -687,9 +803,12 @@ def _fused_mr_round_big(table: jax.Array, seed, round_, n: int,
                   else jnp.zeros((rows_pad, LANES), jnp.uint32))
             m = (rb & jnp.uint32(LANES - 1)).astype(jnp.int32)
             partner = jnp.take_along_axis(rot, m, axis=1)
-            if drop_threshold:
-                keep = (rb >> jnp.uint32(12)) >= jnp.uint32(drop_threshold)
-                partner = jnp.where(keep, partner, jnp.uint32(0))
+            keep = (rb >> jnp.uint32(12)) >= thr_u
+            partner = jnp.where(keep, partner, jnp.uint32(0))
+            if cut_p is not None:
+                pside = jnp.take_along_axis(rot_cut_p, m, axis=1)
+                partner = jnp.where(pside == cut_p, partner,
+                                    jnp.uint32(0))
             if alive_p is not None:
                 partner = partner & alive_p
             node_id = (jax.lax.broadcasted_iota(
@@ -706,9 +825,10 @@ def _fused_mr_round_big(table: jax.Array, seed, round_, n: int,
              jnp.asarray(round_, jnp.int32)
              ^ jnp.int32(0x5D0 + 0x51ED * f)])
         in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM),
+                    pl.BlockSpec(memory_space=pltpu.SMEM),
                     pl.BlockSpec((block, LANES), lambda i: (i, 0)),
                     pl.BlockSpec((block, LANES), lambda i: (i, 0))]
-        operands = [seeds, acc_p, rot]
+        operands = [seeds, thr.reshape((1,)), acc_p, rot]
         if rbits is not None:
             in_specs.append(pl.BlockSpec((1, block, LANES),
                                          lambda i: (0, i, 0)))
@@ -716,13 +836,19 @@ def _fused_mr_round_big(table: jax.Array, seed, round_, n: int,
         if alive_p is not None:
             in_specs.append(pl.BlockSpec((block, LANES), lambda i: (i, 0)))
             operands.append(alive_p)
+        if cut_p is not None:
+            in_specs += [pl.BlockSpec((block, LANES), lambda i: (i, 0)),
+                         pl.BlockSpec((block, LANES), lambda i: (i, 0))]
+            operands += [rot_cut_p, cut_p]
         kernel = functools.partial(_mr_gather_kernel, n=n, block=block,
                                    inject=inject_bits is not None,
-                                   drop_threshold=drop_threshold,
-                                   has_alive=alive_words is not None)
+                                   has_alive=alive_words is not None,
+                                   has_cut=cut_words is not None)
         # Donation contract for the staged path's table operand (the
-        # whole-table kernels' simpler rule is at _fused_call):
-        #   * draws f >= 1 always alias {1: 0}: their table operand is
+        # whole-table kernels' simpler rule is at _fused_call; operand
+        # index 2 = the table, after the seed pair and the SMEM fault
+        # scalar):
+        #   * draws f >= 1 always alias {2: 0}: their table operand is
         #     the previous draw's output — dead after this call — so XLA
         #     reuses the buffer in place.
         #   * draw 0 aliases ONLY in a fanout-1 round.  With fanout > 1
@@ -745,7 +871,7 @@ def _fused_mr_round_big(table: jax.Array, seed, round_, n: int,
             out_shape=jax.ShapeDtypeStruct((rows_pad, LANES), jnp.uint32),
             in_specs=in_specs,
             out_specs=pl.BlockSpec((block, LANES), lambda i: (i, 0)),
-            input_output_aliases={} if no_alias else {1: 0},
+            input_output_aliases={} if no_alias else {2: 0},
             interpret=pallas_interpret_mode(interpret),
         )(*operands)
     return acc_p[:rows] if rows_pad != rows else acc_p
@@ -771,6 +897,28 @@ def render_alive_words(alive: jax.Array, n: int) -> jax.Array:
     flat = jnp.zeros((rows * LANES,), jnp.uint32).at[:n].set(
         jnp.where(alive, jnp.uint32(0xFFFFFFFF), jnp.uint32(0)))
     return flat.reshape(rows, LANES)
+
+
+def render_cut_words(cut, n: int) -> jax.Array:
+    """The per-round partition SIDE mask in the fused one-word-per-NODE
+    geometry — rendered by the ONE :func:`render_alive_words` geometry
+    (the alive-word trick extended to cut words): 0xFFFFFFFF for real
+    nodes at or above the cut, 0 below (and for phantoms).  A closed
+    window (``cut < 0``) renders every real node on one side, which is
+    value-inert in the kernels' side compare — the compiled churn loops
+    pass THIS mask every round so partition-free and partition-bearing
+    scenarios share one executable.  In-trace safe (``cut`` traced)."""
+    ids = jnp.arange(n, dtype=jnp.int32)
+    return render_alive_words(ids >= jnp.asarray(cut, jnp.int32), n)
+
+
+def render_cut_bits(cut, n: int) -> jax.Array:
+    """:func:`render_cut_words`'s node-packed twin for the single-rumor
+    kernel: bit ``b`` of word ``w`` is 1 iff node ``32w + b`` sits at
+    or above the cut (phantom bits 0) — the :func:`node_pack` geometry.
+    In-trace safe."""
+    ids = jnp.arange(n, dtype=jnp.int32)
+    return node_pack(ids >= jnp.asarray(cut, jnp.int32))
 
 
 def fault_masks_word(fault, n: int, origin: int = 0):
@@ -811,13 +959,37 @@ def fused_mr_cov_fn(n: int, rumors: int, fault=None, origin: int = 0):
     return cov
 
 
-@functools.partial(jax.jit, static_argnames=("n", "fanout", "interpret",
-                                             "drop_threshold"))
+@functools.partial(jax.jit, static_argnames=("n", "fanout", "interpret"))
+def _fused_mr_round_jit(table, seed, round_, drop_threshold, n: int,
+                        fanout: int, interpret, inject_bits, alive_words,
+                        cut_words) -> jax.Array:
+    rows = table.shape[0]
+    if _mr_wants_big(rows * LANES * 4, fanout):
+        return _fused_mr_round_big(table, seed, round_, n, interpret,
+                                   inject_bits,
+                                   drop_threshold=drop_threshold,
+                                   alive_words=alive_words, fanout=fanout,
+                                   cut_words=cut_words)
+    if _interpret_impl(interpret) == "reference":
+        return _fused_mr_round_ref(table, n, fanout, inject_bits,
+                                   drop_threshold, alive_words, cut_words)
+    kernel = functools.partial(_fused_mr_kernel, rows=rows, fanout=fanout,
+                               n=n, inject=inject_bits is not None,
+                               has_alive=alive_words is not None,
+                               has_cut=cut_words is not None)
+    # round_salt: distinct hw-PRNG stream from the single-rumor kernel
+    return _fused_call(kernel, rows, seed, round_, table, inject_bits,
+                       interpret, round_salt=0x5D0,
+                       alive_table=alive_words,
+                       drop_threshold=drop_threshold, cut_words=cut_words)
+
+
 def fused_multirumor_pull_round(table: jax.Array, seed: jax.Array,
                                 round_: jax.Array, n: int, fanout: int = 1,
                                 interpret: bool = False,
-                                inject_bits=None, drop_threshold: int = 0,
-                                alive_words=None) -> jax.Array:
+                                inject_bits=None, drop_threshold=0,
+                                alive_words=None,
+                                cut_words=None) -> jax.Array:
     """One fused pull round on a one-word-per-node table.  Pure; jittable.
 
     Tables whose 4-window working set exceeds the VMEM budget route to the
@@ -827,26 +999,17 @@ def fused_multirumor_pull_round(table: jax.Array, seed: jax.Array,
 
     ``inject_bits`` (tests only): ``(sbits uint32[fanout, 8, 128], rbits
     uint32[fanout, rows, 128])`` replacing the hardware PRNG so the kernel
-    math runs under the CPU interpreter.  ``drop_threshold``/
-    ``alive_words`` are the fault masks (fault_masks_word); defaults
-    leave the fault-free lowering unchanged on BOTH routes."""
-    rows = table.shape[0]
-    if _mr_wants_big(rows * LANES * 4, fanout):
-        return _fused_mr_round_big(table, seed, round_, n, interpret,
-                                   inject_bits,
-                                   drop_threshold=drop_threshold,
-                                   alive_words=alive_words, fanout=fanout)
-    if _interpret_impl(interpret) == "reference":
-        return _fused_mr_round_ref(table, n, fanout, inject_bits,
-                                   drop_threshold, alive_words)
-    kernel = functools.partial(_fused_mr_kernel, rows=rows, fanout=fanout,
-                               n=n, inject=inject_bits is not None,
-                               drop_threshold=drop_threshold,
-                               has_alive=alive_words is not None)
-    # round_salt: distinct hw-PRNG stream from the single-rumor kernel
-    return _fused_call(kernel, rows, seed, round_, table, inject_bits,
-                       interpret, round_salt=0x5D0,
-                       alive_table=alive_words)
+    math runs under the CPU interpreter.  ``drop_threshold`` is a
+    RUNTIME operand since the operand PR (int or traced per-round
+    scalar from a nemesis drop table — SMEM on the real path, traced in
+    the reference lowering); ``alive_words``/``cut_words`` are the
+    alive mask (fault_masks_word) and partition side mask
+    (:func:`render_cut_words`); defaults leave the fault-free
+    trajectory bitwise unchanged on BOTH routes."""
+    return _fused_mr_round_jit(table, seed, round_,
+                               jnp.asarray(drop_threshold, jnp.int32),
+                               n, fanout, interpret, inject_bits,
+                               alive_words, cut_words)
 
 
 def fused_table_bytes(n: int, rumors: int) -> int:

@@ -150,23 +150,35 @@ def _cached_alive_words(fault, n: int, origin: int):
 
 @functools.lru_cache(maxsize=32)
 def _cached_churn_masks(fault, n: int, origin: int):
-    """Jitted builder of the churn-path mask operands: ``(cov_words,
-    base_words, die_words, rec_words)`` — the EVENTUAL alive words the
-    cond/coverage compare against (ops/nemesis.fused_eventual_words:
-    permanent churn deaths out of the denominator, transient ones in —
-    the heal-convergence contract), the static base mask, and the
-    die/recover round tables the compiled loop indexes by its round
-    counter.  All runtime OPERANDS: a churn sweep over schedules shares
-    one compiled loop (the alive-mask runtime-operand trick)."""
+    """The churn-path mask operands, built ONCE per fault and cached as
+    VALUES: ``(cov_words, base_words, die_words, rec_words, cut_tbl,
+    thr_tbl)`` — the EVENTUAL alive words the cond/coverage compare
+    against (ops/nemesis.fused_eventual_words: permanent churn deaths
+    out of the denominator, transient ones in — the heal-convergence
+    contract), the static base mask, the die/recover round tables, and
+    (since the operand PR) the per-round partition-cut and 20-bit
+    drop-threshold tables (ops/nemesis.fused_sched_tables) the
+    compiled loop indexes by its round counter.  All runtime OPERANDS:
+    a churn sweep over schedules — events, partition windows, AND
+    drop-rate ramps — shares one compiled loop (the alive-mask
+    runtime-operand trick, extended to cut words and the drop coin).
+
+    Deliberately EAGER, not a per-fault ``jax.jit(build)`` closure: a
+    fresh jit per fault bakes the schedule content as trace constants
+    and pays one backend compile per SCENARIO — exactly the recompile
+    class this PR deletes (the K-scenario compile-count pin in
+    tests/test_sharded_fused.py counts it).  Eager builds dispatch
+    shape-keyed primitive programs shared across every fault of the
+    same shape class, and the lru_cache makes steady re-entry free.
+    Caching device buffers is donation-safe here: the compiled loops
+    donate only the plane stack, never the mask operands."""
     from gossip_tpu.ops import nemesis as NE
-
-    def build():
-        base = NE.fused_base_words(fault, n, origin)
-        die_w, rec_w = NE.fused_word_tables(fault, n)
-        return (NE.fused_eventual_words(base, die_w, rec_w), base,
-                die_w, rec_w)
-
-    return jax.jit(build)
+    cut_np, thr_np = NE.fused_sched_tables(fault, n)
+    base = NE.fused_base_words(fault, n, origin)
+    die_w, rec_w = NE.fused_word_tables(fault, n)
+    return (NE.fused_eventual_words(base, die_w, rec_w), base,
+            die_w, rec_w, jnp.asarray(cut_np, jnp.int32),
+            jnp.asarray(thr_np, jnp.int32))
 
 
 def fused_planes_cov_fn(n: int, fault=None, origin: int = 0):
@@ -180,7 +192,7 @@ def fused_planes_cov_fn(n: int, fault=None, origin: int = 0):
     from gossip_tpu.ops import nemesis as NE
     if NE.get(fault) is not None:
         def cov_churn(p):
-            eventual = _cached_churn_masks(fault, n, origin)()[0]
+            eventual = _cached_churn_masks(fault, n, origin)[0]
             return coverage_planes_masked(p, n, eventual)
         return cov_churn
     if fault is None or not fault.node_death_rate:
@@ -195,35 +207,46 @@ def fused_planes_cov_fn(n: int, fault=None, origin: int = 0):
 def make_sharded_fused_round_masked(n: int, mesh: Mesh, fanout: int = 1,
                                     interpret: bool = False,
                                     inject_bits=None,
-                                    drop_threshold: int = 0,
-                                    has_alive: bool = False):
+                                    has_alive: bool = False,
+                                    has_cut: bool = False):
     """The masked core of :func:`make_sharded_fused_round`:
-    ``round_fn(planes, seed, round_, alive_words=None)`` with the death
-    mask as a runtime OPERAND (replicated over the mesh) instead of a
-    trace-baked constant.  The compiled drivers built on this share one
-    executable across every fault configuration with the same (static)
-    ``drop_threshold`` — a fault-curve sweep over death rates or seeds
-    re-enters one cached program per shape instead of recompiling the
-    whole shard_map loop per point.  Same values as the baked form: the
-    mask is a pure function of the fault config over the REPLICATED
-    node dimension, and it consumes no hardware PRNG (the drop coin
-    rides free bits of the existing partner draw) — the zero-ICI
-    same-stream invariant is untouched."""
+    ``round_fn(planes, seed, round_, alive_words=None,
+    drop_threshold=0, cut_words=None)`` with EVERY fault input as a
+    runtime OPERAND (replicated over the mesh) instead of a
+    trace-baked constant — the death mask, the 20-bit drop threshold
+    (an SMEM scalar inside the kernel since the operand PR, so
+    drop-rate sweeps and RAMPS re-enter one executable), and, with
+    ``has_cut``, the partition side-word mask
+    (ops/pallas_round.render_cut_words).  The compiled drivers built
+    on this share one executable across every fault configuration of
+    the same operand structure — a fault sweep over death rates,
+    seeds, drop rates, ramps, or partition windows re-enters one
+    cached program per shape instead of recompiling the whole
+    shard_map loop per point.  Same values as the baked form: the
+    masks are pure functions of the fault config over the REPLICATED
+    node dimension, and they consume no hardware PRNG (the drop coin
+    rides free bits of the existing partner draw; the side compare
+    rides the partner rotation) — the zero-ICI same-stream invariant
+    is untouched."""
     n_dev = mesh.shape[AXIS]
 
-    def local_round(planes_l, seed, round_, *masks):
+    def local_round(planes_l, seed, round_, thr, *masks):
         alive_words = masks[0] if has_alive else None
+        cut_words = masks[1 if has_alive else 0] if has_cut else None
         w_local = planes_l.shape[0]
         outs = [fused_multirumor_pull_round(
                     planes_l[i], seed, round_, n, fanout, interpret,
                     inject_bits=inject_bits,
-                    drop_threshold=drop_threshold,
-                    alive_words=alive_words)
+                    drop_threshold=thr,
+                    alive_words=alive_words,
+                    cut_words=cut_words)
                 for i in range(w_local)]
         return jnp.stack(outs)
 
-    in_specs = (P(AXIS, None, None), P(), P())
+    in_specs = (P(AXIS, None, None), P(), P(), P())
     if has_alive:
+        in_specs += (P(None, None),)
+    if has_cut:
         in_specs += (P(None, None),)
     # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
     # annotation, which the default shard_map VMA check rejects
@@ -231,16 +254,23 @@ def make_sharded_fused_round_masked(n: int, mesh: Mesh, fanout: int = 1,
         local_round, mesh=mesh, in_specs=in_specs,
         out_specs=P(AXIS, None, None), check_vma=False)
 
-    def round_fn(planes, seed, round_, alive_words=None):
+    def round_fn(planes, seed, round_, alive_words=None,
+                 drop_threshold=0, cut_words=None):
         if planes.shape[0] % n_dev:
             raise ValueError(f"{planes.shape[0]} planes do not divide "
                              f"over {n_dev} devices")
         if (alive_words is not None) != has_alive:
             raise ValueError("alive_words must be passed exactly when the "
                              "round was built with has_alive=True")
+        if (cut_words is not None) != has_cut:
+            raise ValueError("cut_words must be passed exactly when the "
+                             "round was built with has_cut=True")
         masks = (alive_words,) if has_alive else ()
+        if has_cut:
+            masks += (cut_words,)
         return mapped(planes, jnp.asarray(seed, jnp.int32),
-                      jnp.asarray(round_, jnp.int32), *masks)
+                      jnp.asarray(round_, jnp.int32),
+                      jnp.asarray(drop_threshold, jnp.int32), *masks)
 
     return round_fn
 
@@ -253,35 +283,49 @@ def make_sharded_fused_round(n: int, mesh: Mesh, fanout: int = 1,
     zero ICI.  ``inject_bits`` (tests) is one (sbits, rbits) pair reused
     for every plane, which IS the semantic: one shared partner stream.
 
-    ``fault`` (round 4) threads the static fault masks into every
-    plane's kernel call — a fault-binding wrapper around
-    :func:`make_sharded_fused_round_masked` that rebuilds the alive mask
-    in-trace per call (loop-invariant, hoisted by jitted callers).
-    Churn EVENTS render the mask per round from the die/recover word
-    tables (ops/nemesis); partitions and ramps are rejected — no
-    per-pair messages to cut, drop threshold baked static."""
+    ``fault`` threads the fault operands into every plane's kernel call
+    — a fault-binding wrapper around
+    :func:`make_sharded_fused_round_masked` that rebuilds the masks
+    in-trace per call (loop-invariant or round-indexed, hoisted by
+    jitted callers).  Under a churn schedule the FULL nemesis runs:
+    events render the alive words per round from the die/recover word
+    tables, partition windows render per-round side-word cut masks
+    (ops/pallas_round.render_cut_words), and drop-rate ramps index the
+    20-bit threshold table — all from the state's ABSOLUTE round
+    counter, so checkpointed resume stays bitwise
+    (ops/nemesis.fused_sched_tables; the two check_supported rejection
+    rows this engine used to carry are deleted)."""
     from gossip_tpu.ops import nemesis as NE
-    NE.check_supported(fault, engine="fused-planes", partitions=False,
-                       ramp=False)
-    drop_threshold = drop_threshold_for(fault)
+    NE.check_supported(fault, engine="fused-planes")
+    static_thr = drop_threshold_for(fault)
     has_churn = NE.get(fault) is not None
     has_alive = (fault is not None
                  and bool(fault.node_death_rate)) or has_churn
     core = make_sharded_fused_round_masked(
         n, mesh, fanout, interpret, inject_bits=inject_bits,
-        drop_threshold=drop_threshold, has_alive=has_alive)
+        has_alive=has_alive, has_cut=has_churn)
+    if has_churn:
+        # loop-invariant closure constants: converted ONCE here, not
+        # per round_fn call (eager stepwise callers pay one transfer)
+        cut_np, thr_np = NE.fused_sched_tables(fault, n)
+        cut_tbl = jnp.asarray(cut_np, jnp.int32)
+        thr_tbl = jnp.asarray(thr_np, jnp.int32)
 
     def round_fn(planes, seed, round_):
+        from gossip_tpu.ops.pallas_round import render_cut_words
         if has_churn:
             base = NE.fused_base_words(fault, n, origin)
             die_w, rec_w = NE.fused_word_tables(fault, n)
             alive_words = NE.fused_alive_words_at(base, die_w, rec_w,
                                                   round_)
-        elif has_alive:
+            # the ONE clamped steady-row lookup (ops/nemesis._idx)
+            return core(planes, seed, round_, alive_words,
+                        NE._idx(thr_tbl, round_),
+                        render_cut_words(NE._idx(cut_tbl, round_), n))
+        if has_alive:
             alive_words = fault_masks_word(fault, n, origin)[0]
-        else:
-            alive_words = None
-        return core(planes, seed, round_, alive_words)
+            return core(planes, seed, round_, alive_words, static_thr)
+        return core(planes, seed, round_, drop_threshold=static_thr)
 
     return round_fn
 
@@ -377,14 +421,13 @@ def checkpointed_fused_planes(n: int, rumors: int, run: RunConfig,
     """
     from gossip_tpu.ops.pallas_round import FusedState
     from gossip_tpu.utils.checkpoint import run_with_checkpoints
-    # churn EVENTS run in the segments exactly as in the straight fused
-    # drivers — the round closure renders the alive words from the
-    # state's ABSOLUTE round counter, which the checkpoint persists, so
-    # resume == straight run bitwise (utils/checkpoint crash contract);
-    # partitions/ramps stay rejected by make_sharded_fused_round itself
-    # (genuinely impossible on this engine — ops/nemesis.check_supported)
-    # and the coverage denominator under churn is the eventual alive
-    # words (fused_planes_cov_fn)
+    # the FULL churn schedule — events, partition windows, drop-rate
+    # ramps — runs in the segments exactly as in the straight fused
+    # drivers: the round closure renders the alive words, per-round cut
+    # mask, and drop threshold from the state's ABSOLUTE round counter,
+    # which the checkpoint persists, so resume == straight run bitwise
+    # (utils/checkpoint crash contract); the coverage denominator under
+    # churn is the eventual alive words (fused_planes_cov_fn)
     round_fn = make_sharded_fused_round(n, mesh, fanout, interpret,
                                         fault=fault, origin=run.origin)
     cov_planes = fused_planes_cov_fn(n, fault, run.origin)
@@ -448,17 +491,20 @@ def _plane_recorder(n: int, fanout: int, mesh: Mesh):
 
 @functools.lru_cache(maxsize=32)
 def _cached_curve_scan(n: int, seed: int, max_rounds: int, mesh: Mesh,
-                       fanout: int, interpret: bool, drop_threshold: int,
+                       fanout: int, interpret: bool,
                        has_alive: bool, metrics: bool = False,
                        has_churn: bool = False):
     """The compiled curve-scan driver, memoized by EXACTLY the statics
     its trace bakes in (seed and max_rounds are closed-over literals) —
     not the whole RunConfig, whose unused fields (engine, checkpoint
-    knobs) would fragment the cache, and since this round NOT the fault
-    config either: the alive mask is a runtime OPERAND (``*masks``), so
-    a fault-curve sweep over death rates/seeds shares ONE compiled loop
-    per shape instead of recompiling per point (only ``drop_threshold``
-    stays in the key — it specializes the kernel).  Every argument is
+    knobs) would fragment the cache, and NOT the fault config at all:
+    the alive mask, the 20-bit drop threshold (per-round table under
+    churn — so RAMPS ride free), and the partition cut table are all
+    runtime OPERANDS (``*masks``), so a fault sweep over death rates,
+    seeds, drop rates, ramps, or partition windows shares ONE compiled
+    loop per operand structure instead of recompiling per point (the
+    operand PR: only the two structure booleans below remain — they
+    change the operand COUNT, never carry content).  Every argument is
     hashable (Mesh hashes structurally).  Re-entering the driver with
     the same statics — a sweep server, the RPC sidecar, the multichip
     dryrun's steady pass — reuses the jitted callable instead of
@@ -470,35 +516,47 @@ def _cached_curve_scan(n: int, seed: int, max_rounds: int, mesh: Mesh,
     steady path does no per-round host round-trip.  ``metrics`` bakes
     the round-metrics buffer carry into the program (ops/round_metrics
     — part of the memo key: the instrumented and bare loops are
-    different executables).  ``has_churn`` switches the mask operands
-    to the ``(cov_words, base, die, rec)`` quadruple of
-    :func:`_cached_churn_masks`: the loop indexes the die/recover round
-    tables by its own counter (churn schedules ride the memoized loop
-    as runtime OPERANDS — one compiled loop per shape serves every
-    schedule, the alive-mask trick), while the cond/coverage compare
-    against the EVENTUAL alive words."""
+    different executables).  Mask layouts: churn-free passes
+    ``(thr,)`` (plus ``(thr, cov_words)`` under static deaths);
+    ``has_churn`` switches to the ``(cov_words, base, die, rec,
+    cut_tbl, thr_tbl)`` six-tuple of :func:`_cached_churn_masks` — the
+    loop indexes the die/recover/cut/threshold tables by its own
+    counter and renders the per-round side-word cut mask in-trace
+    (render_cut_words, the alive-word trick extended to cut words),
+    while the cond/coverage compare against the EVENTUAL alive
+    words."""
     from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.ops import round_metrics as RM
+    from gossip_tpu.ops.pallas_round import render_cut_words
     step = make_sharded_fused_round_masked(
-        n, mesh, fanout, interpret, drop_threshold=drop_threshold,
-        has_alive=has_alive or has_churn)
+        n, mesh, fanout, interpret,
+        has_alive=has_alive or has_churn, has_cut=has_churn)
     rec = _plane_recorder(n, fanout, mesh) if metrics else None
 
     @functools.partial(jax.jit, donate_argnums=0)
     def scan(planes, *masks):
         if has_churn:
-            cov_words, base_w, die_w, rec_w = masks
+            cov_words, base_w, die_w, rec_w, cut_tbl, thr_tbl = masks
         else:
-            cov_words = masks[0] if has_alive else None
+            thr0 = masks[0]
+            cov_words = masks[1] if has_alive else None
         m0 = (RM.init(max_rounds, mesh.shape[AXIS],
                       "simulate_curve_sharded_fused") if rec else None)
         c0 = RM.count_planes(planes) if rec else None
 
         def body(c, _):
             planes_c, round_c, m, cnt = c
-            aw = (NE.fused_alive_words_at(base_w, die_w, rec_w, round_c)
-                  if has_churn else cov_words)
-            planes_n = step(planes_c, seed, round_c, aw)
+            if has_churn:
+                aw = NE.fused_alive_words_at(base_w, die_w, rec_w,
+                                             round_c)
+                # the ONE clamped steady-row lookup (ops/nemesis._idx)
+                planes_n = step(planes_c, seed, round_c, aw,
+                                NE._idx(thr_tbl, round_c),
+                                render_cut_words(
+                                    NE._idx(cut_tbl, round_c), n))
+            else:
+                planes_n = step(planes_c, seed, round_c, cov_words,
+                                thr0)
             if m is not None:
                 m, cnt = rec(m, cnt, planes_n)
             return ((planes_n, round_c + 1, m, cnt),
@@ -524,11 +582,12 @@ def _init_and_masks(n: int, rumors: int, run: RunConfig, mesh: Mesh,
     t0 = time.perf_counter()
     init = init_plane_state(n, rumors, mesh, run.origin)
     if has_churn:
-        masks = tuple(_cached_churn_masks(fault, n, run.origin)())
+        masks = _cached_churn_masks(fault, n, run.origin)
     elif has_alive:
-        masks = (_cached_alive_words(fault, n, run.origin)(),)
+        masks = (jnp.asarray(drop_threshold_for(fault), jnp.int32),
+                 _cached_alive_words(fault, n, run.origin)())
     else:
-        masks = ()
+        masks = (jnp.asarray(drop_threshold_for(fault), jnp.int32),)
     if timing is not None:
         jax.block_until_ready((init,) + masks)
         timing["init_build_s"] = time.perf_counter() - t0
@@ -550,12 +609,11 @@ def simulate_curve_sharded_fused(n: int, rumors: int, run: RunConfig,
     from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.ops import round_metrics as RM
     from gossip_tpu.utils.trace import maybe_aot_timed
-    NE.check_supported(fault, engine="fused-planes", partitions=False,
-                       ramp=False)
+    NE.check_supported(fault, engine="fused-planes")
     has_alive = fault is not None and bool(fault.node_death_rate)
     has_churn = NE.get(fault) is not None
     scan = _cached_curve_scan(n, run.seed, run.max_rounds, mesh, fanout,
-                              interpret, drop_threshold_for(fault),
+                              interpret,
                               has_alive, RM.wanted(), has_churn)
     init, masks = _init_and_masks(n, rumors, run, mesh, fault, has_alive,
                                   timing, has_churn)
@@ -566,11 +624,12 @@ def simulate_curve_sharded_fused(n: int, rumors: int, run: RunConfig,
 @functools.lru_cache(maxsize=32)
 def _cached_until_loop(n: int, seed: int, max_rounds: int,
                        target_coverage: float, mesh: Mesh,
-                       fanout: int, interpret: bool, drop_threshold: int,
+                       fanout: int, interpret: bool,
                        has_alive: bool, metrics: bool = False,
                        has_churn: bool = False):
     """The compiled until-target driver, memoized like
-    :func:`_cached_curve_scan` (same key contract and rationale, plus
+    :func:`_cached_curve_scan` (same key contract and rationale —
+    fault content all operands, no fault config in the key — plus
     the target the cond compares against).  Returns ``loop(planes,
     *masks) -> (final_planes, rounds, coverage)`` — the reported
     coverage is computed INSIDE the program through the SAME chooser
@@ -580,21 +639,23 @@ def _cached_until_loop(n: int, seed: int, max_rounds: int,
     state does no per-round host round-trip.  ``metrics`` bakes the
     round-metrics buffer carry into the program (part of the memo
     key, as in :func:`_cached_curve_scan`, which also documents
-    ``has_churn``)."""
+    ``has_churn`` and the mask layouts)."""
     from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.ops import round_metrics as RM
+    from gossip_tpu.ops.pallas_round import render_cut_words
     step = make_sharded_fused_round_masked(
-        n, mesh, fanout, interpret, drop_threshold=drop_threshold,
-        has_alive=has_alive or has_churn)
+        n, mesh, fanout, interpret,
+        has_alive=has_alive or has_churn, has_cut=has_churn)
     target = jnp.float32(target_coverage)
     rec = _plane_recorder(n, fanout, mesh) if metrics else None
 
     @functools.partial(jax.jit, donate_argnums=0)
     def loop(planes, *masks):
         if has_churn:
-            cov_words, base_w, die_w, rec_w = masks
+            cov_words, base_w, die_w, rec_w, cut_tbl, thr_tbl = masks
         else:
-            cov_words = masks[0] if has_alive else None
+            thr0 = masks[0]
+            cov_words = masks[1] if has_alive else None
         m0 = (RM.init(max_rounds, mesh.shape[AXIS],
                       "simulate_until_sharded_fused") if rec else None)
         c0 = RM.count_planes(planes) if rec else None
@@ -607,9 +668,17 @@ def _cached_until_loop(n: int, seed: int, max_rounds: int,
 
         def body(c):
             planes_c, round_c, m, cnt = c
-            aw = (NE.fused_alive_words_at(base_w, die_w, rec_w, round_c)
-                  if has_churn else cov_words)
-            planes_n = step(planes_c, seed, round_c, aw)
+            if has_churn:
+                aw = NE.fused_alive_words_at(base_w, die_w, rec_w,
+                                             round_c)
+                # the ONE clamped steady-row lookup (ops/nemesis._idx)
+                planes_n = step(planes_c, seed, round_c, aw,
+                                NE._idx(thr_tbl, round_c),
+                                render_cut_words(
+                                    NE._idx(cut_tbl, round_c), n))
+            else:
+                planes_n = step(planes_c, seed, round_c, cov_words,
+                                thr0)
             if m is not None:
                 m, cnt = rec(m, cnt, planes_n)
             return planes_n, round_c + 1, m, cnt
@@ -638,13 +707,12 @@ def simulate_until_sharded_fused(n: int, rumors: int, run: RunConfig,
     from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.ops import round_metrics as RM
     from gossip_tpu.utils.trace import maybe_aot_timed
-    NE.check_supported(fault, engine="fused-planes", partitions=False,
-                       ramp=False)
+    NE.check_supported(fault, engine="fused-planes")
     has_alive = fault is not None and bool(fault.node_death_rate)
     has_churn = NE.get(fault) is not None
     loop = _cached_until_loop(n, run.seed, run.max_rounds,
                               run.target_coverage, mesh, fanout,
-                              interpret, drop_threshold_for(fault),
+                              interpret,
                               has_alive, RM.wanted(), has_churn)
     init, masks = _init_and_masks(n, rumors, run, mesh, fault, has_alive,
                                   timing, has_churn)

@@ -335,6 +335,107 @@ def churn_sweep_curves(proto: ProtocolConfig, topo: Topology,
                             target=run.target_coverage)
 
 
+@dataclasses.dataclass
+class FusedChurnSweepResult:
+    """K nemesis scenarios through the plane-sharded FUSED engine
+    (:func:`fused_churn_sweep_curves`).  ``msgs`` is the fused
+    accounting's closed form (2*fanout*n per round, every scenario —
+    request+digest transmissions, dropped and dead-partner pulls
+    counted like the solo fused drivers); there is no ``dropped``
+    column because the fused kernels do not materialize per-round
+    destroyed-message counts (the drop coin is resolved inside the
+    kernel) — an honest absence, not a zero."""
+    faults: tuple                 # the FaultConfigs, batch order
+    curves: np.ndarray            # float32[K, T]
+    msgs: np.ndarray              # float32[K, T]
+    rounds_to_target: np.ndarray  # int[K], -1 where never reached
+    target: float
+
+    def summaries(self):
+        out = []
+        for i, f in enumerate(self.faults):
+            ch = f.churn
+            out.append({
+                "scenario": {"events": list(map(list, ch.events)),
+                             "partitions": list(map(list,
+                                                    ch.partitions)),
+                             "ramp": (list(ch.ramp)
+                                      if ch.ramp else None),
+                             "drop_prob": f.drop_prob},
+                "rounds_to_target": int(self.rounds_to_target[i]),
+                "converged": bool(self.rounds_to_target[i] >= 0),
+                "final_coverage": float(self.curves[i, -1]),
+                "msgs_total": float(self.msgs[i, -1]),
+            })
+        return out
+
+
+def fused_churn_sweep_curves(n: int, rumors: int, run: RunConfig,
+                             faults, mesh, fanout: int = 1,
+                             interpret: bool = False,
+                             timing=None) -> FusedChurnSweepResult:
+    """Run K nemesis SCENARIOS — distinct churn/partition/ramp fault
+    programs — through the plane-sharded FUSED Pallas engine for the
+    cost of ONE compile.  The fused scenario batch amortizes by
+    EXECUTABLE REUSE, not vmap: the memoized fused curve scan
+    (parallel/sharded_fused._cached_curve_scan) keys WITHOUT the fault
+    config — every scenario's schedule lowers to runtime operands (the
+    per-round alive words, the partition cut table rendered to
+    side-word masks in-trace, and the 20-bit drop-threshold table the
+    SMEM scalar is indexed from) — so scenario 0 compiles the loop and
+    scenarios 1..K-1 re-enter the same executable (compile-count
+    pinned in tests/test_sharded_fused.py; a vmapped scenario axis is
+    not a lowering the plane-sharded pallas_call program has, and the
+    plane axis already occupies the mesh).
+
+    Every fault must carry a churn schedule and the STATIC fault
+    structure must match across the stack (the churn_sweep_curves
+    contract: ``drop_prob`` may vary freely — it only moves the
+    threshold table).  Scenario k's curve IS the solo
+    ``simulate_curve_sharded_fused(..., fault=faults[k])`` run — the
+    sweep calls exactly that driver, so per-scenario bitwise solo
+    parity holds by construction (still pinned in tests, against
+    drift).  ``timing`` (utils/trace contract) decomposes scenario 0
+    only — the compile-bearing entry; later scenarios are steady
+    re-entries by definition."""
+    from gossip_tpu.parallel.sharded_fused import (
+        simulate_curve_sharded_fused)
+    faults = tuple(faults)
+    if not faults:
+        raise ValueError("need at least one churn FaultConfig")
+    for f in faults:
+        if NE.get(f) is None:
+            raise ValueError(
+                "fused churn sweep scenarios must each carry a churn "
+                "schedule (static-only faults run the plain fused "
+                "curve driver)")
+        NE.check_supported(f, engine="fused-planes")
+    statics = {dataclasses.replace(f, churn=None, drop_prob=0.0)
+               for f in faults}
+    if len(statics) > 1:
+        raise ValueError(
+            "churn sweep scenarios must share the STATIC fault "
+            "structure (node_death_rate/seed/dead_nodes select the "
+            "mask operand layout); vary the churn schedule and "
+            "drop_prob only")
+    curves = []
+    for i, f in enumerate(faults):
+        covs, _ = simulate_curve_sharded_fused(
+            n, rumors, run, mesh, fanout=fanout, fault=f,
+            interpret=interpret, timing=timing if i == 0 else None)
+        curves.append(np.asarray(covs))
+    curves = np.stack(curves)
+    per_round = 2.0 * fanout * n
+    msgs = np.broadcast_to(
+        per_round * np.arange(1, run.max_rounds + 1, dtype=np.float32),
+        curves.shape).copy()
+    return FusedChurnSweepResult(
+        faults=faults, curves=curves, msgs=msgs,
+        rounds_to_target=_rounds_to_target(curves,
+                                           run.target_coverage),
+        target=run.target_coverage)
+
+
 # ---------------------------------------------------------------------------
 # Request-batched serving (the admission batcher's megabatch driver,
 # rpc/batcher): K heterogeneous REQUESTS — distinct (mode, fanout-shared,

@@ -160,7 +160,15 @@ def classify_run(args):
         # than the XLA megabatch); batching such a request would
         # silently break the bitwise solo-dispatch contract, so it
         # falls through to the solo path (labeled).  On CPU this is
-        # never true and auto requests batch normally.
+        # never true and auto requests batch normally.  Since the
+        # fused-operand PR this fall-through is also CHEAP for
+        # fault-bearing sweeps: the solo fused drivers consume the
+        # drop threshold and fault masks as runtime operands, so a
+        # client sweeping drop rates / death rates over auto re-enters
+        # one fused executable instead of paying a Mosaic recompile
+        # per scenario — the batcher no longer needs to steer such
+        # sweeps away from the fused route for compile-amortization
+        # reasons (only the bitwise contract keeps them solo).
         from gossip_tpu.backend import _fused_auto_ok
         if _fused_auto_ok(proto, tc, fault):
             return None, "engine=auto routes to the fused engine", None

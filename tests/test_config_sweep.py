@@ -74,6 +74,13 @@ def test_2d_pod_sweep_matches_1d_batch(family):
                                   solo.rounds_to_target)
 
 
+# ~5.4 s (flight data, the fused-operand-PR rebalance): the 8-config
+# convergence OUTCOMES are depth — the one-program property and the
+# per-point trajectory semantics stay in-gate via
+# test_bitwise_parity_with_solo_round, test_pure_grid_elides_other_half
+# and the compile-cache sweep pins; the full 8-config convergence grid
+# re-proves under -m slow
+@pytest.mark.slow
 def test_eight_configs_one_program_all_converge():
     topo = G.complete(2048)
     run = RunConfig(seed=0, max_rounds=64, target_coverage=0.99)

@@ -302,13 +302,28 @@ def test_fused_planes_resume_under_churn_events_bitwise(tmp_path):
                                   np.asarray(res.table))
     assert cov_f == cov_r
 
-    # partitions/ramps stay genuinely impossible on this engine
-    with pytest.raises(ValueError, match="partition"):
-        leg_fault = FaultConfig(seed=1, churn=ChurnConfig(
-            partitions=((0, 3, 32),)))
-        checkpointed_fused_planes(
-            _N, 2, RunConfig(seed=0, max_rounds=4), mesh,
-            str(tmp_path / "rej.npz"), interpret=True, fault=leg_fault)
+    # partitions and ramps run on this engine since the fused-operand
+    # PR (per-round cut masks + the threshold table behind the SMEM
+    # scalar) — the checkpointed segments index them by the ABSOLUTE
+    # round cursor, so resume under the FULL schedule is bitwise too
+    full_fault = FaultConfig(seed=1, drop_prob=0.05, churn=ChurnConfig(
+        events=((3, 2, 5),), partitions=((1, 6, 32),),
+        ramp=(0, 4, 0.0, 0.3)))
+
+    def fleg(name, rounds, resume_state=None):
+        return checkpointed_fused_planes(
+            _N, 2, RunConfig(seed=0, max_rounds=rounds), mesh,
+            str(tmp_path / name), every=3, interpret=True,
+            fault=full_fault, resume_state=resume_state)
+
+    ffull, fcov, _ = fleg("pfull.npz", 8)
+    fleg("phalf.npz", 4)
+    fres, fcov_r, _ = fleg("phalf.npz", 8,
+                           resume_state=load_state(
+                               str(tmp_path / "phalf.npz")))
+    np.testing.assert_array_equal(np.asarray(ffull.table),
+                                  np.asarray(fres.table))
+    assert fcov == fcov_r
 
 
 # depth tier (tier-1 wall budget, serving-PR rebalance): the churn-
@@ -445,11 +460,15 @@ def _pinned():
         return json.load(f)["digests"]
 
 
-@pytest.mark.parametrize("name", ["ckpt_si"])
+@pytest.mark.parametrize("name", ["ckpt_si", "ckpt_fused"])
 def test_checkpointed_static_fingerprints_fast(name):
     """In-gate subset: the single-device SI surface smokes the
-    re-plumbed run_with_checkpoints against its pre-lift digest.  The
-    full five-surface matrix runs under -m slow below."""
+    re-plumbed run_with_checkpoints against its pre-lift digest, and
+    the fused-planes surface guards the STATIC fused trajectory
+    (drop_prob=0.05 — the drop threshold rides the SMEM scalar operand
+    since the fused-operand PR, and this digest proves the promotion
+    is value-preserving bit for bit).  The full five-surface matrix
+    runs under -m slow below."""
     runner = CS.CHECKPOINTED_SURFACES[name]
     assert runner(CS._static_fault()) == _pinned()[f"ckpt-static:{name}"]
 

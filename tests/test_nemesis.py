@@ -392,45 +392,41 @@ def test_unsupported_engines_reject_loudly():
     with pytest.raises(ValueError, match="partition"):
         make_swim_round(wproto, 64, fault=part)
     make_swim_round(wproto, 64, fault=ramp)       # accepted now
-    # fused planes: events only (driver entry raises pre-compile); the
-    # ramp message must name the REMAINING genuinely-impossible reason
-    # — the Pallas kernel's compile-time threshold — not the stale
-    # claim that the XLA paths bake
+    # fused planes run the FULL schedule since the fused-operand PR:
+    # partition windows lower to per-round side-word cut masks and
+    # drop-rate ramps index the 20-bit threshold table behind the SMEM
+    # scalar — the two rejection rows are DELETED, not special-cased
+    # (tests/test_sharded_fused.py pins the semantics; here the driver
+    # entries must simply accept what they used to refuse)
     from gossip_tpu.parallel.sharded_fused import (
         make_plane_mesh, simulate_until_sharded_fused)
-    with pytest.raises(ValueError, match="partition"):
-        simulate_until_sharded_fused(
+    for fch in (part, ramp):
+        rounds_f, _, _, _ = simulate_until_sharded_fused(
             128 * 8, 40, RunConfig(seed=0, max_rounds=2),
-            make_plane_mesh(4), interpret=True, fault=part)
-    with pytest.raises(ValueError, match="Pallas kernel"):
-        simulate_until_sharded_fused(
-            128 * 8, 40, RunConfig(seed=0, max_rounds=2),
-            make_plane_mesh(4), interpret=True, fault=ramp)
+            make_plane_mesh(4), interpret=True, fault=fch)
+        assert rounds_f == 2
     # checkpointed drivers came OFF the rejection list (crash-safety
     # PR): churn runs in the segments with bitwise resume
     # (tests/test_crash_safety.py pins every surface); only the engines
     # above remain on events=False
-    # the fused ENGINE routing sends churn back to the XLA kernels
-    # (its single-device paths predate the churn denominator) — EXCEPT
-    # the plane-stack checkpointed route, which runs events and
-    # refuses partitions/ramps with the genuinely-impossible reason
+    # the fused ENGINE routing still sends churn back to the XLA
+    # kernels single-device (those paths predate the churn
+    # denominator) — the plane-stack route (checkpointed CLI,
+    # churn-sweep --engine fused) accepts the full schedule: events,
+    # partitions, AND ramps
     from gossip_tpu.backend import _fused_ineligible_reason
     from gossip_tpu.config import TopologyConfig
     fproto = ProtocolConfig(mode=C.PULL, fanout=1, rumors=1)
     ftc = TopologyConfig(family="complete", n=64)
     reason = _fused_ineligible_reason(fproto, ftc, ev, 1)
     assert reason and "churn" in reason
-    # events pass the plane-stack churn gate: any remaining reason is
-    # a later precondition (on CPU, the platform probe), never churn
-    reason = _fused_ineligible_reason(fproto, ftc, ev, 1,
-                                      plane_stack=True)
-    assert reason is None or "churn" not in reason
-    reason = _fused_ineligible_reason(fproto, ftc, part, 1,
-                                      plane_stack=True)
-    assert reason and "partition" in reason
-    reason = _fused_ineligible_reason(fproto, ftc, ramp, 1,
-                                      plane_stack=True)
-    assert reason and "ramp" in reason
+    # every schedule class passes the plane-stack churn gate: any
+    # remaining reason is a later precondition (on CPU, the platform
+    # probe), never churn/partition/ramp
+    for fch in (ev, part, ramp):
+        reason = _fused_ineligible_reason(fproto, ftc, fch, 1,
+                                          plane_stack=True)
+        assert reason is None or "TPU" in reason
 
 
 # -- SWIM churn timeline ----------------------------------------------
@@ -896,6 +892,19 @@ def test_cli_churn_sweep_command(capsys):
     assert cli.main(["churn-sweep", "--n", "64", "--devices", "3",
                      "--scenario", "event=3:2:5"]) == 2
     assert "do not divide" in capsys.readouterr().err
+    # --engine fused: plane-stack eligibility is checked up front with
+    # the ONE reason list (backend._fused_ineligible_reason) — on the
+    # CPU tier the platform probe refuses cleanly before any driver
+    # work (the fused sweep machinery itself is pinned on the virtual
+    # mesh in tests/test_sharded_fused.py); a non-pull mode names the
+    # mode reason first
+    assert cli.main(["churn-sweep", "--n", "64", "--engine", "fused",
+                     "--mode", "pull",
+                     "--scenario", "event=3:2:5"]) == 2
+    assert "TPU" in capsys.readouterr().err
+    assert cli.main(["churn-sweep", "--n", "64", "--engine", "fused",
+                     "--scenario", "event=3:2:5"]) == 2
+    assert "pull" in capsys.readouterr().err
 
 
 def test_cli_churn_parse():

@@ -95,6 +95,19 @@ def test_plane_sharing_validation():
     with pytest.raises(ValueError, match="drop coin"):
         fused_pull_round(t, 0, 0, 4096 * 8, 1, interpret=not ON_TPU,
                          drop_threshold=1000, plane_sharing=2)
+    # still loud with the threshold as a runtime operand: a partition
+    # side mask overlaps the pair split the same way the drop coin does
+    from gossip_tpu.ops.pallas_round import render_cut_bits
+    with pytest.raises(ValueError, match="drop coin"):
+        fused_pull_round(t, 0, 0, 4096 * 8, 1, interpret=not ON_TPU,
+                         cut_words=render_cut_bits(64, 4096 * 8),
+                         plane_sharing=2)
+    # a TRACED threshold cannot be proven zero at trace time — rejected
+    # outright (a silently correlated drop stream would be worse)
+    with pytest.raises(ValueError, match="traced"):
+        fused_pull_round(t, 0, 0, 4096 * 8, 1, interpret=not ON_TPU,
+                         drop_threshold=jnp.int32(104858),
+                         plane_sharing=2)
 
 
 def test_pack_unpack_roundtrip():

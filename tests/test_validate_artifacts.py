@@ -162,3 +162,25 @@ def test_crashloop_artifacts_must_be_attributable(tmp_path):
     with telemetry.Ledger(str(good)) as led:
         led.event("verdict", ok=True, kills=3)
     assert va.validate_file(str(good)) == []
+
+
+def test_fused_sweep_artifacts_must_be_attributable(tmp_path):
+    """A ``*fused_sweep*`` artifact without provenance fails — the
+    fused engine's compile-amortization record
+    (tools/fused_sweep_capture.py) is performance evidence and can
+    never be grandfathered, jsonl or json alike."""
+    bad = tmp_path / "ledger_fused_sweep_r99.jsonl"
+    bad.write_text(json.dumps({"ev": "fused_sweep_record", "ok": True})
+                   + "\n")
+    problems = va.validate_file(str(bad))
+    assert any("provenance" in p for p in problems), problems
+
+    badj = tmp_path / "fused_sweep_summary_r99.json"
+    badj.write_text(json.dumps({"ok": True}))
+    problems = va.validate_file(str(badj))
+    assert any("provenance" in p for p in problems), problems
+
+    good = tmp_path / "ledger_fused_sweep_r98.jsonl"
+    with telemetry.Ledger(str(good)) as led:
+        led.event("fused_sweep_record", ok=True, warm_ratio=4.0)
+    assert va.validate_file(str(good)) == []

@@ -8,14 +8,14 @@ window).  The outer timeout must cover the sum of ALL per-step
 subprocess timeouts at their worst; ``worst_case_budget_s()`` below
 computes it from the same constants the steps use (at the default
 GOSSIP_BENCH_PROBE_ATTEMPTS=3 it is ~2100 (swim A/B) + 1500 (kernel
-numbers) + 1200 (mr) + 900 (prng) + 1200 (roofline) + 2400 (sweep) +
-1800 (swim ablation) + 2700 (ensembles) + ~6020 (bench worst case) +
-2400 (pallas tests) = ~22,220 s):
+numbers) + 1200 (mr) + 900 (prng) + 1200 (fused sweep) + 1200
+(roofline) + 2400 (sweep) + 1800 (swim ablation) + 2700 (ensembles) +
+~6020 (bench worst case) + 2400 (pallas tests) = ~23,420 s):
 
-    timeout 22800 python tools/hw_refresh.py      # default attempts
+    timeout 24000 python tools/hw_refresh.py      # default attempts
     python tools/hw_refresh.py --smoke            # CPU-scale rehearsal
 
-``--smoke`` runs the SAME ten-step pipeline at CPU scale on the
+``--smoke`` runs the SAME eleven-step pipeline at CPU scale on the
 hermetic env (plugin disarmed, 8 virtual devices, interpreter-mode
 kernels, sweep --scale 0.002, single fast bench probe) writing
 ``.smoke``-infixed artifacts — a rehearsal of every subprocess,
@@ -33,6 +33,9 @@ important captures first):
   4. staged big-table MR kernel validation at 10M x 32 rumors
      (post-padding variant) + per-round timing
   5. hardware-PRNG digest of the plane-sharded fused round
+  5b. fused churn sweep: K mixed fault scenarios through ONE fused
+     executable, solo-recompile vs warm ratio on real Mosaic kernels
+     -> artifacts/ledger_fused_sweep_r17.jsonl (fused-operand PR)
   6. roofline: utilization vs first-principles floors, both fused
      layouts -> artifacts/roofline_r05.json  (task 3)
   7. the five BASELINE configs at full scale, SWIM row under the
@@ -59,6 +62,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 MR_TIMEOUT_S = 1200
 PRNG_TIMEOUT_S = 900
+FUSED_SWEEP_TIMEOUT_S = 1200
 SWEEP_TIMEOUT_S = 2400
 TESTS_TIMEOUT_S = 2400
 BENCH_SLACK_S = 200
@@ -140,7 +144,8 @@ def worst_case_budget_s():
     (bench's own worst case is computed by bench.py from its probe/body
     constants)."""
     return (swim_ab_budget_s() + KERNEL_NUMBERS_TIMEOUT_S + MR_TIMEOUT_S
-            + PRNG_TIMEOUT_S + ROOFLINE_TIMEOUT_S + SWEEP_TIMEOUT_S
+            + PRNG_TIMEOUT_S + FUSED_SWEEP_TIMEOUT_S
+            + ROOFLINE_TIMEOUT_S + SWEEP_TIMEOUT_S
             + SWIM_ABLATION_TIMEOUT_S + ENSEMBLES_TIMEOUT_S
             + bench_budget_s() + TESTS_TIMEOUT_S)
 
@@ -389,6 +394,19 @@ def roofline():
     return _run_tool("roofline.py", ROOFLINE_TIMEOUT_S)
 
 
+def fused_churn_sweep():
+    """K mixed nemesis scenarios — events, partition windows, drop
+    ramps — through the plane-sharded fused engine ON THE CHIP: solo
+    (per-scenario Mosaic kernel recompile, the pre-operand cost model)
+    vs warm (one executable, schedule content as runtime operands) —
+    tools/fused_sweep_capture.py.  This is the fused family's first
+    real-hardware fault-scenario measurement; the committed r17 record
+    is the CPU reference-lowering structure proof, and this leg
+    refreshes the stale r06 CPU-fallback headline with Mosaic
+    numbers."""
+    return _run_tool("fused_sweep_capture.py", FUSED_SWEEP_TIMEOUT_S)
+
+
 def ensembles():
     """The round-4 ensemble surface on hardware via the public CLI
     (VERDICT r4 task 6).  The tool merges sub-captures incrementally;
@@ -582,6 +600,7 @@ STEPS = [("swim_diss_ab", swim_diss_ab),
          ("kernel_numbers", kernel_numbers),
          ("mr_staged_10m", mr_staged_10m),
          ("prng_invariant", prng_invariant),
+         ("fused_churn_sweep", fused_churn_sweep),
          ("roofline", roofline),
          ("baseline_sweep", baseline_sweep),
          ("swim_steady_ablation", swim_steady_ablation),

@@ -121,6 +121,16 @@ def _is_txn_name(name: str) -> bool:
     return "txn" in name or "register" in name
 
 
+def _is_fused_sweep_name(name: str) -> bool:
+    """Fused-sweep artifacts by name — the fused engine's
+    compile-amortization evidence (K scenarios through one executable,
+    warm-vs-solo-recompile ratios — tools/fused_sweep_capture) must
+    always be attributable; the legacy allowlist can never grandfather
+    one in (the fused-operand layer post-dates the provenance
+    schema)."""
+    return "fused_sweep" in name
+
+
 def _is_serving_name(name: str) -> bool:
     """Serving/load artifacts by name — throughput and latency gates
     (the admission-batching layer's committed evidence: requests/sec,
@@ -186,6 +196,12 @@ def validate_file(path):
                     "— isolation-anomaly and LWW-convergence "
                     "evidence must be attributable, allowlist or not "
                     "(utils/telemetry.provenance)")
+            if not has_prov and _is_fused_sweep_name(name):
+                problems.append(
+                    "fused-sweep artifact without a provenance line — "
+                    "compile-amortization evidence must be "
+                    "attributable, allowlist or not "
+                    "(utils/telemetry.provenance)")
         else:
             with open(path) as f:
                 doc = json.load(f)
@@ -211,6 +227,12 @@ def validate_file(path):
                     f"{PROVENANCE_KEYS} — isolation-anomaly and "
                     "LWW-convergence evidence must be attributable, "
                     "allowlist or not")
+            elif _is_fused_sweep_name(name) \
+                    and not _has_provenance_keys(doc):
+                problems.append(
+                    "fused-sweep artifact without provenance keys "
+                    f"{PROVENANCE_KEYS} — compile-amortization "
+                    "evidence must be attributable, allowlist or not")
             elif name not in LEGACY and not _has_provenance_keys(doc):
                 problems.append(
                     "new-format json without provenance keys "
