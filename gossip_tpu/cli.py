@@ -1295,6 +1295,46 @@ def cmd_serve(a) -> int:
     return 0
 
 
+def cmd_route(a) -> int:
+    """Spawn N sidecar replicas and front them with the health-gated
+    failover router (rpc/router, docs/SERVING.md "Fleet")."""
+    from gossip_tpu.config import FleetConfig
+    from gossip_tpu.rpc.router import Fleet, fleet_env
+    try:
+        cfg = FleetConfig(replicas=a.replicas,
+                          probe_interval_ms=a.probe_interval_ms,
+                          down_after=a.down_after, up_after=a.up_after,
+                          max_inflight=a.max_inflight)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    replica_argv = []
+    if a.no_batching:
+        replica_argv.append("--no-batching")
+    fleet = Fleet(cfg=cfg, port=a.port, max_workers=a.workers,
+                  replica_argv=replica_argv,
+                  env=fleet_env(platform=a.replica_platform or None))
+    try:
+        if not fleet.router.wait_healthy(a.replicas, timeout_s=60):
+            # a fleet that never admitted all replicas must not print
+            # a success-looking status line and serve only sheds
+            print(f"error: only {fleet.router.healthy_count()}/"
+                  f"{a.replicas} replicas admitted within 60s (see "
+                  f"the replica logs under {fleet.workdir})",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps({
+            "routing": True, "port": fleet.port,
+            "replicas": [r.address for r in fleet.router.replicas],
+            "healthy": fleet.router.healthy_count()}), flush=True)
+        fleet.server.wait_for_termination()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        fleet.close()
+    return 0
+
+
 def cmd_maelstrom(a) -> int:
     from gossip_tpu.runtime.maelstrom_node import main as node_main
     node_main(["--gossip-interval", str(a.gossip_interval),
@@ -1730,6 +1770,34 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "get RESOURCE_EXHAUSTED")
     _add_cache_flags(p)
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("route",
+                       help="front N sidecar replicas with the "
+                            "health-gated failover router "
+                            "(docs/SERVING.md \"Fleet\")")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="sidecar replica processes to spawn")
+    p.add_argument("--port", type=int, default=50051,
+                   help="router port (replicas pick free ports)")
+    p.add_argument("--workers", type=int, default=16)
+    p.add_argument("--probe-interval-ms", type=float, default=250.0,
+                   help="health-probe cadence per replica")
+    p.add_argument("--down-after", type=int, default=2,
+                   help="consecutive probe failures before a replica "
+                        "leaves rotation")
+    p.add_argument("--up-after", type=int, default=3,
+                   help="consecutive healthy probes before a downed "
+                        "replica re-enters rotation (flap hysteresis)")
+    p.add_argument("--max-inflight", type=int, default=8,
+                   help="per-replica in-flight cap; past it the "
+                        "router sheds with RESOURCE_EXHAUSTED")
+    p.add_argument("--no-batching", action="store_true",
+                   help="disable admission batching in the replicas")
+    p.add_argument("--replica-platform", default="cpu",
+                   help="JAX_PLATFORMS pin for replica children "
+                        "(default cpu: N processes cannot share one "
+                        "TPU; '' inherits the ambient platform)")
+    p.set_defaults(fn=cmd_route)
 
     p = sub.add_parser("maelstrom",
                        help="run the Maelstrom protocol node on stdio")

@@ -832,6 +832,68 @@ class ServingConfig:
             raise ValueError("max_queue must be >= 1")
 
 
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Replicated-serving knobs for the fronting router (rpc/router).
+
+    The router fronts N sidecar replicas, health-probes each on the
+    ``SidecarClient.health`` path, dispatches ``Run``/``Ensemble`` to
+    healthy replicas, and on a replica transport failure re-dispatches
+    the in-flight request to a survivor (safe: requests are
+    deterministic pure functions of their payload — docs/SERVING.md
+    "Fleet").  These are the fleet-shape parameters:
+
+    * ``replicas`` — replica count for spawned fleets (``Fleet`` /
+      CLI ``route``); a router built over explicit addresses takes its
+      count from the address list.
+    * ``probe_interval_ms`` / ``probe_timeout_s`` — health-probe
+      cadence and per-probe deadline; a probe past the deadline counts
+      as a failure.
+    * ``down_after`` — consecutive probe failures before a replica
+      leaves rotation (a dispatch-path transport failure marks it down
+      immediately: a dead connection is definitive).
+    * ``up_after`` — consecutive healthy probes before a DOWNED
+      replica re-enters rotation — the flap hysteresis: a replica
+      alternating healthy/unhealthy can never oscillate back in
+      faster than this (test-pinned).  First-time admission at startup
+      needs only one healthy probe (nothing was lost yet).
+    * ``max_inflight`` — per-replica in-flight dispatch cap; when every
+      healthy replica is at its cap the router SHEDS with
+      RESOURCE_EXHAUSTED + a ``shed`` ledger event (it never queues
+      unboundedly, never silently drops).
+    * ``control_capacity`` — ring capacity of each replica's
+      control-plane log key (ops/logs; committed offset = config
+      epoch); a fleet exceeding it in one run errors loudly rather
+      than alias epochs on a ring wrap.
+    """
+
+    replicas: int = 2
+    probe_interval_ms: float = 250.0
+    probe_timeout_s: float = 2.0
+    down_after: int = 2
+    up_after: int = 3
+    max_inflight: int = 8
+    control_capacity: int = 64
+
+    def __post_init__(self):
+        if self.replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        if self.probe_interval_ms <= 0:
+            raise ValueError("probe_interval_ms must be > 0")
+        if self.probe_timeout_s <= 0:
+            raise ValueError("probe_timeout_s must be > 0")
+        if self.down_after < 1:
+            raise ValueError("down_after must be >= 1")
+        if self.up_after < 1:
+            raise ValueError("up_after must be >= 1")
+        if self.max_inflight < 1:
+            raise ValueError("max_inflight must be >= 1")
+        if self.control_capacity < 4:
+            # the LogConfig floor: an unscripted log config must hold
+            # the default send program's ring
+            raise ValueError("control_capacity must be >= 4")
+
+
 EXCHANGES = ("dense", "sparse", "halo")
 
 

@@ -286,10 +286,6 @@ class Batcher:
     # -- admission -----------------------------------------------------
 
     def _admit(self, pending: _Pending) -> _Pending:
-        if self._stop.is_set():
-            # no collector will ever drain again — refuse instead of
-            # stranding the handler on an event nobody will set
-            raise Closed("sidecar batcher is shut down")
         if len(pending.specs) > self.cfg.max_batch:
             # an oversized request can NEVER be scheduled (every tick
             # would defer it back to the leftovers) — refuse at
@@ -299,6 +295,16 @@ class Batcher:
                 f"but max_batch is {self.cfg.max_batch}; split the "
                 "ensemble or raise the server's batch cap")
         with self._lock:
+            # the stop check lives INSIDE the queue lock (shutdown-race
+            # pin, tests/test_serving.py): close() sets the flag and
+            # THEN flushes, so any admission serialized after the flag
+            # refuses here with Closed (-> UNAVAILABLE) while any
+            # admission serialized before it is already in the queue
+            # the final drain flushes — a draining replica rejects new
+            # work BEFORE flushing queued work, and no request can
+            # land in a queue nobody will ever drain again
+            if self._stop.is_set():
+                raise Closed("sidecar batcher is shut down")
             depth = sum(len(p.specs) for _, p in self._queue)
             if depth + len(pending.specs) > self.cfg.max_queue:
                 from gossip_tpu.utils import telemetry
@@ -336,11 +342,18 @@ class Batcher:
     # -- collector -----------------------------------------------------
 
     def close(self):
+        """Drain ordering (the shutdown-race pin): set the stop flag
+        FIRST — from this point every admission that reaches the
+        in-lock check refuses with Closed/UNAVAILABLE — and flush the
+        queued work SECOND.  Rejecting before flushing is what makes a
+        router-initiated drain safe: an admission can never be
+        appended after the final drain swapped the queue out, so no
+        request is ever stranded in a closed queue."""
         self._stop.set()
         self._thread.join(timeout=10)
-        # flush any admission that raced the stop flag past the
-        # collector's final drain (its _admit check happened before
-        # the flag was set) — nobody else will ever answer it
+        # flush admissions serialized before the stop flag (their
+        # in-lock check passed, so they are in the queue) — nobody
+        # else will ever answer them
         self._drain_once()
 
     def _loop(self):

@@ -275,10 +275,18 @@ class SidecarClient:
     Transient transport failures (UNAVAILABLE — server starting up,
     connection reset; plus DEADLINE_EXCEEDED for the cheap idempotent
     ``health`` probe only, whose timeout is not workload-dependent)
-    are retried with capped jittered exponential backoff, the
-    runtime/maelstrom_node retry shape (fresh deadline per attempt,
-    ``max_attempts`` overflow guard, no sleep after the last try).
-    Each retry emits an ``rpc_retry`` event on the ambient run ledger
+    are retried with capped jittered exponential backoff
+    (``max_attempts`` overflow guard, no sleep after the last try).
+    The caller's ``timeout`` is the TOTAL retry budget, not a
+    per-attempt allowance: each attempt's deadline is clamped to the
+    remaining budget and an exhausted budget re-raises instead of
+    dispatching again (the fleet-PR contract — previously each attempt
+    got a fresh deadline, so a dying server could stretch one call to
+    attempts x timeout).  Under that rule a probe that consumed its
+    whole budget in a DEADLINE_EXCEEDED is re-raised immediately; the
+    code stays in ``health``'s retryable set for transport stacks that
+    surface it early, with budget to spare.  Each retry emits an
+    ``rpc_retry`` event on the ambient run ledger
     (utils/telemetry.current) so a flaky transport is flight-recorded,
     never silent.  Well-formed error replies are raised immediately."""
 
@@ -304,15 +312,37 @@ class SidecarClient:
                          method: str, retryable=_TRANSIENT_CODES):
         """One RPC with the retry contract above.  ``retryable`` is the
         status-code set that marks a transport (not application)
-        failure."""
+        failure.
+
+        Retry BUDGET: ``timeout`` is the caller's TOTAL wall budget
+        across all attempts, not a per-attempt allowance — every
+        attempt's deadline is clamped to the remaining budget (the
+        last attempt gets exactly what is left, test-pinned), backoff
+        sleeps never overrun it, and a budget exhausted between
+        attempts re-raises the last transport error instead of
+        starting an attempt the caller already gave up on.  Without
+        the clamp a dying replica could stretch one call to
+        ``max_attempts x timeout`` — exactly the stall a fleet
+        failover deadline cannot absorb."""
         import random
         import time as _time
 
         from gossip_tpu.utils import telemetry
+        deadline = (None if timeout is None
+                    else _time.monotonic() + float(timeout))
         for attempt in range(self.max_attempts):
+            attempt_timeout = timeout
+            if deadline is not None:
+                attempt_timeout = deadline - _time.monotonic()
+                if attempt > 0 and attempt_timeout <= 0:
+                    # budget spent by earlier attempts/backoff — the
+                    # caller abandoned this call; surface the last
+                    # transport failure rather than dispatch again
+                    raise last_error
             try:
-                return call(payload, timeout=timeout)
+                return call(payload, timeout=attempt_timeout)
             except grpc.RpcError as e:
+                last_error = e
                 code = e.code() if callable(getattr(e, "code", None)) \
                     else None
                 if code not in retryable \
@@ -323,6 +353,9 @@ class SidecarClient:
                 sleep = (min(self.backoff_base * (2 ** attempt),
                              self.backoff_cap)
                          * (0.5 + random.random()))
+                if deadline is not None:
+                    sleep = min(sleep,
+                                max(0.0, deadline - _time.monotonic()))
                 telemetry.current().event(
                     "rpc_retry", sync=False, method=method,
                     attempt=attempt + 1, code=str(code),

@@ -592,12 +592,21 @@ def _assert_fingerprints(names):
             f"static:{name} moved under the CRDT PR")
 
 
+# depth tier since the fleet-PR rebalance (tier-1 wall budget, ~8 s):
+# the packed-sharded trajectory stays pinned in-gate by the per-mode
+# packed sharded-vs-unpacked parity params (test_packed) and the
+# nemesis dense digest (test_nemesis's in-gate subset), and the CRDT
+# payload parities (gcounter dense + orset packed mesh parity, both
+# in-gate) would surface any fabric move through the payload
+# trajectories; this guard's golden-digest re-proof runs with the
+# full matrix under -m slow
+@pytest.mark.slow
 def test_no_crdt_fabric_fingerprints_unchanged():
     """The CRDT subsystem rides the fabric without moving it: the
     packed-sharded broadcast trajectory — churn AND static — is
     BITWISE the golden digest captured before this PR
     (tests/data/churn_fingerprints_r06.json).  Packed sharded is the
-    in-gate pick because the CRDT payload shares ITS exchange shape
+    pick because the CRDT payload shares ITS exchange shape
     (all_gather of word rows); dense_sharded is already re-verified
     in-gate by test_nemesis, and the rumor/SWIM surfaces run in the
     slow twin below + test_nemesis's full matrix."""

@@ -47,20 +47,24 @@ _tspec.loader.exec_module(readme_table)
 FAMILIES = frozenset({
     "dense_pushpull", "churn_heal", "churn_sweep", "fused_churn_sweep",
     "crdt_counter", "kafka_log", "txn_register", "serving_batch",
-    "packed_pull", "sparse_antientropy", "topo_sparse_antientropy",
-    "swim_rotating", "halo_banded", "fused_planes",
-    "fused_planes_fault_curve", "rumor_sir", "hybrid_2d_sweep"})
-# the committed r16 record predates the fused-operand PR's
-# fused_churn_sweep family; the committed r15 record additionally
-# predates the transactions PR's txn_register family; the committed
-# r14 record additionally predates the replicated-log PR's kafka_log
-# family; the committed r13 record additionally predates the serving
-# PR's serving_batch family; the committed r11 record additionally
-# predates the CRDT PR's crdt_counter family; the committed
-# r07/r08/r09 records additionally predate the compiled-nemesis PR's
-# churn_heal family and the traced-operand PR's churn_sweep family —
-# each pin stays on its historical set
-FAMILIES_PRE_FUSED_SWEEP = FAMILIES - {"fused_churn_sweep"}
+    "fleet_failover", "packed_pull", "sparse_antientropy",
+    "topo_sparse_antientropy", "swim_rotating", "halo_banded",
+    "fused_planes", "fused_planes_fault_curve", "rumor_sir",
+    "hybrid_2d_sweep"})
+# the committed r17 record predates the fleet PR's fleet_failover
+# family; the committed r16 record additionally predates the
+# fused-operand PR's fused_churn_sweep family; the committed r15
+# record additionally predates the transactions PR's txn_register
+# family; the committed r14 record additionally predates the
+# replicated-log PR's kafka_log family; the committed r13 record
+# additionally predates the serving PR's serving_batch family; the
+# committed r11 record additionally predates the CRDT PR's
+# crdt_counter family; the committed r07/r08/r09 records additionally
+# predate the compiled-nemesis PR's churn_heal family and the
+# traced-operand PR's churn_sweep family — each pin stays on its
+# historical set
+FAMILIES_PRE_FLEET = FAMILIES - {"fleet_failover"}
+FAMILIES_PRE_FUSED_SWEEP = FAMILIES_PRE_FLEET - {"fused_churn_sweep"}
 FAMILIES_PRE_TXN = FAMILIES_PRE_FUSED_SWEEP - {"txn_register"}
 FAMILIES_PRE_LOG = FAMILIES_PRE_TXN - {"kafka_log"}
 FAMILIES_PRE_SERVING = FAMILIES_PRE_LOG - {"serving_batch"}
@@ -447,12 +451,25 @@ def test_committed_r16_4dev_record_carries_txn_register():
 
 def test_committed_r17_4dev_record_carries_fused_churn_sweep():
     """The fused-operand PR's committed 4-device record
-    (artifacts/ledger_dryrun_r17_4dev.jsonl, the ledger_diff gate
-    baseline since r17): cold+warm pair, FULL current family set —
-    fused_churn_sweep included — warm run all-hit, steady and warm
-    budgets held, >= 3x warm-start aggregate, provenance present."""
+    (artifacts/ledger_dryrun_r17_4dev.jsonl): cold+warm pair on its
+    historical family set — fused_churn_sweep included, fleet_failover
+    not yet.  (The live ledger_diff gate baseline moved to the r18
+    record below when the fleet PR grew the family set.)"""
     _assert_cold_warm_record(
         os.path.join(_REPO, "artifacts", "ledger_dryrun_r17_4dev.jsonl"),
+        FAMILIES_PRE_FLEET)
+
+
+def test_committed_r18_4dev_record_carries_fleet_failover():
+    """The fleet PR's committed 4-device record
+    (artifacts/ledger_dryrun_r18_4dev.jsonl, the ledger_diff gate
+    baseline since r18): cold+warm pair, FULL current family set —
+    fleet_failover included (a LIVE in-process router fleet with a
+    hard replica stop runs inside every dry run) — warm run all-hit,
+    steady and warm budgets held, >= 3x warm-start aggregate,
+    provenance present."""
+    _assert_cold_warm_record(
+        os.path.join(_REPO, "artifacts", "ledger_dryrun_r18_4dev.jsonl"),
         FAMILIES)
 
 

@@ -22,12 +22,12 @@ _spec.loader.exec_module(ledger_diff)
 R09_4DEV = os.path.join(_REPO, "artifacts",
                         "ledger_dryrun_r09_4dev.jsonl")
 R09_8DEV = os.path.join(_REPO, "artifacts", "ledger_dryrun_r09.jsonl")
-# the fused-operand PR's 4-device record: same family set as the
-# live dry run (churn_heal, churn_sweep, crdt_counter, serving_batch,
-# kafka_log, txn_register AND fused_churn_sweep included), so the
-# tier-1 gate compares every family like-for-like
-R17_4DEV = os.path.join(_REPO, "artifacts",
-                        "ledger_dryrun_r17_4dev.jsonl")
+# the fleet PR's 4-device record: same family set as the live dry run
+# (churn_heal, churn_sweep, crdt_counter, serving_batch, kafka_log,
+# txn_register, fused_churn_sweep AND fleet_failover included), so
+# the tier-1 gate compares every family like-for-like
+R18_4DEV = os.path.join(_REPO, "artifacts",
+                        "ledger_dryrun_r18_4dev.jsonl")
 
 
 def _write_run(path, families, device_count=4, metrics=None,
@@ -214,10 +214,10 @@ def test_committed_4dev_record_vs_fresh_dryrun_is_clean(dryrun_pair,
     against this session's live warm dry run (same device count, same
     machine class) must come back clean — walls within threshold+floor,
     budgets held, protocol totals compared at equal device count.
-    Since the fused-operand PR the committed record is r17, whose
-    family set includes churn_heal, churn_sweep, crdt_counter,
-    serving_batch, kafka_log, txn_register AND fused_churn_sweep, so
-    the new fused-sweep family's walls gate like every other family.
+    Since the fleet PR the committed record is r18, whose family set
+    includes churn_heal, churn_sweep, crdt_counter, serving_batch,
+    kafka_log, txn_register, fused_churn_sweep AND fleet_failover, so
+    the new fleet family's walls gate like every other family.
 
     Thresholds are calibrated to this container's measured noise: a
     full-suite run swings individual families' warm FIRST-call walls
@@ -235,7 +235,7 @@ def test_committed_4dev_record_vs_fresh_dryrun_is_clean(dryrun_pair,
     own absolute budget check — which never flaked — flags it.  The
     first_ms wall mechanism itself stays pinned on the synthetic
     fixtures above and the injected-regression test below."""
-    rc = ledger_diff.main([R17_4DEV,
+    rc = ledger_diff.main([R18_4DEV,
                            dryrun_pair["warm"]["ledger_path"],
                            "--first-floor-ms", "10000",
                            "--steady-floor-ms", "150"])
@@ -245,7 +245,7 @@ def test_committed_4dev_record_vs_fresh_dryrun_is_clean(dryrun_pair,
     # every family joined — nothing fell out as an only-in-one note
     assert "crdt_counter" in out and "serving_batch" in out
     assert "kafka_log" in out and "txn_register" in out
-    assert "fused_churn_sweep" in out
+    assert "fused_churn_sweep" in out and "fleet_failover" in out
     assert "only in" not in out
     # the metric join actually engaged (same device count, fused
     # drivers instrumented in both)
@@ -260,18 +260,18 @@ def test_committed_record_with_injected_2x_wall_is_flagged(tmp_path,
     calibration that forgives uniform host load, proving the
     thresholds catch a real regression, not just synthetic
     fixtures."""
-    events = telemetry.load_ledger(R17_4DEV)
+    events = telemetry.load_ledger(R18_4DEV)
     runs = [e["run"] for e in events if e.get("ev") == "provenance"]
     warm = runs[-1]
     doubled = str(tmp_path / "doubled.jsonl")
-    # hybrid_2d_sweep carries the record's largest warm first-call
-    # wall, so its doubled delta clears a 1000 ms floor — the
-    # injection proves the wall mechanism fires on REAL committed data
-    # at a noise-hardened floor (the tier-1 like-for-like gate above
-    # goes further and hands first_ms detection to the cache-verdict
-    # assertions entirely; this pin keeps the wall path honest for
-    # manual/CLI use)
-    with open(R17_4DEV) as f, open(doubled, "w") as g:
+    # hybrid_2d_sweep carries one of the record's largest warm
+    # first-call walls, so its doubled delta clears a 1000 ms floor —
+    # the injection proves the wall mechanism fires on REAL committed
+    # data at a noise-hardened floor (the tier-1 like-for-like gate
+    # above goes further and hands first_ms detection to the
+    # cache-verdict assertions entirely; this pin keeps the wall path
+    # honest for manual/CLI use)
+    with open(R18_4DEV) as f, open(doubled, "w") as g:
         for line in f:
             if not line.strip():
                 continue
@@ -282,7 +282,7 @@ def test_committed_record_with_injected_2x_wall_is_flagged(tmp_path,
                     if isinstance(e.get(k), (int, float)):
                         e[k] = 2 * e[k]
             g.write(json.dumps(e) + "\n")
-    rc = ledger_diff.main([R17_4DEV, doubled, "--first-floor-ms",
+    rc = ledger_diff.main([R18_4DEV, doubled, "--first-floor-ms",
                            "1000", "--steady-floor-ms", "150"])
     out = capsys.readouterr().out
     assert rc == 1

@@ -164,6 +164,31 @@ def test_crashloop_artifacts_must_be_attributable(tmp_path):
     assert va.validate_file(str(good)) == []
 
 
+def test_fleet_artifacts_must_be_attributable(tmp_path):
+    """A ``*fleet*``/``*router*``/``*failover*`` artifact without
+    provenance fails — the replicated-serving crashloop record
+    (rpc/router + tools/fleet_crashloop) is robustness evidence and
+    can never be grandfathered, jsonl or json alike."""
+    for name in ("ledger_fleet_r99.jsonl", "router_caps_r99.jsonl",
+                 "failover_trace_r99.jsonl"):
+        bad = tmp_path / name
+        bad.write_text(json.dumps({"ev": "verdict", "ok": True})
+                       + "\n")
+        problems = va.validate_file(str(bad))
+        assert any("provenance" in p for p in problems), (name,
+                                                         problems)
+
+    badj = tmp_path / "fleet_summary_r99.json"
+    badj.write_text(json.dumps({"ok": True}))
+    problems = va.validate_file(str(badj))
+    assert any("provenance" in p for p in problems), problems
+
+    good = tmp_path / "ledger_fleet_r98.jsonl"
+    with telemetry.Ledger(str(good)) as led:
+        led.event("verdict", ok=True, kills=2)
+    assert va.validate_file(str(good)) == []
+
+
 def test_fused_sweep_artifacts_must_be_attributable(tmp_path):
     """A ``*fused_sweep*`` artifact without provenance fails — the
     fused engine's compile-amortization record

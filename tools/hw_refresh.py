@@ -145,7 +145,7 @@ def worst_case_budget_s():
     constants)."""
     return (swim_ab_budget_s() + KERNEL_NUMBERS_TIMEOUT_S + MR_TIMEOUT_S
             + PRNG_TIMEOUT_S + FUSED_SWEEP_TIMEOUT_S
-            + ROOFLINE_TIMEOUT_S + SWEEP_TIMEOUT_S
+            + FLEET_TIMEOUT_S + ROOFLINE_TIMEOUT_S + SWEEP_TIMEOUT_S
             + SWIM_ABLATION_TIMEOUT_S + ENSEMBLES_TIMEOUT_S
             + bench_budget_s() + TESTS_TIMEOUT_S)
 
@@ -407,6 +407,19 @@ def fused_churn_sweep():
     return _run_tool("fused_sweep_capture.py", FUSED_SWEEP_TIMEOUT_S)
 
 
+def fleet_failover():
+    """The replicated serving fleet's crashloop on this host
+    (tools/fleet_crashloop.py): the load mix through the fronting
+    router, seeded mid-load replica SIGKILLs, zero acked-request loss
+    + bitwise failover parity + recovery gates, refreshing the
+    committed fleet record.  Replica children pin JAX_PLATFORMS=cpu by
+    design — N replica processes cannot share one TPU, and the fleet
+    contract is a bitwise-trajectory structure, not a chip rate — so
+    this step certifies the serving layer survives its nemesis on the
+    same host the hardware captures run on."""
+    return _run_tool("fleet_crashloop.py", FLEET_TIMEOUT_S)
+
+
 def ensembles():
     """The round-4 ensemble surface on hardware via the public CLI
     (VERDICT r4 task 6).  The tool merges sub-captures incrementally;
@@ -595,12 +608,15 @@ def tpu_pallas_tests():
 # five-config sweep (which picks up the A/B winner), then the test tier.
 # A window that closes mid-run lands the most important steps first;
 # retries are incremental (pending steps only).
+FLEET_TIMEOUT_S = 1200
+
 STEPS = [("swim_diss_ab", swim_diss_ab),
          ("bench", bench),
          ("kernel_numbers", kernel_numbers),
          ("mr_staged_10m", mr_staged_10m),
          ("prng_invariant", prng_invariant),
          ("fused_churn_sweep", fused_churn_sweep),
+         ("fleet_failover", fleet_failover),
          ("roofline", roofline),
          ("baseline_sweep", baseline_sweep),
          ("swim_steady_ablation", swim_steady_ablation),

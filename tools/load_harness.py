@@ -83,6 +83,23 @@ def request_mix(n=256, rounds=16, fanout=2, repeats=8, seed0=0):
     return reqs
 
 
+def distinct_requests(requests):
+    """One request per distinct compiled SHAPE: everything except the
+    ``run`` block keys the executable (seeds/targets are runtime
+    operands).  The ONE definition of that assumption — the solo
+    warmup, the fleet-leg warmup, and tools/fleet_crashloop.py all
+    dedup through it, so a future shape-affecting field cannot leave
+    one of them cold-compiling inside a measured window."""
+    seen, out = set(), []
+    for req in requests:
+        sig = json.dumps({k: v for k, v in req.items()
+                          if k != "run"}, sort_keys=True)
+        if sig not in seen:
+            seen.add(sig)
+            out.append(req)
+    return out
+
+
 def _warm_megabatch(requests, serving_cfg):
     """Compile every (batch-key, pow2-lane-bucket) megabatch executable
     the ticks can form, directly through the driver — steady-state
@@ -115,13 +132,19 @@ def _warm_megabatch(requests, serving_cfg):
     return sorted(by_key, key=str)
 
 
-def run_leg(label, requests, workers, serving_cfg, timeout_s, led):
+def run_leg(label, requests, workers, serving_cfg, timeout_s, led,
+            address=None):
     """One measured leg: serve in-process, replay the mix from
-    ``workers`` concurrent client threads, return (summary, replies)."""
+    ``workers`` concurrent client threads, return (summary, replies).
+    ``address`` targets an ALREADY-RUNNING server (the fleet-router
+    leg) instead of spinning an in-process sidecar."""
     from gossip_tpu.rpc.sidecar import SidecarClient, serve
     from gossip_tpu.utils import telemetry
-    server, port = serve(port=0, max_workers=workers + 4,
-                         batching=serving_cfg)
+    server = port = None
+    if address is None:
+        server, port = serve(port=0, max_workers=workers + 4,
+                             batching=serving_cfg)
+        address = f"127.0.0.1:{port}"
     n_req = len(requests)
     replies = [None] * n_req
     lat_ms = [None] * n_req
@@ -130,7 +153,7 @@ def run_leg(label, requests, workers, serving_cfg, timeout_s, led):
     lock = threading.Lock()
 
     def worker():
-        client = SidecarClient(f"127.0.0.1:{port}", max_attempts=1)
+        client = SidecarClient(address, max_attempts=1)
         while True:
             with lock:
                 i = cursor["i"]
@@ -155,9 +178,10 @@ def run_leg(label, requests, workers, serving_cfg, timeout_s, led):
         t.join()
     wall = time.perf_counter() - t0
     led.event("load_phase", leg=label, phase="measure_end")
-    if server.gossip_batcher is not None:
-        server.gossip_batcher.close()
-    server.stop(grace=None)
+    if server is not None:
+        if server.gossip_batcher is not None:
+            server.gossip_batcher.close()
+        server.stop(grace=None)
     lat = [x for x in lat_ms if x is not None]
     summary = {
         "leg": label, "requests": n_req, "workers": workers,
@@ -218,6 +242,13 @@ def main(argv=None):
     ap.add_argument("--min-ratio", type=float, default=3.0,
                     help="batched/solo rps acceptance (0 disables)")
     ap.add_argument("--timeout-s", type=float, default=300.0)
+    ap.add_argument("--fleet-replicas", type=int, default=0,
+                    help="also run the replica-count leg: the same "
+                         "mix through a fronting router over N "
+                         "spawned sidecar replicas (rpc/router, "
+                         "docs/SERVING.md \"Fleet\") — gates bitwise "
+                         "reply equality vs the solo leg and ledgers "
+                         "a fleet load_leg (0 = off)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny live batch: 2 repeats, 4 workers, no "
                          "throughput gate (equality + all-warm still "
@@ -261,17 +292,12 @@ def main(argv=None):
         # megabatch executables per (key, lane bucket) ---------------
         led.event("load_phase", leg="warmup", phase="start")
         from gossip_tpu.backend import request_to_args, run_simulation
-        seen_cfg = set()
-        for req in requests:
-            sig = json.dumps({k: v for k, v in req.items()
-                              if k != "run"}, sort_keys=True)
-            if sig in seen_cfg:
-                continue
-            seen_cfg.add(sig)
+        distinct = distinct_requests(requests)
+        for req in distinct:
             run_simulation(**request_to_args(dict(req)))
         keys = _warm_megabatch(requests, serving)
         led.event("load_phase", leg="warmup", phase="end",
-                  distinct_configs=len(seen_cfg),
+                  distinct_configs=len(distinct),
                   batch_keys=len(keys))
 
         solo, solo_replies = run_leg("solo", requests, args.workers,
@@ -279,6 +305,47 @@ def main(argv=None):
         batched, batched_replies = run_leg("batched", requests,
                                            args.workers, serving,
                                            args.timeout_s, led)
+
+        fleet_ok = True
+        if args.fleet_replicas > 0:
+            from gossip_tpu.config import FleetConfig
+            from gossip_tpu.rpc.router import Fleet, fleet_env
+            from gossip_tpu.rpc.sidecar import SidecarClient
+            fleet = Fleet(
+                cfg=FleetConfig(replicas=args.fleet_replicas,
+                                max_inflight=max(8, args.workers)),
+                env=fleet_env(), max_workers=args.workers + 4)
+            try:
+                if not fleet.router.wait_healthy(args.fleet_replicas,
+                                                 timeout_s=60):
+                    raise SystemExit("fleet never reached full "
+                                     "health")
+                # warm each replica directly (the router steers
+                # serial traffic at the least-loaded replica)
+                for r in fleet.router.replicas:
+                    c = SidecarClient(r.address, max_attempts=1)
+                    for req in distinct_requests(requests):
+                        c.run(timeout=args.timeout_s, **req)
+                    c.close()
+                fleet_sum, fleet_replies = run_leg(
+                    f"fleet_r{args.fleet_replicas}", requests,
+                    args.workers, None, args.timeout_s, led,
+                    address=fleet.address)
+                fleet_mismatch = compare_replies(fleet_replies,
+                                                 solo_replies)
+                for m in fleet_mismatch[:10]:
+                    led.event("equality_mismatch", leg="fleet",
+                              detail=m)
+                fleet_ok = (not fleet_mismatch
+                            and not fleet_sum["errors"])
+                led.event("fleet_gate", ok=fleet_ok,
+                          replicas=args.fleet_replicas,
+                          bitwise_equal=not fleet_mismatch,
+                          mismatches=len(fleet_mismatch),
+                          rps=fleet_sum["rps"],
+                          stats=fleet.router.stats())
+            finally:
+                fleet.close()
 
         mismatches = compare_replies(batched_replies, solo_replies)
         for m in mismatches[:10]:
@@ -291,7 +358,7 @@ def main(argv=None):
         ok_ratio = (args.min_ratio <= 0) or (ratio >= args.min_ratio)
         ok = (ok_ratio and not mismatches and compiles == 0
               and not solo["errors"] and not batched["errors"]
-              and coalesced)
+              and coalesced and fleet_ok)
         led.event("serving_gate", ok=ok,
                   throughput_ratio=round(ratio, 2),
                   min_ratio=args.min_ratio, ratio_ok=ok_ratio,

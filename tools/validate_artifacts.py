@@ -131,6 +131,17 @@ def _is_fused_sweep_name(name: str) -> bool:
     return "fused_sweep" in name
 
 
+def _is_fleet_name(name: str) -> bool:
+    """Fleet/router/failover artifacts by name — the replicated-
+    serving evidence (SIGKILLed replicas with zero acked-request loss,
+    bitwise failover replay parity, recovery to full capacity —
+    rpc/router + tools/fleet_crashloop) must always be attributable;
+    the legacy allowlist can never grandfather one in (the whole fleet
+    layer post-dates the provenance schema)."""
+    return ("fleet" in name or "router" in name
+            or "failover" in name)
+
+
 def _is_serving_name(name: str) -> bool:
     """Serving/load artifacts by name — throughput and latency gates
     (the admission-batching layer's committed evidence: requests/sec,
@@ -184,6 +195,12 @@ def validate_file(path):
                     "serving/load artifact without a provenance line "
                     "— throughput/latency gates must be attributable, "
                     "allowlist or not (utils/telemetry.provenance)")
+            if not has_prov and _is_fleet_name(name):
+                problems.append(
+                    "fleet/router/failover artifact without a "
+                    "provenance line — replicated-serving evidence "
+                    "must be attributable, allowlist or not "
+                    "(utils/telemetry.provenance)")
             if not has_prov and _is_log_name(name):
                 problems.append(
                     "replicated-log/kafka artifact without a "
@@ -216,6 +233,12 @@ def validate_file(path):
                     "serving/load artifact without provenance keys "
                     f"{PROVENANCE_KEYS} — throughput/latency gates "
                     "must be attributable, allowlist or not")
+            elif _is_fleet_name(name) and not _has_provenance_keys(doc):
+                problems.append(
+                    "fleet/router/failover artifact without "
+                    f"provenance keys {PROVENANCE_KEYS} — replicated-"
+                    "serving evidence must be attributable, allowlist "
+                    "or not")
             elif _is_log_name(name) and not _has_provenance_keys(doc):
                 problems.append(
                     "replicated-log/kafka artifact without provenance "
