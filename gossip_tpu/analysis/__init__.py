@@ -1,0 +1,11 @@
+"""AST-based invariant analyzer (``gossip_tpu staticcheck``): the
+repo's hard-won invariants as machine-checked lint — recompile-hazard
+rules for the serving/sweep paths, lock discipline for rpc/, and the
+contract conventions (provenance, budget rows, ``Ledger.event``
+collisions, capability-string pairs).  Pure stdlib; never imports jax.
+See docs/STATIC_ANALYSIS.md for the checker catalog and
+tools/staticcheck_baseline.json for the suppression contract."""
+
+from gossip_tpu.analysis.core import Finding  # noqa: F401
+from gossip_tpu.analysis.runner import (Report, main,  # noqa: F401
+                                        run_tree, write_ledger)

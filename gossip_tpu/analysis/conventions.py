@@ -1,0 +1,193 @@
+"""Checker family 3: repo contract conventions, machine-checked.
+
+Each rule encodes a convention a past PR learned the hard way
+(docs/STATIC_ANALYSIS.md names them all):
+
+  * **ledger-event-kind** — ``Ledger.event(kind, ...)`` takes the
+    event name POSITIONALLY; a keyword field named ``kind`` collides
+    with it (the rpc/batcher ``req_kind`` rename exists because of
+    this).  Any ``.event(..., kind=...)`` call flags.
+  * **artifact-writer-provenance** — a tools/ script that writes an
+    artifact must embed ``telemetry.provenance()`` (or write through
+    a ``Ledger``, which stamps it): the validate_artifacts legacy
+    allowlist keeps old FILES green by name, so a tool that never
+    learned provenance can silently regenerate unattributed evidence
+    forever — the gate must sit on the WRITER, not just the output.
+  * **dryrun-budget-row** — every dry-run family measured by
+    ``__graft_entry__`` (the ``rec("family", ...)`` calls) needs rows
+    in BOTH tools/dryrun_budgets.json tables, and every budget row
+    must name a live family: an unbudgeted family ships unguarded, a
+    stale row guards nothing.
+  * **capability-singleton** — ``check_supported(engine="...")``
+    capability strings follow the factory-pair convention (the
+    single-device model and its sharded twin declare the same row); a
+    string appearing at exactly ONE call site is a typo'd or orphaned
+    capability row — the rejection message would name an engine no
+    other factory registers.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, List
+
+from gossip_tpu.analysis.core import (REPO, Finding, Module, call_name,
+                                      expr_text, keyword_arg, str_const)
+
+CHECKER = "conventions"
+
+#: .event(kind=...) scope: every module that can hold a ledger emit
+EVENT_SCOPE_DIRS = ("gossip_tpu", "tools", "bench.py",
+                    "__graft_entry__.py")
+
+#: artifact-writer scope: the tools scripts (helpers prefixed "_" are
+#: loaders, not writers, but scanning them is harmless)
+TOOLS_DIR = "tools"
+
+GRAFT_ENTRY = "__graft_entry__.py"
+BUDGETS_JSON = os.path.join("tools", "dryrun_budgets.json")
+
+_ART_PATH = re.compile(r"(?i)artifacts|\bart\b|_art\(")
+_PROV_REFS = ("provenance", "Ledger", "artifact_ledger", "open_ledger")
+
+
+def check_event_kind(modules: Dict[str, Module]) -> List[Finding]:
+    findings = []
+    for rel in sorted(modules):
+        mod = modules[rel]
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "event"):
+                continue
+            if keyword_arg(node, "kind") is not None:
+                findings.append(Finding(
+                    CHECKER, "ledger-event-kind", rel, node.lineno,
+                    mod.qualname(node),
+                    ".event(kind=...) collides with Ledger.event's "
+                    "positional event-name parameter — rename the "
+                    "field (the rpc/batcher req_kind convention, "
+                    "utils/telemetry.Ledger.event doc)"))
+    return findings
+
+
+def _artifact_writes(mod: Module):
+    """Line numbers of writes whose target path looks artifact-bound:
+    ``open(<expr>, "w"|"a")`` where the unparsed path expression
+    mentions artifacts (ART constants, ``_art(...)`` helpers,
+    literal artifacts/ joins)."""
+    lines = []
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and call_name(node) in ("open", "os.fdopen")):
+            continue
+        mode = None
+        if len(node.args) >= 2:
+            mode = str_const(node.args[1])
+        kw = keyword_arg(node, "mode")
+        if kw is not None:
+            mode = str_const(kw.value)
+        if not mode or not any(c in mode for c in "wax"):
+            continue
+        if node.args and _ART_PATH.search(expr_text(node.args[0])):
+            lines.append(node.lineno)
+    return lines
+
+
+def check_artifact_provenance(modules: Dict[str, Module]) -> List[Finding]:
+    findings = []
+    for rel in sorted(modules):
+        mod = modules[rel]
+        writes = _artifact_writes(mod)
+        if not writes:
+            continue
+        refs = {n.id for n in ast.walk(mod.tree)
+                if isinstance(n, ast.Name)}
+        refs |= {n.attr for n in ast.walk(mod.tree)
+                 if isinstance(n, ast.Attribute)}
+        if any(r in refs for r in _PROV_REFS):
+            continue
+        findings.append(Finding(
+            CHECKER, "artifact-writer-provenance", rel, writes[0], "",
+            "writes an artifact but never references telemetry"
+            ".provenance()/Ledger — the committed output may ride the "
+            "validate_artifacts legacy allowlist, but every "
+            "REGENERATION must be attributable (embed provenance "
+            "under a 'provenance' key, the tools/roofline.py idiom)"))
+    return findings
+
+
+def check_dryrun_budgets(root: str = REPO,
+                         graft_rel: str = GRAFT_ENTRY,
+                         budgets_rel: str = BUDGETS_JSON
+                         ) -> List[Finding]:
+    findings: List[Finding] = []
+    graft_path = os.path.join(root, graft_rel)
+    budgets_path = os.path.join(root, budgets_rel)
+    if not (os.path.isfile(graft_path) and os.path.isfile(budgets_path)):
+        return findings
+    with open(graft_path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=graft_path)
+    families = set()
+    fam_lines = {}
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "rec" and node.args):
+            fam = str_const(node.args[0])
+            if fam:
+                families.add(fam)
+                fam_lines.setdefault(fam, node.lineno)
+    with open(budgets_path, encoding="utf-8") as f:
+        budgets = json.load(f)
+    budgets_rel = budgets_rel.replace(os.sep, "/")
+    for table in ("steady_ms", "first_warm_ms"):
+        rows = budgets.get(table, {})
+        for fam in sorted(families - set(rows)):
+            findings.append(Finding(
+                CHECKER, "dryrun-budget-row", graft_rel,
+                fam_lines.get(fam, 1), "",
+                f"dry-run family '{fam}' has no {table} row in "
+                f"{budgets_rel} — an unbudgeted family ships with no "
+                "wall guard (every family gates like-for-like, "
+                "docs/OBSERVABILITY.md)"))
+        for fam in sorted(set(rows) - families):
+            findings.append(Finding(
+                CHECKER, "dryrun-budget-row", budgets_rel, 1, "",
+                f"{table} row '{fam}' names no live dry-run family "
+                "(rec() call in __graft_entry__) — a stale budget "
+                "row guards nothing; delete it or restore the "
+                "family"))
+    return findings
+
+
+def check_capability_strings(modules: Dict[str, Module]) -> List[Finding]:
+    sites: Dict[str, List] = {}
+    for rel in sorted(modules):
+        mod = modules[rel]
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node).rsplit(".", 1)[-1]
+                    == "check_supported"):
+                continue
+            kw = keyword_arg(node, "engine")
+            engine = str_const(kw.value) if kw is not None else None
+            if engine:
+                sites.setdefault(engine, []).append(
+                    (rel, node.lineno, mod.qualname(node)))
+    findings = []
+    for engine, locs in sorted(sites.items()):
+        if len(locs) > 1:
+            continue
+        rel, line, sym = locs[0]
+        findings.append(Finding(
+            CHECKER, "capability-singleton", rel, line, sym,
+            f"capability string engine='{engine}' appears at exactly "
+            "one check_supported call site — the factory-pair "
+            "convention declares every engine's row in both its "
+            "single-device and sharded factories; a singleton is a "
+            "typo'd or orphaned capability row"))
+    return findings

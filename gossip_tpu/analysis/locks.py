@@ -1,0 +1,399 @@
+"""Checker family 2: lock discipline in the rpc/ serving layer.
+
+PAPER.md §L3's handler contract is single-goroutine only because the
+reference library guarantees it; our serving layer has real threads
+(admission collector, fleet prober, gRPC handler pool) and has already
+shipped one real race — the PR 13 batcher shutdown bug, where the
+``Closed`` check ran OUTSIDE the queue lock so an admission could
+serialize between the stop-flag read and the final drain and strand
+its handler forever.  That bug's shape (and its fix's shape) are now
+machine-checked:
+
+  * **blocking-under-lock** — a blocking call (``.wait()``,
+    ``time.sleep``, thread/process ``.join``, ``os.fsync``,
+    subprocess spawns, gRPC stub dispatch, or a ledger emit WITHOUT
+    ``sync=False`` — ``Ledger.event`` fsyncs by default) inside a held
+    ``threading.Lock`` region stalls every thread contending for that
+    lock.  The ``sync=False`` convention on in-lock telemetry
+    (rpc/batcher backpressure, rpc/router transitions) is exactly the
+    discipline this rule pins.
+  * **stopflag-outside-lock** — reading a stop/closed flag OUTSIDE
+    the lock that guards the queue it gates, in a method that touches
+    the guarded queue (the PR 13 race, planted as a fixture).
+  * **lock-order** — the acquisition-order graph over every lock in
+    the rpc modules (syntactic ``with`` nesting plus propagation
+    through same-class method calls and the ``*_locked`` naming
+    convention) must be acyclic; a cycle is a deadlock waiting for
+    load.
+
+Under-lock regions propagate through same-class ``self.m()`` calls and
+through methods named ``*_locked`` (the repo convention for
+"caller holds the lock"); cross-object calls are a boundary — the
+analyzer over-approximates reachability, never lock ownership.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from gossip_tpu.analysis.core import (Finding, Module, call_name,
+                                      expr_text, keyword_arg)
+
+CHECKER = "locks"
+
+SCOPE = (
+    "gossip_tpu/rpc/batcher.py",
+    "gossip_tpu/rpc/router.py",
+    "gossip_tpu/rpc/sidecar.py",
+)
+
+_LOCK_CTORS = ("threading.Lock", "threading.RLock",
+               "threading.Condition", "Lock", "RLock", "Condition")
+_STOPFLAG_NAME = re.compile(r"stop|clos|shut|halt|quit|done")
+_THREADISH = re.compile(r"thread|proc|worker|child", re.I)
+_LEDGERISH = re.compile(r"telemetry|ledger|\bled\b", re.I)
+
+
+def _is_lock_ctor(node) -> bool:
+    return (isinstance(node, ast.Call)
+            and call_name(node) in _LOCK_CTORS)
+
+
+class _ClassInfo:
+    def __init__(self, name: str):
+        self.name = name
+        self.locks: Set[str] = set()        # self attrs that are locks
+        self.stop_flags: Set[str] = set()   # threading.Event stop/closed
+        self.guarded: Set[str] = set()      # attrs mutated under lock
+        self.methods: Dict[str, ast.FunctionDef] = {}
+
+
+def _collect_classes(mod: Module) -> Dict[str, _ClassInfo]:
+    out: Dict[str, _ClassInfo] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        info = _ClassInfo(node.name)
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.methods[item.name] = item
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            for tgt in sub.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    if _is_lock_ctor(sub.value):
+                        info.locks.add(tgt.attr)
+                    elif (isinstance(sub.value, ast.Call)
+                          and call_name(sub.value) in
+                          ("threading.Event", "Event")
+                          and _STOPFLAG_NAME.search(tgt.attr)):
+                        info.stop_flags.add(tgt.attr)
+        out[node.name] = info
+    return out
+
+
+def _module_locks(mod: Module) -> Set[str]:
+    out = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def _lock_id(mod: Module, cls: Optional[_ClassInfo],
+             mod_locks: Set[str], ctx_expr) -> Optional[str]:
+    """Stable identity of the lock a ``with`` item acquires, or None
+    when the expression is not a known lock.  ``Class.attr`` for self
+    locks (identically-named classes unify across modules — the
+    acquisition ORDER contract is per-type, not per-file);
+    ``module:NAME`` for module-level locks."""
+    if (isinstance(ctx_expr, ast.Attribute)
+            and isinstance(ctx_expr.value, ast.Name)
+            and ctx_expr.value.id == "self"
+            and cls is not None and ctx_expr.attr in cls.locks):
+        return f"{cls.name}.{ctx_expr.attr}"
+    if isinstance(ctx_expr, ast.Name) and ctx_expr.id in mod_locks:
+        stem = mod.relpath.rsplit("/", 1)[-1].rsplit(".", 1)[0]
+        return f"{stem}:{ctx_expr.id}"
+    return None
+
+
+class _LockWalk:
+    """Per-module walk computing, for every statement, the stack of
+    locks held when it executes (syntactic nesting + same-class call
+    propagation + the ``*_locked`` convention)."""
+
+    def __init__(self, mod: Module, classes: Dict[str, _ClassInfo],
+                 mod_locks: Set[str]):
+        self.mod = mod
+        self.classes = classes
+        self.mod_locks = mod_locks
+        self.findings: List[Finding] = []
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        self.lock_sites: Dict[str, Tuple[str, int]] = {}
+        # (method qualname) -> lock stack it was entered under; seeds
+        # re-walks for propagation
+        self._seen: Set[Tuple[str, Tuple[str, ...]]] = set()
+
+    def run(self):
+        for cname, cls in self.classes.items():
+            for mname, fn in cls.methods.items():
+                held: Tuple[str, ...] = ()
+                if mname.endswith("_locked") and cls.locks:
+                    # convention: caller holds the instance lock(s)
+                    held = tuple(f"{cls.name}.{a}"
+                                 for a in sorted(cls.locks))
+                self._walk_fn(fn, cls, held)
+        for node in self.mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_fn(node, None, ())
+        return self
+
+    # -- statement walk ------------------------------------------------
+
+    def _walk_fn(self, fn, cls, held: Tuple[str, ...]):
+        key = (self.mod.qualname(fn), held)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        for stmt in fn.body:
+            self._walk_stmt(stmt, cls, held)
+
+    def _walk_stmt(self, stmt, cls, held: Tuple[str, ...]):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return          # a nested def does not run under the lock
+        if isinstance(stmt, ast.With):
+            inner = held
+            for item in stmt.items:
+                lid = _lock_id(self.mod, cls, self.mod_locks,
+                               item.context_expr)
+                if lid is not None:
+                    self.lock_sites.setdefault(
+                        lid, (self.mod.relpath, stmt.lineno))
+                    for outer in inner:
+                        if outer != lid:
+                            self.edges.setdefault(
+                                (outer, lid),
+                                (self.mod.relpath, stmt.lineno))
+                    inner = inner + (lid,)
+                else:
+                    self._scan_expr(item.context_expr, cls, held)
+            for sub in stmt.body:
+                self._walk_stmt(sub, cls, inner)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if not isinstance(child, ast.stmt):
+                self._scan_expr(child, cls, held)
+        for attr in ("body", "orelse", "finalbody"):
+            for sub in getattr(stmt, attr, ()):
+                if isinstance(sub, ast.stmt):
+                    self._walk_stmt(sub, cls, held)
+        for handler in getattr(stmt, "handlers", ()):
+            for sub in handler.body:
+                self._walk_stmt(sub, cls, held)
+
+    # -- expression scan ----------------------------------------------
+
+    def _scan_expr(self, expr, cls, held: Tuple[str, ...]):
+        if not isinstance(expr, ast.AST):
+            return
+        # manual walk skipping nested def/lambda subtrees: a function
+        # BUILT under the lock does not RUN under it
+        todo = [expr]
+        while todo:
+            node = todo.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            todo.extend(ast.iter_child_nodes(node))
+            if not isinstance(node, ast.Call):
+                continue
+            if held:
+                self._check_blocking(node, held)
+            # propagate held locks through same-class self.m() calls
+            if (held and cls is not None
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"
+                    and node.func.attr in cls.methods):
+                self._walk_fn(cls.methods[node.func.attr], cls, held)
+
+    def _check_blocking(self, node: ast.Call, held: Tuple[str, ...]):
+        name = call_name(node)
+        term = name.rsplit(".", 1)[-1]
+        lock = held[-1]
+        msg = None
+        if name in ("time.sleep", "sleep"):
+            msg = f"time.sleep under held {lock}"
+        elif term == "wait":
+            msg = (f"blocking {name}() under held {lock} — every "
+                   "thread contending for the lock stalls with it")
+        elif term == "join" and _THREADISH.search(
+                expr_text(node.func)):
+            msg = f"thread/process join under held {lock}"
+        elif name in ("os.fsync", "fsync"):
+            msg = f"fsync under held {lock}"
+        elif name.startswith("subprocess."):
+            msg = f"subprocess spawn under held {lock}"
+        elif ".stubs[" in expr_text(node.func):
+            msg = (f"RPC dispatch under held {lock} — a slow replica "
+                   "would serialize the whole router")
+        elif (term in ("event", "gauge", "counter")
+              and _LEDGERISH.search(expr_text(node.func))):
+            kw = keyword_arg(node, "sync")
+            sync_off = (kw is not None
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False)
+            if term == "counter" or not sync_off:
+                msg = (f"fsync'd ledger {term}() under held {lock} — "
+                       "pass sync=False inside lock regions (the "
+                       "rpc/batcher backpressure convention) or emit "
+                       "after release")
+        if msg is not None:
+            self.findings.append(Finding(
+                CHECKER, "blocking-under-lock", self.mod.relpath,
+                node.lineno, self.mod.qualname(node), msg))
+
+
+def _check_stopflags(mod: Module, classes: Dict[str, _ClassInfo],
+                     mod_locks: Set[str]) -> List[Finding]:
+    """The PR 13 rule: a stop/closed-flag READ outside the lock, in a
+    method that also touches the lock-guarded queue."""
+    findings: List[Finding] = []
+    for cls in classes.values():
+        if not cls.locks:
+            continue
+        # guarded attrs: self attrs mutated inside a with-self-lock
+        guarded: Set[str] = set()
+        for fn in cls.methods.values():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.With):
+                    continue
+                if not any(_lock_id(mod, cls, mod_locks,
+                                    i.context_expr)
+                           for i in node.items):
+                    continue
+                for sub in ast.walk(node):
+                    tgt = None
+                    if isinstance(sub, ast.Assign):
+                        tgt = sub.targets[0]
+                    elif isinstance(sub, (ast.AugAssign, ast.Delete)):
+                        tgt = getattr(sub, "target", None) or \
+                            (sub.targets[0] if getattr(
+                                sub, "targets", None) else None)
+                    elif (isinstance(sub, ast.Call)
+                          and isinstance(sub.func, ast.Attribute)
+                          and sub.func.attr in ("append", "pop",
+                                                "extend", "insert",
+                                                "remove", "clear")):
+                        tgt = sub.func.value
+                    while isinstance(tgt, ast.Subscript):
+                        tgt = tgt.value
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                            and tgt.attr not in cls.locks):
+                        guarded.add(tgt.attr)
+        if not guarded:
+            continue
+        for mname, fn in cls.methods.items():
+            if mname.endswith("_locked"):
+                continue
+            touches = any(
+                isinstance(n, ast.Attribute)
+                and isinstance(n.value, ast.Name)
+                and n.value.id == "self" and n.attr in guarded
+                for n in ast.walk(fn))
+            if not touches:
+                continue
+            # locked line ranges (approximate by with-block extents)
+            locked_spans = []
+            for node in ast.walk(fn):
+                if isinstance(node, ast.With) and any(
+                        _lock_id(mod, cls, mod_locks, i.context_expr)
+                        for i in node.items):
+                    locked_spans.append((node.lineno,
+                                         node.end_lineno or node.lineno))
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "is_set"):
+                    continue
+                recv = node.func.value
+                if not (isinstance(recv, ast.Attribute)
+                        and isinstance(recv.value, ast.Name)
+                        and recv.value.id == "self"
+                        and recv.attr in cls.stop_flags):
+                    continue
+                if any(lo <= node.lineno <= hi
+                       for lo, hi in locked_spans):
+                    continue
+                findings.append(Finding(
+                    CHECKER, "stopflag-outside-lock", mod.relpath,
+                    node.lineno, mod.qualname(node),
+                    f"self.{recv.attr}.is_set() read outside the "
+                    f"lock guarding {sorted(guarded)} in a method "
+                    "that touches the guarded state — an admission "
+                    "can serialize between the flag read and the "
+                    "final drain (the PR 13 batcher shutdown race; "
+                    "move the check inside the lock, the "
+                    "rpc/batcher._admit pattern)"))
+    return findings
+
+
+def _find_cycles(edges: Dict[Tuple[str, str], Tuple[str, int]]):
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    cycles = []
+    color: Dict[str, int] = {}
+    stack: List[str] = []
+
+    def dfs(u):
+        color[u] = 1
+        stack.append(u)
+        for v in sorted(graph[u]):
+            if color.get(v, 0) == 0:
+                dfs(v)
+            elif color.get(v) == 1:
+                cycles.append(stack[stack.index(v):] + [v])
+        stack.pop()
+        color[u] = 2
+
+    for u in sorted(graph):
+        if color.get(u, 0) == 0:
+            dfs(u)
+    return cycles
+
+
+def check(modules: Dict[str, Module]) -> List[Finding]:
+    findings: List[Finding] = []
+    all_edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for rel in sorted(modules):
+        mod = modules[rel]
+        classes = _collect_classes(mod)
+        mod_locks = _module_locks(mod)
+        walk = _LockWalk(mod, classes, mod_locks).run()
+        findings.extend(walk.findings)
+        findings.extend(_check_stopflags(mod, classes, mod_locks))
+        for edge, site in walk.edges.items():
+            all_edges.setdefault(edge, site)
+    for cycle in _find_cycles(all_edges):
+        a, b = cycle[0], cycle[1]
+        rel, line = all_edges.get((a, b), ("", 1))
+        findings.append(Finding(
+            CHECKER, "lock-order", rel, line, "",
+            "inconsistent lock-acquisition order across the rpc "
+            f"modules: cycle {' -> '.join(cycle)} — two threads "
+            "taking these locks in opposite orders deadlock under "
+            "load; pick one global order (docs/STATIC_ANALYSIS.md)"))
+    return findings

@@ -1,0 +1,230 @@
+"""Checker family 1: recompile hazards on the serving/sweep paths.
+
+The invariants this family encodes are the PR 9/12 serving lessons
+(docs/SERVING.md, docs/PERF.md):
+
+  * **jnp-over-k** — a ``jnp.stack``/``concatenate``/``array``/
+    ``asarray`` over a Python-sized sequence (list/tuple literal or
+    comprehension) is a fresh tiny XLA program per distinct K.  Solo
+    it is invisible; per-request it is a compile inside the serving
+    window (PR 9's operand assembly is deliberately NUMPY for exactly
+    this reason).
+  * **jit-in-request-path** — a ``jax.jit``/``pjit`` call inside a
+    function reachable per-request builds a fresh jit closure per
+    CALL and retraces every time (the solo ``simulate_curve`` baseline
+    measured ~0.5 rps against the batcher's 95.7x because of this).
+  * **content-in-memo-key** — an ``lru_cache``-decorated builder that
+    produces an executable (a ``jax.jit`` in its body) keyed on
+    fault/schedule CONTENT compiles one executable per scenario: the
+    exact ``_cached_churn_masks`` bug PR 12 deleted (its fix caches
+    VALUES eagerly and keys the compiled loops on no fault content).
+    The repo's naming convention is the escape hatch: a parameter
+    named ``*_static`` declares "statics only, content stripped
+    upstream" (``_cached_dense_loop(fault_static=...)``) and is not
+    flagged; a bare content name on an executable-producing memo key
+    is.
+
+Reachability: the per-request roots are every function in the rpc
+modules plus the ``request_*`` entry points in parallel/sweep; the
+call graph is terminal-name matched (an over-approximation — more
+reachable means stricter).  ``lru_cache``-decorated functions are
+BOUNDARIES for the first two rules: inside a memoized builder,
+trace-time Python runs once per key by construction (that is the
+pattern the serving layer is built on), so only the third rule looks
+inside them — at their keys.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set
+
+from gossip_tpu.analysis.core import (Finding, Module, call_name,
+                                      expr_text, has_decorator)
+
+CHECKER = "recompile"
+
+#: serving/sweep scope — the modules whose functions can run
+#: per-request or per-scenario (docs/STATIC_ANALYSIS.md scope table)
+SCOPE = (
+    "gossip_tpu/rpc/batcher.py",
+    "gossip_tpu/rpc/router.py",
+    "gossip_tpu/rpc/sidecar.py",
+    "gossip_tpu/parallel/sweep.py",
+    "gossip_tpu/ops/nemesis.py",
+)
+
+#: modules whose lru_cache keys the content-in-memo-key rule audits
+#: (every jax-bearing package — the hazard is not serving-specific)
+MEMO_SCOPE_PREFIXES = ("gossip_tpu/",)
+
+_JNP_BUILDERS = ("stack", "concatenate", "array", "asarray")
+
+#: parameter names that carry fault/schedule CONTENT; ``*_static`` is
+#: the declared-static naming convention and never matches
+_CONTENT_PARAM = re.compile(
+    r"^(fault|churn|sched|schedule|events?|drop|drop_tbl|cut|cut_tbl"
+    r"|die|rec|program|tables)$")
+
+_PY_SIZED = (ast.List, ast.Tuple, ast.ListComp, ast.GeneratorExp,
+             ast.SetComp)
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    name = call_name(node)
+    return name in ("jax.jit", "pjit", "jax.pjit") or name.endswith(
+        ".jit")
+
+
+def _module_jit_refs(fn: ast.AST) -> bool:
+    """True when ``fn``'s body references jax.jit/pjit anywhere — as a
+    call OR a decorator on an inner def (the memoized-scan idiom wraps
+    the inner ``scan`` with ``@jax.jit``)."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and _is_jit_call(node):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if expr_text(target) in ("jax.jit", "pjit", "jax.pjit"):
+                    return True
+    return False
+
+
+def _functions(mod: Module) -> Dict[str, ast.FunctionDef]:
+    out = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[mod.qualname(node)] = node
+    return out
+
+
+def _roots(mod: Module, fns: Dict[str, ast.FunctionDef]) -> Set[str]:
+    if "/rpc/" in mod.relpath:
+        return set(fns)                       # the whole serving layer
+    return {qn for qn in fns
+            if qn.split(".")[-1].startswith(("request_", "_request"))}
+
+
+def _reachable(modules: Dict[str, Module]):
+    """(per-module reachable qualname set) from the per-request roots,
+    terminal-name call matching across the scope modules; traversal
+    stops at lru_cache boundaries (their bodies run once per key)."""
+    # global name -> [(relpath, qualname, fn)]
+    by_name: Dict[str, List] = {}
+    all_fns: Dict[str, Dict[str, ast.FunctionDef]] = {}
+    for rel, mod in modules.items():
+        fns = _functions(mod)
+        all_fns[rel] = fns
+        for qn, fn in fns.items():
+            by_name.setdefault(qn.split(".")[-1], []).append(
+                (rel, qn, fn))
+    reach: Set = set()
+    work = []
+    for rel, mod in modules.items():
+        for qn in _roots(mod, all_fns[rel]):
+            work.append((rel, qn))
+    while work:
+        rel, qn = work.pop()
+        if (rel, qn) in reach:
+            continue
+        reach.add((rel, qn))
+        fn = all_fns[rel][qn]
+        if has_decorator(fn, "lru_cache", "cache"):
+            continue                           # memo boundary
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            term = call_name(node).rsplit(".", 1)[-1]
+            for rel2, qn2, _ in by_name.get(term, ()):
+                if (rel2, qn2) not in reach:
+                    work.append((rel2, qn2))
+    per_mod: Dict[str, Set[str]] = {}
+    for rel, qn in reach:
+        per_mod.setdefault(rel, set()).add(qn)
+    return per_mod, all_fns
+
+
+def check(modules: Dict[str, Module],
+          memo_modules: Dict[str, Module]) -> List[Finding]:
+    """``modules``: the serving-scope set (reachability rules);
+    ``memo_modules``: the wider set whose lru_cache keys are audited.
+    Fixture tests pass their planted files as both."""
+    findings: List[Finding] = []
+    per_mod, all_fns = _reachable(modules)
+
+    for rel, mod in modules.items():
+        fns = all_fns.get(rel, {})
+        for qn in sorted(per_mod.get(rel, ())):
+            fn = fns[qn]
+            if has_decorator(fn, "lru_cache", "cache"):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                # findings attach to the INNERMOST def so the
+                # suppression key names the actual site, but nested
+                # defs are only scanned via their own reachability
+                # when called; here we scan the whole body — a nested
+                # helper inside a reachable function runs per-request
+                # too
+                name = call_name(node)
+                if (name.split(".")[0] == "jnp"
+                        and name.rsplit(".", 1)[-1] in _JNP_BUILDERS
+                        and any(isinstance(a, _PY_SIZED)
+                                for a in node.args)):
+                    findings.append(Finding(
+                        CHECKER, "jnp-over-k", rel, node.lineno,
+                        mod.qualname(node),
+                        f"{name} over a Python-sized sequence in a "
+                        "per-request path — a fresh tiny XLA program "
+                        "per distinct K; assemble operands in numpy "
+                        "and convert once (the PR 9 serving lesson, "
+                        "docs/SERVING.md)"))
+                elif _is_jit_call(node):
+                    findings.append(Finding(
+                        CHECKER, "jit-in-request-path", rel,
+                        node.lineno, mod.qualname(node),
+                        f"{name} inside a function reachable "
+                        "per-request builds a fresh jit closure per "
+                        "call and retraces every time (the solo-"
+                        "retrace trap, docs/SERVING.md); hoist it "
+                        "behind an lru_cache keyed on statics only"))
+
+    for rel, mod in memo_modules.items():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not has_decorator(node, "lru_cache", "cache"):
+                continue
+            if not _module_jit_refs(node):
+                continue        # caches values, not executables — the
+                #                 _cached_churn_masks fix pattern
+            args = node.args
+            params = [a.arg for a in (args.posonlyargs + args.args
+                                      + args.kwonlyargs)]
+            for p in params:
+                if _CONTENT_PARAM.match(p):
+                    findings.append(Finding(
+                        CHECKER, "content-in-memo-key", rel,
+                        node.lineno, mod.qualname(node),
+                        f"lru_cache'd executable builder keyed on "
+                        f"content-named parameter '{p}' — one "
+                        "compiled program per scenario (the "
+                        "_cached_churn_masks bug PR 12 deleted); "
+                        "strip content upstream and rename the "
+                        "parameter '*_static', or cache eager VALUES "
+                        "instead of a jit closure"))
+    # dedup: in rpc modules every def (nested ones included) is a
+    # root, and the enclosing function's body walk visits nested-def
+    # sites too — the same violation must count once, not once per
+    # covering walk
+    seen, unique = set(), []
+    for f in findings:
+        k = (f.rule, f.path, f.line, f.symbol)
+        if k not in seen:
+            seen.add(k)
+            unique.append(f)
+    return unique

@@ -1335,6 +1335,25 @@ def cmd_route(a) -> int:
     return 0
 
 
+def cmd_staticcheck(a) -> int:
+    """AST invariant analyzer over the repo's own source (pure stdlib
+    — never initializes jax, so it runs on a wedged-tunnel box):
+    recompile-hazard lint for the serving/sweep paths, lock discipline
+    for rpc/, convention gates, and the suppression-baseline
+    discipline (docs/STATIC_ANALYSIS.md)."""
+    from gossip_tpu.analysis import runner
+    argv = []
+    if a.root is not None:
+        argv += ["--root", a.root]
+    if a.baseline is not None:
+        argv += ["--baseline", a.baseline]
+    if a.ledger:
+        argv += ["--ledger", a.ledger]
+    if a.json_summary:
+        argv += ["--json"]
+    return runner.main(argv)
+
+
 def cmd_maelstrom(a) -> int:
     from gossip_tpu.runtime.maelstrom_node import main as node_main
     node_main(["--gossip-interval", str(a.gossip_interval),
@@ -1798,6 +1817,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "(default cpu: N processes cannot share one "
                         "TPU; '' inherits the ambient platform)")
     p.set_defaults(fn=cmd_route)
+
+    p = sub.add_parser(
+        "staticcheck",
+        help="AST invariant analyzer over the repo source: "
+             "recompile-hazard lint (serving/sweep), rpc lock "
+             "discipline, convention gates; exit 1 on findings "
+             "(docs/STATIC_ANALYSIS.md)")
+    p.add_argument("--root", default=None, metavar="DIR",
+                   help="tree to analyze (default: this repo)")
+    p.add_argument("--baseline", default=None, metavar="JSON",
+                   help="suppression baseline (default: tools/"
+                        "staticcheck_baseline.json; '' disables)")
+    p.add_argument("--ledger", default=None, metavar="PATH",
+                   help="write the provenance-stamped findings ledger")
+    p.add_argument("--json", dest="json_summary", action="store_true",
+                   help="one summary JSON line instead of per-finding "
+                        "text")
+    p.set_defaults(fn=cmd_staticcheck)
 
     p = sub.add_parser("maelstrom",
                        help="run the Maelstrom protocol node on stdio")

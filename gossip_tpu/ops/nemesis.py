@@ -133,17 +133,24 @@ def _event_tables(ch: ChurnConfig, size: int):
     """die/rec int32[size] round tables from the event list (rec < 0 ->
     NEVER; unscripted rows NEVER) — the ONE event-scatter lowering,
     shared by :func:`build` and :func:`fused_word_tables` so the flat
-    and word-rendered engines' churn timelines cannot drift.  In-trace
-    safe (small scatters)."""
-    die = jnp.full((size,), NEVER, jnp.int32)
-    rec = jnp.full((size,), NEVER, jnp.int32)
+    and word-rendered engines' churn timelines cannot drift.
+
+    Built in NUMPY, converted once: the jnp construction this replaces
+    dispatched scatter programs whose shapes were keyed on the EVENT
+    COUNT — on the serving path (build_request_stack per admitted
+    request) that is one tiny XLA compile per distinct event-list
+    length, the jnp-over-K class the staticcheck recompile lint flags
+    (docs/STATIC_ANALYSIS.md).  Value-identical: ChurnConfig enforces
+    one event per node, so the assignment order cannot matter."""
+    import numpy as np
+    die = np.full((size,), NEVER, np.int32)
+    rec = np.full((size,), NEVER, np.int32)
     if ch.events:
-        nodes = jnp.asarray([e[0] for e in ch.events], jnp.int32)
-        die = die.at[nodes].set(jnp.asarray(
-            [e[1] for e in ch.events], jnp.int32))
-        rec = rec.at[nodes].set(jnp.asarray(
-            [e[2] if e[2] >= 0 else NEVER for e in ch.events], jnp.int32))
-    return die, rec
+        nodes = np.asarray([e[0] for e in ch.events], np.int32)
+        die[nodes] = np.asarray([e[1] for e in ch.events], np.int32)
+        rec[nodes] = np.asarray(
+            [e[2] if e[2] >= 0 else NEVER for e in ch.events], np.int32)
+    return jnp.asarray(die), jnp.asarray(rec)
 
 
 # Minimum canonical [T] table length.  Bucketing trades a few padded

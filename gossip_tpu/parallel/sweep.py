@@ -824,8 +824,8 @@ def request_sweep_curves(specs, topo: Optional[Topology] = None,
 def _cached_pod_sweep_scan(n: int, n_pad: int, nl: int, k_max: int,
                            have_ae: bool, need_push: bool, need_pull: bool,
                            multi: bool, have_table: bool, max_rounds: int,
-                           origin: int, mesh, fault, sweep_axis: str,
-                           node_axis: str):
+                           origin: int, mesh, fault_static,
+                           sweep_axis: str, node_axis: str):
     """The 2-D pod sweep's compiled scan, memoized by EXACTLY the
     statics its trace bakes in — max_rounds and origin, not the whole
     RunConfig, whose unused fields (seed: the sweep's seeds are
@@ -860,7 +860,11 @@ def _cached_pod_sweep_scan(n: int, n_pad: int, nl: int, k_max: int,
             nbrs_l, deg_l = nbrs_l[tidx], deg_l[tidx]
         shard = jax.lax.axis_index(node_axis)
         gids = shard * nl + jnp.arange(nl, dtype=jnp.int32)
-        alive_l = sharded_alive(fault, n, n_pad, origin)[gids]
+        # fault_static by name: the grid sweeps reject churn schedules
+        # upstream (check_supported events=False), so this key carries
+        # no schedule content — the staticcheck content-in-memo-key
+        # naming contract (gossip_tpu/analysis/recompile.py)
+        alive_l = sharded_alive(fault_static, n, n_pad, origin)[gids]
         rkey = jax.random.fold_in(base_key, round_)
         visible = seen_l & alive_l[:, None]
 

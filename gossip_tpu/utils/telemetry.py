@@ -452,6 +452,42 @@ def from_env(default_path: Optional[str] = None, argv=None,
         return EchoLedger() if echo else NullLedger()
 
 
+def artifact_ledger(path: str, rewrite: bool = True,
+                    fsync: bool = False, argv=None):
+    """Provenance-stamped ARTIFACT ledger — the ONE stamping helper
+    every committed-jsonl writer shares: tests/conftest.py's per-test
+    duration ledger and gossip_tpu/analysis's staticcheck findings
+    ledger both open through here, so a future writer cannot re-roll
+    (and drift) the remove-then-stamp choreography.
+
+    Differences from :func:`from_env`, which serves RUN flight
+    recorders: ``rewrite=True`` truncates an existing file first — a
+    committed artifact is THIS run's evidence, not an append log
+    (pass False for the explicit-path append convention, e.g. a
+    caller aggregating several test sessions) — and ``fsync`` defaults
+    off (artifact writers run outside any crash window worth an fsync
+    per line; the provenance first line still lands via Ledger's
+    normal emit path).  An unwritable path degrades to the NullLedger
+    with a stderr warning — a recorder must never fail the run it
+    records (the from_env contract)."""
+    if rewrite:
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            pass
+        except OSError as e:
+            sys.stderr.write(f"telemetry: cannot rewrite artifact "
+                             f"ledger {path!r} ({e}); recording "
+                             "disabled\n")
+            return NullLedger()
+    try:
+        return Ledger(path, argv=argv, fsync=fsync)
+    except OSError as e:
+        sys.stderr.write(f"telemetry: cannot open artifact ledger "
+                         f"{path!r} ({e}); recording disabled\n")
+        return NullLedger()
+
+
 def percentile(values, q: float) -> float:
     """Nearest-rank percentile (q in [0, 1]) of a value sequence, 0.0
     with no samples — the ONE latency-quantile definition the serving

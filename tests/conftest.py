@@ -152,18 +152,18 @@ def pytest_sessionfinish(session, exitstatus):
         return              # convention)
     try:
         from gossip_tpu.utils import telemetry
-        if not explicit:
-            # the default path is per-session flight data, rewritten
-            # every session (the .gitignore contract) — only an
-            # explicit $GOSSIP_TEST_LEDGER appends, so a caller can
-            # aggregate several sessions into one shared ledger
-            try:
-                os.remove(path)
-            except FileNotFoundError:
-                pass
-        # fsync=False: flush-only is plenty for test flight data, and
-        # ~300 per-event fsyncs would tax the very wall being measured
-        with telemetry.Ledger(path, fsync=False) as led:
+        # the ONE provenance-stamping artifact-ledger helper
+        # (telemetry.artifact_ledger), shared with the staticcheck
+        # findings writer so the choreography cannot drift.  The
+        # default path is per-session flight data, rewritten every
+        # session (the .gitignore contract) — only an explicit
+        # $GOSSIP_TEST_LEDGER appends, so a caller can aggregate
+        # several sessions into one shared ledger.  fsync=False
+        # (helper default): flush-only is plenty for test flight
+        # data, and ~300 per-event fsyncs would tax the very wall
+        # being measured.
+        with telemetry.artifact_ledger(path,
+                                       rewrite=not explicit) as led:
             for nodeid, wall in sorted(_test_walls.items(),
                                        key=lambda kv: -kv[1]):
                 led.event("test", nodeid=nodeid,

@@ -1,0 +1,13 @@
+"""Planted dry-run-budget violation tree: ``fam_unbudgeted`` has no
+rows in tools/dryrun_budgets.json (must flag dryrun-budget-row), and
+the budgets file names ``fam_ghost`` which no rec() call measures
+(must flag the stale-row direction).  Parsed, never executed."""
+
+
+def rec(name, key, fn):
+    return fn()
+
+
+def _families():
+    rec("fam_budgeted", "first_ms", lambda: 1)
+    rec("fam_unbudgeted", "first_ms", lambda: 2)        # MUST FLAG

@@ -1,0 +1,12 @@
+"""Planted convention violations for tests/test_staticcheck.py
+(parsed, never executed).  Each construct MUST flag."""
+
+
+def emit(ledger):
+    # `kind` collides with Ledger.event's positional event name
+    ledger.event("probe", kind="health")        # MUST FLAG
+
+
+def _lonely_factory(fault, check_supported):
+    # a capability string no other factory registers (singleton)
+    check_supported(fault, engine="typo-engine")    # MUST FLAG

@@ -1,0 +1,53 @@
+"""Planted lock-discipline violations for tests/test_staticcheck.py
+(parsed, never executed).  Each construct MUST flag."""
+
+import threading
+import time
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+
+
+def take_ab():
+    with LOCK_A:
+        with LOCK_B:          # edge A -> B
+            return 1
+
+
+def take_ba():
+    with LOCK_B:
+        with LOCK_A:          # edge B -> A: cycle MUST FLAG lock-order
+            return 2
+
+
+class PlantedBatcher:
+    """The pre-PR-13 batcher shape: stop flag checked OUTSIDE the
+    queue lock, and a sleep held under it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._queue = []
+
+    def submit(self, item):
+        if self._stop.is_set():      # MUST FLAG stopflag-outside-lock
+            raise RuntimeError("closed")
+        with self._lock:
+            self._queue.append(item)
+
+    def drain(self):
+        with self._lock:
+            time.sleep(0.01)         # MUST FLAG blocking-under-lock
+            q = list(self._queue)
+            self._queue.clear()
+        return q
+
+    def emit_locked(self, telemetry):
+        # held by convention (*_locked): a default-sync ledger emit
+        # fsyncs under the lock — MUST FLAG blocking-under-lock
+        telemetry.current().event("batch", size=1)
+
+    def ok_emit(self, telemetry):
+        with self._lock:
+            # sync=False is the sanctioned in-lock emit: must NOT flag
+            telemetry.current().event("batch", sync=False, size=1)

@@ -1,0 +1,48 @@
+"""Planted recompile-hazard violations for tests/test_staticcheck.py.
+
+Every construct here MUST flag — a checker that cannot fail is not a
+checker (the PR 11 txn-checker rule).  This file is never imported or
+executed, only parsed (the analyzer excludes tests/data from every
+live-tree scan), so the jax imports are props."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def request_handler(specs):
+    """Per-request root (request_* naming): both jnp-over-K builds
+    below are one tiny XLA program per distinct len(specs)."""
+    seeds = jnp.asarray([s.seed for s in specs])          # MUST FLAG
+    tables = jnp.stack([s.table for s in specs])          # MUST FLAG
+    return _dispatch(seeds, tables)
+
+
+def _dispatch(seeds, tables):
+    """Reachable from the root through the call graph: the per-call
+    jit closure retraces every request (the solo-retrace trap)."""
+    fn = jax.jit(lambda x: x + 1)                         # MUST FLAG
+    return fn(seeds), tables
+
+
+@functools.lru_cache(maxsize=8)
+def _cached_scenario_loop(fault, n):
+    """Executable builder keyed on content-named ``fault`` — one
+    compiled program per scenario (the _cached_churn_masks bug)."""
+    return jax.jit(lambda x: x * n)                       # param MUST FLAG
+
+
+@functools.lru_cache(maxsize=8)
+def _cached_clean_loop(fault_static, n):
+    """The declared-static convention: must NOT flag."""
+    return jax.jit(lambda x: x * n)
+
+
+def request_nested(specs):
+    """A violation inside a nested helper must count ONCE even though
+    both the enclosing walk and the nested def's own root cover it
+    (the dedup contract)."""
+    def helper(items):
+        return jnp.stack([i.row for i in items])          # MUST FLAG x1
+    return helper(specs)

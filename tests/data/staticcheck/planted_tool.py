@@ -1,0 +1,13 @@
+"""Planted artifact-writer-provenance violation (a tools/-shaped
+script that writes an artifact without ever referencing
+telemetry.provenance()/Ledger).  Parsed, never executed."""
+
+import json
+import os
+
+ART = os.path.join("artifacts", "planted_lint_demo.json")
+
+
+def write():
+    with open(ART, "w") as f:                   # MUST FLAG
+        json.dump({"ok": True}, f)

@@ -209,3 +209,25 @@ def test_fused_sweep_artifacts_must_be_attributable(tmp_path):
     with telemetry.Ledger(str(good)) as led:
         led.event("fused_sweep_record", ok=True, warm_ratio=4.0)
     assert va.validate_file(str(good)) == []
+
+
+def test_staticcheck_artifacts_must_be_attributable(tmp_path):
+    """A ``*staticcheck*``/``*lint*`` artifact without provenance
+    fails — an invariant-analyzer verdict (gossip_tpu/analysis +
+    tools/staticcheck.py) certifies a specific commit's tree and can
+    never be grandfathered, jsonl or json alike."""
+    bad = tmp_path / "ledger_staticcheck_r99.jsonl"
+    bad.write_text(json.dumps({"ev": "staticcheck",
+                               "verdict": "clean"}) + "\n")
+    problems = va.validate_file(str(bad))
+    assert any("provenance" in p for p in problems), problems
+
+    badl = tmp_path / "lint_summary_r99.json"
+    badl.write_text(json.dumps({"verdict": "clean"}))
+    problems = va.validate_file(str(badl))
+    assert any("provenance" in p for p in problems), problems
+
+    good = tmp_path / "ledger_staticcheck_r98.jsonl"
+    with telemetry.artifact_ledger(str(good)) as led:
+        led.event("staticcheck", verdict="clean", findings=0)
+    assert va.validate_file(str(good)) == []

@@ -238,7 +238,13 @@ def main(argv=None):
     matrix["python/batched-25-grid"] = glomers
     ok = ok and glomers["invariant_ok"] and glomers["exit_code"] == 0
 
+    from _telemetry import telemetry
     out = {
+        # the one artifact schema (run_id/git_commit/captured —
+        # tools/validate_artifacts.py): the committed file rides the
+        # legacy allowlist by NAME, but every regeneration must be
+        # attributable (the staticcheck artifact-writer-provenance gate)
+        "provenance": telemetry().provenance(),
         "what": "Maelstrom broadcast workload, immediate vs "
                 "interval-batched relay (VERDICT r3 item 7): same seeded "
                 "5-node line, 20 values at 200 ops/s, both through the "

@@ -125,6 +125,11 @@ def main():
             hard_failures += 1
             doc[name] = {"ok": False,
                          "error": f"{type(e).__name__}: {e}"[:800]}
+        # stamped per write: the merged artifact's attribution is the
+        # run that last touched it (the one artifact schema —
+        # tools/validate_artifacts.py / staticcheck writer gate)
+        from _telemetry import telemetry
+        doc["provenance"] = telemetry().provenance()
         with open(art, "w") as f:
             json.dump(doc, f, indent=1)
     # final summary line = the callers' machine-readable result

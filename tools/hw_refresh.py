@@ -355,6 +355,7 @@ def swim_diss_winner():
         return None
 
 
+STATICCHECK_TIMEOUT_S = 120    # pure-stdlib AST passes: seconds, no jax
 KERNEL_NUMBERS_TIMEOUT_S = 1500
 ROOFLINE_TIMEOUT_S = 1200
 ENSEMBLES_TIMEOUT_S = 2700     # covers both sub-captures' own budgets
@@ -405,6 +406,16 @@ def fused_churn_sweep():
     refreshes the stale r06 CPU-fallback headline with Mosaic
     numbers."""
     return _run_tool("fused_sweep_capture.py", FUSED_SWEEP_TIMEOUT_S)
+
+
+def staticcheck():
+    """The AST invariant analyzer over the tree this capture runs from
+    (tools/staticcheck.py): recompile-hazard lint, rpc lock
+    discipline, convention gates — pure stdlib, CPU-only, seconds.
+    Runs FIRST so a capture window never spends its budget measuring a
+    tree whose serving invariants already regressed; it is also the
+    one step a wedged tunnel cannot take down (no jax import)."""
+    return _run_tool("staticcheck.py", STATICCHECK_TIMEOUT_S)
 
 
 def fleet_failover():
@@ -610,7 +621,8 @@ def tpu_pallas_tests():
 # retries are incremental (pending steps only).
 FLEET_TIMEOUT_S = 1200
 
-STEPS = [("swim_diss_ab", swim_diss_ab),
+STEPS = [("staticcheck", staticcheck),
+         ("swim_diss_ab", swim_diss_ab),
          ("bench", bench),
          ("kernel_numbers", kernel_numbers),
          ("mr_staged_10m", mr_staged_10m),

@@ -143,6 +143,11 @@ def main():
         scaling[str(N)] = full["compile_s"]
     else:
         return 0    # CPU smoke before any 1M artifact exists: no write
+    # stamped per write: the merged artifact's attribution is the run
+    # that last touched it (the one artifact schema —
+    # tools/validate_artifacts.py / staticcheck writer gate)
+    from _telemetry import telemetry
+    prior["provenance"] = telemetry().provenance()
     with open(ART, "w") as f:
         json.dump(prior, f, indent=1)
     print(f"wrote {ART}", file=sys.stderr)

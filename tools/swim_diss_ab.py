@@ -193,7 +193,13 @@ def main():
                    f" -> {best['steady_wall_s']:.1f} s, compile "
                    f"{ctl['compile_s']:.1f} -> {best['compile_s']:.1f} s "
                    f"vs {ctl['swim_diss']} control")
+    from _telemetry import telemetry
     doc = {
+        # the one artifact schema (run_id/git_commit/captured —
+        # tools/validate_artifacts.py): regenerations must be
+        # attributable even though the committed file is
+        # legacy-allowlisted by name (staticcheck writer gate)
+        "provenance": telemetry().provenance(),
         "what": ("A/B of ProtocolConfig.swim_diss lowerings on the "
                  "BASELINE SWIM-1M shape; identical trajectories required "
                  "(rounds/coverage/msgs) per models/swim.disseminate_max"),

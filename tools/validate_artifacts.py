@@ -131,6 +131,17 @@ def _is_fused_sweep_name(name: str) -> bool:
     return "fused_sweep" in name
 
 
+def _is_staticcheck_name(name: str) -> bool:
+    """Staticcheck/lint artifacts by name — the invariant analyzer's
+    own verdict ledgers (clean-tree claims, per-checker finding
+    counts — gossip_tpu/analysis + tools/staticcheck.py) must always
+    be attributable; the legacy allowlist can never grandfather one
+    in (the analyzer post-dates the provenance schema by fifteen
+    rounds, and a lint verdict nobody can attribute to a commit
+    certifies nothing)."""
+    return "staticcheck" in name or "lint" in name
+
+
 def _is_fleet_name(name: str) -> bool:
     """Fleet/router/failover artifacts by name — the replicated-
     serving evidence (SIGKILLed replicas with zero acked-request loss,
@@ -219,6 +230,12 @@ def validate_file(path):
                     "compile-amortization evidence must be "
                     "attributable, allowlist or not "
                     "(utils/telemetry.provenance)")
+            if not has_prov and _is_staticcheck_name(name):
+                problems.append(
+                    "staticcheck/lint artifact without a provenance "
+                    "line — an invariant-analyzer verdict must be "
+                    "attributable, allowlist or not "
+                    "(utils/telemetry.provenance)")
         else:
             with open(path) as f:
                 doc = json.load(f)
@@ -256,6 +273,12 @@ def validate_file(path):
                     "fused-sweep artifact without provenance keys "
                     f"{PROVENANCE_KEYS} — compile-amortization "
                     "evidence must be attributable, allowlist or not")
+            elif _is_staticcheck_name(name) \
+                    and not _has_provenance_keys(doc):
+                problems.append(
+                    "staticcheck/lint artifact without provenance "
+                    f"keys {PROVENANCE_KEYS} — an invariant-analyzer "
+                    "verdict must be attributable, allowlist or not")
             elif name not in LEGACY and not _has_provenance_keys(doc):
                 problems.append(
                     "new-format json without provenance keys "
