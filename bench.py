@@ -239,7 +239,8 @@ def body():
     families = run_churn_families(on_tpu)
     print(json.dumps(measurement_line(rate, backend, n, variant, rounds, dt,
                                       compile_split=split,
-                                      families=families)))
+                                      families=families,
+                                      plan=plan_for_headline(backend))))
     return 0
 
 
@@ -295,8 +296,111 @@ def last_tpu_capture():
     return best
 
 
+# The documented reference topology for planning the 100M headline off
+# hardware (a v4-8-class host: 8 chips x 16 GiB HBM, one slice) — the
+# CPU fallback line plans against THIS so the scoreboard always says
+# what tiling the next TPU window should run; a real TPU line plans
+# against the DETECTED topology instead.
+REFERENCE_TPU_CHIPS = 8
+REFERENCE_TPU_HBM_BYTES = 16 * 1024**3
+HEADLINE_TARGET_N = 100_000_000
+HEADLINE_RUMORS = 64
+
+
+def plan_for_headline(backend):
+    """Optional ``plan`` object for the scoreboard line (the scale-
+    planner PR): what word-plane tiling the HBM budget model picks for
+    the 100M-node headline — so when hardware returns, the headline
+    can move to node-rounds/s/chip AT 100M with the tiling already
+    decided.  Predicted peak bytes come from the plan; the measured
+    side rides the newest committed scale record (predicted-vs-
+    measured at ITS n — the model-validation evidence), since the
+    bench never executes the 100M leg itself (that is the hw_refresh
+    scale_plan step's job).  Returns None if the planner cannot load
+    (this function must never cost the scoreboard its line — the
+    last_tpu_capture wedge-resilience rule); an INFEASIBLE target
+    returns the refusal, binding constraint named — the scoreboard
+    must say which wall, not go quiet."""
+    import jax
+
+    try:
+        from gossip_tpu.planner import budget as PB
+        if backend == "tpu":
+            # any of these can fail on an odd platform (memory_stats
+            # None-or-raise, slice detection, a chip count the mesh
+            # rule refuses) — the scoreboard line outranks the plan
+            devs = jax.devices()
+            stats = devs[0].memory_stats() or {}
+            from gossip_tpu.parallel.multislice import detect_slices
+            dev = PB.DeviceSpec(
+                chips=len(devs),
+                hbm_bytes_per_chip=int(
+                    stats.get("bytes_limit",
+                              REFERENCE_TPU_HBM_BYTES)),
+                slices=detect_slices(devs))
+            source = "detected"
+        else:
+            dev = PB.DeviceSpec(
+                chips=REFERENCE_TPU_CHIPS,
+                hbm_bytes_per_chip=REFERENCE_TPU_HBM_BYTES)
+            source = "reference"
+        out = {"target_n": HEADLINE_TARGET_N,
+               "rumors": HEADLINE_RUMORS, "chips": dev.chips,
+               "hbm_bytes_per_chip": dev.hbm_bytes_per_chip,
+               "slices": dev.slices, "source": source}
+        try:
+            plan = PB.plan_scale(HEADLINE_TARGET_N,
+                                 rumors=HEADLINE_RUMORS, device=dev,
+                                 fanout=1, max_rounds=64)
+        except PB.InfeasiblePlanError as e:
+            out.update(infeasible=str(e), binding=e.binding)
+            return out
+        out.update(tiles=plan.tiles, bucket_words=plan.bucket_words,
+                   predicted_peak_device_bytes=
+                   plan.predicted_peak_device_bytes,
+                   binding=plan.binding)
+        out["record"] = last_scale_record()
+        return out
+    except Exception:
+        return None
+
+
+def last_scale_record():
+    """Newest committed streamed-scale record's predicted-vs-measured
+    pair (artifacts/ledger_scale_r*.jsonl, .smoke excluded) — the
+    evidence that the budget model's predictions bound real
+    allocations.  None when no committed record exists."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    art_dir = os.path.join(repo, "artifacts")
+    best = None
+    try:
+        names = sorted(os.listdir(art_dir))
+    except OSError:
+        return None
+    for name in names:
+        if not (name.startswith("ledger_scale_r")
+                and name.endswith(".jsonl") and ".smoke" not in name):
+            continue
+        try:
+            from gossip_tpu.utils import telemetry
+            events = telemetry.load_ledger(
+                os.path.join(art_dir, name), run="last")
+        except (OSError, ValueError):
+            continue
+        recs = [e for e in events if e.get("ev") == "scale_record"]
+        if recs:
+            r = recs[-1]
+            best = {"artifact": os.path.join("artifacts", name),
+                    "n": r.get("n"), "tiles": r.get("tiles"),
+                    "predicted_peak_device_bytes":
+                        r.get("predicted_peak_device_bytes"),
+                    "measured_loop_bytes": r.get("measured_loop_bytes"),
+                    "ok": r.get("ok")}
+    return best
+
+
 def measurement_line(rate, backend, n, variant, rounds, dt,
-                     compile_split=None, families=None):
+                     compile_split=None, families=None, plan=None):
     """The one-JSON-line scoreboard contract (tests/test_bench_contract.py).
 
     ``vs_baseline`` compares against a TPU-derived north-star rate, so it
@@ -319,7 +423,14 @@ def measurement_line(rate, backend, n, variant, rounds, dt,
     ``churn_heal`` (the flagship config under a full fault program)
     and ``churn_sweep`` (K scenarios, one executable, with the
     first/warm amortization split) — ride the line the same optional
-    way, honestly tagged by the line's own ``backend``."""
+    way, honestly tagged by the line's own ``backend``.
+
+    ``plan`` (the scale-planner PR): the 100M-node headline's capacity
+    plan — target N, tiles/bucket, predicted peak device bytes against
+    the detected (TPU) or reference (fallback) topology, plus the
+    newest committed scale record's predicted-vs-measured pair — so
+    the scoreboard names the tiling the next hardware window should
+    run (:func:`plan_for_headline`)."""
     on_tpu = backend == "tpu"
     line = {
         "metric": "node_rounds_per_sec_per_chip",
@@ -334,6 +445,8 @@ def measurement_line(rate, backend, n, variant, rounds, dt,
         line["compile_split"] = compile_split
     if families is not None:
         line["families"] = families
+    if plan is not None:
+        line["plan"] = plan
     if not on_tpu:
         line["last_tpu"] = last_tpu_capture()
     return line

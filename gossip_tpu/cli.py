@@ -178,6 +178,13 @@ def _add_run_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--resume", action="store_true",
                    help="load --checkpoint PATH and continue to "
                         "max_rounds total rounds")
+    p.add_argument("--plan", default=None, metavar="FILE",
+                   help="execute a ScalePlan (from `gossip_tpu plan`) "
+                        "through the streamed word-plane tile driver "
+                        "instead of the flag-configured run; composes "
+                        "with --checkpoint/--resume (the plan carries "
+                        "n/rumors/fanout/faults/segments — "
+                        "docs/SCALING.md)")
     p.add_argument("--save-curve", default=None, metavar="PATH",
                    help="write the coverage curve as JSONL (implies --curve)")
     p.add_argument("--ensemble", type=int, default=0, metavar="S",
@@ -306,6 +313,30 @@ def _args_to_configs(a):
 def cmd_run(a) -> int:
     from gossip_tpu.backend import run_simulation
     from gossip_tpu.utils.trace import trace   # trace(None) is a no-op
+    if a.plan:
+        # a plan file IS the run configuration (n/mode/rumors/faults/
+        # segments all come from it); any run-shape flag changed from
+        # its parser default would be silently discarded, so it is
+        # refused instead (no-silent-drop policy).  The default map is
+        # read from the live parser at registration time
+        # (_PLAN_GUARDED_RUN_FLAGS in main), so this check cannot
+        # drift from the real defaults.
+        changed = [f"--{k.replace('_', '-')}"
+                   for k, d in a.plan_guard_defaults.items()
+                   if getattr(a, k) != d]
+        if a.ensemble > 1 or a.parity_check or a.curve or a.save_curve:
+            print("error: --plan executes the streamed scale driver; "
+                  "drop --ensemble/--parity-check/--curve/--save-curve",
+                  file=sys.stderr)
+            return 2
+        if changed:
+            print("error: --plan takes the run shape from the plan "
+                  f"file; drop {' '.join(sorted(changed))} (regenerate "
+                  "the plan with `gossip_tpu plan` to change them)",
+                  file=sys.stderr)
+            return 2
+        return _run_plan_file(a.plan, checkpoint=a.checkpoint,
+                              resume=a.resume)
     proto, tc, run, fault, mesh = _args_to_configs(a)
     if a.parity_check and a.ensemble > 1:
         # the ensemble branch would otherwise win and silently discard
@@ -1335,6 +1366,117 @@ def cmd_route(a) -> int:
     return 0
 
 
+def _device_spec_from_flags(a):
+    from gossip_tpu.planner.budget import DeviceSpec
+    return DeviceSpec(
+        chips=a.chips,
+        hbm_bytes_per_chip=int(a.hbm_gb * 1024**3),
+        slices=a.slices,
+        host_ram_bytes=int(a.host_ram_gb * 1024**3))
+
+
+def _plan_fault_from_flags(a):
+    ch = _parse_scenario(a.scenario) if a.scenario else None
+    if ch is None and a.death == 0.0 and a.drop == 0.0:
+        return None
+    return FaultConfig(node_death_rate=a.death, drop_prob=a.drop,
+                       seed=a.fault_seed, churn=ch)
+
+
+def cmd_plan(a) -> int:
+    """Capacity planning without a device: print (or validate) a
+    ScalePlan as JSON — what word-plane tiling / segment schedule /
+    mesh shape fits N on the given topology, or a LOUD refusal naming
+    the binding constraint (planner/budget, docs/SCALING.md).  Pure
+    host arithmetic; runs on a wedged-tunnel box."""
+    from gossip_tpu.planner import budget as PB
+    if a.validate:
+        try:
+            with open(a.validate) as f:
+                doc = json.load(f)
+            plan = PB.plan_from_dict(doc)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(json.dumps({"plan_valid": True, "n": plan.n,
+                          "tiles": plan.tiles,
+                          "bucket_words": plan.bucket_words,
+                          "fingerprint": PB.plan_fingerprint(
+                              plan.to_dict())}))
+        return 0
+    try:
+        fault = _plan_fault_from_flags(a)
+        reserve = (PB.DEFAULT_RESERVE_FRAC if a.reserve is None
+                   else a.reserve)
+        plan = PB.plan_scale(
+            a.n, rumors=a.rumors, device=_device_spec_from_flags(a),
+            engine=a.engine, fanout=a.fanout, max_rounds=a.max_rounds,
+            seed=a.seed, origin=a.origin, fault=fault,
+            segment_every=a.segment_every, reserve_frac=reserve)
+    except PB.InfeasiblePlanError as e:
+        # the refusal IS the product here: one line, constraint named
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    text = plan.to_json()
+    if a.out:
+        with open(a.out, "w") as f:
+            f.write(text + "\n")
+        print(json.dumps({"plan_written": a.out, "n": plan.n,
+                          "tiles": plan.tiles,
+                          "bucket_words": plan.bucket_words,
+                          "predicted_peak_device_bytes":
+                          plan.predicted_peak_device_bytes,
+                          "binding": plan.binding}))
+    else:
+        print(text)
+    return 0
+
+
+def _run_plan_file(path: str, *, checkpoint=None, resume=False,
+                   check_bitwise=False, measure_memory=False) -> int:
+    """Load a plan file and execute it through the streamed driver —
+    shared by ``scale-run`` and ``run --plan`` so the two surfaces
+    cannot drift."""
+    from gossip_tpu.planner import budget as PB
+    from gossip_tpu.planner.stream import run_at_scale
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        plan = PB.plan_from_dict(doc)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if resume and not checkpoint:
+        print("error: --resume needs --checkpoint PATH",
+              file=sys.stderr)
+        return 2
+    try:
+        res = run_at_scale(plan, checkpoint_path=checkpoint,
+                           resume=resume, check_bitwise=check_bitwise,
+                           measure_memory=measure_memory)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    out = res.to_dict()
+    out["plan_fingerprint"] = PB.plan_fingerprint(plan.to_dict())
+    print(json.dumps(out))
+    if check_bitwise and res.bitwise_equal is not True:
+        return 1
+    return 0
+
+
+def cmd_scale_run(a) -> int:
+    """Execute a ScalePlan: stream word-plane tiles through the packed
+    engine per checkpoint segment (planner/stream, docs/SCALING.md)."""
+    return _run_plan_file(a.plan, checkpoint=a.checkpoint,
+                          resume=a.resume,
+                          check_bitwise=a.check_bitwise,
+                          measure_memory=a.measure_memory)
+
+
 def cmd_staticcheck(a) -> int:
     """AST invariant analyzer over the repo's own source (pure stdlib
     — never initializes jax, so it runs on a wedged-tunnel box):
@@ -1462,7 +1604,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = sub.add_parser("run", help="run one simulation")
     _add_run_flags(p)
     _add_cache_flags(p)
-    p.set_defaults(fn=cmd_run)
+    # Flags that COMPOSE with --plan (everything else is run-shape the
+    # plan file carries, and cmd_run refuses it when changed from its
+    # default — no-silent-drop).  The guarded set is EVERY other run
+    # flag, derived from the live parser's own defaults via
+    # parse_args([]), so a future _add_run_flags addition is guarded
+    # automatically instead of silently discarded; the four
+    # output-shape flags get their own earlier refusal message.
+    _PLAN_COMPOSABLE_FLAGS = {
+        "plan", "checkpoint", "resume", "compile_cache",
+        "no_compile_cache", "ensemble", "parity_check", "curve",
+        "save_curve"}
+    _run_defaults = {k: v for k, v in vars(p.parse_args([])).items()
+                     if k not in _PLAN_COMPOSABLE_FLAGS}
+    p.set_defaults(fn=cmd_run, plan_guard_defaults=_run_defaults)
 
     p = sub.add_parser("sweep", help="run the 5 BASELINE benchmark configs")
     p.add_argument("--scale", type=float, default=1.0,
@@ -1819,6 +1974,77 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.set_defaults(fn=cmd_route)
 
     p = sub.add_parser(
+        "plan",
+        help="HBM budget model: what word-plane tiling fits N on this "
+             "topology? (prints a ScalePlan as JSON, or refuses "
+             "naming the binding constraint; pure host arithmetic — "
+             "docs/SCALING.md)")
+    p.add_argument("--n", type=int, default=100_000_000,
+                   help="target node count")
+    p.add_argument("--rumors", type=int, default=64)
+    p.add_argument("--fanout", type=int, default=1)
+    p.add_argument("--engine", default="packed",
+                   choices=("packed", "dense", "fused"),
+                   help="engine byte model (only 'packed' is "
+                        "executable by scale-run)")
+    p.add_argument("--max-rounds", type=int, default=64)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--origin", type=int, default=0)
+    p.add_argument("--chips", type=int, default=1,
+                   help="total chip count")
+    p.add_argument("--hbm-gb", type=float, default=16.0,
+                   help="HBM per chip (GiB); fractional values allowed "
+                        "(the dry-run family plans against artificial "
+                        "budgets)")
+    p.add_argument("--slices", type=int, default=1,
+                   help="DCN slices (chips/slices = the ICI inner "
+                        "axis; >1 emits the hybrid mesh)")
+    p.add_argument("--host-ram-gb", type=float, default=64.0)
+    p.add_argument("--segment-every", type=int, default=None,
+                   help="checkpoint segment length in rounds")
+    p.add_argument("--reserve", type=float, default=None,
+                   help="HBM fraction held back from the plan "
+                        "(default: planner/budget"
+                        ".DEFAULT_RESERVE_FRAC, 0.08)")
+    p.add_argument("--death", type=float, default=0.0)
+    p.add_argument("--drop", type=float, default=0.0)
+    p.add_argument("--fault-seed", type=int, default=0)
+    p.add_argument("--scenario", default=None,
+                   help="fault program spec, the churn-sweep syntax: "
+                        "'event=N:D[:R];partition=S:E:C;ramp=S:E:P0:P1'")
+    p.add_argument("--out", default=None, metavar="FILE",
+                   help="write the plan JSON here instead of stdout")
+    p.add_argument("--validate", default=None, metavar="FILE",
+                   help="validate an existing plan file instead of "
+                        "planning")
+    p.set_defaults(fn=cmd_plan)
+
+    p = sub.add_parser(
+        "scale-run",
+        help="execute a ScalePlan: stream word-plane tiles through "
+             "the packed engine per checkpoint segment "
+             "(docs/SCALING.md)")
+    p.add_argument("--plan", required=True, metavar="FILE",
+                   help="plan JSON from `gossip_tpu plan`")
+    p.add_argument("--checkpoint", default=None, metavar="PATH",
+                   help="publish an atomic npz checkpoint per segment")
+    p.add_argument("--resume", action="store_true",
+                   help="continue from --checkpoint (refuses a "
+                        "mismatched plan or fault-program fingerprint)")
+    p.add_argument("--check-bitwise", action="store_true",
+                   help="also run the untiled in-memory reference and "
+                        "gate byte equality (exit 1 on mismatch)")
+    p.add_argument("--measure-memory", action="store_true",
+                   help="AOT memory analysis of the tile loop "
+                        "(one extra compile)")
+    # the same cache + multi-host init the equivalent `run --plan`
+    # path gets (main()'s dispatch list includes scale-run): a big-N
+    # tile loop's compile is exactly what the persistent cache exists
+    # to amortize
+    _add_cache_flags(p)
+    p.set_defaults(fn=cmd_scale_run)
+
+    p = sub.add_parser(
         "staticcheck",
         help="AST invariant analyzer over the repo source: "
              "recompile-hazard lint (serving/sweep), rpc lock "
@@ -1902,7 +2128,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     a = ap.parse_args(argv)
     try:
         if a.cmd in ("run", "sweep", "grid", "churn-sweep", "crdt",
-                     "log", "txn", "serve"):
+                     "log", "txn", "serve", "scale-run"):
             # multi-host pods: one jax.distributed.initialize() per host
             # before any jax API (no-op without the coordinator env vars)
             from gossip_tpu.parallel.multislice import maybe_init_distributed

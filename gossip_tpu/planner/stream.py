@@ -1,0 +1,459 @@
+"""Execute a ScalePlan: stream word-plane tiles through the packed
+pull engine.
+
+Why tiling along the WORD-PLANE axis is exact: a packed PULL round's
+partner draws, drop coins, liveness rows and partition cuts are all
+functions of ``(base_key, round, node id)`` — never of plane CONTENT
+(models/si_packed.make_packed_round; the same fact behind the fused
+engine's zero-ICI plane sharding).  Gather-then-OR commutes with
+column slicing, so a tile of Wt < W word planes runs the IDENTICAL
+trajectory on its own columns, and the concatenation of T streamed
+tiles is BITWISE the untiled in-memory run — the gate
+:func:`untiled_reference` + ``check_bitwise`` asserts, and
+tests/test_planner.py pins under a mixed fault program.
+
+Execution contract (the PR 6/9 operand discipline):
+
+* ONE compiled loop per tile-shape bucket: every tile pads its words
+  to the plan's pow2 ``bucket_words`` (padded planes are zero words —
+  inert under the OR-merge), all tiles share one step closure
+  (``_tile_step``, memoized with the schedule content STRIPPED from
+  the key), and the segment runner is utils/checkpoint's — so K tiles
+  compile once and a salted re-entry compiles zero
+  (``assert_compiles``-pinned).
+* Tile content is operands: the tile words ride ``device_put`` (double
+  -buffered — the next tile's transfer is issued before the current
+  tile's result is fetched, so jax's async dispatch overlaps copy with
+  compute), the nemesis schedule rides the step's table tail.
+* Crash safety reuses the checkpoint cursor discipline: the full
+  packed state lives on the HOST between segments, every published
+  checkpoint carries the absolute round cursor + exact ``dropped``
+  carry + the plan AND fault-program fingerprints, and ``--resume``
+  refuses a mismatch loudly (utils/checkpoint crash contract; resume
+  == straight streamed run bitwise, test-pinned).
+
+Scope refusals (loud, never silent): engine != packed, mode != pull,
+``dcn_slices`` > 1 (the multi-slice tile fan-out is the hardware-
+capture remainder — tools/hw_refresh runs this executor per slice at
+the window), explicit topologies (a 100M-row neighbor table is its own
+budget item the streamed drivers do not yet carry).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import numpy as np
+
+from gossip_tpu import config as C
+from gossip_tpu.config import FaultConfig, ProtocolConfig
+from gossip_tpu.planner.budget import (ScalePlan, plan_fingerprint,
+                                       WORD_BITS, WORD_BYTES)
+
+
+@dataclasses.dataclass
+class ScaleRunResult:
+    """What a streamed run reports (CLI/tools print it as JSON)."""
+
+    n: int
+    rounds: int
+    coverage: float
+    msgs: float
+    dropped: float
+    tiles: int
+    bucket_words: int
+    segments_run: int
+    resumed: bool
+    halted: bool                       # stopped by halt_after_segments
+    bitwise_equal: Optional[bool]      # vs untiled_reference, if checked
+    measured_loop_bytes: Optional[int]
+    predicted_peak_device_bytes: int
+    final_state: Optional[np.ndarray]  # uint32[n, W] when keep_state
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d.pop("final_state")
+        return d
+
+
+def host_init_packed(n: int, rumors: int, origin: int) -> np.ndarray:
+    """uint32[n, W] initial packed state in NUMPY — bitwise the jax
+    ``pack(init_state(...).seen)`` (rumor r starts at node
+    ``(origin + r) % n``, models/state.init_state; pinned equal in
+    tests/test_planner.py) without ever allocating the bool[N, R]
+    table the jax path goes through: at 100M nodes the device-side
+    init IS the budget item streaming exists to avoid."""
+    w = (rumors + WORD_BITS - 1) // WORD_BITS
+    out = np.zeros((n, w), np.uint32)
+    r = np.arange(rumors)
+    rows = (origin + r) % n
+    bits = np.left_shift(np.uint32(1),
+                         (r % WORD_BITS).astype(np.uint32),
+                         dtype=np.uint32)
+    np.bitwise_or.at(out, (rows, r // WORD_BITS), bits)
+    return out
+
+
+# step closures memoized with schedule CONTENT stripped from the key
+# (the parallel/sharded._cached_dense_loop discipline): two fault
+# programs sharing (static fault, canonical horizon bucket) get ONE
+# step object, so the jitted segment runner's cache serves both and a
+# salted scenario re-entry compiles zero.  BOUNDED FIFO: the keys are
+# tuples, so the utils/checkpoint weak-key trick cannot apply — an
+# unbounded strong dict would pin every step closure (and, through
+# checkpoint._segment_runners' weak keys, its jitted executables)
+# forever in a long-lived process.  Evicting the oldest entry lets
+# the weak runner cache drop with it; a scale run uses ONE entry, so
+# 16 covers any realistic session with zero re-trace churn.
+_STEP_CACHE: "dict" = {}
+_STEP_CACHE_MAX = 16
+
+
+def _tile_step(proto: ProtocolConfig, n: int,
+               fault: Optional[FaultConfig], origin: int, mesh):
+    """(step, schedule tables) for streaming tiles of any word width.
+    The packed PULL step never bakes the word count (its trace is
+    width-polymorphic — jit specializes per tile-shape bucket), and
+    bakes no schedule content (tables are operands)."""
+    import jax.numpy as jnp  # noqa: F401  (jax import deferred)
+    from gossip_tpu.models.si_packed import make_packed_round
+    from gossip_tpu.ops import nemesis as NE
+    from gossip_tpu.topology import generators as G
+
+    ch = NE.get(fault)
+    fault_static = (None if fault is None
+                    else dataclasses.replace(fault, churn=None))
+    t_pad = None if ch is None else NE.canonical_horizon(ch)
+    key = (proto, n, fault_static, t_pad, origin, mesh)
+    step = _STEP_CACHE.get(key)
+    topo = G.complete(n)
+    if step is None:
+        if mesh is None:
+            step, _ = make_packed_round(proto, topo, fault, origin,
+                                        tabled=True)
+        else:
+            from gossip_tpu.parallel.sharded_packed import (
+                make_sharded_packed_round)
+            step, _ = make_sharded_packed_round(proto, topo, mesh,
+                                                fault, origin,
+                                                tabled=True)
+        while len(_STEP_CACHE) >= _STEP_CACHE_MAX:
+            _STEP_CACHE.pop(next(iter(_STEP_CACHE)))
+        _STEP_CACHE[key] = step
+    tables = ()
+    if ch is not None:
+        n_pad = n
+        if mesh is not None:
+            from gossip_tpu.parallel.sharded import pad_to_mesh
+            n_pad = pad_to_mesh(n, mesh, "nodes")
+        tables = NE.sched_args(NE.build(fault, n, n_pad, t_pad=t_pad))
+    return step, tables
+
+
+def _refuse(plan: ScalePlan) -> None:
+    if plan.engine != "packed":
+        raise ValueError(
+            f"run_at_scale streams the packed engine only; plan says "
+            f"engine={plan.engine!r} (the budget model covers it, the "
+            "streamed executor does not — docs/SCALING.md scope)")
+    if plan.mode != C.PULL:
+        raise ValueError(
+            f"run_at_scale streams PULL rounds only, got mode="
+            f"{plan.mode!r} (anti-entropy's reverse delta writes "
+            "cross-tile state — planner/budget.plan_scale already "
+            "refuses this at plan time)")
+    if plan.dcn_slices > 1:
+        raise ValueError(
+            f"plan wants {plan.dcn_slices} DCN slices; this executor "
+            "streams the tile axis serially on one slice — the multi-"
+            "slice tile fan-out rides tools/hw_refresh at the capture "
+            "window (ROADMAP item 3 remainder)")
+
+
+def _mesh_for(plan: ScalePlan):
+    if plan.per_slice == 1:
+        return None
+    from gossip_tpu.parallel.sharded import make_mesh
+    return make_mesh(plan.per_slice, axis_name="nodes")
+
+
+def _measure_loop_bytes(runner, *args) -> Optional[int]:
+    """Peak bytes of the compiled tile loop via AOT memory analysis
+    (argument + output + temp) — the 'measured allocation' the
+    committed record holds the prediction against.  None when the
+    backend cannot report it."""
+    try:
+        stats = runner.lower(*args).compile().memory_analysis()
+        return int(stats.argument_size_in_bytes
+                   + stats.output_size_in_bytes
+                   + stats.temp_size_in_bytes)
+    except Exception:
+        return None
+
+
+def host_coverage(state: np.ndarray, rumors: int,
+                  alive: Optional[np.ndarray] = None,
+                  chunk: int = 1 << 20) -> float:
+    """Min-over-rumors coverage of a host packed state — the numpy
+    twin of ops/bitpack.coverage_packed (integer counts, ONE division
+    at the end: the device-division-lottery discipline), chunked so a
+    100M-row table never materializes its bool expansion."""
+    n, w = state.shape
+    counts = np.zeros(w * WORD_BITS, np.int64)
+    denom = 0
+    # the 32x bit expansion below transiently allocates rows*w*32
+    # uint32s — bound it by WORDS processed, not rows, or a wide
+    # state's "chunk" is the whole table (a ~GiB spike at the
+    # committed-record shape)
+    chunk = max(1, chunk // max(w, 1))
+    for lo in range(0, n, chunk):
+        rows = state[lo:lo + chunk]
+        if alive is not None:
+            m = alive[lo:lo + chunk]
+            rows = rows[m]
+            denom += int(m.sum())
+        else:
+            denom += rows.shape[0]
+        bits = (rows[:, :, None] >> np.arange(WORD_BITS,
+                                              dtype=np.uint32)) & 1
+        counts += bits.reshape(rows.shape[0], -1).sum(0, dtype=np.int64)
+    if denom == 0:
+        return 0.0
+    return float(counts[:rumors].min() / denom)
+
+
+def untiled_reference(plan: ScalePlan, mesh=None):
+    """The in-memory run at full word width W — ONE runner call over
+    the plan's whole round budget through the SAME step factory and
+    segment runner the tiles use.  Returns (uint32[n, W], msgs,
+    dropped).  This is what the streamed trajectory must equal
+    BITWISE."""
+    import jax
+    import jax.numpy as jnp
+    from gossip_tpu.ops import nemesis as NE
+    from gossip_tpu.utils.checkpoint import _segment_runner
+
+    _refuse(plan)
+    proto = ProtocolConfig(mode=plan.mode, fanout=plan.fanout,
+                           rumors=plan.rumors)
+    mesh = _mesh_for(plan) if mesh is None else mesh
+    step, tables = _tile_step(proto, plan.n, plan.fault, plan.origin,
+                              mesh)
+    track = NE.get(plan.fault) is not None
+    runner = _segment_runner(step, track)
+    seen = host_init_packed(plan.n, plan.rumors, plan.origin)
+    st = _place_tile(seen, plan.n, mesh, 0, plan.seed, 0.0)
+    if track:
+        (out, acc) = runner(st, plan.max_rounds, jnp.float32(0.0),
+                            *tables)
+        dropped = float(acc)
+    else:
+        out = runner(st, plan.max_rounds, *tables)
+        dropped = 0.0
+    final = np.asarray(out.seen)[:plan.n]
+    return final, float(out.msgs), dropped
+
+
+def _place_tile(words: np.ndarray, n: int, mesh, round_: int,
+                seed: int, msgs: float):
+    """Pad a host word tile to the mesh row count, ship it, and wrap
+    the SimState the packed step expects.  The device_put is the
+    double-buffer leg: issued eagerly, it overlaps the previous tile's
+    compute under async dispatch."""
+    import jax
+    import jax.numpy as jnp
+    from gossip_tpu.models.state import SimState
+
+    if mesh is None:
+        dev = jax.device_put(words)
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from gossip_tpu.parallel.sharded import pad_to_mesh
+        n_pad = pad_to_mesh(n, mesh, "nodes")
+        if n_pad != words.shape[0]:
+            words = np.concatenate(
+                [words, np.zeros((n_pad - n, words.shape[1]),
+                                 words.dtype)], axis=0)
+        dev = jax.device_put(words,
+                             NamedSharding(mesh, P("nodes", None)))
+    return SimState(seen=dev, round=jnp.int32(round_),
+                    base_key=jax.random.key(seed),
+                    msgs=jnp.float32(msgs))
+
+
+def run_at_scale(plan: ScalePlan, *, checkpoint_path: Optional[str] = None,
+                 resume: bool = False, check_bitwise: bool = False,
+                 measure_memory: bool = False, keep_state: bool = False,
+                 halt_after_segments: Optional[int] = None,
+                 mesh=None) -> ScaleRunResult:
+    """Drive a ScalePlan: T word-plane tiles stream host<->device
+    through each checkpoint segment (module doc has the contract).
+
+    ``halt_after_segments`` stops after that many segments WITH the
+    checkpoint published — the deterministic stand-in for a SIGKILL
+    between segments (tests and the capture tool resume from it and
+    must land bitwise on the uninterrupted run).  ``check_bitwise``
+    additionally runs :func:`untiled_reference` and compares the final
+    states byte-for-byte.  ``measure_memory`` AOT-compiles the tile
+    loop once more for its memory analysis — leave it off in compile-
+    count-pinned paths."""
+    import jax.numpy as jnp
+    from gossip_tpu.ops import nemesis as NE
+    from gossip_tpu.utils import telemetry
+    from gossip_tpu.utils.checkpoint import (_segment_runner, load_meta,
+                                             load_state, save_state)
+
+    _refuse(plan)
+    if resume and not checkpoint_path:
+        raise ValueError("resume=True needs checkpoint_path")
+    n, w_total = plan.n, plan.total_words
+    bucket = plan.bucket_words
+    tiles = plan.tiles
+    plan_doc = plan.to_dict()
+    plan_fp = plan_fingerprint(plan_doc)
+    fault_fp = NE.schedule_fingerprint(plan.fault, n, plan.origin)
+    proto = ProtocolConfig(mode=plan.mode, fanout=plan.fanout,
+                           rumors=plan.rumors)
+    mesh = _mesh_for(plan) if mesh is None else mesh
+    track = NE.get(plan.fault) is not None
+
+    base_round, dropped, msgs = 0, 0.0, 0.0
+    resumed = False
+    if resume:
+        meta = load_meta(checkpoint_path)
+        extra = meta.get("extra") or {}
+        if extra.get("scale_plan") != plan_fp:
+            raise ValueError(
+                f"checkpoint {checkpoint_path} was written under a "
+                f"different scale plan (fingerprint "
+                f"{extra.get('scale_plan')!r} != {plan_fp!r}) — "
+                "resuming a re-tiled run would make its budget claims "
+                "unattributable; regenerate or drop --resume")
+        if extra.get("fault_program") != fault_fp:
+            raise ValueError(
+                f"checkpoint {checkpoint_path} carries fault program "
+                f"{extra.get('fault_program')!r}; this plan builds "
+                f"{fault_fp!r} — a resumed fault program must be the "
+                "one the checkpoint ran (utils/checkpoint crash "
+                "contract)")
+        st = load_state(checkpoint_path)
+        # copy: np.asarray over a jax buffer is a read-only view, and
+        # the tile write-back mutates host in place
+        host = np.array(st.seen, np.uint32)
+        base_round = int(extra["round"])
+        dropped = float(extra.get("dropped", 0.0))
+        msgs = float(st.msgs)
+        resumed = True
+    else:
+        host = host_init_packed(n, plan.rumors, plan.origin)
+
+    step, tables = _tile_step(proto, n, plan.fault, plan.origin, mesh)
+    runner = _segment_runner(step, track)
+
+    def tile_cols(t):
+        lo = t * bucket
+        return lo, min(lo + bucket, w_total)
+
+    def prep(t, round_):
+        lo, hi = tile_cols(t)
+        cols = host[:, lo:hi]
+        if hi - lo < bucket:   # pad trailing planes: zero words are
+            cols = np.concatenate(   # inert under the OR-merge
+                [cols, np.zeros((n, bucket - (hi - lo)), np.uint32)],
+                axis=1)
+        return _place_tile(np.ascontiguousarray(cols), n, mesh, round_,
+                           plan.seed, msgs)
+
+    led = telemetry.current()
+    if led.active:
+        led.event("scale_plan", n=n, tiles=tiles, bucket_words=bucket,
+                  total_words=w_total, segments=plan.segment_count,
+                  predicted_peak_device_bytes=
+                  plan.predicted_peak_device_bytes,
+                  plan_fingerprint=plan_fp, resumed=resumed)
+
+    measured = None
+    segments_run = 0
+    halted = False
+    done = base_round
+    while done < plan.max_rounds:
+        todo = min(plan.segment_every, plan.max_rounds - done)
+        seg_msgs = seg_dropped = None
+        nxt = prep(0, done)
+        for t in range(tiles):
+            cur = nxt
+            if t + 1 < tiles:
+                nxt = prep(t + 1, done)
+            if track:
+                args = (cur, todo, jnp.float32(dropped)) + tables
+                if measured is None and measure_memory:
+                    measured = _measure_loop_bytes(runner, *args)
+                out, acc = runner(*args)
+                tile_dropped = float(acc)
+            else:
+                args = (cur, todo) + tables
+                if measured is None and measure_memory:
+                    measured = _measure_loop_bytes(runner, *args)
+                out = runner(*args)
+                tile_dropped = 0.0
+            tile_msgs = float(out.msgs)
+            if seg_msgs is None:
+                seg_msgs, seg_dropped = tile_msgs, tile_dropped
+            elif (tile_msgs, tile_dropped) != (seg_msgs, seg_dropped):
+                # every tile replays the SAME content-free message
+                # accounting; disagreement means the plane-independence
+                # contract broke — refuse before publishing state
+                raise AssertionError(
+                    f"tile {t} message accounting ({tile_msgs}, "
+                    f"{tile_dropped}) disagrees with tile 0 "
+                    f"({seg_msgs}, {seg_dropped}) — word planes are "
+                    "no longer trajectory-independent")
+            lo, hi = tile_cols(t)
+            host[:, lo:hi] = np.asarray(out.seen)[:n, :hi - lo]
+        done += todo
+        msgs, dropped = seg_msgs, seg_dropped
+        segments_run += 1
+        if checkpoint_path:
+            from gossip_tpu.models.state import SimState
+            import jax
+            save_state(checkpoint_path,
+                       SimState(seen=host, round=jnp.int32(done),
+                                base_key=jax.random.key(plan.seed),
+                                msgs=jnp.float32(msgs)),
+                       extra_meta={"round": done, "dropped": dropped,
+                                   "scale_plan": plan_fp,
+                                   "fault_program": fault_fp})
+            if led.active:
+                led.event("scale_segment", round=done, tiles=tiles,
+                          dropped=dropped)
+        if halt_after_segments is not None \
+                and segments_run >= halt_after_segments \
+                and done < plan.max_rounds:
+            halted = True
+            break
+
+    alive = None
+    if plan.fault is not None:
+        m = NE.metric_alive(plan.fault, n, plan.origin)
+        alive = None if m is None else np.asarray(m).astype(bool)
+    cov = host_coverage(host, plan.rumors, alive)
+
+    bitwise = None
+    if check_bitwise and not halted:
+        ref, ref_msgs, ref_dropped = untiled_reference(plan, mesh=mesh)
+        bitwise = (np.array_equal(ref, host)
+                   and ref_msgs == msgs and ref_dropped == dropped)
+    if led.active:
+        led.event("scale_run", rounds=done, coverage=cov, msgs=msgs,
+                  dropped=dropped, tiles=tiles, halted=halted,
+                  bitwise_equal=bitwise,
+                  measured_loop_bytes=measured)
+    return ScaleRunResult(
+        n=n, rounds=done, coverage=cov, msgs=msgs, dropped=dropped,
+        tiles=tiles, bucket_words=bucket, segments_run=segments_run,
+        resumed=resumed, halted=halted, bitwise_equal=bitwise,
+        measured_loop_bytes=measured,
+        predicted_peak_device_bytes=plan.predicted_peak_device_bytes,
+        final_state=host if keep_state else None)

@@ -110,6 +110,33 @@ def test_line_carries_churn_families():
         1.0, "cpu", 10, "x", 1, 1.0)
 
 
+def test_line_carries_headline_plan():
+    """Scale-planner PR: the 100M-node capacity plan rides the
+    scoreboard line as an optional ``plan`` object.  plan_for_headline
+    on the CPU fallback plans against the REFERENCE topology, names
+    the binding constraint, and carries the committed scale record's
+    predicted-vs-measured pair (the model-validation evidence shipped
+    with this tree); the object survives the JSON trip and is absent
+    when the body did not plan (old artifacts replay)."""
+    plan = bench.plan_for_headline("cpu")
+    assert plan["target_n"] == bench.HEADLINE_TARGET_N
+    assert plan["source"] == "reference"
+    assert plan["chips"] == bench.REFERENCE_TPU_CHIPS
+    # 100M x 64 rumors fits a v4-8-class host in the packed model
+    assert plan["tiles"] >= 1 and plan["binding"]
+    assert plan["predicted_peak_device_bytes"] > 0
+    rec = plan["record"]
+    assert rec is not None, "committed ledger_scale_r20 must resolve"
+    assert rec["ok"] is True
+    assert rec["measured_loop_bytes"] <= \
+        rec["predicted_peak_device_bytes"]
+    line = bench.measurement_line(1.0, "cpu", 10, "x", 1, 1.0,
+                                  plan=plan)
+    assert json.loads(json.dumps(line))["plan"]["record"]["ok"] is True
+    assert "plan" not in bench.measurement_line(
+        1.0, "cpu", 10, "x", 1, 1.0)
+
+
 def test_fallback_carries_last_tpu_pointer():
     """VERDICT r4 task 2: a wedged-tunnel fallback line must point at
     the newest COMMITTED TPU capture so the scoreboard survives a

@@ -22,12 +22,13 @@ _spec.loader.exec_module(ledger_diff)
 R09_4DEV = os.path.join(_REPO, "artifacts",
                         "ledger_dryrun_r09_4dev.jsonl")
 R09_8DEV = os.path.join(_REPO, "artifacts", "ledger_dryrun_r09.jsonl")
-# the fleet PR's 4-device record: same family set as the live dry run
-# (churn_heal, churn_sweep, crdt_counter, serving_batch, kafka_log,
-# txn_register, fused_churn_sweep AND fleet_failover included), so
-# the tier-1 gate compares every family like-for-like
-R18_4DEV = os.path.join(_REPO, "artifacts",
-                        "ledger_dryrun_r18_4dev.jsonl")
+# the scale-planner PR's 4-device record: same family set as the live
+# dry run (churn_heal, churn_sweep, crdt_counter, serving_batch,
+# kafka_log, txn_register, fused_churn_sweep, fleet_failover AND
+# scale_plan included), so the tier-1 gate compares every family
+# like-for-like
+R20_4DEV = os.path.join(_REPO, "artifacts",
+                        "ledger_dryrun_r20_4dev.jsonl")
 
 
 def _write_run(path, families, device_count=4, metrics=None,
@@ -235,7 +236,7 @@ def test_committed_4dev_record_vs_fresh_dryrun_is_clean(dryrun_pair,
     own absolute budget check — which never flaked — flags it.  The
     first_ms wall mechanism itself stays pinned on the synthetic
     fixtures above and the injected-regression test below."""
-    rc = ledger_diff.main([R18_4DEV,
+    rc = ledger_diff.main([R20_4DEV,
                            dryrun_pair["warm"]["ledger_path"],
                            "--first-floor-ms", "10000",
                            "--steady-floor-ms", "150"])
@@ -260,7 +261,7 @@ def test_committed_record_with_injected_2x_wall_is_flagged(tmp_path,
     calibration that forgives uniform host load, proving the
     thresholds catch a real regression, not just synthetic
     fixtures."""
-    events = telemetry.load_ledger(R18_4DEV)
+    events = telemetry.load_ledger(R20_4DEV)
     runs = [e["run"] for e in events if e.get("ev") == "provenance"]
     warm = runs[-1]
     doubled = str(tmp_path / "doubled.jsonl")
@@ -271,7 +272,7 @@ def test_committed_record_with_injected_2x_wall_is_flagged(tmp_path,
     # above goes further and hands first_ms detection to the
     # cache-verdict assertions entirely; this pin keeps the wall path
     # honest for manual/CLI use)
-    with open(R18_4DEV) as f, open(doubled, "w") as g:
+    with open(R20_4DEV) as f, open(doubled, "w") as g:
         for line in f:
             if not line.strip():
                 continue
@@ -282,7 +283,7 @@ def test_committed_record_with_injected_2x_wall_is_flagged(tmp_path,
                     if isinstance(e.get(k), (int, float)):
                         e[k] = 2 * e[k]
             g.write(json.dumps(e) + "\n")
-    rc = ledger_diff.main([R18_4DEV, doubled, "--first-floor-ms",
+    rc = ledger_diff.main([R20_4DEV, doubled, "--first-floor-ms",
                            "1000", "--steady-floor-ms", "150"])
     out = capsys.readouterr().out
     assert rc == 1

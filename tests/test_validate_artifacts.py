@@ -231,3 +231,40 @@ def test_staticcheck_artifacts_must_be_attributable(tmp_path):
     with telemetry.artifact_ledger(str(good)) as led:
         led.event("staticcheck", verdict="clean", findings=0)
     assert va.validate_file(str(good)) == []
+
+
+def test_scale_plan_budget_artifacts_must_be_attributable(tmp_path):
+    """A ``*scale*``/``*plan*``/``*budget*`` artifact without
+    provenance fails — capacity plans and streamed-tiling records
+    (gossip_tpu/planner + tools/scale_capture.py) are the 100M-node
+    scaling evidence and can never be grandfathered, jsonl or json
+    alike.  The ONE colliding legacy name
+    (dryrun_steady_budget_r06.json — the round-6 steady-wall budget
+    snapshot docs/PERF.md cites) is carved out explicitly and stays on
+    the ordinary legacy list."""
+    for name in ("ledger_scale_r99.jsonl", "ledger_plan_r99.jsonl",
+                 "hbm_budget_r99.jsonl"):
+        bad = tmp_path / name
+        bad.write_text(json.dumps({"ev": "scale_record", "ok": True})
+                       + "\n")
+        problems = va.validate_file(str(bad))
+        assert any("provenance" in p for p in problems), (name,
+                                                          problems)
+
+    badj = tmp_path / "scale_plan_r99.json"
+    badj.write_text(json.dumps({"tiles": 4}))
+    problems = va.validate_file(str(badj))
+    assert any("provenance" in p for p in problems), problems
+
+    good = tmp_path / "ledger_scale_r98.jsonl"
+    with telemetry.Ledger(str(good)) as led:
+        led.event("scale_record", ok=True, tiles=4)
+    assert va.validate_file(str(good)) == []
+
+    # the carve-out: matcher-excluded by exact name, still legacy-
+    # allowlisted — and the committed file still parses
+    assert not va._is_scale_name("dryrun_steady_budget_r06.json")
+    assert va._is_scale_name("dryrun_steady_budget_r07.json")
+    committed = os.path.join(va.REPO, "artifacts",
+                             "dryrun_steady_budget_r06.json")
+    assert va.validate_file(committed) == []

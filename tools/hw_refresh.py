@@ -36,6 +36,11 @@ important captures first):
   5b. fused churn sweep: K mixed fault scenarios through ONE fused
      executable, solo-recompile vs warm ratio on real Mosaic kernels
      -> artifacts/ledger_fused_sweep_r17.jsonl (fused-operand PR)
+  5c. scale planner: the streamed bit-plane tiling record (N = 2^20
+     forced to >= 4 tiles, bitwise + coverage + memory-prediction
+     gates), and on a real TPU backend the 100M-node --full-scale leg
+     planned against the DETECTED chip/HBM/slice topology
+     -> artifacts/ledger_scale_r20.jsonl (scale-planner PR)
   6. roofline: utilization vs first-principles floors, both fused
      layouts -> artifacts/roofline_r05.json  (task 3)
   7. the five BASELINE configs at full scale, SWIM row under the
@@ -145,6 +150,7 @@ def worst_case_budget_s():
     constants)."""
     return (swim_ab_budget_s() + KERNEL_NUMBERS_TIMEOUT_S + MR_TIMEOUT_S
             + PRNG_TIMEOUT_S + FUSED_SWEEP_TIMEOUT_S
+            + SCALE_TIMEOUT_S + FULL_SCALE_TIMEOUT_S
             + FLEET_TIMEOUT_S + ROOFLINE_TIMEOUT_S + SWEEP_TIMEOUT_S
             + SWIM_ABLATION_TIMEOUT_S + ENSEMBLES_TIMEOUT_S
             + bench_budget_s() + TESTS_TIMEOUT_S)
@@ -418,6 +424,36 @@ def staticcheck():
     return _run_tool("staticcheck.py", STATICCHECK_TIMEOUT_S)
 
 
+def scale_plan():
+    """The scale planner's streamed-tiling record on this host
+    (tools/scale_capture.py): N = 2^20 forced to >= 4 streamed word-
+    plane tiles, bitwise-vs-untiled + coverage-1.0 + memory-prediction
+    gates — the structural proof refreshed at the capture window.  On
+    a real TPU backend the tool is then re-run with ``--full-scale``:
+    the 100M-node leg plans against the DETECTED chip/HBM/slice
+    topology and executes — gated on real HBM only, which is why the
+    committed record stays the CPU structural proof until a window
+    lands (ROADMAP item 3)."""
+    line = _run_tool("scale_capture.py", SCALE_TIMEOUT_S)
+    if line.get("backend") == "tpu":
+        p = subprocess.run([sys.executable,
+                            os.path.join(REPO, "tools",
+                                         "scale_capture.py"),
+                            "--full-scale", *_smoke_argv()],
+                           capture_output=True, text=True,
+                           timeout=FULL_SCALE_TIMEOUT_S, cwd=REPO,
+                           env=_body_env())
+        if p.returncode == 2:
+            raise WedgeDetected("scale_capture --full-scale rc 2\n"
+                                + (p.stderr or p.stdout)[-400:])
+        if p.returncode != 0:
+            raise RuntimeError(f"full-scale rc {p.returncode}\n"
+                               + (p.stderr or p.stdout)[-400:])
+        line["full_scale"] = json.loads(
+            p.stdout.strip().splitlines()[-1])
+    return line
+
+
 def fleet_failover():
     """The replicated serving fleet's crashloop on this host
     (tools/fleet_crashloop.py): the load mix through the fronting
@@ -620,6 +656,8 @@ def tpu_pallas_tests():
 # A window that closes mid-run lands the most important steps first;
 # retries are incremental (pending steps only).
 FLEET_TIMEOUT_S = 1200
+SCALE_TIMEOUT_S = 1200          # structural record: ~2 min on CPU
+FULL_SCALE_TIMEOUT_S = 3600     # the 100M leg owns a real window slot
 
 STEPS = [("staticcheck", staticcheck),
          ("swim_diss_ab", swim_diss_ab),
@@ -628,6 +666,7 @@ STEPS = [("staticcheck", staticcheck),
          ("mr_staged_10m", mr_staged_10m),
          ("prng_invariant", prng_invariant),
          ("fused_churn_sweep", fused_churn_sweep),
+         ("scale_plan", scale_plan),
          ("fleet_failover", fleet_failover),
          ("roofline", roofline),
          ("baseline_sweep", baseline_sweep),

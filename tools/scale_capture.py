@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Capture the streamed bit-plane scale record (the scale-planner PR's
+acceptance artifact).
+
+The CPU-feasible STRUCTURAL record: N = 2^20 nodes x 256 rumors
+(8 word planes) planned against an artificially tiny HBM budget that
+forces >= 4-tile streaming, run through the full streamed executor
+(planner/stream.run_at_scale) under a MIXED fault program
+(crash/recover event + permanent crash + open partition window +
+drop-rate ramp), with four gates:
+
+  * ``tiles >= 4``                — the plan actually streamed;
+  * ``bitwise_equal``             — the T-tile streamed trajectory is
+    byte-identical to the untiled in-memory run (final state, msgs,
+    AND the exact ``dropped`` total);
+  * ``coverage == 1.0``           — on the EVENTUAL-alive set (the
+    churn convergence denominator, ops/nemesis.metric_alive);
+  * ``measured <= predicted``     — the tile loop's AOT memory
+    analysis lands inside the planner's predicted peak device bytes
+    (the budget model's honesty gate);
+
+plus a crash-safety leg: the run is repeated with a halt after its
+first checkpoint segment and resumed, and the resumed final state must
+equal the uninterrupted one bitwise (the utils/checkpoint cursor
+discipline through the streamed driver).
+
+Everything lands in ONE run ledger (utils/telemetry — provenance first
+line), so the committed artifact passes tools/validate_artifacts.py's
+scale/plan/budget provenance gate.
+
+    python tools/scale_capture.py [OUT.jsonl]    # default
+        artifacts/ledger_scale_r20.jsonl
+    python tools/scale_capture.py --smoke        # CPU rehearsal at
+        2^14 nodes, .smoke-infixed artifact (hw_refresh convention)
+    python tools/scale_capture.py --full-scale   # the 100M-node leg:
+        plans against the DETECTED device topology and executes, into
+        its own artifact (ledger_scale_full.jsonl — the structural
+        record's run="last" readers must keep seeing a scale_record);
+        refuses rc 1 off-TPU (real HBM only; rc 2 stays the hw_refresh
+        wedge signature — ROADMAP item 3's hardware-capture remainder,
+        run by the hw_refresh scale_plan step at the first healthy
+        window)
+
+Platform: ambient (the hw_refresh convention) — the committed record
+on this container is the CPU structural proof; the same tool at a TPU
+window measures real HBM numbers.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N = 2**20
+RUMORS = 256            # 8 word planes -> 4 tiles at the forced budget
+FANOUT = 2
+MAX_ROUNDS = 40
+SEGMENT_EVERY = 10
+SMOKE_N = 2**14
+SMOKE_ROUNDS = 24
+FULL_SCALE_N = 100_000_000
+
+
+def mixed_fault(n):
+    """The crashloop-style mixed program: crash/recover + permanent
+    crash + open partition window + drop ramp, sized so coverage 1.0
+    on the eventual-alive set is reachable inside MAX_ROUNDS."""
+    from gossip_tpu.config import ChurnConfig, FaultConfig
+    return FaultConfig(drop_prob=0.02, seed=2, churn=ChurnConfig(
+        events=((3, 2, 8), (11, 3, -1)),
+        partitions=((4, 10, n // 2),),
+        ramp=(0, 6, 0.0, 0.15)))
+
+
+def forced_plan(n, rounds, *, tiles_at_least=4):
+    """Plan ``n`` against an HBM budget that forces >=
+    ``tiles_at_least`` streamed tiles (the ONE shared construction,
+    planner/budget.forced_device_for_tiles — the budget is recorded
+    in the artifact; nothing about the trajectory depends on it)."""
+    from gossip_tpu.planner import budget as PB
+    fault = mixed_fault(n)
+    dev = PB.forced_device_for_tiles(
+        n, rumors=RUMORS, fanout=FANOUT, max_rounds=rounds,
+        fault=fault, tiles_at_least=tiles_at_least)
+    return PB.plan_scale(n, rumors=RUMORS, device=dev, fanout=FANOUT,
+                         max_rounds=rounds, fault=fault,
+                         segment_every=SEGMENT_EVERY)
+
+
+def full_scale(led) -> int:
+    """The 100M-node hardware leg: plan against the DETECTED topology
+    and execute.  Gated on real TPU HBM — on any other backend this is
+    a structural no-op refused rc 1 (rc 2 would read as the hw_refresh
+    wedge signature; the hw_refresh step only passes --full-scale at a
+    TPU window)."""
+    import jax
+    from gossip_tpu.planner import budget as PB
+    from gossip_tpu.planner.stream import run_at_scale
+    if jax.default_backend() != "tpu":
+        # rc 1, not 2: off-TPU --full-scale is an operator error, and
+        # rc 2 is the hw_refresh wedge-signature convention
+        print(json.dumps({"error": "full-scale needs real TPU HBM",
+                          "backend": jax.default_backend()}))
+        return 1
+    devs = jax.devices()
+    stats = devs[0].memory_stats() or {}
+    hbm = int(stats.get("bytes_limit", 16 * 1024**3))
+    from gossip_tpu.parallel.multislice import detect_slices
+    dev = PB.DeviceSpec(chips=len(devs), hbm_bytes_per_chip=hbm,
+                        slices=detect_slices(devs))
+    plan = PB.plan_scale(FULL_SCALE_N, rumors=64, device=dev,
+                         fanout=FANOUT, max_rounds=64,
+                         fault=mixed_fault(FULL_SCALE_N))
+    led.event("scale_full_plan", **{
+        "n": plan.n, "tiles": plan.tiles,
+        "bucket_words": plan.bucket_words,
+        "chips": dev.chips, "hbm_bytes_per_chip": hbm,
+        "slices": dev.slices,
+        "predicted_peak_device_bytes":
+            plan.predicted_peak_device_bytes})
+    res = run_at_scale(plan, measure_memory=True)
+    led.event("scale_full_run", rounds=res.rounds,
+              coverage=res.coverage, tiles=res.tiles,
+              measured_loop_bytes=res.measured_loop_bytes)
+    print(json.dumps({"full_scale": res.to_dict()}))
+    return 0 if res.coverage == 1.0 else 1
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    smoke = "--smoke" in argv
+    full = "--full-scale" in argv
+    argv = [a for a in argv if a not in ("--smoke", "--full-scale")]
+    infix = ".smoke" if smoke else ""
+    # the full-scale leg gets its OWN artifact: appending a run with
+    # no scale_record event to the structural record would break its
+    # run="last" readers (bench.last_scale_record, the tier-1 pin)
+    default_name = (f"ledger_scale_full{infix}.jsonl" if full
+                    else f"ledger_scale_r20{infix}.jsonl")
+    out_path = (argv[0] if argv else
+                os.path.join(REPO, "artifacts", default_name))
+    if smoke:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    n = SMOKE_N if smoke else N
+    rounds = SMOKE_ROUNDS if smoke else MAX_ROUNDS
+
+    import numpy as np
+
+    import jax
+    from gossip_tpu.planner.stream import run_at_scale
+    from gossip_tpu.utils import telemetry
+
+    led = telemetry.Ledger(out_path)
+    prev = telemetry.activate(led)
+    try:
+        led.record_runtime()
+        if full:
+            return full_scale(led)
+        plan = forced_plan(n, rounds)
+        t0 = time.perf_counter()
+        res = run_at_scale(plan, check_bitwise=True,
+                           measure_memory=True, keep_state=True)
+        streamed_ms = (time.perf_counter() - t0) * 1e3
+
+        # crash-safety leg: halt after the first published segment,
+        # resume, and land bitwise on the uninterrupted run
+        with tempfile.TemporaryDirectory() as td:
+            ck = os.path.join(td, "scale_ck.npz")
+            run_at_scale(plan, checkpoint_path=ck,
+                         halt_after_segments=1)
+            r2 = run_at_scale(plan, checkpoint_path=ck, resume=True,
+                              keep_state=True)
+        resume_bitwise = (np.array_equal(r2.final_state,
+                                         res.final_state)
+                          and r2.dropped == res.dropped
+                          and r2.msgs == res.msgs)
+
+        gates = {
+            "tiles_ge_4": res.tiles >= 4,
+            "bitwise_equal": res.bitwise_equal is True,
+            "coverage_1": res.coverage == 1.0,
+            "memory_within_prediction":
+                res.measured_loop_bytes is not None
+                and res.measured_loop_bytes
+                <= res.predicted_peak_device_bytes,
+            "resume_bitwise": resume_bitwise,
+        }
+        ok = all(gates.values())
+        led.event("scale_record",
+                  n=n, rumors=RUMORS, fanout=FANOUT, rounds=res.rounds,
+                  tiles=res.tiles, bucket_words=res.bucket_words,
+                  total_words=plan.total_words,
+                  segments=res.segments_run,
+                  backend=jax.default_backend(), smoke=smoke,
+                  hbm_budget_bytes=plan.hbm_budget_bytes,
+                  predicted_peak_device_bytes=
+                  res.predicted_peak_device_bytes,
+                  measured_loop_bytes=res.measured_loop_bytes,
+                  coverage=res.coverage, msgs=res.msgs,
+                  dropped=res.dropped,
+                  streamed_wall_ms=round(streamed_ms, 1),
+                  binding=plan.binding, ok=ok, **gates)
+        print(json.dumps({"n": n, "tiles": res.tiles,
+                          "coverage": res.coverage,
+                          "measured_loop_bytes": res.measured_loop_bytes,
+                          "predicted_peak_device_bytes":
+                          res.predicted_peak_device_bytes,
+                          "backend": jax.default_backend(),
+                          "ok": ok, "gates": gates,
+                          "ledger": out_path}))
+        return 0 if ok else 1
+    finally:
+        telemetry.activate(prev)
+        led.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

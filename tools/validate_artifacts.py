@@ -142,6 +142,22 @@ def _is_staticcheck_name(name: str) -> bool:
     return "staticcheck" in name or "lint" in name
 
 
+def _is_scale_name(name: str) -> bool:
+    """Scale-planner artifacts by name — capacity plans, HBM budget
+    verdicts, and streamed-tiling records (gossip_tpu/planner +
+    tools/scale_capture) must always be attributable; the legacy
+    allowlist can never grandfather one in (the whole planner
+    subsystem post-dates the provenance schema).  The ONE name-space
+    collision is carved out explicitly rather than allowlisted:
+    dryrun_steady_budget_r06.json is the round-6 dry-run STEADY-WALL
+    budget snapshot (docs/PERF.md cites it as before/after evidence),
+    not a scale-planner budget — it predates the subsystem by
+    fourteen rounds and stays on the ordinary legacy list above."""
+    if name == "dryrun_steady_budget_r06.json":
+        return False
+    return "scale" in name or "plan" in name or "budget" in name
+
+
 def _is_fleet_name(name: str) -> bool:
     """Fleet/router/failover artifacts by name — the replicated-
     serving evidence (SIGKILLed replicas with zero acked-request loss,
@@ -236,6 +252,12 @@ def validate_file(path):
                     "line — an invariant-analyzer verdict must be "
                     "attributable, allowlist or not "
                     "(utils/telemetry.provenance)")
+            if not has_prov and _is_scale_name(name):
+                problems.append(
+                    "scale/plan/budget artifact without a provenance "
+                    "line — capacity plans and streamed-tiling "
+                    "records must be attributable, allowlist or not "
+                    "(utils/telemetry.provenance)")
         else:
             with open(path) as f:
                 doc = json.load(f)
@@ -279,6 +301,12 @@ def validate_file(path):
                     "staticcheck/lint artifact without provenance "
                     f"keys {PROVENANCE_KEYS} — an invariant-analyzer "
                     "verdict must be attributable, allowlist or not")
+            elif _is_scale_name(name) and not _has_provenance_keys(doc):
+                problems.append(
+                    "scale/plan/budget artifact without provenance "
+                    f"keys {PROVENANCE_KEYS} — capacity plans and "
+                    "streamed-tiling records must be attributable, "
+                    "allowlist or not")
             elif name not in LEGACY and not _has_provenance_keys(doc):
                 problems.append(
                     "new-format json without provenance keys "
