@@ -240,7 +240,8 @@ def body():
     print(json.dumps(measurement_line(rate, backend, n, variant, rounds, dt,
                                       compile_split=split,
                                       families=families,
-                                      plan=plan_for_headline(backend))))
+                                      plan=plan_for_headline(backend),
+                                      serving=serving_for_headline())))
     return 0
 
 
@@ -399,8 +400,59 @@ def last_scale_record():
     return best
 
 
+def serving_for_headline():
+    """Optional ``serving`` object for the scoreboard line (the
+    mesh-sharded serving PR): rps + p99 per devices-per-replica width
+    from the newest committed meshserve capture
+    (artifacts/ledger_meshserve_r*.jsonl, .smoke excluded) — so the
+    serving trajectory joins the headline the way ``plan`` did for
+    capacity.  Carries the capture's own honesty bits verbatim:
+    ``scaling_resolved`` says whether the host could even express the
+    device parallelism (tools/load_harness meshserve gate), and
+    ``ok``/``devices_ratio`` are the gate's verdict, not re-derived.
+    Returns None when no committed record exists or anything fails to
+    parse — this function must never cost the scoreboard its line
+    (the last_tpu_capture wedge-resilience rule)."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    art_dir = os.path.join(repo, "artifacts")
+    best = None
+    try:
+        names = sorted(os.listdir(art_dir))
+        for name in names:
+            if not (name.startswith("ledger_meshserve_r")
+                    and name.endswith(".jsonl")
+                    and ".smoke" not in name):
+                continue
+            try:
+                from gossip_tpu.utils import telemetry
+                events = telemetry.load_ledger(
+                    os.path.join(art_dir, name), run="last")
+            except (OSError, ValueError):
+                continue
+            gates = [e for e in events
+                     if e.get("ev") == "meshserve_gate"]
+            if not gates:
+                continue
+            g = gates[-1]
+            legs = {}
+            for label, leg in sorted((g.get("legs") or {}).items()):
+                legs[label] = {"devices": leg.get("devices"),
+                               "rps": leg.get("rps"),
+                               "p99_ms": leg.get("p99_ms")}
+            best = {"artifact": os.path.join("artifacts", name),
+                    "ok": g.get("ok"),
+                    "connections": g.get("connections"),
+                    "devices_ratio": g.get("devices_ratio"),
+                    "scaling_resolved": g.get("scaling_resolved"),
+                    "legs": legs}
+        return best
+    except Exception:
+        return None
+
+
 def measurement_line(rate, backend, n, variant, rounds, dt,
-                     compile_split=None, families=None, plan=None):
+                     compile_split=None, families=None, plan=None,
+                     serving=None):
     """The one-JSON-line scoreboard contract (tests/test_bench_contract.py).
 
     ``vs_baseline`` compares against a TPU-derived north-star rate, so it
@@ -430,7 +482,13 @@ def measurement_line(rate, backend, n, variant, rounds, dt,
     the detected (TPU) or reference (fallback) topology, plus the
     newest committed scale record's predicted-vs-measured pair — so
     the scoreboard names the tiling the next hardware window should
-    run (:func:`plan_for_headline`)."""
+    run (:func:`plan_for_headline`).
+
+    ``serving`` (the mesh-sharded serving PR): rps + p99 per
+    devices-per-replica width from the newest committed meshserve
+    capture, with the gate's own ``ok``/``devices_ratio``/
+    ``scaling_resolved`` verdict bits carried verbatim
+    (:func:`serving_for_headline`)."""
     on_tpu = backend == "tpu"
     line = {
         "metric": "node_rounds_per_sec_per_chip",
@@ -447,6 +505,8 @@ def measurement_line(rate, backend, n, variant, rounds, dt,
         line["families"] = families
     if plan is not None:
         line["plan"] = plan
+    if serving is not None:
+        line["serving"] = serving
     if not on_tpu:
         line["last_tpu"] = last_tpu_capture()
     return line

@@ -1315,13 +1315,27 @@ def cmd_serve(a) -> int:
         try:
             batching = ServingConfig(tick_ms=a.batch_tick_ms,
                                      max_batch=a.batch_max,
-                                     max_queue=a.batch_queue)
+                                     max_queue=a.batch_queue,
+                                     devices=a.devices,
+                                     coordinator=a.coordinator,
+                                     num_processes=a.num_processes,
+                                     process_id=a.process_id)
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
-    server, port = serve(a.port, a.workers, batching=batching)
+    try:
+        server, port = serve(a.port, a.workers, batching=batching)
+    except ValueError as e:
+        # the mesh refusal (fewer devices than --devices) must be a
+        # clean CLI error, not a traceback — the fleet's spawn gate
+        # reads the child's stderr tail
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     print(json.dumps({"serving": True, "port": port,
-                      "batching": batching is not None}), flush=True)
+                      "batching": batching is not None,
+                      "devices": (batching.devices
+                                  if batching is not None else 1)}),
+          flush=True)
     server.wait_for_termination()
     return 0
 
@@ -1335,16 +1349,29 @@ def cmd_route(a) -> int:
         cfg = FleetConfig(replicas=a.replicas,
                           probe_interval_ms=a.probe_interval_ms,
                           down_after=a.down_after, up_after=a.up_after,
-                          max_inflight=a.max_inflight)
+                          max_inflight=a.max_inflight,
+                          devices_per_replica=a.devices_per_replica)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     replica_argv = []
     if a.no_batching:
+        if cfg.devices_per_replica > 1:
+            print("error: --devices-per-replica needs batching "
+                  "replicas (the mesh shards the admission megabatch); "
+                  "drop --no-batching", file=sys.stderr)
+            return 2
         replica_argv.append("--no-batching")
+    if cfg.devices_per_replica > 1:
+        # BOTH halves of the mesh contract: the child's ServingConfig
+        # width (--devices) AND the host-device-count env (fleet_env
+        # devices=) — either alone silently degrades, which the
+        # post-spawn serving_devices gate then refuses
+        replica_argv += ["--devices", str(cfg.devices_per_replica)]
     fleet = Fleet(cfg=cfg, port=a.port, max_workers=a.workers,
                   replica_argv=replica_argv,
-                  env=fleet_env(platform=a.replica_platform or None))
+                  env=fleet_env(platform=a.replica_platform or None,
+                                devices=cfg.devices_per_replica))
     try:
         if not fleet.router.wait_healthy(a.replicas, timeout_s=60):
             # a fleet that never admitted all replicas must not print
@@ -1942,6 +1969,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--batch-queue", type=int, default=256,
                    help="backpressure cap: admissions past this depth "
                         "get RESOURCE_EXHAUSTED")
+    p.add_argument("--devices", type=int, default=1,
+                   help="megabatch mesh width (power of two): shard "
+                        "each tick's megabatch over the first K JAX "
+                        "devices; refuses at startup when the process "
+                        "has fewer (docs/SERVING.md \"Mesh-sharded "
+                        "replicas\")")
+    p.add_argument("--coordinator", default=None,
+                   metavar="HOST:PORT",
+                   help="jax.distributed coordinator address when one "
+                        "logical replica spans processes")
+    p.add_argument("--num-processes", type=int, default=1,
+                   help="process count of the jax.distributed "
+                        "topology (1 = the degenerate single-process "
+                        "case, no initialization)")
+    p.add_argument("--process-id", type=int, default=0,
+                   help="this process's rank in [0, num-processes)")
     _add_cache_flags(p)
     p.set_defaults(fn=cmd_serve)
 
@@ -1967,6 +2010,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "router sheds with RESOURCE_EXHAUSTED")
     p.add_argument("--no-batching", action="store_true",
                    help="disable admission batching in the replicas")
+    p.add_argument("--devices-per-replica", type=int, default=1,
+                   help="megabatch mesh width per replica (power of "
+                        "two): children get XLA_FLAGS=--xla_force_"
+                        "host_platform_device_count=K and serve "
+                        "--devices K; the fleet refuses loudly if a "
+                        "child reports fewer serving devices")
     p.add_argument("--replica-platform", default="cpu",
                    help="JAX_PLATFORMS pin for replica children "
                         "(default cpu: N processes cannot share one "

@@ -817,11 +817,29 @@ class ServingConfig:
       queue without bound (the reply tells the client to back off —
       SidecarClient's retry policy treats it as a well-formed error,
       never a transport failure).
+    * ``devices`` — megabatch mesh width: the batcher shards the
+      request axis of each tick's megabatch over the first ``devices``
+      JAX devices (a 1-D ``Mesh`` on the ``"request"`` axis —
+      parallel/sweep.request_sweep_curves).  Must be a power of two so
+      every pow2 lane bucket divides the mesh and dispatch never
+      fragments the executable cache; 1 (default) is the solo
+      single-device path, bit-identical everywhere.  The batcher
+      REFUSES at construction when the process has fewer devices than
+      requested — a mesh must never silently degrade.
+    * ``coordinator`` / ``num_processes`` / ``process_id`` — the
+      jax.distributed topology for one logical replica spanning
+      processes (``jax.distributed.initialize`` in rpc/sidecar.serve);
+      ``num_processes == 1`` (default) is the degenerate single-process
+      case that skips initialization entirely and runs everywhere.
     """
 
     tick_ms: float = 20.0
     max_batch: int = 64
     max_queue: int = 256
+    devices: int = 1
+    coordinator: Optional[str] = None
+    num_processes: int = 1
+    process_id: int = 0
 
     def __post_init__(self):
         if self.tick_ms <= 0:
@@ -830,6 +848,20 @@ class ServingConfig:
             raise ValueError("max_batch must be >= 1")
         if self.max_queue < 1:
             raise ValueError("max_queue must be >= 1")
+        if self.devices < 1 or (self.devices & (self.devices - 1)):
+            raise ValueError(
+                "devices must be a power of two >= 1 (pow2 lane "
+                "buckets must divide the mesh so dispatch never "
+                f"fragments the executable cache), got {self.devices}")
+        if self.num_processes < 1:
+            raise ValueError("num_processes must be >= 1")
+        if not 0 <= self.process_id < self.num_processes:
+            raise ValueError("process_id must be in [0, num_processes)")
+        if self.num_processes > 1 and not self.coordinator:
+            raise ValueError(
+                "a multi-process replica (num_processes > 1) needs a "
+                "coordinator address (host:port) for "
+                "jax.distributed.initialize")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -865,6 +897,15 @@ class FleetConfig:
       control-plane log key (ops/logs; committed offset = config
       epoch); a fleet exceeding it in one run errors loudly rather
       than alias epochs on a ring wrap.
+    * ``devices_per_replica`` — megabatch mesh width each spawned
+      replica must serve with (ServingConfig.devices in the child).
+      The fleet threads the host-device-count env to children
+      (``XLA_FLAGS=--xla_force_host_platform_device_count=K`` via
+      router.fleet_env) and REFUSES loudly after spawn when a child's
+      health probe reports fewer serving devices than requested — the
+      child pins ``JAX_PLATFORMS=cpu``, so without the env the mesh
+      would silently degrade to 1 device.  Power of two, like
+      ServingConfig.devices.
     """
 
     replicas: int = 2
@@ -874,10 +915,17 @@ class FleetConfig:
     up_after: int = 3
     max_inflight: int = 8
     control_capacity: int = 64
+    devices_per_replica: int = 1
 
     def __post_init__(self):
         if self.replicas < 1:
             raise ValueError("replicas must be >= 1")
+        if (self.devices_per_replica < 1
+                or (self.devices_per_replica
+                    & (self.devices_per_replica - 1))):
+            raise ValueError(
+                "devices_per_replica must be a power of two >= 1, "
+                f"got {self.devices_per_replica}")
         if self.probe_interval_ms <= 0:
             raise ValueError("probe_interval_ms must be > 0")
         if self.probe_timeout_s <= 0:

@@ -30,11 +30,28 @@ executable iff they agree on everything the TRACE bakes:
   pow2 rumor bucket             rumors itself (phantom-column mask)
   max_rounds (scan length)      target_coverage (host-side readout)
   exclude_self                  seed, origin (key + seen operands)
-  mesh (None: single-device)    drop_prob (the drop table)
+  mesh width (ServingConfig     drop_prob (the drop table)
+    .devices: the 1-D request-
+    axis mesh; 1 = solo path)
   —                             static death mask (alive operands)
   —                             the whole churn schedule
                                   (nemesis.build_request_stack)
   ============================  =====================================
+
+Mesh-sharded dispatch (the perf PR): when ``ServingConfig.devices > 1``
+the collector dispatches each tick's megabatch onto a 1-D device mesh
+over the request axis (request_sweep_curves ``mesh=``) instead of the
+solo single-device path.  The mesh itself never enters the scan memo
+key — jit re-specializes on input shardings — and the replica uses ONE
+mesh for its lifetime, so the executable cache stays one-per-(key,
+lane-bucket) exactly as on the solo path.  Lane buckets are padded up
+to the device count (both powers of two, so every bucket divides the
+mesh evenly); requests padded to the bucket ride inert rows.  Replies
+stay bitwise equal to solo dispatch: the sharded scan computes the
+same integer counts per lane and the host readout is unchanged.  The
+batcher REFUSES at construction when the process has fewer devices
+than configured — a mesh silently degrading to 1 device is the failure
+mode the fleet's devices_per_replica gate exists to catch.
 
 Everything else about the serving queue (tick cadence, per-tick batch
 cap, backpressure depth) lives in :class:`~gossip_tpu.config
@@ -274,6 +291,12 @@ class Batcher:
 
     def __init__(self, cfg: Optional[ServingConfig] = None):
         self.cfg = cfg or ServingConfig()
+        # the replica's megabatch mesh, built ONCE for the batcher's
+        # lifetime (one mesh -> one sharding per shape -> the
+        # executable cache stays one-per-(key, lane-bucket)); devices=1
+        # is the solo single-device path with no mesh at all
+        self.devices = self.cfg.devices
+        self._mesh = self._build_mesh(self.cfg.devices)
         self._lock = threading.Lock()
         self._queue = []          # [(BatchKey, _Pending)], FIFO
         self._stop = threading.Event()
@@ -282,6 +305,27 @@ class Batcher:
                                         name="gossip-admission-batcher",
                                         daemon=True)
         self._thread.start()
+
+    @staticmethod
+    def _build_mesh(devices: int):
+        """The replica's 1-D request-axis mesh, or None for the solo
+        path.  Refuses LOUDLY when the process has fewer devices than
+        configured: a replica pinned to CPU without the host-device-
+        count env would otherwise serve a silently degraded mesh (the
+        devices_per_replica satellite)."""
+        if devices <= 1:
+            return None
+        import jax
+        from jax.sharding import Mesh
+        devs = jax.devices()
+        if len(devs) < devices:
+            raise ValueError(
+                f"ServingConfig.devices={devices} but this process has "
+                f"only {len(devs)} JAX device(s) — the megabatch mesh "
+                "would silently degrade; launch with XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={devices} "
+                "(CPU) or on a host with enough accelerator devices")
+        return Mesh(devs[:devices], ("request",))
 
     # -- admission -----------------------------------------------------
 
@@ -434,9 +478,16 @@ class Batcher:
         p.event.set()
 
     def _run_group(self, key: BatchKey, entries, queue_depth: int):
-        from gossip_tpu.parallel.sweep import request_sweep_curves
+        from gossip_tpu.parallel.sweep import (_pow2_at_least,
+                                               request_sweep_curves)
         from gossip_tpu.utils import telemetry
         specs = tuple(s for e in entries for s in e.specs)
+        # lane bucket padded up to the mesh width: pow2 buckets divide
+        # pow2 device counts, so mesh dispatch reuses exactly the solo
+        # path's bucket set (floored at `devices`) and never fragments
+        # the executable cache; None keeps the solo default
+        lanes = (_pow2_at_least(len(specs), self.devices)
+                 if self._mesh is not None else None)
         mon = _monitor()
         before = mon.backend_compiles
         t0 = time.monotonic()
@@ -450,6 +501,8 @@ class Batcher:
                                        n_pad=(None if key.topology
                                               is not None
                                               else key.n_bucket),
+                                       mesh=self._mesh,
+                                       lanes=lanes,
                                        full=True)
         except Exception as e:          # defensive: classify should
             err = BatchError(           # have filtered invalid configs
@@ -472,6 +525,7 @@ class Batcher:
             "batched": True, "tick": self._tick,
             "size": len(specs), "requests": len(entries),
             "run_ms": round(run_ms, 1), "cache": cache,
+            "devices": self.devices,
             "semantics": "fixed-scan", **key.describe()}
         telemetry.current().event(
             "batch", sync=False, tick=self._tick,
@@ -480,6 +534,7 @@ class Batcher:
             wait_ms_p50=round(telemetry.percentile(waits, 0.50), 1),
             wait_ms_max=round(waits[-1], 1) if waits else 0.0,
             run_ms=round(run_ms, 1), compiles=compiles, cache=cache,
+            devices=self.devices,
             **key.describe())
         off = 0
         for p in entries:
@@ -512,7 +567,8 @@ class Batcher:
             "msgs": float(res.msgs[i][-1]),
             "wall_s": round(batch_meta["run_ms"] / 1e3, 4),
             "curve": curve if p.want_curve else None,
-            "meta": {"clock": "rounds", "devices": 1,
+            "meta": {"clock": "rounds",
+                     "devices": batch_meta.get("devices", 1),
                      "msgs_counts": "transmissions",
                      "engine": "xla-request-batch",
                      "state_digest": res.state_digests[i],

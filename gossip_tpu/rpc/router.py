@@ -635,12 +635,21 @@ def spawn_replica(workdir: str, name: str, extra_argv=(),
 
 
 def fleet_env(compile_cache_dir: Optional[str] = None,
-              platform: Optional[str] = "cpu") -> dict:
+              platform: Optional[str] = "cpu",
+              devices: Optional[int] = None) -> dict:
     """Replica-child environment: repo importable, platform pinned
     (default CPU — N replica processes cannot share one TPU; pass
     ``platform=None`` to inherit the ambient pin on a multi-chip
     host), and an optional SHARED compile-cache dir so a respawned
-    replica starts warm from its predecessors' executables."""
+    replica starts warm from its predecessors' executables.
+
+    ``devices`` threads the host-device-count env
+    (``XLA_FLAGS=--xla_force_host_platform_device_count=K``) for
+    mesh-sharded replicas: a child pinned to CPU has exactly ONE
+    device without it, so its megabatch mesh would silently degrade —
+    the bug the devices_per_replica satellite closes.  An ambient
+    host-device-count flag is left alone (the caller pinned it);
+    otherwise the flag is appended to any other ambient XLA_FLAGS."""
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + (
         os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
@@ -648,7 +657,40 @@ def fleet_env(compile_cache_dir: Optional[str] = None,
         env["JAX_PLATFORMS"] = platform
     if compile_cache_dir is not None:
         env["GOSSIP_COMPILE_CACHE"] = compile_cache_dir
+    if devices is not None and devices > 1:
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{devices}").strip()
     return env
+
+
+def _verify_replica_devices(addr: str, name: str, want: int,
+                            timeout_s: float = 30.0):
+    """The devices-per-replica gate: a freshly spawned child must
+    REPORT the mesh width it actually serves with (the health reply's
+    ``serving_devices`` — rpc/sidecar._health) or the fleet refuses
+    loudly.  Without this, a replica missing the host-device-count env
+    (or spawned without ``--devices``) comes up healthy, answers
+    correctly, and silently serves a 1-device mesh — throughput
+    degradation no probe would ever surface."""
+    if want <= 1:
+        return
+    from gossip_tpu.rpc.sidecar import SidecarClient
+    client = SidecarClient(addr)
+    try:
+        h = client.health(timeout=timeout_s)
+    finally:
+        client.close()
+    got = int(h.get("serving_devices", h.get("devices", 1)))
+    if got < want:
+        raise RuntimeError(
+            f"replica {name} at {addr} reports serving_devices={got} "
+            f"but the fleet requires devices_per_replica={want} — the "
+            "megabatch mesh silently degraded; spawn children with "
+            "fleet_env(devices=K) (XLA_FLAGS=--xla_force_host_platform"
+            "_device_count=K) AND the serve --devices flag")
 
 
 class Fleet:
@@ -657,7 +699,10 @@ class Fleet:
     ``route`` command runs.  ``kill(i)`` SIGKILLs a replica;
     ``restart(i)`` spawns a replacement on a fresh port and leaves the
     router's hysteresis to re-admit it (after a control-plane
-    catchup)."""
+    catchup).  When ``cfg.devices_per_replica > 1`` every spawn (and
+    respawn) is gated by :func:`_verify_replica_devices` — a child
+    serving a narrower mesh than configured fails the fleet loudly at
+    startup instead of degrading throughput silently."""
 
     def __init__(self, n: Optional[int] = None,
                  cfg: Optional[FleetConfig] = None,
@@ -680,6 +725,8 @@ class Fleet:
                                             self.replica_argv, self.env)
                 procs.append(proc)
                 addrs.append(f"127.0.0.1:{rport}")
+                _verify_replica_devices(
+                    addrs[-1], f"r{i}_g0", self.cfg.devices_per_replica)
             # serve_router inside the same net: a router bind failure
             # (port in use) must not strand N orphaned replica children
             self.server, self.port, self.router = serve_router(
@@ -713,10 +760,19 @@ class Fleet:
         re-admits it after ``up_after`` consecutive healthy probes
         (with a gossip catchup first)."""
         self._gen[i] += 1
-        proc, rport = spawn_replica(
-            self.workdir, f"r{i}_g{self._gen[i]}", self.replica_argv,
-            self.env)
+        name = f"r{i}_g{self._gen[i]}"
+        proc, rport = spawn_replica(self.workdir, name,
+                                    self.replica_argv, self.env)
         addr = f"127.0.0.1:{rport}"
+        try:
+            _verify_replica_devices(addr, name,
+                                    self.cfg.devices_per_replica)
+        except Exception:
+            # a degraded replacement must not join the rotation — kill
+            # it and re-raise (the caller decides whether to retry)
+            proc.kill()
+            proc.wait()
+            raise
         self.router.replace_replica(i, addr, proc)
         return addr
 

@@ -202,14 +202,41 @@ def _ensemble(request: bytes, context, batcher=None) -> bytes:
     return json.dumps(out).encode()
 
 
-def _health(request: bytes, context) -> bytes:
+def _health(request: bytes, context, batcher=None) -> bytes:
     import jax
     return json.dumps({
         "ok": True,
         "backend": jax.default_backend(),
         "devices": len(jax.devices()),
+        # the megabatch mesh width this replica actually serves with —
+        # the fleet's devices_per_replica refusal probes THIS field, so
+        # a child that came up with a degraded mesh cannot hide behind
+        # a healthy raw device count (rpc/router.Fleet)
+        "serving_devices": (batcher.devices if batcher is not None
+                            else 1),
         "service": SERVICE,
     }).encode()
+
+
+def _maybe_init_distributed(batching: Optional[ServingConfig]):
+    """The cross-host path: one logical replica spanning processes via
+    ``jax.distributed.initialize`` (SNIPPETS.md [1]/[2] — "run
+    computations across all available devices across processes").
+    Driven entirely by ServingConfig's coordinator/num_processes/
+    process_id; the degenerate ``num_processes == 1`` case (the
+    default) skips initialization and runs everywhere, single-process
+    multi-device included.  Must run before the first jax use in this
+    process — serve() calls it before constructing the Batcher (whose
+    mesh enumerates devices).  Idempotence: a second initialize in one
+    process is a jax error, so a re-serve in-process keeps num_processes
+    at 1 (tests, the load harness)."""
+    if batching is None or batching.num_processes <= 1:
+        return
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=batching.coordinator,
+        num_processes=batching.num_processes,
+        process_id=batching.process_id)
 
 
 def serve(port: int = 50051, max_workers: int = 4,
@@ -228,10 +255,15 @@ def serve(port: int = 50051, max_workers: int = 4,
     per-request solo dispatch byte for byte.  With batching on,
     ``max_workers`` bounds the number of requests that can WAIT on a
     tick concurrently — size it at least to the expected concurrency.
+    ``batching.devices > 1`` shards each tick's megabatch over a 1-D
+    device mesh (rpc/batcher mesh dispatch); ``batching.num_processes
+    > 1`` first joins the jax.distributed topology so one logical
+    replica spans processes (docs/SERVING.md "Mesh-sharded replicas").
     The collector is a daemon thread; ``server.gossip_batcher.close()``
     drains it (tests, the load harness)."""
     batcher = None
     if batching is not None:
+        _maybe_init_distributed(batching)
         from gossip_tpu.rpc.batcher import Batcher
         batcher = Batcher(batching)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
@@ -245,7 +277,8 @@ def serve(port: int = 50051, max_workers: int = 4,
             request_deserializer=_identity,
             response_serializer=_identity),
         "Health": grpc.unary_unary_rpc_method_handler(
-            _health, request_deserializer=_identity,
+            lambda req, ctx: _health(req, ctx, batcher),
+            request_deserializer=_identity,
             response_serializer=_identity),
     }
     server.add_generic_rpc_handlers(

@@ -137,6 +137,36 @@ def test_line_carries_headline_plan():
         1.0, "cpu", 10, "x", 1, 1.0)
 
 
+def test_line_carries_headline_serving():
+    """Mesh-serving PR: the committed meshserve capture rides the
+    scoreboard line as an optional ``serving`` object — rps + p99 per
+    devices-per-replica width, with the capture's own honesty bits
+    (``ok``/``devices_ratio``/``scaling_resolved``) carried verbatim,
+    never re-derived.  The repo ships
+    artifacts/ledger_meshserve_r21.jsonl, so the object must resolve
+    against this tree; it survives the JSON trip and is absent when
+    the body did not pass one (old artifacts replay)."""
+    serving = bench.serving_for_headline()
+    assert serving is not None, \
+        "committed ledger_meshserve record must resolve"
+    assert serving["artifact"].startswith("artifacts/ledger_meshserve")
+    assert serving["ok"] is True
+    assert serving["connections"] >= 1024
+    assert serving["devices_ratio"] > 0
+    assert isinstance(serving["scaling_resolved"], bool)
+    assert len(serving["legs"]) >= 2
+    widths = set()
+    for leg in serving["legs"].values():
+        assert leg["rps"] > 0 and leg["p99_ms"] > 0
+        widths.add(leg["devices"])
+    assert 1 in widths and max(widths) >= 4
+    line = bench.measurement_line(1.0, "cpu", 10, "x", 1, 1.0,
+                                  serving=serving)
+    assert json.loads(json.dumps(line))["serving"]["ok"] is True
+    assert "serving" not in bench.measurement_line(
+        1.0, "cpu", 10, "x", 1, 1.0)
+
+
 def test_fallback_carries_last_tpu_pointer():
     """VERDICT r4 task 2: a wedged-tunnel fallback line must point at
     the newest COMMITTED TPU capture so the scoreboard survives a

@@ -47,12 +47,13 @@ _tspec.loader.exec_module(readme_table)
 FAMILIES = frozenset({
     "dense_pushpull", "churn_heal", "churn_sweep", "fused_churn_sweep",
     "crdt_counter", "kafka_log", "txn_register", "serving_batch",
-    "fleet_failover", "packed_pull", "scale_plan",
+    "mesh_serving", "fleet_failover", "packed_pull", "scale_plan",
     "sparse_antientropy",
     "topo_sparse_antientropy", "swim_rotating", "halo_banded",
     "fused_planes", "fused_planes_fault_curve", "rumor_sir",
     "hybrid_2d_sweep"})
-# the committed r18 record predates the scale-planner PR's scale_plan
+# the committed r20 record predates the mesh-serving PR's mesh_serving
+# family; the committed r18 record predates the scale-planner PR's scale_plan
 # family; the committed r17 record additionally predates the fleet
 # PR's fleet_failover
 # family; the committed r16 record additionally predates the
@@ -66,7 +67,8 @@ FAMILIES = frozenset({
 # predate the compiled-nemesis PR's churn_heal family and the
 # traced-operand PR's churn_sweep family — each pin stays on its
 # historical set
-FAMILIES_PRE_SCALE = FAMILIES - {"scale_plan"}
+FAMILIES_PRE_MESH = FAMILIES - {"mesh_serving"}
+FAMILIES_PRE_SCALE = FAMILIES_PRE_MESH - {"scale_plan"}
 FAMILIES_PRE_FLEET = FAMILIES_PRE_SCALE - {"fleet_failover"}
 FAMILIES_PRE_FUSED_SWEEP = FAMILIES_PRE_FLEET - {"fused_churn_sweep"}
 FAMILIES_PRE_TXN = FAMILIES_PRE_FUSED_SWEEP - {"txn_register"}
@@ -477,14 +479,27 @@ def test_committed_r18_4dev_record_carries_fleet_failover():
 
 def test_committed_r20_4dev_record_carries_scale_plan():
     """The scale-planner PR's committed 4-device record
-    (artifacts/ledger_dryrun_r20_4dev.jsonl, the ledger_diff gate
-    baseline since r20): cold+warm pair, FULL current family set —
-    scale_plan included (a forced >= 2-tile streamed run with the
-    bitwise-vs-untiled gate runs inside every dry run) — warm run
-    all-hit, steady and warm budgets held, >= 3x warm-start aggregate,
-    provenance present."""
+    (artifacts/ledger_dryrun_r20_4dev.jsonl): cold+warm pair on its
+    historical family set — scale_plan included (a forced >= 2-tile
+    streamed run with the bitwise-vs-untiled gate runs inside every
+    dry run), mesh_serving not yet.  (The live ledger_diff gate
+    baseline moved to the r21 record below when the mesh-serving PR
+    grew the family set.)"""
     _assert_cold_warm_record(
         os.path.join(_REPO, "artifacts", "ledger_dryrun_r20_4dev.jsonl"),
+        FAMILIES_PRE_MESH)
+
+
+def test_committed_r21_4dev_record_carries_mesh_serving():
+    """The mesh-serving PR's committed 4-device record
+    (artifacts/ledger_dryrun_r21_4dev.jsonl, the ledger_diff gate
+    baseline since r21): cold+warm pair, FULL current family set —
+    mesh_serving included (the serving tick driven end to end through
+    a Batcher whose megabatch shards over the whole dry-run mesh) —
+    warm run all-hit, steady and warm budgets held, >= 3x warm-start
+    aggregate, provenance present."""
+    _assert_cold_warm_record(
+        os.path.join(_REPO, "artifacts", "ledger_dryrun_r21_4dev.jsonl"),
         FAMILIES)
 
 

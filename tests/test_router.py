@@ -341,6 +341,64 @@ def test_cli_route_validates_flags(capsys):
     from gossip_tpu.cli import main as cli_main
     assert cli_main(["route", "--replicas", "0"]) == 2
     assert "replicas" in capsys.readouterr().err
+    # mesh-sharded replicas need the admission batcher: refusing the
+    # contradiction beats spawning a fleet whose mesh can never run
+    assert cli_main(["route", "--devices-per-replica", "4",
+                     "--no-batching"]) == 2
+    assert "devices-per-replica" in capsys.readouterr().err
+    # devices per replica must be a pow2 (FleetConfig validation):
+    # lane buckets divide the mesh or the executable cache fragments
+    assert cli_main(["route", "--devices-per-replica", "3"]) == 2
+    assert "power of two" in capsys.readouterr().err
+
+
+# -- devices-per-replica gate (the mesh-sharded serving PR) -----------
+
+def test_fleet_env_threads_host_device_count(monkeypatch):
+    """A replica child pinned to CPU has exactly ONE XLA device unless
+    fleet_env threads the host-device-count flag — the silent-mesh-
+    degradation bug this PR's satellite closes.  An ambient pin is
+    respected, never duplicated."""
+    from gossip_tpu.rpc.router import fleet_env
+    monkeypatch.delenv("XLA_FLAGS", raising=False)
+    env = fleet_env(devices=4)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env["XLA_FLAGS"] == \
+        "--xla_force_host_platform_device_count=4"
+    # devices=1 (or None) adds nothing: the solo replica path
+    assert "XLA_FLAGS" not in fleet_env(devices=1)
+    # an ambient count is the caller's pin — left alone
+    monkeypatch.setenv("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=8")
+    assert fleet_env(devices=4)["XLA_FLAGS"] == \
+        "--xla_force_host_platform_device_count=8"
+    # other ambient flags survive the append
+    monkeypatch.setenv("XLA_FLAGS", "--xla_dump_to=/tmp/x")
+    assert fleet_env(devices=4)["XLA_FLAGS"] == \
+        "--xla_dump_to=/tmp/x --xla_force_host_platform_device_count=4"
+
+
+def test_replica_device_verification_refuses_degraded_mesh():
+    """Planted degradation: a live replica serving WITHOUT a mesh
+    (exactly what a child missing the host-device-count env degrades
+    to) reports serving_devices=1 in its health reply, and the fleet's
+    spawn-time gate refuses it loudly instead of running a healthy-
+    looking 1-device fleet — a gate that cannot fail is not a gate."""
+    from gossip_tpu.rpc.router import _verify_replica_devices
+    from gossip_tpu.rpc.sidecar import serve
+    server, port = serve(port=0, max_workers=2,
+                         batching=ServingConfig(tick_ms=25.0))
+    try:
+        addr = f"127.0.0.1:{port}"
+        _verify_replica_devices(addr, "r0_g0", 1)        # solo: fine
+        with pytest.raises(RuntimeError) as ei:
+            _verify_replica_devices(addr, "r0_g0", 2)
+        msg = str(ei.value)
+        assert "serving_devices=1" in msg
+        assert "devices_per_replica=2" in msg
+    finally:
+        server.gossip_batcher.close()
+        server.stop(grace=None)
 
 
 # -- committed record + live smoke ------------------------------------

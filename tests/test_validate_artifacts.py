@@ -144,6 +144,28 @@ def test_serving_artifacts_must_be_attributable(tmp_path):
     assert va.validate_file(str(good)) == []
 
 
+def test_meshserve_artifacts_must_be_attributable(tmp_path):
+    """A ``*meshserve*`` artifact without provenance fails — the
+    mesh-sharded device-scaling capture (load_harness --mesh-devices)
+    is the PR's headline evidence and can never be grandfathered,
+    jsonl or json alike."""
+    bad = tmp_path / "ledger_meshserve_r99.jsonl"
+    bad.write_text(json.dumps({"ev": "meshserve_gate", "ok": True})
+                   + "\n")
+    problems = va.validate_file(str(bad))
+    assert any("provenance" in p for p in problems), problems
+
+    badj = tmp_path / "meshserve_summary_r99.json"
+    badj.write_text(json.dumps({"ok": True}))
+    problems = va.validate_file(str(badj))
+    assert any("provenance" in p for p in problems), problems
+
+    good = tmp_path / "ledger_meshserve_r98.jsonl"
+    with telemetry.Ledger(str(good)) as led:
+        led.event("meshserve_gate", ok=True, devices_ratio=1.1)
+    assert va.validate_file(str(good)) == []
+
+
 def test_crashloop_artifacts_must_be_attributable(tmp_path):
     """A ``*crashloop*`` artifact without provenance fails — the
     SIGKILL/resume record (tools/crashloop.py) is robustness evidence

@@ -467,6 +467,35 @@ def fleet_failover():
     return _run_tool("fleet_crashloop.py", FLEET_TIMEOUT_S)
 
 
+def mesh_serving():
+    """The mesh-sharded serving capture on this host
+    (tools/load_harness.py --mesh-devices, docs/SERVING.md
+    "Mesh-sharded replicas"): fixed-concurrency legs per
+    devices-per-replica width, gated on bitwise reply parity and
+    steady-all-warm.  On hosts with enough schedulable cores the
+    >= --mesh-min-ratio device-scaling gate arms itself
+    (``scaling_resolved`` in the gate event) — THIS step is where the
+    committed meshserve record's scaling leg gets its real
+    multi-core/multi-chip recapture; on a serial host the capture
+    still certifies parity + warmth and ledgers the scaling leg as
+    unresolved."""
+    p = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "load_harness.py"),
+                        "--mesh-devices", "1,4",
+                        "--out", _art("ledger_meshserve_r21.jsonl"),
+                        *_smoke_argv()],
+                       capture_output=True, text=True,
+                       timeout=MESH_SERVING_TIMEOUT_S, cwd=REPO,
+                       env=_body_env())
+    if p.returncode == 2:
+        raise WedgeDetected("load_harness rc 2 (wedge signature)\n"
+                            + (p.stderr or p.stdout)[-400:])
+    if p.returncode != 0:
+        raise RuntimeError(f"rc {p.returncode}\n"
+                           + (p.stderr or p.stdout)[-400:])
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
 def ensembles():
     """The round-4 ensemble surface on hardware via the public CLI
     (VERDICT r4 task 6).  The tool merges sub-captures incrementally;
@@ -656,6 +685,7 @@ def tpu_pallas_tests():
 # A window that closes mid-run lands the most important steps first;
 # retries are incremental (pending steps only).
 FLEET_TIMEOUT_S = 1200
+MESH_SERVING_TIMEOUT_S = 1200   # thousands of connections x 2 legs
 SCALE_TIMEOUT_S = 1200          # structural record: ~2 min on CPU
 FULL_SCALE_TIMEOUT_S = 3600     # the 100M leg owns a real window slot
 
@@ -668,6 +698,7 @@ STEPS = [("staticcheck", staticcheck),
          ("fused_churn_sweep", fused_churn_sweep),
          ("scale_plan", scale_plan),
          ("fleet_failover", fleet_failover),
+         ("mesh_serving", mesh_serving),
          ("roofline", roofline),
          ("baseline_sweep", baseline_sweep),
          ("swim_steady_ablation", swim_steady_ablation),

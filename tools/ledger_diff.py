@@ -132,11 +132,25 @@ def extract(events):
         metrics[drv] = {"rounds": e.get("rounds"),
                         "shards": e.get("shards"),
                         **(e.get("totals") or {})}
+    # serving legs (tools/load_harness load_leg events) ride along
+    # informationally: rps and the percentile columns are carried so
+    # latency regressions are *diffable*, but they NEVER flag — walls
+    # never gate (wall-clock under a thread harness is host-load
+    # noise; the gates that matter — bitwise parity, steady-all-warm —
+    # live in the capture's own gate events)
+    serving = {}
+    for e in events:
+        if e.get("ev") == "load_leg" and e.get("leg"):
+            serving[e["leg"]] = {
+                k: e.get(k) for k in ("rps", "p50_ms", "p95_ms",
+                                      "p99_ms", "devices", "replicas")
+                if e.get(k) is not None}
     return {"run_id": prov.get("run_id"),
             "captured": prov.get("captured"),
             "git_commit": prov.get("git_commit"),
             "device_count": rt.get("device_count"),
-            "families": families, "metrics": metrics}
+            "families": families, "metrics": metrics,
+            "serving": serving}
 
 
 def _indexed_metric_events(events):
@@ -311,8 +325,26 @@ def diff(old, new, ratio=1.8, steady_floor_ms=50.0,
                 "mesh-dependent)")
         metric_rows.append(row)
 
+    # serving legs join informationally — rps/p50/p95/p99 deltas are
+    # carried for the reader but NEVER produce a flag (walls never
+    # gate; a latency number under a thread harness is host-load
+    # noise, and the real gates — parity, all-warm — live in the
+    # capture's own gate events)
+    serving_rows = []
+    for leg in sorted(set(old.get("serving") or {})
+                      | set(new.get("serving") or {})):
+        o = (old.get("serving") or {}).get(leg)
+        n = (new.get("serving") or {}).get(leg)
+        if o is None or n is None:
+            notes.append(f"serving[{leg}]: only in "
+                         f"{'new' if o is None else 'old'} run — "
+                         "reported, not gated")
+            continue
+        serving_rows.append({"leg": leg, "old": o, "new": n})
+
     return {"rows": rows, "metric_rows": metric_rows, "flags": flags,
-            "notes": notes, "drift": drift}
+            "notes": notes, "drift": drift,
+            "serving_rows": serving_rows}
 
 
 def _fmt(v):
@@ -373,6 +405,24 @@ def render(old, new, d):
                      for k in keys]
             out.append(f"| {r['driver']} | " + " | ".join(cells)
                        + f" | {', '.join(r['flagged']) or '—'} |")
+        out.append("")
+    if d.get("serving_rows"):
+        out.append("## Serving legs (informational — walls never gate)")
+        out.append("")
+        out.append("| leg | devices | rps old→new | p50 old→new (ms) "
+                   "| p95 old→new (ms) | p99 old→new (ms) |")
+        out.append("|---|---|---|---|---|---|")
+        for r in d["serving_rows"]:
+            o, n = r["old"], r["new"]
+            devs = (str(o.get("devices")) if o.get("devices")
+                    == n.get("devices")
+                    else f"{o.get('devices')}→{n.get('devices')}")
+            out.append(
+                f"| {r['leg']} | {devs} "
+                f"| {_fmt(o.get('rps'))} → {_fmt(n.get('rps'))} "
+                f"| {_fmt(o.get('p50_ms'))} → {_fmt(n.get('p50_ms'))} "
+                f"| {_fmt(o.get('p95_ms'))} → {_fmt(n.get('p95_ms'))} "
+                f"| {_fmt(o.get('p99_ms'))} → {_fmt(n.get('p99_ms'))} |")
         out.append("")
     if d["flags"]:
         out.append("## Regressions flagged")

@@ -38,6 +38,27 @@ rendered by tools/batching_report.py.
 
     python tools/load_harness.py --out artifacts/ledger_serving_r14.jsonl
     python tools/load_harness.py --smoke     # tiny live batch, no ratio gate
+
+**Meshserve mode** (``--mesh-devices``): the thousands-of-concurrent-
+connections capture for mesh-sharded replicas (docs/SERVING.md
+"Mesh-sharded replicas").  One leg per (replica count x devices-per-
+replica) pair over the SAME request list at FIXED concurrency — every
+request rides its own client connection (one channel + thread each),
+so ``--connections`` IS the concurrency.  Replica-count 1 legs serve
+in-process (their per-tick ``batch`` events land on this ledger — the
+steady-all-warm gate's evidence); replica counts > 1 spawn a Fleet
+with ``devices_per_replica`` threading the host-device-count env.
+Every leg's replies are gated BITWISE against driver-computed
+references (the solo-parity + composition-invariance contracts make
+one reference set serve every leg), and the final ``meshserve_gate``
+requires the widest-mesh leg to beat the 1-device leg on rps at this
+fixed concurrency by ``--mesh-min-ratio`` (the acceptance line is
+1.5x).  The committed capture runs on the 4-device CPU mesh (the
+XLA host-device count is set automatically when jax is not yet
+loaded):
+
+    python tools/load_harness.py --mesh-devices 1,4 \
+        --out artifacts/ledger_meshserve_r21.jsonl
 """
 
 import argparse
@@ -100,25 +121,40 @@ def distinct_requests(requests):
     return out
 
 
-def _warm_megabatch(requests, serving_cfg):
-    """Compile every (batch-key, pow2-lane-bucket) megabatch executable
-    the ticks can form, directly through the driver — steady-state
-    serving must never touch the compile path (the gate below)."""
+def _group_by_key(requests):
+    """``{BatchKey: [(index, spec), ...]}`` for a batchable request
+    list (index-preserving, so references map back to reply slots)."""
     from gossip_tpu.backend import request_to_args
-    from gossip_tpu.parallel.sweep import request_sweep_curves
-    from gossip_tpu.rpc.batcher import classify_run, _topo_for
+    from gossip_tpu.rpc.batcher import classify_run
     by_key = {}
-    for req in requests:
+    for i, req in enumerate(requests):
         key, spec, _ = classify_run(request_to_args(dict(req)))
         if key is None:
             raise SystemExit(f"load mix contains an unbatchable "
                              f"request: {spec}")
-        by_key.setdefault(key, []).append(spec)
-    from gossip_tpu.parallel.sweep import _pow2_at_least
-    for key, specs in by_key.items():
+        by_key.setdefault(key, []).append((i, spec))
+    return by_key
+
+
+def _warm_megabatch(requests, serving_cfg, devices=1):
+    """Compile every (batch-key, pow2-lane-bucket) megabatch executable
+    the ticks can form, directly through the driver — steady-state
+    serving must never touch the compile path (the gate below).
+    ``devices > 1`` warms the MESH lowering the batcher will use: the
+    same lane buckets floored at the device count, dispatched on the
+    replica mesh (rpc/batcher mesh dispatch — one executable per
+    (key, bucket) there too, jit re-specializing on shardings)."""
+    from gossip_tpu.parallel.sweep import (_pow2_at_least,
+                                           request_sweep_curves)
+    from gossip_tpu.rpc.batcher import Batcher, _topo_for
+    mesh = Batcher._build_mesh(devices)
+    by_key = _group_by_key(requests)
+    for key, entries in by_key.items():
+        specs = [s for _, s in entries]
         max_lanes = _pow2_at_least(min(len(specs),
-                                       serving_cfg.max_batch))
-        lanes = 1
+                                       serving_cfg.max_batch),
+                                   devices)
+        lanes = max(1, devices)
         while lanes <= max_lanes:
             batch = (specs * lanes)[:lanes]
             # full=True matches the batcher's lowering exactly: one
@@ -127,17 +163,53 @@ def _warm_megabatch(requests, serving_cfg):
             request_sweep_curves(batch, topo=_topo_for(key.topology),
                                  n_pad=(None if key.topology is not None
                                         else key.n_bucket), lanes=lanes,
-                                 full=True)
+                                 mesh=mesh, full=True)
             lanes *= 2
     return sorted(by_key, key=str)
 
 
+def reference_replies(requests, serving_cfg):
+    """Driver-computed expected replies, one per request — the bitwise
+    yardstick every meshserve leg is gated against.  Sound because of
+    two PINNED contracts (tests/test_serving.py): megabatch rows equal
+    solo ``simulate_curve`` bitwise, and rows are invariant to batch
+    COMPOSITION — so chunking the request list through the no-mesh
+    driver yields exactly the bytes any server leg (any mesh width,
+    any tick grouping) must return.  Cheap: a handful of megabatches
+    instead of thousands of solo dispatches."""
+    from gossip_tpu.parallel.sweep import request_sweep_curves
+    from gossip_tpu.rpc.batcher import _topo_for
+    refs = [None] * len(requests)
+    for key, entries in _group_by_key(requests).items():
+        for at in range(0, len(entries), serving_cfg.max_batch):
+            chunk = entries[at:at + serving_cfg.max_batch]
+            res = request_sweep_curves(
+                tuple(s for _, s in chunk),
+                topo=_topo_for(key.topology),
+                n_pad=(None if key.topology is not None
+                       else key.n_bucket),
+                full=True)
+            for j, (i, _) in enumerate(chunk):
+                curve = [float(c) for c in res.curves[j]]
+                refs[i] = {"curve": curve, "coverage": curve[-1],
+                           "msgs": float(res.msgs[j][-1]),
+                           "rounds": int(res.rounds_to_target[j])}
+    return refs
+
+
 def run_leg(label, requests, workers, serving_cfg, timeout_s, led,
-            address=None):
+            address=None, devices=1, attempts=1):
     """One measured leg: serve in-process, replay the mix from
-    ``workers`` concurrent client threads, return (summary, replies).
-    ``address`` targets an ALREADY-RUNNING server (the fleet-router
-    leg) instead of spinning an in-process sidecar."""
+    ``workers`` concurrent client threads — each thread owns its OWN
+    channel, so ``workers == len(requests)`` is the one-connection-
+    per-request shape the meshserve capture uses — return (summary,
+    replies).  ``address`` targets an ALREADY-RUNNING server (the
+    fleet-router and multi-replica mesh legs) instead of spinning an
+    in-process sidecar; ``devices`` labels the leg's mesh width in the
+    ledger; ``attempts`` is the per-client UNAVAILABLE retry budget
+    (replies are pure functions of their payload, so a retried request
+    cannot change the bitwise gate — thousands of channels racing one
+    accept loop need it)."""
     from gossip_tpu.rpc.sidecar import SidecarClient, serve
     from gossip_tpu.utils import telemetry
     server = port = None
@@ -153,7 +225,7 @@ def run_leg(label, requests, workers, serving_cfg, timeout_s, led,
     lock = threading.Lock()
 
     def worker():
-        client = SidecarClient(address, max_attempts=1)
+        client = SidecarClient(address, max_attempts=attempts)
         while True:
             with lock:
                 i = cursor["i"]
@@ -185,6 +257,7 @@ def run_leg(label, requests, workers, serving_cfg, timeout_s, led,
     lat = [x for x in lat_ms if x is not None]
     summary = {
         "leg": label, "requests": n_req, "workers": workers,
+        "devices": devices,
         "errors": len(errors), "wall_s": round(wall, 3),
         "rps": round(n_req / wall, 2),
         "p50_ms": round(telemetry.percentile(lat, 0.50), 1),
@@ -214,19 +287,197 @@ def compare_replies(batched, solo):
     return bad
 
 
-def measure_window_batch_events(path, run_id):
-    """The ``batch`` events inside the batched leg's measurement window
+def measure_window_batch_events(path, run_id, leg="batched"):
+    """The ``batch`` events inside one leg's measurement window
     (between its load_phase markers) — the steady-all-warm gate's
-    evidence."""
+    evidence.  ``leg`` picks the window: "batched" for the classic
+    capture, "mesh_r1_dK" per in-process meshserve leg."""
     from gossip_tpu.utils import telemetry
     events = telemetry.load_ledger(path, run=run_id)
     out, active = [], False
     for e in events:
-        if e.get("ev") == "load_phase" and e.get("leg") == "batched":
+        if e.get("ev") == "load_phase" and e.get("leg") == leg:
             active = e.get("phase") == "measure_start"
         elif e.get("ev") == "batch" and active:
             out.append(e)
     return out
+
+
+def _ensure_host_devices(k):
+    """Best-effort XLA host-device-count pin for the meshserve capture:
+    only effective BEFORE the first jax import (XLA_FLAGS is read at
+    backend init) and only on the CPU platform.  When jax is already
+    loaded the ambient device count stands — the Batcher then refuses
+    loudly if it cannot build the requested mesh, so a silent 1-device
+    capture is impossible either way."""
+    if k <= 1 or "jax" in sys.modules:
+        return
+    if os.environ.get("JAX_PLATFORMS", "cpu") not in ("", "cpu"):
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={k}"
+        ).strip()
+
+
+# when the host cannot express the mesh's device parallelism at all
+# (fewer schedulable CPUs than devices: every "device" timeshares one
+# core), the scaling leg is UNRESOLVED — the ratio gate then only
+# requires the mesh not to regress the solo path beyond thread-harness
+# noise, and the gate event records scaling_resolved=false so no
+# downstream consumer can mistake the capture for scaling evidence
+# (same philosophy as fleet legs' measure_compiles=None: ledgered as
+# unmeasured, never silently green).  The >= --mesh-min-ratio check
+# arms itself automatically on any host with enough cores — the
+# hw_refresh mesh_serving step is where that recapture rides.
+_SERIAL_HOST_FLOOR = 0.85
+
+
+def run_meshserve(args, led, out_path):
+    """The per-(replica count x devices-per-replica) capture: warm the
+    driver for every mesh width, compute the bitwise reference set
+    once, then one fixed-concurrency leg per pair — finally the
+    ``meshserve_gate``: widest-mesh rps >= ``--mesh-min-ratio`` x
+    1-device rps (on hosts whose CPU count can express the device
+    parallelism — see ``_SERIAL_HOST_FLOOR``), bitwise parity on EVERY
+    leg, zero compiles in every in-process measured window."""
+    from gossip_tpu.config import ServingConfig
+    devices_list = sorted({int(d) for d in
+                           args.mesh_devices.split(",") if d})
+    replicas_list = sorted({int(r) for r in
+                            args.mesh_replicas.split(",") if r})
+    connections = args.connections
+    # a 2 MiB stack per client/handler thread: thousands of threads at
+    # the default 8 MiB would be pure address-space waste (they only
+    # drive a channel / wait on a tick); the collector thread runs only
+    # warm dispatch inside the measured window
+    if connections >= 512:
+        threading.stack_size(2 * 1024 * 1024)
+    base = request_mix(n=args.n, rounds=args.rounds,
+                       fanout=args.fanout,
+                       repeats=(connections + 3) // 4)
+    requests = base[:connections]
+    led.event("load_config", mode="meshserve",
+              requests=len(requests), connections=connections,
+              devices_legs=devices_list, replicas_legs=replicas_list,
+              n=args.n, rounds=args.rounds, tick_ms=args.tick_ms,
+              max_batch=args.max_batch, smoke=bool(args.smoke))
+
+    def cfg_for(devs):
+        return ServingConfig(tick_ms=args.tick_ms,
+                             max_batch=args.max_batch,
+                             max_queue=connections + 256,
+                             devices=devs)
+
+    led.event("load_phase", leg="warmup", phase="start")
+    refs = reference_replies(requests, cfg_for(1))
+    for devs in devices_list:
+        _warm_megabatch(requests, cfg_for(devs), devices=devs)
+    led.event("load_phase", leg="warmup", phase="end",
+              references=len(refs))
+
+    legs, mismatch_total, errors_total, compiles_total = {}, 0, 0, 0
+    for reps in replicas_list:
+        for devs in devices_list:
+            label = f"mesh_r{reps}_d{devs}"
+            if reps == 1:
+                summary, replies = run_leg(
+                    label, requests, connections, cfg_for(devs),
+                    args.timeout_s, led, devices=devs, attempts=4)
+                evs = measure_window_batch_events(out_path, led.run_id,
+                                                  leg=label)
+                compiles = sum(e.get("compiles") or 0 for e in evs)
+                summary["measure_compiles"] = compiles
+                compiles_total += compiles
+            else:
+                from gossip_tpu.config import FleetConfig
+                from gossip_tpu.rpc.router import Fleet, fleet_env
+                from gossip_tpu.rpc.sidecar import SidecarClient
+                fleet = Fleet(
+                    cfg=FleetConfig(replicas=reps,
+                                    devices_per_replica=devs,
+                                    max_inflight=connections),
+                    replica_argv=(("--devices", str(devs))
+                                  if devs > 1 else ()),
+                    env=fleet_env(devices=devs),
+                    max_workers=connections + 4)
+                try:
+                    if not fleet.router.wait_healthy(reps,
+                                                     timeout_s=60):
+                        raise SystemExit(f"{label}: fleet never "
+                                         "reached full health")
+                    for r in fleet.router.replicas:
+                        c = SidecarClient(r.address, max_attempts=1)
+                        for req in distinct_requests(requests):
+                            c.run(timeout=args.timeout_s, **req)
+                        c.close()
+                    summary, replies = run_leg(
+                        label, requests, connections, None,
+                        args.timeout_s, led, address=fleet.address,
+                        devices=devs, attempts=4)
+                    # child compiles are invisible to this ledger, so
+                    # the all-warm gate covers in-process legs only —
+                    # ledgered as unmeasured, never silently green
+                    summary["measure_compiles"] = None
+                finally:
+                    fleet.close()
+            bad = compare_replies(replies, refs)
+            for m in bad[:10]:
+                led.event("equality_mismatch", leg=label, detail=m)
+            summary["bitwise_equal"] = not bad
+            mismatch_total += len(bad)
+            errors_total += summary["errors"]
+            legs[label] = summary
+
+    base_leg = legs.get(f"mesh_r1_d{devices_list[0]}")
+    peak_leg = legs.get(f"mesh_r1_d{devices_list[-1]}")
+    ratio = (peak_leg["rps"] / base_leg["rps"]
+             if base_leg and peak_leg and base_leg["rps"] else 0.0)
+    try:
+        sched_cpus = len(os.sched_getaffinity(0))
+    except AttributeError:                  # non-Linux fallback
+        sched_cpus = os.cpu_count() or 1
+    scaling_resolved = sched_cpus >= devices_list[-1]
+    if args.mesh_min_ratio <= 0:
+        ok_ratio = True
+    elif scaling_resolved:
+        ok_ratio = ratio >= args.mesh_min_ratio
+    else:
+        # the host cannot express the device parallelism (every
+        # device timeshares sched_cpus < peak cores): the scaling leg
+        # is unresolved, not passed — gate only that the mesh path
+        # does not regress the solo path beyond harness noise
+        ok_ratio = ratio >= _SERIAL_HOST_FLOOR
+    ok = (ok_ratio and mismatch_total == 0 and errors_total == 0
+          and compiles_total == 0)
+    led.event("meshserve_gate", ok=ok,
+              devices_ratio=round(ratio, 2),
+              min_ratio=args.mesh_min_ratio, ratio_ok=ok_ratio,
+              sched_cpus=sched_cpus,
+              scaling_resolved=scaling_resolved,
+              serial_host_floor=(None if scaling_resolved
+                                 else _SERIAL_HOST_FLOOR),
+              connections=connections,
+              base_devices=devices_list[0],
+              peak_devices=devices_list[-1],
+              bitwise_equal=mismatch_total == 0,
+              mismatches=mismatch_total,
+              steady_all_warm=compiles_total == 0,
+              measure_compiles=compiles_total,
+              errors=errors_total, legs=legs)
+    print(json.dumps({"ok": ok, "mode": "meshserve",
+                      "devices_ratio": round(ratio, 2),
+                      "scaling_resolved": scaling_resolved,
+                      "sched_cpus": sched_cpus,
+                      "connections": connections,
+                      "legs": {k: {"rps": v["rps"],
+                                   "p99_ms": v["p99_ms"]}
+                               for k, v in legs.items()},
+                      "bitwise_equal": mismatch_total == 0,
+                      "steady_all_warm": compiles_total == 0,
+                      "ledger": out_path}))
+    return 0 if ok else 1
 
 
 def main(argv=None):
@@ -249,14 +500,34 @@ def main(argv=None):
                          "docs/SERVING.md \"Fleet\") — gates bitwise "
                          "reply equality vs the solo leg and ledgers "
                          "a fleet load_leg (0 = off)")
+    ap.add_argument("--mesh-devices", default=None,
+                    help="meshserve mode: comma list of devices-per-"
+                         "replica leg widths (e.g. '1,4'); switches "
+                         "the capture to fixed-concurrency mesh legs "
+                         "gated by meshserve_gate (docs/SERVING.md "
+                         "\"Mesh-sharded replicas\")")
+    ap.add_argument("--mesh-replicas", default="1",
+                    help="meshserve mode: comma list of replica "
+                         "counts to cross with --mesh-devices "
+                         "(replicas > 1 spawn a Fleet with "
+                         "devices_per_replica)")
+    ap.add_argument("--connections", type=int, default=2048,
+                    help="meshserve mode: concurrent client "
+                         "connections = requests per leg (one channel "
+                         "+ thread each; the fixed-concurrency axis)")
+    ap.add_argument("--mesh-min-ratio", type=float, default=1.5,
+                    help="meshserve acceptance: widest-mesh rps / "
+                         "1-device rps at fixed concurrency "
+                         "(0 disables)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny live batch: 2 repeats, 4 workers, no "
                          "throughput gate (equality + all-warm still "
                          "gate)")
     ap.add_argument("--out", default=None,
                     help="ledger path (default: a temp file; the "
-                         "committed capture passes artifacts/"
-                         "ledger_serving_r14.jsonl)")
+                         "committed captures pass artifacts/"
+                         "ledger_serving_r14.jsonl / "
+                         "ledger_meshserve_r21.jsonl)")
     args = ap.parse_args(argv)
     if args.smoke:
         args.repeats = min(args.repeats, 2)
@@ -264,6 +535,17 @@ def main(argv=None):
         args.n = min(args.n, 128)
         args.rounds = min(args.rounds, 8)
         args.min_ratio = 0.0
+        args.mesh_min_ratio = 0.0
+        args.connections = min(args.connections, 64)
+        if args.out and args.out.endswith(".jsonl"):
+            # the tool owns its smoke infixing (hw_refresh convention:
+            # a smoke rehearsal must never clobber a committed capture)
+            args.out = args.out[:-len(".jsonl")] + ".smoke.jsonl"
+    if args.mesh_devices:
+        # BEFORE any jax-importing call: the widest leg needs that many
+        # XLA host devices in this process
+        _ensure_host_devices(max(int(d) for d in
+                                 args.mesh_devices.split(",") if d))
 
     from gossip_tpu.config import ServingConfig
     from gossip_tpu.utils import telemetry
@@ -277,6 +559,8 @@ def main(argv=None):
     prev = telemetry.activate(led)
     try:
         led.record_runtime()
+        if args.mesh_devices:
+            return run_meshserve(args, led, out_path)
         requests = request_mix(n=args.n, rounds=args.rounds,
                                fanout=args.fanout,
                                repeats=args.repeats)
@@ -338,11 +622,20 @@ def main(argv=None):
                               detail=m)
                 fleet_ok = (not fleet_mismatch
                             and not fleet_sum["errors"])
+                # rps alone hid latency regressions (the percentile
+                # satellite): the gate event carries the leg's
+                # p50/p95/p99 — the SAME telemetry.percentile values
+                # run_leg computed, never a second definition — so
+                # fleet latency is diffable (ledger_diff carries them
+                # informationally; walls never gate)
                 led.event("fleet_gate", ok=fleet_ok,
                           replicas=args.fleet_replicas,
                           bitwise_equal=not fleet_mismatch,
                           mismatches=len(fleet_mismatch),
                           rps=fleet_sum["rps"],
+                          p50_ms=fleet_sum["p50_ms"],
+                          p95_ms=fleet_sum["p95_ms"],
+                          p99_ms=fleet_sum["p99_ms"],
                           stats=fleet.router.stats())
             finally:
                 fleet.close()

@@ -170,13 +170,14 @@ def _is_fleet_name(name: str) -> bool:
 
 
 def _is_serving_name(name: str) -> bool:
-    """Serving/load artifacts by name — throughput and latency gates
-    (the admission-batching layer's committed evidence: requests/sec,
-    p50/p95/p99, bitwise-equality verdicts — tools/load_harness) must
-    always be attributable; the legacy allowlist can never grandfather
-    one in (the whole serving layer post-dates the provenance
-    schema)."""
-    return "serving" in name or "load" in name
+    """Serving/load/meshserve artifacts by name — throughput and
+    latency gates (the admission-batching layer's committed evidence:
+    requests/sec, p50/p95/p99, bitwise-equality verdicts —
+    tools/load_harness, including the mesh-sharded device-scaling
+    captures) must always be attributable; the legacy allowlist can
+    never grandfather one in (the whole serving layer post-dates the
+    provenance schema)."""
+    return "serving" in name or "load" in name or "meshserve" in name
 
 
 def validate_file(path):
