@@ -24,6 +24,15 @@ Each rule encodes a convention a past PR learned the hard way
     string appearing at exactly ONE call site is a typo'd or orphaned
     capability row — the rejection message would name an engine no
     other factory registers.
+  * **sync-emit-in-request-path** — every ``.event(...)`` /
+    ``.gauge(...)`` reachable from a serving request-path root
+    (``Router.dispatch``, the batcher admission/tick scope, the
+    sidecar handlers — :data:`REQUEST_PATH_ROOTS`) must pass a
+    literal ``sync=False``: one defaulted emit puts an fsync on the
+    hot path and the zero-new-fsyncs serving contract
+    (docs/OBSERVABILITY.md "Request tracing") dies silently.
+    Reachability is the same-module call graph by terminal name —
+    the import-free discipline every family here uses.
 """
 
 from __future__ import annotations
@@ -32,7 +41,7 @@ import ast
 import json
 import os
 import re
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from gossip_tpu.analysis.core import (REPO, Finding, Module, call_name,
                                       expr_text, keyword_arg, str_const)
@@ -52,6 +61,19 @@ BUDGETS_JSON = os.path.join("tools", "dryrun_budgets.json")
 
 _ART_PATH = re.compile(r"(?i)artifacts|\bart\b|_art\(")
 _PROV_REFS = ("provenance", "Ledger", "artifact_ledger", "open_ledger")
+
+#: sync-emit-in-request-path roots: per module, the qualnames whose
+#: same-module call graph IS the timed serving path.  Router.dispatch
+#: covers failover/shed/trace emits (and mark_down/mark_up via the
+#: transport-failure branch); the batcher admission + tick scopes
+#: cover backpressure/batch/request_trace; the sidecar handlers cover
+#: the solo-trace and client-retry emits.
+REQUEST_PATH_ROOTS = {
+    "gossip_tpu/rpc/router.py": ("Router.dispatch",),
+    "gossip_tpu/rpc/batcher.py": ("Batcher._admit", "Batcher._loop"),
+    "gossip_tpu/rpc/sidecar.py": ("_run", "_ensemble",
+                                  "SidecarClient._call_with_retry"),
+}
 
 
 def check_event_kind(modules: Dict[str, Module]) -> List[Finding]:
@@ -117,6 +139,66 @@ def check_artifact_provenance(modules: Dict[str, Module]) -> List[Finding]:
             "validate_artifacts legacy allowlist, but every "
             "REGENERATION must be attributable (embed provenance "
             "under a 'provenance' key, the tools/roofline.py idiom)"))
+    return findings
+
+
+def check_sync_emit(modules: Dict[str, Module],
+                    roots: Optional[Dict[str, tuple]] = None
+                    ) -> List[Finding]:
+    """``sync-emit-in-request-path`` (module doc): walk the same-module
+    call graph from each root qualname by terminal callee name (the
+    :func:`gossip_tpu.analysis.core.call_name` convention), and flag
+    every ``.event(``/``.gauge(`` call whose ``sync`` keyword is
+    absent or not the literal ``False``.  Terminal-name reachability
+    over-approximates (a helper shared with a cold path still counts)
+    — exactly right for this rule: a shared helper that fsyncs is a
+    request-path fsync whenever the hot path reaches it."""
+    roots = REQUEST_PATH_ROOTS if roots is None else roots
+    findings: List[Finding] = []
+    for rel in sorted(roots):
+        mod = modules.get(rel)
+        if mod is None:
+            continue
+        by_name: Dict[str, list] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, []).append(node)
+        work = []
+        for qn in roots[rel]:
+            term = qn.rsplit(".", 1)[-1]
+            work += [fn for fn in by_name.get(term, ())
+                     if mod.qualname(fn) == qn]
+        seen, flagged = set(), set()
+        while work:
+            fn = work.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = call_name(node).rsplit(".", 1)[-1]
+                if callee in ("event", "gauge"):
+                    kw = keyword_arg(node, "sync")
+                    if (kw is not None
+                            and isinstance(kw.value, ast.Constant)
+                            and kw.value.value is False):
+                        continue
+                    if node.lineno in flagged:
+                        continue
+                    flagged.add(node.lineno)
+                    findings.append(Finding(
+                        CHECKER, "sync-emit-in-request-path", rel,
+                        node.lineno, mod.qualname(node),
+                        "ledger emit reachable from a request-path "
+                        "root without a literal sync=False — one "
+                        "defaulted emit fsyncs the timed serving path "
+                        "and silently breaks the zero-new-fsyncs "
+                        "contract (docs/OBSERVABILITY.md \"Request "
+                        "tracing\"; roots: "
+                        f"{', '.join(roots[rel])})"))
+                elif callee in by_name:
+                    work.extend(by_name[callee])
     return findings
 
 

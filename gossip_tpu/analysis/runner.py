@@ -93,6 +93,7 @@ def run_tree(root: str = REPO,
     findings += recompile.check(serving, memo)
     findings += locks.check(rpc)
     findings += conventions.check_event_kind(event_mods)
+    findings += conventions.check_sync_emit(event_mods)
     findings += conventions.check_artifact_provenance(tool_mods)
     findings += conventions.check_dryrun_budgets(root)
     findings += conventions.check_capability_strings(memo)

@@ -1310,6 +1310,13 @@ def cmd_txn(a) -> int:
 def cmd_serve(a) -> int:
     from gossip_tpu.config import ServingConfig
     from gossip_tpu.rpc.sidecar import serve
+    from gossip_tpu.utils import telemetry
+    # the replica's flight recorder: GOSSIP_TELEMETRY in the child env
+    # (tools/trace_capture.py points every replica at ONE shared file —
+    # the multi-writer torn-line contract) or the NullLedger; without
+    # this activation a replica's batch/request_trace events would
+    # vanish and no cross-ledger waterfall could ever join
+    telemetry.activate(telemetry.from_env(argv=sys.argv))
     batching = None
     if not a.no_batching:
         try:
@@ -1391,6 +1398,118 @@ def cmd_route(a) -> int:
     finally:
         fleet.close()
     return 0
+
+
+def _fleet_degraded(m: dict) -> List[str]:
+    """Degradation reasons from one Metrics reply (empty = healthy).
+    One definition for the CLI exit code, the --json document, and the
+    --out artifact — fleet-status cannot disagree with itself."""
+    reasons = []
+    if m.get("router"):
+        if m.get("healthy", 0) < m.get("replicas", 0):
+            reasons.append(f"{m.get('healthy', 0)}/"
+                           f"{m.get('replicas', 0)} replicas healthy")
+        for row in m.get("fleet", ()):
+            if not row.get("healthy"):
+                reasons.append(f"replica {row.get('replica')} "
+                               f"{(row.get('state') or 'down')}")
+            elif "error" in row:
+                reasons.append(f"replica {row.get('replica')} metrics "
+                               f"unreachable: {row['error']}")
+    elif not m.get("ok"):
+        reasons.append("replica reports not ok")
+    return reasons
+
+
+def _render_fleet_status(m: dict) -> str:
+    """The human fleet table (one poll).  A router reply renders the
+    fleet; a bare replica reply renders its own window."""
+    if not m.get("router"):
+        w = m.get("window", {})
+        return (f"replica | rps {w.get('rps', 0)} "
+                f"p50 {w.get('p50_ms', 0)}ms p99 {w.get('p99_ms', 0)}ms"
+                f" | inflight {m.get('inflight', 0)} compiles "
+                f"{m.get('compiles_total')} (+{m.get('compiles_delta')})"
+                f" devices {m.get('serving_devices')}")
+    w = m.get("window", {})
+    c = m.get("counters", {})
+    lines = [f"fleet {m.get('healthy', 0)}/{m.get('replicas', 0)} "
+             f"healthy | rps {w.get('rps', 0)} p50 {w.get('p50_ms', 0)}"
+             f"ms p99 {w.get('p99_ms', 0)}ms | dispatched "
+             f"{c.get('dispatched', 0)} failovers "
+             f"{c.get('failovers', 0)} sheds {c.get('sheds', 0)}"]
+    for row in m.get("fleet", ()):
+        state = "up" if row.get("healthy") \
+            else (row.get("state") or "down").upper()
+        line = (f"  r{row.get('replica')} {row.get('address', ''):<21}"
+                f" {state:<5} epoch {row.get('epoch')} "
+                f"inflight {row.get('inflight')}")
+        rm = row.get("metrics")
+        if rm:
+            rw = rm.get("window", {})
+            line += (f" | rps {rw.get('rps', 0)} "
+                     f"p50 {rw.get('p50_ms', 0)}ms "
+                     f"p99 {rw.get('p99_ms', 0)}ms | compiles "
+                     f"{rm.get('compiles_total')} "
+                     f"(+{rm.get('compiles_delta')}) devices "
+                     f"{rm.get('serving_devices')}")
+        elif "error" in row:
+            line += f" | error: {row['error']}"
+        lines.append(line)
+    return "\n".join(lines)
+
+
+def cmd_fleet_status(a) -> int:
+    """Live fleet health over the Metrics RPC (docs/OBSERVABILITY.md
+    "Live fleet metrics").  Exit codes: 0 = every replica healthy and
+    reporting, 1 = degraded (a down replica, an unreachable metrics
+    leaf, or healthy < replicas), 2 = the target itself unreachable —
+    a rollout gate can `fleet-status && proceed` directly."""
+    import time as _time
+
+    import grpc
+
+    from gossip_tpu.rpc.sidecar import SidecarClient
+    from gossip_tpu.utils import telemetry
+    client = SidecarClient(a.address, max_attempts=1)
+    rc = 2
+    try:
+        while True:
+            try:
+                m = client.metrics(timeout=a.timeout_s)
+            except (grpc.RpcError, ValueError) as e:
+                code = e.code() if callable(getattr(e, "code", None)) \
+                    else None
+                print(f"error: {a.address} unreachable "
+                      f"({code or type(e).__name__})", file=sys.stderr)
+                rc = 2
+                m = None
+            if m is not None:
+                reasons = _fleet_degraded(m)
+                rc = 1 if reasons else 0
+                if a.as_json:
+                    print(json.dumps({"degraded": bool(reasons),
+                                      "reasons": reasons,
+                                      "metrics": m}), flush=True)
+                else:
+                    print(_render_fleet_status(m), flush=True)
+                    for reason in reasons:
+                        print(f"  DEGRADED: {reason}", flush=True)
+                if a.out:
+                    # *fleet_status* artifacts are provenance-required
+                    # (tools/validate_artifacts.py, never grandfathered)
+                    with open(a.out, "w") as f:
+                        json.dump({"provenance": telemetry.provenance(),
+                                   "degraded": bool(reasons),
+                                   "reasons": reasons, "metrics": m},
+                                  f, indent=1)
+            if not a.watch:
+                return rc
+            _time.sleep(a.interval_s)
+    except KeyboardInterrupt:
+        return rc
+    finally:
+        client.close()
 
 
 def _device_spec_from_flags(a):
@@ -2021,6 +2140,29 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "(default cpu: N processes cannot share one "
                         "TPU; '' inherits the ambient platform)")
     p.set_defaults(fn=cmd_route)
+
+    p = sub.add_parser(
+        "fleet-status",
+        help="live fleet metrics table over the Metrics RPC; exits "
+             "nonzero on a degraded replica (docs/OBSERVABILITY.md "
+             "\"Live fleet metrics\")")
+    p.add_argument("address", metavar="HOST:PORT",
+                   help="router address (renders the whole fleet) or "
+                        "a single replica address (renders its window)")
+    p.add_argument("--watch", action="store_true",
+                   help="re-render every --interval seconds until ^C "
+                        "(exit code reflects the LAST poll)")
+    p.add_argument("--interval", dest="interval_s", type=float,
+                   default=2.0, help="--watch poll cadence, seconds")
+    p.add_argument("--timeout", dest="timeout_s", type=float,
+                   default=10.0, help="per-poll Metrics RPC timeout")
+    p.add_argument("--json", dest="as_json", action="store_true",
+                   help="one JSON document per poll instead of the "
+                        "table")
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="also write the latest poll as a provenance-"
+                        "stamped fleet_status JSON artifact")
+    p.set_defaults(fn=cmd_fleet_status)
 
     p = sub.add_parser(
         "plan",

@@ -263,14 +263,16 @@ class _Pending:
     """One admitted request waiting on its batch tick."""
 
     __slots__ = ("kind", "key", "specs", "want_curve", "deadline",
-                 "enq_t", "event", "reply", "error")
+                 "enq_t", "event", "reply", "error", "trace_id")
 
-    def __init__(self, kind, key, specs, want_curve, deadline):
+    def __init__(self, kind, key, specs, want_curve, deadline,
+                 trace_id=None):
         self.kind = kind                  # "run" | "ensemble"
         self.key = key
         self.specs = specs                # tuple[RequestSpec]
         self.want_curve = want_curve
         self.deadline = deadline          # absolute monotonic or None
+        self.trace_id = trace_id          # request correlation id
         self.enq_t = time.monotonic()
         self.event = threading.Event()
         self.reply = None
@@ -355,33 +357,48 @@ class Batcher:
                 telemetry.current().event(
                     "backpressure", sync=False, queue_depth=depth,
                     rejected_lanes=len(pending.specs),
-                    max_queue=self.cfg.max_queue)
+                    max_queue=self.cfg.max_queue,
+                    trace_id=pending.trace_id)
                 raise QueueFull(
                     f"admission queue full ({depth}/"
                     f"{self.cfg.max_queue} lanes); back off and retry")
             self._queue.append((pending.key, pending))
+        if pending.trace_id is not None:
+            # the admission span: queue depth at entry + the lane
+            # count this request will occupy; its queue-wait closes in
+            # the terminal request_trace (sync=False — admission runs
+            # inside the handler's measured window)
+            from gossip_tpu.utils import telemetry
+            telemetry.current().event(
+                "trace_admit", sync=False, trace_id=pending.trace_id,
+                req_kind=pending.kind, lanes=len(pending.specs),
+                queue_depth=depth)
         return pending
 
-    def submit_run(self, args, deadline) -> Tuple[Optional[_Pending],
-                                                  Optional[str]]:
+    def submit_run(self, args, deadline,
+                   trace_id=None) -> Tuple[Optional[_Pending],
+                                           Optional[str]]:
         """Admit a Run request: ``(pending, None)`` when batchable
         (caller blocks on ``pending.wait()``), ``(None, reason)`` for
         the solo fallthrough.  Raises :class:`QueueFull` at the
-        backpressure cap."""
+        backpressure cap.  ``trace_id`` rides the pending through the
+        tick so the batch event and the terminal request_trace carry
+        it (docs/OBSERVABILITY.md)."""
         key, spec, want_curve = classify_run(args)
         if key is None:
             return None, spec
         return self._admit(_Pending("run", key, (spec,), want_curve,
-                                    deadline)), None
+                                    deadline, trace_id)), None
 
-    def submit_ensemble(self, args, seeds, count, deadline):
+    def submit_ensemble(self, args, seeds, count, deadline,
+                        trace_id=None):
         """Ensemble twin of :meth:`submit_run` — each seed is one
         megabatch lane."""
         key, specs = classify_ensemble(args, seeds, count)
         if key is None:
             return None, specs
         return self._admit(_Pending("ensemble", key, specs, False,
-                                    deadline)), None
+                                    deadline, trace_id)), None
 
     # -- collector -----------------------------------------------------
 
@@ -470,7 +487,8 @@ class Batcher:
         # positional (the event name) and would collide
         telemetry.current().event(
             "deadline_exceeded", sync=False, req_kind=p.kind,
-            wait_ms=round(wait_ms, 1), lanes=len(p.specs))
+            wait_ms=round(wait_ms, 1), lanes=len(p.specs),
+            trace_id=p.trace_id)
         p.error = Expired(
             "deadline expired before the batch tick ran "
             f"(waited {wait_ms:.0f} ms; the client timeout bounds "
@@ -535,6 +553,10 @@ class Batcher:
             wait_ms_max=round(waits[-1], 1) if waits else 0.0,
             run_ms=round(run_ms, 1), compiles=compiles, cache=cache,
             devices=self.devices,
+            # the megabatch span links its member traces — the
+            # tick-membership edge of the waterfall join
+            trace_ids=[p.trace_id for p in entries
+                       if p.trace_id is not None],
             **key.describe())
         off = 0
         for p in entries:
@@ -547,6 +569,16 @@ class Batcher:
             except Exception as e:
                 p.error = BatchError(
                     f"reply assembly failed: {type(e).__name__}: {e}")
+            if p.trace_id is not None:
+                # the replica half of the per-request waterfall
+                # (queue wait + batch run); the router half carries
+                # proxy_ms/retries — tools/trace_report.py joins them
+                telemetry.current().event(
+                    "request_trace", sync=False, trace_id=p.trace_id,
+                    source="replica", req_kind=p.kind, batched=True,
+                    tick=self._tick, lanes=k, cache=cache,
+                    queue_wait_ms=round((t0 - p.enq_t) * 1e3, 1),
+                    batch_run_ms=round(run_ms, 1))
             off += k
             p.event.set()
 

@@ -222,7 +222,8 @@ class _Replica:
         self.client = SidecarClient(address, max_attempts=1)
         self.stubs = {"run": self.client._run,
                       "ensemble": self.client._ensemble,
-                      "health": self.client._health}
+                      "health": self.client._health,
+                      "metrics": self.client._metrics}
 
     def close(self):
         try:
@@ -252,6 +253,12 @@ class Router:
         self.counters = {"dispatched": 0, "failovers": 0, "sheds": 0,
                          "deadline_rejects": 0, "downs": 0, "ups": 0,
                          "catchups": 0}
+        # the router's own live-metrics window: end-to-end dispatch
+        # latencies (queue wait + run + failover retries, as the
+        # CLIENT experiences them) plus shed/failover counters — the
+        # fleet half of the Metrics reply (docs/OBSERVABILITY.md)
+        from gossip_tpu.utils import telemetry
+        self.metrics = telemetry.MetricsWindow()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -445,12 +452,24 @@ class Router:
 
     def dispatch(self, method: str, payload: bytes, context) -> bytes:
         """Route one RPC with failover (module-doc contract); aborts
-        the gRPC context on shed/deadline/replica-reply errors."""
+        the gRPC context on shed/deadline/replica-reply errors.
+
+        Tracing: the incoming ``gossip-trace-id`` metadata (rpc/sidecar
+        TRACE_KEY) is read once, stamped on every span this dispatch
+        emits (``dispatch_attempt`` per attempt, ``failover``/``shed``/
+        ``deadline_exceeded`` on those paths, a terminal
+        ``request_trace`` on success), and FORWARDED verbatim to the
+        replica — the reply bytes stay untouched.  All emits are
+        sync=False: the dispatch loop IS the timed path."""
         import grpc
 
         from gossip_tpu.rpc import batcher as B
+        from gossip_tpu.rpc.sidecar import trace_id_of, trace_metadata
         from gossip_tpu.utils import telemetry
         deadline = B.deadline_of(context)
+        trace_id = trace_id_of(context)
+        metadata = trace_metadata(trace_id)
+        t_start = time.monotonic()
         tried: list = []
         while True:
             remaining = None
@@ -463,7 +482,7 @@ class Router:
                     telemetry.current().event(
                         "deadline_exceeded", sync=False,
                         source="router", method=method,
-                        tried=list(tried))
+                        tried=list(tried), trace_id=trace_id)
                     context.abort(
                         grpc.StatusCode.DEADLINE_EXCEEDED,
                         "deadline expired before a replica could "
@@ -476,21 +495,34 @@ class Router:
                                   if x.healthy)
                     inflight = [x.inflight for x in self.replicas]
                     self.counters["sheds"] += 1
+                self.metrics.bump("sheds")
                 reason = ("no healthy replica"
                           if healthy == 0 else "all replicas at the "
                           "in-flight cap")
                 telemetry.current().event(
                     "shed", sync=False, method=method, reason=reason,
                     healthy=healthy, inflight=inflight,
-                    tried=list(tried))
+                    tried=list(tried), trace_id=trace_id)
                 context.abort(
                     grpc.StatusCode.RESOURCE_EXHAUSTED,
                     f"fleet shed: {reason} "
                     f"({healthy}/{len(self.replicas)} healthy); back "
                     "off and retry")
+            if trace_id is not None:
+                # one span per dispatch attempt: which replica, its
+                # probe state at pick time, and the deadline budget
+                # still available — the failover half of the waterfall
+                telemetry.current().event(
+                    "dispatch_attempt", sync=False, trace_id=trace_id,
+                    method=method, attempt=len(tried) + 1,
+                    replica=r.index, consec_ok=r.consec_ok,
+                    consec_fail=r.consec_fail,
+                    remaining_s=(None if remaining is None
+                                 else round(remaining, 3)))
             try:
                 try:
-                    return r.stubs[method](payload, timeout=remaining)
+                    reply = r.stubs[method](payload, timeout=remaining,
+                                            metadata=metadata)
                 finally:
                     with self._lock:
                         r.inflight -= 1
@@ -516,11 +548,13 @@ class Router:
                     tried.append(r.index)
                     with self._lock:
                         self.counters["failovers"] += 1
+                    self.metrics.bump("failovers")
                     telemetry.current().event(
                         "failover", sync=False, method=method,
                         from_replica=r.index, tried=list(tried),
                         remaining_s=(None if remaining is None
-                                     else round(remaining, 3)))
+                                     else round(remaining, 3)),
+                        trace_id=trace_id)
                     continue
                 # a WELL-FORMED replica reply (it processed the call)
                 # or the propagated client deadline: verbatim, never
@@ -528,6 +562,23 @@ class Router:
                 details = e.details() if callable(
                     getattr(e, "details", None)) else str(e)
                 context.abort(code, details or str(code))
+            proxy_ms = (time.monotonic() - t_start) * 1e3
+            self.metrics.record(proxy_ms)
+            if trace_id is not None:
+                # the terminal router-side waterfall half: end-to-end
+                # proxy wall, retry count, and how much of the client
+                # deadline this request consumed
+                budget_s = (None if deadline is None
+                            else deadline - t_start)
+                telemetry.current().event(
+                    "request_trace", sync=False, trace_id=trace_id,
+                    source="router", method=method, replica=r.index,
+                    retries=len(tried),
+                    proxy_ms=round(proxy_ms, 1),
+                    deadline_consumed=(
+                        None if not budget_s
+                        else round(proxy_ms / 1e3 / budget_s, 4)))
+            return reply
 
     def close(self):
         self._stop.set()
@@ -569,6 +620,41 @@ def serve_router(addresses: Sequence[str], port: int = 0,
             "epochs": s["epochs"], "states": s["states"],
             "service": SERVICE}).encode()
 
+    def _metrics(request, context):
+        """The fleet metrics plane: the router's own dispatch window
+        plus one row per replica (its Metrics reply fanned in, or the
+        error that kept it out — a dead replica is a row, never a
+        silent hole).  `gossip_tpu fleet-status` renders exactly this
+        reply and exits nonzero on any degraded row."""
+        s = router.stats()
+        rows = []
+        for r in list(router.replicas):
+            row = {"replica": r.index, "address": r.address,
+                   "healthy": r.healthy,
+                   "state": s["states"][r.index],
+                   "epoch": s["epochs"][r.index],
+                   "inflight": s["inflight"][r.index]}
+            try:
+                raw = r.stubs["metrics"](
+                    b"{}", timeout=router.cfg.probe_timeout_s)
+                row["metrics"] = json.loads(raw)
+            except Exception as e:          # noqa: BLE001 — a dead
+                # replica's row must carry WHY, not kill the fan-out
+                row["error"] = (f"{type(e).__name__}: "
+                                + str(e).splitlines()[0][:200]
+                                if str(e) else type(e).__name__)
+            rows.append(row)
+        return json.dumps({
+            "ok": s["healthy"] > 0, "router": True,
+            "service": SERVICE, "role": "router",
+            "replicas": s["replicas"], "healthy": s["healthy"],
+            "window": router.metrics.snapshot(),
+            "counters": {k: s[k] for k in
+                         ("dispatched", "failovers", "sheds",
+                          "deadline_rejects", "downs", "ups",
+                          "catchups") if k in s},
+            "fleet": rows}).encode()
+
     server = grpc.server(futures.ThreadPoolExecutor(
         max_workers=max_workers))
     handlers = {
@@ -580,6 +666,9 @@ def serve_router(addresses: Sequence[str], port: int = 0,
             response_serializer=_identity),
         "Health": grpc.unary_unary_rpc_method_handler(
             _health, request_deserializer=_identity,
+            response_serializer=_identity),
+        "Metrics": grpc.unary_unary_rpc_method_handler(
+            _metrics, request_deserializer=_identity,
             response_serializer=_identity),
     }
     server.add_generic_rpc_handlers(

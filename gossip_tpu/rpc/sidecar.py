@@ -37,6 +37,9 @@ well-formed error is never retried).
 from __future__ import annotations
 
 import json
+import sys
+import threading
+import time
 from concurrent import futures
 from typing import Optional, Tuple
 
@@ -45,6 +48,36 @@ import grpc
 from gossip_tpu.config import ServingConfig
 
 SERVICE = "gossip.Simulator"
+
+# The one gRPC metadata key of the tracing plane: SidecarClient mints a
+# trace id per logical request (telemetry.new_trace_id) and sends it
+# here; the router reads it, stamps its dispatch spans with it, and
+# FORWARDS the same key to the replica it picks, so client -> router ->
+# replica batcher all ledger the one id (docs/OBSERVABILITY.md
+# "Request tracing").  Lowercase per gRPC metadata rules.
+TRACE_KEY = "gossip-trace-id"
+
+
+def trace_id_of(context) -> Optional[str]:
+    """The request's trace id from its gRPC invocation metadata, or
+    None (an untraced caller — every event gate below is conditional,
+    so untraced requests cost nothing and ledger nothing new)."""
+    try:
+        md = context.invocation_metadata()
+    except Exception:
+        return None
+    for item in md or ():
+        if item[0] == TRACE_KEY:
+            return str(item[1])
+    return None
+
+
+def trace_metadata(trace_id: Optional[str]):
+    """Outgoing-metadata tuple carrying ``trace_id`` (None passes
+    through: grpc treats metadata=None as no metadata)."""
+    if trace_id is None:
+        return None
+    return ((TRACE_KEY, trace_id),)
 
 # Exceptions a malformed/invalid request may legitimately raise while
 # being parsed/validated/run — each becomes INVALID_ARGUMENT with a
@@ -91,8 +124,25 @@ def _await_batched(pending, context) -> bytes:
         context.abort(grpc.StatusCode.INTERNAL, _one_line(e))
 
 
+def _solo_trace(trace_id: Optional[str], req_kind: str, run_ms: float,
+                note: Optional[str]):
+    """The solo path's terminal replica-side ``request_trace``: no
+    queue, so queue_wait is structurally zero and batch_run is the
+    whole solo dispatch.  sync=False — this emit sits inside the
+    handler's measured window (the driver_timing discipline)."""
+    if trace_id is None:
+        return
+    from gossip_tpu.utils import telemetry
+    telemetry.current().event(
+        "request_trace", sync=False, trace_id=trace_id,
+        source="replica", req_kind=req_kind, batched=False,
+        solo_reason=note, queue_wait_ms=0.0,
+        batch_run_ms=round(run_ms, 1))
+
+
 def _run(request: bytes, context, batcher=None) -> bytes:
     from gossip_tpu.backend import request_to_args, run_simulation
+    trace_id = trace_id_of(context)
     try:
         args = request_to_args(_parse_obj(request))
     except _BAD_REQUEST as e:
@@ -102,7 +152,8 @@ def _run(request: bytes, context, batcher=None) -> bytes:
         from gossip_tpu.rpc import batcher as B
         try:
             pending, note = batcher.submit_run(args,
-                                               B.deadline_of(context))
+                                               B.deadline_of(context),
+                                               trace_id=trace_id)
         except B.QueueFull as e:
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
                           _one_line(e))
@@ -113,10 +164,12 @@ def _run(request: bytes, context, batcher=None) -> bytes:
             context.abort(grpc.StatusCode.UNAVAILABLE, _one_line(e))
         if pending is not None:
             return _await_batched(pending, context)
+    t0 = time.monotonic()
     try:
         report = run_simulation(**args)
     except (ValueError, TypeError) as e:
         context.abort(grpc.StatusCode.INVALID_ARGUMENT, _one_line(e))
+    _solo_trace(trace_id, "run", (time.monotonic() - t0) * 1e3, note)
     out = report.to_dict()
     if batcher is not None:
         # the solo fallthrough under a batching sidecar is loudly
@@ -134,6 +187,7 @@ def _ensemble(request: bytes, context, batcher=None) -> bytes:
     Under an admission-batching sidecar, each seed rides one megabatch
     lane next to concurrent Run requests of the same batch key."""
     from gossip_tpu.backend import request_to_args, run_ensemble
+    trace_id = trace_id_of(context)
     try:
         req = _parse_obj(request)
         seeds = req.pop("seeds", None)
@@ -174,7 +228,8 @@ def _ensemble(request: bytes, context, batcher=None) -> bytes:
         from gossip_tpu.rpc import batcher as B
         try:
             pending, note = batcher.submit_ensemble(
-                args, seeds, count, B.deadline_of(context))
+                args, seeds, count, B.deadline_of(context),
+                trace_id=trace_id)
         except B.QueueFull as e:
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
                           _one_line(e))
@@ -185,6 +240,7 @@ def _ensemble(request: bytes, context, batcher=None) -> bytes:
             context.abort(grpc.StatusCode.UNAVAILABLE, _one_line(e))
         if pending is not None:
             return _await_batched(pending, context)
+    t0 = time.monotonic()
     try:
         # the payload-workload keys are always present in the parsed
         # args (request_to_args emits them as None when absent) and
@@ -197,6 +253,8 @@ def _ensemble(request: bytes, context, batcher=None) -> bytes:
                "n": args["tc"].n, **extra}
     except (ValueError, TypeError) as e:
         context.abort(grpc.StatusCode.INVALID_ARGUMENT, _one_line(e))
+    _solo_trace(trace_id, "ensemble", (time.monotonic() - t0) * 1e3,
+                note)
     if batcher is not None:
         out["batch"] = {"batched": False, "reason": note}
     return json.dumps(out).encode()
@@ -215,6 +273,56 @@ def _health(request: bytes, context, batcher=None) -> bytes:
         "serving_devices": (batcher.devices if batcher is not None
                             else 1),
         "service": SERVICE,
+    }).encode()
+
+
+def _backend_compiles() -> Optional[int]:
+    """This process's cumulative backend-compile count from the shared
+    JitCompileMonitor, or None when unknowable.  Guarded on jax being
+    ALREADY imported: a Metrics poll must never be the thing that
+    initializes a backend (the telemetry record_runtime rule) — and a
+    jax-less process truthfully has zero compiles."""
+    if "jax" not in sys.modules:
+        return 0
+    try:
+        from gossip_tpu.rpc.batcher import _monitor
+        mon = _monitor()
+        return mon.backend_compiles if mon.durations_available else None
+    except Exception:
+        return None
+
+
+def _metrics(request: bytes, context, batcher=None, window=None,
+             state=None, lock=None) -> bytes:
+    """The replica's live-metrics reply (the fleet plane's per-replica
+    leaf — docs/OBSERVABILITY.md "Live fleet metrics"): the rolling
+    request window (rps + p50/p95/p99 over ``window_s``), in-flight
+    gauge, cumulative + since-last-poll backend compiles, and the
+    ambient ledger's fsync count (the zero-new-fsyncs-in-the-timed-
+    path verification hook).  Read-only and cheap: no jax init, no
+    device transfer, no ledger write."""
+    from gossip_tpu.utils import telemetry
+    snap = window.snapshot() if window is not None else {}
+    compiles = _backend_compiles()
+    inflight = 0
+    delta = None
+    if state is not None and lock is not None:
+        with lock:
+            inflight = state["inflight"]
+            if compiles is not None:
+                delta = compiles - state["last_compiles"]
+                state["last_compiles"] = compiles
+    return json.dumps({
+        "ok": True,
+        "service": SERVICE,
+        "role": "replica",
+        "serving_devices": (batcher.devices if batcher is not None
+                            else 1),
+        "inflight": inflight,
+        "window": snap,
+        "compiles_total": compiles,
+        "compiles_delta": delta,
+        "ledger_fsyncs": getattr(telemetry.current(), "fsyncs", 0),
     }).encode()
 
 
@@ -261,23 +369,52 @@ def serve(port: int = 50051, max_workers: int = 4,
     replica spans processes (docs/SERVING.md "Mesh-sharded replicas").
     The collector is a daemon thread; ``server.gossip_batcher.close()``
     drains it (tests, the load harness)."""
+    from gossip_tpu.utils import telemetry
     batcher = None
     if batching is not None:
         _maybe_init_distributed(batching)
         from gossip_tpu.rpc.batcher import Batcher
         batcher = Batcher(batching)
+    # the live-metrics plane: one rolling window + inflight gauge per
+    # replica, read by the Metrics RPC (and through it the router's
+    # fleet fan-out and `gossip_tpu fleet-status`)
+    window = telemetry.MetricsWindow()
+    mstate = {"inflight": 0, "last_compiles": 0}
+    mlock = threading.Lock()
+
+    def _observed(fn):
+        """Record every Run/Ensemble into the rolling window (latency
+        + inflight), success or abort — a replica that only aborts
+        still shows traffic."""
+        def handler(req, ctx):
+            t0 = time.perf_counter()
+            with mlock:
+                mstate["inflight"] += 1
+            try:
+                return fn(req, ctx)
+            finally:
+                with mlock:
+                    mstate["inflight"] -= 1
+                window.record((time.perf_counter() - t0) * 1e3)
+        return handler
+
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     handlers = {
         "Run": grpc.unary_unary_rpc_method_handler(
-            lambda req, ctx: _run(req, ctx, batcher),
+            _observed(lambda req, ctx: _run(req, ctx, batcher)),
             request_deserializer=_identity,
             response_serializer=_identity),
         "Ensemble": grpc.unary_unary_rpc_method_handler(
-            lambda req, ctx: _ensemble(req, ctx, batcher),
+            _observed(lambda req, ctx: _ensemble(req, ctx, batcher)),
             request_deserializer=_identity,
             response_serializer=_identity),
         "Health": grpc.unary_unary_rpc_method_handler(
             lambda req, ctx: _health(req, ctx, batcher),
+            request_deserializer=_identity,
+            response_serializer=_identity),
+        "Metrics": grpc.unary_unary_rpc_method_handler(
+            lambda req, ctx: _metrics(req, ctx, batcher, window,
+                                      mstate, mlock),
             request_deserializer=_identity,
             response_serializer=_identity),
     }
@@ -288,6 +425,7 @@ def serve(port: int = 50051, max_workers: int = 4,
         raise OSError(f"could not bind {host}:{port} (port in use?)")
     server.start()
     server.gossip_batcher = batcher
+    server.gossip_metrics = window
     return server, bound
 
 
@@ -340,9 +478,13 @@ class SidecarClient:
         self._health = self._channel.unary_unary(
             f"/{SERVICE}/Health", request_serializer=_identity,
             response_deserializer=_identity)
+        self._metrics = self._channel.unary_unary(
+            f"/{SERVICE}/Metrics", request_serializer=_identity,
+            response_deserializer=_identity)
 
     def _call_with_retry(self, call, payload: bytes, timeout,
-                         method: str, retryable=_TRANSIENT_CODES):
+                         method: str, retryable=_TRANSIENT_CODES,
+                         metadata=None, trace_id=None):
         """One RPC with the retry contract above.  ``retryable`` is the
         status-code set that marks a transport (not application)
         failure.
@@ -373,7 +515,8 @@ class SidecarClient:
                     # transport failure rather than dispatch again
                     raise last_error
             try:
-                return call(payload, timeout=attempt_timeout)
+                return call(payload, timeout=attempt_timeout,
+                            metadata=metadata)
             except grpc.RpcError as e:
                 last_error = e
                 code = e.code() if callable(getattr(e, "code", None)) \
@@ -392,27 +535,51 @@ class SidecarClient:
                 telemetry.current().event(
                     "rpc_retry", sync=False, method=method,
                     attempt=attempt + 1, code=str(code),
-                    sleep_s=round(sleep, 3))
+                    sleep_s=round(sleep, 3), trace_id=trace_id)
                 _time.sleep(sleep)
         raise AssertionError("unreachable: loop returns or raises")
 
-    def run(self, timeout: Optional[float] = 600.0, **request) -> dict:
+    def run(self, timeout: Optional[float] = 600.0,
+            trace_id: Optional[str] = None, **request) -> dict:
         """One simulation.  kwargs mirror the JSON request fields:
-        backend, proto, topology, run, fault, mesh, curve."""
+        backend, proto, topology, run, fault, mesh, curve.
+
+        Every call carries a trace id in gRPC metadata (minted here
+        unless the caller supplies one — capture tools pass their own
+        so they can join the waterfall afterwards); the reply bytes
+        stay untouched (the router's transparent-bytes contract), the
+        correlation lives entirely in metadata + ledgers."""
+        from gossip_tpu.utils import telemetry
+        tid = trace_id or telemetry.new_trace_id()
         return json.loads(self._call_with_retry(
-            self._run, json.dumps(request).encode(), timeout, "run"))
+            self._run, json.dumps(request).encode(), timeout, "run",
+            metadata=trace_metadata(tid), trace_id=tid))
 
     def ensemble(self, timeout: Optional[float] = 600.0,
-                 **request) -> dict:
+                 trace_id: Optional[str] = None, **request) -> dict:
         """Seed-ensemble statistics; kwargs mirror the Run fields plus
-        seeds=[...] or ensemble=count."""
+        seeds=[...] or ensemble=count.  Trace-id contract as in
+        :meth:`run`."""
+        from gossip_tpu.utils import telemetry
+        tid = trace_id or telemetry.new_trace_id()
         return json.loads(self._call_with_retry(
             self._ensemble, json.dumps(request).encode(), timeout,
-            "ensemble"))
+            "ensemble", metadata=trace_metadata(tid), trace_id=tid))
 
     def health(self, timeout: float = 10.0) -> dict:
         return json.loads(self._call_with_retry(
             self._health, b"{}", timeout, "health",
+            retryable=_TRANSIENT_CODES
+            | {grpc.StatusCode.DEADLINE_EXCEEDED}))
+
+    def metrics(self, timeout: float = 10.0) -> dict:
+        """The live-metrics snapshot: a replica answers for itself
+        (rps/percentiles/inflight/compiles/fsyncs); a router answers
+        for the whole fleet (its own dispatch window + one row per
+        replica — rpc/router Metrics fan-out).  Untraced: a metrics
+        poll is not a request."""
+        return json.loads(self._call_with_retry(
+            self._metrics, b"{}", timeout, "metrics",
             retryable=_TRANSIENT_CODES
             | {grpc.StatusCode.DEADLINE_EXCEEDED}))
 

@@ -48,12 +48,14 @@ Render a ledger with tools/telemetry_report.py.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import math
 import os
 import subprocess
 import sys
+import threading
 import time
 import uuid
 from typing import IO, Iterator, Optional
@@ -109,6 +111,17 @@ def provenance(argv=None) -> dict:
         "platform": sys.platform,
         "pid": os.getpid(),
     }
+
+
+def new_trace_id() -> str:
+    """A fresh request correlation id (16 hex chars) — minted ONCE per
+    logical request by the outermost client (rpc/sidecar.SidecarClient)
+    and carried verbatim through gRPC metadata across router dispatch,
+    failover re-dispatch, and batcher admission, so every ledger event
+    a request touches joins on the same id (tools/trace_report.py).
+    uuid4-derived: no coordination, no clock, collision odds at any
+    realistic request volume are negligible (64 bits)."""
+    return uuid.uuid4().hex[:16]
 
 
 def _finite(x):
@@ -168,6 +181,10 @@ class Ledger:
         self._f: Optional[IO[str]] = open(self.path, "a")
         self._echo = echo
         self._fsync = fsync
+        # fsyncs actually issued: the zero-new-fsyncs-in-the-timed-path
+        # claim (request tracing, docs/OBSERVABILITY.md) is verified by
+        # reading this counter across a measured window, not by trust
+        self.fsyncs = 0
         self._span_stack: list = []
         self._next_span = 1
         self._counters: dict = {}
@@ -201,6 +218,7 @@ class Ledger:
             self._f.flush()
             if self._fsync and sync:
                 os.fsync(self._f.fileno())
+                self.fsyncs += 1
         except OSError as e:
             # the flight recorder must never be what kills the flight
             # (disk full mid-run): warn once, stop recording
@@ -335,6 +353,7 @@ class NullLedger:
     path = None
     run_id = None
     active = False
+    fsyncs = 0
 
     def event(self, kind, sync=True, **fields):
         pass
@@ -505,6 +524,68 @@ def percentile(values, q: float) -> float:
     return float(vals[min(len(vals) - 1, max(0, rank - 1))])
 
 
+class MetricsWindow:
+    """Thread-safe rolling metrics window for the live fleet plane
+    (the ``Metrics`` RPC on gossip.Simulator — rpc/sidecar serves one
+    per replica, rpc/router keeps its own for dispatch latencies).
+
+    Holds (monotonic_ts, latency_ms) samples pruned to the trailing
+    ``window_s`` seconds plus named monotonic counters (sheds,
+    failovers, ...).  ``snapshot()`` is the one read path: rps over
+    the window, sample count, p50/p95/p99 via :func:`percentile` (the
+    shared nearest-rank definition), and the counter totals.  Pure
+    host-side bookkeeping — a record() is an append + occasional
+    popleft under a lock, never an fsync, never a device transfer —
+    so the zero-steady-state-cost contract of this module holds.
+    """
+
+    def __init__(self, window_s: float = 60.0):
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._samples: collections.deque = collections.deque()
+        self._counters: dict = {}
+
+    def record(self, latency_ms: float, now: Optional[float] = None):
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._samples.append((now, float(latency_ms)))
+            self._prune_locked(now)
+
+    def bump(self, name: str, inc: int = 1):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + inc
+
+    def _prune_locked(self, now: float):
+        cutoff = now - self.window_s
+        while self._samples and self._samples[0][0] < cutoff:
+            self._samples.popleft()
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._prune_locked(now)
+            lats = [ms for _, ms in self._samples]
+            oldest = self._samples[0][0] if self._samples else now
+            counters = dict(self._counters)
+        # rps over the ACTUAL span covered, not the nominal window:
+        # a 3-second-old process with 30 samples reports ~10 rps, not
+        # the misleading 0.5 a fixed 60 s denominator would give
+        if lats:
+            span = min(max(now - oldest, 1e-9), self.window_s)
+            rps = len(lats) / span
+        else:
+            rps = 0.0
+        return {
+            "window_s": self.window_s,
+            "n": len(lats),
+            "rps": round(rps, 3),
+            "p50_ms": round(percentile(lats, 0.50), 3),
+            "p95_ms": round(percentile(lats, 0.95), 3),
+            "p99_ms": round(percentile(lats, 0.99), 3),
+            **counters,
+        }
+
+
 # -- reading ----------------------------------------------------------
 
 def parse_dryrun_table(text: str):
@@ -528,7 +609,8 @@ def parse_dryrun_table(text: str):
 
 
 def load_ledger(path: str, run: Optional[str] = None,
-                strict: bool = False):
+                strict: bool = False,
+                trace_id: Optional[str] = None):
     """Parse a ledger back into a list of event dicts.
 
     Crash contract: every fsynced line is durable, and a kill between
@@ -542,7 +624,10 @@ def load_ledger(path: str, run: Optional[str] = None,
     default; ``strict=True`` (single-writer files, tests) raises
     ValueError on any torn line that is not the final one.
     ``run`` filters to one run id; ``run="last"`` selects the newest
-    provenance line's run."""
+    provenance line's run.  ``trace_id`` filters to the events of one
+    request trace (events carrying that ``trace_id`` field) — the
+    single-trace read path tools/trace_report.py's exemplar drill-down
+    and the failover-propagation tests share."""
     events = []
     with open(path) as f:
         lines = f.read().splitlines()
@@ -562,4 +647,6 @@ def load_ledger(path: str, run: Optional[str] = None,
         run = provs[-1]["run"] if provs else None
     if run is not None:
         events = [e for e in events if e.get("run") == run]
+    if trace_id is not None:
+        events = [e for e in events if e.get("trace_id") == trace_id]
     return events

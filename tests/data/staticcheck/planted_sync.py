@@ -1,0 +1,33 @@
+"""Planted sync-emit-in-request-path violations + negative twin for
+tests/test_staticcheck.py (parsed, never executed).  The test roots
+this module at ``Router.dispatch`` and ``CleanRouter.dispatch``:
+``Router`` MUST flag twice (defaulted emit in the root, sync=True in a
+reachable helper), ``CleanRouter`` — the same call shape with literal
+``sync=False`` everywhere — must stay silent, and the off-path emit
+must never flag (reachability, not module scan)."""
+
+
+class Router:
+    def dispatch(self, telemetry, method):
+        telemetry.current().event("shed", method=method)    # MUST FLAG
+        return self._attempt(telemetry, method)
+
+    def _attempt(self, telemetry, method):
+        # sync present but not the literal False
+        telemetry.current().event(                          # MUST FLAG
+            "dispatch_attempt", sync=True, method=method)
+
+
+class CleanRouter:
+    def dispatch(self, telemetry, method):
+        telemetry.current().event("shed", sync=False, method=method)
+        return self._attempt(telemetry, method)
+
+    def _attempt(self, telemetry, method):
+        telemetry.current().event(
+            "dispatch_attempt", sync=False, method=method)
+
+
+def off_path_report(telemetry):
+    # not reachable from any root: a post-run reporter may fsync
+    telemetry.current().event("report_done")        # must NOT flag

@@ -47,12 +47,13 @@ _tspec.loader.exec_module(readme_table)
 FAMILIES = frozenset({
     "dense_pushpull", "churn_heal", "churn_sweep", "fused_churn_sweep",
     "crdt_counter", "kafka_log", "txn_register", "serving_batch",
-    "mesh_serving", "fleet_failover", "packed_pull", "scale_plan",
-    "sparse_antientropy",
+    "mesh_serving", "fleet_failover", "request_trace", "packed_pull",
+    "scale_plan", "sparse_antientropy",
     "topo_sparse_antientropy", "swim_rotating", "halo_banded",
     "fused_planes", "fused_planes_fault_curve", "rumor_sir",
     "hybrid_2d_sweep"})
-# the committed r20 record predates the mesh-serving PR's mesh_serving
+# the committed r21 record predates the tracing PR's request_trace
+# family; the committed r20 record predates the mesh-serving PR's mesh_serving
 # family; the committed r18 record predates the scale-planner PR's scale_plan
 # family; the committed r17 record additionally predates the fleet
 # PR's fleet_failover
@@ -67,7 +68,8 @@ FAMILIES = frozenset({
 # predate the compiled-nemesis PR's churn_heal family and the
 # traced-operand PR's churn_sweep family — each pin stays on its
 # historical set
-FAMILIES_PRE_MESH = FAMILIES - {"mesh_serving"}
+FAMILIES_PRE_TRACE = FAMILIES - {"request_trace"}
+FAMILIES_PRE_MESH = FAMILIES_PRE_TRACE - {"mesh_serving"}
 FAMILIES_PRE_SCALE = FAMILIES_PRE_MESH - {"scale_plan"}
 FAMILIES_PRE_FLEET = FAMILIES_PRE_SCALE - {"fleet_failover"}
 FAMILIES_PRE_FUSED_SWEEP = FAMILIES_PRE_FLEET - {"fused_churn_sweep"}
@@ -183,11 +185,20 @@ def test_dryrun_warm_process_reuses_cold_process_cache(dryrun_pair):
     warm_evs, warm_compiles = compile_events(dryrun_pair["warm"])
     assert {e["family"] for e in cold_compiles} == FAMILIES
     assert {e["family"] for e in warm_compiles} == FAMILIES
-    # process A pays real compiles; process B is served by the cache
-    assert all(e["cache"] == "miss" for e in cold_compiles)
-    assert all(e["cache"] == "hit" for e in warm_compiles), [
+    # process A pays real compiles; process B is served by the cache.
+    # request_trace is host-only by design — zero compiles of its own
+    # is the family's whole point (the batcher reuses serving_batch's
+    # executables), so its compile event says cache="none" in BOTH
+    # processes and sits outside the miss->hit proof.
+    assert all(e["cache"] == "miss" for e in cold_compiles
+               if e["family"] != "request_trace")
+    assert all(e["cache"] == "hit" for e in warm_compiles
+               if e["family"] != "request_trace"), [
         (e["family"], e["cache"]) for e in warm_compiles
         if e["cache"] != "hit"]
+    assert all(e["cache"] == "none"
+               for e in cold_compiles + warm_compiles
+               if e["family"] == "request_trace")
     # the enable event recorded the shared dir in both ledgers
     for evs in (cold_evs, warm_evs):
         cc = [e for e in evs if e["ev"] == "compile_cache"]
@@ -381,11 +392,13 @@ def test_committed_r11_4dev_record_carries_churn_sweep():
     assert warm_total * 3 <= cold_total
 
 
-def _assert_cold_warm_record(path, families):
+def _assert_cold_warm_record(path, families, host_only=frozenset()):
     """The committed 4-device cold+warm record contract the r13 and
     r14 pins share: two provenance-stamped runs, the given family set,
     warm run all-hit, steady + warm budgets held, >= 3x warm-start
-    aggregate."""
+    aggregate.  ``host_only`` names families that compile nothing of
+    their own (request_trace) — their compile events carry
+    cache="none" and sit outside the all-hit proof."""
     all_events = telemetry.load_ledger(path)
     run_ids = telemetry_report.runs(all_events)
     assert len(run_ids) == 2
@@ -404,7 +417,12 @@ def _assert_cold_warm_record(path, families):
     assert all(warm_fam[f]["first_ms"] <= wbudgets[f] for f in warm_fam)
     assert all(e["cache"] == "hit" for e in warm
                if e.get("ev") == "compile"
-               and e.get("phase") == "first_ms")
+               and e.get("phase") == "first_ms"
+               and e["family"] not in host_only)
+    assert all(e["cache"] == "none" for e in warm
+               if e.get("ev") == "compile"
+               and e.get("phase") == "first_ms"
+               and e["family"] in host_only)
     cold_fam = telemetry_report.family_table(cold)
     cold_total = sum(r["first_ms"] for r in cold_fam.values())
     warm_total = sum(r["first_ms"] for r in warm_fam.values())
@@ -493,14 +511,31 @@ def test_committed_r20_4dev_record_carries_scale_plan():
 def test_committed_r21_4dev_record_carries_mesh_serving():
     """The mesh-serving PR's committed 4-device record
     (artifacts/ledger_dryrun_r21_4dev.jsonl, the ledger_diff gate
-    baseline since r21): cold+warm pair, FULL current family set —
-    mesh_serving included (the serving tick driven end to end through
-    a Batcher whose megabatch shards over the whole dry-run mesh) —
-    warm run all-hit, steady and warm budgets held, >= 3x warm-start
-    aggregate, provenance present."""
+    baseline r21 through the mesh-serving PR): cold+warm pair on its
+    historical family set — mesh_serving included (the serving tick
+    driven end to end through a Batcher whose megabatch shards over
+    the whole dry-run mesh), request_trace not yet — warm run all-hit,
+    steady and warm budgets held, >= 3x warm-start aggregate,
+    provenance present.  (The live ledger_diff gate baseline moved to
+    the r22 record below when the tracing PR grew the family set.)"""
     _assert_cold_warm_record(
         os.path.join(_REPO, "artifacts", "ledger_dryrun_r21_4dev.jsonl"),
-        FAMILIES)
+        FAMILIES_PRE_TRACE)
+
+
+def test_committed_r22_4dev_record_carries_request_trace():
+    """The tracing PR's committed 4-device record
+    (artifacts/ledger_dryrun_r22_4dev.jsonl, the ledger_diff gate
+    baseline since r22): cold+warm pair, FULL current family set —
+    request_trace included (a live router+batcher pair driven through
+    SidecarClient with minted trace ids, the cross-half waterfall join
+    asserted inside the dry-run body) — warm run all-hit apart from
+    the host-only request_trace family (cache="none": it compiles
+    nothing of its own), steady and warm budgets held, >= 3x
+    warm-start aggregate, provenance present."""
+    _assert_cold_warm_record(
+        os.path.join(_REPO, "artifacts", "ledger_dryrun_r22_4dev.jsonl"),
+        FAMILIES, host_only=frozenset({"request_trace"}))
 
 
 def test_committed_r09_4dev_record_matches_live_pair_shape(dryrun_pair):

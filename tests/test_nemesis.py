@@ -950,7 +950,7 @@ def test_sidecar_retries_transient_then_succeeds(tmp_path):
                            backoff_base=0.001, backoff_cap=0.002)
     calls = []
 
-    def flaky(payload, timeout):
+    def flaky(payload, timeout, metadata=None):
         calls.append(timeout)
         if len(calls) < 3:
             raise _fake_rpc_error(grpc.StatusCode.UNAVAILABLE)
@@ -982,7 +982,7 @@ def test_sidecar_never_retries_well_formed_error_reply():
                            backoff_base=0.001)
     calls = []
 
-    def invalid(payload, timeout):
+    def invalid(payload, timeout, metadata=None):
         calls.append(1)
         raise _fake_rpc_error(grpc.StatusCode.INVALID_ARGUMENT)
 
@@ -993,7 +993,7 @@ def test_sidecar_never_retries_well_formed_error_reply():
     # and the attempt cap bounds a dead transport
     dead_calls = []
 
-    def dead(payload, timeout):
+    def dead(payload, timeout, metadata=None):
         dead_calls.append(1)
         raise _fake_rpc_error(grpc.StatusCode.UNAVAILABLE)
 

@@ -101,6 +101,42 @@ def test_conventions_fixture_flags():
         tool_found
 
 
+def test_sync_emit_fixture_flags_and_negative_twin():
+    """sync-emit-in-request-path: the planted Router flags BOTH shapes
+    (defaulted emit in the root, sync=True in a reachable helper); the
+    negative twin CleanRouter — identical call graph with literal
+    sync=False — stays silent; the off-path emit never flags
+    (reachability from the roots, not a module-wide scan)."""
+    mods = _fixture_modules("planted_sync.py")
+    roots = {"planted_sync.py": ("Router.dispatch",
+                                 "CleanRouter.dispatch")}
+    found = conventions.check_sync_emit(mods, roots=roots)
+    assert _rules(found) == {"sync-emit-in-request-path"}, found
+    assert len(found) == 2, found
+    assert {f.symbol for f in found} == {"Router.dispatch",
+                                         "Router._attempt"}, found
+    assert not any("CleanRouter" in f.symbol for f in found), found
+    assert not any("off_path" in f.symbol for f in found), found
+
+
+def test_sync_emit_live_roots_resolve():
+    """The REQUEST_PATH_ROOTS table must name real qualnames: a rename
+    of Router.dispatch (or a batcher scope) that orphans its root
+    would silently disarm the rule.  Every configured root must
+    resolve to exactly one function in its module."""
+    mods = core.load_modules(REPO,
+                             sorted(conventions.REQUEST_PATH_ROOTS))
+    for rel, qualnames in conventions.REQUEST_PATH_ROOTS.items():
+        mod = mods[rel]
+        import ast as _ast
+        fns = [n for n in _ast.walk(mod.tree)
+               if isinstance(n, (_ast.FunctionDef,
+                                 _ast.AsyncFunctionDef))]
+        for qn in qualnames:
+            hits = [fn for fn in fns if mod.qualname(fn) == qn]
+            assert len(hits) == 1, (rel, qn, len(hits))
+
+
 def test_budget_fixture_flags_both_directions():
     found = conventions.check_dryrun_budgets(
         root=os.path.join(FIX, "budget_tree"))

@@ -259,6 +259,75 @@ def test_flight_recorder_survives_sigkill_mid_dryrun(tmp_path):
     assert killed_during.split(":")[0] in md
 
 
+@pytest.mark.skipif(not hasattr(signal, "SIGKILL"),
+                    reason="needs POSIX SIGKILL")
+def test_flight_recorder_survives_sigkill_on_trace_ledger(tmp_path):
+    """Satellite pin: the flight-recorder contract extends to a
+    trace-BEARING ledger.  SIGKILL a serving process mid-traffic: the
+    ledger still parses (at most a torn line, dropped by contract),
+    every ``request_trace`` written before the kill survives with a
+    usable 16-hex trace_id, and ``load_ledger(trace_id=...)``
+    round-trips on the partial file — a crash must not cost the
+    waterfalls of the requests it already acked."""
+    ledger = str(tmp_path / "killed_trace.jsonl")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["GOSSIP_TELEMETRY"] = ledger
+    child = (
+        "from gossip_tpu.utils import telemetry\n"
+        "telemetry.activate(telemetry.from_env("
+        "argv=['trace_kill_child']))\n"
+        "from gossip_tpu.config import ServingConfig\n"
+        "from gossip_tpu.rpc.sidecar import SidecarClient, serve\n"
+        "server, port = serve(port=0, batching=ServingConfig("
+        "tick_ms=10, max_batch=8))\n"
+        "client = SidecarClient(f'127.0.0.1:{port}')\n"
+        "i = 0\n"
+        "while True:\n"
+        "    client.run(backend='jax-tpu',\n"
+        "               proto={'mode': 'push', 'fanout': 2},\n"
+        "               topology={'family': 'complete', 'n': 32},\n"
+        "               run={'max_rounds': 3, 'engine': 'xla',\n"
+        "                    'seed': i}, curve=True)\n"
+        "    i += 1\n")
+    proc = subprocess.Popen([sys.executable, "-c", child], env=env,
+                            cwd=_REPO, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                pytest.fail("serving child exited before the kill")
+            if os.path.exists(ledger):
+                try:
+                    events = telemetry.load_ledger(ledger)
+                except ValueError:
+                    events = []
+                if any(e.get("ev") == "request_trace"
+                       for e in events):
+                    proc.send_signal(signal.SIGKILL)
+                    break
+            time.sleep(0.05)
+        else:
+            pytest.fail("no request_trace appeared within 180 s")
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    events = telemetry.load_ledger(ledger)
+    assert events[0]["ev"] == "provenance"
+    traced = [e for e in events if e.get("ev") == "request_trace"]
+    assert traced
+    tid = traced[0]["trace_id"]
+    assert len(tid) == 16
+    assert all(c in "0123456789abcdef" for c in tid)
+    sub = telemetry.load_ledger(ledger, trace_id=tid)
+    assert sub and all(e.get("trace_id") == tid for e in sub)
+    assert any(e.get("ev") == "request_trace" for e in sub)
+
+
 def test_reserved_keys_never_collide(tmp_path):
     """A caller-supplied run/ts/ev field (the pre-ledger watchdog
     format carried its own 'ts') must not corrupt run filtering — it

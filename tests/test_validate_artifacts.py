@@ -211,6 +211,39 @@ def test_fleet_artifacts_must_be_attributable(tmp_path):
     assert va.validate_file(str(good)) == []
 
 
+def test_trace_artifacts_must_be_attributable(tmp_path):
+    """A ``*trace*``/``*fleet_status*`` artifact without provenance
+    fails — per-request waterfalls and fleet health snapshots
+    (tools/trace_report, tools/trace_capture, `gossip_tpu fleet-status
+    --out`) are observability evidence and can never be grandfathered,
+    jsonl or json alike.  An unattributed waterfall LOOKS like
+    per-request evidence while naming no reproducible commit."""
+    for name in ("ledger_trace_r99.jsonl", "trace_join_r99.jsonl",
+                 "fleet_status_r99.jsonl"):
+        bad = tmp_path / name
+        bad.write_text(json.dumps({"ev": "request_trace",
+                                   "trace_id": "ab"}) + "\n")
+        problems = va.validate_file(str(bad))
+        assert any("provenance" in p for p in problems), (name,
+                                                          problems)
+
+    for name in ("trace_exemplars_r99.json", "fleet_status_r99.json"):
+        badj = tmp_path / name
+        badj.write_text(json.dumps({"ok": True}))
+        problems = va.validate_file(str(badj))
+        assert any("provenance" in p for p in problems), (name,
+                                                          problems)
+
+    good = tmp_path / "ledger_trace_r98.jsonl"
+    with telemetry.Ledger(str(good)) as led:
+        led.event("request_trace", trace_id="ab", source="router")
+    assert va.validate_file(str(good)) == []
+    goodj = tmp_path / "fleet_status_r98.json"
+    goodj.write_text(json.dumps({"provenance": telemetry.provenance(),
+                                 "degraded": False}))
+    assert va.validate_file(str(goodj)) == []
+
+
 def test_fused_sweep_artifacts_must_be_attributable(tmp_path):
     """A ``*fused_sweep*`` artifact without provenance fails — the
     fused engine's compile-amortization record

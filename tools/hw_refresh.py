@@ -467,6 +467,24 @@ def fleet_failover():
     return _run_tool("fleet_crashloop.py", FLEET_TIMEOUT_S)
 
 
+def request_trace():
+    """The request-tracing record on this host
+    (tools/trace_capture.py, docs/OBSERVABILITY.md "Request tracing &
+    live metrics"): the traced load mix through the router, one seeded
+    mid-load SIGKILL, every acked request joining to a COMPLETE
+    waterfall (failover-replayed included), fleet-status seeing the
+    kill and the recovery, and the post-recovery steady window gated
+    zero-compile + zero-fsync via the Metrics counters.  The summary
+    line is re-joined here to refresh the ATTRIBUTED slow-request
+    exemplars (wall + dominant leg) alongside the committed ledger."""
+    out = _run_tool("trace_capture.py", TRACE_TIMEOUT_S)
+    import trace_report
+    rows = trace_report.waterfalls(
+        trace_report.load_events([out["ledger"]]))
+    out["exemplars"] = trace_report.exemplars(rows, k=3)
+    return out
+
+
 def mesh_serving():
     """The mesh-sharded serving capture on this host
     (tools/load_harness.py --mesh-devices, docs/SERVING.md
@@ -685,6 +703,7 @@ def tpu_pallas_tests():
 # A window that closes mid-run lands the most important steps first;
 # retries are incremental (pending steps only).
 FLEET_TIMEOUT_S = 1200
+TRACE_TIMEOUT_S = 1200          # traced crashloop + steady window
 MESH_SERVING_TIMEOUT_S = 1200   # thousands of connections x 2 legs
 SCALE_TIMEOUT_S = 1200          # structural record: ~2 min on CPU
 FULL_SCALE_TIMEOUT_S = 3600     # the 100M leg owns a real window slot
@@ -698,6 +717,7 @@ STEPS = [("staticcheck", staticcheck),
          ("fused_churn_sweep", fused_churn_sweep),
          ("scale_plan", scale_plan),
          ("fleet_failover", fleet_failover),
+         ("request_trace", request_trace),
          ("mesh_serving", mesh_serving),
          ("roofline", roofline),
          ("baseline_sweep", baseline_sweep),

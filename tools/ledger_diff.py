@@ -145,12 +145,26 @@ def extract(events):
                 k: e.get(k) for k in ("rps", "p50_ms", "p95_ms",
                                       "p99_ms", "devices", "replicas")
                 if e.get(k) is not None}
+    # the request-trace join summary (tools/trace_report via
+    # load_harness's trace_join event) rides along informationally
+    # too: per-request waterfall quantiles are thread-harness walls —
+    # the trace event kinds (request_trace / dispatch_attempt /
+    # trace_admit / trace_join) NEVER join the gated totals above
+    tj = next((e for e in events if e.get("ev") == "trace_join"), None)
+    traces = None
+    if tj is not None:
+        traces = {"traces": tj.get("traces"),
+                  "complete": tj.get("complete"),
+                  "replayed": tj.get("replayed"),
+                  "expired": tj.get("expired"),
+                  "wall_p50_ms": (tj.get("wall_ms") or {}).get("p50"),
+                  "wall_p99_ms": (tj.get("wall_ms") or {}).get("p99")}
     return {"run_id": prov.get("run_id"),
             "captured": prov.get("captured"),
             "git_commit": prov.get("git_commit"),
             "device_count": rt.get("device_count"),
             "families": families, "metrics": metrics,
-            "serving": serving}
+            "serving": serving, "traces": traces}
 
 
 def _indexed_metric_events(events):
@@ -342,9 +356,20 @@ def diff(old, new, ratio=1.8, steady_floor_ms=50.0,
             continue
         serving_rows.append({"leg": leg, "old": o, "new": n})
 
+    # trace-join summaries carry the same never-gate contract as the
+    # serving legs: waterfall quantiles are host-load-shaped walls
+    trace_row = None
+    if old.get("traces") or new.get("traces"):
+        if old.get("traces") and new.get("traces"):
+            trace_row = {"old": old["traces"], "new": new["traces"]}
+        else:
+            notes.append("trace_join: only in "
+                         f"{'new' if not old.get('traces') else 'old'} "
+                         "run — reported, not gated")
+
     return {"rows": rows, "metric_rows": metric_rows, "flags": flags,
             "notes": notes, "drift": drift,
-            "serving_rows": serving_rows}
+            "serving_rows": serving_rows, "trace_row": trace_row}
 
 
 def _fmt(v):
@@ -423,6 +448,23 @@ def render(old, new, d):
                 f"| {_fmt(o.get('p50_ms'))} → {_fmt(n.get('p50_ms'))} "
                 f"| {_fmt(o.get('p95_ms'))} → {_fmt(n.get('p95_ms'))} "
                 f"| {_fmt(o.get('p99_ms'))} → {_fmt(n.get('p99_ms'))} |")
+        out.append("")
+    if d.get("trace_row"):
+        o, n = d["trace_row"]["old"], d["trace_row"]["new"]
+        out.append("## Request traces (informational — never gate)")
+        out.append("")
+        out.append("| traces old→new | complete | replayed | expired "
+                   "| wall p50 (ms) | wall p99 (ms) |")
+        out.append("|---|---|---|---|---|---|")
+        out.append(
+            f"| {_fmt(o.get('traces'))} → {_fmt(n.get('traces'))} "
+            f"| {_fmt(o.get('complete'))} → {_fmt(n.get('complete'))} "
+            f"| {_fmt(o.get('replayed'))} → {_fmt(n.get('replayed'))} "
+            f"| {_fmt(o.get('expired'))} → {_fmt(n.get('expired'))} "
+            f"| {_fmt(o.get('wall_p50_ms'))} → "
+            f"{_fmt(n.get('wall_p50_ms'))} "
+            f"| {_fmt(o.get('wall_p99_ms'))} → "
+            f"{_fmt(n.get('wall_p99_ms'))} |")
         out.append("")
     if d["flags"]:
         out.append("## Regressions flagged")

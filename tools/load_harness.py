@@ -303,6 +303,30 @@ def measure_window_batch_events(path, run_id, leg="batched"):
     return out
 
 
+def emit_trace_join(led, out_path):
+    """Join this run's request traces (tools/trace_report — the ONE
+    waterfall-join implementation) and ledger the summary plus the
+    attributed p99 exemplars as a ``trace_join`` event, so the
+    committed capture carries its own tail-latency decomposition.
+    In-process legs land BOTH request_trace halves on this ledger
+    (clients mint trace ids, the server shares the ambient ledger);
+    fleet-leg replica subprocesses write no ledger here, so their
+    traces join router-half-only — reported, never gated."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import trace_report
+    finally:
+        sys.path.pop(0)
+    events = trace_report.load_events([out_path])
+    rows = trace_report.waterfalls(events)
+    if not rows:
+        return None
+    summary = trace_report.summarize(rows)
+    led.event("trace_join", **summary,
+              exemplars=trace_report.exemplars(rows, k=3))
+    return summary
+
+
 def _ensure_host_devices(k):
     """Best-effort XLA host-device-count pin for the meshserve capture:
     only effective BEFORE the first jax import (XLA_FLAGS is read at
@@ -466,6 +490,7 @@ def run_meshserve(args, led, out_path):
               steady_all_warm=compiles_total == 0,
               measure_compiles=compiles_total,
               errors=errors_total, legs=legs)
+    emit_trace_join(led, out_path)
     print(json.dumps({"ok": ok, "mode": "meshserve",
                       "devices_ratio": round(ratio, 2),
                       "scaling_resolved": scaling_resolved,
@@ -663,7 +688,11 @@ def main(argv=None):
                   max_batch_size=max(sizes) if sizes else 0,
                   coalesced=coalesced,
                   solo=solo, batched=batched)
+        traces = emit_trace_join(led, out_path)
         print(json.dumps({"ok": ok, "ratio": round(ratio, 2),
+                          "traces": (traces or {}).get("traces", 0),
+                          "complete_waterfalls":
+                              (traces or {}).get("complete", 0),
                           "solo_rps": solo["rps"],
                           "batched_rps": batched["rps"],
                           "batched_p50_ms": batched["p50_ms"],

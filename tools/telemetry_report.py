@@ -216,6 +216,19 @@ def _serving_section(events):
     return render_serving_section(events)
 
 
+def _trace_section(events):
+    """The "Request traces" lines, rendered by the trace tool's ONE
+    implementation (tools/trace_report.render_trace_section — the
+    ``request_trace`` waterfall join has exactly one reader).  Empty
+    for runs with no trace-bearing events."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from trace_report import render_trace_section
+    finally:
+        sys.path.pop(0)
+    return render_trace_section(events)
+
+
 def check_health(events):
     """Ledger-health problems for the ``--check`` CI gate: a run whose
     evidence cannot be trusted mechanically.  Flags (a) a missing
@@ -235,7 +248,14 @@ def check_health(events):
     return problems
 
 
-def render_markdown(events, budgets=None, title=None):
+def render_markdown(events, budgets=None, title=None,
+                    trace_events=None):
+    """``trace_events`` overrides the event set the "Request traces"
+    section joins over: the waterfall halves are written by DIFFERENT
+    processes (router run + replica runs in one multi-writer file), so
+    a run-filtered view would render every trace incomplete — main()
+    passes the whole file.  None = join the same events as the rest of
+    the report (single-writer ledgers)."""
     budgets = load_budgets() if budgets is None else budgets
     out = []
     prov = next((e for e in events if e.get("ev") == "provenance"), None)
@@ -316,6 +336,8 @@ def render_markdown(events, budgets=None, title=None):
 
     out.extend(_protocol_metrics_section(events))
     out.extend(_serving_section(events))
+    out.extend(_trace_section(events if trace_events is None
+                              else trace_events))
 
     tree = span_tree(events)
     if tree:
@@ -430,9 +452,17 @@ def main(argv=None):
     budgets = load_budgets(args.budgets)
     name = os.path.basename(args.ledger)
     if args.all_runs:
+        # per-run parts suppress the trace section (trace_events=[]):
+        # the halves of one waterfall live in different writers' runs,
+        # so the join is rendered ONCE over the whole file instead
         parts = [render_markdown(
             [e for e in all_events if e.get("run") == r], budgets,
-            title=f"{name} — run {r}") for r in runs(all_events)]
+            title=f"{name} — run {r}", trace_events=[])
+            for r in runs(all_events)]
+        traces = _trace_section(all_events)
+        if traces:
+            parts.append("\n".join(
+                [f"# {name} — cross-run trace join", ""] + traces))
         doc = "\n\n".join(parts)
     else:
         rs = runs(all_events)
@@ -441,7 +471,8 @@ def main(argv=None):
             print(f"no events for run {args.run!r} in {args.ledger}",
                   file=sys.stderr)
             return 1
-        doc = render_markdown(events, budgets, title=name)
+        doc = render_markdown(events, budgets, title=name,
+                              trace_events=all_events)
     if args.out:
         with open(args.out, "w") as f:
             f.write(doc + "\n")

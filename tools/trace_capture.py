@@ -1,0 +1,461 @@
+#!/usr/bin/env python
+"""Trace capture: the committed proof that request tracing survives a
+replica SIGKILL end to end.
+
+tools/fleet_crashloop.py proved the fleet loses no acked request under
+kills; this tool proves every one of those requests is ATTRIBUTABLE
+afterwards (docs/OBSERVABILITY.md "Request tracing & live metrics").
+It runs a 3-replica fleet behind a router, drives the load-harness mix
+with one client-minted trace id per request, SIGKILLs K replicas at a
+seeded mid-load acked threshold, and gates:
+
+  * **joinable complete waterfalls** — every acked request's trace id
+    joins across the shared multi-writer ledger (router half + replica
+    half, tools/trace_report.py) INCLUDING the failover-replayed ones
+    (a re-dispatched request leaves two replica halves; the last is
+    the acked attempt and the join must still close);
+  * **fleet-status sees the kill and the recovery** — the same
+    degradation predicate the CLI exits nonzero on
+    (gossip_tpu.cli._fleet_degraded over the router's Metrics reply)
+    reports degraded after the SIGKILL and healthy again after the
+    probe hysteresis re-admits the respawn;
+  * **zero steady-state cost** — a post-recovery steady window of
+    traced requests completes with ZERO backend compiles and ZERO new
+    fsyncs on every replica AND on the router's own ledger, verified
+    from ``compiles_total`` / ``ledger_fsyncs`` in the Metrics replies
+    at the window edges (never by trust: rpc/sidecar._metrics reads
+    the live counters) — tracing rides the flight recorder's
+    write-through (sync=False) path and costs the timed path nothing.
+
+Replica children share ONE ledger file via GOSSIP_TELEMETRY in their
+env (the multi-writer append contract: every emit is one flushed
+write framed by newlines, so concurrent writers at worst cost blank
+lines every reader skips).  The committed record is
+``artifacts/ledger_trace_r22.jsonl`` (provenance first line;
+tools/validate_artifacts.py refuses any ``*trace*`` artifact without
+provenance, never grandfathered).
+
+    python tools/trace_capture.py            # committed-record config:
+        # 3 replicas, 32 requests, K=1 seeded mid-load SIGKILL ->
+        # artifacts/ledger_trace_r22.jsonl
+    python tools/trace_capture.py --smoke --out /tmp/trace.jsonl
+
+Runs on the hermetic CPU tier by design (replica children pinned to
+JAX_PLATFORMS=cpu, shared compile cache): the tracing contract is a
+join/zero-cost structure, not a chip rate.
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import trace_report  # noqa: E402
+from fleet_crashloop import kill_thresholds  # noqa: E402
+from load_harness import distinct_requests, request_mix  # noqa: E402
+
+DEFAULT_OUT = os.path.join(REPO, "artifacts", "ledger_trace_r22.jsonl")
+
+
+def _fleet_rows(m: dict) -> dict:
+    """Per-replica (compiles_total, ledger_fsyncs) from one router
+    Metrics reply — the steady-window edge snapshot.  A row without a
+    metrics leaf (dead / unreachable replica) is reported as None so
+    the caller fails the zero-cost gate loudly instead of skipping."""
+    out = {}
+    for row in m.get("fleet", ()):
+        rm = row.get("metrics")
+        out[row["replica"]] = (
+            None if rm is None
+            else (rm.get("compiles_total"), rm.get("ledger_fsyncs")))
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--kills", type=int, default=1,
+                    help="seeded mid-load replica SIGKILLs (the "
+                         "committed record carries K=1 on 3 replicas)")
+    ap.add_argument("--kill-seed", type=int, default=22,
+                    help="seeds the kill threshold and victim draw "
+                         "(a failing sequence replays exactly)")
+    ap.add_argument("--n", type=int, default=256)
+    ap.add_argument("--rounds", type=int, default=16)
+    ap.add_argument("--repeats", type=int, default=8,
+                    help="repeats of the 4-shape load-harness mix")
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--steady", type=int, default=6,
+                    help="post-recovery steady-window requests (the "
+                         "zero-compile / zero-fsync gate)")
+    ap.add_argument("--timeout-s", type=float, default=300.0,
+                    help="per-request client deadline (bounds queue "
+                         "wait + run + failover end to end)")
+    ap.add_argument("--probe-interval-ms", type=float, default=200.0)
+    ap.add_argument("--up-after", type=int, default=3)
+    ap.add_argument("--replica-platform", default="cpu",
+                    help="JAX_PLATFORMS pin for replica children "
+                         "('' inherits the ambient platform)")
+    ap.add_argument("--workdir", default=None,
+                    help="replica log/cache scratch dir (default: a "
+                         "fresh temp dir)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny live fleet: 2 replicas, 8 requests "
+                         "(every gate still enforced)")
+    ap.add_argument("--out", default=None,
+                    help="ledger path (default: the committed record "
+                         "path, '.smoke'-infixed under --smoke — the "
+                         "hw_refresh rehearsal convention)")
+    a = ap.parse_args(argv)
+    if a.out is None:
+        a.out = (DEFAULT_OUT.replace(".jsonl", ".smoke.jsonl")
+                 if a.smoke else DEFAULT_OUT)
+    if a.smoke:
+        a.replicas = min(a.replicas, 2)
+        a.repeats = min(a.repeats, 2)
+        a.workers = min(a.workers, 4)
+        a.n = min(a.n, 128)
+        a.rounds = min(a.rounds, 8)
+        a.steady = min(a.steady, 3)
+    a.kills = min(a.kills, max(1, a.replicas - 1))
+
+    if a.workdir is None:
+        import tempfile
+        a.workdir = tempfile.mkdtemp(prefix="trace_capture_")
+    os.makedirs(a.workdir, exist_ok=True)
+
+    from gossip_tpu.cli import _fleet_degraded
+    from gossip_tpu.config import FleetConfig
+    from gossip_tpu.rpc.router import Fleet, fleet_env
+    from gossip_tpu.rpc.sidecar import SidecarClient
+    from gossip_tpu.utils import telemetry
+
+    # a fresh record every run: the artifact is THIS capture's story,
+    # not an accumulation of every rehearsal that ever targeted it
+    if os.path.exists(a.out):
+        os.remove(a.out)
+    led = telemetry.Ledger(a.out)   # router + tool events land here
+    prev = telemetry.activate(led)
+    fleet = None
+    client = None
+    try:
+        led.record_runtime()
+        requests = request_mix(n=a.n, rounds=a.rounds,
+                               repeats=a.repeats)
+        total = len(requests)
+        thresholds, rng = kill_thresholds(a.kills, total, a.kill_seed)
+        led.event("config", replicas=a.replicas, kills=a.kills,
+                  kill_seed=a.kill_seed, kill_thresholds=thresholds,
+                  requests=total, workers=a.workers, n=a.n,
+                  rounds=a.rounds, steady=a.steady,
+                  smoke=bool(a.smoke))
+
+        # ---- the fleet: children append to OUR ledger file ----------
+        cfg = FleetConfig(replicas=a.replicas,
+                          probe_interval_ms=a.probe_interval_ms,
+                          up_after=a.up_after,
+                          max_inflight=max(8, a.workers))
+        env = fleet_env(
+            compile_cache_dir=os.path.join(a.workdir, "cache"),
+            platform=a.replica_platform or None)
+        env["GOSSIP_TELEMETRY"] = led.path
+        fleet = Fleet(cfg=cfg, workdir=a.workdir, env=env,
+                      max_workers=a.workers + 4)
+        if not fleet.router.wait_healthy(a.replicas, timeout_s=60):
+            raise RuntimeError("fleet never reached full health at "
+                               "startup")
+        # warm each replica DIRECTLY (the router would steer all
+        # serial warmup at one replica); the shared cache dir serves
+        # replicas 1..N-1 and every respawn from replica 0's compiles
+        t0 = time.perf_counter()
+        distinct = distinct_requests(requests)
+        for r in fleet.router.replicas:
+            c = SidecarClient(r.address, max_attempts=1)
+            for req in distinct:
+                c.run(timeout=a.timeout_s, **req)
+            c.close()
+        led.event("warmup_done",
+                  wall_s=round(time.perf_counter() - t0, 3),
+                  distinct=len(distinct))
+
+        # ---- measured run: traced concurrent load + seeded kill -----
+        tids = [telemetry.new_trace_id() for _ in range(total)]
+        replies = [None] * total
+        errors = []
+        acked = {"count": 0}
+        cursor = {"i": 0}
+        lock = threading.Lock()
+
+        def worker():
+            c = SidecarClient(fleet.address, max_attempts=1)
+            while True:
+                with lock:
+                    i = cursor["i"]
+                    if i >= total:
+                        break
+                    cursor["i"] = i + 1
+                try:
+                    replies[i] = c.run(timeout=a.timeout_s,
+                                       trace_id=tids[i], **requests[i])
+                    with lock:
+                        acked["count"] += 1
+                except Exception as e:
+                    with lock:
+                        errors.append(
+                            f"req {i}: {type(e).__name__}: "
+                            f"{str(e).splitlines()[0][:200]}")
+            c.close()
+
+        client = SidecarClient(fleet.address, max_attempts=1)
+
+        def poll_status(want_degraded, timeout_s, tag):
+            """Poll the router's Metrics reply with the CLI's OWN
+            degradation predicate until it reports the wanted state;
+            ledger a fleet_status event either way (the record of
+            fleet-status seeing the kill / the recovery)."""
+            deadline = time.monotonic() + timeout_s
+            reasons, m = [], None
+            while time.monotonic() < deadline:
+                try:
+                    m = client.metrics(timeout=10.0)
+                    reasons = _fleet_degraded(m)
+                except Exception as e:    # noqa: BLE001 — mid-kill
+                    # transport blips are the thing being observed
+                    reasons = [f"metrics poll failed: "
+                               f"{type(e).__name__}"]
+                    m = None
+                if bool(reasons) == want_degraded:
+                    break
+                time.sleep(0.05)
+            led.event("fleet_status", tag=tag,
+                      degraded=bool(reasons), reasons=reasons[:8],
+                      healthy=(m or {}).get("healthy"),
+                      replicas=(m or {}).get("replicas"),
+                      failovers=((m or {}).get("counters") or {})
+                      .get("failovers"))
+            return bool(reasons) == want_degraded
+
+        led.event("load_phase", phase="measure_start")
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker)
+                   for _ in range(a.workers)]
+        for t in threads:
+            t.start()
+        kills_done = 0
+        kill_acked = []
+        saw_degraded = False
+        for threshold in thresholds:
+            while True:
+                with lock:
+                    now_acked = acked["count"]
+                    done = cursor["i"] >= total
+                if now_acked >= threshold:
+                    break
+                if done and not any(t.is_alive() for t in threads):
+                    break
+                time.sleep(0.002)
+            with lock:
+                now_acked = acked["count"]
+            if now_acked >= total:
+                led.event("kill_vacuous", threshold=threshold,
+                          acked=now_acked)
+                break
+            live = [i for i, r in enumerate(fleet.router.replicas)
+                    if r.proc is not None and r.proc.poll() is None
+                    and r.healthy]
+            if not live:
+                led.event("kill_skipped", threshold=threshold,
+                          reason="no healthy replica to interrupt")
+                continue
+            victim = rng.choice(live)
+            pid = fleet.kill(victim)
+            kills_done += 1
+            kill_acked.append(now_acked)
+            led.event("kill", seq=kills_done, replica=victim, pid=pid,
+                      threshold=threshold, acked=now_acked,
+                      run_id=led.run_id)
+            # fleet-status must SEE the kill before the respawn is
+            # re-admitted: the probe marks the victim down within
+            # down_after * probe_interval, load keeps flowing on the
+            # survivors while we watch
+            saw_degraded |= poll_status(True, timeout_s=30.0,
+                                        tag=f"after_kill_{kills_done}")
+            addr = fleet.restart(victim)
+            led.event("respawn", replica=victim, address=addr)
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        led.event("load_phase", phase="measure_end",
+                  wall_s=round(wall, 3),
+                  rps=round(total / wall, 2) if wall else None)
+
+        # ---- recovery: fleet-status must report healthy again -------
+        recovered = fleet.router.wait_healthy(a.replicas,
+                                              timeout_s=120)
+        saw_recovered = poll_status(False, timeout_s=60.0,
+                                    tag="after_recovery")
+        stats = fleet.router.stats()
+        led.event("recovered", ok=recovered, **stats)
+
+        # ---- steady window: tracing must cost NOTHING ---------------
+        # re-warm the respawn directly so any (cache-served) compile
+        # lands OUTSIDE the measured window, then snapshot the live
+        # counters at both edges via the Metrics plane itself
+        for r in fleet.router.replicas:
+            c = SidecarClient(r.address, max_attempts=1)
+            for req in distinct:
+                c.run(timeout=a.timeout_s, **req)
+            c.close()
+        steady_tids = [telemetry.new_trace_id()
+                       for _ in range(a.steady)]
+        m0 = client.metrics(timeout=10.0)
+        edge0 = _fleet_rows(m0)
+        router_fsyncs0 = led.fsyncs
+        for j, tid in enumerate(steady_tids):
+            client.run(timeout=a.timeout_s, trace_id=tid,
+                       **distinct[j % len(distinct)])
+        m1 = client.metrics(timeout=10.0)
+        edge1 = _fleet_rows(m1)
+        router_fsyncs_delta = led.fsyncs - router_fsyncs0
+        steady_cost = {"router_fsyncs_delta": router_fsyncs_delta,
+                       "replicas": {}}
+        cost_problems = []
+        for idx in sorted(edge1):
+            b, e = edge0.get(idx), edge1.get(idx)
+            if b is None or e is None:
+                cost_problems.append(
+                    f"replica {idx} had no metrics leaf at a steady "
+                    "window edge — zero-cost unverifiable")
+                continue
+            compiles = (None if b[0] is None or e[0] is None
+                        else e[0] - b[0])
+            fsyncs = (None if b[1] is None or e[1] is None
+                      else e[1] - b[1])
+            steady_cost["replicas"][idx] = {
+                "compiles_delta": compiles, "fsyncs_delta": fsyncs}
+            if compiles not in (0, None):
+                cost_problems.append(
+                    f"replica {idx} compiled {compiles}x inside the "
+                    "steady window — tracing is not free")
+            if fsyncs != 0:
+                cost_problems.append(
+                    f"replica {idx} fsynced {fsyncs}x inside the "
+                    "steady window — a sync emit leaked into the "
+                    "request path")
+        if router_fsyncs_delta != 0:
+            cost_problems.append(
+                f"router ledger fsynced {router_fsyncs_delta}x inside "
+                "the steady window")
+        led.event("steady_cost", ok=not cost_problems, **steady_cost)
+
+        # ---- the join: every acked request attributable -------------
+        events = telemetry.load_ledger(a.out)   # ALL writers' runs
+        joined = trace_report.join_traces(events)
+        missing, incomplete = [], []
+        for tid in tids + steady_tids:
+            rec = joined.get(tid)
+            if rec is None:
+                missing.append(tid)
+                continue
+            if not trace_report.waterfall(rec)["complete"]:
+                incomplete.append(tid)
+        replayed = [tid for tid in tids
+                    if tid in joined
+                    and joined[tid]["attempts"] > 1]
+        replayed_complete = [
+            tid for tid in replayed
+            if trace_report.waterfall(joined[tid])["complete"]]
+
+        # ---- verdict ------------------------------------------------
+        problems = list(errors) + cost_problems
+        if kills_done < a.kills:
+            problems.append(f"only {kills_done}/{a.kills} kills "
+                            "landed (raise --repeats)")
+        for k, at in enumerate(kill_acked):
+            if not 0 < at < total:
+                problems.append(f"kill {k + 1} landed at acked={at} "
+                                f"of {total} — not mid-load")
+        if not recovered:
+            problems.append(
+                f"fleet never recovered to {a.replicas} healthy "
+                f"replicas (healthy={stats['healthy']})")
+        if kills_done and not saw_degraded:
+            problems.append("fleet-status never reported the kill "
+                            "(no degraded poll after SIGKILL)")
+        if not saw_recovered:
+            problems.append("fleet-status never reported recovery "
+                            "(degraded at the final poll)")
+        router_events = [e for e in events
+                         if e.get("run") == led.run_id]
+
+        def count(kind):
+            return sum(1 for e in router_events
+                       if e.get("ev") == kind)
+        if count("replica_down") < kills_done:
+            problems.append("fewer replica_down events than kills")
+        if kills_done and count("failover") < 1:
+            problems.append("no failover event: no in-flight request "
+                            "was ever re-dispatched")
+        if missing:
+            problems.append(f"{len(missing)} acked trace ids never "
+                            f"joined (e.g. {missing[:3]})")
+        if incomplete:
+            problems.append(f"{len(incomplete)} joined traces lack a "
+                            "router or replica half "
+                            f"(e.g. {incomplete[:3]})")
+        if kills_done and count("failover") and not replayed:
+            problems.append("failovers happened but no joined trace "
+                            "shows >1 dispatch attempt")
+        if replayed and not replayed_complete:
+            problems.append("no failover-replayed trace joined to a "
+                            "complete waterfall")
+        led.event("verdict", ok=not problems, kills=kills_done,
+                  kill_acked=kill_acked, requests=total,
+                  acked=acked["count"], errors=len(errors),
+                  traces=len(tids) + len(steady_tids),
+                  joined=len(tids) + len(steady_tids) - len(missing),
+                  complete=len(tids) + len(steady_tids)
+                  - len(missing) - len(incomplete),
+                  replayed=len(replayed),
+                  replayed_complete=len(replayed_complete),
+                  failovers=stats["failovers"],
+                  recovered_full_capacity=recovered,
+                  fleet_status_saw_kill=saw_degraded,
+                  fleet_status_saw_recovery=saw_recovered,
+                  healthy=stats["healthy"],
+                  steady_cost=steady_cost, problems=problems)
+        if problems:
+            for p in problems:
+                print(f"TRACE CAPTURE FAIL: {p}", file=sys.stderr)
+            return 1
+        print(json.dumps({
+            "ok": True, "kills": kills_done, "requests": total,
+            "acked": acked["count"],
+            "traces": len(tids) + len(steady_tids),
+            "complete_waterfalls": len(tids) + len(steady_tids),
+            "replayed": len(replayed),
+            "failovers": stats["failovers"],
+            "healthy": stats["healthy"],
+            "steady_compiles_delta": 0,
+            "steady_fsyncs_delta": 0,
+            "ledger": a.out}))
+        return 0
+    finally:
+        if client is not None:
+            client.close()
+        if fleet is not None:
+            fleet.close()
+        telemetry.activate(prev)
+        led.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

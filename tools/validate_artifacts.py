@@ -180,6 +180,18 @@ def _is_serving_name(name: str) -> bool:
     return "serving" in name or "load" in name or "meshserve" in name
 
 
+def _is_trace_name(name: str) -> bool:
+    """Trace/fleet-status artifacts by name — the request-tracing and
+    live-metrics evidence (per-request waterfalls joined by trace_id,
+    fleet health snapshots — tools/trace_report, tools/trace_capture,
+    `gossip_tpu fleet-status --out`) must always be attributable; the
+    legacy allowlist can never grandfather one in (the whole tracing
+    plane post-dates the provenance schema).  An unattributed
+    waterfall is worse than none: it LOOKS like per-request evidence
+    while naming no commit anyone can reproduce it against."""
+    return "trace" in name or "fleet_status" in name
+
+
 def validate_file(path):
     """[] when valid, else a list of human-readable problems."""
     name = os.path.basename(path)
@@ -259,6 +271,12 @@ def validate_file(path):
                     "line — capacity plans and streamed-tiling "
                     "records must be attributable, allowlist or not "
                     "(utils/telemetry.provenance)")
+            if not has_prov and _is_trace_name(name):
+                problems.append(
+                    "trace/fleet_status artifact without a provenance "
+                    "line — per-request waterfalls and fleet health "
+                    "snapshots must be attributable, allowlist or not "
+                    "(utils/telemetry.provenance)")
         else:
             with open(path) as f:
                 doc = json.load(f)
@@ -307,6 +325,12 @@ def validate_file(path):
                     "scale/plan/budget artifact without provenance "
                     f"keys {PROVENANCE_KEYS} — capacity plans and "
                     "streamed-tiling records must be attributable, "
+                    "allowlist or not")
+            elif _is_trace_name(name) and not _has_provenance_keys(doc):
+                problems.append(
+                    "trace/fleet_status artifact without provenance "
+                    f"keys {PROVENANCE_KEYS} — per-request waterfalls "
+                    "and fleet health snapshots must be attributable, "
                     "allowlist or not")
             elif name not in LEGACY and not _has_provenance_keys(doc):
                 problems.append(
