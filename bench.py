@@ -396,6 +396,12 @@ def last_scale_record():
                     "predicted_peak_device_bytes":
                         r.get("predicted_peak_device_bytes"),
                     "measured_loop_bytes": r.get("measured_loop_bytes"),
+                    # the pipelined-vs-serial pair (r23+): how much of
+                    # the segment wall the three-stage pipeline hid,
+                    # and the two A/B walls it was derived from
+                    "overlap_efficiency": r.get("overlap_efficiency"),
+                    "streamed_wall_ms": r.get("streamed_wall_ms"),
+                    "serial_wall_ms": r.get("serial_wall_ms"),
                     "ok": r.get("ok")}
     return best
 

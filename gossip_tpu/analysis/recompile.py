@@ -23,6 +23,14 @@ The invariants this family encodes are the PR 9/12 serving lessons
     upstream" (``_cached_dense_loop(fault_static=...)``) and is not
     flagged; a bare content name on an executable-producing memo key
     is.
+  * **blocking-fetch-in-segment-loop** — planner/stream's segment
+    loop is a three-stage software pipeline (dispatch tile *k*, drain
+    tile *k−1*); a synchronous ``np.asarray``/``np.array``/
+    ``block_until_ready`` inside any of its loops stalls the host on
+    the device and re-serializes fetch against compute.  A function
+    named ``_drain*`` is the sanctioned deferred-fetch site and is
+    exempt — the same declared-escape naming convention as
+    ``*_static``.
 
 Reachability: the per-request roots are every function in the rpc
 modules plus the ``request_*`` entry points in parallel/sweep; the
@@ -58,6 +66,23 @@ SCOPE = (
 #: modules whose lru_cache keys the content-in-memo-key rule audits
 #: (every jax-bearing package — the hazard is not serving-specific)
 MEMO_SCOPE_PREFIXES = ("gossip_tpu/",)
+
+#: the streamed executor's scope: its segment loop is a three-stage
+#: pipeline (planner/stream module doc), and a synchronous fetch
+#: inside any of its loops collapses the pipeline back to
+#: compute-plus-transfer serial
+STREAM_SCOPE = ("gossip_tpu/planner/stream.py",)
+
+#: call names that block the host on device results (D2H fetch or
+#: synchronization) — the pipeline-defeating set
+_BLOCKING_FETCHES = ("np.asarray", "np.array", "numpy.asarray",
+                     "numpy.array", "jax.block_until_ready")
+
+#: the sanctioned deferred-fetch helper prefix: planner/stream routes
+#: every blocking fetch through its ``_drain`` helper, which runs one
+#: tile BEHIND the dispatch — the same declared-escape naming
+#: convention as ``*_static`` memo params above
+_DRAIN_PREFIX = "_drain"
 
 _JNP_BUILDERS = ("stack", "concatenate", "array", "asarray")
 
@@ -144,6 +169,45 @@ def _reachable(modules: Dict[str, Module]):
     for rel, qn in reach:
         per_mod.setdefault(rel, set()).add(qn)
     return per_mod, all_fns
+
+
+def check_stream_fetch(modules: Dict[str, Module]) -> List[Finding]:
+    """**blocking-fetch-in-segment-loop** over :data:`STREAM_SCOPE`: a
+    ``np.asarray``/``np.array``/``block_until_ready`` call lexically
+    inside a For/While loop stalls the host mid-pipeline — the fetch
+    the three-stage segment loop exists to hide (planner/stream module
+    doc).  Calls enclosed by a function named ``_drain*`` are the
+    sanctioned deferred-fetch site and never flag; fixture tests prove
+    both directions."""
+    findings: List[Finding] = []
+    for rel, mod in modules.items():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if not (name in _BLOCKING_FETCHES
+                    or name.rsplit(".", 1)[-1] == "block_until_ready"):
+                continue
+            in_loop = sanctioned = False
+            cur = mod.parents.get(node)
+            while cur is not None:
+                if isinstance(cur, (ast.For, ast.While)):
+                    in_loop = True
+                elif isinstance(cur, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) \
+                        and cur.name.startswith(_DRAIN_PREFIX):
+                    sanctioned = True
+                cur = mod.parents.get(cur)
+            if in_loop and not sanctioned:
+                findings.append(Finding(
+                    CHECKER, "blocking-fetch-in-segment-loop", rel,
+                    node.lineno, mod.qualname(node),
+                    f"{name} inside a segment-loop body blocks the "
+                    "host on the device and collapses the three-stage "
+                    "tile pipeline to serial (planner/stream module "
+                    "doc); defer the fetch one tile and route it "
+                    "through a _drain* helper"))
+    return findings
 
 
 def check(modules: Dict[str, Module],

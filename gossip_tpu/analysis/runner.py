@@ -78,12 +78,14 @@ def run_tree(root: str = REPO,
     all_mods = load_modules(
         root, sorted(set(memo_files) | set(event_files)
                      | set(tool_files) | set(recompile.SCOPE)
+                     | set(recompile.STREAM_SCOPE)
                      | set(locks.SCOPE)))
 
     def view(paths):
         return {p: all_mods[p] for p in paths if p in all_mods}
 
     serving = view(recompile.SCOPE)
+    stream = view(recompile.STREAM_SCOPE)
     memo = view(memo_files)
     rpc = view(locks.SCOPE)
     event_mods = view(event_files)
@@ -91,6 +93,7 @@ def run_tree(root: str = REPO,
 
     findings: List[Finding] = []
     findings += recompile.check(serving, memo)
+    findings += recompile.check_stream_fetch(stream)
     findings += locks.check(rpc)
     findings += conventions.check_event_kind(event_mods)
     findings += conventions.check_sync_emit(event_mods)

@@ -1582,7 +1582,8 @@ def cmd_plan(a) -> int:
 
 
 def _run_plan_file(path: str, *, checkpoint=None, resume=False,
-                   check_bitwise=False, measure_memory=False) -> int:
+                   check_bitwise=False, measure_memory=False,
+                   overlap=True) -> int:
     """Load a plan file and execute it through the streamed driver —
     shared by ``scale-run`` and ``run --plan`` so the two surfaces
     cannot drift."""
@@ -1602,7 +1603,8 @@ def _run_plan_file(path: str, *, checkpoint=None, resume=False,
     try:
         res = run_at_scale(plan, checkpoint_path=checkpoint,
                            resume=resume, check_bitwise=check_bitwise,
-                           measure_memory=measure_memory)
+                           measure_memory=measure_memory,
+                           overlap=overlap)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -1620,7 +1622,8 @@ def cmd_scale_run(a) -> int:
     return _run_plan_file(a.plan, checkpoint=a.checkpoint,
                           resume=a.resume,
                           check_bitwise=a.check_bitwise,
-                          measure_memory=a.measure_memory)
+                          measure_memory=a.measure_memory,
+                          overlap=not a.no_overlap)
 
 
 def cmd_staticcheck(a) -> int:
@@ -2228,6 +2231,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--measure-memory", action="store_true",
                    help="AOT memory analysis of the tile loop "
                         "(one extra compile)")
+    p.add_argument("--no-overlap", action="store_true",
+                   help="drain each tile synchronously instead of "
+                        "running the three-stage fetch pipeline — the "
+                        "serial A/B leg for overlap capture "
+                        "(trajectories are bitwise identical either "
+                        "way; docs/SCALING.md)")
     # the same cache + multi-host init the equivalent `run --plan`
     # path gets (main()'s dispatch list includes scale-run): a big-N
     # tile loop's compile is exactly what the persistent cache exists

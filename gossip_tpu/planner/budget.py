@@ -193,6 +193,11 @@ def engine_components(engine: str, *, n: int, rumors: int, fanout: int,
             # the NEXT tile's device_put landing while this one
             # computes (planner/stream double buffering)
             "double_buffer": state,
+            # the PREVIOUS tile's result, still resident while its D2H
+            # fetch drains behind this tile's compute — the third
+            # pipeline stage (planner/stream _drain); same tile shape,
+            # output dtype == state dtype
+            "fetch_buffer": state,
             "sched_operands": sched,
             "metrics_stack": metrics,
         }

@@ -21,10 +21,35 @@ Execution contract (the PR 6/9 operand discipline):
   the key), and the segment runner is utils/checkpoint's — so K tiles
   compile once and a salted re-entry compiles zero
   (``assert_compiles``-pinned).
-* Tile content is operands: the tile words ride ``device_put`` (double
-  -buffered — the next tile's transfer is issued before the current
-  tile's result is fetched, so jax's async dispatch overlaps copy with
-  compute), the nemesis schedule rides the step's table tail.
+* Tile content is operands: the tile words ride ``device_put``, the
+  nemesis schedule rides the step's table tail.
+* THREE-STAGE PIPELINE: the segment loop dispatches tile *k*'s compute
+  and only THEN drains tile *k−1*'s result — so while *k−1* computes,
+  *k*'s ``device_put`` transfer is in flight (stage 1), and while *k*
+  computes, *k−1*'s D2H fetch (``copy_to_host_async`` + the host
+  write-back) proceeds (stage 3).  Steady-state segment wall ≈
+  max(compute, transfer), not their sum.  EVERY blocking fetch
+  (``block_until_ready`` / ``np.asarray`` / scalar conversion) lives
+  in the ``_drain`` helper — the one sanctioned site the staticcheck
+  ``blocking-fetch-in-segment-loop`` rule exempts; a synchronous fetch
+  anywhere else in the segment loop defeats the pipeline and flags.
+  Per-tile transfer-in / compute / fetch-out walls ride ``tile_stream``
+  ledger events (sync=False — no fsync in the timed window) and roll
+  into the run-level ``overlap_efficiency``: the fraction of segment
+  wall the host did NOT spend stalled on the device.  ``overlap=False``
+  (CLI ``--no-overlap``) drains each tile immediately — the serial A/B
+  leg the committed record compares against, bitwise-identical by
+  construction (drain order per tile is unchanged, only its overlap
+  with the next dispatch is).
+* MULTI-SLICE FAN-OUT: a ``dcn_slices`` > 1 plan executes the SAME
+  tile stream across the :func:`parallel.multislice.make_hybrid_mesh`
+  hybrid mesh — each mesh row (one DCN slice, node axis on ICI) gets
+  every ``tiles``-th tile round-robin, with one in-flight drain slot
+  per slice.  Tiles are independent trajectories, so ZERO bytes cross
+  DCN; the per-segment tile-0 accounting assertion is enforced per
+  slice (the message names the slice); and all slices drain into the
+  ONE crash-safe host cursor before each checkpoint publish, so the
+  resume contract is byte-identical to the single-slice run.
 * Crash safety reuses the checkpoint cursor discipline: the full
   packed state lives on the HOST between segments, every published
   checkpoint carries the absolute round cursor + exact ``dropped``
@@ -33,16 +58,16 @@ Execution contract (the PR 6/9 operand discipline):
   == straight streamed run bitwise, test-pinned).
 
 Scope refusals (loud, never silent): engine != packed, mode != pull,
-``dcn_slices`` > 1 (the multi-slice tile fan-out is the hardware-
-capture remainder — tools/hw_refresh runs this executor per slice at
-the window), explicit topologies (a 100M-row neighbor table is its own
-budget item the streamed drivers do not yet carry).
+more DCN slices than the platform reports (multislice.
+_hybrid_device_grid refuses), explicit topologies (a 100M-row
+neighbor table is its own budget item the streamed drivers do not yet
+carry).
 """
 
 from __future__ import annotations
 
 import dataclasses
-import math
+import time
 from typing import Optional
 
 import numpy as np
@@ -70,6 +95,11 @@ class ScaleRunResult:
     bitwise_equal: Optional[bool]      # vs untiled_reference, if checked
     measured_loop_bytes: Optional[int]
     predicted_peak_device_bytes: int
+    dcn_slices: int                    # tile fan-out width (1 = serial)
+    overlap: bool                      # three-stage pipeline engaged?
+    # 1 - (host stall wall / segment wall), clamped to [0, 1]; None
+    # when no segment ran (module doc "THREE-STAGE PIPELINE")
+    overlap_efficiency: Optional[float]
     final_state: Optional[np.ndarray]  # uint32[n, W] when keep_state
 
     def to_dict(self) -> dict:
@@ -164,12 +194,6 @@ def _refuse(plan: ScalePlan) -> None:
             f"{plan.mode!r} (anti-entropy's reverse delta writes "
             "cross-tile state — planner/budget.plan_scale already "
             "refuses this at plan time)")
-    if plan.dcn_slices > 1:
-        raise ValueError(
-            f"plan wants {plan.dcn_slices} DCN slices; this executor "
-            "streams the tile axis serially on one slice — the multi-"
-            "slice tile fan-out rides tools/hw_refresh at the capture "
-            "window (ROADMAP item 3 remainder)")
 
 
 def _mesh_for(plan: ScalePlan):
@@ -177,6 +201,65 @@ def _mesh_for(plan: ScalePlan):
         return None
     from gossip_tpu.parallel.sharded import make_mesh
     return make_mesh(plan.per_slice, axis_name="nodes")
+
+
+@dataclasses.dataclass
+class _SliceCtx:
+    """One DCN slice's execution context: its node mesh (or pinned
+    single device), its step closure and segment runner.  Tiles fan
+    out round-robin ``t % dcn_slices`` — each slice streams an
+    independent sub-sequence of tiles, zero DCN bytes by construction
+    (module doc "MULTI-SLICE FAN-OUT")."""
+
+    index: int
+    mesh: object       # 1-D node Mesh, or None when per_slice == 1
+    device: object     # pinned jax.Device when mesh is None (else None)
+    step: object
+    tables: tuple
+    runner: object
+
+
+def _slice_contexts(plan: ScalePlan, proto: ProtocolConfig,
+                    track: bool, mesh) -> list:
+    """Build the per-slice execution contexts.
+
+    Single slice: the historical path — one context on the default
+    device (or the caller's node mesh).  Multi slice: rows of the
+    hybrid device grid (parallel/multislice) become per-slice node
+    meshes (ICI inner axis) or pinned single devices, so jit
+    specializes one executable per bucket PER SLICE and dispatches
+    overlap across slices.  A caller-supplied ``mesh`` on a multislice
+    plan must be the (dcn_slices, per_slice) hybrid mesh itself."""
+    from gossip_tpu.utils.checkpoint import _segment_runner
+
+    def ctx(i, m, dev):
+        step, tables = _tile_step(proto, plan.n, plan.fault,
+                                  plan.origin, m)
+        return _SliceCtx(index=i, mesh=m, device=dev, step=step,
+                         tables=tables, runner=_segment_runner(step,
+                                                               track))
+
+    if plan.dcn_slices <= 1:
+        m = _mesh_for(plan) if mesh is None else mesh
+        return [ctx(0, m, None)]
+
+    from jax.sharding import Mesh
+    if mesh is None:
+        from gossip_tpu.parallel.multislice import make_hybrid_mesh
+        mesh = make_hybrid_mesh(plan.dcn_slices, plan.per_slice)
+    grid = np.asarray(mesh.devices)
+    if grid.shape != (plan.dcn_slices, plan.per_slice):
+        raise ValueError(
+            f"plan wants a {plan.dcn_slices}x{plan.per_slice} hybrid "
+            f"mesh; the supplied mesh has device grid {grid.shape} — "
+            "build it with multislice.make_hybrid_mesh")
+    out = []
+    for s in range(plan.dcn_slices):
+        if plan.per_slice == 1:
+            out.append(ctx(s, None, grid[s, 0]))
+        else:
+            out.append(ctx(s, Mesh(grid[s], ("nodes",)), None))
+    return out
 
 
 def _measure_loop_bytes(runner, *args) -> Optional[int]:
@@ -224,12 +307,14 @@ def host_coverage(state: np.ndarray, rumors: int,
     return float(counts[:rumors].min() / denom)
 
 
-def untiled_reference(plan: ScalePlan, mesh=None):
+def untiled_reference(plan: ScalePlan, mesh=None, device=None):
     """The in-memory run at full word width W — ONE runner call over
     the plan's whole round budget through the SAME step factory and
     segment runner the tiles use.  Returns (uint32[n, W], msgs,
     dropped).  This is what the streamed trajectory must equal
-    BITWISE."""
+    BITWISE.  A multislice run passes slice 0's (mesh, device) — word
+    -plane trajectories are device-placement invariant, so any one
+    slice's context is the reference."""
     import jax
     import jax.numpy as jnp
     from gossip_tpu.ops import nemesis as NE
@@ -238,13 +323,15 @@ def untiled_reference(plan: ScalePlan, mesh=None):
     _refuse(plan)
     proto = ProtocolConfig(mode=plan.mode, fanout=plan.fanout,
                            rumors=plan.rumors)
-    mesh = _mesh_for(plan) if mesh is None else mesh
+    if mesh is None and device is None:
+        mesh = _mesh_for(plan)
     step, tables = _tile_step(proto, plan.n, plan.fault, plan.origin,
                               mesh)
     track = NE.get(plan.fault) is not None
     runner = _segment_runner(step, track)
     seen = host_init_packed(plan.n, plan.rumors, plan.origin)
-    st = _place_tile(seen, plan.n, mesh, 0, plan.seed, 0.0)
+    st = _place_tile(seen, plan.n, mesh, 0, plan.seed, 0.0,
+                     device=device)
     if track:
         (out, acc) = runner(st, plan.max_rounds, jnp.float32(0.0),
                             *tables)
@@ -257,17 +344,19 @@ def untiled_reference(plan: ScalePlan, mesh=None):
 
 
 def _place_tile(words: np.ndarray, n: int, mesh, round_: int,
-                seed: int, msgs: float):
+                seed: int, msgs: float, device=None):
     """Pad a host word tile to the mesh row count, ship it, and wrap
     the SimState the packed step expects.  The device_put is the
     double-buffer leg: issued eagerly, it overlaps the previous tile's
-    compute under async dispatch."""
+    compute under async dispatch.  ``device`` pins a meshless tile to
+    one slice's device (multislice fan-out)."""
     import jax
     import jax.numpy as jnp
     from gossip_tpu.models.state import SimState
 
     if mesh is None:
-        dev = jax.device_put(words)
+        dev = (jax.device_put(words) if device is None
+               else jax.device_put(words, device))
     else:
         from jax.sharding import NamedSharding, PartitionSpec as P
         from gossip_tpu.parallel.sharded import pad_to_mesh
@@ -287,9 +376,11 @@ def run_at_scale(plan: ScalePlan, *, checkpoint_path: Optional[str] = None,
                  resume: bool = False, check_bitwise: bool = False,
                  measure_memory: bool = False, keep_state: bool = False,
                  halt_after_segments: Optional[int] = None,
-                 mesh=None) -> ScaleRunResult:
+                 overlap: bool = True, mesh=None) -> ScaleRunResult:
     """Drive a ScalePlan: T word-plane tiles stream host<->device
-    through each checkpoint segment (module doc has the contract).
+    through each checkpoint segment as a three-stage pipeline, fanned
+    across DCN slices when the plan is multislice (module doc has both
+    contracts).
 
     ``halt_after_segments`` stops after that many segments WITH the
     checkpoint published — the deterministic stand-in for a SIGKILL
@@ -298,12 +389,15 @@ def run_at_scale(plan: ScalePlan, *, checkpoint_path: Optional[str] = None,
     additionally runs :func:`untiled_reference` and compares the final
     states byte-for-byte.  ``measure_memory`` AOT-compiles the tile
     loop once more for its memory analysis — leave it off in compile-
-    count-pinned paths."""
+    count-pinned paths.  ``overlap=False`` drains every tile
+    immediately after dispatch (the serial A/B leg, CLI
+    ``--no-overlap``) — trajectories are identical either way, only
+    the fetch's overlap with the next dispatch changes."""
     import jax.numpy as jnp
     from gossip_tpu.ops import nemesis as NE
     from gossip_tpu.utils import telemetry
-    from gossip_tpu.utils.checkpoint import (_segment_runner, load_meta,
-                                             load_state, save_state)
+    from gossip_tpu.utils.checkpoint import (load_meta, load_state,
+                                             save_state)
 
     _refuse(plan)
     if resume and not checkpoint_path:
@@ -311,13 +405,14 @@ def run_at_scale(plan: ScalePlan, *, checkpoint_path: Optional[str] = None,
     n, w_total = plan.n, plan.total_words
     bucket = plan.bucket_words
     tiles = plan.tiles
+    n_slices = max(1, plan.dcn_slices)
     plan_doc = plan.to_dict()
     plan_fp = plan_fingerprint(plan_doc)
     fault_fp = NE.schedule_fingerprint(plan.fault, n, plan.origin)
     proto = ProtocolConfig(mode=plan.mode, fanout=plan.fanout,
                            rumors=plan.rumors)
-    mesh = _mesh_for(plan) if mesh is None else mesh
     track = NE.get(plan.fault) is not None
+    ctxs = _slice_contexts(plan, proto, track, mesh)
 
     base_round, dropped, msgs = 0, 0.0, 0.0
     resumed = False
@@ -349,27 +444,25 @@ def run_at_scale(plan: ScalePlan, *, checkpoint_path: Optional[str] = None,
     else:
         host = host_init_packed(n, plan.rumors, plan.origin)
 
-    step, tables = _tile_step(proto, n, plan.fault, plan.origin, mesh)
-    runner = _segment_runner(step, track)
-
     def tile_cols(t):
         lo = t * bucket
         return lo, min(lo + bucket, w_total)
 
-    def prep(t, round_):
+    def prep(t, round_, ctx):
         lo, hi = tile_cols(t)
         cols = host[:, lo:hi]
         if hi - lo < bucket:   # pad trailing planes: zero words are
             cols = np.concatenate(   # inert under the OR-merge
                 [cols, np.zeros((n, bucket - (hi - lo)), np.uint32)],
                 axis=1)
-        return _place_tile(np.ascontiguousarray(cols), n, mesh, round_,
-                           plan.seed, msgs)
+        return _place_tile(np.ascontiguousarray(cols), n, ctx.mesh,
+                           round_, plan.seed, msgs, device=ctx.device)
 
     led = telemetry.current()
     if led.active:
         led.event("scale_plan", n=n, tiles=tiles, bucket_words=bucket,
                   total_words=w_total, segments=plan.segment_count,
+                  dcn_slices=n_slices, overlap=overlap,
                   predicted_peak_device_bytes=
                   plan.predicted_peak_device_bytes,
                   plan_fingerprint=plan_fp, resumed=resumed)
@@ -377,28 +470,64 @@ def run_at_scale(plan: ScalePlan, *, checkpoint_path: Optional[str] = None,
     measured = None
     segments_run = 0
     halted = False
+    wait_total_ms = 0.0        # host stall wall across all segments
+    wall_total_ms = 0.0        # segment walls across all segments
     done = base_round
     while done < plan.max_rounds:
         todo = min(plan.segment_every, plan.max_rounds - done)
         seg_msgs = seg_dropped = None
-        nxt = prep(0, done)
-        for t in range(tiles):
-            cur = nxt
-            if t + 1 < tiles:
-                nxt = prep(t + 1, done)
+        seg_round = done
+        seg_t0 = time.perf_counter()
+        # one in-flight (dispatched, undrained) tile per slice — the
+        # third pipeline buffer budget.engine_components accounts as
+        # fetch_buffer
+        pending = [None] * n_slices
+
+        def _dispatch(t, todo):
+            """Stage 1+2: stage the tile's words onto its slice
+            (transfer-in overlaps the slice's previous compute — the
+            pending tile is NOT yet drained) and enqueue the segment
+            loop; then enqueue the D2H copy behind the compute so the
+            fetch starts the moment the result exists.  Returns the
+            in-flight record ``_drain`` settles."""
+            nonlocal measured
+            ctx = ctxs[t % n_slices]
+            t0 = time.perf_counter()
+            cur = prep(t, seg_round, ctx)
+            t1 = time.perf_counter()
             if track:
-                args = (cur, todo, jnp.float32(dropped)) + tables
-                if measured is None and measure_memory:
-                    measured = _measure_loop_bytes(runner, *args)
-                out, acc = runner(*args)
-                tile_dropped = float(acc)
+                args = (cur, todo, jnp.float32(dropped)) + ctx.tables
             else:
-                args = (cur, todo) + tables
-                if measured is None and measure_memory:
-                    measured = _measure_loop_bytes(runner, *args)
-                out = runner(*args)
-                tile_dropped = 0.0
+                args = (cur, todo) + ctx.tables
+            if measured is None and measure_memory:
+                measured = _measure_loop_bytes(ctx.runner, *args)
+            if track:
+                out, acc = ctx.runner(*args)
+            else:
+                out, acc = ctx.runner(*args), None
+            out.seen.copy_to_host_async()
+            t2 = time.perf_counter()
+            return {"tile": t, "slice": ctx.index, "out": out,
+                    "acc": acc, "put_ms": (t1 - t0) * 1e3,
+                    "dispatch_ms": (t2 - t1) * 1e3}
+
+        def _drain(rec):
+            """Stage 3 — the ONE place the segment loop blocks on the
+            device (staticcheck blocking-fetch-in-segment-loop exempts
+            ``_drain*`` by name): wait for the tile's result, write its
+            columns into the host cursor, settle the message
+            accounting, and emit the tile's walls."""
+            nonlocal seg_msgs, seg_dropped, wait_total_ms
+            t, out = rec["tile"], rec["out"]
+            t0 = time.perf_counter()
+            out.seen.block_until_ready()
+            t1 = time.perf_counter()
             tile_msgs = float(out.msgs)
+            tile_dropped = (float(rec["acc"])
+                            if rec["acc"] is not None else 0.0)
+            lo, hi = tile_cols(t)
+            host[:, lo:hi] = np.asarray(out.seen)[:n, :hi - lo]
+            t2 = time.perf_counter()
             if seg_msgs is None:
                 seg_msgs, seg_dropped = tile_msgs, tile_dropped
             elif (tile_msgs, tile_dropped) != (seg_msgs, seg_dropped):
@@ -406,12 +535,37 @@ def run_at_scale(plan: ScalePlan, *, checkpoint_path: Optional[str] = None,
                 # accounting; disagreement means the plane-independence
                 # contract broke — refuse before publishing state
                 raise AssertionError(
-                    f"tile {t} message accounting ({tile_msgs}, "
-                    f"{tile_dropped}) disagrees with tile 0 "
-                    f"({seg_msgs}, {seg_dropped}) — word planes are "
-                    "no longer trajectory-independent")
-            lo, hi = tile_cols(t)
-            host[:, lo:hi] = np.asarray(out.seen)[:n, :hi - lo]
+                    f"tile {t} (slice {rec['slice']}) message "
+                    f"accounting ({tile_msgs}, {tile_dropped}) "
+                    f"disagrees with tile 0 ({seg_msgs}, "
+                    f"{seg_dropped}) — word planes are no longer "
+                    "trajectory-independent")
+            wait_ms = (t1 - t0) * 1e3
+            wait_total_ms += wait_ms
+            if led.active:
+                led.event("tile_stream", sync=False, round=seg_round,
+                          tile=t, slice=rec["slice"],
+                          put_ms=rec["put_ms"],
+                          dispatch_ms=rec["dispatch_ms"],
+                          wait_ms=wait_ms,
+                          copy_ms=(t2 - t1) * 1e3)
+
+        for t in range(tiles):
+            s = t % n_slices
+            rec = _dispatch(t, todo)
+            prev, pending[s] = pending[s], rec
+            if not overlap:
+                pending[s] = None
+                _drain(rec)
+            elif prev is not None:
+                # tile t is now in flight on slice s; draining t -
+                # n_slices overlaps its transfer AND compute
+                _drain(prev)
+        for rec in sorted((p for p in pending if p is not None),
+                          key=lambda r: r["tile"]):
+            _drain(rec)
+        seg_wall_ms = (time.perf_counter() - seg_t0) * 1e3
+        wall_total_ms += seg_wall_ms
         done += todo
         msgs, dropped = seg_msgs, seg_dropped
         segments_run += 1
@@ -427,7 +581,7 @@ def run_at_scale(plan: ScalePlan, *, checkpoint_path: Optional[str] = None,
                                    "fault_program": fault_fp})
             if led.active:
                 led.event("scale_segment", round=done, tiles=tiles,
-                          dropped=dropped)
+                          dropped=dropped, wall_ms=seg_wall_ms)
         if halt_after_segments is not None \
                 and segments_run >= halt_after_segments \
                 and done < plan.max_rounds:
@@ -440,15 +594,22 @@ def run_at_scale(plan: ScalePlan, *, checkpoint_path: Optional[str] = None,
         alive = None if m is None else np.asarray(m).astype(bool)
     cov = host_coverage(host, plan.rumors, alive)
 
+    efficiency = None
+    if wall_total_ms > 0.0:
+        efficiency = max(0.0, min(1.0,
+                                  1.0 - wait_total_ms / wall_total_ms))
     bitwise = None
     if check_bitwise and not halted:
-        ref, ref_msgs, ref_dropped = untiled_reference(plan, mesh=mesh)
+        ref, ref_msgs, ref_dropped = untiled_reference(
+            plan, mesh=ctxs[0].mesh, device=ctxs[0].device)
         bitwise = (np.array_equal(ref, host)
                    and ref_msgs == msgs and ref_dropped == dropped)
     if led.active:
         led.event("scale_run", rounds=done, coverage=cov, msgs=msgs,
                   dropped=dropped, tiles=tiles, halted=halted,
-                  bitwise_equal=bitwise,
+                  bitwise_equal=bitwise, dcn_slices=n_slices,
+                  overlap=overlap, overlap_efficiency=efficiency,
+                  wall_ms=wall_total_ms, wait_ms=wait_total_ms,
                   measured_loop_bytes=measured)
     return ScaleRunResult(
         n=n, rounds=done, coverage=cov, msgs=msgs, dropped=dropped,
@@ -456,4 +617,6 @@ def run_at_scale(plan: ScalePlan, *, checkpoint_path: Optional[str] = None,
         resumed=resumed, halted=halted, bitwise_equal=bitwise,
         measured_loop_bytes=measured,
         predicted_peak_device_bytes=plan.predicted_peak_device_bytes,
+        dcn_slices=n_slices, overlap=overlap,
+        overlap_efficiency=efficiency,
         final_state=host if keep_state else None)

@@ -1,0 +1,41 @@
+"""Planted blocking-fetch-in-segment-loop violations + the negative
+twin (tests/test_staticcheck.py proves both directions — the PR 11
+a-checker-that-cannot-fail discipline).  Never imported: AST fodder
+for gossip_tpu/analysis/recompile.check_stream_fetch only."""
+
+import numpy as np
+
+
+def stream_segments_serial(tiles, runner, host):
+    """The pre-pipeline shape: the fetch blocks inside the tile loop,
+    so every tile pays compute + transfer serially.  Both calls below
+    MUST flag."""
+    for t in range(tiles):
+        out = runner(t)
+        out.seen.block_until_ready()          # planted: flags
+        host[t] = np.asarray(out.seen)        # planted: flags
+    return host
+
+
+def _drain_pending(host, rec):
+    """Negative twin: the sanctioned deferred-fetch helper — blocking
+    is its JOB (it runs one tile behind the dispatch).  Nothing in
+    here may flag, loop or not."""
+    for r in rec:
+        r.seen.block_until_ready()            # sanctioned: must NOT flag
+        host[r.tile] = np.asarray(r.seen)     # sanctioned: must NOT flag
+    return host
+
+
+def stream_segments_pipelined(tiles, runner, host):
+    """Negative twin: the three-stage shape — dispatch, then drain the
+    PREVIOUS tile through the _drain* helper.  Must NOT flag."""
+    pending = None
+    for t in range(tiles):
+        rec = runner(t)
+        if pending is not None:
+            _drain_pending(host, [pending])
+        pending = rec
+    if pending is not None:
+        _drain_pending(host, [pending])
+    return host

@@ -126,10 +126,14 @@ def test_line_carries_headline_plan():
     assert plan["tiles"] >= 1 and plan["binding"]
     assert plan["predicted_peak_device_bytes"] > 0
     rec = plan["record"]
-    assert rec is not None, "committed ledger_scale_r20 must resolve"
+    assert rec is not None, "committed ledger_scale_r23 must resolve"
     assert rec["ok"] is True
     assert rec["measured_loop_bytes"] <= \
         rec["predicted_peak_device_bytes"]
+    # the newest record by name wins: r23 carries the pipeline pair
+    assert rec["artifact"].endswith("ledger_scale_r23.jsonl")
+    assert 0.0 <= rec["overlap_efficiency"] <= 1.0
+    assert rec["streamed_wall_ms"] > 0 and rec["serial_wall_ms"] > 0
     line = bench.measurement_line(1.0, "cpu", 10, "x", 1, 1.0,
                                   plan=plan)
     assert json.loads(json.dumps(line))["plan"]["record"]["ok"] is True

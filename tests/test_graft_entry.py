@@ -48,11 +48,13 @@ FAMILIES = frozenset({
     "dense_pushpull", "churn_heal", "churn_sweep", "fused_churn_sweep",
     "crdt_counter", "kafka_log", "txn_register", "serving_batch",
     "mesh_serving", "fleet_failover", "request_trace", "packed_pull",
-    "scale_plan", "sparse_antientropy",
+    "scale_plan", "scale_stream_overlap", "sparse_antientropy",
     "topo_sparse_antientropy", "swim_rotating", "halo_banded",
     "fused_planes", "fused_planes_fault_curve", "rumor_sir",
     "hybrid_2d_sweep"})
-# the committed r21 record predates the tracing PR's request_trace
+# the committed r22 record predates the pipelined-streaming PR's
+# scale_stream_overlap family; the committed r21 record predates the
+# tracing PR's request_trace
 # family; the committed r20 record predates the mesh-serving PR's mesh_serving
 # family; the committed r18 record predates the scale-planner PR's scale_plan
 # family; the committed r17 record additionally predates the fleet
@@ -68,7 +70,8 @@ FAMILIES = frozenset({
 # predate the compiled-nemesis PR's churn_heal family and the
 # traced-operand PR's churn_sweep family — each pin stays on its
 # historical set
-FAMILIES_PRE_TRACE = FAMILIES - {"request_trace"}
+FAMILIES_PRE_OVERLAP = FAMILIES - {"scale_stream_overlap"}
+FAMILIES_PRE_TRACE = FAMILIES_PRE_OVERLAP - {"request_trace"}
 FAMILIES_PRE_MESH = FAMILIES_PRE_TRACE - {"mesh_serving"}
 FAMILIES_PRE_SCALE = FAMILIES_PRE_MESH - {"scale_plan"}
 FAMILIES_PRE_FLEET = FAMILIES_PRE_SCALE - {"fleet_failover"}
@@ -526,7 +529,7 @@ def test_committed_r21_4dev_record_carries_mesh_serving():
 def test_committed_r22_4dev_record_carries_request_trace():
     """The tracing PR's committed 4-device record
     (artifacts/ledger_dryrun_r22_4dev.jsonl, the ledger_diff gate
-    baseline since r22): cold+warm pair, FULL current family set —
+    baseline for r22): cold+warm pair on its historical family set —
     request_trace included (a live router+batcher pair driven through
     SidecarClient with minted trace ids, the cross-half waterfall join
     asserted inside the dry-run body) — warm run all-hit apart from
@@ -535,6 +538,20 @@ def test_committed_r22_4dev_record_carries_request_trace():
     warm-start aggregate, provenance present."""
     _assert_cold_warm_record(
         os.path.join(_REPO, "artifacts", "ledger_dryrun_r22_4dev.jsonl"),
+        FAMILIES_PRE_OVERLAP, host_only=frozenset({"request_trace"}))
+
+
+def test_committed_r23_4dev_record_carries_stream_overlap():
+    """The pipelined-streaming PR's committed 4-device record
+    (artifacts/ledger_dryrun_r23_4dev.jsonl, the ledger_diff gate
+    baseline since r23): cold+warm pair, FULL current family set —
+    scale_stream_overlap included (a forced >=3-tile pipelined run
+    gated bitwise against the untiled reference inside the dry-run
+    body, salted steady re-entry) — warm run all-hit apart from the
+    host-only request_trace family, steady and warm budgets held,
+    >= 3x warm-start aggregate, provenance present."""
+    _assert_cold_warm_record(
+        os.path.join(_REPO, "artifacts", "ledger_dryrun_r23_4dev.jsonl"),
         FAMILIES, host_only=frozenset({"request_trace"}))
 
 

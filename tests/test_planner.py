@@ -93,7 +93,9 @@ def test_bucket_stability_and_determinism():
     """Growing N under a FIXED budget never widens the tile bucket
     (pow2 buckets shrink monotonically), and planning is a pure
     function of its inputs."""
-    dev = PB.DeviceSpec(chips=1, hbm_bytes_per_chip=50 * 1024**2,
+    # 64 MB: fits n=10**6 at the 1-word tile even with the pipeline's
+    # fetch_buffer term in the peak (planner/budget engine_components)
+    dev = PB.DeviceSpec(chips=1, hbm_bytes_per_chip=64 * 1024**2,
                         host_ram_bytes=1 << 34)
     last_bucket = None
     for n in (10**4, 10**5, 3 * 10**5, 10**6):
@@ -246,6 +248,100 @@ def test_streamed_bitwise_under_mixed_fault_program():
     assert res.rounds == plan.max_rounds
 
 
+def test_pipelined_four_tiles_bitwise_vs_no_overlap_and_untiled(
+        tmp_path):
+    """The pipeline gate: a forced >=4-tile run with the three-stage
+    fetch overlap is BITWISE the serial --no-overlap leg AND the
+    untiled reference (state, msgs, exact dropped) under the mixed
+    fault program; its tile_stream ledger events carry every tile's
+    four walls and the run reports a sane overlap_efficiency."""
+    from gossip_tpu.utils import telemetry
+    plan = _forced_plan(tiles=4)
+    assert plan.tiles >= 4
+    path = str(tmp_path / "tile_stream.jsonl")
+    led = telemetry.Ledger(path)
+    prev = telemetry.activate(led)
+    try:
+        piped = PS.run_at_scale(plan, check_bitwise=True,
+                                keep_state=True)
+    finally:
+        telemetry.activate(prev)
+        led.close()
+    assert piped.overlap and piped.bitwise_equal is True
+    assert 0.0 <= piped.overlap_efficiency <= 1.0
+    serial = PS.run_at_scale(plan, overlap=False, keep_state=True)
+    assert not serial.overlap
+    assert np.array_equal(piped.final_state, serial.final_state)
+    assert (piped.msgs, piped.dropped) == (serial.msgs, serial.dropped)
+    evs = [e for e in telemetry.load_ledger(path)
+           if e.get("ev") == "tile_stream"]
+    # one event per tile per segment, each with the four pipeline walls
+    assert len(evs) == plan.tiles * plan.segment_count, evs
+    for e in evs:
+        for k in ("put_ms", "dispatch_ms", "wait_ms", "copy_ms"):
+            assert e[k] >= 0.0, e
+    assert {e["tile"] for e in evs} == set(range(plan.tiles))
+    run_ev = [e for e in telemetry.load_ledger(path)
+              if e.get("ev") == "scale_run"][-1]
+    assert run_ev["overlap"] is True
+    assert 0.0 <= run_ev["overlap_efficiency"] <= 1.0
+
+
+def test_two_slice_hybrid_bitwise_vs_single_slice():
+    """The multislice gate: a dcn_slices=2 plan EXECUTES (the refusal
+    is lifted) on the simulated hybrid mesh — conftest forces 8 CPU
+    devices — and its trajectory is bitwise the single-slice run's:
+    tiles fan out round-robin with zero cross-slice bytes, so the
+    slice count is invisible to the result."""
+    plan1 = _forced_plan(tiles=4)
+    dev2 = PB.DeviceSpec(
+        chips=2, slices=2,
+        hbm_bytes_per_chip=plan1.device.hbm_bytes_per_chip,
+        host_ram_bytes=plan1.device.host_ram_bytes)
+    plan2 = PB.plan_scale(plan1.n, rumors=plan1.rumors, device=dev2,
+                          fanout=plan1.fanout,
+                          max_rounds=plan1.max_rounds,
+                          fault=plan1.fault,
+                          segment_every=plan1.segment_every)
+    assert plan2.mesh_kind == "hybrid" and plan2.dcn_slices == 2
+    assert plan2.tiles == plan1.tiles >= 4
+    r1 = PS.run_at_scale(plan1, keep_state=True)
+    r2 = PS.run_at_scale(plan2, check_bitwise=True, keep_state=True)
+    assert r2.dcn_slices == 2
+    assert r2.bitwise_equal is True     # vs its own untiled reference
+    assert np.array_equal(r1.final_state, r2.final_state)
+    assert (r1.msgs, r1.dropped) == (r2.msgs, r2.dropped)
+
+
+def test_two_slice_mid_pipeline_resume_bitwise(tmp_path):
+    """Crash safety through the fan-out: halt a 2-slice pipelined run
+    after one published segment, resume, land bitwise on the
+    uninterrupted run — all slices drain into the ONE host cursor
+    before the publish, so the resume contract is slice-count
+    independent."""
+    plan1 = _forced_plan(tiles=4)
+    dev2 = PB.DeviceSpec(
+        chips=2, slices=2,
+        hbm_bytes_per_chip=plan1.device.hbm_bytes_per_chip,
+        host_ram_bytes=plan1.device.host_ram_bytes)
+    plan = PB.plan_scale(plan1.n, rumors=plan1.rumors, device=dev2,
+                         fanout=plan1.fanout,
+                         max_rounds=plan1.max_rounds,
+                         fault=plan1.fault,
+                         segment_every=plan1.segment_every)
+    straight = PS.run_at_scale(plan, keep_state=True)
+    ck = str(tmp_path / "slice_ck.npz")
+    r1 = PS.run_at_scale(plan, checkpoint_path=ck,
+                         halt_after_segments=1)
+    assert r1.halted
+    r2 = PS.run_at_scale(plan, checkpoint_path=ck, resume=True,
+                         keep_state=True)
+    assert r2.resumed and r2.rounds == plan.max_rounds
+    assert np.array_equal(r2.final_state, straight.final_state)
+    assert r2.msgs == straight.msgs
+    assert r2.dropped == straight.dropped
+
+
 def test_tiles_compile_once_per_bucket_and_salted_reentry_zero(
         assert_compiles):
     """K tiles share ONE executable per pow2 shape bucket, and a
@@ -304,12 +400,22 @@ def test_streamed_resume_bitwise_and_fingerprint_refusals(tmp_path):
 
 def test_stream_refusals_are_loud():
     plan = _forced_plan()
-    for field, val, match in (
-            ("engine", "dense", "packed engine only"),
-            ("dcn_slices", 2, "DCN slices")):
-        broken = dataclasses.replace(plan, **{field: val})
-        with pytest.raises(ValueError, match=match):
-            PS.run_at_scale(broken)
+    broken = dataclasses.replace(plan, engine="dense")
+    with pytest.raises(ValueError, match="packed engine only"):
+        PS.run_at_scale(broken)
+    # dcn_slices > 1 EXECUTES now (the multislice fan-out), but a plan
+    # wanting more slices than the platform reports still refuses
+    # loudly (multislice._hybrid_device_grid), never silently shrinks
+    overdrawn = dataclasses.replace(plan, dcn_slices=999)
+    with pytest.raises(ValueError, match="devices"):
+        PS.run_at_scale(overdrawn)
+    # a caller-supplied mesh whose grid disagrees with the plan's
+    # slicing refuses too — a silently re-gridded run would make the
+    # per-slice accounting unattributable
+    two_slice = dataclasses.replace(plan, dcn_slices=2)
+    from gossip_tpu.parallel.sharded import make_mesh
+    with pytest.raises(ValueError, match="hybrid"):
+        PS.run_at_scale(two_slice, mesh=make_mesh(1, axis_name="nodes"))
     with pytest.raises(ValueError, match="checkpoint_path"):
         PS.run_at_scale(plan, resume=True)
 
@@ -343,13 +449,15 @@ def test_memory_prediction_bounds_measurement():
 
 
 def test_committed_scale_record_verdict():
-    """The committed artifacts/ledger_scale_r20.jsonl cannot rot:
-    provenance-stamped, N = 2^20 forced to >= 4 streamed tiles, final
-    state bitwise the untiled run, coverage 1.0 on the eventual-alive
-    set, measured allocation inside the predicted peak, resume
-    bitwise."""
+    """The committed artifacts/ledger_scale_r23.jsonl cannot rot:
+    provenance-stamped, N = 2^20 forced to >= 4 streamed tiles through
+    the three-stage pipeline, final state bitwise the untiled run AND
+    the --no-overlap serial run, a sane overlap_efficiency, the
+    simulated 2-slice hybrid leg executing bitwise (the dcn_slices
+    refusal is lifted), coverage 1.0 on the eventual-alive set,
+    measured allocation inside the predicted peak, resume bitwise."""
     from gossip_tpu.utils import telemetry
-    path = os.path.join(_REPO, "artifacts", "ledger_scale_r20.jsonl")
+    path = os.path.join(_REPO, "artifacts", "ledger_scale_r23.jsonl")
     events = telemetry.load_ledger(path, run="last")
     assert events[0]["ev"] == "provenance"
     assert len(events[0]["git_commit"]) == 40
@@ -358,16 +466,27 @@ def test_committed_scale_record_verdict():
     assert rec["n"] == 2**20
     assert rec["tiles"] >= 4
     assert rec["bitwise_equal"] is True
+    assert rec["no_overlap_bitwise"] is True
+    assert 0.0 <= rec["overlap_efficiency"] <= 1.0
+    assert rec["two_slice_bitwise"] is True
+    assert rec["two_slice_dcn_slices"] == 2
     assert rec["coverage"] == 1.0
     assert rec["resume_bitwise"] is True
     assert rec["measured_loop_bytes"] <= \
         rec["predicted_peak_device_bytes"]
     assert rec["dropped"] > 0        # the mixed program really ran
+    # per-tile pipeline walls landed in the same run (sync=False
+    # emission from inside the timed segment loop)
+    ts = [e for e in events if e["ev"] == "tile_stream"]
+    assert len(ts) >= rec["tiles"]
+    assert all(k in ts[0]
+               for k in ("put_ms", "dispatch_ms", "wait_ms",
+                         "copy_ms"))
     # the smoke rehearsal parses with the same shape (hw_refresh
     # convention)
     smoke = telemetry.load_ledger(
         os.path.join(_REPO, "artifacts",
-                     "ledger_scale_r20.smoke.jsonl"), run="last")
+                     "ledger_scale_r23.smoke.jsonl"), run="last")
     srec = [e for e in smoke if e["ev"] == "scale_record"][-1]
     assert srec["ok"] is True and srec["smoke"] is True
 

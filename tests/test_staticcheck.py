@@ -71,6 +71,24 @@ def test_recompile_fixture_flags():
     assert len(nested) == 1, found
 
 
+def test_stream_fetch_fixture_flags_and_negative_twin():
+    """blocking-fetch-in-segment-loop: the planted serial segment loop
+    flags BOTH blocking shapes (block_until_ready + np.asarray); the
+    ``_drain*`` deferred-fetch helper and the pipelined loop that
+    routes through it must NOT flag — the sanctioned-site escape is
+    load-bearing (planner/stream's own loop uses it)."""
+    mods = _fixture_modules("planted_stream_fetch.py")
+    found = recompile.check_stream_fetch(mods)
+    assert _rules(found) == {"blocking-fetch-in-segment-loop"}, found
+    serial = [f for f in found
+              if f.symbol == "stream_segments_serial"]
+    assert len(serial) == 2, found      # the wait AND the fetch
+    assert not any(f.symbol.startswith("_drain_pending")
+                   for f in found), found
+    assert not any(f.symbol == "stream_segments_pipelined"
+                   for f in found), found
+
+
 def test_lock_fixture_flags():
     mods = _fixture_modules("planted_locks.py")
     found = locks.check(mods)

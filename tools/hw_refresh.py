@@ -424,33 +424,46 @@ def staticcheck():
     return _run_tool("staticcheck.py", STATICCHECK_TIMEOUT_S)
 
 
+def _scale_leg(flag, timeout_s):
+    """One gated scale_capture re-run (--full-scale / --multislice)
+    into its own artifact, returning the leg's last stdout JSON line.
+    rc 2 keeps the wedge-signature meaning; any other non-zero rc is
+    the leg's own gate failing."""
+    p = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools",
+                                     "scale_capture.py"),
+                        flag, *_smoke_argv()],
+                       capture_output=True, text=True,
+                       timeout=timeout_s, cwd=REPO, env=_body_env())
+    if p.returncode == 2:
+        raise WedgeDetected(f"scale_capture {flag} rc 2\n"
+                            + (p.stderr or p.stdout)[-400:])
+    if p.returncode != 0:
+        raise RuntimeError(f"scale_capture {flag} rc {p.returncode}\n"
+                           + (p.stderr or p.stdout)[-400:])
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
 def scale_plan():
     """The scale planner's streamed-tiling record on this host
     (tools/scale_capture.py): N = 2^20 forced to >= 4 streamed word-
-    plane tiles, bitwise-vs-untiled + coverage-1.0 + memory-prediction
-    gates — the structural proof refreshed at the capture window.  On
-    a real TPU backend the tool is then re-run with ``--full-scale``:
-    the 100M-node leg plans against the DETECTED chip/HBM/slice
-    topology and executes — gated on real HBM only, which is why the
+    plane tiles through the three-stage pipeline, bitwise-vs-untiled +
+    no-overlap-A/B + simulated-2-slice + coverage-1.0 +
+    memory-prediction gates — the structural proof refreshed at the
+    capture window.  On a real TPU backend the tool is then re-run
+    with ``--full-scale`` (the 100M-node leg against the DETECTED
+    chip/HBM/slice topology — gated on real HBM only, which is why the
     committed record stays the CPU structural proof until a window
-    lands (ROADMAP item 3)."""
+    lands, ROADMAP item 3), and when the structural record reports
+    more than one DCN slice, with ``--multislice`` too: the executor
+    leg that fans the tile stream across the REAL slices."""
     line = _run_tool("scale_capture.py", SCALE_TIMEOUT_S)
     if line.get("backend") == "tpu":
-        p = subprocess.run([sys.executable,
-                            os.path.join(REPO, "tools",
-                                         "scale_capture.py"),
-                            "--full-scale", *_smoke_argv()],
-                           capture_output=True, text=True,
-                           timeout=FULL_SCALE_TIMEOUT_S, cwd=REPO,
-                           env=_body_env())
-        if p.returncode == 2:
-            raise WedgeDetected("scale_capture --full-scale rc 2\n"
-                                + (p.stderr or p.stdout)[-400:])
-        if p.returncode != 0:
-            raise RuntimeError(f"full-scale rc {p.returncode}\n"
-                               + (p.stderr or p.stdout)[-400:])
-        line["full_scale"] = json.loads(
-            p.stdout.strip().splitlines()[-1])
+        line["full_scale"] = _scale_leg("--full-scale",
+                                        FULL_SCALE_TIMEOUT_S)
+        if line.get("slices", 1) > 1:
+            line["multislice"] = _scale_leg("--multislice",
+                                            FULL_SCALE_TIMEOUT_S)
     return line
 
 
