@@ -241,7 +241,8 @@ def body():
                                       compile_split=split,
                                       families=families,
                                       plan=plan_for_headline(backend),
-                                      serving=serving_for_headline())))
+                                      serving=serving_for_headline(),
+                                      costs=costs_for_headline())))
     return 0
 
 
@@ -456,9 +457,67 @@ def serving_for_headline():
         return None
 
 
+def costs_for_headline():
+    """Optional ``costs`` object for the scoreboard line (the
+    observability PR): per-engine XLA cost attribution from the newest
+    committed cost record (artifacts/ledger_cost_r*.jsonl, .smoke
+    excluded) — the chokepoint's ``xla_compile`` events joined by
+    tools/cost_report, plus the packed ``budget_xcheck`` verdict
+    (measured ≤ predicted peak bytes at the forced-tile plan).  Null
+    attribution fields ride verbatim (a backend without cost analysis
+    recorded explicit nulls, never zeros).  Returns None when no
+    committed record exists or anything fails to parse — this function
+    must never cost the scoreboard its line (the last_tpu_capture
+    wedge-resilience rule)."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    art_dir = os.path.join(repo, "artifacts")
+    best = None
+    try:
+        names = sorted(os.listdir(art_dir))
+        for name in names:
+            if not (name.startswith("ledger_cost_r")
+                    and name.endswith(".jsonl")
+                    and ".smoke" not in name):
+                continue
+            try:
+                from gossip_tpu.utils import telemetry
+                events = telemetry.load_ledger(
+                    os.path.join(art_dir, name), run="last")
+            except (OSError, ValueError):
+                continue
+            sys.path.insert(0, os.path.join(repo, "tools"))
+            try:
+                from cost_report import join_costs
+            finally:
+                sys.path.pop(0)
+            joined = join_costs(events)
+            if not joined["rows"]:
+                continue
+            engines = {}
+            for r in joined["rows"]:
+                eng = engines.setdefault(r["label"], {
+                    "compile_ms": 0.0, "flops": None,
+                    "bytes_accessed": None, "peak_bytes": None,
+                    "bytes_per_node_round": None})
+                eng["compile_ms"] = round(
+                    eng["compile_ms"] + r["compile_ms"], 1)
+                for k in ("flops", "bytes_accessed", "peak_bytes",
+                          "bytes_per_node_round"):
+                    if r.get(k) is not None:
+                        eng[k] = max(eng[k] or 0, r[k])
+            xc = [x for x in joined["xchecks"]
+                  if x.get("engine") == "packed"] or joined["xchecks"]
+            best = {"artifact": os.path.join("artifacts", name),
+                    "engines": engines,
+                    "budget_xcheck": xc[-1] if xc else None}
+        return best
+    except Exception:
+        return None
+
+
 def measurement_line(rate, backend, n, variant, rounds, dt,
                      compile_split=None, families=None, plan=None,
-                     serving=None):
+                     serving=None, costs=None):
     """The one-JSON-line scoreboard contract (tests/test_bench_contract.py).
 
     ``vs_baseline`` compares against a TPU-derived north-star rate, so it
@@ -494,7 +553,12 @@ def measurement_line(rate, backend, n, variant, rounds, dt,
     devices-per-replica width from the newest committed meshserve
     capture, with the gate's own ``ok``/``devices_ratio``/
     ``scaling_resolved`` verdict bits carried verbatim
-    (:func:`serving_for_headline`)."""
+    (:func:`serving_for_headline`).
+
+    ``costs`` (the observability PR): per-engine XLA cost attribution
+    and the packed budget cross-check verdict from the newest
+    committed cost record (:func:`costs_for_headline`) — nulls stay
+    nulls, the record-never-gate convention."""
     on_tpu = backend == "tpu"
     line = {
         "metric": "node_rounds_per_sec_per_chip",
@@ -513,6 +577,8 @@ def measurement_line(rate, backend, n, variant, rounds, dt,
         line["plan"] = plan
     if serving is not None:
         line["serving"] = serving
+    if costs is not None:
+        line["costs"] = costs
     if not on_tpu:
         line["last_tpu"] = last_tpu_capture()
     return line

@@ -33,6 +33,15 @@ Each rule encodes a convention a past PR learned the hard way
     (docs/OBSERVABILITY.md "Request tracing") dies silently.
     Reachability is the same-module call graph by terminal name —
     the import-free discipline every family here uses.
+  * **unattributed-compile** — an executable acquired by a raw
+    ``.lower(...).compile(...)`` chain in gossip_tpu scope bypasses
+    the ONE attribution chokepoint (utils/compile_cache
+    .load_or_compile): no ``xla_compile`` ledger event, no cache
+    verdict, no cost/memory attribution — the compile happened but
+    the cost plane never saw it (the planner/stream memory probe was
+    the live true positive this rule retired).  The chokepoint module
+    itself is exempt; a function named ``*_unattributed`` declares a
+    reviewed escape (the ``_drain*`` naming-escape convention).
 """
 
 from __future__ import annotations
@@ -74,6 +83,49 @@ REQUEST_PATH_ROOTS = {
     "gossip_tpu/rpc/sidecar.py": ("_run", "_ensemble",
                                   "SidecarClient._call_with_retry"),
 }
+
+
+#: unattributed-compile exemption: the chokepoint is the ONE module
+#: allowed to lower and compile directly — everything else routes
+#: through it (or carries a ``*_unattributed`` escape name)
+UNATTRIBUTED_EXEMPT = ("gossip_tpu/utils/compile_cache.py",)
+
+
+def check_unattributed_compile(modules: Dict[str, Module]
+                               ) -> List[Finding]:
+    """``unattributed-compile`` (module doc): flag every
+    ``<expr>.lower(...).compile(...)`` acquisition chain outside the
+    chokepoint module.  The AST shape is exact — a ``Call`` whose func
+    is ``Attribute(attr='compile')`` over a ``Call`` whose func is
+    ``Attribute(attr='lower')`` — so string ``.lower()`` calls never
+    false-positive (their result is never ``.compile()``d)."""
+    findings = []
+    for rel in sorted(modules):
+        if rel.replace(os.sep, "/") in UNATTRIBUTED_EXEMPT:
+            continue
+        mod = modules[rel]
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "compile"
+                    and isinstance(node.func.value, ast.Call)
+                    and isinstance(node.func.value.func, ast.Attribute)
+                    and node.func.value.func.attr == "lower"):
+                continue
+            fn = mod.enclosing_function(node)
+            if fn is not None and fn.name.endswith("_unattributed"):
+                continue
+            findings.append(Finding(
+                CHECKER, "unattributed-compile", rel, node.lineno,
+                mod.qualname(node),
+                "raw .lower().compile() bypasses the attribution "
+                "chokepoint — this executable emits no xla_compile "
+                "event (no label, no cache verdict, no cost/memory "
+                "attribution); acquire it through utils/compile_cache"
+                ".load_or_compile(fn, *args, label=...) or name the "
+                "enclosing function *_unattributed with a reviewed "
+                "reason (docs/STATIC_ANALYSIS.md)"))
+    return findings
 
 
 def check_event_kind(modules: Dict[str, Module]) -> List[Finding]:

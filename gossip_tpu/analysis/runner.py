@@ -100,6 +100,7 @@ def run_tree(root: str = REPO,
     findings += conventions.check_artifact_provenance(tool_mods)
     findings += conventions.check_dryrun_budgets(root)
     findings += conventions.check_capability_strings(memo)
+    findings += conventions.check_unattributed_compile(memo)
 
     entries, problems = (load_baseline(baseline_path)
                          if baseline_path else ([], []))

@@ -274,7 +274,7 @@ def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
         from gossip_tpu.utils.trace import maybe_aot_timed
         timing: Dict[str, float] = {}
         t0 = time.perf_counter()
-        final, covs = maybe_aot_timed(scan, timing, init)
+        final, covs = maybe_aot_timed(scan, timing, init, label="solo")
         wall = time.perf_counter() - t0
         # the scanned state already accumulated the closed-form total
         rounds, cov, msgs, curve = _curve_summary(
@@ -308,7 +308,7 @@ def _run_fused(proto: ProtocolConfig, tc: TopologyConfig, run: RunConfig,
     from gossip_tpu.utils.trace import maybe_aot_timed
     timing: Dict[str, float] = {}
     t0 = time.perf_counter()
-    final = maybe_aot_timed(loop, timing, init)
+    final = maybe_aot_timed(loop, timing, init, label="solo")
     wall = time.perf_counter() - t0
     cov = float(cov_fn(final.table))
     rounds = int(final.round)

@@ -1426,11 +1426,16 @@ def _render_fleet_status(m: dict) -> str:
     fleet; a bare replica reply renders its own window."""
     if not m.get("router"):
         w = m.get("window", {})
-        return (f"replica | rps {w.get('rps', 0)} "
+        line = (f"replica | rps {w.get('rps', 0)} "
                 f"p50 {w.get('p50_ms', 0)}ms p99 {w.get('p99_ms', 0)}ms"
                 f" | inflight {m.get('inflight', 0)} compiles "
                 f"{m.get('compiles_total')} (+{m.get('compiles_delta')})"
                 f" devices {m.get('serving_devices')}")
+        lc = m.get("last_compile")
+        if lc:
+            line += (f" | last compile {lc.get('label')} "
+                     f"[{lc.get('cache')}]")
+        return line
     w = m.get("window", {})
     c = m.get("counters", {})
     lines = [f"fleet {m.get('healthy', 0)}/{m.get('replicas', 0)} "
@@ -1453,6 +1458,10 @@ def _render_fleet_status(m: dict) -> str:
                      f"{rm.get('compiles_total')} "
                      f"(+{rm.get('compiles_delta')}) devices "
                      f"{rm.get('serving_devices')}")
+            lc = rm.get("last_compile")
+            if lc:
+                line += (f" | last compile {lc.get('label')} "
+                         f"[{lc.get('cache')}]")
         elif "error" in row:
             line += f" | error: {row['error']}"
         lines.append(line)

@@ -234,7 +234,7 @@ def simulate_curve_crdt(cfg: CrdtConfig, proto: ProtocolConfig,
         return final, convs, msgs, truth
 
     final, convs, msgs, truth = maybe_aot_timed(scan, timing, init,
-                                                *tables)
+                                                *tables, label="crdt_solo")
     eventual = np.asarray(CR.eventual_alive_crdt(fault, n, run.origin))
     denom = max(1, int(eventual.sum()))
     conv = np.asarray(convs, np.int64) / denom
@@ -291,7 +291,8 @@ def simulate_until_crdt(cfg: CrdtConfig, proto: ProtocolConfig,
         return jax.lax.while_loop(cond, lambda s: step(s, *tbl),
                                   state), truth
 
-    final, truth = maybe_aot_timed(loop, timing, init, *tables)
+    final, truth = maybe_aot_timed(loop, timing, init, *tables,
+                                   label="crdt_solo")
     conv = int(CR.converged_count(
         final.val, truth,
         CR.eventual_alive_crdt(fault, n, run.origin))) / denom

@@ -215,7 +215,7 @@ def simulate_curve_log(cfg: LogConfig, proto: ProtocolConfig,
         return final, convs, msgs, truth
 
     final, convs, msgs, truth = maybe_aot_timed(scan, timing, init,
-                                                *tables)
+                                                *tables, label="log_solo")
     eventual = np.asarray(LG.eventual_alive_crdt(fault, n, run.origin))
     denom = max(1, int(eventual.sum()))
     conv = np.asarray(convs, np.int64) / denom
@@ -258,7 +258,8 @@ def simulate_until_log(cfg: LogConfig, proto: ProtocolConfig,
         return jax.lax.while_loop(cond, lambda s: step(s, *tbl),
                                   state), truth
 
-    final, truth = maybe_aot_timed(loop, timing, init, *tables)
+    final, truth = maybe_aot_timed(loop, timing, init, *tables,
+                                   label="log_solo")
     conv = int(LG.converged_count(
         final.val, truth,
         LG.eventual_alive_crdt(fault, n, run.origin))) / denom

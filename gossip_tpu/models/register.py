@@ -219,7 +219,7 @@ def simulate_curve_txn(cfg: TxnConfig, proto: ProtocolConfig,
         return final, convs, msgs, truth
 
     final, convs, msgs, truth = maybe_aot_timed(scan, timing, init,
-                                                *tables)
+                                                *tables, label="txn_solo")
     eventual = np.asarray(RG.eventual_alive_crdt(fault, n, run.origin))
     denom = max(1, int(eventual.sum()))
     conv = np.asarray(convs, np.int64) / denom
@@ -263,7 +263,8 @@ def simulate_until_txn(cfg: TxnConfig, proto: ProtocolConfig,
         return jax.lax.while_loop(cond, lambda s: step(s, *tbl),
                                   state), truth
 
-    final, truth = maybe_aot_timed(loop, timing, init, *tables)
+    final, truth = maybe_aot_timed(loop, timing, init, *tables,
+                                   label="txn_solo")
     conv = int(RG.converged_count(
         final.val, truth,
         RG.eventual_alive_crdt(fault, n, run.origin))) / denom

@@ -291,7 +291,7 @@ def simulate_until_halo(proto: ProtocolConfig, topo: Topology,
             return step(s, *tbl)
         return jax.lax.while_loop(cond, body, state)
 
-    final = maybe_aot_timed(loop, timing, init, *tables)
+    final = maybe_aot_timed(loop, timing, init, *tables, label="halo")
     alive = NE.metric_alive(fault, n, run.origin)
     return (int(final.round), float(coverage(final.seen, alive)),
             float(final.msgs), final, band_of(topo))
@@ -322,5 +322,6 @@ def simulate_curve_halo(proto: ProtocolConfig, topo: Topology,
             return s, (coverage(s.seen, alive), s.msgs)
         return jax.lax.scan(body, state, None, length=run.max_rounds)
 
-    final, (covs, msgs) = maybe_aot_timed(scan, timing, init, *tables)
+    final, (covs, msgs) = maybe_aot_timed(scan, timing, init, *tables,
+                                          label="halo")
     return np.asarray(covs), np.asarray(msgs), final, band_of(topo)

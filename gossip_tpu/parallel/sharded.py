@@ -511,7 +511,7 @@ def simulate_curve_sharded(proto: ProtocolConfig, topo: Topology,
         fn, operands = _dense_churn_call("curve", proto, topo, run,
                                          mesh, fault, axis_name)
         (final, _, _), (covs, msgs) = maybe_aot_timed(fn, timing,
-                                                      *operands)
+                                                      *operands, label="dense")
         return np.asarray(covs), np.asarray(msgs), final
     step, tables = make_sharded_si_round(proto, topo, mesh, fault,
                                          run.origin, axis_name, tabled=True)
@@ -537,7 +537,7 @@ def simulate_curve_sharded(proto: ProtocolConfig, topo: Topology,
                             length=run.max_rounds)
 
     (final, _, _), (covs, msgs) = maybe_aot_timed(scan, timing, init,
-                                                  *tables)
+                                                  *tables, label="dense")
     return np.asarray(covs), np.asarray(msgs), final
 
 
@@ -559,7 +559,7 @@ def simulate_until_sharded(proto: ProtocolConfig, topo: Topology,
         # churn path: the shape-keyed memoized loop (curve-driver twin)
         fn, operands = _dense_churn_call("until", proto, topo, run,
                                          mesh, fault, axis_name)
-        final, _, _ = maybe_aot_timed(fn, timing, *operands)
+        final, _, _ = maybe_aot_timed(fn, timing, *operands, label="dense")
         alive_pad = NE.eventual_alive_pad(fault, topo.n, n_pad,
                                           run.origin)
         return (int(final.round),
@@ -592,6 +592,6 @@ def simulate_until_sharded(proto: ProtocolConfig, topo: Topology,
             return s, m, cnt
         return jax.lax.while_loop(cond, body, (state, m0, c0))
 
-    final, _, _ = maybe_aot_timed(loop, timing, init, *tables)
+    final, _, _ = maybe_aot_timed(loop, timing, init, *tables, label="dense")
     return (int(final.round), float(coverage(final.seen, alive_pad)),
             float(final.msgs), final)

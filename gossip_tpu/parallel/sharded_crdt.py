@@ -275,7 +275,8 @@ def simulate_curve_crdt_sharded(cfg: CrdtConfig, proto: ProtocolConfig,
     # recomputing it here would re-lower the injection operands and
     # run the scatter program un-jitted on the host, per call
     (final, _), (convs, msgs), truth = maybe_aot_timed(scan, timing,
-                                                       init, *tables)
+                                                       init, *tables,
+                                                       label="crdt")
     eventual_np = np.asarray(CR.eventual_alive_crdt(fault, n,
                                                     run.origin))
     denom = max(1, int(eventual_np.sum()))
@@ -345,7 +346,8 @@ def simulate_until_crdt_sharded(cfg: CrdtConfig, proto: ProtocolConfig,
         final, m, _ = jax.lax.while_loop(cond, body, (state, m0, c0))
         return (final, m), truth
 
-    (final, _), truth = maybe_aot_timed(loop, timing, init, *tables)
+    (final, _), truth = maybe_aot_timed(loop, timing, init, *tables,
+                                        label="crdt")
     eventual = _pad_rows(CR.eventual_alive_crdt(fault, n, run.origin),
                          n_pad, False)
     conv = int(CR.converged_count(final.val, truth, eventual)) / denom

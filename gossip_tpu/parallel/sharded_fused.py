@@ -617,7 +617,7 @@ def simulate_curve_sharded_fused(n: int, rumors: int, run: RunConfig,
                               has_alive, RM.wanted(), has_churn)
     init, masks = _init_and_masks(n, rumors, run, mesh, fault, has_alive,
                                   timing, has_churn)
-    final, covs, _ = maybe_aot_timed(scan, timing, init, *masks)
+    final, covs, _ = maybe_aot_timed(scan, timing, init, *masks, label="fused")
     return covs, final
 
 
@@ -716,7 +716,8 @@ def simulate_until_sharded_fused(n: int, rumors: int, run: RunConfig,
                               has_alive, RM.wanted(), has_churn)
     init, masks = _init_and_masks(n, rumors, run, mesh, fault, has_alive,
                                   timing, has_churn)
-    final, rounds, cov, _ = maybe_aot_timed(loop, timing, init, *masks)
+    final, rounds, cov, _ = maybe_aot_timed(loop, timing, init, *masks,
+                                            label="fused")
     rounds = int(rounds)
     cov = float(cov)
     msgs = 2.0 * fanout * n * rounds

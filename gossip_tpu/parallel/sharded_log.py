@@ -260,7 +260,8 @@ def simulate_curve_log_sharded(cfg: LogConfig, proto: ProtocolConfig,
     # would re-lower the injection operands un-jitted per call (the
     # sharded_crdt review lesson)
     (final, _), (convs, msgs), truth = maybe_aot_timed(scan, timing,
-                                                       init, *tables)
+                                                       init, *tables,
+                                                       label="log")
     eventual_np = np.asarray(LG.eventual_alive_crdt(fault, n,
                                                     run.origin))
     denom = max(1, int(eventual_np.sum()))
@@ -330,7 +331,8 @@ def simulate_until_log_sharded(cfg: LogConfig, proto: ProtocolConfig,
         final, m, _ = jax.lax.while_loop(cond, body, (state, m0, c0))
         return (final, m), truth
 
-    (final, _), truth = maybe_aot_timed(loop, timing, init, *tables)
+    (final, _), truth = maybe_aot_timed(loop, timing, init, *tables,
+                                        label="log")
     eventual = _pad_rows(LG.eventual_alive_crdt(fault, n, run.origin),
                          n_pad, False)
     conv = int(LG.converged_count(final.val, truth, eventual)) / denom

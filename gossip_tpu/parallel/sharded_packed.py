@@ -344,7 +344,7 @@ def simulate_until_packed_sharded(proto: ProtocolConfig, topo: Topology,
             return s, m, cnt
         return jax.lax.while_loop(cond, body, (state, m0, c0))
 
-    final, _, _ = maybe_aot_timed(loop, timing, init, *tables)
+    final, _, _ = maybe_aot_timed(loop, timing, init, *tables, label="packed")
     return (int(final.round),
             float(coverage_packed(final.seen, r, alive_pad)),
             float(final.msgs), final)

@@ -261,7 +261,8 @@ def simulate_curve_txn_sharded(cfg: TxnConfig, proto: ProtocolConfig,
     # would re-lower the write operands un-jitted per call (the
     # sharded_crdt review lesson)
     (final, _), (convs, msgs), truth = maybe_aot_timed(scan, timing,
-                                                       init, *tables)
+                                                       init, *tables,
+                                                       label="txn")
     eventual_np = np.asarray(RG.eventual_alive_crdt(fault, n,
                                                     run.origin))
     denom = max(1, int(eventual_np.sum()))
@@ -331,7 +332,8 @@ def simulate_until_txn_sharded(cfg: TxnConfig, proto: ProtocolConfig,
         final, m, _ = jax.lax.while_loop(cond, body, (state, m0, c0))
         return (final, m), truth
 
-    (final, _), truth = maybe_aot_timed(loop, timing, init, *tables)
+    (final, _), truth = maybe_aot_timed(loop, timing, init, *tables,
+                                        label="txn")
     eventual = _pad_rows(RG.eventual_alive_crdt(fault, n, run.origin),
                          n_pad, False)
     conv = int(RG.converged_count(final.val, truth, eventual)) / denom

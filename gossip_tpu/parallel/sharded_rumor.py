@@ -261,7 +261,8 @@ def simulate_curve_rumor_sharded(proto: ProtocolConfig, topo: Topology,
                             length=run.max_rounds)
 
     (final, _, _), (covs, hots, msgs) = maybe_aot_timed(scan, timing,
-                                                        init, *tables)
+                                                        init, *tables,
+                                                        label="rumor")
     return covs, hots, msgs, final
 
 
@@ -335,7 +336,7 @@ def simulate_until_rumor_sharded(proto: ProtocolConfig, topo: Topology,
 
         return jax.lax.while_loop(cond, body, (state, m0, p0))
 
-    final, _, _ = maybe_aot_timed(loop, timing, init, *tables)
+    final, _, _ = maybe_aot_timed(loop, timing, init, *tables, label="rumor")
     # always weight by the padded alive mask: padding rows must not
     # deflate coverage (sharded_alive marks them dead even fault-free)
     n_pad = pad_to_mesh(topo.n, mesh, axis_name)

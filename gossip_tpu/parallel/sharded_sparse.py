@@ -872,7 +872,8 @@ def simulate_curve_topo_sparse(proto: ProtocolConfig, topo, run: RunConfig,
                             None, length=run.max_rounds)
 
     ((final, _, _, _),
-     (covs, msgs, ovfs)) = maybe_aot_timed(scan, timing, init, *tables)
+     (covs, msgs, ovfs)) = maybe_aot_timed(scan, timing, init, *tables,
+                                           label="sparse")
     return (np.asarray(covs), np.asarray(msgs), final, meta,
             np.asarray(ovfs))
 
@@ -926,7 +927,8 @@ def simulate_until_topo_sparse(proto: ProtocolConfig, topo, run: RunConfig,
         return jax.lax.while_loop(cond, body,
                                   (state, jnp.float32(0.0), m0, c0))
 
-    final, ovf, _, _ = maybe_aot_timed(loop, timing, init, *tables)
+    final, ovf, _, _ = maybe_aot_timed(loop, timing, init, *tables,
+                                       label="sparse")
     return (int(final.round),
             float(coverage_packed(final.seen, r, alive_pad)),
             float(final.msgs), final, meta, float(ovf))
@@ -985,7 +987,7 @@ def simulate_curve_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
                             length=run.max_rounds)
 
     (final, _, _), (covs, msgs) = maybe_aot_timed(scan, timing, init,
-                                                  *tables)
+                                                  *tables, label="sparse")
     return np.asarray(covs), np.asarray(msgs), final, meta
 
 
@@ -1047,7 +1049,7 @@ def simulate_until_sparse(proto: ProtocolConfig, n: int, run: RunConfig,
             return s, m, cnt
         return jax.lax.while_loop(cond, body, (state, m0, c0))
 
-    final, _, _ = maybe_aot_timed(loop, timing, init, *tables)
+    final, _, _ = maybe_aot_timed(loop, timing, init, *tables, label="sparse")
     return (int(final.round),
             float(coverage_packed(final.seen, r, alive_pad)),
             float(final.msgs), final, meta)

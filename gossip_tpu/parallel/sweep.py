@@ -321,7 +321,7 @@ def churn_sweep_curves(proto: ProtocolConfig, topo: Topology,
     topo_tbl = () if topo.implicit else (topo.nbrs, topo.deg)
     from gossip_tpu.utils.trace import maybe_aot_timed
     _, (cnts, msgs, lost) = maybe_aot_timed(
-        scan, timing, init, alive_stack, *topo_tbl, *sched_ops)
+        scan, timing, init, alive_stack, *topo_tbl, *sched_ops, label="sweep")
     # one true f32 division per cell (the scan emits exact integer
     # counts — see _cached_churn_sweep_scan's readout comment)
     denom = np.asarray(alive_stack.sum(axis=1)).astype(np.float32)
@@ -788,7 +788,7 @@ def request_sweep_curves(specs, topo: Optional[Topology] = None,
     topo_tbl = (topo.nbrs, topo.deg) if have_table else ()
     from gossip_tpu.utils.trace import maybe_aot_timed
     seen_f, cnts, msgs, lost = maybe_aot_timed(scan, timing, *ops,
-                                               *topo_tbl)
+                                               *topo_tbl, label="sweep")
 
     # -- per-request readouts split back out of the stacked buffers --
     cnts = np.asarray(cnts).T[:kN]       # [K, T] exact integers
@@ -1093,7 +1093,7 @@ def config_sweep_curves_2d(points, topo, run: RunConfig,
     from gossip_tpu.utils.trace import maybe_aot_timed
     _, (covs, msgs) = maybe_aot_timed(scan, timing, init_seen, keys,
                                       jnp.zeros((cN,), jnp.float32),
-                                      *flags, *tables)
+                                      *flags, *tables, label="sweep")
     _emit_pod_sweep_cache_telemetry(cache_before)
     curves = np.asarray(covs).T
     return ConfigSweepResult(points=points, curves=curves,

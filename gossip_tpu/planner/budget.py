@@ -436,6 +436,47 @@ def plan_fingerprint(doc: dict) -> str:
         json.dumps(doc, sort_keys=True).encode()).hexdigest()
 
 
+def crosscheck_peak(predicted_bytes, measured_bytes, *,
+                    engine: str = "packed", n=None, tiles=None,
+                    plan_fingerprint=None,
+                    source: str = "xla_memory_analysis") -> dict:
+    """The measured≤predicted drift gate, as ONE reusable cross-check:
+    XLA's own memory analysis (the independent source ROADMAP item 1
+    asks for) against this module's hand-maintained closed forms.
+    Returns the verdict dict and emits it as one ``budget_xcheck``
+    event — sync=False, because callers run this inside timed windows
+    (the streamed executor's first dispatch; tools/cost_capture.py's
+    engine sweep).
+
+    Record-never-gate at the event layer: ``measured_bytes=None`` (a
+    backend without memory analysis) records explicit nulls with
+    ``ok=None`` — the EVENT never fabricates a verdict; gating callers
+    (scale_capture's memory gate, cost_capture's packed cross-check)
+    decide what a null means for THEIR artifact.  A real pair with
+    measured > predicted is ``ok=False``: the closed form drifted
+    below reality and every capacity plan built on it is a lie —
+    exactly what the PR 15 committed record (92.3 MB ≤ 106.5 MB)
+    existed to prevent, now re-checked wherever a compiled executable
+    self-reports its footprint."""
+    from gossip_tpu.utils import telemetry
+    ok = None
+    headroom = None
+    predicted = int(predicted_bytes) if predicted_bytes is not None \
+        else None
+    measured = int(measured_bytes) if measured_bytes is not None \
+        else None
+    if measured is not None and predicted:
+        ok = bool(measured <= predicted)
+        headroom = round(1.0 - measured / predicted, 4)
+    verdict = {"engine": engine, "n": n, "tiles": tiles,
+               "predicted_bytes": predicted,
+               "measured_bytes": measured, "ok": ok,
+               "headroom_frac": headroom, "source": source,
+               "plan_fingerprint": plan_fingerprint}
+    telemetry.current().event("budget_xcheck", sync=False, **verdict)
+    return verdict
+
+
 def forced_device_for_tiles(n: int, *, rumors: int, fanout: int,
                             max_rounds: int,
                             fault: Optional[FaultConfig],

@@ -74,8 +74,9 @@ import numpy as np
 
 from gossip_tpu import config as C
 from gossip_tpu.config import FaultConfig, ProtocolConfig
-from gossip_tpu.planner.budget import (ScalePlan, plan_fingerprint,
-                                       WORD_BITS, WORD_BYTES)
+from gossip_tpu.planner.budget import (ScalePlan, crosscheck_peak,
+                                       plan_fingerprint, WORD_BITS,
+                                       WORD_BYTES)
 
 
 @dataclasses.dataclass
@@ -263,15 +264,20 @@ def _slice_contexts(plan: ScalePlan, proto: ProtocolConfig,
 
 
 def _measure_loop_bytes(runner, *args) -> Optional[int]:
-    """Peak bytes of the compiled tile loop via AOT memory analysis
-    (argument + output + temp) — the 'measured allocation' the
-    committed record holds the prediction against.  None when the
-    backend cannot report it."""
+    """Peak bytes of the compiled tile loop (argument + output + temp)
+    — the 'measured allocation' the committed record holds the
+    prediction against.  Acquired through the ONE attributed
+    chokepoint (utils/compile_cache.load_or_compile), so the measuring
+    compile emits its own ``xla_compile`` event like every other
+    executable in the tree — this used to be the lone raw
+    ``.lower().compile()`` in driver scope, the live true positive the
+    ``unattributed-compile`` rule now guards against.  None when the
+    backend cannot report memory analysis."""
+    from gossip_tpu.utils import compile_cache as CC
     try:
-        stats = runner.lower(*args).compile().memory_analysis()
-        return int(stats.argument_size_in_bytes
-                   + stats.output_size_in_bytes
-                   + stats.temp_size_in_bytes)
+        compiled, _ = CC.load_or_compile(runner, *args,
+                                         label="scale_stream")
+        return CC.xla_attribution(compiled)["peak_bytes"]
     except Exception:
         return None
 
@@ -501,6 +507,10 @@ def run_at_scale(plan: ScalePlan, *, checkpoint_path: Optional[str] = None,
                 args = (cur, todo) + ctx.tables
             if measured is None and measure_memory:
                 measured = _measure_loop_bytes(ctx.runner, *args)
+                crosscheck_peak(
+                    plan.predicted_peak_device_bytes, measured,
+                    engine=plan.engine, n=plan.n, tiles=plan.tiles,
+                    plan_fingerprint=plan_fp)
             if track:
                 out, acc = ctx.runner(*args)
             else:

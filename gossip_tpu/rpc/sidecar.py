@@ -301,7 +301,7 @@ def _metrics(request: bytes, context, batcher=None, window=None,
     ambient ledger's fsync count (the zero-new-fsyncs-in-the-timed-
     path verification hook).  Read-only and cheap: no jax init, no
     device transfer, no ledger write."""
-    from gossip_tpu.utils import telemetry
+    from gossip_tpu.utils import compile_cache, telemetry
     snap = window.snapshot() if window is not None else {}
     compiles = _backend_compiles()
     inflight = 0
@@ -312,7 +312,7 @@ def _metrics(request: bytes, context, batcher=None, window=None,
             if compiles is not None:
                 delta = compiles - state["last_compiles"]
                 state["last_compiles"] = compiles
-    return json.dumps({
+    reply = {
         "ok": True,
         "service": SERVICE,
         "role": "replica",
@@ -323,7 +323,17 @@ def _metrics(request: bytes, context, batcher=None, window=None,
         "compiles_total": compiles,
         "compiles_delta": delta,
         "ledger_fsyncs": getattr(telemetry.current(), "fsyncs", 0),
-    }).encode()
+    }
+    # last-compile attribution (the cost plane's per-replica leaf):
+    # absent-not-wrong — before the first chokepoint compile there is
+    # NO last_compile key, never a fabricated empty one
+    last = compile_cache.last_compile()
+    if last is not None:
+        reply["last_compile"] = {"label": last.get("label"),
+                                 "cache": last.get("cache"),
+                                 "compile_ms": last.get("compile_ms"),
+                                 "peak_bytes": last.get("peak_bytes")}
+    return json.dumps(reply).encode()
 
 
 def _maybe_init_distributed(batching: Optional[ServingConfig]):

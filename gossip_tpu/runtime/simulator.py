@@ -111,7 +111,7 @@ def simulate_until(proto: ProtocolConfig, topo: Topology, run: RunConfig,
         return jax.lax.while_loop(cond, body, init_state_)
 
     from gossip_tpu.utils.trace import maybe_aot_timed
-    final = maybe_aot_timed(loop, timing, init, *tables)
+    final = maybe_aot_timed(loop, timing, init, *tables, label="solo")
     return UntilResult(
         rounds=int(final.round),
         coverage=float(coverage(final.seen, alive)),
@@ -238,7 +238,8 @@ def simulate_swim_curve(proto: ProtocolConfig, n: int, rounds: int,
             return (s, m, prev), frac
         return jax.lax.scan(body, (state, m0, p0), None, length=rounds)
 
-    (final, _, _), fracs = maybe_aot_timed(scan, timing, init, *tables)
+    (final, _, _), fracs = maybe_aot_timed(scan, timing, init, *tables,
+                                           label="solo")
     return np.asarray(fracs), final
 
 
@@ -318,7 +319,8 @@ def simulate_swim_until(proto: ProtocolConfig, n: int, max_rounds: int,
             (state, jnp.float32(0.0), jnp.float32(0.0), m0, p0))
 
     from gossip_tpu.utils.trace import maybe_aot_timed
-    final, det, peak, _, _ = maybe_aot_timed(loop, timing, init, *tables)
+    final, det, peak, _, _ = maybe_aot_timed(loop, timing, init, *tables,
+                                             label="solo")
     return int(final.round), float(det), float(peak), final
 
 

@@ -241,8 +241,78 @@ def _try_store(path: str, compiled, fns) -> None:
                          f"({type(e).__name__}: {e})\n")
 
 
-def load_or_compile(jitted, *args, cache_dir: Optional[str] = None
-                    ) -> Tuple[object, str]:
+# -- XLA cost & memory attribution (the observability PR) -------------
+
+# every xla_compile event carries ALL of these keys, populated or
+# explicit-null (record-never-gate): a consumer joins on schema, not on
+# backend luck.  peak_bytes is argument+output+temp — the same closed
+# form the PR 15 scale gate measured against budget.py's prediction.
+ATTRIBUTION_FIELDS = ("flops", "bytes_accessed", "argument_bytes",
+                      "output_bytes", "temp_bytes", "peak_bytes")
+
+_LAST_COMPILE: Optional[dict] = None
+
+
+def last_compile() -> Optional[dict]:
+    """The most recent chokepoint compile's attribution record (the
+    ``xla_compile`` event fields), or None when this process has not
+    compiled through the chokepoint yet — the sidecar's ``Metrics``
+    reply reads this so a steady-state fleet that compiles shows WHAT
+    compiled (absent-not-wrong: no compile means no field, never a
+    fabricated one)."""
+    return _LAST_COMPILE
+
+
+def xla_attribution(compiled) -> dict:
+    """``cost_analysis()`` flops/bytes-accessed and
+    ``memory_analysis()`` argument/output/temp/peak bytes of a compiled
+    executable — every field explicit None when the backend/object
+    cannot report it (older jax lines return no analyses; interpret
+    stubs have neither method).  Never raises: attribution is evidence
+    about the run, not a gate on it."""
+    out = {k: None for k in ATTRIBUTION_FIELDS}
+    try:
+        cost = compiled.cost_analysis()
+        # this jax line returns [per-computation dict]; others a dict
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        if cost.get("flops") is not None:
+            out["flops"] = float(cost["flops"])
+        if cost.get("bytes accessed") is not None:
+            out["bytes_accessed"] = float(cost["bytes accessed"])
+    except Exception:
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        for field, attr in (("argument_bytes", "argument_size_in_bytes"),
+                            ("output_bytes", "output_size_in_bytes"),
+                            ("temp_bytes", "temp_size_in_bytes")):
+            v = getattr(mem, attr, None)
+            if v is not None:
+                out[field] = int(v)
+        if None not in (out["argument_bytes"], out["output_bytes"],
+                        out["temp_bytes"]):
+            out["peak_bytes"] = (out["argument_bytes"]
+                                 + out["output_bytes"]
+                                 + out["temp_bytes"])
+    except Exception:
+        pass
+    return out
+
+
+def _default_label(jitted, name: str) -> str:
+    """Fallback driver label when the caller supplies none: the wrapped
+    function's defining module tail (``parallel.sharded`` → the engine
+    family), else the function name — so even an unlabeled compile is
+    attributable to SOME surface."""
+    mod = getattr(jitted, "__module__", None)
+    if mod and mod.startswith("gossip_tpu."):
+        return mod[len("gossip_tpu."):]
+    return mod or name
+
+
+def load_or_compile(jitted, *args, cache_dir: Optional[str] = None,
+                    label: Optional[str] = None) -> Tuple[object, str]:
     """(compiled, status): the AOT chokepoint.  Lower ``jitted`` for
     ``args``, then either deserialize a stored executable (``"hit"``)
     or compile and store it (``"miss"``); ``"disabled"`` when no cache
@@ -255,7 +325,18 @@ def load_or_compile(jitted, *args, cache_dir: Optional[str] = None
 
     The lowering runs unconditionally: it IS the key (module doc), so
     a warm process still pays trace+lower — that residual is exactly
-    what the dry run's ``first_warm_ms`` budgets bound."""
+    what the dry run's ``first_warm_ms`` budgets bound.
+
+    Every acquisition here additionally emits one ``xla_compile``
+    event (sync=False — this runs inside callers' timed windows) with
+    the caller's driver ``label``, the store ``key``, the acquire
+    wall, the cache verdict, and the executable's own cost/memory
+    attribution (:func:`xla_attribution`, explicit nulls on backends
+    without the analyses) — the self-attribution plane
+    docs/OBSERVABILITY.md "XLA cost & memory attribution" documents;
+    :func:`last_compile` keeps the most recent record for the live
+    Metrics surface."""
+    global _LAST_COMPILE
     from gossip_tpu import compat
     from gossip_tpu.utils import telemetry
     if cache_dir is None:
@@ -263,6 +344,8 @@ def load_or_compile(jitted, *args, cache_dir: Optional[str] = None
     fns = compat.serialize_executable_fns()
     led = telemetry.current()
     name = getattr(jitted, "__name__", None) or type(jitted).__name__
+    key = None
+    t0 = time.perf_counter()
     with led.span("compile", fn=name) as ext:
         # on the END event too: the report's cache table reads rows
         # from span_end lines (span_start attrs don't ride along)
@@ -283,7 +366,14 @@ def load_or_compile(jitted, *args, cache_dir: Optional[str] = None
                 status = "miss"
             ext["key"] = key
         ext["cache"] = status
+    wall_ms = (time.perf_counter() - t0) * 1e3
     led.counter(f"compile_cache_{status}")
+    record = {"label": label or _default_label(jitted, name),
+              "fn": name, "key": key, "cache": status,
+              "compile_ms": round(wall_ms, 3),
+              **xla_attribution(compiled)}
+    _LAST_COMPILE = dict(record)
+    led.event("xla_compile", sync=False, **record)
     return compiled, status
 
 

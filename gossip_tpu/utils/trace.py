@@ -85,9 +85,12 @@ def profile(tag: Optional[str] = None) -> Iterator[None]:
         stop()
 
 
-def aot_timed(jitted, *args):
+def aot_timed(jitted, *args, label=None):
     """(out, compile_s, steady_s, cache): obtain the executable for
     these arguments ahead of time, then time the execution alone.
+    ``label`` is the caller's driver label for the chokepoint's
+    ``xla_compile`` attribution event (utils/compile_cache) — the
+    per-engine name a cost report groups by.
 
     The hardware-table contract (round-2 verdict): reported walls must
     not mix one-off compile cost with steady-state throughput — the
@@ -105,7 +108,8 @@ def aot_timed(jitted, *args):
 
     from gossip_tpu.utils import compile_cache
     t0 = time.perf_counter()
-    compiled, cache = compile_cache.load_or_compile(jitted, *args)
+    compiled, cache = compile_cache.load_or_compile(jitted, *args,
+                                                    label=label)
     compile_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     out = compiled(*args)
@@ -127,10 +131,13 @@ def steady_timed(jitted, *args):
     return out, time.perf_counter() - t0
 
 
-def maybe_aot_timed(jitted, timing, *args):
+def maybe_aot_timed(jitted, timing, *args, label=None):
     """:func:`aot_timed` when the caller passed a ``timing`` dict (fills
     ``compile_s``/``steady_s``), a plain call otherwise — the one place
     the drivers' optional-timing branch and its key names live.
+    ``label`` names the calling driver for compile attribution
+    (:func:`aot_timed`); it also rides the ``driver_timing`` event so
+    walls and costs join on the same engine name.
 
     ``timing={"aot": False}`` opts into :func:`steady_timed` instead:
     ``steady_s`` is the cached-executable execution and ``compile_s``
@@ -153,7 +160,7 @@ def maybe_aot_timed(jitted, timing, *args):
         timing.setdefault("compile_s", 0.0)
     else:
         (out, timing["compile_s"], timing["steady_s"],
-         timing["compile_cache"]) = aot_timed(jitted, *args)
+         timing["compile_cache"]) = aot_timed(jitted, *args, label=label)
     # every driver's wall decomposition reaches the ambient run ledger
     # (utils/telemetry) with no per-driver plumbing; a NullLedger makes
     # this a no-op.  The emit happens AFTER this call's own timed
@@ -164,6 +171,7 @@ def maybe_aot_timed(jitted, timing, *args):
     telemetry.current().event(
         "driver_timing", sync=False,
         fn=fn_name,
+        label=label,
         cache=timing.get("compile_cache"),
         # walls only: the bool "aot" control flag is an int subclass
         # and must not masquerade as a timing field

@@ -1,0 +1,42 @@
+"""Planted unattributed-compile violations (conventions family).
+
+``bypass_chokepoint`` MUST flag: a raw ``.lower().compile()`` chain
+acquires an executable the cost plane never sees — no ``xla_compile``
+event, no cache verdict, no attribution (the shape planner/stream's
+memory probe had before it migrated onto the chokepoint).
+``bypass_jit_inline`` MUST flag too: jitting and chaining in one
+expression is the same bypass.  The negative twins must NOT flag:
+``measure_chokepoint`` routes through load_or_compile (the sanctioned
+acquisition), ``probe_vmem_unattributed`` carries the naming-escape
+(a reviewed raw probe, the ``_drain*`` convention applied here), and
+``normalize_label`` proves string ``.lower()`` never false-positives.
+"""
+
+
+def bypass_chokepoint(runner, x):
+    # MUST flag: the executable exists, the ledger never heard of it
+    compiled = runner.lower(x).compile()
+    return compiled.memory_analysis()
+
+
+def bypass_jit_inline(jax, step, x):
+    # MUST flag: same chain, built inline from a fresh jit wrapper
+    return jax.jit(step).lower(x).compile()
+
+
+def measure_chokepoint(compile_cache, runner, x):
+    # must NOT flag: the ONE sanctioned acquisition path
+    compiled, status = compile_cache.load_or_compile(
+        runner, x, label="planted")
+    return compile_cache.xla_attribution(compiled)
+
+
+def probe_vmem_unattributed(runner, x):
+    # must NOT flag: the declared escape — the function name carries
+    # the reviewed rationale, like _drain* for blocking fetches
+    return runner.lower(x).compile()
+
+
+def normalize_label(label):
+    # must NOT flag: str.lower() is not a lowering
+    return label.lower().strip()

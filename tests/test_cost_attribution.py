@@ -1,0 +1,260 @@
+"""The XLA cost & memory attribution plane (observability PR).
+
+Four layers of proof:
+
+  1. **Self-attribution at the chokepoint** — every
+     ``utils/compile_cache.load_or_compile`` acquisition emits one
+     ``xla_compile`` event carrying the driver label, the store key,
+     the cache verdict, the compile wall, and EVERY attribution field
+     (``compile_cache.ATTRIBUTION_FIELDS``) — populated on this CPU
+     backend, explicit nulls elsewhere (record-never-gate).
+  2. **Degrade path** — an executable without ``cost_analysis`` /
+     ``memory_analysis`` attributes as all-None, the event still
+     carries the keys, and the report renders ``n/a`` — never a crash,
+     never a fabricated zero.  The sidecar's Metrics reply has NO
+     ``last_compile`` key before the first chokepoint compile
+     (absent-not-wrong).
+  3. **The drift gate** — ``planner/budget.crosscheck_peak`` goes
+     green on measured ≤ predicted, RED on an inflated measurement (an
+     under-predicting closed form must fail, per the acceptance
+     criterion), and null on a backend without memory analysis — and
+     every verdict lands as one ``budget_xcheck`` event.
+  4. **The committed record** — ``artifacts/ledger_cost_r24.jsonl``
+     (+ ``.smoke``) pins the capture green: provenance first line,
+     every gate true, every compile attributed.
+"""
+
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from gossip_tpu.planner import budget as PB
+from gossip_tpu.utils import compile_cache, telemetry
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def own_ledger(tmp_path):
+    p = str(tmp_path / "led.jsonl")
+    led = telemetry.Ledger(p)
+    prev = telemetry.activate(led)
+    yield led
+    telemetry.activate(prev)
+    led.close()
+
+
+# -- 1. self-attribution at the chokepoint -----------------------------
+
+def test_chokepoint_emits_attributed_xla_compile(own_ledger, tmp_path,
+                                                 monkeypatch):
+    monkeypatch.setenv(compile_cache.ENV_VAR, str(tmp_path / "cc"))
+    f = jax.jit(lambda x: jnp.sin(x).sum())
+    x = jnp.arange(128.0)
+    _, s1 = compile_cache.load_or_compile(f, x, label="probe_engine")
+    _, s2 = compile_cache.load_or_compile(jax.jit(lambda x: jnp.sin(x)
+                                                  .sum()), x,
+                                          label="probe_engine")
+    assert (s1, s2) == ("miss", "hit")
+    events = telemetry.load_ledger(own_ledger.path)
+    compiles = [e for e in events if e["ev"] == "xla_compile"]
+    assert [e["cache"] for e in compiles] == ["miss", "hit"]
+    for e in compiles:
+        assert e["label"] == "probe_engine"
+        assert e["key"] and e["compile_ms"] > 0
+        # every attribution field PRESENT — and on this CPU backend,
+        # populated (cost_analysis + memory_analysis both work here)
+        for field in compile_cache.ATTRIBUTION_FIELDS:
+            assert field in e, field
+            assert e[field] is not None, field
+        assert e["peak_bytes"] == (e["argument_bytes"]
+                                   + e["output_bytes"]
+                                   + e["temp_bytes"])
+    # the live surface kept the most recent record
+    last = compile_cache.last_compile()
+    assert last is not None and last["cache"] == "hit"
+    assert last["label"] == "probe_engine"
+
+
+def test_default_label_when_caller_passes_none(own_ledger, monkeypatch):
+    monkeypatch.setenv(compile_cache.ENV_VAR, "")
+    f = jax.jit(lambda x: x + 1)
+    _, status = compile_cache.load_or_compile(f, jnp.arange(4))
+    assert status == "disabled"
+    [e] = [e for e in telemetry.load_ledger(own_ledger.path)
+           if e["ev"] == "xla_compile"]
+    assert e["label"]          # never an unlabeled event
+    assert e["key"] is None    # no store, no fingerprint — explicit
+
+
+# -- 2. degrade path: nulls, n/a, absent-not-wrong ---------------------
+
+class _Opaque:
+    """An executable with neither analysis (older jax lines, interpret
+    stubs)."""
+
+
+class _Raising:
+    def cost_analysis(self):
+        raise RuntimeError("unimplemented on this backend")
+
+    def memory_analysis(self):
+        raise RuntimeError("unimplemented on this backend")
+
+
+@pytest.mark.parametrize("stub", [_Opaque(), _Raising()])
+def test_attribution_degrades_to_explicit_nulls(stub):
+    out = compile_cache.xla_attribution(stub)
+    assert set(out) == set(compile_cache.ATTRIBUTION_FIELDS)
+    assert all(v is None for v in out.values())
+
+
+def test_report_renders_null_attribution_as_na():
+    cost_report = _load_tool("cost_report")
+    events = [{"ev": "xla_compile", "label": "tpu_only", "fn": "step",
+               "key": "k", "cache": "miss", "compile_ms": 12.0,
+               **{f: None for f in compile_cache.ATTRIBUTION_FIELDS}}]
+    lines = cost_report.render_cost_section(events)
+    row = next(ln for ln in lines if "tpu_only" in ln)
+    assert "n/a" in row and " 0" not in row.replace("12.0", "")
+    # a ledger with no attribution events renders NO section at all
+    assert cost_report.render_cost_section([{"ev": "family"}]) == []
+
+
+def test_telemetry_report_embeds_cost_section():
+    telemetry_report = _load_tool("telemetry_report")
+    events = [{"ev": "xla_compile", "ts": 0.0, "label": "dense",
+               "fn": "step", "key": "k", "cache": "miss",
+               "compile_ms": 3.0, "flops": 100.0,
+               "bytes_accessed": 4096.0, "argument_bytes": 1024,
+               "output_bytes": 1024, "temp_bytes": 0,
+               "peak_bytes": 2048}]
+    text = telemetry_report.render_markdown(events)
+    assert "## Executable costs" in text and "dense" in text
+
+
+def test_bytes_per_node_round_from_cost_case():
+    cost_report = _load_tool("cost_report")
+    events = [
+        {"ev": "cost_case", "label": "dense", "n": 32, "rounds": 4},
+        {"ev": "xla_compile", "label": "dense", "fn": "step",
+         "key": "k", "cache": "miss", "compile_ms": 3.0,
+         "flops": 1.0, "bytes_accessed": 128 * 32 * 4.0,
+         "argument_bytes": 1, "output_bytes": 1, "temp_bytes": 0,
+         "peak_bytes": 2},
+    ]
+    [row] = cost_report.join_costs(events)["rows"]
+    assert row["bytes_per_node_round"] == 128.0
+
+
+def test_sidecar_metrics_last_compile_absent_not_wrong(monkeypatch):
+    from gossip_tpu.rpc import sidecar
+    monkeypatch.setattr(compile_cache, "_LAST_COMPILE", None)
+    reply = json.loads(sidecar._metrics(b"", None))
+    assert reply["ok"] and "last_compile" not in reply
+    monkeypatch.setattr(
+        compile_cache, "_LAST_COMPILE",
+        {"label": "dense", "fn": "step", "key": "k", "cache": "hit",
+         "compile_ms": 1.5, "peak_bytes": 4096})
+    reply = json.loads(sidecar._metrics(b"", None))
+    assert reply["last_compile"] == {"label": "dense", "cache": "hit",
+                                     "compile_ms": 1.5,
+                                     "peak_bytes": 4096}
+
+
+# -- 3. the drift gate -------------------------------------------------
+
+def test_crosscheck_green_red_and_null(own_ledger):
+    green = PB.crosscheck_peak(200, 150, engine="packed", n=64, tiles=4)
+    assert green["ok"] is True and green["headroom_frac"] == 0.25
+    # an inflated measurement (equivalently: a deflated closed form)
+    # MUST go red — the acceptance criterion's failure mode
+    red = PB.crosscheck_peak(100, 200)
+    assert red["ok"] is False and red["headroom_frac"] == -1.0
+    null = PB.crosscheck_peak(100, None)
+    assert null["ok"] is None and null["measured_bytes"] is None
+    events = [e for e in telemetry.load_ledger(own_ledger.path)
+              if e["ev"] == "budget_xcheck"]
+    assert [e["ok"] for e in events] == [True, False, None]
+    assert events[0]["n"] == 64 and events[0]["tiles"] == 4
+    assert all(e["source"] == "xla_memory_analysis" for e in events)
+
+
+def test_report_marks_exceeded_xcheck():
+    cost_report = _load_tool("cost_report")
+    events = [{"ev": "budget_xcheck", "engine": "packed", "n": 64,
+               "tiles": 4, "predicted_bytes": 100,
+               "measured_bytes": 200, "ok": False,
+               "headroom_frac": -1.0, "source": "xla_memory_analysis",
+               "plan_fingerprint": None}]
+    text = "\n".join(cost_report.render_cost_section(events))
+    assert "**EXCEEDED**" in text
+
+
+def test_stream_dispatch_emits_xcheck(own_ledger, monkeypatch):
+    """The generalized gate in situ: a real (tiny) streamed dispatch
+    with measure_memory=True routes its measuring compile through the
+    chokepoint (label ``scale_stream``) and emits ONE budget_xcheck
+    whose measured side equals the result's measured_loop_bytes."""
+    from gossip_tpu.planner.stream import run_at_scale
+    monkeypatch.setenv(compile_cache.ENV_VAR, "")
+    dev = PB.forced_device_for_tiles(512, rumors=128, fanout=2,
+                                     max_rounds=4, fault=None,
+                                     tiles_at_least=2,
+                                     host_ram_bytes=1 << 30)
+    plan = PB.plan_scale(512, rumors=128, device=dev, fanout=2,
+                         max_rounds=4, segment_every=3)
+    res = run_at_scale(plan, measure_memory=True)
+    events = telemetry.load_ledger(own_ledger.path)
+    [xc] = [e for e in events if e["ev"] == "budget_xcheck"]
+    assert xc["engine"] == plan.engine and xc["n"] == plan.n
+    assert xc["measured_bytes"] == res.measured_loop_bytes
+    assert xc["predicted_bytes"] == plan.predicted_peak_device_bytes
+    assert xc["ok"] is True     # the live closed form must hold
+    compiles = [e for e in events if e["ev"] == "xla_compile"]
+    assert "scale_stream" in {e["label"] for e in compiles}
+
+
+# -- 4. the committed record -------------------------------------------
+
+@pytest.mark.parametrize("name", ["ledger_cost_r24.jsonl",
+                                  "ledger_cost_r24.smoke.jsonl"])
+def test_committed_cost_record_green(name):
+    path = os.path.join(_REPO, "artifacts", name)
+    events = telemetry.load_ledger(path, run="last")
+    assert events[0]["ev"] == "provenance"
+    [rec] = [e for e in events if e["ev"] == "cost_record"]
+    for gate in ("ok", "engines_attributed", "all_events_attributed",
+                 "attribution_fields_present", "warm_hit",
+                 "tiles_ge_4", "xcheck_green"):
+        assert rec[gate] is True, gate
+    compiles = [e for e in events if e["ev"] == "xla_compile"]
+    assert {e["label"] for e in compiles} >= {
+        "dense", "packed", "sparse", "fused", "crdt", "log", "txn",
+        "scale_stream"}
+    for e in compiles:
+        assert e["cache"] in ("hit", "miss", "disabled")
+        for field in compile_cache.ATTRIBUTION_FIELDS:
+            assert field in e, field
+    [xc] = [e for e in events if e["ev"] == "budget_xcheck"][-1:]
+    assert xc["ok"] is True
+    # zero fsyncs from the attribution plane itself: the capture's
+    # fsync count must come only from provenance/counters, and every
+    # xla_compile/budget_xcheck/cost_case event is flush-only — pinned
+    # structurally by test_telemetry's sync=False contract; here we
+    # pin that the record renders (the report tool's contract)
+    cost_report = _load_tool("cost_report")
+    text = "\n".join(cost_report.render_cost_section(events))
+    assert "## Executable costs" in text and "scale_stream" in text

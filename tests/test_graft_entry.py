@@ -51,8 +51,10 @@ FAMILIES = frozenset({
     "scale_plan", "scale_stream_overlap", "sparse_antientropy",
     "topo_sparse_antientropy", "swim_rotating", "halo_banded",
     "fused_planes", "fused_planes_fault_curve", "rumor_sir",
-    "hybrid_2d_sweep"})
-# the committed r22 record predates the pipelined-streaming PR's
+    "hybrid_2d_sweep", "cost_attribution"})
+# the committed r23 record predates the observability PR's
+# cost_attribution family; the committed r22 record predates the
+# pipelined-streaming PR's
 # scale_stream_overlap family; the committed r21 record predates the
 # tracing PR's request_trace
 # family; the committed r20 record predates the mesh-serving PR's mesh_serving
@@ -70,7 +72,8 @@ FAMILIES = frozenset({
 # predate the compiled-nemesis PR's churn_heal family and the
 # traced-operand PR's churn_sweep family — each pin stays on its
 # historical set
-FAMILIES_PRE_OVERLAP = FAMILIES - {"scale_stream_overlap"}
+FAMILIES_PRE_COST = FAMILIES - {"cost_attribution"}
+FAMILIES_PRE_OVERLAP = FAMILIES_PRE_COST - {"scale_stream_overlap"}
 FAMILIES_PRE_TRACE = FAMILIES_PRE_OVERLAP - {"request_trace"}
 FAMILIES_PRE_MESH = FAMILIES_PRE_TRACE - {"mesh_serving"}
 FAMILIES_PRE_SCALE = FAMILIES_PRE_MESH - {"scale_plan"}
@@ -192,16 +195,27 @@ def test_dryrun_warm_process_reuses_cold_process_cache(dryrun_pair):
     # request_trace is host-only by design — zero compiles of its own
     # is the family's whole point (the batcher reuses serving_batch's
     # executables), so its compile event says cache="none" in BOTH
-    # processes and sits outside the miss->hit proof.
+    # processes and sits outside the miss->hit proof.  A warm
+    # cost_attribution is served by the AOT chokepoint store, which
+    # the plain-jit persistent-cache monitor cannot see (cache="none"
+    # in the warm process) — its own miss->hit proof is the
+    # chokepoint's xla_compile verdicts, asserted below.
     assert all(e["cache"] == "miss" for e in cold_compiles
                if e["family"] != "request_trace")
     assert all(e["cache"] == "hit" for e in warm_compiles
-               if e["family"] != "request_trace"), [
+               if e["family"] not in ("request_trace",
+                                      "cost_attribution")), [
         (e["family"], e["cache"]) for e in warm_compiles
         if e["cache"] != "hit"]
     assert all(e["cache"] == "none"
                for e in cold_compiles + warm_compiles
                if e["family"] == "request_trace")
+    # the chokepoint family's cross-process warm proof, on its own
+    # attribution events: cold (miss, hit), warm (hit, hit)
+    for evs, want in ((cold_evs, ["miss", "hit"]),
+                      (warm_evs, ["hit", "hit"])):
+        assert [e["cache"] for e in evs if e["ev"] == "xla_compile"
+                and e.get("label") == "cost_probe"] == want
     # the enable event recorded the shared dir in both ledgers
     for evs in (cold_evs, warm_evs):
         cc = [e for e in evs if e["ev"] == "compile_cache"]
@@ -543,16 +557,52 @@ def test_committed_r22_4dev_record_carries_request_trace():
 
 def test_committed_r23_4dev_record_carries_stream_overlap():
     """The pipelined-streaming PR's committed 4-device record
-    (artifacts/ledger_dryrun_r23_4dev.jsonl, the ledger_diff gate
-    baseline since r23): cold+warm pair, FULL current family set —
-    scale_stream_overlap included (a forced >=3-tile pipelined run
-    gated bitwise against the untiled reference inside the dry-run
-    body, salted steady re-entry) — warm run all-hit apart from the
-    host-only request_trace family, steady and warm budgets held,
-    >= 3x warm-start aggregate, provenance present."""
+    (artifacts/ledger_dryrun_r23_4dev.jsonl): cold+warm pair on its
+    historical family set — scale_stream_overlap included (a forced
+    >=3-tile pipelined run gated bitwise against the untiled reference
+    inside the dry-run body, salted steady re-entry), cost_attribution
+    not yet — warm run all-hit apart from the host-only request_trace
+    family, steady and warm budgets held, >= 3x warm-start aggregate,
+    provenance present.  (The live ledger_diff gate baseline moved to
+    the r24 record below when the observability PR grew the family
+    set.)"""
     _assert_cold_warm_record(
         os.path.join(_REPO, "artifacts", "ledger_dryrun_r23_4dev.jsonl"),
-        FAMILIES, host_only=frozenset({"request_trace"}))
+        FAMILIES_PRE_COST, host_only=frozenset({"request_trace"}))
+
+
+def test_committed_r24_4dev_record_carries_cost_attribution():
+    """The observability PR's committed 4-device record
+    (artifacts/ledger_dryrun_r24_4dev.jsonl, the ledger_diff gate
+    baseline since r24): cold+warm pair, FULL current family set —
+    cost_attribution included (a tiny probe acquired through the
+    utils/compile_cache.load_or_compile chokepoint plus a salted
+    fresh-closure re-entry, the self-attribution assertions running
+    inside the body against its own ledger).  The family sits with
+    request_trace outside the plain-jit all-hit proof: its compiles
+    travel the AOT chokepoint, invisible to the persistent-cache
+    monitor (warm ``compile`` event cache="none"); its warm-start
+    proof is the chokepoint's OWN ``xla_compile`` hit verdicts,
+    asserted below.  Steady and warm budgets held, >= 3x warm-start
+    aggregate, provenance present."""
+    path = os.path.join(_REPO, "artifacts",
+                        "ledger_dryrun_r24_4dev.jsonl")
+    _assert_cold_warm_record(
+        path, FAMILIES,
+        host_only=frozenset({"request_trace", "cost_attribution"}))
+    # the chokepoint's own attribution events carry the warm proof:
+    # cold leg = (miss, hit) — forced first compile, salted re-entry
+    # HIT in the same process; warm leg = (hit, hit) — the store
+    # served the executable across processes
+    all_events = telemetry.load_ledger(path)
+    run_ids = telemetry_report.runs(all_events)
+    per_run = []
+    for rid in run_ids:
+        per_run.append([e["cache"] for e in all_events
+                        if e.get("run") == rid
+                        and e.get("ev") == "xla_compile"
+                        and e.get("label") == "cost_probe"])
+    assert per_run == [["miss", "hit"], ["hit", "hit"]]
 
 
 def test_committed_r09_4dev_record_matches_live_pair_shape(dryrun_pair):

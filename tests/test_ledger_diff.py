@@ -22,14 +22,15 @@ _spec.loader.exec_module(ledger_diff)
 R09_4DEV = os.path.join(_REPO, "artifacts",
                         "ledger_dryrun_r09_4dev.jsonl")
 R09_8DEV = os.path.join(_REPO, "artifacts", "ledger_dryrun_r09.jsonl")
-# the pipelined-streaming PR's 4-device record: same family set as
-# the live dry run (churn_heal, churn_sweep, crdt_counter,
-# serving_batch, kafka_log, txn_register, fused_churn_sweep,
-# fleet_failover, scale_plan, mesh_serving, request_trace AND
-# scale_stream_overlap included), so the tier-1 gate compares every
-# family like-for-like
-R23_4DEV = os.path.join(_REPO, "artifacts",
-                        "ledger_dryrun_r23_4dev.jsonl")
+# the observability PR's 4-device record: same family set as the
+# live dry run (churn_heal, churn_sweep, crdt_counter, serving_batch,
+# kafka_log, txn_register, fused_churn_sweep, fleet_failover,
+# scale_plan, mesh_serving, request_trace, scale_stream_overlap AND
+# cost_attribution included), so the tier-1 gate compares every
+# family like-for-like; r23 (pipelined-streaming PR) stays committed
+# as history but predates the cost_attribution family
+R24_4DEV = os.path.join(_REPO, "artifacts",
+                        "ledger_dryrun_r24_4dev.jsonl")
 
 
 def _write_run(path, families, device_count=4, metrics=None,
@@ -216,12 +217,12 @@ def test_committed_4dev_record_vs_fresh_dryrun_is_clean(dryrun_pair,
     against this session's live warm dry run (same device count, same
     machine class) must come back clean — walls within threshold+floor,
     budgets held, protocol totals compared at equal device count.
-    Since the pipelined-streaming PR the committed record is r23,
-    whose family set includes churn_heal, churn_sweep, crdt_counter,
+    Since the observability PR the committed record is r24, whose
+    family set includes churn_heal, churn_sweep, crdt_counter,
     serving_batch, kafka_log, txn_register, fused_churn_sweep,
-    fleet_failover, scale_plan, mesh_serving, request_trace AND
-    scale_stream_overlap, so the new pipeline family's walls gate
-    like every other family.
+    fleet_failover, scale_plan, mesh_serving, request_trace,
+    scale_stream_overlap AND cost_attribution, so the attribution
+    chokepoint family's walls gate like every other family.
 
     Thresholds are calibrated to this container's measured noise: a
     full-suite run swings individual families' warm FIRST-call walls
@@ -239,7 +240,7 @@ def test_committed_4dev_record_vs_fresh_dryrun_is_clean(dryrun_pair,
     own absolute budget check — which never flaked — flags it.  The
     first_ms wall mechanism itself stays pinned on the synthetic
     fixtures above and the injected-regression test below."""
-    rc = ledger_diff.main([R23_4DEV,
+    rc = ledger_diff.main([R24_4DEV,
                            dryrun_pair["warm"]["ledger_path"],
                            "--first-floor-ms", "10000",
                            "--steady-floor-ms", "150"])
@@ -252,6 +253,7 @@ def test_committed_4dev_record_vs_fresh_dryrun_is_clean(dryrun_pair,
     assert "fused_churn_sweep" in out and "fleet_failover" in out
     assert "scale_plan" in out and "mesh_serving" in out
     assert "request_trace" in out and "scale_stream_overlap" in out
+    assert "cost_attribution" in out
     assert "only in" not in out
     # the metric join actually engaged (same device count, fused
     # drivers instrumented in both)
@@ -266,19 +268,19 @@ def test_committed_record_with_injected_2x_wall_is_flagged(tmp_path,
     calibration that forgives uniform host load, proving the
     thresholds catch a real regression, not just synthetic
     fixtures."""
-    events = telemetry.load_ledger(R23_4DEV)
+    events = telemetry.load_ledger(R24_4DEV)
     runs = [e["run"] for e in events if e.get("ev") == "provenance"]
     warm = runs[-1]
     doubled = str(tmp_path / "doubled.jsonl")
     # churn_sweep carries one of the record's largest warm first-call
-    # walls (~636 ms in r23), so its doubled delta clears a 500 ms
+    # walls (~615 ms in r24), so its doubled delta clears a 500 ms
     # floor — the injection proves the wall mechanism fires on REAL
     # committed data at a noise-hardened floor (warm-wall jitter is
     # tens of ms; the tier-1 like-for-like gate above goes further and
     # hands first_ms detection to the cache-verdict assertions
     # entirely; this pin keeps the wall path honest for manual/CLI
     # use)
-    with open(R23_4DEV) as f, open(doubled, "w") as g:
+    with open(R24_4DEV) as f, open(doubled, "w") as g:
         for line in f:
             if not line.strip():
                 continue
@@ -289,7 +291,7 @@ def test_committed_record_with_injected_2x_wall_is_flagged(tmp_path,
                     if isinstance(e.get(k), (int, float)):
                         e[k] = 2 * e[k]
             g.write(json.dumps(e) + "\n")
-    rc = ledger_diff.main([R23_4DEV, doubled, "--first-floor-ms",
+    rc = ledger_diff.main([R24_4DEV, doubled, "--first-floor-ms",
                            "500", "--steady-floor-ms", "150"])
     out = capsys.readouterr().out
     assert rc == 1

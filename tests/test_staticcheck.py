@@ -119,6 +119,26 @@ def test_conventions_fixture_flags():
         tool_found
 
 
+def test_unattributed_compile_fixture_flags_and_negative_twins():
+    """unattributed-compile: both planted ``.lower().compile()``
+    chains flag; the chokepoint-routed twin, the ``*_unattributed``
+    naming-escape, and a plain string ``.lower()`` stay silent; the
+    chokepoint module itself is exempt by path."""
+    mods = _fixture_modules("planted_unattributed.py")
+    found = conventions.check_unattributed_compile(mods)
+    assert _rules(found) == {"unattributed-compile"}, found
+    assert {f.symbol for f in found} == {"bypass_chokepoint",
+                                         "bypass_jit_inline"}, found
+    assert not any("measure_chokepoint" in f.symbol
+                   for f in found), found
+    assert not any("unattributed" in f.symbol for f in found), found
+    assert not any("normalize_label" in f.symbol for f in found), found
+    # path exemption: the same tree keyed as the chokepoint module
+    exempt = {conventions.UNATTRIBUTED_EXEMPT[0]:
+              mods["planted_unattributed.py"]}
+    assert conventions.check_unattributed_compile(exempt) == []
+
+
 def test_sync_emit_fixture_flags_and_negative_twin():
     """sync-emit-in-request-path: the planted Router flags BOTH shapes
     (defaulted emit in the root, sync=True in a reachable helper); the

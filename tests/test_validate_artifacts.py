@@ -288,6 +288,39 @@ def test_staticcheck_artifacts_must_be_attributable(tmp_path):
     assert va.validate_file(str(good)) == []
 
 
+def test_cost_attribution_artifacts_must_be_attributable(tmp_path):
+    """A ``*cost*``/``*xprof*``/``*attribution*`` artifact without
+    provenance fails — XLA cost & memory attribution evidence
+    (utils/compile_cache's xla_compile events via
+    tools/cost_capture.py) can never be grandfathered, jsonl or json
+    alike: an unattributed cost table is the exact failure the
+    attribution plane exists to prevent."""
+    for name in ("ledger_cost_r99.jsonl", "xprof_dump_r99.jsonl",
+                 "attribution_r99.jsonl"):
+        bad = tmp_path / name
+        bad.write_text(json.dumps({"ev": "xla_compile",
+                                   "label": "dense"}) + "\n")
+        problems = va.validate_file(str(bad))
+        assert any("provenance" in p for p in problems), (name,
+                                                          problems)
+
+    for name in ("cost_table_r99.json", "attribution_r99.json"):
+        badj = tmp_path / name
+        badj.write_text(json.dumps({"flops": 1.0}))
+        problems = va.validate_file(str(badj))
+        assert any("provenance" in p for p in problems), (name,
+                                                          problems)
+
+    good = tmp_path / "ledger_cost_r98.jsonl"
+    with telemetry.Ledger(str(good)) as led:
+        led.event("xla_compile", label="dense", cache="miss")
+    assert va.validate_file(str(good)) == []
+    goodj = tmp_path / "cost_table_r98.json"
+    goodj.write_text(json.dumps({"provenance": telemetry.provenance(),
+                                 "flops": 1.0}))
+    assert va.validate_file(str(goodj)) == []
+
+
 def test_scale_plan_budget_artifacts_must_be_attributable(tmp_path):
     """A ``*scale*``/``*plan*``/``*budget*`` artifact without
     provenance fails — capacity plans and streamed-tiling records

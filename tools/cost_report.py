@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Join ``xla_compile`` attribution events into the per-engine cost
+table — the "Executable costs" read-out of the XLA cost & memory
+attribution plane (utils/compile_cache.load_or_compile, the ONE
+acquisition chokepoint, docs/OBSERVABILITY.md).
+
+Every chokepoint compile lands one ``xla_compile`` event carrying the
+caller's driver label, the executable fingerprint, the cache verdict,
+and XLA's own cost/memory analysis (flops, bytes accessed, argument/
+output/temp bytes) — or explicit nulls where the backend reports none
+(record-never-gate: a null renders ``n/a``, never a fabricated zero).
+``cost_case`` events (tools/cost_capture.py) supply the plan shape
+(nodes × rounds) so attributed bytes normalize to bytes/node/round —
+the "where do the bytes go" number docs/PERF.md reasons with.
+``budget_xcheck`` events (planner/budget.crosscheck_peak) render as
+the measured≤predicted drift-gate table.
+
+    python tools/cost_report.py ARTIFACT.jsonl          # last run
+    python tools/cost_report.py ARTIFACT.jsonl --run RUNID
+
+tools/telemetry_report.py embeds :func:`render_cost_section` so the
+full-ledger report and this tool can never disagree about what an
+``xla_compile`` event means (the one-reader-per-schema convention).
+"""
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: xla_compile table columns pulled straight off the event (the
+#: utils/compile_cache.ATTRIBUTION_FIELDS order, minus the arg/out/
+#: temp decomposition the summary table folds into peak)
+_COST_COLS = ("flops", "bytes_accessed", "peak_bytes")
+
+
+def _telemetry():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from _telemetry import telemetry
+    finally:
+        sys.path.pop(0)
+    return telemetry()
+
+
+def _fmt(v, unit=""):
+    """``n/a`` for null attribution (a backend that reports none),
+    thousands-grouped otherwise — a null must be visibly a null, never
+    a zero someone averages."""
+    if v is None:
+        return "n/a"
+    if isinstance(v, float) and not v.is_integer():
+        return f"{v:,.1f}{unit}"
+    return f"{int(v):,}{unit}"
+
+
+def join_costs(events):
+    """``{"rows": [...], "xchecks": [...], "cases": {...}}`` from one
+    run's events.  ``rows`` has one entry per (label, fn) executable —
+    an engine that compiles an init step and a round step keeps both
+    visible — with the cache verdict, compile wall, attribution
+    fields, and ``bytes_per_node_round`` when a ``cost_case`` event
+    supplies that label's plan shape."""
+    cases = {}
+    for e in events:
+        if e.get("ev") == "cost_case" and e.get("label"):
+            cases[e["label"]] = {"n": e.get("n"),
+                                 "rounds": e.get("rounds")}
+    rows = []
+    index = {}
+    for e in events:
+        if e.get("ev") != "xla_compile":
+            continue
+        label = e.get("label") or e.get("fn") or "?"
+        key = (label, e.get("fn"))
+        row = index.get(key)
+        if row is None:
+            row = {"label": label, "fn": e.get("fn"), "compiles": 0,
+                   "verdicts": {}, "compile_ms": 0.0, "key": None,
+                   **{c: None for c in _COST_COLS},
+                   "bytes_per_node_round": None}
+            index[key] = row
+            rows.append(row)
+        row["compiles"] += 1
+        verdict = e.get("cache")
+        row["verdicts"][verdict] = row["verdicts"].get(verdict, 0) + 1
+        if e.get("compile_ms") is not None:
+            row["compile_ms"] += e["compile_ms"]
+        if e.get("key") is not None:
+            row["key"] = e["key"]
+        for c in _COST_COLS:
+            if e.get(c) is not None:
+                row[c] = e[c]
+        case = cases.get(label)
+        if (case and row["bytes_accessed"] is not None
+                and case.get("n") and case.get("rounds")):
+            row["bytes_per_node_round"] = (
+                row["bytes_accessed"] / (case["n"] * case["rounds"]))
+    xchecks = [{k: v for k, v in e.items()
+                if k not in ("ev", "ts", "run")}
+               for e in events if e.get("ev") == "budget_xcheck"]
+    return {"rows": rows, "xchecks": xchecks, "cases": cases}
+
+
+def render_cost_section(events):
+    """Markdown lines for the "Executable costs" section, [] when the
+    run carries no attribution events (pre-attribution ledgers render
+    without the section, not with an empty table)."""
+    joined = join_costs(events)
+    if not joined["rows"] and not joined["xchecks"]:
+        return []
+    out = ["## Executable costs", ""]
+    if joined["rows"]:
+        out.append("| engine | fn | cache | compile_ms | flops "
+                   "| bytes accessed | peak bytes | bytes/node/round |")
+        out.append("|---|---|---|---|---|---|---|---|")
+        for r in joined["rows"]:
+            cache = ", ".join(f"{k}×{v}" if v > 1 else str(k)
+                              for k, v in sorted(
+                                  r["verdicts"].items(),
+                                  key=lambda kv: str(kv[0])))
+            bpnr = r["bytes_per_node_round"]
+            out.append(
+                f"| {r['label']} | {r['fn'] or '-'} | {cache} "
+                f"| {r['compile_ms']:.1f} | {_fmt(r['flops'])} "
+                f"| {_fmt(r['bytes_accessed'])} "
+                f"| {_fmt(r['peak_bytes'])} "
+                f"| {_fmt(round(bpnr, 1) if bpnr is not None else None)} |")
+        out.append("")
+    if joined["xchecks"]:
+        out.append("### Budget cross-checks (measured ≤ predicted)")
+        out.append("")
+        out.append("| engine | n | tiles | predicted bytes "
+                   "| measured bytes | verdict | headroom |")
+        out.append("|---|---|---|---|---|---|---|")
+        for x in joined["xchecks"]:
+            ok = x.get("ok")
+            verdict = ("n/a" if ok is None
+                       else "green" if ok else "**EXCEEDED**")
+            frac = x.get("headroom_frac")
+            out.append(
+                f"| {x.get('engine')} | {_fmt(x.get('n'))} "
+                f"| {_fmt(x.get('tiles'))} "
+                f"| {_fmt(x.get('predicted_bytes'))} "
+                f"| {_fmt(x.get('measured_bytes'))} | {verdict} "
+                f"| {f'{frac:.1%}' if frac is not None else 'n/a'} |")
+        out.append("")
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("ledger", help="path to a telemetry JSONL ledger")
+    ap.add_argument("--run", default="last",
+                    help="run id to render (default: newest)")
+    args = ap.parse_args(argv)
+    events = _telemetry().load_ledger(args.ledger, run=args.run)
+    lines = render_cost_section(events)
+    if not lines:
+        print(f"no xla_compile/budget_xcheck events in {args.ledger}",
+              file=sys.stderr)
+        return 1
+    print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

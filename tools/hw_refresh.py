@@ -150,7 +150,7 @@ def worst_case_budget_s():
     constants)."""
     return (swim_ab_budget_s() + KERNEL_NUMBERS_TIMEOUT_S + MR_TIMEOUT_S
             + PRNG_TIMEOUT_S + FUSED_SWEEP_TIMEOUT_S
-            + SCALE_TIMEOUT_S + FULL_SCALE_TIMEOUT_S
+            + SCALE_TIMEOUT_S + FULL_SCALE_TIMEOUT_S + COST_TIMEOUT_S
             + FLEET_TIMEOUT_S + ROOFLINE_TIMEOUT_S + SWEEP_TIMEOUT_S
             + SWIM_ABLATION_TIMEOUT_S + ENSEMBLES_TIMEOUT_S
             + bench_budget_s() + TESTS_TIMEOUT_S)
@@ -467,6 +467,21 @@ def scale_plan():
     return line
 
 
+def cost_attribution():
+    """The XLA cost & memory attribution record on this host
+    (tools/cost_capture.py, docs/OBSERVABILITY.md "XLA cost & memory
+    attribution"): one forced-miss compile per engine through the ONE
+    chokepoint, every ``xla_compile`` event labeled + verdict-carrying
+    with cost/memory fields populated-or-null, the cross-closure warm
+    re-entry coming back a store HIT, and the packed budget
+    cross-check green (measured peak bytes <= the planner's closed
+    form at a forced >=4-tile plan).  On a TPU window the same tool
+    attributes real HBM executables — the cost table the capacity
+    plans cite then names hardware numbers, not the CPU structural
+    proof."""
+    return _run_tool("cost_capture.py", COST_TIMEOUT_S)
+
+
 def fleet_failover():
     """The replicated serving fleet's crashloop on this host
     (tools/fleet_crashloop.py): the load mix through the fronting
@@ -720,6 +735,7 @@ TRACE_TIMEOUT_S = 1200          # traced crashloop + steady window
 MESH_SERVING_TIMEOUT_S = 1200   # thousands of connections x 2 legs
 SCALE_TIMEOUT_S = 1200          # structural record: ~2 min on CPU
 FULL_SCALE_TIMEOUT_S = 3600     # the 100M leg owns a real window slot
+COST_TIMEOUT_S = 900            # 7 tiny compiles + one forced-tile run
 
 STEPS = [("staticcheck", staticcheck),
          ("swim_diss_ab", swim_diss_ab),
@@ -729,6 +745,7 @@ STEPS = [("staticcheck", staticcheck),
          ("prng_invariant", prng_invariant),
          ("fused_churn_sweep", fused_churn_sweep),
          ("scale_plan", scale_plan),
+         ("cost_attribution", cost_attribution),
          ("fleet_failover", fleet_failover),
          ("request_trace", request_trace),
          ("mesh_serving", mesh_serving),

@@ -229,6 +229,19 @@ def _trace_section(events):
     return render_trace_section(events)
 
 
+def _cost_section(events):
+    """The "Executable costs" lines, rendered by the cost tool's ONE
+    implementation (tools/cost_report.render_cost_section — the
+    ``xla_compile``/``budget_xcheck`` attribution schema has exactly
+    one reader).  Empty for runs with no attribution events."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from cost_report import render_cost_section
+    finally:
+        sys.path.pop(0)
+    return render_cost_section(events)
+
+
 def check_health(events):
     """Ledger-health problems for the ``--check`` CI gate: a run whose
     evidence cannot be trusted mechanically.  Flags (a) a missing
@@ -338,6 +351,7 @@ def render_markdown(events, budgets=None, title=None,
     out.extend(_serving_section(events))
     out.extend(_trace_section(events if trace_events is None
                               else trace_events))
+    out.extend(_cost_section(events))
 
     tree = span_tree(events)
     if tree:

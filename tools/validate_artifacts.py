@@ -180,6 +180,20 @@ def _is_serving_name(name: str) -> bool:
     return "serving" in name or "load" in name or "meshserve" in name
 
 
+def _is_cost_name(name: str) -> bool:
+    """Cost/xprof/attribution artifacts by name — the XLA cost &
+    memory attribution evidence (per-executable flops/bytes, cache
+    verdicts, the packed budget_xcheck measured≤predicted pair —
+    utils/compile_cache's xla_compile events via tools/cost_capture)
+    must always be attributable; the legacy allowlist can never
+    grandfather one in (the whole attribution plane post-dates the
+    provenance schema).  An unattributed cost table is the exact
+    failure the plane exists to prevent: numbers nobody can pin to a
+    commit or a compile."""
+    return ("cost" in name or "xprof" in name
+            or "attribution" in name)
+
+
 def _is_trace_name(name: str) -> bool:
     """Trace/fleet-status artifacts by name — the request-tracing and
     live-metrics evidence (per-request waterfalls joined by trace_id,
@@ -277,6 +291,12 @@ def validate_file(path):
                     "line — per-request waterfalls and fleet health "
                     "snapshots must be attributable, allowlist or not "
                     "(utils/telemetry.provenance)")
+            if not has_prov and _is_cost_name(name):
+                problems.append(
+                    "cost/xprof/attribution artifact without a "
+                    "provenance line — XLA cost & memory attribution "
+                    "evidence must be attributable, allowlist or not "
+                    "(utils/telemetry.provenance)")
         else:
             with open(path) as f:
                 doc = json.load(f)
@@ -331,6 +351,12 @@ def validate_file(path):
                     "trace/fleet_status artifact without provenance "
                     f"keys {PROVENANCE_KEYS} — per-request waterfalls "
                     "and fleet health snapshots must be attributable, "
+                    "allowlist or not")
+            elif _is_cost_name(name) and not _has_provenance_keys(doc):
+                problems.append(
+                    "cost/xprof/attribution artifact without "
+                    f"provenance keys {PROVENANCE_KEYS} — XLA cost & "
+                    "memory attribution evidence must be attributable, "
                     "allowlist or not")
             elif name not in LEGACY and not _has_provenance_keys(doc):
                 problems.append(
