@@ -23,6 +23,16 @@ The invariants this family encodes are the PR 9/12 serving lessons
     upstream" (``_cached_dense_loop(fault_static=...)``) and is not
     flagged; a bare content name on an executable-producing memo key
     is.
+  * **byz-table-in-memo-key** — the same hazard for the byzantine
+    layer: an executable-producing memo keyed on liar-program
+    CONTENT (``byz``/``liars``/``byz_kind``/``quorum``/...) compiles
+    one program per adversary scenario, defeating the operand
+    discipline that makes the salted dry-run re-entry free
+    (ops/nemesis ``byz_args``: liar content is data on the table
+    tail, never shape).  Same ``*_static`` escape hatch.  Kept as
+    its OWN rule — the byz param vocabulary must not dilute the
+    fault/schedule regex, and a byz finding names the byz-specific
+    fix (thread the program through ``tabled=True`` operands).
   * **blocking-fetch-in-segment-loop** — planner/stream's segment
     loop is a three-stage software pipeline (dispatch tile *k*, drain
     tile *k−1*); a synchronous ``np.asarray``/``np.array``/
@@ -91,6 +101,14 @@ _JNP_BUILDERS = ("stack", "concatenate", "array", "asarray")
 _CONTENT_PARAM = re.compile(
     r"^(fault|churn|sched|schedule|events?|drop|drop_tbl|cut|cut_tbl"
     r"|die|rec|program|tables)$")
+
+#: parameter names that carry byzantine liar-program content (the
+#: ByzConfig lowering: kind/start/arg tables + the traced quorum
+#: scalar — ops/nemesis).  Deliberately NOT folded into
+#: :data:`_CONTENT_PARAM`: the finding names the byz-specific fix
+_BYZ_PARAM = re.compile(
+    r"^(byz|byz_cfg|byz_tbl|byz_kind|byz_start|byz_arg|liars?"
+    r"|quorum)$")
 
 _PY_SIZED = (ast.List, ast.Tuple, ast.ListComp, ast.GeneratorExp,
              ast.SetComp)
@@ -281,13 +299,26 @@ def check(modules: Dict[str, Module],
                         "strip content upstream and rename the "
                         "parameter '*_static', or cache eager VALUES "
                         "instead of a jit closure"))
+                elif _BYZ_PARAM.match(p):
+                    findings.append(Finding(
+                        CHECKER, "byz-table-in-memo-key", rel,
+                        node.lineno, mod.qualname(node),
+                        f"lru_cache'd executable builder keyed on "
+                        f"byz-program parameter '{p}' — one compiled "
+                        "program per adversary scenario, defeating "
+                        "the operand discipline (liar content rides "
+                        "the step table tail as data, never shape — "
+                        "ops/nemesis byz_args); thread the program "
+                        "through tabled=True operands or rename the "
+                        "parameter '*_static'"))
     # dedup: in rpc modules every def (nested ones included) is a
     # root, and the enclosing function's body walk visits nested-def
     # sites too — the same violation must count once, not once per
-    # covering walk
+    # covering walk.  The message joins the key so two distinct
+    # content params on ONE memoized def each keep their finding
     seen, unique = set(), []
     for f in findings:
-        k = (f.rule, f.path, f.line, f.symbol)
+        k = (f.rule, f.path, f.line, f.symbol, f.message)
         if k not in seen:
             seen.add(k)
             unique.append(f)

@@ -277,6 +277,27 @@ def _parse_churn(a):
                        partitions=tuple(partitions), ramp=ramp)
 
 
+def _parse_byz(a):
+    """--byz NODE:ROUND:KIND[:ARG] (+ --byz-quorum) -> ByzConfig or
+    None.  Field validation (known kinds, one action per node, quorum
+    range) lives in ByzConfig itself — this only parses the colon
+    syntax, the _parse_churn discipline."""
+    specs = getattr(a, "byz", None) or ()
+    if not specs:
+        return None
+    liars = []
+    for s in specs:
+        p = s.split(":")
+        if len(p) not in (3, 4):
+            raise ValueError("--byz takes NODE:ROUND:KIND[:ARG] "
+                             f"colon-separated fields, got {s!r}")
+        liars.append((int(p[0]), int(p[1]), p[2],
+                      int(p[3]) if len(p) == 4 else 0))
+    from gossip_tpu.config import ByzConfig
+    return ByzConfig(liars=tuple(liars),
+                     quorum=getattr(a, "byz_quorum", 2))
+
+
 def _args_to_configs(a):
     t = a.swim_suspect_rounds
     if not t and a.mode == "swim":    # import only when needed: pulls in jax
@@ -1083,10 +1104,12 @@ def cmd_crdt(a) -> int:
     run = RunConfig(target_coverage=a.target, max_rounds=a.max_rounds,
                     seed=a.seed, origin=a.origin)
     churn = _parse_churn(a)
+    byz = _parse_byz(a)
     fault = None
-    if a.drop > 0 or a.death > 0 or churn is not None:
+    if (a.drop > 0 or a.death > 0 or churn is not None
+            or byz is not None):
         fault = FaultConfig(node_death_rate=a.death, drop_prob=a.drop,
-                            seed=a.seed, churn=churn)
+                            seed=a.seed, churn=churn, byz=byz)
     topo = G.build(tc)
     want_curve = a.curve or bool(a.save_curve)
     import time as _time
@@ -1098,21 +1121,22 @@ def cmd_crdt(a) -> int:
         mesh = make_mesh(a.devices)
         if want_curve:
             conv, msgs, final, truth = simulate_curve_crdt_sharded(
-                cfg, proto, topo, run, mesh, fault)
+                cfg, proto, topo, run, mesh, fault, defend=a.defend)
         else:
             rounds, vc, msgs_f, final, truth = (
                 simulate_until_crdt_sharded(cfg, proto, topo, run,
-                                            mesh, fault))
+                                            mesh, fault,
+                                            defend=a.defend))
         engine = "crdt-sharded"
     else:
         from gossip_tpu.models.crdt import (simulate_curve_crdt,
                                             simulate_until_crdt)
         if want_curve:
             conv, msgs, final, truth = simulate_curve_crdt(
-                cfg, proto, topo, run, fault)
+                cfg, proto, topo, run, fault, defend=a.defend)
         else:
             rounds, vc, msgs_f, final, truth = simulate_until_crdt(
-                cfg, proto, topo, run, fault)
+                cfg, proto, topo, run, fault, defend=a.defend)
         engine = "crdt-xla"
     wall = _time.perf_counter() - t0
     if want_curve:
@@ -1127,6 +1151,9 @@ def cmd_crdt(a) -> int:
            "compile_cache": _cache_stamp(a)}
     if churn is not None:
         out["fault_program"] = True
+    if byz is not None:
+        out["byz_program"] = True
+        out["defended"] = bool(a.defend)
     if a.save_curve:
         from gossip_tpu.utils.metrics import dump_curve_jsonl
         dump_curve_jsonl(a.save_curve, [float(c) for c in conv],
@@ -1252,10 +1279,12 @@ def cmd_txn(a) -> int:
     run = RunConfig(target_coverage=a.target, max_rounds=a.max_rounds,
                     seed=a.seed, origin=a.origin)
     churn = _parse_churn(a)
+    byz = _parse_byz(a)
     fault = None
-    if a.drop > 0 or a.death > 0 or churn is not None:
+    if (a.drop > 0 or a.death > 0 or churn is not None
+            or byz is not None):
         fault = FaultConfig(node_death_rate=a.death, drop_prob=a.drop,
-                            seed=a.seed, churn=churn)
+                            seed=a.seed, churn=churn, byz=byz)
     topo = G.build(tc)
     want_curve = a.curve or bool(a.save_curve)
     import time as _time
@@ -1267,21 +1296,22 @@ def cmd_txn(a) -> int:
         mesh = make_mesh(a.devices)
         if want_curve:
             conv, msgs, final, truth = simulate_curve_txn_sharded(
-                cfg, proto, topo, run, mesh, fault)
+                cfg, proto, topo, run, mesh, fault, defend=a.defend)
         else:
             rounds, tcv, msgs_f, final, truth = (
                 simulate_until_txn_sharded(cfg, proto, topo, run,
-                                           mesh, fault))
+                                           mesh, fault,
+                                           defend=a.defend))
         engine = "txn-sharded"
     else:
         from gossip_tpu.models.register import (simulate_curve_txn,
                                                 simulate_until_txn)
         if want_curve:
             conv, msgs, final, truth = simulate_curve_txn(
-                cfg, proto, topo, run, fault)
+                cfg, proto, topo, run, fault, defend=a.defend)
         else:
             rounds, tcv, msgs_f, final, truth = simulate_until_txn(
-                cfg, proto, topo, run, fault)
+                cfg, proto, topo, run, fault, defend=a.defend)
         engine = "txn-xla"
     wall = _time.perf_counter() - t0
     if want_curve:
@@ -1297,6 +1327,9 @@ def cmd_txn(a) -> int:
            "load": a.load, "compile_cache": _cache_stamp(a)}
     if churn is not None:
         out["fault_program"] = True
+    if byz is not None:
+        out["byz_program"] = True
+        out["defended"] = bool(a.defend)
     if a.save_curve:
         from gossip_tpu.utils.metrics import dump_curve_jsonl
         dump_curve_jsonl(a.save_curve, [float(c) for c in conv],
@@ -1949,6 +1982,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--drop-ramp", default=None,
                    metavar="START:END:P0:P1",
                    help="nemesis drop-rate ramp")
+    p.add_argument("--byz", action="append", default=None,
+                   metavar="NODE:ROUND:KIND[:ARG]",
+                   help="scripted byzantine liar: from ROUND on, NODE "
+                        "serves forged state of KIND (corrupt | replay "
+                        "| equivocate | inflate), ARG the kind-specific "
+                        "payload knob; repeatable, one action per node "
+                        "(docs/ROBUSTNESS.md \"Byzantine adversaries\")")
+    p.add_argument("--byz-quorum", type=int, default=2,
+                   help="independent-witness count q for defended set "
+                        "bit admission (1-3; needs fanout >= q)")
+    p.add_argument("--defend", action="store_true",
+                   help="enable the array-form defenses (owner-column "
+                        "guards, monotonicity clamps, quorum echo); "
+                        "off = the undefended control arm")
     p.add_argument("--curve", action="store_true",
                    help="include the per-round value-convergence curve")
     p.add_argument("--save-curve", default=None, metavar="PATH",
@@ -2079,6 +2126,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--drop-ramp", default=None,
                    metavar="START:END:P0:P1",
                    help="nemesis drop-rate ramp")
+    p.add_argument("--byz", action="append", default=None,
+                   metavar="NODE:ROUND:KIND[:ARG]",
+                   help="scripted byzantine liar: from ROUND on, NODE "
+                        "serves forged register state of KIND (corrupt "
+                        "| replay | equivocate | inflate), ARG the "
+                        "kind-specific payload knob; repeatable "
+                        "(docs/ROBUSTNESS.md \"Byzantine adversaries\")")
+    p.add_argument("--byz-quorum", type=int, default=2,
+                   help="independent-witness count q (register defense "
+                        "is owner-provenance, q applies to set planes)")
+    p.add_argument("--defend", action="store_true",
+                   help="enable the array-form defenses (owner-"
+                        "provenance admission); off = the undefended "
+                        "control arm")
     p.add_argument("--curve", action="store_true",
                    help="include the per-round txn-convergence curve")
     p.add_argument("--save-curve", default=None, metavar="PATH",

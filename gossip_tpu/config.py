@@ -303,6 +303,94 @@ class ChurnConfig:
         return max(ends) + 1
 
 
+# Byzantine liar actions (ops/nemesis byz lowering).  Every kind is a
+# SERVE-side transform of the state row a liar hands to pulling peers:
+BYZ_CORRUPT = "corrupt"        # flip payload words it forwards (xor arg)
+BYZ_REPLAY = "replay"          # serve a stale snapshot of its own planes
+BYZ_EQUIVOCATE = "equivocate"  # different state per partner (keyed by id)
+BYZ_INFLATE = "inflate"        # write columns/keys it does not own
+
+BYZ_KINDS = (BYZ_CORRUPT, BYZ_REPLAY, BYZ_EQUIVOCATE, BYZ_INFLATE)
+
+
+@dataclasses.dataclass(frozen=True)
+class ByzConfig:
+    """A scripted *byzantine program* — nodes that LIE (ROADMAP item 4),
+    the adversarial half of the nemesis subsystem.
+
+    Where :class:`ChurnConfig` scripts fail-stop faults (a down node is
+    silent), this scripts liars: ``liars`` are ``(node, round, kind,
+    arg)`` quadruples — from ``round`` onward, ``node`` serves every
+    pull with a transformed state row (:data:`BYZ_KINDS` catalog;
+    docs/ROBUSTNESS.md "Byzantine adversaries").  The program lowers to
+    padded runtime operands on the step's ``tables`` tail exactly like
+    the churn schedule (ops/nemesis.byz_args — compiled loops carry
+    shapes, never liar content), and the transforms render RECEIVER
+    side, so a liar's own durable state stays honest: the lie is on the
+    wire, which is the BFT model (a faulty replica can say anything but
+    cannot rewrite history it already gossiped).
+
+    A liar corrupts only components it does NOT own — its own
+    column/element/key writes are its own to make and are
+    indistinguishable from honest writes (the standard BFT limitation;
+    the ``byz_conv`` metric judges convergence on HONEST-owned
+    components for exactly this reason).
+
+    ``quorum`` is the echo-sampling threshold q of the defended packed
+    set kernels: a broadcast bit not served by its owner directly is
+    admitted only when seen from >= q distinct partners in one round.
+    It lowers as a TRACED scalar operand, and bounds the non-colluding
+    liar tolerance at f < q (q identically-scripted colluders can meet
+    their own quorum — docs/ROBUSTNESS.md).
+
+    One action per node (the ChurnConfig one-event rule); an empty
+    program is normalized to ``None`` by :class:`FaultConfig`.
+    """
+
+    liars: Tuple[Tuple[int, int, str, int], ...] = ()
+    quorum: int = 2
+
+    def __post_init__(self):
+        object.__setattr__(self, "liars", tuple(
+            (int(a[0]), int(a[1]), str(a[2]), int(a[3]) if len(a) > 3
+             else 0)
+            for a in (tuple(x) for x in self.liars)))
+        for a in self.liars:
+            if len(a) != 4:
+                raise ValueError(f"byz liar {a} must be "
+                                 "(node, round, kind[, arg])")
+            node, rnd, kind, arg = a
+            if node < 0:
+                raise ValueError(f"byz liar node {node} must be >= 0")
+            if rnd < 0 or rnd > MAX_CHURN_HORIZON:
+                raise ValueError(
+                    f"byz liar round {rnd} outside "
+                    f"[0, {MAX_CHURN_HORIZON}] (the schedule horizon "
+                    "cap, shared with ChurnConfig)")
+            if kind not in BYZ_KINDS:
+                raise ValueError(f"unknown byz kind {kind!r}; choose "
+                                 f"from {BYZ_KINDS}")
+            if arg < 0:
+                raise ValueError(f"byz liar {a}: arg must be >= 0 (an "
+                                 "xor/inflation pattern, not a sign)")
+        nodes = [a[0] for a in self.liars]
+        if len(set(nodes)) != len(nodes):
+            raise ValueError("byz program must script each node at "
+                             "most once (one standing lie per node — "
+                             "the ChurnConfig one-event rule)")
+        if not 1 <= self.quorum <= 3:
+            raise ValueError(
+                f"quorum={self.quorum} outside [1, 3]: the defended "
+                "set kernels count echoes with a carry-save chain of "
+                "depth 3 (ops/crdt.pull_merge_crdt_byz); a larger "
+                "quorum needs a deeper chain, added when an engine "
+                "needs it")
+
+    @property
+    def empty(self) -> bool:
+        return not self.liars
+
+
 # CRDT payload kinds (ops/crdt.py).  The Gossip Glomers sibling
 # workloads of the reference's broadcast: same epidemic exchange, a
 # commutative-merge payload instead of the infected bit.
@@ -715,6 +803,10 @@ class FaultConfig:
     # Time-varying fault schedule (CLI --churn-event/--partition/
     # --drop-ramp, RPC fault.churn object).
     churn: Optional["ChurnConfig"] = None
+    # Scripted byzantine liars (CLI --byz NODE:ROUND:KIND[:ARG], RPC
+    # fault.byz object) — ByzConfig; None keeps every kernel on its
+    # honest-exchange path, bitwise unchanged.
+    byz: Optional["ByzConfig"] = None
 
     def __post_init__(self):
         # JSON/RPC delivers lists; coerce so the config stays hashable.
@@ -739,6 +831,12 @@ class FaultConfig:
             # path (and its bitwise pins) for configs that carry a
             # vacuous churn object
             object.__setattr__(self, "churn", None)
+        if isinstance(self.byz, dict):        # RPC: nested JSON object
+            object.__setattr__(self, "byz", ByzConfig(**self.byz))
+        if self.byz is not None and self.byz.empty:
+            # no liars == no byzantine program: keep the honest
+            # exchange path (the churn normalization rule)
+            object.__setattr__(self, "byz", None)
 
 
 ENGINES = ("auto", "fused", "xla", "native")

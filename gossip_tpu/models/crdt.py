@@ -101,15 +101,46 @@ def check_crdt_mode(proto: ProtocolConfig) -> None:
             "does not have, the models/si_packed.py precedent)")
 
 
+def check_byz_defendable(cfg, fault, fanout: int, defend: bool) -> None:
+    """The defend/byz coupling, one loud reason per arm (shared by the
+    single-device and sharded factories and the CLI): ``defend=True``
+    without a liar program is rejected (the defended admission CHANGES
+    the exchange — owner-direct propagation — so a defended no-liar
+    run is not the control arm of anything), and a defended packed-set
+    run needs ``fanout >= quorum`` (a round that samples fewer
+    partners than the echo threshold could never admit a broadcast
+    bit by quorum)."""
+    from gossip_tpu.ops import nemesis as NE
+    bz = NE.get_byz(fault)
+    if defend and bz is None:
+        raise ValueError(
+            "defend=True without a byzantine program: the defended "
+            "admission changes the exchange (owner-direct "
+            "propagation), so there is nothing it would be defending "
+            "against — script liars with --byz, or drop --defend")
+    if (defend and bz is not None and cfg is not None
+            and getattr(cfg, "kind", None) in C.CRDT_SET_KINDS
+            and fanout < fault.byz.quorum):
+        raise ValueError(
+            f"defended packed-set exchange with fanout={fanout} < "
+            f"quorum={fault.byz.quorum}: a bit echoed by fewer "
+            "partners than are even sampled per round can never meet "
+            "the quorum — raise --fanout or lower ByzConfig.quorum")
+
+
 def make_crdt_round(cfg: CrdtConfig, proto: ProtocolConfig,
                     topo: Topology, fault: Optional[FaultConfig] = None,
-                    origin: int = 0, tabled: bool = False):
+                    origin: int = 0, tabled: bool = False,
+                    defend: bool = False):
     """Single-device CRDT round step; the sharded twin lives in
     parallel/sharded_crdt.py and must stay bitwise identical (pinned
     in tests/test_crdt.py).  Returns ``step: CrdtState -> CrdtState``
     (or ``(state, lost)`` on the churn path — the models/si.py
     contract); ``tabled=True`` returns ``(step, tables)`` with
-    topology + injection (+ schedule) arrays as step ARGUMENTS."""
+    topology + injection (+ schedule) (+ byzantine program) arrays as
+    step ARGUMENTS.  ``defend=True`` switches the exchange to the
+    defended admission (ops/crdt byzantine section); ``defend=False``
+    under a liar program is the undefended control arm."""
     check_crdt_mode(proto)
     n, k = topo.n, proto.fanout
     if cfg.kind == C.VCLOCK:
@@ -120,18 +151,25 @@ def make_crdt_round(cfg: CrdtConfig, proto: ProtocolConfig,
     tables = () if topo.implicit else (topo.nbrs, topo.deg)
     from gossip_tpu.ops import nemesis as NE
     ch = NE.get(fault)
+    bz = NE.get_byz(fault)
     # capability row: the CRDT pull exchange rides the dense/packed
     # fabric and honors the FULL schedule feature set — events,
-    # partition windows, drop ramps (docs/ROBUSTNESS.md catalog)
-    NE.check_supported(fault, engine="crdt-pull")
-    # injections then (on the churn path) the schedule: both runtime
-    # operands on the table tail, shapes-only in the compiled loop
+    # partition windows, drop ramps — plus the byzantine liar program
+    # with array-form defenses (docs/ROBUSTNESS.md catalog)
+    NE.check_supported(fault, engine="crdt-pull", byz=True)
+    check_byz_defendable(cfg, fault, k, defend)
+    # injections then (on the churn path) the schedule, then the liar
+    # program OUTERMOST: all runtime operands on the table tail,
+    # shapes-only in the compiled loop
     tables = tables + CR.inject_args(cfg, n)
     if ch is not None:
         tables = tables + NE.sched_args(NE.build(fault, n))
+    if bz is not None:
+        tables = tables + NE.byz_args(NE.build_byz(fault, n))
     zero = jnp.zeros((), CR.state_dtype(cfg))
 
     def step_tabled(state: CrdtState, *tbl):
+        tbl, byzt = NE.split_byz(bz, tbl)
         tbl, sched = NE.split_tables(ch, tbl)
         tbl, inj = CR.split_inject(cfg, tbl)
         nbrs_t, deg_t = tbl if tbl else (None, None)
@@ -167,7 +205,13 @@ def make_crdt_round(cfg: CrdtConfig, proto: ProtocolConfig,
                               partners0, dp, n, force=ch is not None)
         if ch is not None:
             partners = NE.partition_targets(cut, ids, partners, n)
-        pulled = CR.pull_merge_crdt(cfg.kind, visible, partners, n)
+        if bz is not None:
+            pulled = CR.pull_merge_crdt_byz(
+                cfg, visible, partners, n, byz=byzt,
+                round_=state.round, gids=ids, n=n, origin=origin,
+                alive_fn=alive_fn, defend=defend)
+        else:
+            pulled = CR.pull_merge_crdt(cfg.kind, visible, partners, n)
         if alive is not None:
             partners = jnp.where(alive[:, None], partners, n)
         n_req = jnp.sum(partners < n).astype(jnp.float32)
@@ -200,7 +244,7 @@ def _conv_target_count(run: RunConfig, eventual_total: int) -> int:
 def simulate_curve_crdt(cfg: CrdtConfig, proto: ProtocolConfig,
                         topo: Topology, run: RunConfig,
                         fault: Optional[FaultConfig] = None,
-                        timing=None):
+                        timing=None, defend: bool = False):
     """``lax.scan`` over rounds recording the per-round CONVERGED-NODE
     COUNT (int32) and msgs; returns ``(value_conv f64[T], msgs f32[T],
     final_state, truth_value)`` with value_conv divided once on the
@@ -212,14 +256,16 @@ def simulate_curve_crdt(cfg: CrdtConfig, proto: ProtocolConfig,
     from gossip_tpu.utils.trace import maybe_aot_timed
     check_injections_reachable(cfg, run)
     step, tables = make_crdt_round(cfg, proto, topo, fault, run.origin,
-                                   tabled=True)
+                                   tabled=True, defend=defend)
     ch = NE.get(fault)
+    bz = NE.get_byz(fault)
     n = topo.n
     init = init_crdt_state(run, cfg, n)
 
     @jax.jit
     def scan(state, *tbl):
-        _, inj0 = CR.split_inject(cfg, NE.split_tables(ch, tbl)[0])
+        _, inj0 = CR.split_inject(cfg, NE.split_tables(
+            ch, NE.split_byz(bz, tbl)[0])[0])
         truth = CR.ground_truth(cfg, inj0, fault, n, run.origin)
         eventual = CR.eventual_alive_crdt(fault, n, run.origin)
 
@@ -258,7 +304,7 @@ def truth_scalar(cfg: CrdtConfig, truth, n: int):
 def simulate_until_crdt(cfg: CrdtConfig, proto: ProtocolConfig,
                         topo: Topology, run: RunConfig,
                         fault: Optional[FaultConfig] = None,
-                        timing=None):
+                        timing=None, defend: bool = False):
     """``lax.while_loop`` until the converged-node count reaches the
     integer target (``target_coverage`` of the eventual-alive set);
     returns ``(rounds, value_conv, msgs, final_state, truth_value)``."""
@@ -268,9 +314,10 @@ def simulate_until_crdt(cfg: CrdtConfig, proto: ProtocolConfig,
     from gossip_tpu.utils.trace import maybe_aot_timed
     check_injections_reachable(cfg, run)
     step, tables = make_crdt_round(cfg, proto, topo, fault, run.origin,
-                                   tabled=True)
+                                   tabled=True, defend=defend)
     step = NE.drop_lost(step, NE.get(fault))
     ch = NE.get(fault)
+    bz = NE.get_byz(fault)
     n = topo.n
     init = init_crdt_state(run, cfg, n)
     eventual_np = np.asarray(CR.eventual_alive_crdt(fault, n,
@@ -280,7 +327,8 @@ def simulate_until_crdt(cfg: CrdtConfig, proto: ProtocolConfig,
 
     @jax.jit
     def loop(state, *tbl):
-        _, inj0 = CR.split_inject(cfg, NE.split_tables(ch, tbl)[0])
+        _, inj0 = CR.split_inject(cfg, NE.split_tables(
+            ch, NE.split_byz(bz, tbl)[0])[0])
         truth = CR.ground_truth(cfg, inj0, fault, n, run.origin)
         eventual = CR.eventual_alive_crdt(fault, n, run.origin)
 

@@ -98,30 +98,41 @@ def check_txn_mode(proto: ProtocolConfig) -> None:
 def make_register_round(cfg: TxnConfig, proto: ProtocolConfig,
                         topo: Topology,
                         fault: Optional[FaultConfig] = None,
-                        origin: int = 0, tabled: bool = False):
+                        origin: int = 0, tabled: bool = False,
+                        defend: bool = False):
     """Single-device LWW-register round step; the sharded twin lives
     in parallel/sharded_register.py and must stay bitwise identical
     (pinned in tests/test_txn.py).  Returns ``step: RegState ->
     RegState`` (or ``(state, lost)`` on the churn path);
     ``tabled=True`` returns ``(step, tables)`` with topology + write
-    (+ schedule) arrays as step ARGUMENTS."""
+    (+ schedule) (+ byzantine program) arrays as step ARGUMENTS.
+    ``defend=True`` switches the exchange to the owner/clamp-defended
+    admission (ops/registers byzantine section); ``defend=False``
+    under a liar program is the undefended control arm."""
     check_txn_mode(proto)
     n, k = topo.n, proto.fanout
     drop_prob = 0.0 if fault is None else fault.drop_prob
     tables = () if topo.implicit else (topo.nbrs, topo.deg)
+    from gossip_tpu.models.crdt import check_byz_defendable
     from gossip_tpu.ops import nemesis as NE
     ch = NE.get(fault)
+    bz = NE.get_byz(fault)
     # capability row: the register pull exchange rides the dense
     # fabric and honors the FULL schedule feature set — events,
-    # partition windows, drop ramps (docs/ROBUSTNESS.md scenario
+    # partition windows, drop ramps — plus the byzantine liar program
+    # with the owner/clamp defense (docs/ROBUSTNESS.md scenario
     # catalog)
-    NE.check_supported(fault, engine="txn-pull")
+    NE.check_supported(fault, engine="txn-pull", byz=True)
+    check_byz_defendable(None, fault, k, defend)
     tables = tables + RG.inject_args(cfg, n)
     if ch is not None:
         tables = tables + NE.sched_args(NE.build(fault, n))
+    if bz is not None:
+        tables = tables + NE.byz_args(NE.build_byz(fault, n))
     zero = jnp.zeros((), jnp.int32)
 
     def step_tabled(state: RegState, *tbl):
+        tbl, byzt = NE.split_byz(bz, tbl)
         tbl, sched = NE.split_tables(ch, tbl)
         tbl, inj = RG.split_inject(cfg, tbl)
         nbrs_t, deg_t = tbl if tbl else (None, None)
@@ -154,7 +165,14 @@ def make_register_round(cfg: TxnConfig, proto: ProtocolConfig,
                               partners0, dp, n, force=ch is not None)
         if ch is not None:
             partners = NE.partition_targets(cut, ids, partners, n)
-        pulled = RG.pull_merge_reg(visible, partners, n)
+        if bz is not None:
+            pulled = RG.pull_merge_reg_byz(
+                visible, partners, n, byz=byzt, round_=state.round,
+                gids=ids, n=n,
+                alive_fn=RG.alive_at_fn(fault, n, origin),
+                defend=defend)
+        else:
+            pulled = RG.pull_merge_reg(visible, partners, n)
         if alive is not None:
             partners = jnp.where(alive[:, None], partners, n)
         n_req = jnp.sum(partners < n).astype(jnp.float32)
@@ -185,7 +203,7 @@ def _conv_target_count(run: RunConfig, eventual_total: int) -> int:
 def simulate_curve_txn(cfg: TxnConfig, proto: ProtocolConfig,
                        topo: Topology, run: RunConfig,
                        fault: Optional[FaultConfig] = None,
-                       timing=None):
+                       timing=None, defend: bool = False):
     """``lax.scan`` over rounds recording the per-round CONVERGED-NODE
     COUNT (int32) and msgs; returns ``(txn_conv f64[T], msgs f32[T],
     final_state, truth_summary)`` with txn_conv divided once on the
@@ -197,14 +215,17 @@ def simulate_curve_txn(cfg: TxnConfig, proto: ProtocolConfig,
     from gossip_tpu.utils.trace import maybe_aot_timed
     check_writes_reachable(cfg, run)
     step, tables = make_register_round(cfg, proto, topo, fault,
-                                       run.origin, tabled=True)
+                                       run.origin, tabled=True,
+                                       defend=defend)
     ch = NE.get(fault)
+    bz = NE.get_byz(fault)
     n = topo.n
     init = init_reg_state(run, cfg, n)
 
     @jax.jit
     def scan(state, *tbl):
-        _, inj0 = RG.split_inject(cfg, NE.split_tables(ch, tbl)[0])
+        _, inj0 = RG.split_inject(cfg, NE.split_tables(
+            ch, NE.split_byz(bz, tbl)[0])[0])
         truth = RG.ground_truth(cfg, inj0, fault, n, run.origin)
         eventual = RG.eventual_alive_crdt(fault, n, run.origin)
 
@@ -230,7 +251,7 @@ def simulate_curve_txn(cfg: TxnConfig, proto: ProtocolConfig,
 def simulate_until_txn(cfg: TxnConfig, proto: ProtocolConfig,
                        topo: Topology, run: RunConfig,
                        fault: Optional[FaultConfig] = None,
-                       timing=None):
+                       timing=None, defend: bool = False):
     """``lax.while_loop`` until the converged-node count reaches the
     integer target; returns ``(rounds, txn_conv, msgs, final_state,
     truth_summary)``."""
@@ -240,9 +261,11 @@ def simulate_until_txn(cfg: TxnConfig, proto: ProtocolConfig,
     from gossip_tpu.utils.trace import maybe_aot_timed
     check_writes_reachable(cfg, run)
     step, tables = make_register_round(cfg, proto, topo, fault,
-                                       run.origin, tabled=True)
+                                       run.origin, tabled=True,
+                                       defend=defend)
     step = NE.drop_lost(step, NE.get(fault))
     ch = NE.get(fault)
+    bz = NE.get_byz(fault)
     n = topo.n
     init = init_reg_state(run, cfg, n)
     eventual_np = np.asarray(RG.eventual_alive_crdt(fault, n,
@@ -252,7 +275,8 @@ def simulate_until_txn(cfg: TxnConfig, proto: ProtocolConfig,
 
     @jax.jit
     def loop(state, *tbl):
-        _, inj0 = RG.split_inject(cfg, NE.split_tables(ch, tbl)[0])
+        _, inj0 = RG.split_inject(cfg, NE.split_tables(
+            ch, NE.split_byz(bz, tbl)[0])[0])
         truth = RG.ground_truth(cfg, inj0, fault, n, run.origin)
         eventual = RG.eventual_alive_crdt(fault, n, run.origin)
 

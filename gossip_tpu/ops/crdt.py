@@ -154,6 +154,226 @@ def pull_merge_crdt(kind: str, rows_all: jax.Array, partners: jax.Array,
     return out
 
 
+# -- byzantine exchange: liar transforms + array-form defenses ---------
+#
+# The byz half of the nemesis subsystem (ops/nemesis ByzSchedule;
+# docs/ROBUSTNESS.md "Byzantine adversaries").  Both halves are
+# RECEIVER-side renders of the gathered rows, all jnp.where on the byz
+# tables, so the compiled loop carries liar SHAPES but never content:
+#
+#   * :func:`_byz_serve_counter` / :func:`_byz_serve_set` transform the
+#     row an ACTIVE liar partner serves — corrupt (xor), replay (the
+#     genesis snapshot: all zeros, maximal staleness), equivocate (a
+#     receiver-id-keyed pattern), inflate (raise columns / set bits it
+#     does not own).  Every transform touches only NON-OWN components:
+#     a liar's own column/element is its own to write (the standard
+#     BFT limitation — an own-component lie is indistinguishable from
+#     a legitimate write), which is exactly what makes the defended
+#     admission below provably reject ALL dishonest content.
+#   * with ``defend=True`` the admission is a one-line lattice check
+#     per payload: counters admit only the partner's OWN column (the
+#     owner-column write guard; the max join is itself the per-column
+#     monotonicity clamp), packed sets admit a bit served directly by
+#     its owner OR echoed by >= quorum distinct partners this round
+#     (the quorum scalar is a traced operand).  Defended exchanges
+#     propagate owner-direct (slower — coupon-collector rounds — but
+#     EXACT on honest-owned components under any f liars scripted
+#     here; quorum additionally tolerates f < q non-colluding forgers
+#     on the broadcast planes).
+
+def set_owner_words(elements: int, n: int, origin: int) -> jax.Array:
+    """uint32[n, 2W]: the packed element bits node i OWNS (element e's
+    owner is ``(origin + e) % n`` — the inject_rows convention), tiled
+    over both planes (an add bit and its tombstone share an owner).
+    Content-static (iota + pack), shared by the liar transforms, the
+    defended admission, and the honest-component convergence mask so
+    the three can never disagree on ownership."""
+    owners = (origin + jnp.arange(elements, dtype=jnp.int32)) % n
+    own = owners[None, :] == jnp.arange(n, dtype=jnp.int32)[:, None]
+    w = pack(own)                                      # [n, W]
+    return jnp.concatenate([w, w], axis=1)             # [n, 2W]
+
+
+def _set_universe(elements: int, words2: int) -> jax.Array:
+    """uint32[2W]: the element-universe bits of both planes — keeps
+    every wire transform off the padding bits past ``elements`` (the
+    ops/bitpack never-set contract; a forged padding bit would leak
+    into popcount observability)."""
+    ones = jnp.ones((1, elements), jnp.bool_)
+    w = pack(ones)[0]
+    return jnp.concatenate([w, w])[:words2]
+
+
+def _byz_serve_counter(got, safe, active, gids, byz, n: int):
+    """Render what liar partners SERVE (counter shards [Nl, k, S]) —
+    module comment catalog; non-own columns only."""
+    from gossip_tpu.ops import nemesis as NE
+    kindp = byz.kind[safe][:, :, None]                 # [Nl, k, 1]
+    argp = byz.arg[safe][:, :, None]
+    s = got.shape[-1]
+    col_owner = jnp.arange(s, dtype=jnp.int32) % n
+    nonown = col_owner[None, None, :] != safe[:, :, None]
+    corrupt = jnp.where(nonown, got ^ argp, got)
+    inflate = jnp.where(nonown, got + argp, got)
+    equiv = jnp.where(nonown,
+                      got + argp * (1 + gids[:, None, None]), got)
+    out = jnp.where(kindp == NE.BYZ_CODES["corrupt"], corrupt, got)
+    out = jnp.where(kindp == NE.BYZ_CODES["replay"],
+                    jnp.zeros_like(got), out)
+    out = jnp.where(kindp == NE.BYZ_CODES["equivocate"], equiv, out)
+    out = jnp.where(kindp == NE.BYZ_CODES["inflate"], inflate, out)
+    return jnp.where(active[:, :, None], out, got)
+
+
+def _byz_serve_set(got, safe, active, gids, byz, own_words, universe):
+    """Render what liar partners SERVE (packed set planes
+    [Nl, k, 2W]) — non-own bits only, inside the element universe."""
+    from gossip_tpu.ops import nemesis as NE
+    kindp = byz.kind[safe][:, :, None]
+    argp = byz.arg[safe].astype(jnp.uint32)[:, :, None]
+    foreign = ~own_words[safe] & universe              # [Nl, k, 2W]
+    corrupt = got ^ (argp & foreign)
+    inflate = got | foreign
+    epat = argp ^ (gids.astype(jnp.uint32)
+                   * jnp.uint32(2654435761))[:, None, None]
+    equiv = got ^ (epat & foreign)
+    out = jnp.where(kindp == NE.BYZ_CODES["corrupt"], corrupt, got)
+    out = jnp.where(kindp == NE.BYZ_CODES["replay"],
+                    jnp.zeros_like(got), out)
+    out = jnp.where(kindp == NE.BYZ_CODES["equivocate"], equiv, out)
+    out = jnp.where(kindp == NE.BYZ_CODES["inflate"], inflate, out)
+    return jnp.where(active[:, :, None], out, got)
+
+
+def _unique_valid(safe, valid):
+    """bool[Nl, k]: first occurrence of each distinct valid partner —
+    the quorum dedupe (a partner sampled twice is ONE independent
+    witness, never two)."""
+    k = safe.shape[1]
+    if k == 1:
+        return valid
+    eq = safe[:, :, None] == safe[:, None, :]          # [Nl, j, i]
+    earlier = jnp.tril(jnp.ones((k, k), jnp.bool_), -1)[None]
+    dup = jnp.any(eq & valid[:, None, :] & earlier, axis=2)
+    return valid & ~dup
+
+
+def pull_merge_crdt_byz(cfg: CrdtConfig, rows_all: jax.Array,
+                        partners: jax.Array, sentinel: int, *,
+                        byz, round_, gids: jax.Array, n: int,
+                        origin: int, alive_fn, defend: bool
+                        ) -> jax.Array:
+    """:func:`pull_merge_crdt` under a byzantine program: gather, mask
+    invalid partners to the merge identity, render what each ACTIVE
+    liar partner serves (module comment), then either the honest merge
+    (``defend=False`` — the control arm, provably divergent under
+    forging liars: a forged value above truth sticks under max/OR
+    forever) or the defended admission (owner-column guard for counter
+    shards; owner-direct OR quorum-echo for packed set bits).  A
+    churn-down liar serves nothing — its row is already zeroed by the
+    visibility mask and ``alive_fn`` gates the transform too."""
+    kind = cfg.kind
+    valid = partners < sentinel
+    safe = jnp.minimum(partners, sentinel - 1)
+    got = rows_all[safe]                               # [Nl, k, S]
+    got = jnp.where(valid[:, :, None], got,
+                    jnp.zeros((), rows_all.dtype))
+    from gossip_tpu.ops import nemesis as NE
+    active = (valid & NE.byz_active(byz, safe, round_)
+              & alive_fn(safe, round_))
+    if kind in CRDT_SET_KINDS:
+        own_words = set_owner_words(cfg.elements, n, origin)
+        universe = _set_universe(cfg.elements, rows_all.shape[-1])
+        got = _byz_serve_set(got, safe, active, gids, byz, own_words,
+                             universe)
+        if not defend:
+            out = got[:, 0, :]
+            for j in range(1, got.shape[1]):
+                out = merge_or(out, got[:, j, :])
+            return out
+        # defended: owner-direct bits, plus bits echoed by >= quorum
+        # distinct partners (carry-save counting chain, depth 3 —
+        # ByzConfig caps quorum at 3)
+        uniq = _unique_valid(safe, valid)
+        once = jnp.zeros_like(got[:, 0, :])
+        twice = jnp.zeros_like(once)
+        thrice = jnp.zeros_like(once)
+        direct = jnp.zeros_like(once)
+        for j in range(got.shape[1]):
+            b = jnp.where(uniq[:, j, None], got[:, j, :],
+                          jnp.zeros((), got.dtype))
+            thrice = thrice | (twice & b)
+            twice = twice | (once & b)
+            once = once | b
+            direct = direct | (got[:, j, :] & own_words[safe[:, j]])
+        q = byz.quorum
+        echoed = jnp.where(q <= 1, once,
+                           jnp.where(q == 2, twice, thrice))
+        return direct | echoed
+    # counter shards / vector clocks
+    got = _byz_serve_counter(got, safe, active, gids, byz, n)
+    if defend:
+        # owner-column write guard: from partner p admit only column
+        # p's plane entries (pncounter: both its P and N columns fold
+        # through col % n); max IS the monotonicity clamp
+        s = got.shape[-1]
+        col_owner = jnp.arange(s, dtype=jnp.int32) % n
+        admit = ((col_owner[None, None, :] == safe[:, :, None])
+                 & valid[:, :, None])
+        got = jnp.where(admit, got, jnp.zeros((), got.dtype))
+    out = got[:, 0, :]
+    for j in range(1, got.shape[1]):
+        out = merge_max(out, got[:, j, :])
+    return out
+
+
+# -- honest-component convergence (the byz_conv metric) ----------------
+
+def honest_component_mask(cfg: CrdtConfig, n: int, origin: int,
+                          honest: jax.Array):
+    """The honest-OWNED components of a state row: bool[S] column mask
+    for counter shards, uint32[2W] bit mask for packed sets.  A
+    liar-owned component is excluded from the ``byz_conv`` equality —
+    a liar may withhold (replay) or self-write arbitrarily, both
+    undetectable by construction, so honest convergence is only ever
+    claimable on honest-owned state (docs/ROBUSTNESS.md)."""
+    if cfg.kind in CRDT_SET_KINDS:
+        owners = (origin + jnp.arange(cfg.elements,
+                                      dtype=jnp.int32)) % n
+        w = pack(honest[owners][None, :])[0]
+        return jnp.concatenate([w, w])
+    s = state_width(cfg, n)
+    col_owner = jnp.arange(s, dtype=jnp.int32) % n
+    return honest[col_owner]
+
+
+def byz_converged_count(cfg: CrdtConfig, rows: jax.Array,
+                        truth: jax.Array, alive_honest: jax.Array,
+                        comp_mask) -> jax.Array:
+    """int32 count of honest eventually-alive nodes whose HONEST-owned
+    components equal the ground truth bitwise — the ``byz_conv``
+    numerator (:func:`converged_count` restricted by
+    :func:`honest_component_mask`; divide by the honest eventual-alive
+    total once on the host, the bitwise-curve convention)."""
+    if cfg.kind in CRDT_SET_KINDS:
+        eq = jnp.all((rows & comp_mask[None, :])
+                     == (truth & comp_mask)[None, :], axis=-1)
+    else:
+        eq = jnp.all(jnp.where(comp_mask[None, :],
+                               rows == truth[None, :], True), axis=-1)
+    return jnp.sum(eq & alive_honest, dtype=jnp.int32)
+
+
+def byz_conv_frac(cfg: CrdtConfig, rows: jax.Array, truth: jax.Array,
+                  alive_honest: jax.Array, comp_mask) -> jax.Array:
+    """f32 in-trace byz_conv fraction — RoundMetrics column only (the
+    value_conv_frac rule: pinned readouts use the integer count)."""
+    c = byz_converged_count(cfg, rows, truth, alive_honest,
+                            comp_mask).astype(jnp.float32)
+    return c / jnp.maximum(jnp.sum(alive_honest, dtype=jnp.float32),
+                           1.0)
+
+
 # -- injection lowering (runtime operands, the nemesis pattern) --------
 
 def _pad_pow2(length: int) -> int:

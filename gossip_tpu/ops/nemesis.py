@@ -88,7 +88,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from gossip_tpu.config import ChurnConfig, FaultConfig
+from gossip_tpu.config import (BYZ_CORRUPT, BYZ_EQUIVOCATE, BYZ_INFLATE,
+                               BYZ_REPLAY, ByzConfig, ChurnConfig,
+                               FaultConfig)
 
 # Sentinel round for "never": far beyond any realistic max_rounds but
 # safely below int32 overflow under the +1 arithmetic of round counters.
@@ -100,6 +102,13 @@ def get(fault: Optional[FaultConfig]) -> Optional[ChurnConfig]:
     every kernel factory branches on (FaultConfig normalizes an empty
     ChurnConfig to None, so `get(fault) is None` == static hot path)."""
     return fault.churn if fault is not None else None
+
+
+def get_byz(fault: Optional[FaultConfig]) -> Optional[ByzConfig]:
+    """The byzantine program carried by a fault config, or None — the
+    :func:`get` twin (FaultConfig normalizes an empty ByzConfig to
+    None, so `get_byz(fault) is None` == honest exchange path)."""
+    return fault.byz if fault is not None else None
 
 
 class Schedule:
@@ -451,6 +460,138 @@ def split_tables(ch: Optional[ChurnConfig], tbl: tuple):
             Schedule(*tbl[-N_SCHED_OPERANDS:]))
 
 
+# -- the byzantine program (scripted liars — ByzConfig lowering) -------
+
+# Integer liar-kind codes of the lowered tables (0 = honest; content,
+# never shape — two byz programs of the same n_pad share one compiled
+# loop).  The config-string -> code map is the ONE translation.
+BYZ_HONEST = 0
+BYZ_CODES = {BYZ_CORRUPT: 1, BYZ_REPLAY: 2, BYZ_EQUIVOCATE: 3,
+             BYZ_INFLATE: 4}
+
+# How many trailing step arguments a byzantine program occupies on a
+# factory's ``tables`` tuple (byz_args / split_byz): kind/start/arg
+# int32[n_pad] + the traced quorum scalar.
+N_BYZ_OPERANDS = 4
+
+
+class ByzSchedule:
+    """Device-resident byzantine program (module doc): per-node liar
+    ``kind`` codes (:data:`BYZ_CODES`; 0 honest), the ``start`` round
+    each lie begins (:data:`NEVER` for honest rows), the per-liar
+    transform ``arg``, and the traced ``quorum`` scalar of the defended
+    set kernels.  A registered pytree, like :class:`Schedule`."""
+
+    __slots__ = ("kind", "start", "arg", "quorum")
+
+    def __init__(self, kind, start, arg, quorum):
+        self.kind = kind
+        self.start = start
+        self.arg = arg
+        self.quorum = quorum
+
+
+def _byz_flatten(b):
+    return ((b.kind, b.start, b.arg, b.quorum), None)
+
+
+def _byz_unflatten(_, children):
+    return ByzSchedule(*children)
+
+
+jax.tree_util.register_pytree_node(ByzSchedule, _byz_flatten,
+                                   _byz_unflatten)
+
+
+def build_byz(fault: FaultConfig, n: int,
+              n_pad: Optional[int] = None) -> ByzSchedule:
+    """Lower ``fault.byz`` to the per-node liar tables.  NUMPY
+    construction, converted once (the :func:`_event_tables` rationale:
+    a jnp scatter per distinct liar-list length is a tiny recompile
+    class the staticcheck lint flags); padding rows are honest with
+    ``start = NEVER``."""
+    import numpy as np
+    bz = get_byz(fault)
+    if bz is None:
+        raise ValueError("build_byz() needs a FaultConfig with a byz "
+                         "program (gate on nemesis.get_byz(fault) "
+                         "first)")
+    validate_liars(fault, n)
+    n_pad = n if n_pad is None else n_pad
+    kind = np.zeros((n_pad,), np.int32)
+    start = np.full((n_pad,), NEVER, np.int32)
+    arg = np.zeros((n_pad,), np.int32)
+    for node, rnd, k, a in bz.liars:
+        kind[node] = BYZ_CODES[k]
+        start[node] = rnd
+        arg[node] = a
+    return ByzSchedule(kind=jnp.asarray(kind), start=jnp.asarray(start),
+                       arg=jnp.asarray(arg),
+                       quorum=jnp.asarray(bz.quorum, jnp.int32))
+
+
+def byz_args(bz: ByzSchedule) -> tuple:
+    """The byzantine program as a flat tail of step arguments — the
+    :func:`sched_args` twin.  Table-tail order: topology + injection
+    (+ schedule) (+ byz) — the byz operands ride OUTERMOST so steps
+    peel them first (:func:`split_byz` before :func:`split_tables`)."""
+    return (bz.kind, bz.start, bz.arg, bz.quorum)
+
+
+def byz_of_tables(tbl) -> ByzSchedule:
+    """The ByzSchedule riding a factory's table tail (:func:`byz_args`
+    layout) — the :func:`sched_of_tables` twin."""
+    return ByzSchedule(*tbl[-N_BYZ_OPERANDS:])
+
+
+def split_byz(bz: Optional[ByzConfig], tbl: tuple):
+    """(head_tables, ByzSchedule-or-None): peel the byz operands back
+    off a step's ``*tables`` tail — the ONE inverse of
+    :func:`byz_args`, called BEFORE :func:`split_tables` (the byz tail
+    is outermost)."""
+    if bz is None:
+        return tbl, None
+    return tbl[:-N_BYZ_OPERANDS], ByzSchedule(*tbl[-N_BYZ_OPERANDS:])
+
+
+def validate_liars(fault: FaultConfig, n: int) -> None:
+    """Host-side guard: scripted liars must reference real node ids —
+    an out-of-range liar would silently scatter-drop (corrupt
+    nobody), the validate_events rule."""
+    bz = get_byz(fault)
+    if bz is None:
+        return
+    bad = [a for a in bz.liars if a[0] >= n]
+    if bad:
+        raise ValueError(f"byz liars reference node ids >= n={n}: "
+                         f"{bad}")
+
+
+def honest_mask(fault: Optional[FaultConfig], n: int) -> jax.Array:
+    """bool[n]: True where the node is NOT a scripted liar — the
+    ``byz_conv`` numerator/denominator mask (a liar's convergence is
+    its own business; honest nodes must agree on honest-owned
+    components — docs/ROBUSTNESS.md).  Config-only, host-cheap."""
+    import numpy as np
+    mask = np.ones((n,), bool)
+    bz = get_byz(fault)
+    if bz is not None:
+        for node, _, _, _ in bz.liars:
+            if node < n:
+                mask[node] = False
+    return jnp.asarray(mask)
+
+
+def byz_active(byz: ByzSchedule, nodes, round_) -> jax.Array:
+    """bool[...]: is ``nodes``'s scripted lie active at ``round_``
+    (kind nonzero and the start round reached)?  Broadcasts; callers
+    AND in liveness — a churn-down liar serves nothing, so its lie
+    transforms nothing (the dead-nodes-are-silent contract)."""
+    nodes = jnp.asarray(nodes, jnp.int32)
+    r = jnp.asarray(round_, jnp.int32)
+    return (byz.kind[nodes] != BYZ_HONEST) & (byz.start[nodes] <= r)
+
+
 def validate_events(fault: FaultConfig, n: int) -> None:
     """Host-side guard: scripted churn must reference real node ids —
     an out-of-range event would silently scatter-drop (kill nobody)."""
@@ -649,7 +790,7 @@ def fused_eventual_words(base_words: jax.Array, die_w: jax.Array,
 
 def check_supported(fault: Optional[FaultConfig], *, engine: str,
                     partitions: bool = True, ramp: bool = True,
-                    events: bool = True) -> None:
+                    events: bool = True, byz: bool = False) -> None:
     """Reject schedule features an engine cannot honor — loudly, never
     silently (the no-silent-substitution policy).  Since the operand
     PRs (XLA paths, then the fused Pallas kernels: drop threshold as
@@ -668,7 +809,21 @@ def check_supported(fault: Optional[FaultConfig], *, engine: str,
         ONLY the topo-sparse exchange and the grid config sweeps
         remain (the checkpointed segment drivers came off this list
         when resume grew the fault-program fingerprint +
-        absolute-round contract — utils/checkpoint module doc)."""
+        absolute-round contract — utils/checkpoint module doc);
+      * ``byz=False`` (the default) — an engine that cannot RUN a
+        byzantine liar program: only the crdt-pull and register-pull
+        exchanges render liar transforms and carry the array-form
+        defenses (owner guards / monotonicity clamps / quorum echo —
+        ops/crdt.pull_merge_crdt_byz), so every other engine rejects a
+        ``fault.byz`` loudly.  Checked FIRST: a byz program without a
+        churn schedule must still reject on an unsupported engine."""
+    if get_byz(fault) is not None and not byz:
+        raise ValueError(
+            f"the {engine} engine cannot run a byzantine liar program "
+            "(no receiver-side transform/defense hooks in its "
+            "exchange); run the crdt-pull or register-pull payloads — "
+            "docs/ROBUSTNESS.md \"Byzantine adversaries\" capability "
+            "rows")
     ch = get(fault)
     if ch is None:
         return

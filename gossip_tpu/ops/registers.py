@@ -74,11 +74,13 @@ from gossip_tpu.ops.crdt import (NO_ROUND, _applied_mask, _pad_pow2,
                                  alive_at_fn, converged_count,
                                  eventual_alive_crdt, value_conv_frac)
 
-__all__ = ["N_INJECT_OPERANDS", "alive_at_fn", "converged_count",
-           "eventual_alive_crdt", "ground_truth", "inject_args",
-           "inject_rows", "merge_lww", "pack_ts", "payload_count",
-           "pull_merge_reg", "split_inject", "state_width",
-           "truth_summary", "txn_writes", "value_conv_frac"]
+__all__ = ["N_INJECT_OPERANDS", "alive_at_fn", "byz_conv_frac",
+           "byz_converged_count", "converged_count",
+           "eventual_alive_crdt", "ground_truth", "honest_key_mask",
+           "inject_args", "inject_rows", "merge_lww", "pack_ts",
+           "payload_count", "pull_merge_reg", "pull_merge_reg_byz",
+           "split_inject", "state_width", "truth_summary",
+           "txn_writes", "value_conv_frac"]
 
 # Trailing step arguments the write program occupies on a factory's
 # ``tables`` tuple: (w_node, w_key, w_round, w_val), each padded
@@ -147,6 +149,129 @@ def pull_merge_reg(rows_all: jax.Array, partners: jax.Array,
     for j in range(1, got.shape[1]):
         out = merge_lww(out, got[:, j, :])
     return out
+
+
+# -- byzantine exchange: liar transforms + the owner/clamp defense -----
+#
+# Register twin of ops/crdt's byzantine kernels (module comment there;
+# docs/ROBUSTNESS.md "Byzantine adversaries").  The packed timestamp
+# CARRIES provenance — ``(ts - 1) % n`` is the claimed owner and
+# ``(ts - 1) // n`` the claimed round — so the defense is two integer
+# compares per key: admit an entry from partner p only when p IS the
+# claimed owner (owner-column write guard) and the claimed round is
+# not in the future (the monotonicity clamp — a forged
+# fresher-than-now timestamp is discarded as forged).  Every scripted
+# liar transform forges only entries claimed-owned by OTHER nodes
+# (own-entry lies are legitimate writes by definition — the BFT
+# limitation), so the defended admission rejects all of it, while the
+# undefended ts-max join locks any inflated timestamp in forever.
+
+def _byz_serve_reg(got, safe, active, gids, byz, n: int):
+    """Render what liar partners SERVE (register rows [Nl, k, 2K]):
+    corrupt = foreign nonzero entries get ts + n (claimed round + 1,
+    claimed owner PRESERVED — a plausible forged foreign write) and a
+    value xor'd with arg; replay = the genesis snapshot (all zeros —
+    pure withholding); equivocate = foreign timestamps inflated by a
+    receiver-id-keyed number of rounds; inflate = foreign timestamps
+    raised by ``arg * n`` rounds (the value is left alone — the lie is
+    freshness, which pins the stale value above all later honest
+    writes undefended).  ts == 0 entries are never touched: an
+    unwritten key has no claimed owner to preserve, and fabricating
+    one would alias node n-1's provenance."""
+    from gossip_tpu.ops import nemesis as NE
+    k = got.shape[-1] // 2
+    v, t = got[..., :k], got[..., k:]
+    kindp = byz.kind[safe][:, :, None]                 # [Nl, k, 1]
+    argp = byz.arg[safe][:, :, None]
+    foreign = (t > 0) & (((t - 1) % n) != safe[:, :, None])
+    t_cor = jnp.where(foreign, t + n, t)
+    v_cor = jnp.where(foreign, v ^ argp, v)
+    t_inf = jnp.where(foreign, t + n * argp, t)
+    t_eqv = jnp.where(foreign,
+                      t + n * (1 + (gids[:, None, None] & 3)), t)
+    vv = jnp.where(kindp == NE.BYZ_CODES["corrupt"], v_cor, v)
+    tt = jnp.where(kindp == NE.BYZ_CODES["corrupt"], t_cor, t)
+    vv = jnp.where(kindp == NE.BYZ_CODES["replay"], 0, vv)
+    tt = jnp.where(kindp == NE.BYZ_CODES["replay"], 0, tt)
+    tt = jnp.where(kindp == NE.BYZ_CODES["equivocate"], t_eqv, tt)
+    tt = jnp.where(kindp == NE.BYZ_CODES["inflate"], t_inf, tt)
+    out = jnp.concatenate([vv, tt], axis=-1)
+    return jnp.where(active[:, :, None], out, got)
+
+
+def pull_merge_reg_byz(rows_all: jax.Array, partners: jax.Array,
+                       sentinel: int, *, byz, round_,
+                       gids: jax.Array, n: int, alive_fn,
+                       defend: bool) -> jax.Array:
+    """:func:`pull_merge_reg` under a byzantine program (section
+    comment).  Defended admission per key, from partner p at round r:
+    ``(ts > 0) & ((ts - 1) % n == p) & ((ts - 1) // n <= r)`` — then
+    the LWW join of the admitted entries.  Owner-direct propagation
+    only (honest relayed entries are rejected too — slower, still
+    exact); the control arm ``defend=False`` merges the rendered rows
+    unguarded and provably diverges under any ts-inflating liar."""
+    valid = partners < sentinel
+    safe = jnp.minimum(partners, sentinel - 1)
+    got = rows_all[safe]                              # [Nl, k, 2K]
+    got = jnp.where(valid[:, :, None], got,
+                    jnp.zeros((), rows_all.dtype))
+    from gossip_tpu.ops import nemesis as NE
+    active = (valid & NE.byz_active(byz, safe, round_)
+              & alive_fn(safe, round_))
+    got = _byz_serve_reg(got, safe, active, gids, byz, n)
+    if defend:
+        k = got.shape[-1] // 2
+        v, t = got[..., :k], got[..., k:]
+        r = jnp.asarray(round_, jnp.int32)
+        admit = (valid[:, :, None] & (t > 0)
+                 & (((t - 1) % n) == safe[:, :, None])
+                 & (((t - 1) // n) <= r))
+        got = jnp.concatenate([jnp.where(admit, v, 0),
+                               jnp.where(admit, t, 0)], axis=-1)
+    out = got[:, 0, :]
+    for j in range(1, got.shape[1]):
+        out = merge_lww(out, got[:, j, :])
+    return out
+
+
+# -- honest-component convergence (the byz_conv metric) ----------------
+
+def honest_key_mask(cfg: TxnConfig, inj: tuple, fault, n: int,
+                    origin: int, honest: jax.Array) -> jax.Array:
+    """bool[K]: keys whose ground-truth winner is honest-owned (or
+    never written).  The byz_conv equality is restricted to these — a
+    liar may withhold its own scripted wins (replay) or overwrite its
+    own entries arbitrarily, both undetectable by construction, so
+    honest convergence is only claimable where truth itself is honest
+    (docs/ROBUSTNESS.md).  Built from :func:`_write_plan`'s winning
+    timestamps — the same decomposition as the ground truth."""
+    _, _, best = _write_plan(cfg, inj, fault, n, origin)
+    owner = jnp.where(best > 0, (best - 1) % n, 0)
+    return (best == 0) | honest[owner]
+
+
+def byz_converged_count(cfg: TxnConfig, rows: jax.Array,
+                        truth: jax.Array, alive_honest: jax.Array,
+                        key_mask: jax.Array) -> jax.Array:
+    """int32 count of honest eventually-alive rows equal to truth on
+    every honest-won key, BOTH planes (value and timestamp — the
+    full-row discipline of ``converged_count``): the byz_conv
+    numerator, divided once on the host."""
+    m2 = jnp.concatenate([key_mask, key_mask])
+    eq = jnp.all(jnp.where(m2[None, :], rows == truth[None, :], True),
+                 axis=-1)
+    return jnp.sum(eq & alive_honest, dtype=jnp.int32)
+
+
+def byz_conv_frac(cfg: TxnConfig, rows: jax.Array, truth: jax.Array,
+                  alive_honest: jax.Array,
+                  key_mask: jax.Array) -> jax.Array:
+    """f32 in-trace byz_conv fraction — RoundMetrics column only; the
+    pinned readout is the integer count."""
+    c = byz_converged_count(cfg, rows, truth, alive_honest,
+                            key_mask).astype(jnp.float32)
+    return c / jnp.maximum(jnp.sum(alive_honest, dtype=jnp.float32),
+                           1.0)
 
 
 # -- the skewed default traffic program (closed forms, no RNG) ---------

@@ -95,6 +95,20 @@ payload, ops/registers):
               convergence metric (same in-loop-f32 / integer-readout
               split as ``value_conv``).
 
+Byzantine observables (present when the stack is built with
+``byz=True`` — drivers running a liar program, ops/nemesis
+ByzSchedule):
+
+``byz_conv``  fraction of HONEST eventual-alive nodes whose
+              HONEST-OWNED components (counter columns / set element
+              bits / register keys won by honest writers) equal the
+              honest-masked ground truth after the round — the
+              byzantine-convergence headline (defended runs reach
+              exactly 1.0; the undefended control arm provably
+              diverges — docs/ROBUSTNESS.md "Byzantine adversaries").
+              Same in-loop-f32 / integer-readout split as
+              ``value_conv``.
+
 ``GOSSIP_ROUND_METRICS=0`` (or empty) is the kill switch; metrics are
 also skipped when no run ledger is active (:func:`wanted`) — the
 buffers exist to be ledgered, and dark buffers would tax every test
@@ -143,14 +157,14 @@ class RoundMetrics:
 
     __slots__ = ("cursor", "newly", "dup", "msgs", "bytes", "front",
                  "alive", "cut_pairs", "dropped", "value_conv",
-                 "log_conv", "txn_conv", "label", "nemesis", "crdt",
-                 "log", "txn")
+                 "log_conv", "txn_conv", "byz_conv", "label",
+                 "nemesis", "crdt", "log", "txn", "byz")
 
     def __init__(self, cursor, newly, dup, msgs, bytes, front,
                  alive, cut_pairs, dropped, value_conv, log_conv,
-                 txn_conv, label: str, nemesis: bool = False,
+                 txn_conv, byz_conv, label: str, nemesis: bool = False,
                  crdt: bool = False, log: bool = False,
-                 txn: bool = False):
+                 txn: bool = False, byz: bool = False):
         self.cursor = cursor
         self.newly = newly
         self.dup = dup
@@ -163,11 +177,13 @@ class RoundMetrics:
         self.value_conv = value_conv
         self.log_conv = log_conv
         self.txn_conv = txn_conv
+        self.byz_conv = byz_conv
         self.label = label
         self.nemesis = nemesis
         self.crdt = crdt
         self.log = log
         self.txn = txn
+        self.byz = byz
 
     def _replace(self, **kw):
         fields = {k: getattr(self, k) for k in self.__slots__}
@@ -178,14 +194,14 @@ class RoundMetrics:
 def _rm_flatten(m):
     return ((m.cursor, m.newly, m.dup, m.msgs, m.bytes, m.front,
              m.alive, m.cut_pairs, m.dropped, m.value_conv,
-             m.log_conv, m.txn_conv),
-            (m.label, m.nemesis, m.crdt, m.log, m.txn))
+             m.log_conv, m.txn_conv, m.byz_conv),
+            (m.label, m.nemesis, m.crdt, m.log, m.txn, m.byz))
 
 
 def _rm_unflatten(aux, children):
-    label, nemesis, crdt, log, txn = aux
+    label, nemesis, crdt, log, txn, byz = aux
     return RoundMetrics(*children, label=label, nemesis=nemesis,
-                        crdt=crdt, log=log, txn=txn)
+                        crdt=crdt, log=log, txn=txn, byz=byz)
 
 
 jax.tree_util.register_pytree_node(RoundMetrics, _rm_flatten,
@@ -194,7 +210,8 @@ jax.tree_util.register_pytree_node(RoundMetrics, _rm_flatten,
 
 def init(max_rounds: int, n_shards: int, label: str,
          nemesis: bool = False, crdt: bool = False,
-         log: bool = False, txn: bool = False) -> RoundMetrics:
+         log: bool = False, txn: bool = False,
+         byz: bool = False) -> RoundMetrics:
     """Zeroed buffer stack for up to ``max_rounds`` rounds over
     ``n_shards`` shards (1 for single-device drivers).  Tiny: 9 T + T*S
     floats — at the flagship's T=128, S=8 that is 4 KB.  ``nemesis``
@@ -202,7 +219,8 @@ def init(max_rounds: int, n_shards: int, label: str,
     dropped are recorded and ledgered; zeros otherwise); ``crdt`` marks
     one carrying the value-convergence column, ``log`` one carrying the
     replicated-log convergence column, ``txn`` one carrying the
-    LWW-register convergence column (module doc)."""
+    LWW-register convergence column, ``byz`` one carrying the
+    honest-component byzantine convergence column (module doc)."""
     if max_rounds < 1:
         raise ValueError(f"max_rounds={max_rounds} must be >= 1")
     if n_shards < 1:
@@ -213,22 +231,25 @@ def init(max_rounds: int, n_shards: int, label: str,
                         front=jnp.zeros((max_rounds, n_shards),
                                         jnp.float32),
                         alive=z, cut_pairs=z, dropped=z, value_conv=z,
-                        log_conv=z, txn_conv=z, label=label,
-                        nemesis=nemesis, crdt=crdt, log=log, txn=txn)
+                        log_conv=z, txn_conv=z, byz_conv=z, label=label,
+                        nemesis=nemesis, crdt=crdt, log=log, txn=txn,
+                        byz=byz)
 
 
 def record(m: RoundMetrics, *, newly, dup, msgs, bytes,
            front, alive=None, cut_pairs=None,
            dropped=None, value_conv=None,
-           log_conv=None, txn_conv=None) -> RoundMetrics:
+           log_conv=None, txn_conv=None,
+           byz_conv=None) -> RoundMetrics:
     """Write one round's row at the cursor (in-trace; scatter writes
     only).  The cursor is clamped to the last row so an over-long loop
     can never write out of bounds — by contract the drivers size the
     buffers with ``run.max_rounds``, which also bounds their loops.
     The nemesis columns (alive/cut_pairs/dropped), the CRDT
     ``value_conv`` column, the replicated-log ``log_conv`` column, and
-    the LWW-register ``txn_conv`` column are only written when passed
-    — the static-fault / non-payload recorders never touch them."""
+    the LWW-register ``txn_conv`` column, and the byzantine
+    ``byz_conv`` column are only written when passed — the
+    static-fault / non-payload recorders never touch them."""
     i = jnp.minimum(m.cursor, m.newly.shape[0] - 1)
     f32 = lambda v: jnp.asarray(v, jnp.float32)       # noqa: E731
     kw = {}
@@ -244,6 +265,8 @@ def record(m: RoundMetrics, *, newly, dup, msgs, bytes,
         kw["log_conv"] = m.log_conv.at[i].set(f32(log_conv))
     if txn_conv is not None:
         kw["txn_conv"] = m.txn_conv.at[i].set(f32(txn_conv))
+    if byz_conv is not None:
+        kw["byz_conv"] = m.byz_conv.at[i].set(f32(byz_conv))
     return m._replace(
         cursor=m.cursor + 1,
         newly=m.newly.at[i].set(f32(newly)),
@@ -370,10 +393,11 @@ def emit(out, ledger, fn=None):
     import numpy as np
     for m in stacks:
         (cursor, newly, dup, msgs, bytes_, front, alive, cut_pairs,
-         dropped, value_conv, log_conv, txn_conv) = jax.device_get(
+         dropped, value_conv, log_conv, txn_conv,
+         byz_conv) = jax.device_get(
             (m.cursor, m.newly, m.dup, m.msgs, m.bytes, m.front,
              m.alive, m.cut_pairs, m.dropped, m.value_conv,
-             m.log_conv, m.txn_conv))
+             m.log_conv, m.txn_conv, m.byz_conv))
         r = min(int(cursor), int(newly.shape[0]))
 
         def ser(a, nd=3):
@@ -398,6 +422,11 @@ def emit(out, ledger, fn=None):
             # LWW-register convergence per round (the isolation-layer
             # headline — ops/registers)
             extra["txn_conv"] = ser(txn_conv, nd=4)
+        if m.byz:
+            # honest-component convergence per round under a liar
+            # program (the byzantine headline — defended runs end at
+            # exactly 1.0, the undefended control arm does not)
+            extra["byz_conv"] = ser(byz_conv, nd=4)
         totals = {"newly": round(float(np.sum(newly[:r])), 3),
                   "dup": round(float(np.sum(dup[:r])), 3),
                   "msgs": round(float(np.sum(msgs[:r])), 3),
@@ -413,6 +442,9 @@ def emit(out, ledger, fn=None):
         if m.txn:
             totals["txn_conv_final"] = (
                 round(float(txn_conv[r - 1]), 4) if r else 0.0)
+        if m.byz:
+            totals["byz_conv_final"] = (
+                round(float(byz_conv[r - 1]), 4) if r else 0.0)
         ledger.event(
             "round_metrics", sync=False, driver=m.label, fn=fn,
             rounds=r, shards=int(front.shape[1]),

@@ -34,6 +34,7 @@ from gossip_tpu.config import (CrdtConfig, FaultConfig, ProtocolConfig,
                                RunConfig)
 from gossip_tpu.models import si as si_mod
 from gossip_tpu.models.crdt import (CrdtState, _conv_target_count,
+                                    check_byz_defendable,
                                     check_crdt_mode,
                                     check_injections_reachable,
                                     init_crdt_state, truth_scalar)
@@ -48,10 +49,13 @@ from gossip_tpu.topology.generators import Topology
 def make_sharded_crdt_round(
         cfg: CrdtConfig, proto: ProtocolConfig, topo: Topology,
         mesh: Mesh, fault: Optional[FaultConfig] = None, origin: int = 0,
-        axis_name: str = "nodes", tabled: bool = False):
+        axis_name: str = "nodes", tabled: bool = False,
+        defend: bool = False):
     """``tabled=True`` returns ``(step, tables)`` with padded topology
-    + injection (+ schedule) arrays as step ARGUMENTS (no O(N) jit
-    closure constants — models/swim.py doc)."""
+    + injection (+ schedule) (+ byzantine program) arrays as step
+    ARGUMENTS (no O(N) jit closure constants — models/swim.py doc).
+    ``defend=True`` switches the exchange to the defended admission
+    (ops/crdt byzantine section; models/crdt.py twin)."""
     check_crdt_mode(proto)
     n, k = topo.n, proto.fanout
     if cfg.kind == C.VCLOCK:
@@ -62,8 +66,11 @@ def make_sharded_crdt_round(
     drop_prob = 0.0 if fault is None else fault.drop_prob
     from gossip_tpu.ops import nemesis as NE
     ch = NE.get(fault)
-    # capability row: full schedule feature set on the CRDT fabric
-    NE.check_supported(fault, engine="crdt-pull")
+    bz = NE.get_byz(fault)
+    # capability row: full schedule feature set on the CRDT fabric,
+    # plus the byzantine liar program with array-form defenses
+    NE.check_supported(fault, engine="crdt-pull", byz=True)
+    check_byz_defendable(cfg, fault, k, defend)
 
     have_table = not topo.implicit
     if have_table:
@@ -73,6 +80,7 @@ def make_sharded_crdt_round(
     zero = jnp.zeros((), jnp.int32 if counters else jnp.uint32)
 
     def local_round(val_l, round_, base_key, msgs, *table):
+        table, byzt = NE.split_byz(bz, table)
         table, sched = NE.split_tables(ch, table)
         table, inj = CR.split_inject(cfg, table)
         shard = jax.lax.axis_index(axis_name)
@@ -107,7 +115,13 @@ def make_sharded_crdt_round(
                               partners0, dp, n, force=ch is not None)
         if ch is not None:
             partners = NE.partition_targets(cut, gids, partners, n)
-        pulled = CR.pull_merge_crdt(cfg.kind, rows_all, partners, n)
+        if bz is not None:
+            pulled = CR.pull_merge_crdt_byz(
+                cfg, rows_all, partners, n, byz=byzt, round_=round_,
+                gids=gids, n=n, origin=origin, alive_fn=alive_fn,
+                defend=defend)
+        else:
+            pulled = CR.pull_merge_crdt(cfg.kind, rows_all, partners, n)
         partners = jnp.where(alive_l[:, None], partners, n)
         n_req = jnp.sum(partners < n).astype(jnp.float32)
         if ch is not None:
@@ -134,6 +148,9 @@ def make_sharded_crdt_round(
     if ch is not None:
         in_specs += [rep] * NE.N_SCHED_OPERANDS
         tables = tables + NE.sched_args(NE.build(fault, n, n_pad))
+    if bz is not None:
+        in_specs += [rep] * NE.N_BYZ_OPERANDS
+        tables = tables + NE.byz_args(NE.build_byz(fault, n, n_pad))
 
     out_specs = (sh2, rep, rep) if ch is not None else (sh2, rep)
     mapped = shard_map(local_round, mesh=mesh, in_specs=tuple(in_specs),
@@ -160,14 +177,18 @@ def init_sharded_crdt_state(run: RunConfig, cfg: CrdtConfig,
 
 
 def _crdt_recorder(cfg: CrdtConfig, proto: ProtocolConfig, n: int,
-                   n_pad: int, n_shards: int, truth, eventual_pad):
+                   n_pad: int, n_shards: int, truth, eventual_pad,
+                   byz_extra=None):
     """In-loop metrics row for the CRDT pull kernels (ops/round_metrics
     — the parallel/sharded_packed._packed_recorder twin).  ``newly`` is
     the per-round delta of the merged payload mass (counter mass / set
     bits — monotone under merge, so the delta is exact); ``value_conv``
     is the converged fraction on the eventual-alive set; per-device
     egress is the state all_gather: ``nl * S * 4`` bytes plus the msgs
-    psum."""
+    psum.  Under a liar program ``byz_extra = (component_mask,
+    honest_eventual_pad)`` adds the ``byz_conv`` column — honest-node
+    convergence on honest-owned components (ops/crdt byzantine
+    section)."""
     from gossip_tpu.ops import round_metrics as RM
     s = CR.state_width(cfg, n)
     nl = n_pad // n_shards
@@ -187,6 +208,10 @@ def _crdt_recorder(cfg: CrdtConfig, proto: ProtocolConfig, n: int,
                       dtype=jnp.float32)
         tot = jnp.sum(alive_pad.reshape(n_shards, -1), axis=1,
                       dtype=jnp.float32)
+        if byz_extra is not None:
+            comp_mask, honest_pad = byz_extra
+            kw["byz_conv"] = CR.byz_conv_frac(cfg, s1.val, truth,
+                                              honest_pad, comp_mask)
         return RM.record(
             m, newly=newly, msgs=msgs,
             dup=RM.dup_estimate(offered_per_msg * msgs, newly),
@@ -199,14 +224,15 @@ def _crdt_recorder(cfg: CrdtConfig, proto: ProtocolConfig, n: int,
 
 
 def _sharded_truth_and_alive(cfg: CrdtConfig, tbl, ch, fault, n: int,
-                             n_pad: int, origin: int):
+                             n_pad: int, origin: int, bz=None):
     """(truth row, eventual-alive over padded rows) — truth from the
     TRACED injection operands on the step's table tail (the compiled
     loop carries injection shapes, never content — models/crdt.py
     discipline), shared by both sharded drivers so the metric and the
-    readout agree."""
+    readout agree.  The byz tail (outermost) is peeled first."""
     from gossip_tpu.ops import nemesis as NE
-    head, _ = NE.split_tables(ch, tbl)
+    head, _ = NE.split_byz(bz, tbl)
+    head, _ = NE.split_tables(ch, head)
     _, inj = CR.split_inject(cfg, head)
     truth = CR.ground_truth(cfg, inj, fault, n, origin)
     eventual = _pad_rows(CR.eventual_alive_crdt(fault, n, origin),
@@ -214,16 +240,33 @@ def _sharded_truth_and_alive(cfg: CrdtConfig, tbl, ch, fault, n: int,
     return truth, eventual
 
 
+def _byz_recorder_extra(cfg, fault, bz, n: int, n_pad: int,
+                        origin: int, eventual_pad):
+    """``(component_mask, honest_eventual_pad)`` for the recorders'
+    ``byz_conv`` column, or None without a liar program — the honest
+    masks are numpy-built from the static fault config (constants in
+    the trace, like the liveness predicates)."""
+    if bz is None:
+        return None
+    from gossip_tpu.ops import nemesis as NE
+    honest = NE.honest_mask(fault, n)
+    comp_mask = CR.honest_component_mask(cfg, n, origin, honest)
+    honest_pad = eventual_pad & _pad_rows(honest, n_pad, False)
+    return comp_mask, honest_pad
+
+
 def simulate_curve_crdt_sharded(cfg: CrdtConfig, proto: ProtocolConfig,
                                 topo: Topology, run: RunConfig,
                                 mesh: Mesh,
                                 fault: Optional[FaultConfig] = None,
-                                axis_name: str = "nodes", timing=None):
+                                axis_name: str = "nodes", timing=None,
+                                defend: bool = False):
     """Sharded scan driver: returns ``(value_conv f64[T], msgs f32[T],
     final_state, truth_value)`` — value_conv from the integer converged
     count divided once on the host (models/crdt.py contract).  With an
     active run ledger the scan carries a RoundMetrics stack with the
-    ``value_conv`` column, flushed once by the chokepoint."""
+    ``value_conv`` column (plus ``byz_conv`` under a liar program),
+    flushed once by the chokepoint."""
     import numpy as np
 
     from gossip_tpu.ops import nemesis as NE
@@ -232,8 +275,9 @@ def simulate_curve_crdt_sharded(cfg: CrdtConfig, proto: ProtocolConfig,
     check_injections_reachable(cfg, run)
     step, tables = make_sharded_crdt_round(cfg, proto, topo, mesh, fault,
                                            run.origin, axis_name,
-                                           tabled=True)
+                                           tabled=True, defend=defend)
     ch = NE.get(fault)
+    bz = NE.get_byz(fault)
     n = topo.n
     n_pad = pad_to_mesh(n, mesh, axis_name)
     n_shards = mesh.shape[axis_name]
@@ -243,12 +287,17 @@ def simulate_curve_crdt_sharded(cfg: CrdtConfig, proto: ProtocolConfig,
     @jax.jit
     def scan(state, *tbl):
         truth, eventual = _sharded_truth_and_alive(cfg, tbl, ch, fault,
-                                                   n, n_pad, run.origin)
+                                                   n, n_pad, run.origin,
+                                                   bz)
+        byz_extra = _byz_recorder_extra(cfg, fault, bz, n, n_pad,
+                                        run.origin, eventual)
         rec = (_crdt_recorder(cfg, proto, n, n_pad, n_shards, truth,
-                              eventual) if RM.wanted() else None)
+                              eventual, byz_extra)
+               if RM.wanted() else None)
         m0 = (RM.init(run.max_rounds, n_shards,
                       "simulate_curve_crdt_sharded",
-                      nemesis=ch is not None, crdt=True)
+                      nemesis=ch is not None, crdt=True,
+                      byz=bz is not None)
               if rec else None)
         c0 = CR.payload_count(cfg, state.val, eventual) if rec else None
 
@@ -261,8 +310,8 @@ def simulate_curve_crdt_sharded(cfg: CrdtConfig, proto: ProtocolConfig,
                 s, lo = step(s0, *tbl), None
             if m is not None:
                 m, cnt = rec(m, cnt, round0, msgs0, s, eventual,
-                             nem=(obs(round0, lo,
-                                      NE.sched_of_tables(tbl))
+                             nem=(obs(round0, lo, NE.sched_of_tables(
+                                      NE.split_byz(bz, tbl)[0]))
                                   if obs else None))
             return (s, m, cnt), (
                 CR.converged_count(s.val, truth, eventual), s.msgs)
@@ -288,7 +337,8 @@ def simulate_until_crdt_sharded(cfg: CrdtConfig, proto: ProtocolConfig,
                                 topo: Topology, run: RunConfig,
                                 mesh: Mesh,
                                 fault: Optional[FaultConfig] = None,
-                                axis_name: str = "nodes", timing=None):
+                                axis_name: str = "nodes", timing=None,
+                                defend: bool = False):
     """Sharded while_loop driver: ``(rounds, value_conv, msgs,
     final_state, truth_value)`` — the loop cond is the exact integer
     converged-count compare (models/crdt._conv_target_count)."""
@@ -300,8 +350,9 @@ def simulate_until_crdt_sharded(cfg: CrdtConfig, proto: ProtocolConfig,
     check_injections_reachable(cfg, run)
     step, tables = make_sharded_crdt_round(cfg, proto, topo, mesh, fault,
                                            run.origin, axis_name,
-                                           tabled=True)
+                                           tabled=True, defend=defend)
     ch = NE.get(fault)
+    bz = NE.get_byz(fault)
     n = topo.n
     n_pad = pad_to_mesh(n, mesh, axis_name)
     n_shards = mesh.shape[axis_name]
@@ -315,12 +366,17 @@ def simulate_until_crdt_sharded(cfg: CrdtConfig, proto: ProtocolConfig,
     @jax.jit
     def loop(state, *tbl):
         truth, eventual = _sharded_truth_and_alive(cfg, tbl, ch, fault,
-                                                   n, n_pad, run.origin)
+                                                   n, n_pad, run.origin,
+                                                   bz)
+        byz_extra = _byz_recorder_extra(cfg, fault, bz, n, n_pad,
+                                        run.origin, eventual)
         rec = (_crdt_recorder(cfg, proto, n, n_pad, n_shards, truth,
-                              eventual) if RM.wanted() else None)
+                              eventual, byz_extra)
+               if RM.wanted() else None)
         m0 = (RM.init(run.max_rounds, n_shards,
                       "simulate_until_crdt_sharded",
-                      nemesis=ch is not None, crdt=True)
+                      nemesis=ch is not None, crdt=True,
+                      byz=bz is not None)
               if rec else None)
         c0 = CR.payload_count(cfg, state.val, eventual) if rec else None
 
@@ -338,8 +394,8 @@ def simulate_until_crdt_sharded(cfg: CrdtConfig, proto: ProtocolConfig,
                 s, lo = step(s0, *tbl), None
             if m is not None:
                 m, cnt = rec(m, cnt, round0, msgs0, s, eventual,
-                             nem=(obs(round0, lo,
-                                      NE.sched_of_tables(tbl))
+                             nem=(obs(round0, lo, NE.sched_of_tables(
+                                      NE.split_byz(bz, tbl)[0]))
                                   if obs else None))
             return s, m, cnt
 

@@ -46,19 +46,27 @@ from gossip_tpu.topology.generators import Topology
 def make_sharded_register_round(
         cfg: TxnConfig, proto: ProtocolConfig, topo: Topology,
         mesh: Mesh, fault: Optional[FaultConfig] = None, origin: int = 0,
-        axis_name: str = "nodes", tabled: bool = False):
+        axis_name: str = "nodes", tabled: bool = False,
+        defend: bool = False):
     """``tabled=True`` returns ``(step, tables)`` with padded topology
-    + write (+ schedule) arrays as step ARGUMENTS (no O(N) jit
-    closure constants — models/swim.py doc)."""
+    + write (+ schedule) (+ byzantine program) arrays as step
+    ARGUMENTS (no O(N) jit closure constants — models/swim.py doc).
+    ``defend=True`` switches the exchange to the owner/clamp-defended
+    admission (ops/registers byzantine section)."""
     check_txn_mode(proto)
     n, k = topo.n, proto.fanout
     n_pad = pad_to_mesh(n, mesh, axis_name)
     nl = n_pad // mesh.shape[axis_name]
     drop_prob = 0.0 if fault is None else fault.drop_prob
+    from gossip_tpu.models.crdt import check_byz_defendable
     from gossip_tpu.ops import nemesis as NE
     ch = NE.get(fault)
-    # capability row: full schedule feature set on the register fabric
-    NE.check_supported(fault, engine="txn-pull")
+    bz = NE.get_byz(fault)
+    # capability row: full schedule feature set on the register
+    # fabric, plus the byzantine liar program with the owner/clamp
+    # defense
+    NE.check_supported(fault, engine="txn-pull", byz=True)
+    check_byz_defendable(None, fault, k, defend)
 
     have_table = not topo.implicit
     if have_table:
@@ -67,6 +75,7 @@ def make_sharded_register_round(
     zero = jnp.zeros((), jnp.int32)
 
     def local_round(val_l, round_, base_key, msgs, *table):
+        table, byzt = NE.split_byz(bz, table)
         table, sched = NE.split_tables(ch, table)
         table, inj = RG.split_inject(cfg, table)
         shard = jax.lax.axis_index(axis_name)
@@ -99,7 +108,14 @@ def make_sharded_register_round(
                               partners0, dp, n, force=ch is not None)
         if ch is not None:
             partners = NE.partition_targets(cut, gids, partners, n)
-        pulled = RG.pull_merge_reg(rows_all, partners, n)
+        if bz is not None:
+            pulled = RG.pull_merge_reg_byz(
+                rows_all, partners, n, byz=byzt, round_=round_,
+                gids=gids, n=n,
+                alive_fn=RG.alive_at_fn(fault, n, origin),
+                defend=defend)
+        else:
+            pulled = RG.pull_merge_reg(rows_all, partners, n)
         partners = jnp.where(alive_l[:, None], partners, n)
         n_req = jnp.sum(partners < n).astype(jnp.float32)
         if ch is not None:
@@ -126,6 +142,9 @@ def make_sharded_register_round(
     if ch is not None:
         in_specs += [rep] * NE.N_SCHED_OPERANDS
         tables = tables + NE.sched_args(NE.build(fault, n, n_pad))
+    if bz is not None:
+        in_specs += [rep] * NE.N_BYZ_OPERANDS
+        tables = tables + NE.byz_args(NE.build_byz(fault, n, n_pad))
 
     out_specs = (sh2, rep, rep) if ch is not None else (sh2, rep)
     mapped = shard_map(local_round, mesh=mesh, in_specs=tuple(in_specs),
@@ -152,13 +171,18 @@ def init_sharded_reg_state(run: RunConfig, cfg: TxnConfig,
 
 
 def _txn_recorder(cfg: TxnConfig, proto: ProtocolConfig, n: int,
-                  n_pad: int, n_shards: int, truth, eventual_pad):
+                  n_pad: int, n_shards: int, truth, eventual_pad,
+                  byz_extra=None):
     """In-loop metrics row for the register pull kernels — the
     parallel/sharded_log._log_recorder twin.  ``newly`` is the
     per-round delta of the merged timestamp mass (monotone under the
     LWW join where the value plane is not, so the delta is exact);
     ``txn_conv`` is the converged fraction on the eventual-alive set;
-    per-device egress is the state all_gather plus the msgs psum."""
+    per-device egress is the state all_gather plus the msgs psum.
+    Under a liar program ``byz_extra = (honest_key_mask,
+    honest_eventual_pad)`` adds the ``byz_conv`` column — honest-node
+    convergence on honest-won keys (ops/registers byzantine
+    section)."""
     from gossip_tpu.ops import round_metrics as RM
     s = RG.state_width(cfg)
     nl = n_pad // n_shards
@@ -176,6 +200,10 @@ def _txn_recorder(cfg: TxnConfig, proto: ProtocolConfig, n: int,
                       dtype=jnp.float32)
         tot = jnp.sum(alive_pad.reshape(n_shards, -1), axis=1,
                       dtype=jnp.float32)
+        if byz_extra is not None:
+            key_mask, honest_pad = byz_extra
+            kw["byz_conv"] = RG.byz_conv_frac(cfg, s1.val, truth,
+                                              honest_pad, key_mask)
         return RM.record(
             m, newly=newly, msgs=msgs,
             dup=RM.dup_estimate(offered_per_msg * msgs, newly),
@@ -188,24 +216,43 @@ def _txn_recorder(cfg: TxnConfig, proto: ProtocolConfig, n: int,
 
 
 def _sharded_truth_and_alive(cfg: TxnConfig, tbl, ch, fault, n: int,
-                             n_pad: int, origin: int):
-    """(truth row, eventual-alive over padded rows) — truth from the
-    TRACED write operands on the step's table tail, shared by both
-    sharded drivers so the metric and the readout agree."""
+                             n_pad: int, origin: int, bz=None):
+    """(truth row, eventual-alive over padded rows, write operands) —
+    truth from the TRACED write operands on the step's table tail,
+    shared by both sharded drivers so the metric and the readout
+    agree.  The byz tail (outermost) is peeled first."""
     from gossip_tpu.ops import nemesis as NE
-    head, _ = NE.split_tables(ch, tbl)
+    head, _ = NE.split_byz(bz, tbl)
+    head, _ = NE.split_tables(ch, head)
     _, inj = RG.split_inject(cfg, head)
     truth = RG.ground_truth(cfg, inj, fault, n, origin)
     eventual = _pad_rows(RG.eventual_alive_crdt(fault, n, origin),
                          n_pad, False)
-    return truth, eventual
+    return truth, eventual, inj
+
+
+def _byz_recorder_extra(cfg, fault, bz, n: int, n_pad: int,
+                        origin: int, eventual_pad, inj):
+    """``(honest_key_mask, honest_eventual_pad)`` for the recorders'
+    ``byz_conv`` column, or None without a liar program — the key mask
+    comes from the TRACED write operands (ops/registers
+    .honest_key_mask: the same _write_plan decomposition as ground
+    truth)."""
+    if bz is None:
+        return None
+    from gossip_tpu.ops import nemesis as NE
+    honest = NE.honest_mask(fault, n)
+    key_mask = RG.honest_key_mask(cfg, inj, fault, n, origin, honest)
+    honest_pad = eventual_pad & _pad_rows(honest, n_pad, False)
+    return key_mask, honest_pad
 
 
 def simulate_curve_txn_sharded(cfg: TxnConfig, proto: ProtocolConfig,
                                topo: Topology, run: RunConfig,
                                mesh: Mesh,
                                fault: Optional[FaultConfig] = None,
-                               axis_name: str = "nodes", timing=None):
+                               axis_name: str = "nodes", timing=None,
+                               defend: bool = False):
     """Sharded scan driver: returns ``(txn_conv f64[T], msgs f32[T],
     final_state, truth_summary)`` — txn_conv from the integer
     converged count divided once on the host (models/register.py
@@ -218,8 +265,10 @@ def simulate_curve_txn_sharded(cfg: TxnConfig, proto: ProtocolConfig,
     check_writes_reachable(cfg, run)
     step, tables = make_sharded_register_round(cfg, proto, topo, mesh,
                                                fault, run.origin,
-                                               axis_name, tabled=True)
+                                               axis_name, tabled=True,
+                                               defend=defend)
     ch = NE.get(fault)
+    bz = NE.get_byz(fault)
     n = topo.n
     n_pad = pad_to_mesh(n, mesh, axis_name)
     n_shards = mesh.shape[axis_name]
@@ -228,13 +277,17 @@ def simulate_curve_txn_sharded(cfg: TxnConfig, proto: ProtocolConfig,
 
     @jax.jit
     def scan(state, *tbl):
-        truth, eventual = _sharded_truth_and_alive(cfg, tbl, ch, fault,
-                                                   n, n_pad, run.origin)
+        truth, eventual, inj = _sharded_truth_and_alive(
+            cfg, tbl, ch, fault, n, n_pad, run.origin, bz)
+        byz_extra = _byz_recorder_extra(cfg, fault, bz, n, n_pad,
+                                        run.origin, eventual, inj)
         rec = (_txn_recorder(cfg, proto, n, n_pad, n_shards, truth,
-                             eventual) if RM.wanted() else None)
+                             eventual, byz_extra)
+               if RM.wanted() else None)
         m0 = (RM.init(run.max_rounds, n_shards,
                       "simulate_curve_txn_sharded",
-                      nemesis=ch is not None, txn=True)
+                      nemesis=ch is not None, txn=True,
+                      byz=bz is not None)
               if rec else None)
         c0 = RG.payload_count(cfg, state.val, eventual) if rec else None
 
@@ -247,8 +300,8 @@ def simulate_curve_txn_sharded(cfg: TxnConfig, proto: ProtocolConfig,
                 s, lo = step(s0, *tbl), None
             if m is not None:
                 m, cnt = rec(m, cnt, round0, msgs0, s, eventual,
-                             nem=(obs(round0, lo,
-                                      NE.sched_of_tables(tbl))
+                             nem=(obs(round0, lo, NE.sched_of_tables(
+                                      NE.split_byz(bz, tbl)[0]))
                                   if obs else None))
             return (s, m, cnt), (
                 RG.converged_count(s.val, truth, eventual), s.msgs)
@@ -274,7 +327,8 @@ def simulate_until_txn_sharded(cfg: TxnConfig, proto: ProtocolConfig,
                                topo: Topology, run: RunConfig,
                                mesh: Mesh,
                                fault: Optional[FaultConfig] = None,
-                               axis_name: str = "nodes", timing=None):
+                               axis_name: str = "nodes", timing=None,
+                               defend: bool = False):
     """Sharded while_loop driver: ``(rounds, txn_conv, msgs,
     final_state, truth_summary)`` — the loop cond is the exact integer
     converged-count compare."""
@@ -286,8 +340,10 @@ def simulate_until_txn_sharded(cfg: TxnConfig, proto: ProtocolConfig,
     check_writes_reachable(cfg, run)
     step, tables = make_sharded_register_round(cfg, proto, topo, mesh,
                                                fault, run.origin,
-                                               axis_name, tabled=True)
+                                               axis_name, tabled=True,
+                                               defend=defend)
     ch = NE.get(fault)
+    bz = NE.get_byz(fault)
     n = topo.n
     n_pad = pad_to_mesh(n, mesh, axis_name)
     n_shards = mesh.shape[axis_name]
@@ -300,13 +356,17 @@ def simulate_until_txn_sharded(cfg: TxnConfig, proto: ProtocolConfig,
 
     @jax.jit
     def loop(state, *tbl):
-        truth, eventual = _sharded_truth_and_alive(cfg, tbl, ch, fault,
-                                                   n, n_pad, run.origin)
+        truth, eventual, inj = _sharded_truth_and_alive(
+            cfg, tbl, ch, fault, n, n_pad, run.origin, bz)
+        byz_extra = _byz_recorder_extra(cfg, fault, bz, n, n_pad,
+                                        run.origin, eventual, inj)
         rec = (_txn_recorder(cfg, proto, n, n_pad, n_shards, truth,
-                             eventual) if RM.wanted() else None)
+                             eventual, byz_extra)
+               if RM.wanted() else None)
         m0 = (RM.init(run.max_rounds, n_shards,
                       "simulate_until_txn_sharded",
-                      nemesis=ch is not None, txn=True)
+                      nemesis=ch is not None, txn=True,
+                      byz=bz is not None)
               if rec else None)
         c0 = RG.payload_count(cfg, state.val, eventual) if rec else None
 
@@ -324,8 +384,8 @@ def simulate_until_txn_sharded(cfg: TxnConfig, proto: ProtocolConfig,
                 s, lo = step(s0, *tbl), None
             if m is not None:
                 m, cnt = rec(m, cnt, round0, msgs0, s, eventual,
-                             nem=(obs(round0, lo,
-                                      NE.sched_of_tables(tbl))
+                             nem=(obs(round0, lo, NE.sched_of_tables(
+                                      NE.split_byz(bz, tbl)[0]))
                                   if obs else None))
             return s, m, cnt
 

@@ -733,6 +733,9 @@ async def run_txn_workload(n: int, ops: int, rate: float = 50.0,
                                    and verdict["ok"])
         out["anomalies"] = {"g0": len(verdict["g0"]),
                             "g1a": len(verdict["g1a"]),
+                            "g1b": len(verdict["g1b"]),
+                            "g1c": len(verdict["g1c"]),
+                            "lost_update": len(verdict["lost_update"]),
                             "defects": len(verdict["defects"])}
         out["g0_ok"] = not verdict["g0"]
         out["g1a_ok"] = not verdict["g1a"]

@@ -26,6 +26,25 @@ portable phenomena, the classes Jepsen's Elle checks first):
     visible; a client-side timeout is INDETERMINATE (the Maelstrom
     info-timeout convention — the txn may have applied with its ack
     lost) and its writes are legitimate reads, never G1a.
+  * **G1b (intermediate read)** — a committed transaction read a
+    value some OTHER transaction overwrote within itself: the writer
+    wrote the same key again later in its own micro-op list, so only
+    the final write is ever a committed version.  A transaction
+    reading its own in-progress write is internal program order, not
+    an isolation phenomenon — self-reads never flag.
+  * **G1c (circular information flow)** — a cycle in ww ∪ wr that a
+    read-depends edge closes (a ww-only cycle is already G0).  wr
+    edges attribute each committed read to its unique writer; aborted
+    writers are excluded — reading one is G1a, not information flow.
+  * **lost update** — two COMMITTED transactions both read the same
+    (key, pre-value) — ``None`` meaning the initial state — and both
+    wrote that key: one update was computed from a version the
+    other's write superseded.  REPORTED but excluded from ``ok``:
+    the LWW register claims read-committed, not snapshot isolation,
+    and losing concurrent updates across a partition is its
+    documented merge semantics — the list is surfaced so captures
+    can pin its presence or absence, never treated as a violation of
+    a claim the system does not make.
 
 Trace format (built by runtime/maelstrom_harness.run_txn_workload, or
 synthesized by tests): a list of transaction records
@@ -46,9 +65,10 @@ path, and the unit tests that plant anomalies.
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["check_txn_trace", "ww_edges"]
+__all__ = ["check_txn_trace", "ww_edges", "wr_edges"]
 
 
 def _writer_index(txns) -> Tuple[Dict[object, dict], list]:
@@ -127,16 +147,76 @@ def _find_cycle(edges) -> Optional[List[int]]:
     return None
 
 
+def wr_edges(txns) -> List[Tuple[int, int, object]]:
+    """The read-depends edges: ``(writer_id, reader_id, key)`` whenever
+    a COMMITTED transaction read a value some other transaction wrote
+    (unique write values attribute each read to exactly one writer).
+    Aborted writers are excluded — a committed read of one is G1a, not
+    information flow — and self-reads carry no cross-txn dependency."""
+    by_value, _ = _writer_index(txns)
+    edges = []
+    for t in txns:
+        if t.get("status") != "committed":
+            continue
+        for key, value in t.get("reads", ()):
+            if value is None:
+                continue
+            w = by_value.get(value)
+            if (w is None or w["id"] == t["id"]
+                    or w.get("status") == "aborted"):
+                continue
+            edges.append((w["id"], t["id"], key))
+    return edges
+
+
+def _cycle_through(edges, start: int, nxt: int) -> Optional[List[int]]:
+    """A closed walk ``[start, nxt, ..., start]`` that returns from
+    ``nxt`` to ``start`` over ``edges``, or None — BFS, so the
+    reported cycle is the shortest one the (start → nxt) edge closes."""
+    adj: Dict[int, list] = {}
+    for a, b, _ in edges:
+        adj.setdefault(a, []).append(b)
+    if nxt == start:
+        return [start, start]
+    parent: Dict[int, int] = {}
+    seen = {nxt}
+    q = deque([nxt])
+    while q:
+        cur = q.popleft()
+        for m in adj.get(cur, ()):
+            if m == start:
+                back = [cur]
+                while back[-1] != nxt:
+                    back.append(parent[back[-1]])
+                back.reverse()
+                return [start] + back + [start]
+            if m not in seen:
+                seen.add(m)
+                parent[m] = cur
+                q.append(m)
+    return None
+
+
 def check_txn_trace(txns, final_reads: Optional[Dict] = None) -> dict:
     """Classify the trace; returns
 
-    ``{"ok": bool, "g0": [...], "g1a": [...], "defects": [...],
+    ``{"ok": bool, "g0": [...], "g1a": [...], "g1b": [...],
+    "g1c": [...], "lost_update": [...], "defects": [...],
     "committed": int, "aborted": int, "indeterminate": int}``
 
     * ``g0``: each entry a dict with the offending txn-id cycle and
       the keys whose version orders close it;
     * ``g1a``: each entry ``{"reader": id, "key": k, "value": v,
       "writer": id}`` — a committed read of an aborted write;
+    * ``g1b``: each entry adds ``"final"`` — a committed read of a
+      write the writing transaction itself overwrote (intermediate
+      state; self-reads never flag);
+    * ``g1c``: a witness cycle in ww ∪ wr closed by a wr edge
+      (``{"cycle": [...], "wr_edge": [w, r, key]}``);
+    * ``lost_update``: ``{"key": k, "pre": v, "txns": [ids]}`` —
+      committed read-modify-writes of the same version; REPORTED but
+      excluded from ``ok`` (LWW read-committed loses concurrent
+      updates by design — see module docstring);
     * ``defects``: trace-integrity problems that would make the
       verdict unsound (duplicate write values, same-key timestamp
       collisions) — reported separately so a broken harness can never
@@ -191,7 +271,61 @@ def check_txn_trace(txns, final_reads: Optional[Dict] = None) -> dict:
                 g1a.append({"reader": t["id"], "key": key,
                             "value": value, "writer": writer["id"]})
 
-    out = {"g0": g0, "g1a": g1a, "defects": defects,
+    # -- G1b: committed reads of intermediate writes -------------------
+    # Only a transaction's LAST write to a key is ever a committed
+    # version; a foreign read of an earlier one observed state that
+    # never existed between transactions.  Self-reads are program
+    # order (a txn reading its own in-progress write), never flagged.
+    g1b = []
+    for t in txns:
+        if t.get("status") != "committed":
+            continue
+        for key, value in t.get("reads", ()):
+            if value is None:
+                continue
+            writer = by_value.get(value)
+            if (writer is None or writer["id"] == t["id"]
+                    or writer.get("status") == "aborted"):
+                continue        # unattributed, self, or already G1a
+            same_key = [w["value"] for w in writer.get("writes", ())
+                        if w["key"] == key]
+            if value in same_key and same_key[-1] != value:
+                g1b.append({"reader": t["id"], "writer": writer["id"],
+                            "key": key, "value": value,
+                            "final": same_key[-1]})
+
+    # -- G1c: circular information flow --------------------------------
+    # A cycle in ww ∪ wr that a read-depends edge closes; ww-only
+    # cycles are already G0, so each candidate starts from a wr edge.
+    g1c = []
+    wr = wr_edges(txns)
+    for a, b, key in wr:
+        cyc = _cycle_through(edges + wr, a, b)
+        if cyc is not None:
+            g1c.append({"cycle": cyc, "wr_edge": [a, b, str(key)]})
+            break               # one witness cycle, like G0
+
+    # -- lost update: two committed read-modify-writes of one version --
+    # Reported, not folded into ``ok`` (see module docstring): LWW
+    # read-committed registers lose concurrent updates by design.
+    lost_update = []
+    rmw: Dict[Tuple[object, object], List[int]] = {}
+    for t in txns:
+        if t.get("status") != "committed":
+            continue
+        wrote = {w["key"] for w in t.get("writes", ())}
+        for key, value in t.get("reads", ()):
+            if key in wrote:
+                rmw.setdefault((key, value), []).append(t["id"])
+    for (key, pre), ids in sorted(rmw.items(),
+                                  key=lambda kv: (str(kv[0][0]),
+                                                  str(kv[0][1]))):
+        ids = sorted(set(ids))
+        if len(ids) >= 2:
+            lost_update.append({"key": key, "pre": pre, "txns": ids})
+
+    out = {"g0": g0, "g1a": g1a, "g1b": g1b, "g1c": g1c,
+           "lost_update": lost_update, "defects": defects,
            "committed": sum(1 for t in txns
                             if t.get("status") == "committed"),
            "aborted": sum(1 for t in txns
@@ -243,6 +377,6 @@ def check_txn_trace(txns, final_reads: Optional[Dict] = None) -> dict:
                     lww_ok = False
         out["converged"] = bool(agree and lww_ok)
 
-    out["ok"] = not (g0 or g1a or defects) and out.get("converged",
-                                                       True)
+    out["ok"] = (not (g0 or g1a or g1b or g1c or defects)
+                 and out.get("converged", True))
     return out

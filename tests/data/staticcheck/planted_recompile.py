@@ -39,6 +39,28 @@ def _cached_clean_loop(fault_static, n):
     return jax.jit(lambda x: x * n)
 
 
+@functools.lru_cache(maxsize=8)
+def _cached_byz_loop(liars, quorum, n):
+    """Executable builder keyed on liar-program content — one compiled
+    program per adversary scenario (liar content is table-tail DATA,
+    never shape — ops/nemesis byz_args)."""
+    return jax.jit(lambda x: x * n)       # both byz params MUST FLAG
+
+
+@functools.lru_cache(maxsize=8)
+def _cached_byz_clean_loop(byz_static, n):
+    """The declared-static escape on the byz vocabulary: must NOT
+    flag."""
+    return jax.jit(lambda x: x * n)
+
+
+@functools.lru_cache(maxsize=8)
+def _cached_byz_values(byz, n):
+    """Caches eager VALUES (no jit in body) keyed on a byz param: the
+    build_byz table-lowering pattern itself — must NOT flag."""
+    return tuple(range(n))
+
+
 def request_nested(specs):
     """A violation inside a nested helper must count ONCE even though
     both the enclosing walk and the nested def's own root cover it

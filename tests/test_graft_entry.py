@@ -51,8 +51,10 @@ FAMILIES = frozenset({
     "scale_plan", "scale_stream_overlap", "sparse_antientropy",
     "topo_sparse_antientropy", "swim_rotating", "halo_banded",
     "fused_planes", "fused_planes_fault_curve", "rumor_sir",
-    "hybrid_2d_sweep", "cost_attribution"})
-# the committed r23 record predates the observability PR's
+    "hybrid_2d_sweep", "cost_attribution", "byzantine_conv"})
+# the committed r24 record predates the byzantine-nemesis PR's
+# byzantine_conv family; the committed r23 record predates the
+# observability PR's
 # cost_attribution family; the committed r22 record predates the
 # pipelined-streaming PR's
 # scale_stream_overlap family; the committed r21 record predates the
@@ -72,7 +74,8 @@ FAMILIES = frozenset({
 # predate the compiled-nemesis PR's churn_heal family and the
 # traced-operand PR's churn_sweep family — each pin stays on its
 # historical set
-FAMILIES_PRE_COST = FAMILIES - {"cost_attribution"}
+FAMILIES_PRE_BYZ = FAMILIES - {"byzantine_conv"}
+FAMILIES_PRE_COST = FAMILIES_PRE_BYZ - {"cost_attribution"}
 FAMILIES_PRE_OVERLAP = FAMILIES_PRE_COST - {"scale_stream_overlap"}
 FAMILIES_PRE_TRACE = FAMILIES_PRE_OVERLAP - {"request_trace"}
 FAMILIES_PRE_MESH = FAMILIES_PRE_TRACE - {"mesh_serving"}
@@ -573,27 +576,56 @@ def test_committed_r23_4dev_record_carries_stream_overlap():
 
 def test_committed_r24_4dev_record_carries_cost_attribution():
     """The observability PR's committed 4-device record
-    (artifacts/ledger_dryrun_r24_4dev.jsonl, the ledger_diff gate
-    baseline since r24): cold+warm pair, FULL current family set —
-    cost_attribution included (a tiny probe acquired through the
-    utils/compile_cache.load_or_compile chokepoint plus a salted
-    fresh-closure re-entry, the self-attribution assertions running
-    inside the body against its own ledger).  The family sits with
-    request_trace outside the plain-jit all-hit proof: its compiles
-    travel the AOT chokepoint, invisible to the persistent-cache
-    monitor (warm ``compile`` event cache="none"); its warm-start
-    proof is the chokepoint's OWN ``xla_compile`` hit verdicts,
-    asserted below.  Steady and warm budgets held, >= 3x warm-start
-    aggregate, provenance present."""
+    (artifacts/ledger_dryrun_r24_4dev.jsonl): cold+warm pair on its
+    historical family set — cost_attribution included (a tiny probe
+    acquired through the utils/compile_cache.load_or_compile
+    chokepoint plus a salted fresh-closure re-entry), byzantine_conv
+    not yet.  The family sits with request_trace outside the plain-jit
+    all-hit proof: its compiles travel the AOT chokepoint, invisible
+    to the persistent-cache monitor (warm ``compile`` event
+    cache="none"); its warm-start proof is the chokepoint's OWN
+    ``xla_compile`` hit verdicts, asserted below.  (The live
+    ledger_diff gate baseline moved to the r25 record below when the
+    byzantine-nemesis PR grew the family set.)"""
     path = os.path.join(_REPO, "artifacts",
                         "ledger_dryrun_r24_4dev.jsonl")
     _assert_cold_warm_record(
-        path, FAMILIES,
+        path, FAMILIES_PRE_BYZ,
         host_only=frozenset({"request_trace", "cost_attribution"}))
     # the chokepoint's own attribution events carry the warm proof:
     # cold leg = (miss, hit) — forced first compile, salted re-entry
     # HIT in the same process; warm leg = (hit, hit) — the store
     # served the executable across processes
+    all_events = telemetry.load_ledger(path)
+    run_ids = telemetry_report.runs(all_events)
+    per_run = []
+    for rid in run_ids:
+        per_run.append([e["cache"] for e in all_events
+                        if e.get("run") == rid
+                        and e.get("ev") == "xla_compile"
+                        and e.get("label") == "cost_probe"])
+    assert per_run == [["miss", "hit"], ["hit", "hit"]]
+
+
+def test_committed_r25_4dev_record_carries_byzantine_conv():
+    """The byzantine-nemesis PR's committed 4-device record
+    (artifacts/ledger_dryrun_r25_4dev.jsonl, the ledger_diff gate
+    baseline since r25): cold+warm pair, FULL current family set —
+    byzantine_conv included (the DEFENDED sharded CRDT step under a
+    MIXED nemesis: fail-stop churn + partition + ramp PLUS a scripted
+    liar program, defenses on; the steady leg re-enters the SAME
+    executable with a salted liar program — different liars, rounds,
+    kinds and quorum — the pure-operand proof that byz content never
+    enters the trace).  byzantine_conv is a plain-jit family, so it
+    sits INSIDE the all-hit warm proof, unlike the two host-only
+    chokepoint families.  Steady and warm budgets held, >= 3x
+    warm-start aggregate, provenance present; the cost probe's
+    chokepoint verdicts stay pinned as in r24."""
+    path = os.path.join(_REPO, "artifacts",
+                        "ledger_dryrun_r25_4dev.jsonl")
+    _assert_cold_warm_record(
+        path, FAMILIES,
+        host_only=frozenset({"request_trace", "cost_attribution"}))
     all_events = telemetry.load_ledger(path)
     run_ids = telemetry_report.runs(all_events)
     per_run = []

@@ -22,15 +22,15 @@ _spec.loader.exec_module(ledger_diff)
 R09_4DEV = os.path.join(_REPO, "artifacts",
                         "ledger_dryrun_r09_4dev.jsonl")
 R09_8DEV = os.path.join(_REPO, "artifacts", "ledger_dryrun_r09.jsonl")
-# the observability PR's 4-device record: same family set as the
+# the byzantine-nemesis PR's 4-device record: same family set as the
 # live dry run (churn_heal, churn_sweep, crdt_counter, serving_batch,
 # kafka_log, txn_register, fused_churn_sweep, fleet_failover,
-# scale_plan, mesh_serving, request_trace, scale_stream_overlap AND
-# cost_attribution included), so the tier-1 gate compares every
-# family like-for-like; r23 (pipelined-streaming PR) stays committed
-# as history but predates the cost_attribution family
-R24_4DEV = os.path.join(_REPO, "artifacts",
-                        "ledger_dryrun_r24_4dev.jsonl")
+# scale_plan, mesh_serving, request_trace, scale_stream_overlap,
+# cost_attribution AND byzantine_conv included), so the tier-1 gate
+# compares every family like-for-like; r24 (observability PR) stays
+# committed as history but predates the byzantine_conv family
+R25_4DEV = os.path.join(_REPO, "artifacts",
+                        "ledger_dryrun_r25_4dev.jsonl")
 
 
 def _write_run(path, families, device_count=4, metrics=None,
@@ -217,12 +217,14 @@ def test_committed_4dev_record_vs_fresh_dryrun_is_clean(dryrun_pair,
     against this session's live warm dry run (same device count, same
     machine class) must come back clean — walls within threshold+floor,
     budgets held, protocol totals compared at equal device count.
-    Since the observability PR the committed record is r24, whose
+    Since the byzantine-nemesis PR the committed record is r25, whose
     family set includes churn_heal, churn_sweep, crdt_counter,
     serving_batch, kafka_log, txn_register, fused_churn_sweep,
     fleet_failover, scale_plan, mesh_serving, request_trace,
-    scale_stream_overlap AND cost_attribution, so the attribution
-    chokepoint family's walls gate like every other family.
+    scale_stream_overlap, cost_attribution AND byzantine_conv (the
+    defended sharded step under a mixed fail-stop + liar program, with
+    a salted steady re-entry), so the adversarial family's walls gate
+    like every other family.
 
     Thresholds are calibrated to this container's measured noise: a
     full-suite run swings individual families' warm FIRST-call walls
@@ -240,7 +242,7 @@ def test_committed_4dev_record_vs_fresh_dryrun_is_clean(dryrun_pair,
     own absolute budget check — which never flaked — flags it.  The
     first_ms wall mechanism itself stays pinned on the synthetic
     fixtures above and the injected-regression test below."""
-    rc = ledger_diff.main([R24_4DEV,
+    rc = ledger_diff.main([R25_4DEV,
                            dryrun_pair["warm"]["ledger_path"],
                            "--first-floor-ms", "10000",
                            "--steady-floor-ms", "150"])
@@ -253,7 +255,7 @@ def test_committed_4dev_record_vs_fresh_dryrun_is_clean(dryrun_pair,
     assert "fused_churn_sweep" in out and "fleet_failover" in out
     assert "scale_plan" in out and "mesh_serving" in out
     assert "request_trace" in out and "scale_stream_overlap" in out
-    assert "cost_attribution" in out
+    assert "cost_attribution" in out and "byzantine_conv" in out
     assert "only in" not in out
     # the metric join actually engaged (same device count, fused
     # drivers instrumented in both)
@@ -268,19 +270,19 @@ def test_committed_record_with_injected_2x_wall_is_flagged(tmp_path,
     calibration that forgives uniform host load, proving the
     thresholds catch a real regression, not just synthetic
     fixtures."""
-    events = telemetry.load_ledger(R24_4DEV)
+    events = telemetry.load_ledger(R25_4DEV)
     runs = [e["run"] for e in events if e.get("ev") == "provenance"]
     warm = runs[-1]
     doubled = str(tmp_path / "doubled.jsonl")
     # churn_sweep carries one of the record's largest warm first-call
-    # walls (~615 ms in r24), so its doubled delta clears a 500 ms
+    # walls (~733 ms in r25), so its doubled delta clears a 500 ms
     # floor — the injection proves the wall mechanism fires on REAL
     # committed data at a noise-hardened floor (warm-wall jitter is
     # tens of ms; the tier-1 like-for-like gate above goes further and
     # hands first_ms detection to the cache-verdict assertions
     # entirely; this pin keeps the wall path honest for manual/CLI
     # use)
-    with open(R24_4DEV) as f, open(doubled, "w") as g:
+    with open(R25_4DEV) as f, open(doubled, "w") as g:
         for line in f:
             if not line.strip():
                 continue
@@ -291,7 +293,7 @@ def test_committed_record_with_injected_2x_wall_is_flagged(tmp_path,
                     if isinstance(e.get(k), (int, float)):
                         e[k] = 2 * e[k]
             g.write(json.dumps(e) + "\n")
-    rc = ledger_diff.main([R24_4DEV, doubled, "--first-floor-ms",
+    rc = ledger_diff.main([R25_4DEV, doubled, "--first-floor-ms",
                            "500", "--steady-floor-ms", "150"])
     out = capsys.readouterr().out
     assert rc == 1

@@ -55,6 +55,16 @@ def test_recompile_fixture_flags():
     assert "jnp-over-k" in rules, found
     assert "jit-in-request-path" in rules, found
     assert "content-in-memo-key" in rules, found
+    # byz-table-in-memo-key: the planted liar-keyed builder flags on
+    # BOTH byz params; the *_static escape and the eager-values memo
+    # (build_byz's own lowering pattern) stay silent
+    byz = [f for f in found if f.rule == "byz-table-in-memo-key"]
+    assert {f.symbol for f in byz} == {"_cached_byz_loop"}, found
+    assert len(byz) == 2, found           # 'liars' AND 'quorum'
+    assert not any(f.symbol == "_cached_byz_clean_loop"
+                   for f in found), found
+    assert not any(f.symbol == "_cached_byz_values"
+                   for f in found), found
     # the jnp-over-K hazard flags all three planted builds (asarray +
     # stack + the nested helper's stack, each exactly once)
     assert sum(f.rule == "jnp-over-k" for f in found) == 3, found

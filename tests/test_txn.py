@@ -421,6 +421,101 @@ def test_checker_defects_and_convergence_cross_check():
     assert check_txn_trace(leak, final_reads=clean_final)["ok"]
 
 
+def test_checker_flags_planted_g1b_intermediate_read():
+    """A committed FOREIGN read of a value its writer itself
+    overwrote on the same key MUST be classified G1b (intermediate
+    read); reading the writer's FINAL value of the key is legitimate,
+    and a txn re-reading its own intermediate write is not G1b (the
+    read-your-writes path, not an isolation leak)."""
+    from gossip_tpu.runtime.txn_checker import check_txn_trace
+    planted = [
+        _committed(1, writes=[("x", 10, (1, 0)), ("x", 12, (2, 0))]),
+        _committed(2, reads=[["x", 10]]),
+    ]
+    out = check_txn_trace(planted)
+    assert out["g1b"] == [{"reader": 2, "writer": 1, "key": "x",
+                           "value": 10, "final": 12}]
+    assert not out["ok"]
+    # negative twin: the reader saw the writer's FINAL value — clean
+    final_read = [
+        _committed(1, writes=[("x", 10, (1, 0)), ("x", 12, (2, 0))]),
+        _committed(2, reads=[["x", 12]]),
+    ]
+    out2 = check_txn_trace(final_read)
+    assert not out2["g1b"] and out2["ok"]
+    # negative twin: SELF-read of an intermediate value — clean
+    self_read = [
+        _committed(1, writes=[("x", 10, (1, 0)), ("x", 12, (2, 0))],
+                   reads=[["x", 10]]),
+    ]
+    out3 = check_txn_trace(self_read)
+    assert not out3["g1b"] and out3["ok"]
+
+
+def test_checker_flags_planted_g1c_circular_information_flow():
+    """A ww u wr cycle closed by a wr edge MUST be classified G1c
+    (circular information flow): T2's y-write precedes T1's (ww
+    T2 -> T1) while T2 reads T1's x-write (wr T1 -> T2) — no ww-only
+    cycle, so G0 stays empty and the wr edge is what closes the
+    loop.  Shifting T2's y-write after T1's breaks the cycle."""
+    from gossip_tpu.runtime.txn_checker import check_txn_trace
+    planted = [
+        _committed(1, writes=[("x", 10, (1, 0)), ("y", 11, (2, 0))]),
+        _committed(2, writes=[("y", 21, (1, 1))], reads=[["x", 10]]),
+    ]
+    out = check_txn_trace(planted)
+    assert not out["g0"]
+    assert out["g1c"] and not out["ok"]
+    cyc = out["g1c"][0]
+    assert cyc["cycle"][0] == cyc["cycle"][-1]
+    assert set(cyc["cycle"]) == {1, 2}
+    assert cyc["wr_edge"] == [1, 2, "x"]
+    # negative twin: same reads, T2's y-write AFTER T1's — both edges
+    # now point T1 -> T2, no cycle, clean
+    ordered = [
+        _committed(1, writes=[("x", 10, (1, 0)), ("y", 11, (2, 0))]),
+        _committed(2, writes=[("y", 21, (3, 1))], reads=[["x", 10]]),
+    ]
+    out2 = check_txn_trace(ordered)
+    assert not out2["g1c"] and out2["ok"]
+
+
+def test_checker_reports_lost_update_without_failing_verdict():
+    """Two committed txns that both read the same (key, pre-value)
+    snapshot and both wrote the key MUST be reported as a lost update
+    — but the verdict stays ok: LWW read-committed registers lose
+    concurrent updates BY DESIGN (a live partitioned run can
+    legitimately produce one), so the checker reports the anomaly for
+    the harness counts without branding the trace a violation."""
+    from gossip_tpu.runtime.txn_checker import check_txn_trace
+    planted = [
+        _committed(1, writes=[("x", 5, (1, 0))], reads=[["x", None]]),
+        _committed(2, writes=[("x", 7, (2, 1))], reads=[["x", None]]),
+    ]
+    out = check_txn_trace(planted)
+    assert out["lost_update"] == [{"key": "x", "pre": None,
+                                   "txns": [1, 2]}]
+    assert out["ok"]  # reported, NOT folded into the verdict
+    # non-None pre-value: two RMWs atop the same committed version
+    stacked = [
+        _committed(1, writes=[("x", 5, (1, 0))]),
+        _committed(2, writes=[("x", 6, (2, 1))], reads=[["x", 5]]),
+        _committed(3, writes=[("x", 7, (3, 2))], reads=[["x", 5]]),
+    ]
+    out2 = check_txn_trace(stacked)
+    assert out2["lost_update"] == [{"key": "x", "pre": 5,
+                                    "txns": [2, 3]}]
+    assert out2["ok"]
+    # negative twin: SERIALIZED read-modify-writes — each read sees
+    # the prior write, no shared snapshot, nothing lost
+    serial = [
+        _committed(1, writes=[("x", 5, (1, 0))], reads=[["x", None]]),
+        _committed(2, writes=[("x", 7, (2, 1))], reads=[["x", 5]]),
+    ]
+    out3 = check_txn_trace(serial)
+    assert not out3["lost_update"] and out3["ok"]
+
+
 # -- CLI ---------------------------------------------------------------
 
 def test_cli_txn_run_and_error_paths(capsys, monkeypatch):
@@ -545,7 +640,9 @@ def test_txn_workload_through_partition_direct_api():
     assert stats["partitioned"] is True
     assert stats["g0_ok"] is True and stats["g1a_ok"] is True
     assert stats["converged"] is True
-    assert stats["anomalies"] == {"g0": 0, "g1a": 0, "defects": 0}
+    assert stats["anomalies"] == {"g0": 0, "g1a": 0, "g1b": 0,
+                                  "g1c": 0, "lost_update": 0,
+                                  "defects": 0}
     assert stats["committed"] > 0
     # txns + final read-alls are client ops via the shared accounting
     assert stats["ops"] > 12 and stats["broadcast_ops"] == 0
@@ -565,7 +662,8 @@ def test_cli_maelstrom_check_txn_in_gate(capsys):
     assert out["invariant_ok"] is True and out["partitioned"] is True
     assert out["g0_ok"] is True and out["g1a_ok"] is True
     assert out["converged"] is True
-    assert out["anomalies"] == {"g0": 0, "g1a": 0, "defects": 0}
+    assert out["anomalies"] == {"g0": 0, "g1a": 0, "g1b": 0, "g1c": 0,
+                                "lost_update": 0, "defects": 0}
     assert out["committed"] > 0
     # the native router speaks the broadcast envelope set only
     rc = cli.main(["maelstrom-check", "--workload", "txn",

@@ -482,6 +482,18 @@ def cost_attribution():
     return _run_tool("cost_capture.py", COST_TIMEOUT_S)
 
 
+def byzantine_conv():
+    """The byzantine-adversary convergence record on this host
+    (tools/byzantine_capture.py, docs/ROBUSTNESS.md "Byzantine
+    adversaries"): the mixed fail-stop + scripted-liar scenario with
+    the defended arm converging EXACTLY on the honest eventual-alive
+    set (integer count == denominator) while the undefended control
+    arm provably diverges, plus bitwise 1-vs-4-device mesh parity.
+    Integer arithmetic on honest-owned components, not a chip rate —
+    but re-proven on whatever host the hardware captures run on."""
+    return _run_tool("byzantine_capture.py", BYZ_TIMEOUT_S)
+
+
 def fleet_failover():
     """The replicated serving fleet's crashloop on this host
     (tools/fleet_crashloop.py): the load mix through the fronting
@@ -736,6 +748,7 @@ MESH_SERVING_TIMEOUT_S = 1200   # thousands of connections x 2 legs
 SCALE_TIMEOUT_S = 1200          # structural record: ~2 min on CPU
 FULL_SCALE_TIMEOUT_S = 3600     # the 100M leg owns a real window slot
 COST_TIMEOUT_S = 900            # 7 tiny compiles + one forced-tile run
+BYZ_TIMEOUT_S = 900             # 2 payload classes x 2 arms + parity
 
 STEPS = [("staticcheck", staticcheck),
          ("swim_diss_ab", swim_diss_ab),
@@ -744,6 +757,7 @@ STEPS = [("staticcheck", staticcheck),
          ("mr_staged_10m", mr_staged_10m),
          ("prng_invariant", prng_invariant),
          ("fused_churn_sweep", fused_churn_sweep),
+         ("byzantine_conv", byzantine_conv),
          ("scale_plan", scale_plan),
          ("cost_attribution", cost_attribution),
          ("fleet_failover", fleet_failover),

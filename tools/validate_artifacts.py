@@ -101,6 +101,19 @@ def _is_nemesis_name(name: str) -> bool:
             or "crashloop" in name or "crdt" in name)
 
 
+def _is_byz_name(name: str) -> bool:
+    """Byzantine-adversary artifacts by name — the liar-scenario
+    evidence (defended honest-set convergence vs the undefended
+    control arm, quorum parameters, mesh-parity verdicts —
+    ops/nemesis byz programs via tools/byzantine_capture) must always
+    be attributable; the legacy allowlist can never grandfather one
+    in (the whole byzantine layer post-dates the provenance schema).
+    An unattributed adversary record is the exact claim the defense
+    lattice exists to reject: state nobody can trace to a writer."""
+    return ("byz" in name or "byzantine" in name
+            or "adversary" in name)
+
+
 def _is_log_name(name: str) -> bool:
     """Replicated-log ("kafka") artifacts by name — log-convergence
     verdicts and workload invariant records (the ordered
@@ -267,6 +280,12 @@ def validate_file(path):
                     "— isolation-anomaly and LWW-convergence "
                     "evidence must be attributable, allowlist or not "
                     "(utils/telemetry.provenance)")
+            if not has_prov and _is_byz_name(name):
+                problems.append(
+                    "byzantine/adversary artifact without a "
+                    "provenance line — liar-scenario evidence must "
+                    "be attributable, allowlist or not "
+                    "(utils/telemetry.provenance)")
             if not has_prov and _is_fused_sweep_name(name):
                 problems.append(
                     "fused-sweep artifact without a provenance line — "
@@ -328,6 +347,11 @@ def validate_file(path):
                     f"{PROVENANCE_KEYS} — isolation-anomaly and "
                     "LWW-convergence evidence must be attributable, "
                     "allowlist or not")
+            elif _is_byz_name(name) and not _has_provenance_keys(doc):
+                problems.append(
+                    "byzantine/adversary artifact without provenance "
+                    f"keys {PROVENANCE_KEYS} — liar-scenario evidence "
+                    "must be attributable, allowlist or not")
             elif _is_fused_sweep_name(name) \
                     and not _has_provenance_keys(doc):
                 problems.append(
